@@ -1,0 +1,23 @@
+"""jubatus_tpu — a TPU-native distributed online machine-learning framework.
+
+A from-scratch framework with the capabilities of Jubatus (the reference
+surveyed in SURVEY.md): a family of online-learning engines — classifier,
+regression, recommender, nearest_neighbor, anomaly, clustering, stat, weight,
+bandit, burst, graph — that train on streaming data, serve queries over
+MessagePack-RPC, and scale out across a TPU pod.
+
+Architecture (TPU-first, not a port):
+
+- The *model plane* is JAX: model state lives in device arrays (sharded via
+  ``jax.sharding`` on multi-chip meshes), learning updates are jitted XLA
+  programs (``jubatus_tpu.ops``), and the distributed "mix" (model averaging,
+  the reference's get_diff/put_diff RPC loop) is an XLA collective (psum over
+  ICI) — see ``jubatus_tpu.parallel``.
+- The *serving plane* is a MessagePack-RPC front end speaking the reference's
+  wire protocol (``jubatus_tpu.rpc``) so existing jubatus clients work,
+  feeding microbatched updates into the JAX runtime.
+- ``jubatus_tpu.framework`` is the server lifecycle: config, save/load in the
+  reference's checkpoint envelope, mixer scheduling, status.
+"""
+
+from jubatus_tpu.version import VERSION, __version__  # noqa: F401

@@ -1,0 +1,11 @@
+"""Core kernel library (the rebuild of the jubatus_core surface, SURVEY.md §2.9).
+
+- ``datum``: the user-facing input record (string/num/binary key-values).
+- ``fv``: the feature-vector converter — config-driven datum → weighted sparse
+  feature vector, hashed into a fixed 2^k feature space (hashing trick) so the
+  model plane is dense JAX arrays instead of string-keyed hash maps.
+- ``sparse``: padded batched sparse-vector representation fed to XLA kernels.
+"""
+
+from jubatus_tpu.core.datum import Datum  # noqa: F401
+from jubatus_tpu.core.sparse import SparseBatch, SparseVector  # noqa: F401

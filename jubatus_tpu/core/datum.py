@@ -1,0 +1,92 @@
+"""The datum type — the universal input record.
+
+Equivalent of core::fv_converter::datum consumed throughout the reference
+(client side mirror: /root/reference/jubatus/client/common/datum.hpp). A datum
+is three lists of (key, value) pairs: string, numeric, and binary. On the wire
+(MessagePack-RPC) it is the 3-tuple of those lists, which is the reference's
+msgpack layout for datum.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Tuple
+
+
+class Datum:
+    """An input record: string, numeric and binary key-value pairs."""
+
+    __slots__ = ("string_values", "num_values", "binary_values")
+
+    def __init__(
+        self,
+        values: Any = None,
+        *,
+        string_values: Iterable[Tuple[str, str]] = (),
+        num_values: Iterable[Tuple[str, float]] = (),
+        binary_values: Iterable[Tuple[str, bytes]] = (),
+    ) -> None:
+        self.string_values: List[Tuple[str, str]] = list(string_values)
+        self.num_values: List[Tuple[str, float]] = list(num_values)
+        self.binary_values: List[Tuple[str, bytes]] = list(binary_values)
+        if values is not None:
+            # Convenience constructor: Datum({"age": 25, "name": "x"}) routes
+            # each value to the right list by Python type.
+            for k, v in (values.items() if isinstance(values, dict) else values):
+                self.add(k, v)
+
+    def add(self, key: str, value: Any) -> "Datum":
+        if isinstance(value, bool):
+            raise TypeError("datum values must be str, number, or bytes")
+        if isinstance(value, str):
+            self.string_values.append((key, value))
+        elif isinstance(value, (int, float)):
+            self.num_values.append((key, float(value)))
+        elif isinstance(value, (bytes, bytearray)):
+            self.binary_values.append((key, bytes(value)))
+        else:
+            raise TypeError(f"unsupported datum value type: {type(value)!r}")
+        return self
+
+    add_string = add
+    add_number = add
+    add_binary = add
+
+    # -- wire format (msgpack tuple of three kv lists) ----------------------
+    def to_msgpack(self):
+        return (
+            [list(kv) for kv in self.string_values],
+            [list(kv) for kv in self.num_values],
+            [list(kv) for kv in self.binary_values],
+        )
+
+    @classmethod
+    def from_msgpack(cls, obj) -> "Datum":
+        d = cls()
+        if obj is None:
+            return d
+        sv = obj[0] if len(obj) > 0 else []
+        nv = obj[1] if len(obj) > 1 else []
+        bv = obj[2] if len(obj) > 2 else []
+
+        def _s(x):
+            return x.decode("utf-8", "replace") if isinstance(x, bytes) else x
+
+        d.string_values = [(_s(k), _s(v)) for k, v in sv]
+        d.num_values = [(_s(k), float(v)) for k, v in nv]
+        d.binary_values = [(_s(k), v) for k, v in bv]
+        return d
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"Datum(string_values={self.string_values!r}, "
+            f"num_values={self.num_values!r}, "
+            f"binary_values=<{len(self.binary_values)} items>)"
+        )
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, Datum)
+            and self.string_values == other.string_values
+            and self.num_values == other.num_values
+            and self.binary_values == other.binary_values
+        )

@@ -1,0 +1,18 @@
+"""Feature-vector converter (rebuild of core::fv_converter, SURVEY.md §2.9).
+
+Pipeline: datum → (filters) → per-rule feature extraction → weighting
+(sample_weight × global_weight) → combination features → hashed sparse vector
+in a fixed 2^k index space.
+
+The hashing trick replaces the reference's string-keyed sparse weight maps:
+models become dense JAX arrays indexed by feature hash, which is what lets
+updates run as XLA scatter/gather kernels and lets mix run as a psum.
+"""
+
+from jubatus_tpu.core.fv.converter import (  # noqa: F401
+    ConverterConfig,
+    DatumToFVConverter,
+    make_fv_converter,
+)
+from jubatus_tpu.core.fv.hashing import FeatureHasher  # noqa: F401
+from jubatus_tpu.core.fv.weight_manager import WeightManager  # noqa: F401

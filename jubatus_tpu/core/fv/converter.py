@@ -1,0 +1,460 @@
+"""Config-driven datum → weighted sparse feature vector.
+
+Implements the converter JSON schema used by every engine config in the
+reference (e.g. /root/reference/config/classifier/pa.json,
+config/weight/default.json): string/num filter types+rules, string/num
+types+rules, combination types+rules, with sample weights (bin/tf/log_tf) and
+global weights (bin/idf/weight).
+
+Feature naming follows the reference's convention so weight-engine dumps and
+decode paths read the same:
+  string features:  "<key>$<value>@<type>#<sample_weight>/<global_weight>"
+  num features:     "<key>@num" / "<key>@log" / "<key>$<value>@str"
+  combinations:     "<left>&<right>"
+
+Output is hashed into the FeatureHasher's 2^k index space (core/fv/hashing.py)
+— the dense-array model plane starts here.
+
+Plugin ("dynamic") types — the reference's dlopen'd mecab/ux/image plugins
+(SURVEY.md §2.8) — are resolved through a Python registry
+(register_string_type / register_num_type) instead of so_factory.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.core.fv.hashing import FeatureHasher
+from jubatus_tpu.core.fv.weight_manager import WeightManager
+from jubatus_tpu.core.sparse import SparseVector
+
+
+class ConverterError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# key matchers: "*" all, "prefix*", "*suffix", exact
+# ---------------------------------------------------------------------------
+def make_key_matcher(pattern: str) -> Callable[[str], bool]:
+    if pattern == "*":
+        return lambda key: True
+    if pattern.endswith("*"):
+        prefix = pattern[:-1]
+        return lambda key: key.startswith(prefix)
+    if pattern.startswith("*"):
+        suffix = pattern[1:]
+        return lambda key: key.endswith(suffix)
+    return lambda key: key == pattern
+
+
+# ---------------------------------------------------------------------------
+# plugin registry (replaces so_factory + "dynamic" method, SURVEY.md §2.8)
+# ---------------------------------------------------------------------------
+_STRING_TYPE_PLUGINS: Dict[str, Callable[[Dict[str, str]], "Splitter"]] = {}
+_NUM_TYPE_PLUGINS: Dict[str, Callable[[Dict[str, str]], Callable]] = {}
+
+
+def register_string_type(name: str, factory) -> None:
+    _STRING_TYPE_PLUGINS[name] = factory
+
+
+def register_num_type(name: str, factory) -> None:
+    _NUM_TYPE_PLUGINS[name] = factory
+
+
+# ---------------------------------------------------------------------------
+# string splitters
+# ---------------------------------------------------------------------------
+Splitter = Callable[[str], List[str]]
+
+
+def _split_whole(text: str) -> List[str]:
+    return [text] if text else []
+
+
+def _split_space(text: str) -> List[str]:
+    return text.split()
+
+
+def _make_ngram(char_num: int) -> Splitter:
+    def split(text: str) -> List[str]:
+        return [text[i : i + char_num] for i in range(len(text) - char_num + 1)]
+
+    return split
+
+
+def _make_regexp_splitter(pattern: str, group: int) -> Splitter:
+    rx = re.compile(pattern)
+
+    def split(text: str) -> List[str]:
+        return [m.group(group) for m in rx.finditer(text)]
+
+    return split
+
+
+def _build_string_type(name: str, params: Dict[str, str]) -> Splitter:
+    method = params.get("method")
+    if method == "ngram":
+        char_num = int(params.get("char_num", "1"))
+        if char_num < 1:
+            raise ConverterError(f"ngram char_num must be >= 1: {char_num}")
+        return _make_ngram(char_num)
+    if method == "regexp":
+        return _make_regexp_splitter(params["pattern"], int(params.get("group", "0")))
+    if method == "dynamic":
+        plug = params.get("function") or params.get("path", "")
+        if plug in _STRING_TYPE_PLUGINS:
+            return _STRING_TYPE_PLUGINS[plug](params)
+        raise ConverterError(f"unknown dynamic string type plugin: {plug!r}")
+    raise ConverterError(f"unknown string type method {method!r} for {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# filters
+# ---------------------------------------------------------------------------
+def _build_string_filter(params: Dict[str, str]) -> Callable[[str], str]:
+    method = params.get("method")
+    if method == "regexp":
+        rx = re.compile(params["pattern"])
+        replace = params.get("replace", "")
+        return lambda text: rx.sub(replace, text)
+    raise ConverterError(f"unknown string filter method {method!r}")
+
+
+def _build_num_filter(params: Dict[str, str]) -> Callable[[float], float]:
+    method = params.get("method")
+    if method == "add":
+        value = float(params["value"])
+        return lambda x: x + value
+    if method == "linear_normalization":
+        lo, hi = float(params["min"]), float(params["max"])
+        if hi <= lo:
+            raise ConverterError("linear_normalization requires max > min")
+        return lambda x: (min(max(x, lo), hi) - lo) / (hi - lo)
+    if method == "gaussian_normalization":
+        mean = float(params["average"])
+        std = float(params["standard_deviation"])
+        if std <= 0:
+            raise ConverterError("gaussian_normalization requires positive stddev")
+        return lambda x: (x - mean) / std
+    if method == "sigmoid_normalization":
+        gain, bias = float(params["gain"]), float(params["bias"])
+        return lambda x: 1.0 / (1.0 + math.exp(-gain * (x - bias)))
+    raise ConverterError(f"unknown num filter method {method!r}")
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+class StringRule:
+    def __init__(self, key: str, type_name: str, sample_weight: str, global_weight: str):
+        self.matcher = make_key_matcher(key)
+        self.type_name = type_name
+        if sample_weight not in ("bin", "tf", "log_tf"):
+            raise ConverterError(f"unknown sample_weight {sample_weight!r}")
+        if global_weight not in ("bin", "idf", "weight"):
+            raise ConverterError(f"unknown global_weight {global_weight!r}")
+        self.sample_weight = sample_weight
+        self.global_weight = global_weight
+
+
+class NumRule:
+    def __init__(self, key: str, type_name: str):
+        self.matcher = make_key_matcher(key)
+        self.type_name = type_name
+
+
+class FilterRule:
+    def __init__(self, key: str, type_name: str, suffix: str):
+        self.matcher = make_key_matcher(key)
+        self.type_name = type_name
+        self.suffix = suffix
+
+
+class CombinationRule:
+    def __init__(self, key_left: str, key_right: str, type_name: str):
+        self.match_left = make_key_matcher(key_left)
+        self.match_right = make_key_matcher(key_right)
+        self.type_name = type_name
+
+
+class ConverterConfig:
+    """Parsed "converter" block of an engine config JSON."""
+
+    def __init__(self, raw: Optional[dict] = None):
+        raw = raw or {}
+        self.raw = raw
+
+        self.string_types: Dict[str, Splitter] = {
+            "str": _split_whole,
+            "space": _split_space,
+        }
+        for name, params in (raw.get("string_types") or {}).items():
+            self.string_types[name] = _build_string_type(name, params)
+
+        self.string_filters: Dict[str, Callable[[str], str]] = {}
+        for name, params in (raw.get("string_filter_types") or {}).items():
+            self.string_filters[name] = _build_string_filter(params)
+
+        self.num_filters: Dict[str, Callable[[float], float]] = {}
+        for name, params in (raw.get("num_filter_types") or {}).items():
+            self.num_filters[name] = _build_num_filter(params)
+
+        # built-in num types: num / log / str; "dynamic" via registry
+        self.num_types: Dict[str, str] = {"num": "num", "log": "log", "str": "str"}
+        self.num_type_fns: Dict[str, Callable] = {}
+        for name, params in (raw.get("num_types") or {}).items():
+            method = params.get("method")
+            if method == "dynamic":
+                plug = params.get("function") or params.get("path", "")
+                if plug not in _NUM_TYPE_PLUGINS:
+                    raise ConverterError(f"unknown dynamic num type plugin: {plug!r}")
+                self.num_type_fns[name] = _NUM_TYPE_PLUGINS[plug](params)
+            elif method in ("num", "log", "str"):
+                self.num_types[name] = method
+            else:
+                raise ConverterError(f"unknown num type method {method!r}")
+
+        self.string_rules = [
+            StringRule(
+                r["key"],
+                r["type"],
+                r.get("sample_weight", "bin"),
+                r.get("global_weight", "bin"),
+            )
+            for r in (raw.get("string_rules") or [])
+        ]
+        self.num_rules = [NumRule(r["key"], r["type"]) for r in (raw.get("num_rules") or [])]
+        self.string_filter_rules = [
+            FilterRule(r["key"], r["type"], r["suffix"])
+            for r in (raw.get("string_filter_rules") or [])
+        ]
+        self.num_filter_rules = [
+            FilterRule(r["key"], r["type"], r["suffix"])
+            for r in (raw.get("num_filter_rules") or [])
+        ]
+        # combination types: built-ins mul/add, or named with method mul/add
+        self.combination_types: Dict[str, str] = {"mul": "mul", "add": "add"}
+        for name, params in (raw.get("combination_types") or {}).items():
+            method = params.get("method")
+            if method not in ("mul", "add"):
+                raise ConverterError(f"unknown combination method {method!r}")
+            self.combination_types[name] = method
+        self.combination_rules = [
+            CombinationRule(r["key_left"], r["key_right"], r["type"])
+            for r in (raw.get("combination_rules") or [])
+        ]
+
+        # validate referenced type names exist
+        for r in self.string_rules:
+            if r.type_name not in self.string_types:
+                raise ConverterError(f"string rule references unknown type {r.type_name!r}")
+        for r in self.num_rules:
+            if r.type_name not in self.num_types and r.type_name not in self.num_type_fns:
+                raise ConverterError(f"num rule references unknown type {r.type_name!r}")
+        for r in self.string_filter_rules:
+            if r.type_name not in self.string_filters:
+                raise ConverterError(f"string filter rule references unknown type {r.type_name!r}")
+        for r in self.num_filter_rules:
+            if r.type_name not in self.num_filters:
+                raise ConverterError(f"num filter rule references unknown type {r.type_name!r}")
+        for r in self.combination_rules:
+            if r.type_name not in self.combination_types:
+                raise ConverterError(f"combination rule references unknown type {r.type_name!r}")
+
+
+# ---------------------------------------------------------------------------
+# the converter
+# ---------------------------------------------------------------------------
+class DatumToFVConverter:
+    """datum → hashed weighted sparse feature vector."""
+
+    def __init__(
+        self,
+        config: ConverterConfig,
+        hasher: Optional[FeatureHasher] = None,
+        weights: Optional[WeightManager] = None,
+    ):
+        self.config = config
+        self.hasher = hasher or FeatureHasher()
+        self.weights = weights or WeightManager(self.hasher.dim)
+
+    @property
+    def dim(self) -> int:
+        return self.hasher.dim
+
+    # -- filters ------------------------------------------------------------
+    def _apply_filters(self, datum: Datum) -> Datum:
+        cfg = self.config
+        out = Datum(
+            string_values=datum.string_values,
+            num_values=datum.num_values,
+            binary_values=datum.binary_values,
+        )
+        for rule in cfg.string_filter_rules:
+            fn = cfg.string_filters[rule.type_name]
+            for key, value in list(out.string_values):
+                if rule.matcher(key):
+                    out.string_values.append((key + rule.suffix, fn(value)))
+        for rule in cfg.num_filter_rules:
+            fn = cfg.num_filters[rule.type_name]
+            for key, value in list(out.num_values):
+                if rule.matcher(key):
+                    out.num_values.append((key + rule.suffix, fn(value)))
+        return out
+
+    # -- extraction ---------------------------------------------------------
+    def _named_features(self, datum: Datum) -> Dict[str, float]:
+        """Produce the weighted feature dict keyed by full feature name."""
+        cfg = self.config
+        datum = self._apply_filters(datum)
+        features: Dict[str, float] = {}
+
+        # string rules
+        for rule in cfg.string_rules:
+            splitter = cfg.string_types[rule.type_name]
+            for key, text in datum.string_values:
+                if not rule.matcher(key):
+                    continue
+                counts: Dict[str, int] = {}
+                for term in splitter(text):
+                    counts[term] = counts.get(term, 0) + 1
+                for term, tf in counts.items():
+                    if rule.sample_weight == "bin":
+                        sw = 1.0
+                    elif rule.sample_weight == "tf":
+                        sw = float(tf)
+                    else:  # log_tf
+                        sw = math.log(1.0 + tf)
+                    name = (
+                        f"{key}${term}@{rule.type_name}"
+                        f"#{rule.sample_weight}/{rule.global_weight}"
+                    )
+                    features[name] = features.get(name, 0.0) + sw
+
+        # num rules
+        for rule in cfg.num_rules:
+            kind = cfg.num_types.get(rule.type_name)
+            fn = cfg.num_type_fns.get(rule.type_name)
+            for key, value in datum.num_values:
+                if not rule.matcher(key):
+                    continue
+                if fn is not None:
+                    for name, v in fn(key, value):
+                        features[name] = features.get(name, 0.0) + v
+                    continue
+                tname = rule.type_name
+                if kind == "num":
+                    name = f"{key}@{tname}"
+                    features[name] = features.get(name, 0.0) + value
+                elif kind == "log":
+                    name = f"{key}@{tname}"
+                    features[name] = features.get(name, 0.0) + math.log(max(1.0, value))
+                elif kind == "str":
+                    name = f"{key}${_format_num(value)}@{tname}"
+                    features[name] = features.get(name, 0.0) + 1.0
+
+        # combination features over the features produced so far. Each rule
+        # emits each unordered pair once (canonical name order), regardless of
+        # which side matched which matcher; values accumulate across rules.
+        if cfg.combination_rules:
+            base = list(features.items())
+            for rule in cfg.combination_rules:
+                op = cfg.combination_types[rule.type_name]
+                seen = set()
+                for lname, lval in base:
+                    if not rule.match_left(lname):
+                        continue
+                    for rname, rval in base:
+                        if lname == rname or not rule.match_right(rname):
+                            continue
+                        a, b = (lname, rname) if lname < rname else (rname, lname)
+                        if (a, b) in seen:
+                            continue
+                        seen.add((a, b))
+                        cval = lval * rval if op == "mul" else lval + rval
+                        name = f"{a}&{b}"
+                        features[name] = features.get(name, 0.0) + cval
+
+        return features
+
+    # -- hashing + global weights -------------------------------------------
+    def convert(self, datum: Datum, update_weights: bool = False) -> SparseVector:
+        """Convert to hashed (index, value) pairs, applying global weights.
+
+        update_weights=True is the train path (reference's
+        convert_and_update_weight): document frequencies are recorded before
+        idf lookup.
+        """
+        named = self._named_features(datum)
+        # hash + resolve global weight per feature
+        hashed: Dict[int, float] = {}
+        idf_indices = []
+        entries: List[Tuple[int, float, str]] = []
+        for name, value in named.items():
+            idx = self.hasher.index(name)
+            gw_kind = _global_weight_kind(name)
+            entries.append((idx, value, gw_kind))
+            if gw_kind == "idf":
+                idf_indices.append(idx)
+        if update_weights and idf_indices:
+            self.weights.observe(set(idf_indices))
+        for idx, value, gw_kind in entries:
+            if gw_kind == "idf":
+                value *= self.weights.idf(idx)
+            elif gw_kind == "weight":
+                value *= self.weights.user_weight(idx)
+            hashed[idx] = hashed.get(idx, 0.0) + value
+        return sorted(hashed.items())
+
+    def convert_named(self, datum: Datum) -> Dict[str, float]:
+        """Named (unhashed) features with global weights applied — for the
+        weight engine's calc_weight and for tests."""
+        named = self._named_features(datum)
+        out = {}
+        for name, value in named.items():
+            gw_kind = _global_weight_kind(name)
+            idx = self.hasher.index(name)
+            if gw_kind == "idf":
+                value *= self.weights.idf(idx)
+            elif gw_kind == "weight":
+                value *= self.weights.user_weight(idx)
+            out[name] = value
+        return out
+
+    def revert_feature(self, index: int) -> Optional[Tuple[str, str]]:
+        """Best-effort hash→(key, value) decode, for decode_row-style APIs."""
+        name = self.hasher.name_of(index)
+        if name is None:
+            return None
+        if "$" in name:
+            key, rest = name.split("$", 1)
+            value = rest.split("@", 1)[0]
+            return key, value
+        return name.split("@", 1)[0], ""
+
+
+def _format_num(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(v)
+
+
+def _global_weight_kind(name: str) -> str:
+    if "/" in name:
+        return name.rsplit("/", 1)[1]
+    return "bin"
+
+
+def make_fv_converter(
+    converter_block: Optional[dict],
+    dim_bits: int = 20,
+    weights: Optional[WeightManager] = None,
+) -> DatumToFVConverter:
+    """Factory mirroring core::fv_converter::make_fv_converter
+    (reference usage: jubatus/server/server/classifier_serv.cpp:110)."""
+    config = ConverterConfig(converter_block)
+    hasher = FeatureHasher(dim_bits=dim_bits)
+    return DatumToFVConverter(config, hasher, weights or WeightManager(hasher.dim))

@@ -1,0 +1,47 @@
+"""Feature-name hashing into a fixed 2^k index space.
+
+The reference stores features by full string name in sparse maps
+(core::fv_converter sfv → local_storage string-keyed rows). TPU-native models
+are dense arrays, so feature names are hashed to indices with the hashing
+trick. Index 0 is reserved as the padding slot: real features map to
+[1, dim-1], so padded (index=0, value=0) entries can never alias a live
+feature's gradient in scatter updates.
+
+An optional bounded reverse table keeps hash→name for the engines that need
+to *decode* features back to names (weight engine's calc_weight dump, the
+recommender's decode_row, fv_converter::revert — SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, Optional
+
+
+class FeatureHasher:
+    """Stable string→index hashing with optional reverse lookup."""
+
+    def __init__(self, dim_bits: int = 20, reverse_capacity: int = 1 << 16):
+        if not (4 <= dim_bits <= 31):
+            raise ValueError("dim_bits must be in [4, 31]")
+        self.dim_bits = dim_bits
+        self.dim = 1 << dim_bits
+        self._mask = self.dim - 1
+        self._reverse: Dict[int, str] = {}
+        self._reverse_capacity = reverse_capacity
+
+    def index(self, name: str, remember: bool = True) -> int:
+        # crc32 is stable across processes/platforms (unlike Python's hash()).
+        h = zlib.crc32(name.encode("utf-8")) & self._mask
+        if h == 0:
+            h = 1  # index 0 is the padding slot
+        if remember and len(self._reverse) < self._reverse_capacity:
+            self._reverse.setdefault(h, name)
+        return h
+
+    def name_of(self, index: int) -> Optional[str]:
+        """Reverse lookup (best effort; None if evicted or never seen)."""
+        return self._reverse.get(int(index))
+
+    def clear_reverse(self) -> None:
+        self._reverse.clear()

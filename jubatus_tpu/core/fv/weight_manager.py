@@ -1,0 +1,95 @@
+"""Global-weight state: document frequencies for idf + user-set weights.
+
+The reference's core::fv_converter::weight_manager accumulates per-feature
+document counts (for idf/bm25 global weights) and user weights set through the
+weight engine's `update` RPC; it is itself a mixable so counts converge across
+the cluster (SURVEY.md §2.4 weight engine, §2.9).
+
+TPU-native design: document-frequency counts live in a dense float32 array
+over the hashed feature space. That makes the mix diff a dense array — exactly
+psum-able over ICI with the model diffs in the same collective, instead of a
+string-keyed map merge.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import numpy as np
+
+
+class WeightManager:
+    """Tracks df counts and user weights over the hashed feature space."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        # master = state as of last mix; diff = local updates since.
+        self._df_master = np.zeros(dim, dtype=np.float32)
+        self._df_diff = np.zeros(dim, dtype=np.float32)
+        self._ndocs_master = 0.0
+        self._ndocs_diff = 0.0
+        self._user_weights: Dict[int, float] = {}
+
+    # -- ingest -------------------------------------------------------------
+    def observe(self, indices) -> None:
+        """Record one document's feature occurrence (unique indices)."""
+        self._df_diff[np.asarray(list(indices), dtype=np.int64)] += 1.0
+        self._ndocs_diff += 1.0
+
+    def set_user_weight(self, index: int, weight: float) -> None:
+        self._user_weights[index] = float(weight)
+
+    # -- lookup -------------------------------------------------------------
+    @property
+    def ndocs(self) -> float:
+        return self._ndocs_master + self._ndocs_diff
+
+    def idf(self, index: int) -> float:
+        n = self.ndocs
+        df = float(self._df_master[index] + self._df_diff[index])
+        if n <= 0 or df <= 0:
+            return 1.0
+        return math.log(n / df)
+
+    def user_weight(self, index: int) -> float:
+        return self._user_weights.get(index, 1.0)
+
+    # -- mixable protocol (parallel/mix.py) ---------------------------------
+    def get_diff(self):
+        return {
+            "df": self._df_diff.copy(),
+            "ndocs": np.float32(self._ndocs_diff),
+        }
+
+    @staticmethod
+    def mix(lhs, rhs):
+        return {"df": lhs["df"] + rhs["df"], "ndocs": lhs["ndocs"] + rhs["ndocs"]}
+
+    def put_diff(self, diff) -> bool:
+        self._df_master += np.asarray(diff["df"])
+        self._ndocs_master += float(diff["ndocs"])
+        self._df_diff[:] = 0.0
+        self._ndocs_diff = 0.0
+        return True
+
+    # -- persistence --------------------------------------------------------
+    def pack(self):
+        return {
+            "df": (self._df_master + self._df_diff),
+            "ndocs": self.ndocs,
+            "user_weights": dict(self._user_weights),
+        }
+
+    def unpack(self, obj) -> None:
+        self._df_master = np.asarray(obj["df"], dtype=np.float32).copy()
+        self._ndocs_master = float(obj["ndocs"])
+        self._df_diff[:] = 0.0
+        self._ndocs_diff = 0.0
+        self._user_weights = {int(k): float(v) for k, v in obj["user_weights"].items()}
+
+    def clear(self) -> None:
+        self._df_master[:] = 0.0
+        self._df_diff[:] = 0.0
+        self._ndocs_master = self._ndocs_diff = 0.0
+        self._user_weights.clear()

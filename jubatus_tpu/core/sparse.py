@@ -1,0 +1,95 @@
+"""Padded sparse-vector batches — the host↔device interchange format.
+
+The reference keeps feature vectors as string-keyed sparse maps
+(core::fv_converter sfv, consumed per-datum under a write lock — SURVEY.md
+§3.2). On TPU the model plane wants fixed shapes: a feature vector is hashed
+into a 2^k index space (fv/hashing.py) and a *batch* of vectors is a pair of
+dense arrays (indices, values) padded to a common nnz. Padding entries carry
+value 0.0 so they are no-ops in every kernel (gathers multiply by 0, scatter
+adds add 0).
+
+Pad widths are bucketed to powers of two so XLA recompiles O(log max_nnz)
+times, not per batch shape.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+# (index, weight) pairs, already hashed. The canonical sparse vector type.
+SparseVector = List[Tuple[int, float]]
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class SparseBatch:
+    """A batch of hashed sparse feature vectors as padded numpy arrays.
+
+    Attributes:
+      idx:  int32  [B, K] feature indices (0 for padding)
+      val:  float32 [B, K] feature values (0.0 for padding)
+    """
+
+    __slots__ = ("idx", "val")
+
+    def __init__(self, idx: np.ndarray, val: np.ndarray) -> None:
+        assert idx.shape == val.shape and idx.ndim == 2
+        self.idx = idx
+        self.val = val
+
+    @property
+    def batch_size(self) -> int:
+        return self.idx.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.idx.shape[1]
+
+    @classmethod
+    def from_vectors(
+        cls,
+        vectors: Sequence[SparseVector],
+        min_width: int = 8,
+        batch_bucket: int = 1,
+    ) -> "SparseBatch":
+        """Pack hashed sparse vectors into padded arrays.
+
+        Widths (and optionally batch sizes) are rounded up to power-of-two
+        buckets to bound the number of distinct XLA compilations.
+        """
+        n = len(vectors)
+        bsz = _bucket(max(n, 1), batch_bucket) if batch_bucket > 1 else max(n, 1)
+        width = _bucket(max((len(v) for v in vectors), default=1), min_width)
+        idx = np.zeros((bsz, width), dtype=np.int32)
+        val = np.zeros((bsz, width), dtype=np.float32)
+        for i, vec in enumerate(vectors):
+            if not vec:
+                continue
+            k = len(vec)
+            idx[i, :k] = [j for j, _ in vec]
+            val[i, :k] = [w for _, w in vec]
+        return cls(idx, val)
+
+    def pad_aux(self, aux: Sequence, fill=0, dtype=None) -> np.ndarray:
+        """Pad a per-example array (labels, targets) to this batch's row count.
+
+        Required when batch_bucket > 1 added all-zero padding rows: training
+        kernels gate updates on ||x||^2 > 0, so padded rows are no-ops for
+        any in-range fill value.
+        """
+        out = np.full(self.batch_size, fill, dtype=dtype or np.asarray(aux).dtype)
+        out[: len(aux)] = aux
+        return out
+
+    def squared_norms(self) -> np.ndarray:
+        return (self.val.astype(np.float64) ** 2).sum(axis=1)
+
+    def __len__(self) -> int:
+        return self.batch_size

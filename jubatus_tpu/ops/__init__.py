@@ -1,0 +1,9 @@
+"""XLA/Pallas learning kernels — the compute plane.
+
+Each module provides pure, jittable functions over fixed-shape arrays.
+State lives in pytrees of (master, diff) pairs: training writes the diff,
+the mix collective psums diffs across replicas (parallel/mix.py), and
+masters absorb the mixed diff. All updates are formulated to be *additive*
+in the diff so the psum is the exact reduction, not an approximation of the
+reference's sequential pairwise fold (linear_mixer.cpp:481-499).
+"""

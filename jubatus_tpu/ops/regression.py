@@ -1,0 +1,90 @@
+"""Passive-Aggressive regression kernels (PA / PA1 / PA2).
+
+Rebuild of jubatus_core's regression algorithms (config schema:
+/root/reference/config/regression/default.json — method "PA1" with
+"sensitivity" epsilon and "regularization_weight" C). Same state layout and
+additive-diff mix semantics as ops/classifier.py, with a single weight row.
+
+Update (epsilon-insensitive hinge): err = y - w.x, l = |err| - epsilon;
+if l > 0: w += sign(err) * alpha * x with
+  PA:  alpha = l / x2
+  PA1: alpha = min(C, l / x2)
+  PA2: alpha = l / (x2 + 1/(2C))
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+METHODS = ("PA", "PA1", "PA2")
+
+
+class RegressionState(NamedTuple):
+    w: jax.Array   # [D] master weights
+    dw: jax.Array  # [D] local diff since last mix
+
+
+def init_state(dim: int) -> RegressionState:
+    return RegressionState(
+        w=jnp.zeros((dim,), jnp.float32), dw=jnp.zeros((dim,), jnp.float32)
+    )
+
+
+@jax.jit
+def estimate(state: RegressionState, idx: jax.Array, val: jax.Array) -> jax.Array:
+    """Batch estimates: [B]."""
+    eff = state.w + state.dw
+    return jnp.einsum("bk,bk->b", jnp.take(eff, idx), val)
+
+
+@functools.partial(jax.jit, static_argnames=("method",), donate_argnums=(0,))
+def train_batch(
+    state: RegressionState,
+    idx: jax.Array,      # [B, K]
+    val: jax.Array,      # [B, K]
+    targets: jax.Array,  # [B]
+    sensitivity: float,
+    c: float,
+    *,
+    method: str,
+) -> RegressionState:
+    def step(carry, ex):
+        w, dw = carry
+        e_idx, e_val, y = ex
+        pred = jnp.sum((jnp.take(w, e_idx) + jnp.take(dw, e_idx)) * e_val)
+        err = y - pred
+        loss = jnp.abs(err) - sensitivity
+        x2 = jnp.maximum(jnp.sum(e_val * e_val), 1e-12)
+        if method == "PA":
+            alpha = loss / x2
+        elif method == "PA1":
+            alpha = jnp.minimum(c, loss / x2)
+        elif method == "PA2":
+            alpha = loss / (x2 + 1.0 / (2.0 * c))
+        else:
+            raise ValueError(f"unknown regression method {method!r}")
+        alpha = jnp.where(loss > 0.0, alpha, 0.0)
+        dw = dw.at[e_idx].add(jnp.sign(err) * alpha * e_val)
+        return (w, dw), ()
+
+    (w, dw), _ = jax.lax.scan(step, tuple(state), (idx, val, targets))
+    return RegressionState(w, dw)
+
+
+# -- mixable protocol -------------------------------------------------------
+def get_diff(state: RegressionState):
+    return {"dw": state.dw, "count": jnp.float32(1.0)}
+
+
+def mix_diffs(lhs, rhs):
+    return jax.tree_util.tree_map(lambda a, b: a + b, lhs, rhs)
+
+
+@jax.jit
+def put_diff(state: RegressionState, diff) -> RegressionState:
+    n = jnp.maximum(diff["count"], 1.0)
+    return RegressionState(w=state.w + diff["dw"] / n, dw=jnp.zeros_like(state.dw))

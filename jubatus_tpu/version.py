@@ -1,0 +1,13 @@
+"""Framework version.
+
+The reference declares VERSION='1.0.2' in wscript:7; we keep an independent
+version for the new framework plus the reference compatibility version used
+in the checkpoint envelope (framework/save_load.py).
+"""
+
+VERSION = "0.1.0"
+__version__ = VERSION
+
+# Version of the jubatus API surface we are compatible with (reference
+# wscript:7). Embedded in saved model headers for tool parity.
+COMPAT_JUBATUS_VERSION = (1, 0, 2)

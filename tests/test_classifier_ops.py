@@ -1,0 +1,142 @@
+"""Classifier kernel tests: learning behavior of every method + mix semantics.
+
+Mirrors the reference's test intent for classifier algorithms and the
+mix-fold associativity assertion in linear_mixer_test.cpp:156-169 — here the
+stronger property holds: diffs are additive so any mix order is exact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jubatus_tpu.core.sparse import SparseBatch
+from jubatus_tpu.ops import classifier as C
+
+DIM = 1 << 12
+L = 4
+
+
+def make_blobs(rng, n, n_features=16, n_classes=3, sep=3.0):
+    """Sparse-ish synthetic multiclass data in the hashed index space."""
+    centers = rng.normal(size=(n_classes, n_features)) * sep
+    labels = rng.integers(0, n_classes, size=n)
+    dense = centers[labels] + rng.normal(size=(n, n_features))
+    # map features to fixed distinct hash indices (avoid 0, the padding slot)
+    feat_idx = rng.choice(np.arange(1, DIM), size=n_features, replace=False)
+    vectors = [
+        [(int(feat_idx[j]), float(dense[i, j])) for j in range(n_features)]
+        for i in range(n)
+    ]
+    return vectors, labels
+
+
+def batchify(vectors, labels):
+    sb = SparseBatch.from_vectors(vectors)
+    return (
+        jnp.asarray(sb.idx),
+        jnp.asarray(sb.val),
+        jnp.asarray(labels, jnp.int32),
+    )
+
+
+def accuracy(state, idx, val, labels, mask):
+    s = C.scores(state, idx, val, mask)
+    return float(jnp.mean(jnp.argmax(s, axis=1) == labels))
+
+
+@pytest.mark.parametrize("method", C.METHODS)
+def test_method_learns_separable_data(method, rng):
+    vectors, labels = make_blobs(rng, 300)
+    idx, val, y = batchify(vectors, labels)
+    mask = jnp.array([True, True, True, False])
+    state = C.init_state(L, DIM, method in C.CONFIDENCE_METHODS)
+    param = 1.0
+    for _ in range(3):
+        state = C.train_batch(state, idx, val, y, mask, param, method=method)
+    acc = accuracy(state, idx, val, y, mask)
+    assert acc > 0.9, f"{method} failed to learn: acc={acc}"
+
+
+def test_dead_labels_never_predicted(rng):
+    vectors, labels = make_blobs(rng, 100, n_classes=2)
+    idx, val, y = batchify(vectors, labels)
+    mask = jnp.array([True, True, False, False])
+    state = C.init_state(L, DIM, False)
+    state = C.train_batch(state, idx, val, y, mask, 1.0, method="PA")
+    s = C.scores(state, idx, val, mask)
+    assert int(jnp.max(jnp.argmax(s, axis=1))) <= 1
+
+
+def test_single_label_no_update(rng):
+    """With one live label there is no competitor: train must be a no-op
+    (reference margin over 'other labels' is empty)."""
+    vectors, labels = make_blobs(rng, 10, n_classes=1)
+    idx, val, y = batchify(vectors, labels)
+    mask = jnp.array([True, False, False, False])
+    state = C.init_state(L, DIM, False)
+    state = C.train_batch(state, idx, val, y, mask, 1.0, method="PA")
+    assert float(jnp.abs(state.dw).max()) == 0.0
+
+
+def test_padding_is_noop(rng):
+    """Padded entries (idx 0, val 0) must not perturb the model."""
+    vectors, labels = make_blobs(rng, 50)
+    mask = jnp.array([True, True, True, False])
+    sb_narrow = SparseBatch.from_vectors(vectors, min_width=16)
+    sb_wide = SparseBatch.from_vectors(vectors, min_width=64)
+    y = jnp.asarray(labels, jnp.int32)
+    s1 = C.init_state(L, DIM, True)
+    s2 = C.init_state(L, DIM, True)
+    s1 = C.train_batch(s1, jnp.asarray(sb_narrow.idx), jnp.asarray(sb_narrow.val),
+                       y, mask, 1.0, method="AROW")
+    s2 = C.train_batch(s2, jnp.asarray(sb_wide.idx), jnp.asarray(sb_wide.val),
+                       y, mask, 1.0, method="AROW")
+    np.testing.assert_allclose(np.asarray(s1.dw), np.asarray(s2.dw), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s1.dprec), np.asarray(s2.dprec), atol=1e-5)
+
+
+def test_mix_diff_additive_and_order_free(rng):
+    """Two replicas train on disjoint halves; mixing their diffs in either
+    order gives the identical master — the exact-psum property that replaces
+    the reference's sequential fold (linear_mixer.cpp:481-499)."""
+    vectors, labels = make_blobs(rng, 200)
+    half = 100
+    mask = jnp.array([True, True, True, False])
+    states = []
+    for lo, hi in ((0, half), (half, 200)):
+        idx, val, y = batchify(vectors[lo:hi], labels[lo:hi])
+        st = C.init_state(L, DIM, True)
+        st = C.train_batch(st, idx, val, y, mask, 1.0, method="AROW")
+        states.append(st)
+    d0, d1 = C.get_diff(states[0]), C.get_diff(states[1])
+    m01 = C.mix_diffs(d0, d1)
+    m10 = C.mix_diffs(d1, d0)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        , m01, m10)
+    assert float(m01["count"]) == 2.0
+
+    mixed0 = C.put_diff(states[0], m01)
+    mixed1 = C.put_diff(states[1], m10)
+    np.testing.assert_allclose(np.asarray(mixed0.w), np.asarray(mixed1.w), atol=1e-6)
+    # post-mix local diffs are cleared
+    assert float(jnp.abs(mixed0.dw).max()) == 0.0
+    # mixed model still classifies the full set well
+    idx, val, y = batchify(vectors, labels)
+    acc = accuracy(mixed0, idx, val, y, mask)
+    assert acc > 0.85
+
+
+def test_grow_labels_preserves_model(rng):
+    vectors, labels = make_blobs(rng, 100)
+    idx, val, y = batchify(vectors, labels)
+    mask = jnp.array([True, True, True, False])
+    state = C.init_state(L, DIM, True)
+    state = C.train_batch(state, idx, val, y, mask, 1.0, method="AROW")
+    grown = C.grow_labels(state, 6)
+    assert grown.w.shape == (6, DIM)
+    np.testing.assert_allclose(np.asarray(grown.w[:L]), np.asarray(state.w))
+    mask6 = jnp.concatenate([mask, jnp.array([False, False])])
+    acc = accuracy(grown, idx, val, y, mask6)
+    assert acc > 0.9

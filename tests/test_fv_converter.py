@@ -1,0 +1,192 @@
+"""fv_converter tests — config parsing, extraction rules, weighting, hashing.
+
+Models the converter blocks used across /root/reference/config/*/*.json.
+"""
+
+import math
+
+import pytest
+
+from jubatus_tpu.core import Datum
+from jubatus_tpu.core.fv import make_fv_converter
+from jubatus_tpu.core.fv.converter import ConverterError
+
+DEFAULT = {
+    "string_rules": [
+        {"key": "*", "type": "str", "sample_weight": "bin", "global_weight": "bin"}
+    ],
+    "num_rules": [{"key": "*", "type": "num"}],
+}
+
+
+def test_default_converter_str_and_num():
+    conv = make_fv_converter(DEFAULT, dim_bits=16)
+    named = conv.convert_named(Datum({"title": "hello", "age": 25}))
+    assert named == {"title$hello@str#bin/bin": 1.0, "age@num": 25.0}
+
+
+def test_hashed_output_stable_and_padded_slot_free():
+    conv = make_fv_converter(DEFAULT, dim_bits=16)
+    fv1 = conv.convert(Datum({"title": "hello", "age": 25}))
+    fv2 = conv.convert(Datum({"title": "hello", "age": 25}))
+    assert fv1 == fv2
+    assert all(i != 0 for i, _ in fv1)  # index 0 reserved for padding
+    assert all(0 < i < conv.dim for i, _ in fv1)
+
+
+def test_ngram_splitter_tf_weighting():
+    cfg = {
+        "string_types": {"bigram": {"method": "ngram", "char_num": "2"}},
+        "string_rules": [
+            {"key": "*", "type": "bigram", "sample_weight": "tf", "global_weight": "bin"}
+        ],
+        "num_rules": [],
+    }
+    conv = make_fv_converter(cfg, dim_bits=16)
+    named = conv.convert_named(Datum({"t": "aaa"}))
+    # bigrams of "aaa" = ["aa", "aa"] -> tf 2
+    assert named == {"t$aa@bigram#tf/bin": 2.0}
+
+
+def test_space_splitter_log_tf():
+    cfg = {
+        "string_rules": [
+            {"key": "*", "type": "space", "sample_weight": "log_tf", "global_weight": "bin"}
+        ],
+        "num_rules": [],
+    }
+    conv = make_fv_converter(cfg, dim_bits=16)
+    named = conv.convert_named(Datum({"t": "a b a"}))
+    assert named["t$a@space#log_tf/bin"] == pytest.approx(math.log(3.0))
+    assert named["t$b@space#log_tf/bin"] == pytest.approx(math.log(2.0))
+
+
+def test_num_log_and_str_types():
+    cfg = {
+        "num_types": {"mylog": {"method": "log"}},
+        "num_rules": [
+            {"key": "a", "type": "mylog"},
+            {"key": "b", "type": "str"},
+        ],
+        "string_rules": [],
+    }
+    conv = make_fv_converter(cfg, dim_bits=16)
+    named = conv.convert_named(Datum({"a": 100.0, "b": 42}))
+    assert named["a@mylog"] == pytest.approx(math.log(100.0))
+    assert named["b$42@str"] == 1.0
+
+
+def test_idf_global_weight():
+    cfg = {
+        "string_rules": [
+            {"key": "*", "type": "space", "sample_weight": "bin", "global_weight": "idf"}
+        ],
+        "num_rules": [],
+    }
+    conv = make_fv_converter(cfg, dim_bits=16)
+    # document 1: "common rare", documents 2..4: "common"
+    conv.convert(Datum({"t": "common rare"}), update_weights=True)
+    for _ in range(3):
+        conv.convert(Datum({"t": "common"}), update_weights=True)
+    named = conv.convert_named(Datum({"t": "common rare"}))
+    assert named["t$common@space#bin/idf"] == pytest.approx(math.log(4 / 4))
+    assert named["t$rare@space#bin/idf"] == pytest.approx(math.log(4 / 1))
+
+
+def test_string_filter_regexp():
+    cfg = {
+        "string_filter_types": {
+            "detag": {"method": "regexp", "pattern": "<[^>]*>", "replace": ""}
+        },
+        "string_filter_rules": [{"key": "*", "type": "detag", "suffix": "-detagged"}],
+        "string_rules": [
+            {"key": "*-detagged", "type": "str", "sample_weight": "bin", "global_weight": "bin"}
+        ],
+        "num_rules": [],
+    }
+    conv = make_fv_converter(cfg, dim_bits=16)
+    named = conv.convert_named(Datum({"html": "<p>hi</p>"}))
+    assert named == {"html-detagged$hi@str#bin/bin": 1.0}
+
+
+def test_num_filters():
+    cfg = {
+        "num_filter_types": {
+            "add5": {"method": "add", "value": "5"},
+            "lin": {"method": "linear_normalization", "min": "0", "max": "100"},
+            "sig": {"method": "sigmoid_normalization", "gain": "1", "bias": "0"},
+        },
+        "num_filter_rules": [
+            {"key": "x", "type": "add5", "suffix": "+5"},
+            {"key": "x", "type": "lin", "suffix": "_lin"},
+            {"key": "x", "type": "sig", "suffix": "_sig"},
+        ],
+        "num_rules": [{"key": "*", "type": "num"}],
+        "string_rules": [],
+    }
+    conv = make_fv_converter(cfg, dim_bits=16)
+    named = conv.convert_named(Datum({"x": 50.0}))
+    assert named["x@num"] == 50.0
+    assert named["x+5@num"] == 55.0
+    assert named["x_lin@num"] == pytest.approx(0.5)
+    assert named["x_sig@num"] == pytest.approx(1 / (1 + math.exp(-50)))
+
+
+def test_combination_rules_mul():
+    cfg = {
+        "string_rules": [],
+        "num_rules": [{"key": "*", "type": "num"}],
+        "combination_rules": [{"key_left": "*", "key_right": "*", "type": "mul"}],
+    }
+    conv = make_fv_converter(cfg, dim_bits=16)
+    named = conv.convert_named(Datum({"a": 2.0, "b": 3.0}))
+    assert named["a@num"] == 2.0 and named["b@num"] == 3.0
+    assert named["a@num&b@num"] == 6.0
+
+
+def test_key_matchers_prefix_suffix_exact():
+    cfg = {
+        "num_rules": [
+            {"key": "pre*", "type": "num"},
+            {"key": "*fix", "type": "log"},
+            {"key": "exact", "type": "num"},
+        ],
+        "string_rules": [],
+    }
+    conv = make_fv_converter(cfg, dim_bits=16)
+    named = conv.convert_named(Datum({"pre_a": 1.0, "suf_fix": 2.0, "exact": 3.0, "no": 4.0}))
+    assert set(named) == {"pre_a@num", "suf_fix@log", "exact@num"}
+
+
+def test_revert_feature():
+    conv = make_fv_converter(DEFAULT, dim_bits=16)
+    fv = conv.convert(Datum({"title": "hello"}))
+    (idx, _), = fv
+    assert conv.revert_feature(idx) == ("title", "hello")
+
+
+def test_invalid_configs_raise():
+    with pytest.raises(ConverterError):
+        make_fv_converter({"string_rules": [{"key": "*", "type": "nope"}]})
+    with pytest.raises(ConverterError):
+        make_fv_converter(
+            {"string_rules": [
+                {"key": "*", "type": "str", "sample_weight": "huh", "global_weight": "bin"}
+            ]}
+        )
+    with pytest.raises(ConverterError):
+        make_fv_converter({"num_types": {"x": {"method": "wat"}}, "num_rules": []})
+
+
+def test_reference_config_files_parse():
+    """Every converter block shipped in the reference's config/ must parse."""
+    import glob
+    import json
+
+    paths = glob.glob("/root/reference/config/*/*.json")
+    assert paths, "reference configs not found"
+    for path in paths:
+        with open(path) as f:
+            cfg = json.load(f)
+        if "converter" in cfg:
+            make_fv_converter(cfg["converter"], dim_bits=10)

@@ -1,0 +1,56 @@
+"""PA regression kernel tests."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jubatus_tpu.core.sparse import SparseBatch
+from jubatus_tpu.ops import regression as R
+
+DIM = 1 << 12
+
+
+def make_linear(rng, n, n_features=8, noise=0.01):
+    feat_idx = rng.choice(np.arange(1, DIM), size=n_features, replace=False)
+    w_true = rng.normal(size=n_features)
+    x = rng.normal(size=(n, n_features))
+    y = x @ w_true + noise * rng.normal(size=n)
+    vectors = [
+        [(int(feat_idx[j]), float(x[i, j])) for j in range(n_features)]
+        for i in range(n)
+    ]
+    return vectors, y
+
+
+@pytest.mark.parametrize("method", R.METHODS)
+def test_regression_learns(method, rng):
+    vectors, y = make_linear(rng, 400)
+    sb = SparseBatch.from_vectors(vectors)
+    idx, val = jnp.asarray(sb.idx), jnp.asarray(sb.val)
+    targets = jnp.asarray(y, jnp.float32)
+    state = R.init_state(DIM)
+    for _ in range(5):
+        state = R.train_batch(state, idx, val, targets, 0.01, 1.0, method=method)
+    pred = R.estimate(state, idx, val)
+    rmse = float(jnp.sqrt(jnp.mean((pred - targets) ** 2)))
+    assert rmse < 0.25, f"{method}: rmse={rmse}"
+
+
+def test_mix_two_replicas(rng):
+    vectors, y = make_linear(rng, 400)
+    states = []
+    for lo, hi in ((0, 200), (200, 400)):
+        sb = SparseBatch.from_vectors(vectors[lo:hi])
+        st = R.init_state(DIM)
+        for _ in range(3):
+            st = R.train_batch(
+                st, jnp.asarray(sb.idx), jnp.asarray(sb.val),
+                jnp.asarray(y[lo:hi], jnp.float32), 0.01, 1.0, method="PA1",
+            )
+        states.append(st)
+    total = R.mix_diffs(R.get_diff(states[0]), R.get_diff(states[1]))
+    mixed = R.put_diff(states[0], total)
+    sb = SparseBatch.from_vectors(vectors)
+    pred = R.estimate(mixed, jnp.asarray(sb.idx), jnp.asarray(sb.val))
+    rmse = float(jnp.sqrt(jnp.mean((pred - jnp.asarray(y, jnp.float32)) ** 2)))
+    assert rmse < 0.5
