@@ -411,14 +411,19 @@ class DatumToFVConverter:
             hashed[idx] = hashed.get(idx, 0.0) + value
         return sorted(hashed.items())
 
-    def convert_named(self, datum: Datum) -> Dict[str, float]:
+    def convert_named(self, datum: Datum, update_weights: bool = False) -> Dict[str, float]:
         """Named (unhashed) features with global weights applied — for the
-        weight engine's calc_weight and for tests."""
+        weight engine's calc_weight/update and for tests. Runs the extraction
+        pipeline once; update_weights records document frequencies first."""
         named = self._named_features(datum)
+        entries = [(name, self.hasher.index(name), value) for name, value in named.items()]
+        if update_weights:
+            idf_idx = {i for name, i, _ in entries if _global_weight_kind(name) == "idf"}
+            if idf_idx:
+                self.weights.observe(idf_idx)
         out = {}
-        for name, value in named.items():
+        for name, idx, value in entries:
             gw_kind = _global_weight_kind(name)
-            idx = self.hasher.index(name)
             if gw_kind == "idf":
                 value *= self.weights.idf(idx)
             elif gw_kind == "weight":

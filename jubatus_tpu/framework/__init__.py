@@ -1,0 +1,9 @@
+"""Server framework: driver lifecycle, save/load, mixer scheduling, config.
+
+Rebuild of jubatus/server/framework/ (SURVEY.md §2.3) minus what a static TPU
+mesh makes unnecessary (ZooKeeper master election, CHT ring maintenance).
+"""
+
+from jubatus_tpu.framework.driver import DriverBase  # noqa: F401
+from jubatus_tpu.framework.save_load import load_model, save_model  # noqa: F401
+from jubatus_tpu.framework.mixer import IntervalMixer  # noqa: F401

@@ -1,0 +1,57 @@
+"""Driver base class (≙ core::driver::driver_base, SURVEY.md §2.9).
+
+A driver owns one engine's model state + fv_converter and exposes:
+- the engine's business API (train/classify/... defined by subclasses),
+- the mixable protocol for the mix engine (get_mixables),
+- pack/unpack for checkpointing (framework/save_load.py),
+- clear and schema sync.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from jubatus_tpu.parallel.mix import Mixable
+
+
+class DriverBase:
+    #: engine type name, e.g. "classifier" — matches the reference's server
+    #: type strings used in model filenames and RPC registration.
+    TYPE: str = "base"
+
+    #: bumped when a driver's pack() layout changes (reference
+    #: user_data_version, server_base.hpp:41-109)
+    USER_DATA_VERSION: int = 1
+
+    def __init__(self) -> None:
+        self.update_count = 0
+
+    # -- mix plane ----------------------------------------------------------
+    def get_mixables(self) -> Dict[str, Mixable]:
+        return {}
+
+    def get_schema(self) -> List[str]:
+        """Row-vocabulary schema for pre-mix alignment (default: none)."""
+        return []
+
+    def sync_schema(self, union_schema: List[str]) -> None:
+        pass
+
+    # -- persistence --------------------------------------------------------
+    def pack(self) -> Any:
+        raise NotImplementedError
+
+    def unpack(self, obj: Any) -> None:
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        raise NotImplementedError
+
+    # -- bookkeeping ---------------------------------------------------------
+    def event_model_updated(self, n: int = 1) -> None:
+        """Reference server_base::event_model_updated (server_base.cpp:214-219):
+        bump the update counter; the mixer watches it."""
+        self.update_count += n
+
+    def get_status(self) -> Dict[str, Any]:
+        return {"type": self.TYPE, "update_count": self.update_count}

@@ -1,0 +1,104 @@
+"""Interval-driven mix scheduling (≙ linear_mixer's stabilizer_loop).
+
+Reference behavior (mixer/linear_mixer.cpp:362-435, defaults from
+server_util.cpp:223-228): a background thread wakes at most every 0.5 s and
+fires a mix when update_count >= interval_count (512) OR elapsed >=
+interval_sec (16 s) with at least one update. Here the mix itself is a
+collective (parallel/mix.py) executed by a supplied callable, so the same
+scheduler drives LocalMixGroup in tests and the pod collective in production.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+
+class IntervalMixer:
+    POLL_SEC = 0.5  # linear_mixer.cpp:372-374
+
+    def __init__(
+        self,
+        mix_fn: Callable[[], Any],
+        *,
+        interval_sec: float = 16.0,
+        interval_count: int = 512,
+    ) -> None:
+        self._mix_fn = mix_fn
+        self.interval_sec = interval_sec
+        self.interval_count = interval_count
+        self._counter = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        # status counters (reference linear_mixer.cpp:349-360)
+        self.mix_count = 0
+        self.last_mix_duration = 0.0
+        self._last_mix_time = time.monotonic()
+
+    # -- server integration --------------------------------------------------
+    def updated(self, n: int = 1) -> None:
+        """Called on every model update (server_base::event_model_updated)."""
+        with self._cond:
+            self._counter += n
+            if self._counter >= self.interval_count:
+                self._cond.notify()
+
+    def mix_now(self) -> Any:
+        """Synchronous mix (the reference's do_mix RPC)."""
+        with self._cond:
+            return self._do_mix_locked()
+
+    def _do_mix_locked(self) -> Any:
+        start = time.monotonic()
+        result = self._mix_fn()
+        self.last_mix_duration = time.monotonic() - start
+        self.mix_count += 1
+        self._counter = 0
+        self._last_mix_time = time.monotonic()
+        return result
+
+    # -- background loop ------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="mixer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _loop(self) -> None:
+        with self._cond:
+            while self._running:
+                self._cond.wait(timeout=self.POLL_SEC)
+                if not self._running:
+                    return
+                elapsed = time.monotonic() - self._last_mix_time
+                due = self._counter >= self.interval_count or (
+                    self._counter > 0 and elapsed >= self.interval_sec
+                )
+                if due:
+                    try:
+                        self._do_mix_locked()
+                    except Exception:  # mix failure must not kill the loop
+                        import logging
+
+                        logging.getLogger(__name__).exception("mix round failed")
+
+    def get_status(self) -> Dict[str, Any]:
+        return {
+            "mix_count": self.mix_count,
+            "counter": self._counter,
+            "interval_sec": self.interval_sec,
+            "interval_count": self.interval_count,
+            "last_mix_duration": self.last_mix_duration,
+        }

@@ -1,0 +1,11 @@
+"""Engine drivers — one per reference engine (SURVEY.md §2.4).
+
+Each driver binds an fv_converter + XLA kernels (ops/) into the engine's
+business API, implements the mixable protocol for the mix plane, and
+pack/unpack for checkpoints. The RPC layer (rpc/) exposes them over the
+reference's wire protocol.
+"""
+
+from jubatus_tpu.models.classifier import ClassifierDriver  # noqa: F401
+from jubatus_tpu.models.regression import RegressionDriver  # noqa: F401
+from jubatus_tpu.models.weight import WeightDriver  # noqa: F401

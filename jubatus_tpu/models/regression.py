@@ -1,0 +1,112 @@
+"""Regression engine driver.
+
+API parity with the reference regression service (regression.idl: train /
+estimate / clear; regression_serv.cpp). Config schema from
+/root/reference/config/regression/default.json: method PA/PA1/PA2 with
+parameter {sensitivity, regularization_weight}.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.core.fv import make_fv_converter
+from jubatus_tpu.core.sparse import SparseBatch
+from jubatus_tpu.framework.driver import DriverBase
+from jubatus_tpu.ops import regression as ops
+
+
+class RegressionConfigError(ValueError):
+    pass
+
+
+class RegressionDriver(DriverBase):
+    TYPE = "regression"
+
+    def __init__(self, config: dict, dim_bits: int = 18):
+        super().__init__()
+        self.config = config
+        self.config_json = json.dumps(config)
+        method = config.get("method")
+        if method not in ops.METHODS:
+            raise RegressionConfigError(f"unknown regression method {method!r}")
+        self.method = method
+        param = config.get("parameter") or {}
+        self.sensitivity = float(param.get("sensitivity", 0.1))
+        self.c = float(param.get("regularization_weight", 1.0))
+        self.converter = make_fv_converter(config.get("converter"), dim_bits=dim_bits)
+        self.state = ops.init_state(self.converter.dim)
+
+    def train(self, data: Sequence[Tuple[float, Datum]]) -> int:
+        if not data:
+            return 0
+        vectors = [self.converter.convert(d, update_weights=True) for _, d in data]
+        targets = [float(y) for y, _ in data]
+        sb = SparseBatch.from_vectors(vectors)
+        self.state = ops.train_batch(
+            self.state,
+            jnp.asarray(sb.idx),
+            jnp.asarray(sb.val),
+            jnp.asarray(targets, jnp.float32),
+            self.sensitivity,
+            self.c,
+            method=self.method,
+        )
+        self.event_model_updated(len(data))
+        return len(data)
+
+    def estimate(self, data: Sequence[Datum]) -> List[float]:
+        if not data:
+            return []
+        vectors = [self.converter.convert(d) for d in data]
+        sb = SparseBatch.from_vectors(vectors)
+        pred = ops.estimate(self.state, jnp.asarray(sb.idx), jnp.asarray(sb.val))
+        return [float(x) for x in np.asarray(pred)]
+
+    def clear(self) -> None:
+        self.state = ops.init_state(self.converter.dim)
+        self.converter.weights.clear()
+        self.update_count = 0
+
+    def get_mixables(self):
+        return {"regression": _RegressionMixable(self), "weights": self.converter.weights}
+
+    def pack(self) -> Any:
+        return {
+            "method": self.method,
+            "dim": self.converter.dim,
+            "w": np.asarray(self.state.w + self.state.dw),
+            "weights": self.converter.weights.pack(),
+        }
+
+    def unpack(self, obj: Any) -> None:
+        if int(obj.get("dim", self.converter.dim)) != self.converter.dim:
+            raise ValueError(
+                f"checkpoint feature dim {obj['dim']} != driver dim "
+                f"{self.converter.dim} (dim_bits mismatch)"
+            )
+        w = jnp.asarray(obj["w"])
+        self.state = ops.RegressionState(w=w, dw=jnp.zeros_like(w))
+        self.converter.weights.unpack(obj["weights"])
+
+    def get_status(self) -> Dict[str, Any]:
+        st = super().get_status()
+        st.update(method=self.method, num_features=self.converter.dim)
+        return st
+
+
+class _RegressionMixable:
+    def __init__(self, driver: RegressionDriver):
+        self._d = driver
+
+    def get_diff(self):
+        return ops.get_diff(self._d.state)
+
+    def put_diff(self, diff) -> bool:
+        self._d.state = ops.put_diff(self._d.state, diff)
+        return True

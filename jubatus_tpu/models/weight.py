@@ -1,0 +1,53 @@
+"""Weight engine driver.
+
+API parity with the reference weight service (weight.idl: update /
+calc_weight / clear; weight_serv.cpp — exposes fv_converter weights for
+debugging, SURVEY.md §2.4). `update` runs the train-path conversion
+(recording document frequencies); `calc_weight` runs the analyze path. Both
+return the named feature list with final weights.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Tuple
+
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.core.fv import make_fv_converter
+from jubatus_tpu.framework.driver import DriverBase
+
+
+class WeightDriver(DriverBase):
+    TYPE = "weight"
+
+    def __init__(self, config: dict, dim_bits: int = 18):
+        super().__init__()
+        self.config = config
+        self.config_json = json.dumps(config)
+        self.converter = make_fv_converter(config.get("converter"), dim_bits=dim_bits)
+
+    def update(self, d: Datum) -> List[Tuple[str, float]]:
+        result = self.converter.convert_named(d, update_weights=True)
+        self.event_model_updated()
+        return sorted(result.items())
+
+    def calc_weight(self, d: Datum) -> List[Tuple[str, float]]:
+        return sorted(self.converter.convert_named(d).items())
+
+    def clear(self) -> None:
+        self.converter.weights.clear()
+        self.update_count = 0
+
+    def get_mixables(self):
+        return {"weights": self.converter.weights}
+
+    def pack(self) -> Any:
+        return {"weights": self.converter.weights.pack()}
+
+    def unpack(self, obj: Any) -> None:
+        self.converter.weights.unpack(obj["weights"])
+
+    def get_status(self) -> Dict[str, Any]:
+        st = super().get_status()
+        st.update(num_features=self.converter.dim)
+        return st

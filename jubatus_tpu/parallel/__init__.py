@@ -1,0 +1,17 @@
+"""Distributed plane: device meshes, the mix collective, sharding helpers.
+
+The reference's distributed training loop is the MIX protocol: a
+ZooKeeper-elected master fans out get_diff RPCs, folds diffs pairwise, and
+broadcasts put_diff (linear_mixer.cpp:437-559, SURVEY.md §3.3). Here the same
+semantics run as one XLA AllReduce over ICI: every replica's diff pytree is
+psum'd inside a shard_map'd step, and every replica absorbs the result —
+symmetric, no master election, exact for our additive diffs.
+"""
+
+from jubatus_tpu.parallel.mesh import replica_mesh  # noqa: F401
+from jubatus_tpu.parallel.mix import (  # noqa: F401
+    LocalMixGroup,
+    Mixable,
+    allreduce_diffs,
+    tree_sum,
+)

@@ -1,0 +1,45 @@
+"""Device mesh construction helpers.
+
+A jubatus_tpu cluster is a static `jax.sharding.Mesh`. The axes in use:
+
+- ``replica``: data-parallel model replicas (the reference's N server
+  processes joined in one cluster name). The mix collective psums over it.
+- ``shard``: row/feature sharding for instance-based engines (the reference's
+  consistent-hash-table row placement, cht.cpp:107-143 — replaced by static
+  mesh placement, SURVEY.md §5 "long-context").
+
+Multi-host: call jax.distributed.initialize() before building the mesh; the
+same code then spans hosts with collectives riding ICI (and DCN across
+slices). Single chip degenerates to a 1-device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def replica_mesh(n_replicas: Optional[int] = None, devices=None) -> Mesh:
+    """A 1-D mesh of model replicas over the first n devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_replicas is not None:
+        if n_replicas > len(devices):
+            raise ValueError(
+                f"requested {n_replicas} replicas but only {len(devices)} devices"
+            )
+        devices = devices[:n_replicas]
+    return Mesh(np.asarray(devices), axis_names=("replica",))
+
+
+def grid_mesh(replica: int, shard: int, devices=None) -> Mesh:
+    """A 2-D (replica, shard) mesh: data-parallel groups of row-sharded
+    stores — the TPU equivalent of N CHT-sharded servers with replication."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = replica * shard
+    if need > len(devices):
+        raise ValueError(f"mesh {replica}x{shard} needs {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(replica, shard)
+    return Mesh(arr, axis_names=("replica", "shard"))

@@ -1,0 +1,131 @@
+"""The mix engine: model averaging as an XLA collective.
+
+Reference semantics (linear_mixer.cpp:437-559, SURVEY.md §3.3): master pulls
+diffs from all replicas, folds them pairwise with mixable->mix, broadcasts the
+folded diff, every replica applies it via put_diff and clears its local diff.
+The fold is the AllReduce combiner; because every jubatus_tpu diff is a pytree
+whose mix is elementwise addition (ops/* keep updates additive by design),
+the whole round is exactly `psum(diff)` + local put_diff — symmetric across
+replicas, no master election, order-independent.
+
+Two execution paths share the same Mixable protocol:
+
+- ``allreduce_diffs``: the TPU path. Stacked per-replica diffs live sharded
+  over the mesh's ``replica`` axis; a shard_map'd psum reduces them over ICI.
+- ``LocalMixGroup``: the in-process path used by tests and by multi-engine
+  simulation (the reference's linear_communication_stub seam,
+  linear_mixer_test.cpp:65-112): N driver instances mix through host memory.
+
+Schema sync: engines whose array rows are keyed by a dynamic vocabulary
+(classifier labels) must align rows before arrays can be summed. Mixables may
+implement ``sync_schema(union_of_schemas)``; the group/cluster computes the
+sorted union of all replicas' schemas first (on TPU pods: a tiny host-side
+allgather over DCN, out of the hot path), each replica permutes/grows its
+arrays to the canonical schema, then the array psum runs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Protocol, Sequence, runtime_checkable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@runtime_checkable
+class Mixable(Protocol):
+    """The linear-mixable protocol (reference core mixable, SURVEY.md §2.9).
+
+    get_diff returns a pytree of arrays/scalars; mix is elementwise addition
+    (performed by the engine, not the mixable); put_diff absorbs the reduced
+    diff and resets local accumulation, returning False if the local model is
+    obsolete (triggers full-model recovery, linear_mixer.cpp:598-632).
+    """
+
+    def get_diff(self) -> Any: ...
+
+    def put_diff(self, diff: Any) -> bool: ...
+
+
+def tree_sum(diffs: Sequence[Any]) -> Any:
+    """Host-side fold of diff pytrees (the reference's pairwise fold —
+    associative here, so order is irrelevant)."""
+    acc = diffs[0]
+    for d in diffs[1:]:
+        acc = jax.tree_util.tree_map(lambda a, b: a + b, acc, d)
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _psum_stacked(stacked, *, mesh: Mesh, axis: str):
+    """psum a pytree whose leaves are stacked [n_replicas, ...] and sharded
+    over `axis`; result is replicated (every replica holds the total)."""
+
+    def body(local):
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(jnp.sum(x, axis=0), axis), local
+        )
+
+    return jax.shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P())(stacked)
+
+
+def allreduce_diffs(per_replica_diffs: Sequence[Any], mesh: Mesh, axis: str = "replica"):
+    """Reduce per-replica diff pytrees to one total via an XLA collective.
+
+    In production each replica contributes its local shard of the stacked
+    array; in tests the stack is built host-side and sharded onto the mesh.
+    Returns the total diff (as held by replica 0).
+    """
+    n = mesh.shape[axis]
+    if len(per_replica_diffs) != n:
+        raise ValueError(f"got {len(per_replica_diffs)} diffs for a {n}-replica mesh")
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *per_replica_diffs
+    )
+    sharding = NamedSharding(mesh, P(axis))
+    stacked = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), stacked
+    )
+    total = _psum_stacked(stacked, mesh=mesh, axis=axis)
+    return jax.tree_util.tree_map(lambda x: jax.device_get(x), total)
+
+
+class LocalMixGroup:
+    """In-process mix over N mixable-bearing drivers (the stub seam).
+
+    Drivers expose ``get_mixables() -> dict[name, Mixable]`` and optionally
+    ``get_schema() / sync_schema(union)`` for row-alignment (classifier
+    labels). mix() runs schema sync, then per-mixable diff reduction
+    (optionally through a real device mesh), then put_diff everywhere.
+    """
+
+    def __init__(self, drivers: Sequence[Any], mesh: Optional[Mesh] = None):
+        if not drivers:
+            raise ValueError("LocalMixGroup needs at least one driver")
+        self.drivers = list(drivers)
+        self.mesh = mesh
+
+    def mix(self) -> Dict[str, Any]:
+        # 1. schema sync (label vocab union etc.)
+        schemas = [d.get_schema() for d in self.drivers if hasattr(d, "get_schema")]
+        if schemas:
+            union: List[str] = sorted(set().union(*map(set, schemas)))
+            for d in self.drivers:
+                d.sync_schema(union)
+        # 2. per-mixable reduce + put
+        stats: Dict[str, Any] = {}
+        names = list(self.drivers[0].get_mixables().keys())
+        for name in names:
+            diffs = [d.get_mixables()[name].get_diff() for d in self.drivers]
+            if self.mesh is not None and self.mesh.shape.get("replica") == len(diffs):
+                total = allreduce_diffs(diffs, self.mesh)
+            else:
+                total = tree_sum(diffs)
+            for d in self.drivers:
+                d.get_mixables()[name].put_diff(total)
+            stats[name] = jax.tree_util.tree_map(
+                lambda x: getattr(x, "shape", None), total
+            )
+        return stats

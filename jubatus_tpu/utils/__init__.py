@@ -1,0 +1,3 @@
+"""Shared utilities: msgpack serialization of array pytrees, timers."""
+
+from jubatus_tpu.utils.serialization import pack_obj, unpack_obj  # noqa: F401

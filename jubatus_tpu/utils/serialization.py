@@ -1,0 +1,47 @@
+"""msgpack packing for model state pytrees (numpy / JAX arrays included).
+
+The reference serializes models through core::framework::packer into msgpack
+streams (linear_mixer.cpp:513-517, save_load.cpp:113-158). We keep msgpack as
+the envelope for wire/file parity and add one ext type for ndarrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import msgpack
+import numpy as np
+
+_EXT_NDARRAY = 42
+
+
+def _default(obj: Any):
+    # jax.Array and np.ndarray both expose __array__
+    if hasattr(obj, "__array__"):
+        arr = np.ascontiguousarray(np.asarray(obj))
+        payload = msgpack.packb(
+            (arr.dtype.str, list(arr.shape), arr.tobytes()), use_bin_type=True
+        )
+        return msgpack.ExtType(_EXT_NDARRAY, payload)
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    raise TypeError(f"cannot msgpack {type(obj)!r}")
+
+
+def _ext_hook(code: int, data: bytes):
+    if code == _EXT_NDARRAY:
+        dtype, shape, raw = msgpack.unpackb(data, raw=False)
+        return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape).copy()
+    return msgpack.ExtType(code, data)
+
+
+def pack_obj(obj: Any) -> bytes:
+    return msgpack.packb(obj, default=_default, use_bin_type=True)
+
+
+def unpack_obj(data: bytes) -> Any:
+    return msgpack.unpackb(
+        data, ext_hook=_ext_hook, raw=False, strict_map_key=False
+    )

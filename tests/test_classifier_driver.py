@@ -1,0 +1,212 @@
+"""Classifier driver tests — API parity with the reference classifier service
+(train/classify/get_labels/set_label/delete_label/clear/save/load) and the
+distributed mix with label-schema sync.
+
+Mirrors the black-box coverage of
+/root/reference/client_test/classifier_test.cpp (train/classify round trips,
+save/load) without the RPC layer (that layer has its own tests).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from jubatus_tpu.core import Datum
+from jubatus_tpu.framework import load_model, save_model
+from jubatus_tpu.framework.save_load import SaveLoadError
+from jubatus_tpu.models import ClassifierDriver
+from jubatus_tpu.parallel import LocalMixGroup
+
+CFG = {
+    "method": "AROW",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {
+        "string_rules": [
+            {"key": "*", "type": "space", "sample_weight": "tf", "global_weight": "bin"}
+        ],
+        "num_rules": [{"key": "*", "type": "num"}],
+    },
+}
+
+SPAM = [
+    Datum({"t": "buy cheap pills now"}),
+    Datum({"t": "cheap pills discount buy now"}),
+    Datum({"t": "discount pills buy"}),
+]
+HAM = [
+    Datum({"t": "meeting notes for tuesday"}),
+    Datum({"t": "tuesday agenda and meeting notes"}),
+    Datum({"t": "agenda for the meeting"}),
+]
+
+
+def trained_driver(dim_bits=12):
+    d = ClassifierDriver(CFG, dim_bits=dim_bits)
+    data = [("spam", x) for x in SPAM] + [("ham", x) for x in HAM]
+    for _ in range(3):
+        d.train(data)
+    return d
+
+
+def top_label(result):
+    return max(result, key=lambda kv: kv[1])[0]
+
+
+def test_train_classify_roundtrip():
+    d = trained_driver()
+    res = d.classify([Datum({"t": "cheap discount pills"}), Datum({"t": "notes for agenda"})])
+    assert top_label(res[0]) == "spam"
+    assert top_label(res[1]) == "ham"
+    # classify returns a score for every live label
+    assert {lab for lab, _ in res[0]} == {"spam", "ham"}
+
+
+def test_get_labels_counts_and_set_delete():
+    d = trained_driver()
+    labels = d.get_labels()
+    assert labels == {"spam": 9, "ham": 9}
+    assert d.set_label("eggs") is True
+    assert d.set_label("eggs") is False
+    assert set(d.get_labels()) == {"spam", "ham", "eggs"}
+    assert d.get_labels()["eggs"] == 0
+    assert d.delete_label("eggs") is True
+    assert d.delete_label("eggs") is False
+    assert set(d.get_labels()) == {"spam", "ham"}
+
+
+def test_deleted_label_slot_reuse_is_clean():
+    d = trained_driver()
+    d.delete_label("spam")
+    d.set_label("other")
+    res = d.classify([Datum({"t": "cheap discount pills"})])
+    scores = dict(res[0])
+    assert scores["other"] == 0.0
+
+
+def test_train_returns_count_and_empty_ok():
+    d = ClassifierDriver(CFG, dim_bits=10)
+    assert d.train([]) == 0
+    assert d.train([("a", Datum({"t": "x y"})), ("b", Datum({"t": "z w"}))]) == 2
+    assert d.classify([]) == []
+
+
+def test_clear_resets():
+    d = trained_driver()
+    d.clear()
+    assert d.get_labels() == {}
+    assert d.classify([Datum({"t": "anything"})]) == [[]]
+    assert d.update_count == 0
+
+
+def test_label_capacity_growth():
+    d = ClassifierDriver(CFG, dim_bits=10)
+    for i in range(20):
+        d.train([(f"label{i:02d}", Datum({"t": f"word{i} tok{i}"}))])
+    assert len(d.get_labels()) == 20
+    assert d.capacity >= 20
+
+
+def test_unknown_method_raises():
+    with pytest.raises(ValueError):
+        ClassifierDriver({"method": "SVM"})
+
+
+def test_save_load_roundtrip(tmp_path):
+    d = trained_driver()
+    path = str(tmp_path / "model.jubatus")
+    save_model(path, d, model_id="c0", config=d.config_json)
+    before = d.classify([Datum({"t": "cheap discount pills"})])
+
+    d2 = ClassifierDriver(CFG, dim_bits=12)
+    system, ver = load_model(path, d2, expected_config=d2.config_json)
+    assert system["type"] == "classifier"
+    after = d2.classify([Datum({"t": "cheap discount pills"})])
+    assert sorted(dict(before[0])) == sorted(dict(after[0]))
+    np.testing.assert_allclose(
+        sorted(v for _, v in before[0]), sorted(v for _, v in after[0]), atol=1e-6
+    )
+    assert d2.get_labels() == d.get_labels()
+
+
+def test_load_validates_type_crc_and_config(tmp_path):
+    d = trained_driver()
+    path = str(tmp_path / "model.jubatus")
+    save_model(path, d, config=d.config_json)
+
+    # wrong engine type
+    from jubatus_tpu.models import RegressionDriver
+
+    r = RegressionDriver({"method": "PA1"}, dim_bits=10)
+    with pytest.raises(SaveLoadError, match="type"):
+        load_model(path, r)
+
+    # config mismatch (semantic compare — whitespace-only diffs are fine)
+    with pytest.raises(SaveLoadError, match="config"):
+        load_model(path, ClassifierDriver(CFG, dim_bits=12),
+                   expected_config=json.dumps({"method": "PA"}))
+    spaced = json.dumps(json.loads(d.config_json), indent=3)
+    load_model(path, ClassifierDriver(CFG, dim_bits=12), expected_config=spaced)
+
+    # corruption -> CRC failure
+    raw = bytearray(open(path, "rb").read())
+    raw[60] ^= 0xFF
+    bad = str(tmp_path / "bad.jubatus")
+    open(bad, "wb").write(bytes(raw))
+    with pytest.raises(SaveLoadError, match="CRC32"):
+        load_model(bad, ClassifierDriver(CFG, dim_bits=12))
+
+
+EGGS = [
+    Datum({"t": "fresh organic eggs from the farm"}),
+    Datum({"t": "farm eggs organic dozen"}),
+    Datum({"t": "dozen fresh eggs"}),
+]
+
+
+def test_mix_two_replicas_with_distinct_labels():
+    """Replicas see different label sets ({spam,ham} vs {eggs,ham}); after mix
+    both know all three labels and classify each other's classes — the
+    schema-sync + psum path."""
+    d0 = ClassifierDriver(CFG, dim_bits=12)
+    d1 = ClassifierDriver(CFG, dim_bits=12)
+    for _ in range(3):
+        d0.train([("spam", x) for x in SPAM] + [("ham", x) for x in HAM])
+        d1.train([("eggs", x) for x in EGGS] + [("ham", x) for x in HAM])
+    group = LocalMixGroup([d0, d1])
+    group.mix()
+    assert d0.get_schema() == d1.get_schema() == ["eggs", "ham", "spam"]
+    assert d0.get_labels() == d1.get_labels() == {"spam": 9, "ham": 18, "eggs": 9}
+    for d in (d0, d1):
+        res = d.classify([
+            Datum({"t": "cheap discount pills"}),
+            Datum({"t": "meeting agenda notes"}),
+            Datum({"t": "organic farm eggs"}),
+        ])
+        assert top_label(res[0]) == "spam"
+        assert top_label(res[1]) == "ham"
+        assert top_label(res[2]) == "eggs"
+    # post-mix: local diffs are cleared; another mix is a no-op on weights
+    w_before = np.asarray(d0.state.w).copy()
+    group.mix()
+    np.testing.assert_allclose(np.asarray(d0.state.w), w_before, atol=1e-6)
+
+
+def test_mix_replicas_equivalent_over_device_mesh():
+    """Same mix through a real 4-device mesh collective must equal host fold."""
+    from jubatus_tpu.parallel import replica_mesh
+
+    ds = [ClassifierDriver(CFG, dim_bits=10) for _ in range(4)]
+    data = [("spam", x) for x in SPAM] + [("ham", x) for x in HAM]
+    for i, d in enumerate(ds):
+        d.train(data[i::2] if i % 2 == 0 else data[1::2])
+
+    host = [ClassifierDriver(CFG, dim_bits=10) for _ in range(4)]
+    for i, d in enumerate(host):
+        d.train(data[i::2] if i % 2 == 0 else data[1::2])
+
+    LocalMixGroup(ds, mesh=replica_mesh(4)).mix()
+    LocalMixGroup(host).mix()
+    np.testing.assert_allclose(
+        np.asarray(ds[0].state.w), np.asarray(host[0].state.w), rtol=1e-5, atol=1e-6
+    )

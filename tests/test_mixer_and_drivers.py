@@ -1,0 +1,144 @@
+"""IntervalMixer scheduling tests (the stabilizer-loop semantics,
+linear_mixer.cpp:362-435) + regression/weight driver tests."""
+
+import time
+
+import pytest
+
+from jubatus_tpu.core import Datum
+from jubatus_tpu.framework import IntervalMixer
+from jubatus_tpu.models import RegressionDriver, WeightDriver
+from jubatus_tpu.parallel import LocalMixGroup
+
+
+def test_mixer_fires_on_count_threshold():
+    fired = []
+    m = IntervalMixer(lambda: fired.append(time.monotonic()),
+                      interval_sec=9999, interval_count=10)
+    m.POLL_SEC = 0.01
+    m.start()
+    try:
+        m.updated(10)
+        deadline = time.time() + 5
+        while not fired and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        m.stop()
+    assert len(fired) == 1
+    assert m.mix_count == 1
+    assert m.get_status()["counter"] == 0
+
+
+def test_mixer_fires_on_time_threshold_only_with_updates():
+    fired = []
+    m = IntervalMixer(lambda: fired.append(1), interval_sec=0.05, interval_count=10_000)
+    m.POLL_SEC = 0.01
+    m.start()
+    try:
+        time.sleep(0.2)
+        assert not fired  # no updates -> no mix
+        m.updated(1)
+        deadline = time.time() + 5
+        while not fired and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        m.stop()
+    assert fired
+
+
+def test_mixer_mix_now_and_failure_does_not_kill_loop():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+
+    m = IntervalMixer(flaky, interval_sec=9999, interval_count=1)
+    m.POLL_SEC = 0.01
+    m.start()
+    try:
+        m.updated(1)
+        deadline = time.time() + 5
+        while len(calls) < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        m.updated(1)
+        while len(calls) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        m.stop()
+    assert len(calls) >= 2
+
+
+def test_mixer_stop_while_running_is_clean():
+    m = IntervalMixer(lambda: None)
+    m.start()
+    m.stop()
+    assert m._thread is None
+
+
+REG_CFG = {
+    "method": "PA1",
+    "parameter": {"sensitivity": 0.01, "regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}], "string_rules": []},
+}
+
+
+def test_regression_driver_end_to_end(tmp_path, rng):
+    d = RegressionDriver(REG_CFG, dim_bits=10)
+    # no implicit intercept (reference parity): model it as a constant feature
+    data = [(2.0 * x + 1.0, Datum({"x": x, "bias": 1.0})) for x in rng.uniform(-1, 1, 200)]
+    for _ in range(5):
+        d.train(data)
+    pred = d.estimate([Datum({"x": 0.5, "bias": 1.0}), Datum({"x": -0.5, "bias": 1.0})])
+    assert pred[0] == pytest.approx(2.0, abs=0.3)
+    assert pred[1] == pytest.approx(0.0, abs=0.3)
+
+    from jubatus_tpu.framework import load_model, save_model
+
+    path = str(tmp_path / "r.jubatus")
+    save_model(path, d, config=d.config_json)
+    d2 = RegressionDriver(REG_CFG, dim_bits=10)
+    load_model(path, d2, expected_config=d2.config_json)
+    assert d2.estimate([Datum({"x": 0.5, "bias": 1.0})])[0] == pytest.approx(pred[0], abs=1e-5)
+
+    d.clear()
+    assert d.estimate([Datum({"x": 0.5, "bias": 1.0})])[0] == 0.0
+
+
+def test_regression_mix(rng):
+    ds = [RegressionDriver(REG_CFG, dim_bits=10) for _ in range(2)]
+    xs = rng.uniform(-1, 1, 200)
+    for i, d in enumerate(ds):
+        for _ in range(5):
+            d.train([(3.0 * x, Datum({"x": x})) for x in xs[i::2]])
+    LocalMixGroup(ds).mix()
+    for d in ds:
+        assert d.estimate([Datum({"x": 1.0})])[0] == pytest.approx(3.0, abs=0.4)
+
+
+WEIGHT_CFG = {
+    "converter": {
+        "string_rules": [
+            {"key": "*", "type": "space", "sample_weight": "tf", "global_weight": "idf"}
+        ],
+        "num_rules": [{"key": "*", "type": "num"}],
+    }
+}
+
+
+def test_weight_driver_update_and_mix():
+    d0 = WeightDriver(WEIGHT_CFG, dim_bits=10)
+    d1 = WeightDriver(WEIGHT_CFG, dim_bits=10)
+    for _ in range(4):
+        d0.update(Datum({"t": "common rare0"}))
+        d1.update(Datum({"t": "common rare1"}))
+    # idf on d0 only knows its local docs pre-mix
+    pre = dict(d0.calc_weight(Datum({"t": "common rare1"})))
+    LocalMixGroup([d0, d1]).mix()
+    post = dict(d0.calc_weight(Datum({"t": "common rare1"})))
+    # after mix, d0 knows rare1 occurs in half the corpus -> finite idf < pre
+    k = "t$rare1@space#tf/idf"
+    assert post[k] < pre[k]
+    common = "t$common@space#tf/idf"
+    assert post[common] == pytest.approx(0.0, abs=1e-6)  # in every doc -> idf 0
