@@ -1,0 +1,111 @@
+"""Headline benchmark: classifier online-train throughput (AROW) on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no benchmark figures (BASELINE.md: "published": {});
+its hot path is the per-datum C++ driver update under a write lock
+(classifier_serv.cpp:127-146, SURVEY.md §3.2). As the baseline stand-in we
+time a faithful per-example numpy implementation of the same AROW update on
+this host's CPU — the closest measurable proxy for the reference's
+single-core sequential semantics — and report vs_baseline as the speedup of
+the TPU microbatched kernel over it.
+
+Workload: AROW binary classifier (Criteo-CTR-shaped: L=2, D=2^20 hashed
+features, 64 non-zeros/example), the BASELINE.json primary config.
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from jubatus_tpu.ops import classifier as C
+
+DIM_BITS = 20
+D = 1 << DIM_BITS
+L = 2
+K = 64
+BATCH = 4096
+WARMUP_STEPS = 2
+STEPS = 20
+BASELINE_EXAMPLES = 2000
+
+
+def make_data(rng, n):
+    idx = rng.integers(1, D, size=(n, K), dtype=np.int32)
+    val = rng.normal(size=(n, K)).astype(np.float32)
+    labels = rng.integers(0, L, size=n).astype(np.int32)
+    return idx, val, labels
+
+
+def numpy_arow_per_example(idx, val, labels, r=1.0):
+    """Reference-semantics sequential AROW on CPU (the baseline stand-in)."""
+    w = np.zeros((L, D), np.float32)
+    sigma = np.ones((L, D), np.float32)
+    n = len(labels)
+    t0 = time.perf_counter()
+    for i in range(n):
+        ii, vv, y = idx[i], val[i], labels[i]
+        s = (w[:, ii] * vv).sum(axis=1)
+        other = 1 - y
+        margin = s[y] - s[other]
+        loss = max(0.0, 1.0 - margin)
+        if loss > 0.0:
+            x2 = vv * vv
+            v = ((sigma[y, ii] + sigma[other, ii]) * x2).sum()
+            beta = 1.0 / (v + r)
+            alpha = loss * beta
+            w[y, ii] += alpha * sigma[y, ii] * vv
+            w[other, ii] -= alpha * sigma[other, ii] * vv
+            prec_inc = x2 / r
+            sigma[y, ii] = 1.0 / (1.0 / sigma[y, ii] + prec_inc)
+            sigma[other, ii] = 1.0 / (1.0 / sigma[other, ii] + prec_inc)
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+
+    # --- TPU path ---
+    state = C.init_state(L, D, confidence=True)
+    mask = jnp.array([True, True])
+    batches = [make_data(rng, BATCH) for _ in range(STEPS + WARMUP_STEPS)]
+    dev_batches = [
+        (jax.device_put(i, dev), jax.device_put(v, dev), jax.device_put(l, dev))
+        for i, v, l in batches
+    ]
+    for i in range(WARMUP_STEPS):
+        bi, bv, bl = dev_batches[i]
+        state = C.train_batch(state, bi, bv, bl, mask, 1.0, method="AROW")
+    # NB: block_until_ready under the axon tunnel can return before remote
+    # execution finishes; a scalar device->host fetch is the reliable barrier.
+    float(jnp.sum(state.dw))
+    t0 = time.perf_counter()
+    for i in range(WARMUP_STEPS, WARMUP_STEPS + STEPS):
+        bi, bv, bl = dev_batches[i]
+        state = C.train_batch(state, bi, bv, bl, mask, 1.0, method="AROW")
+    float(jnp.sum(state.dw))
+    tpu_sps = STEPS * BATCH / (time.perf_counter() - t0)
+
+    # --- baseline stand-in ---
+    bi, bv, bl = make_data(rng, BASELINE_EXAMPLES)
+    base_sps = numpy_arow_per_example(bi, bv, bl)
+
+    print(
+        json.dumps(
+            {
+                "metric": "classifier_train_samples_per_sec_arow_d2^20",
+                "value": round(tpu_sps, 1),
+                "unit": "samples/s",
+                "vs_baseline": round(tpu_sps / base_sps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -9,9 +9,24 @@ A driver owns one engine's model state + fv_converter and exposes:
 
 from __future__ import annotations
 
+import functools
+import threading
 from typing import Any, Dict, List
 
 from jubatus_tpu.parallel.mix import Mixable
+
+
+def locked(fn):
+    """Method decorator: hold the driver's model lock (the reference's
+    JRLOCK_/JWLOCK_ decorators collapsed to one reentrant lock — snapshot
+    reads of JAX arrays make a reader/writer split unnecessary for now)."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self.lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
 
 
 class DriverBase:
@@ -25,6 +40,11 @@ class DriverBase:
 
     def __init__(self) -> None:
         self.update_count = 0
+        #: model lock (the reference's rw_mutex, server_base.hpp:70-72):
+        #: drivers hold it in their public methods; the mix engine holds every
+        #: participant's lock for the round (parallel/mix.py), so a background
+        #: mix can never interleave with train/classify on the same model.
+        self.lock = threading.RLock()
 
     # -- mix plane ----------------------------------------------------------
     def get_mixables(self) -> Dict[str, Mixable]:

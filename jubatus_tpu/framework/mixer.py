@@ -31,6 +31,7 @@ class IntervalMixer:
         self._counter = 0
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        self._mix_serialize = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._running = False
         # status counters (reference linear_mixer.cpp:349-360)
@@ -48,17 +49,22 @@ class IntervalMixer:
 
     def mix_now(self) -> Any:
         """Synchronous mix (the reference's do_mix RPC)."""
-        with self._cond:
-            return self._do_mix_locked()
+        return self._run_mix()
 
-    def _do_mix_locked(self) -> Any:
-        start = time.monotonic()
-        result = self._mix_fn()
-        self.last_mix_duration = time.monotonic() - start
-        self.mix_count += 1
-        self._counter = 0
-        self._last_mix_time = time.monotonic()
-        return result
+    def _run_mix(self) -> Any:
+        """Execute one mix round WITHOUT holding the condition lock: updated()
+        callers (the train hot path) must never block behind a collective.
+        _mix_serialize keeps concurrent mix_now/loop rounds from overlapping."""
+        with self._mix_serialize:
+            with self._cond:
+                self._counter = 0
+            start = time.monotonic()
+            result = self._mix_fn()
+            with self._cond:
+                self.last_mix_duration = time.monotonic() - start
+                self.mix_count += 1
+                self._last_mix_time = time.monotonic()
+            return result
 
     # -- background loop ------------------------------------------------------
     def start(self) -> None:
@@ -77,8 +83,10 @@ class IntervalMixer:
             self._thread = None
 
     def _loop(self) -> None:
-        with self._cond:
-            while self._running:
+        while True:
+            with self._cond:
+                if not self._running:
+                    return
                 self._cond.wait(timeout=self.POLL_SEC)
                 if not self._running:
                     return
@@ -86,13 +94,13 @@ class IntervalMixer:
                 due = self._counter >= self.interval_count or (
                     self._counter > 0 and elapsed >= self.interval_sec
                 )
-                if due:
-                    try:
-                        self._do_mix_locked()
-                    except Exception:  # mix failure must not kill the loop
-                        import logging
+            if due:
+                try:
+                    self._run_mix()  # outside the cond lock
+                except Exception:  # mix failure must not kill the loop
+                    import logging
 
-                        logging.getLogger(__name__).exception("mix round failed")
+                    logging.getLogger(__name__).exception("mix round failed")
 
     def get_status(self) -> Dict[str, Any]:
         return {

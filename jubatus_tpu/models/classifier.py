@@ -26,7 +26,7 @@ import numpy as np
 from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.core.fv import make_fv_converter
 from jubatus_tpu.core.sparse import SparseBatch
-from jubatus_tpu.framework.driver import DriverBase
+from jubatus_tpu.framework.driver import DriverBase, locked
 from jubatus_tpu.ops import classifier as ops
 
 _LINEAR_METHODS = set(ops.METHODS)
@@ -41,10 +41,13 @@ class ClassifierConfigError(ValueError):
 class ClassifierDriver(DriverBase):
     TYPE = "classifier"
 
-    def __init__(self, config: dict, dim_bits: int = 18):
+    def __init__(self, config: dict, dim_bits: int = 18, train_mode: str = "parallel"):
         super().__init__()
         self.config = config
         self.config_json = json.dumps(config)
+        # "parallel" = vectorized microbatch (TPU hot path); "sequential" =
+        # exact per-datum reference semantics (ops/classifier.py).
+        self.train_mode = train_mode
         method = config.get("method")
         if method in _NN_METHODS:
             # instance-based classifier over the NN engine — separate driver
@@ -99,12 +102,14 @@ class ClassifierDriver(DriverBase):
         self.label_slots[label] = slot
         return slot
 
+    @locked
     def set_label(self, label: str) -> bool:
         if label in self.label_slots:
             return False
         self._ensure_label(label)
         return True
 
+    @locked
     def delete_label(self, label: str) -> bool:
         """Remove a label locally. In a cluster this MUST be applied on every
         replica (the reference routes delete_label as #@broadcast,
@@ -127,6 +132,7 @@ class ClassifierDriver(DriverBase):
         self.labels[slot] = ""
         return True
 
+    @locked
     def get_labels(self) -> Dict[str, int]:
         return {
             lab: int(self.label_counts[slot] + self._dcounts[slot])
@@ -134,6 +140,7 @@ class ClassifierDriver(DriverBase):
         }
 
     # -- train / classify ----------------------------------------------------
+    @locked
     def train(self, data: Sequence[Tuple[str, Datum]]) -> int:
         if not data:
             return 0
@@ -152,10 +159,12 @@ class ClassifierDriver(DriverBase):
             self._mask(),
             self.param,
             method=self.method,
+            mode=self.train_mode,
         )
         self.event_model_updated(len(data))
         return len(data)
 
+    @locked
     def classify(self, data: Sequence[Datum]) -> List[List[Tuple[str, float]]]:
         if not data:
             return []
@@ -171,6 +180,7 @@ class ClassifierDriver(DriverBase):
             out.append([(lab, float(row[slot])) for lab, slot in self.label_slots.items()])
         return out
 
+    @locked
     def clear(self) -> None:
         self._init_model()
         self.converter.weights.clear()
@@ -226,6 +236,7 @@ class ClassifierDriver(DriverBase):
         return {"classifier": _ClassifierMixable(self), "weights": self.converter.weights}
 
     # -- persistence ---------------------------------------------------------
+    @locked
     def pack(self) -> Any:
         return {
             "method": self.method,
@@ -238,7 +249,15 @@ class ClassifierDriver(DriverBase):
             "weights": self.converter.weights.pack(),
         }
 
+    @locked
     def unpack(self, obj: Any) -> None:
+        saved_method = obj.get("method")
+        if isinstance(saved_method, bytes):
+            saved_method = saved_method.decode()
+        if saved_method != self.method:
+            raise ValueError(
+                f"checkpoint method {saved_method!r} != driver method {self.method!r}"
+            )
         if int(obj.get("dim", self.converter.dim)) != self.converter.dim:
             raise ValueError(
                 f"checkpoint feature dim {obj['dim']} != driver dim "
@@ -258,6 +277,7 @@ class ClassifierDriver(DriverBase):
         self._dcounts = np.zeros_like(self.label_counts)
         self.converter.weights.unpack(obj["weights"])
 
+    @locked
     def get_status(self) -> Dict[str, Any]:
         st = super().get_status()
         st.update(

@@ -17,7 +17,7 @@ import numpy as np
 from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.core.fv import make_fv_converter
 from jubatus_tpu.core.sparse import SparseBatch
-from jubatus_tpu.framework.driver import DriverBase
+from jubatus_tpu.framework.driver import DriverBase, locked
 from jubatus_tpu.ops import regression as ops
 
 
@@ -42,6 +42,7 @@ class RegressionDriver(DriverBase):
         self.converter = make_fv_converter(config.get("converter"), dim_bits=dim_bits)
         self.state = ops.init_state(self.converter.dim)
 
+    @locked
     def train(self, data: Sequence[Tuple[float, Datum]]) -> int:
         if not data:
             return 0
@@ -60,6 +61,7 @@ class RegressionDriver(DriverBase):
         self.event_model_updated(len(data))
         return len(data)
 
+    @locked
     def estimate(self, data: Sequence[Datum]) -> List[float]:
         if not data:
             return []
@@ -68,6 +70,7 @@ class RegressionDriver(DriverBase):
         pred = ops.estimate(self.state, jnp.asarray(sb.idx), jnp.asarray(sb.val))
         return [float(x) for x in np.asarray(pred)]
 
+    @locked
     def clear(self) -> None:
         self.state = ops.init_state(self.converter.dim)
         self.converter.weights.clear()
@@ -76,6 +79,7 @@ class RegressionDriver(DriverBase):
     def get_mixables(self):
         return {"regression": _RegressionMixable(self), "weights": self.converter.weights}
 
+    @locked
     def pack(self) -> Any:
         return {
             "method": self.method,
@@ -84,7 +88,15 @@ class RegressionDriver(DriverBase):
             "weights": self.converter.weights.pack(),
         }
 
+    @locked
     def unpack(self, obj: Any) -> None:
+        saved_method = obj.get("method")
+        if isinstance(saved_method, bytes):
+            saved_method = saved_method.decode()
+        if saved_method != self.method:
+            raise ValueError(
+                f"checkpoint method {saved_method!r} != driver method {self.method!r}"
+            )
         if int(obj.get("dim", self.converter.dim)) != self.converter.dim:
             raise ValueError(
                 f"checkpoint feature dim {obj['dim']} != driver dim "

@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Tuple
 
 from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.core.fv import make_fv_converter
-from jubatus_tpu.framework.driver import DriverBase
+from jubatus_tpu.framework.driver import DriverBase, locked
 
 
 class WeightDriver(DriverBase):
@@ -26,14 +26,17 @@ class WeightDriver(DriverBase):
         self.config_json = json.dumps(config)
         self.converter = make_fv_converter(config.get("converter"), dim_bits=dim_bits)
 
+    @locked
     def update(self, d: Datum) -> List[Tuple[str, float]]:
         result = self.converter.convert_named(d, update_weights=True)
         self.event_model_updated()
         return sorted(result.items())
 
+    @locked
     def calc_weight(self, d: Datum) -> List[Tuple[str, float]]:
         return sorted(self.converter.convert_named(d).items())
 
+    @locked
     def clear(self) -> None:
         self.converter.weights.clear()
         self.update_count = 0
@@ -41,9 +44,11 @@ class WeightDriver(DriverBase):
     def get_mixables(self):
         return {"weights": self.converter.weights}
 
+    @locked
     def pack(self) -> Any:
         return {"weights": self.converter.weights.pack()}
 
+    @locked
     def unpack(self, obj: Any) -> None:
         self.converter.weights.unpack(obj["weights"])
 

@@ -108,6 +108,20 @@ class LocalMixGroup:
         self.mesh = mesh
 
     def mix(self) -> Dict[str, Any]:
+        # hold every participant's model lock for the round (deadlock-free:
+        # consistent acquisition order; drivers only ever take their own)
+        locks = sorted(
+            (d.lock for d in self.drivers if hasattr(d, "lock")), key=id
+        )
+        try:
+            for lk in locks:
+                lk.acquire()
+            return self._mix_locked()
+        finally:
+            for lk in reversed(locks):
+                lk.release()
+
+    def _mix_locked(self) -> Dict[str, Any]:
         # 1. schema sync (label vocab union etc.)
         schemas = [d.get_schema() for d in self.drivers if hasattr(d, "get_schema")]
         if schemas:

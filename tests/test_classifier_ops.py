@@ -45,17 +45,36 @@ def accuracy(state, idx, val, labels, mask):
     return float(jnp.mean(jnp.argmax(s, axis=1) == labels))
 
 
+@pytest.mark.parametrize("mode", ["parallel", "sequential"])
 @pytest.mark.parametrize("method", C.METHODS)
-def test_method_learns_separable_data(method, rng):
+def test_method_learns_separable_data(method, mode, rng):
     vectors, labels = make_blobs(rng, 300)
     idx, val, y = batchify(vectors, labels)
     mask = jnp.array([True, True, True, False])
     state = C.init_state(L, DIM, method in C.CONFIDENCE_METHODS)
     param = 1.0
     for _ in range(3):
-        state = C.train_batch(state, idx, val, y, mask, param, method=method)
+        state = C.train_batch(state, idx, val, y, mask, param, method=method, mode=mode)
     acc = accuracy(state, idx, val, y, mask)
-    assert acc > 0.9, f"{method} failed to learn: acc={acc}"
+    assert acc > 0.9, f"{method}/{mode} failed to learn: acc={acc}"
+
+
+def test_parallel_matches_sequential_on_batch_of_one(rng):
+    """With B=1 the snapshot semantics coincide: both paths must agree."""
+    vectors, labels = make_blobs(rng, 20)
+    mask = jnp.array([True, True, True, False])
+    s_par = C.init_state(L, DIM, True)
+    s_seq = C.init_state(L, DIM, True)
+    for vec, lab in zip(vectors, labels):
+        sb = SparseBatch.from_vectors([vec])
+        args = (jnp.asarray(sb.idx), jnp.asarray(sb.val),
+                jnp.asarray([lab], jnp.int32), mask, 1.0)
+        s_par = C.train_batch(s_par, *args, method="AROW", mode="parallel")
+        s_seq = C.train_batch(s_seq, *args, method="AROW", mode="sequential")
+    np.testing.assert_allclose(np.asarray(s_par.dw), np.asarray(s_seq.dw),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(s_par.dprec), np.asarray(s_seq.dprec),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_dead_labels_never_predicted(rng):

@@ -142,3 +142,49 @@ def test_weight_driver_update_and_mix():
     assert post[k] < pre[k]
     common = "t$common@space#tf/idf"
     assert post[common] == pytest.approx(0.0, abs=1e-6)  # in every doc -> idf 0
+
+
+def test_concurrent_train_and_mix_thread_safety():
+    """Hammer train/classify from one thread while background mixes run —
+    the model-lock discipline (driver.lock + group lock acquisition) must
+    keep state consistent (the reference's rw_mutex, server_base.hpp:70-72)."""
+    import threading
+    from jubatus_tpu.models import ClassifierDriver
+    from jubatus_tpu.framework import IntervalMixer
+
+    cfg = {
+        "method": "PA",
+        "converter": {
+            "string_rules": [
+                {"key": "*", "type": "space", "sample_weight": "bin", "global_weight": "bin"}
+            ],
+            "num_rules": [],
+        },
+    }
+    ds = [ClassifierDriver(cfg, dim_bits=10) for _ in range(2)]
+    group = LocalMixGroup(ds)
+    mixer = IntervalMixer(group.mix, interval_sec=9999, interval_count=4)
+    mixer.POLL_SEC = 0.005
+    errors = []
+
+    def hammer(d, tag):
+        try:
+            for i in range(30):
+                d.train([(f"l{i % 3}", Datum({"t": f"w{i} z{i % 5} {tag}"}))])
+                mixer.updated(1)
+                d.classify([Datum({"t": f"w{i}"})])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    mixer.start()
+    threads = [threading.Thread(target=hammer, args=(d, i)) for i, d in enumerate(ds)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mixer.stop()
+    assert not errors, errors
+    assert mixer.mix_count >= 1
+    # both replicas converged to the same schema
+    group.mix()
+    assert ds[0].get_schema() == ds[1].get_schema()
