@@ -6,6 +6,8 @@ pack/unpack for checkpoints. The RPC layer (rpc/) exposes them over the
 reference's wire protocol.
 """
 
+from jubatus_tpu.models.bandit import BanditDriver  # noqa: F401
 from jubatus_tpu.models.classifier import ClassifierDriver  # noqa: F401
 from jubatus_tpu.models.regression import RegressionDriver  # noqa: F401
+from jubatus_tpu.models.stat import StatDriver  # noqa: F401
 from jubatus_tpu.models.weight import WeightDriver  # noqa: F401

@@ -48,6 +48,12 @@ class Mixable(Protocol):
 
     def put_diff(self, diff: Any) -> bool: ...
 
+    # Optional: a custom associative combiner ``mix(acc, diff) -> acc``
+    # (the reference's mixable->mix, linear_mixer.cpp:481-499). When present
+    # the group folds with it instead of elementwise pytree addition —
+    # engines with sparse/dict-shaped diffs (bandit) use this to avoid
+    # shipping dense zero matrices.
+
 
 def tree_sum(diffs: Sequence[Any]) -> Any:
     """Host-side fold of diff pytrees (the reference's pairwise fold —
@@ -132,8 +138,12 @@ class LocalMixGroup:
         stats: Dict[str, Any] = {}
         names = list(self.drivers[0].get_mixables().keys())
         for name in names:
-            diffs = [d.get_mixables()[name].get_diff() for d in self.drivers]
-            if self.mesh is not None and self.mesh.shape.get("replica") == len(diffs):
+            mixables = [d.get_mixables()[name] for d in self.drivers]
+            diffs = [m.get_diff() for m in mixables]
+            custom_mix = getattr(mixables[0], "mix", None)
+            if custom_mix is not None:
+                total = functools.reduce(custom_mix, diffs)
+            elif self.mesh is not None and self.mesh.shape.get("replica") == len(diffs):
                 total = allreduce_diffs(diffs, self.mesh)
             else:
                 total = tree_sum(diffs)

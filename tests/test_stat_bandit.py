@@ -1,0 +1,198 @@
+"""Stat + bandit engine tests (API parity with stat.idl / bandit.idl,
+mix semantics via the LocalMixGroup stub seam — SURVEY.md §4 tier 2)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from jubatus_tpu.models.bandit import BanditConfigError, BanditDriver
+from jubatus_tpu.models.stat import StatDriver
+from jubatus_tpu.parallel import LocalMixGroup
+
+
+# ---------------------------------------------------------------------------
+# stat
+# ---------------------------------------------------------------------------
+def test_stat_basic_reductions():
+    s = StatDriver({"window_size": 128})
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        s.push("x", v)
+    assert s.sum("x") == 10.0
+    assert s.max("x") == 4.0
+    assert s.min("x") == 1.0
+    assert s.stddev("x") == pytest.approx(np.std([1, 2, 3, 4]))
+    assert s.moment("x", 1, 0.0) == pytest.approx(2.5)
+    assert s.moment("x", 2, 2.5) == pytest.approx(np.mean((np.arange(1, 5) - 2.5) ** 2))
+
+
+def test_stat_window_eviction():
+    s = StatDriver({"window_size": 3})
+    for v in [1, 2, 3, 4, 5]:
+        s.push("k", v)
+    # window holds the last 3 values
+    assert s.sum("k") == 12.0
+    assert s.min("k") == 3.0
+
+
+def test_stat_entropy_across_keys():
+    s = StatDriver({"window_size": 16})
+    for _ in range(2):
+        s.push("a", 1.0)
+    for _ in range(2):
+        s.push("b", 1.0)
+    # two keys, equal counts -> H = log 2
+    assert s.entropy() == pytest.approx(math.log(2))
+    assert s.entropy("a") == s.entropy("b")  # key is routing-only
+
+
+def test_stat_missing_key_raises():
+    s = StatDriver({"window_size": 4})
+    with pytest.raises(KeyError):
+        s.sum("nope")
+
+
+def test_stat_save_load_roundtrip():
+    s = StatDriver({"window_size": 4})
+    for v in [1, 2, 3, 4, 5]:
+        s.push("k", v)
+    s.push("j", 7.0)
+    packed = s.pack()
+    s2 = StatDriver({"window_size": 4})
+    s2.unpack(packed)
+    assert s2.sum("k") == s.sum("k")
+    assert s2.min("k") == s.min("k")
+    assert s2.sum("j") == 7.0
+
+
+def test_stat_mix_entropy_uses_cluster_counts():
+    a = StatDriver({"window_size": 16})
+    b = StatDriver({"window_size": 16})
+    for _ in range(4):
+        a.push("x", 1.0)
+    for _ in range(4):
+        b.push("y", 1.0)
+    LocalMixGroup([a, b]).mix()
+    # cluster-wide: two keys with 4 each -> log 2 on BOTH replicas
+    assert a.entropy() == pytest.approx(math.log(2))
+    assert b.entropy() == pytest.approx(math.log(2))
+
+
+# ---------------------------------------------------------------------------
+# bandit
+# ---------------------------------------------------------------------------
+def _cfg(method, **param):
+    return {"method": method, "parameter": {"assume_unrewarded": False, **param}}
+
+
+def test_bandit_register_and_info():
+    b = BanditDriver(_cfg("ucb1"))
+    assert b.register_arm("a")
+    assert b.register_arm("b")
+    assert not b.register_arm("a")
+    b.register_reward("p1", "a", 1.0)
+    info = b.get_arm_info("p1")
+    assert info["a"] == {"trial_count": 1, "weight": 1.0}
+    assert info["b"] == {"trial_count": 0, "weight": 0.0}
+    assert b.delete_arm("b")
+    assert "b" not in b.get_arm_info("p1")
+
+
+def test_bandit_ucb1_tries_all_then_exploits():
+    b = BanditDriver(_cfg("ucb1"))
+    for a in ("a", "b", "c"):
+        b.register_arm(a)
+    seen = set()
+    for _ in range(3):
+        arm = b.select_arm("p")
+        seen.add(arm)
+        b.register_reward("p", arm, 1.0 if arm == "b" else 0.0)
+    assert seen == {"a", "b", "c"}
+    # equalize trial counts so the exploration bonus cancels; b's mean wins
+    for _ in range(20):
+        b.register_reward("p", "a", 0.0)
+        b.register_reward("p", "b", 1.0)
+        b.register_reward("p", "c", 0.0)
+    assert b.select_arm("p") == "b"
+
+
+def test_bandit_epsilon_greedy_zero_eps_is_greedy():
+    b = BanditDriver(_cfg("epsilon_greedy", epsilon=0.0))
+    b.register_arm("bad")
+    b.register_arm("good")
+    b.register_reward("p", "good", 5.0)
+    b.register_reward("p", "bad", 0.1)
+    for _ in range(5):
+        assert b.select_arm("p") == "good"
+
+
+def test_bandit_assume_unrewarded_counts_trials_on_select():
+    b = BanditDriver({"method": "ucb1",
+                      "parameter": {"assume_unrewarded": True}})
+    b.register_arm("a")
+    b.select_arm("p")
+    assert b.get_arm_info("p")["a"]["trial_count"] == 1
+    b.register_reward("p", "a", 2.0)
+    info = b.get_arm_info("p")
+    assert info["a"]["trial_count"] == 1  # reward does not double-count
+    assert info["a"]["weight"] == 2.0
+
+
+def test_bandit_softmax_and_exp3_prefer_rewarded_arm():
+    for method, param in (("softmax", {"tau": 0.05}), ("exp3", {"gamma": 0.3})):
+        b = BanditDriver(_cfg(method, **param), seed=1)
+        b.register_arm("x")
+        b.register_arm("y")
+        for _ in range(30):
+            b.register_reward("p", "y", 1.0)
+        picks = [b.select_arm("p") for _ in range(50)]
+        assert picks.count("y") > picks.count("x")
+
+
+def test_bandit_reset_and_clear():
+    b = BanditDriver(_cfg("ucb1"))
+    b.register_arm("a")
+    b.register_reward("p", "a", 1.0)
+    b.reset("p")
+    assert b.get_arm_info("p")["a"]["trial_count"] == 0
+    b.clear()
+    assert b.arms == []
+
+
+def test_bandit_bad_config():
+    with pytest.raises(BanditConfigError):
+        BanditDriver({"method": "thompson"})
+    with pytest.raises(BanditConfigError):
+        BanditDriver(_cfg("softmax", tau=0.0))
+
+
+def test_bandit_mix_merges_player_stats():
+    a = BanditDriver(_cfg("ucb1"))
+    b = BanditDriver(_cfg("ucb1"))
+    for d in (a, b):
+        d.register_arm("arm")
+    a.register_reward("p", "arm", 1.0)
+    b.register_reward("p", "arm", 2.0)
+    b.register_reward("q", "arm", 5.0)
+    LocalMixGroup([a, b]).mix()
+    for d in (a, b):
+        info = d.get_arm_info("p")
+        assert info["arm"]["trial_count"] == 2
+        assert info["arm"]["weight"] == pytest.approx(3.0)
+        assert d.get_arm_info("q")["arm"]["weight"] == pytest.approx(5.0)
+    # second mix must not double-apply (diffs cleared)
+    LocalMixGroup([a, b]).mix()
+    assert a.get_arm_info("p")["arm"]["weight"] == pytest.approx(3.0)
+
+
+def test_bandit_save_load_roundtrip():
+    b = BanditDriver(_cfg("exp3", gamma=0.2), seed=3)
+    b.register_arm("a")
+    b.register_arm("b")
+    for _ in range(5):
+        arm = b.select_arm("p")
+        b.register_reward("p", arm, 1.0)
+    packed = b.pack()
+    b2 = BanditDriver(_cfg("exp3", gamma=0.2))
+    b2.unpack(packed)
+    assert b2.get_arm_info("p") == b.get_arm_info("p")
