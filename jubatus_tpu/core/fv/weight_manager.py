@@ -56,6 +56,9 @@ class WeightManager:
         return self._user_weights.get(index, 1.0)
 
     # -- mixable protocol (parallel/mix.py) ---------------------------------
+    #: mix() below is elementwise addition, so the mesh psum path applies
+    MIX_IS_SUM = True
+
     def get_diff(self):
         return {
             "df": self._df_diff.copy(),

@@ -1,0 +1,224 @@
+"""Fixed-capacity sparse row store with LRU unlearning.
+
+The instance-data backbone for the instance-based engines (recommender,
+nearest_neighbor, anomaly — SURVEY.md §7.2 "storage layer"): id-keyed rows
+of hashed sparse vectors, held as padded [C, K] arrays so every similarity
+kernel in ops/knn.py is one vectorized pass.
+
+- Capacity C and pad width K grow by doubling (bounded recompiles, like
+  core/sparse.py buckets).
+- ``max_size`` caps the live row count with least-recently-touched eviction —
+  the reference's "unlearner": "lru" configs (e.g.
+  /root/reference/config/recommender/lsh_unlearn_lru.json). On fixed-HBM TPU
+  a capacity bound is mandatory, not optional (SURVEY.md §7 hard part e).
+- Host numpy is the source of truth (updates are per-row scatter writes);
+  ``device_view()`` lazily uploads and caches the jnp arrays, invalidated by
+  a version counter — queries hit HBM-resident arrays, updates don't force
+  a round-trip each time.
+- Mix support: ``updated_since_mix`` tracks locally-written row ids; the
+  engine drivers ship them as sparse dict diffs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from jubatus_tpu.core.sparse import SparseVector
+
+_INITIAL_CAPACITY = 64
+_INITIAL_WIDTH = 8
+
+
+def _pow2_at_least(n: int, minimum: int) -> int:
+    p = minimum
+    while p < n:
+        p *= 2
+    return p
+
+
+class RowStore:
+    def __init__(self, max_size: Optional[int] = None,
+                 keep_datum: bool = False) -> None:
+        self.max_size = max_size
+        self.keep_datum = keep_datum
+        self._init()
+
+    def _init(self) -> None:
+        self.capacity = _INITIAL_CAPACITY
+        self.width = _INITIAL_WIDTH
+        self.idx = np.zeros((self.capacity, self.width), np.int32)
+        self.val = np.zeros((self.capacity, self.width), np.float32)
+        self.ids: List[str] = []              # slot -> id ("" = dead)
+        self.slots: Dict[str, int] = {}       # id -> slot
+        self._clock = 0
+        self._touch: Dict[str, int] = {}      # id -> last-touch tick (LRU)
+        self.datums: Dict[str, Any] = {}      # id -> original datum
+        self.updated_since_mix: Dict[str, None] = {}
+        self.version = 0                      # bumped on every write
+        self._dev_cache: Optional[Tuple[int, Any, Any, Any]] = None
+
+    # -- sizing --------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.slots)
+
+    def __contains__(self, row_id: str) -> bool:
+        return row_id in self.slots
+
+    def _grow_capacity(self) -> None:
+        self.capacity *= 2
+        self.idx = np.vstack([self.idx, np.zeros_like(self.idx)])
+        self.val = np.vstack([self.val, np.zeros_like(self.val)])
+
+    def _grow_width(self, need: int) -> None:
+        new_w = _pow2_at_least(need, self.width * 2)
+        pad = new_w - self.width
+        self.idx = np.pad(self.idx, ((0, 0), (0, pad)))
+        self.val = np.pad(self.val, ((0, 0), (0, pad)))
+        self.width = new_w
+
+    def _free_slot(self) -> int:
+        if len(self.ids) < self.capacity:
+            self.ids.append("")
+            return len(self.ids) - 1
+        for s, rid in enumerate(self.ids):
+            if not rid:
+                return s
+        self._grow_capacity()
+        self.ids.append("")
+        return len(self.ids) - 1
+
+    # -- writes --------------------------------------------------------------
+    def set_row(self, row_id: str, vec: SparseVector,
+                datum: Any = None) -> int:
+        """Insert or overwrite a row; returns its slot. Evicts the least
+        recently touched row first when max_size is reached."""
+        slot = self.slots.get(row_id)
+        if slot is None:
+            if self.max_size is not None and len(self.slots) >= self.max_size:
+                self._evict_lru()
+            slot = self._free_slot()
+            self.ids[slot] = row_id
+            self.slots[row_id] = slot
+        if len(vec) > self.width:
+            self._grow_width(len(vec))
+        self.idx[slot].fill(0)
+        self.val[slot].fill(0.0)
+        k = len(vec)
+        if k:
+            self.idx[slot, :k] = [i for i, _ in vec]
+            self.val[slot, :k] = [w for _, w in vec]
+        if self.keep_datum and datum is not None:
+            self.datums[row_id] = datum
+        self.touch(row_id)
+        self.updated_since_mix[row_id] = None
+        self.version += 1
+        return slot
+
+    def remove_row(self, row_id: str) -> bool:
+        slot = self.slots.pop(row_id, None)
+        if slot is None:
+            return False
+        self.ids[slot] = ""
+        self.idx[slot].fill(0)
+        self.val[slot].fill(0.0)
+        self._touch.pop(row_id, None)
+        self.datums.pop(row_id, None)
+        self.updated_since_mix.pop(row_id, None)
+        self.version += 1
+        return True
+
+    def clear(self) -> None:
+        self._init()
+
+    def touch(self, row_id: str) -> None:
+        self._clock += 1
+        self._touch[row_id] = self._clock
+
+    def _evict_lru(self) -> None:
+        victim = min(self._touch, key=self._touch.get)
+        self.remove_row(victim)
+
+    # -- reads ---------------------------------------------------------------
+    def get_row(self, row_id: str) -> Optional[SparseVector]:
+        slot = self.slots.get(row_id)
+        if slot is None:
+            return None
+        k = int((self.val[slot] != 0).sum())
+        order = np.nonzero(self.val[slot])[0]
+        return [(int(self.idx[slot, j]), float(self.val[slot, j]))
+                for j in order[:k]]
+
+    def all_ids(self) -> List[str]:
+        return list(self.slots.keys())
+
+    def iter_rows(self) -> Iterator[Tuple[str, int]]:
+        return iter(self.slots.items())
+
+    def live_mask(self) -> np.ndarray:
+        m = np.zeros(self.capacity, bool)
+        for s in self.slots.values():
+            m[s] = True
+        return m
+
+    def device_view(self):
+        """(idx, val, live_mask) as device arrays, cached per version."""
+        if self._dev_cache is None or self._dev_cache[0] != self.version:
+            self._dev_cache = (
+                self.version,
+                jnp.asarray(self.idx),
+                jnp.asarray(self.val),
+                jnp.asarray(self.live_mask()),
+            )
+        return self._dev_cache[1], self._dev_cache[2], self._dev_cache[3]
+
+    # -- mix / persistence ----------------------------------------------------
+    def pop_update_diff(self) -> Dict[str, Tuple[list, list, Any]]:
+        """Rows written since the last mix as {id: (idx_list, val_list,
+        datum)}; clears the tracker."""
+        out = {}
+        for rid in self.updated_since_mix:
+            slot = self.slots.get(rid)
+            if slot is None:
+                continue
+            nz = np.nonzero(self.val[slot])[0]
+            out[rid] = (
+                self.idx[slot, nz].tolist(),
+                self.val[slot, nz].tolist(),
+                self.datums.get(rid),
+            )
+        self.updated_since_mix = {}
+        return out
+
+    def apply_update_diff(self, diff: Dict[str, Tuple[list, list, Any]]) -> None:
+        for rid, (ii, vv, datum) in diff.items():
+            rid = rid.decode() if isinstance(rid, bytes) else rid
+            vec = [(int(i), float(v)) for i, v in zip(ii, vv)]
+            self.set_row(rid, vec, datum=datum)
+        # rows arriving via mix are not "local updates" for the next round
+        self.updated_since_mix = {}
+
+    def pack(self) -> Any:
+        return {
+            "rows": {
+                rid: (
+                    self.idx[s][np.nonzero(self.val[s])].tolist(),
+                    self.val[s][np.nonzero(self.val[s])].tolist(),
+                )
+                for rid, s in self.slots.items()
+            },
+            "datums": {rid: d.to_msgpack() if hasattr(d, "to_msgpack") else d
+                       for rid, d in self.datums.items()} if self.keep_datum else {},
+        }
+
+    def unpack(self, obj: Any, datum_decoder=None) -> None:
+        self._init()
+        for rid, (ii, vv) in obj["rows"].items():
+            rid = rid.decode() if isinstance(rid, bytes) else rid
+            self.set_row(rid, [(int(i), float(v)) for i, v in zip(ii, vv)])
+        for rid, d in (obj.get("datums") or {}).items():
+            rid = rid.decode() if isinstance(rid, bytes) else rid
+            self.datums[rid] = datum_decoder(d) if datum_decoder else d
+        self.updated_since_mix = {}

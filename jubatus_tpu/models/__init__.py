@@ -6,8 +6,11 @@ pack/unpack for checkpoints. The RPC layer (rpc/) exposes them over the
 reference's wire protocol.
 """
 
+from jubatus_tpu.models.anomaly import AnomalyDriver  # noqa: F401
 from jubatus_tpu.models.bandit import BanditDriver  # noqa: F401
 from jubatus_tpu.models.classifier import ClassifierDriver  # noqa: F401
+from jubatus_tpu.models.nearest_neighbor import NearestNeighborDriver  # noqa: F401
+from jubatus_tpu.models.recommender import RecommenderDriver  # noqa: F401
 from jubatus_tpu.models.regression import RegressionDriver  # noqa: F401
 from jubatus_tpu.models.stat import StatDriver  # noqa: F401
 from jubatus_tpu.models.weight import WeightDriver  # noqa: F401

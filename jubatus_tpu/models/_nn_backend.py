@@ -1,0 +1,240 @@
+"""Shared similarity-search backend for the instance-based engines.
+
+Composes a RowStore (core/row_store.py) with one of the ops/knn.py methods
+and keeps the per-row signature/projection tables aligned with the store.
+Used by nearest_neighbor, recommender, and anomaly (the reference layers the
+same way: recommender/anomaly sit on core nearest-neighbor backends,
+/root/reference/config/anomaly/lof.json nests a NN method config).
+
+Methods and their distance/similarity conventions:
+
+  lsh          Hamming distance in [0,1] over sign-random-projection bits;
+               similarity = 1 - distance.
+  minhash      1 - (weighted-Jaccard estimate); similarity = 1 - distance.
+  euclid_lsh   JL-estimated euclidean distance; similarity = -distance
+               (the reference scores euclidean similarity as the negated
+               distance, so "bigger is more similar" holds).
+  inverted_index  exact cosine similarity; distance = 1 - similarity.
+  euclid          exact euclidean distance; similarity = -distance.
+
+Write path is buffered: set_row queues the vector and signatures are
+computed for ALL pending rows in one batched kernel call at the next query
+(amortizes jit dispatch; the reference instead pays a per-update index
+write). Everything device-side is cached per store version.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from jubatus_tpu.core.row_store import RowStore
+from jubatus_tpu.core.sparse import SparseBatch, SparseVector
+from jubatus_tpu.ops import knn
+
+HASH_METHODS = ("lsh", "minhash", "euclid_lsh")
+EXACT_METHODS = ("inverted_index", "euclid")
+METHODS = HASH_METHODS + EXACT_METHODS
+
+# methods where the natural score is a similarity (largest-first)
+_SIMILARITY_NATIVE = {"inverted_index"}
+
+
+class NNBackend:
+    def __init__(self, method: str, *, dim: int, hash_num: int = 64,
+                 seed: int = 0, max_size: Optional[int] = None,
+                 keep_datum: bool = False):
+        if method not in METHODS:
+            raise ValueError(f"unknown nearest-neighbor method {method!r}")
+        self.method = method
+        self.dim = dim
+        self.hash_num = int(hash_num)
+        self.seed = int(seed)
+        self.store = RowStore(max_size=max_size, keep_datum=keep_datum)
+        self._pending: Dict[str, SparseVector] = {}
+        self._init_sigs()
+
+    def _init_sigs(self) -> None:
+        c = self.store.capacity
+        if self.method == "lsh":
+            self._sigs = np.zeros((c, knn.packed_words(self.hash_num)), np.uint32)
+        elif self.method == "minhash":
+            self._sigs = np.zeros((c, self.hash_num), np.uint32)
+        elif self.method == "euclid_lsh":
+            self._sigs = np.zeros((c, self.hash_num), np.float32)
+        else:
+            self._sigs = None
+        self._sig_dev: Optional[Tuple[int, Any]] = None
+
+    # -- writes ---------------------------------------------------------------
+    def set_row(self, row_id: str, vec: SparseVector, datum: Any = None) -> None:
+        self.store.set_row(row_id, vec, datum=datum)
+        if self._sigs is not None:
+            self._pending[row_id] = vec
+
+    def remove_row(self, row_id: str) -> bool:
+        self._pending.pop(row_id, None)
+        return self.store.remove_row(row_id)
+
+    def clear(self) -> None:
+        self.store.clear()
+        self._pending.clear()
+        self._init_sigs()
+
+    # -- signature maintenance -----------------------------------------------
+    def _flush(self) -> None:
+        if self._sigs is None or not self._pending:
+            return
+        if self._sigs.shape[0] != self.store.capacity:
+            pad = self.store.capacity - self._sigs.shape[0]
+            self._sigs = np.pad(self._sigs, ((0, pad), (0, 0)))
+        items = [(rid, vec) for rid, vec in self._pending.items()
+                 if rid in self.store.slots]
+        self._pending.clear()
+        if not items:
+            return
+        sb = SparseBatch.from_vectors([vec for _, vec in items])
+        idx, val = jnp.asarray(sb.idx), jnp.asarray(sb.val)
+        if self.method == "lsh":
+            sigs = knn.lsh_signature(idx, val, hash_num=self.hash_num,
+                                     seed=self.seed)
+        elif self.method == "minhash":
+            sigs = knn.minhash_signature(idx, val, hash_num=self.hash_num,
+                                         seed=self.seed)
+        else:
+            sigs = knn.euclid_projection(idx, val, hash_num=self.hash_num,
+                                         seed=self.seed)
+        sigs = np.asarray(sigs)
+        for row, (rid, _) in enumerate(items):
+            self._sigs[self.store.slots[rid]] = sigs[row]
+        self._sig_dev = None
+
+    def _sig_view(self):
+        if self._sig_dev is None or self._sig_dev[0] != self.store.version:
+            self._sig_dev = (self.store.version, jnp.asarray(self._sigs))
+        return self._sig_dev[1]
+
+    # -- queries ---------------------------------------------------------------
+    def _query_sig(self, vec: SparseVector):
+        sb = SparseBatch.from_vectors([vec])
+        idx, val = jnp.asarray(sb.idx), jnp.asarray(sb.val)
+        if self.method == "lsh":
+            return knn.lsh_signature(idx, val, hash_num=self.hash_num,
+                                     seed=self.seed)[0]
+        if self.method == "minhash":
+            return knn.minhash_signature(idx, val, hash_num=self.hash_num,
+                                         seed=self.seed)[0]
+        return knn.euclid_projection(idx, val, hash_num=self.hash_num,
+                                     seed=self.seed)[0]
+
+    def distances(self, vec: SparseVector) -> np.ndarray:
+        """Distance of every live slot to the query; dead slots +inf. [C]."""
+        self._flush()
+        live = self.store.live_mask()
+        if not live.any():
+            return np.full(self.store.capacity, np.inf, np.float32)
+        if self.method in HASH_METHODS:
+            q = self._query_sig(vec)
+            sigs = self._sig_view()
+            if self.method == "lsh":
+                d = knn.hamming_distances(q, sigs, hash_num=self.hash_num)
+            elif self.method == "minhash":
+                d = knn.minhash_distances(q, sigs)
+            else:
+                d = knn.euclid_lsh_distances(q, sigs, hash_num=self.hash_num)
+        else:
+            idx, val, _ = self.store.device_view()
+            qd = knn.densify(jnp.asarray(np.array([i for i, _ in vec] or [0],
+                                                  np.int32)),
+                             jnp.asarray(np.array([v for _, v in vec] or [0.0],
+                                                  np.float32)),
+                             dim=self.dim)
+            if self.method == "inverted_index":
+                d = 1.0 - knn.cosine_scores(idx, val, qd)
+            else:
+                d = knn.euclid_distances(idx, val, qd)
+        d = np.asarray(d, np.float32).copy()
+        d[~live] = np.inf
+        return d
+
+    def similarity_from_distance(self, d: np.ndarray) -> np.ndarray:
+        if self.method in ("euclid_lsh", "euclid"):
+            return -d
+        return 1.0 - d
+
+    def neighbors(self, vec: SparseVector, k: int) -> List[Tuple[str, float]]:
+        """k nearest as (id, distance), ascending."""
+        d = self.distances(vec)
+        k = min(k, len(self.store))
+        if k <= 0:
+            return []
+        order = np.argpartition(d, k - 1)[:k]
+        order = order[np.argsort(d[order])]
+        return [(self.store.ids[s], float(d[s])) for s in order]
+
+    def similar(self, vec: SparseVector, k: int) -> List[Tuple[str, float]]:
+        """k most similar as (id, similarity), descending."""
+        return [(rid, float(self.similarity_from_distance(np.float32(dist))))
+                for rid, dist in self.neighbors(vec, k)]
+
+    # -- batch distances (LOF lrd cache) ---------------------------------------
+    def distances_from_slots(self, slots: np.ndarray,
+                             chunk: int = 256) -> np.ndarray:
+        """Distances from each of the given row slots to every slot:
+        [len(slots), C]; dead columns +inf. Hash methods run the batched
+        signature kernels (one [B, C] pass per chunk); exact methods fall
+        back to a per-row loop over the single-query kernel."""
+        self._flush()
+        live = self.store.live_mask()
+        c = self.store.capacity
+        out = np.full((len(slots), c), np.inf, np.float32)
+        if not live.any():
+            return out
+        if self.method in HASH_METHODS:
+            sigs = self._sig_view()
+            for lo in range(0, len(slots), chunk):
+                sel = np.asarray(slots[lo:lo + chunk])
+                q = sigs[jnp.asarray(sel)]
+                if self.method == "lsh":
+                    d = knn.hamming_distances_batch(q, sigs,
+                                                    hash_num=self.hash_num)
+                elif self.method == "minhash":
+                    d = knn.minhash_distances_batch(q, sigs)
+                else:
+                    d = knn.euclid_lsh_distances_batch(q, sigs,
+                                                       hash_num=self.hash_num)
+                out[lo:lo + chunk] = np.asarray(d)
+        else:
+            for row, s in enumerate(slots):
+                rid = self.store.ids[int(s)]
+                vec = self.store.get_row(rid) or []
+                out[row] = self.distances(vec)
+        out[:, ~live] = np.inf
+        return out
+
+    # -- persistence / mix -----------------------------------------------------
+    def pack(self) -> Any:
+        self._flush()
+        return {"store": self.store.pack()}
+
+    def unpack(self, obj: Any, datum_decoder=None) -> None:
+        self.clear()
+        self.store.unpack(obj["store"], datum_decoder=datum_decoder)
+        for rid in self.store.all_ids():
+            vec = self.store.get_row(rid)
+            if self._sigs is not None:
+                self._pending[rid] = vec
+
+    def pop_update_diff(self):
+        return self.store.pop_update_diff()
+
+    def apply_update_diff(self, diff, datum_decoder=None) -> None:
+        for rid, (ii, vv, datum) in diff.items():
+            rid = rid.decode() if isinstance(rid, bytes) else rid
+            if datum is not None and datum_decoder is not None:
+                datum = datum_decoder(datum)
+            vec = [(int(i), float(v)) for i, v in zip(ii, vv)]
+            self.set_row(rid, vec, datum=datum)
+        self.store.updated_since_mix = {}

@@ -233,11 +233,17 @@ class BanditDriver(DriverBase):
         return {
             "method": self.method,
             "arms": list(self.arms),
+            # iterate each player's actual stat keys, not self.arms: a mix
+            # can land stats for an arm whose register_arm broadcast hasn't
+            # reached this replica yet — a checkpoint must not drop them
             "players": {
                 p: {
-                    "trials": {a: st.trials(a) for a in self.arms},
-                    "weight": {a: st.weight(a) for a in self.arms},
-                    "logw": {a: st.logw(a) for a in self.arms},
+                    "trials": {a: st.trials(a) for a in
+                               set(st.trials_m) | set(st.trials_d)},
+                    "weight": {a: st.weight(a) for a in
+                               set(st.weight_m) | set(st.weight_d)},
+                    "logw": {a: st.logw(a) for a in
+                             set(st.logw_m) | set(st.logw_d)},
                 }
                 for p, st in self.players.items()
             },
@@ -293,16 +299,17 @@ class _BanditMixable:
 
     @staticmethod
     def mix(acc, diff):
-        out = {p: {a: list(v) for a, v in cells.items()}
-               for p, cells in acc.items()}
+        # merge in place: the fold's acc is always a transient — either the
+        # first replica's freshly-built get_diff dict or a prior mix result —
+        # so an O(touched-cells) in-place merge keeps the whole reduce linear
         for p, cells in diff.items():
-            mine = out.setdefault(p, {})
+            mine = acc.setdefault(p, {})
             for a, v in cells.items():
                 if a in mine:
                     mine[a] = [x + y for x, y in zip(mine[a], v)]
                 else:
                     mine[a] = list(v)
-        return out
+        return acc
 
     def put_diff(self, diff) -> bool:
         def _s(x):

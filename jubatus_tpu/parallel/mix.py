@@ -141,14 +141,20 @@ class LocalMixGroup:
             mixables = [d.get_mixables()[name] for d in self.drivers]
             diffs = [m.get_diff() for m in mixables]
             custom_mix = getattr(mixables[0], "mix", None)
-            if custom_mix is not None:
-                total = functools.reduce(custom_mix, diffs)
-            elif self.mesh is not None and self.mesh.shape.get("replica") == len(diffs):
+            # Routing: the mesh collective handles any diff whose combine is
+            # elementwise addition over a fixed-shape array pytree — i.e. no
+            # custom mix, or one explicitly marked MIX_IS_SUM (WeightManager).
+            # Dict-shaped sparse diffs (bandit, row stores) must fold host-side.
+            summable = custom_mix is None or getattr(mixables[0], "MIX_IS_SUM", False)
+            if (summable and self.mesh is not None
+                    and self.mesh.shape.get("replica") == len(diffs)):
                 total = allreduce_diffs(diffs, self.mesh)
+            elif custom_mix is not None:
+                total = functools.reduce(custom_mix, diffs)
             else:
                 total = tree_sum(diffs)
-            for d in self.drivers:
-                d.get_mixables()[name].put_diff(total)
+            for m in mixables:
+                m.put_diff(total)
             stats[name] = jax.tree_util.tree_map(
                 lambda x: getattr(x, "shape", None), total
             )

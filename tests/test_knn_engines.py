@@ -1,0 +1,330 @@
+"""NN kernel + nearest_neighbor / recommender / anomaly engine tests.
+
+Kernel properties are checked against numpy references; engine APIs against
+the reference IDL surfaces (nearest_neighbor.idl, recommender.idl,
+anomaly.idl) with real config shapes from /root/reference/config/."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.core.row_store import RowStore
+from jubatus_tpu.core.sparse import SparseBatch
+from jubatus_tpu.models import (AnomalyDriver, NearestNeighborDriver,
+                                RecommenderDriver)
+from jubatus_tpu.ops import knn
+from jubatus_tpu.parallel import LocalMixGroup
+
+CONV = {
+    "string_rules": [{"key": "*", "type": "str", "sample_weight": "bin",
+                      "global_weight": "bin"}],
+    "num_rules": [{"key": "*", "type": "num"}],
+}
+
+
+def _nn_cfg(method, **param):
+    return {"converter": CONV, "method": method,
+            "parameter": {"hash_num": 64, **param}}
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+def test_lsh_signature_deterministic_and_similarity_ordering(rng):
+    k, h = 16, 128
+    base = rng.normal(size=512).astype(np.float32)
+    idx = jnp.asarray(rng.integers(1, 512, size=(3, k), dtype=np.int32))
+    # row 0 and row 1 share indices/values (identical); row 2 differs
+    idx = idx.at[1].set(idx[0])
+    val = jnp.asarray(rng.normal(size=(3, k)).astype(np.float32))
+    val = val.at[1].set(val[0])
+    sigs = knn.lsh_signature(idx, val, hash_num=h)
+    assert sigs.shape == (3, knn.packed_words(h))
+    d = knn.hamming_distances(sigs[0], sigs, hash_num=h)
+    assert d[0] == 0.0 and d[1] == 0.0
+    assert 0.0 < float(d[2]) <= 1.0
+
+
+def test_lsh_close_vectors_closer_than_random(rng):
+    h, k = 256, 32
+    idx = rng.integers(1, 4096, size=(3, k), dtype=np.int32)
+    idx[1] = idx[0]  # same support
+    v0 = rng.normal(size=k).astype(np.float32)
+    val = np.stack([v0, v0 + 0.01 * rng.normal(size=k).astype(np.float32),
+                    rng.normal(size=k).astype(np.float32)])
+    sigs = knn.lsh_signature(jnp.asarray(idx), jnp.asarray(val), hash_num=h)
+    d = knn.hamming_distances(sigs[0], sigs, hash_num=h)
+    assert float(d[1]) < float(d[2])
+
+
+def test_minhash_jaccard_estimate(rng):
+    h = 512
+    # sets: A={1..20}, B={1..10, 101..110} -> weighted jaccard = 10/30
+    a = [(i, 1.0) for i in range(1, 21)]
+    b = [(i, 1.0) for i in range(1, 11)] + [(i, 1.0) for i in range(101, 111)]
+    sb = SparseBatch.from_vectors([a, b])
+    sigs = knn.minhash_signature(jnp.asarray(sb.idx), jnp.asarray(sb.val),
+                                 hash_num=h)
+    d = knn.minhash_distances(sigs[0], sigs)
+    assert d[0] == 0.0
+    assert float(d[1]) == pytest.approx(1 - 10 / 30, abs=0.08)
+
+
+def test_euclid_lsh_distance_estimate(rng):
+    h = 512
+    x = rng.normal(size=64).astype(np.float32)
+    y = x + rng.normal(size=64).astype(np.float32) * 0.5
+    ids = np.arange(1, 65, dtype=np.int32)
+    sb = SparseBatch.from_vectors(
+        [[(int(i), float(v)) for i, v in zip(ids, x)],
+         [(int(i), float(v)) for i, v in zip(ids, y)]])
+    p = knn.euclid_projection(jnp.asarray(sb.idx), jnp.asarray(sb.val), hash_num=h)
+    d = knn.euclid_lsh_distances(p[0], p, hash_num=h)
+    true = float(np.linalg.norm(x - y))
+    assert float(d[0]) == pytest.approx(0.0, abs=1e-4)
+    assert float(d[1]) == pytest.approx(true, rel=0.25)
+
+
+def test_exact_cosine_and_euclid_kernels(rng):
+    dim = 1 << 10
+    rows = rng.normal(size=(5, 8)).astype(np.float32)
+    ids = rng.integers(1, dim, size=(5, 8)).astype(np.int32)
+    q = np.zeros(dim, np.float32)
+    qi = ids[0]
+    q[qi] = rows[0]
+    d_cos = knn.cosine_scores(jnp.asarray(ids), jnp.asarray(rows), jnp.asarray(q))
+    assert float(d_cos[0]) == pytest.approx(1.0, abs=1e-5)
+    d_euc = knn.euclid_distances(jnp.asarray(ids), jnp.asarray(rows), jnp.asarray(q))
+    assert float(d_euc[0]) == pytest.approx(0.0, abs=1e-3)
+
+
+def test_batched_distance_kernels_match_single(rng):
+    h = 64
+    sb = SparseBatch.from_vectors(
+        [[(int(i), float(v)) for i, v in
+          zip(rng.integers(1, 256, 12), rng.normal(size=12))] for _ in range(6)])
+    idx, val = jnp.asarray(sb.idx), jnp.asarray(sb.val)
+    sigs = knn.lsh_signature(idx, val, hash_num=h)
+    batch = knn.hamming_distances_batch(sigs, sigs, hash_num=h)
+    for i in range(6):
+        single = knn.hamming_distances(sigs[i], sigs, hash_num=h)
+        np.testing.assert_allclose(np.asarray(batch[i]), np.asarray(single))
+    proj = knn.euclid_projection(idx, val, hash_num=h)
+    pb = knn.euclid_lsh_distances_batch(proj, proj, hash_num=h)
+    for i in range(6):
+        single = knn.euclid_lsh_distances(proj[i], proj, hash_num=h)
+        # batch kernel uses the MXU-friendly ||q||^2 - 2q.r + ||r||^2
+        # expansion, which loses ~1e-3 absolute precision in f32
+        np.testing.assert_allclose(np.asarray(pb[i]), np.asarray(single),
+                                   rtol=1e-4, atol=5e-3)
+
+
+# ---------------------------------------------------------------------------
+# row store
+# ---------------------------------------------------------------------------
+def test_row_store_set_get_remove_grow():
+    rs = RowStore()
+    for i in range(200):  # force capacity growth past 64
+        rs.set_row(f"r{i}", [(i + 1, 1.0)])
+    assert len(rs) == 200
+    assert rs.get_row("r5") == [(6, 1.0)]
+    assert rs.remove_row("r5")
+    assert not rs.remove_row("r5")
+    assert "r5" not in rs
+    # width growth
+    rs.set_row("wide", [(i, 1.0) for i in range(1, 40)])
+    assert rs.width >= 40
+    assert len(rs.get_row("wide")) == 39
+
+
+def test_row_store_lru_eviction():
+    rs = RowStore(max_size=3)
+    for i in range(3):
+        rs.set_row(f"r{i}", [(i + 1, 1.0)])
+    rs.touch("r0")  # refresh r0; r1 is now LRU
+    rs.set_row("r3", [(10, 1.0)])
+    assert "r1" not in rs
+    assert "r0" in rs and "r2" in rs and "r3" in rs
+
+
+def test_row_store_pack_unpack():
+    rs = RowStore()
+    rs.set_row("a", [(3, 1.5), (7, -2.0)])
+    rs2 = RowStore()
+    rs2.unpack(rs.pack())
+    assert rs2.get_row("a") == [(3, 1.5), (7, -2.0)]
+
+
+# ---------------------------------------------------------------------------
+# nearest_neighbor engine
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("method", ["lsh", "minhash", "euclid_lsh"])
+def test_nn_engine_finds_identical_row(method):
+    d = NearestNeighborDriver(_nn_cfg(method), dim_bits=12)
+    d.set_row("x", Datum({"f1": 1.0, "f2": 2.0}))
+    d.set_row("y", Datum({"f1": -5.0, "f3": 9.0}))
+    d.set_row("z", Datum({"f4": 3.3}))
+    res = d.neighbor_row_from_datum(Datum({"f1": 1.0, "f2": 2.0}), 2)
+    assert res[0][0] == "x"
+    assert res[0][1] == pytest.approx(0.0, abs=1e-5)
+    res_id = d.neighbor_row_from_id("x", 3)
+    assert res_id[0][0] == "x"
+    assert len(res_id) == 3
+    sim = d.similar_row_from_id("x", 2)
+    assert sim[0][0] == "x"
+    assert sorted(d.get_all_rows()) == ["x", "y", "z"]
+
+
+def test_nn_engine_unlearner_caps_rows():
+    cfg = _nn_cfg("lsh", unlearner="lru",
+                  unlearner_parameter={"max_size": 4})
+    d = NearestNeighborDriver(cfg, dim_bits=12)
+    for i in range(10):
+        d.set_row(f"r{i}", Datum({"f": float(i)}))
+    assert len(d.get_all_rows()) == 4
+
+
+def test_nn_engine_mix_replicates_rows():
+    a = NearestNeighborDriver(_nn_cfg("lsh"), dim_bits=12)
+    b = NearestNeighborDriver(_nn_cfg("lsh"), dim_bits=12)
+    a.set_row("only_a", Datum({"f1": 1.0}))
+    b.set_row("only_b", Datum({"f2": 2.0}))
+    LocalMixGroup([a, b]).mix()
+    assert sorted(a.get_all_rows()) == ["only_a", "only_b"]
+    assert sorted(b.get_all_rows()) == ["only_a", "only_b"]
+
+
+def test_nn_engine_save_load():
+    d = NearestNeighborDriver(_nn_cfg("euclid_lsh"), dim_bits=12)
+    d.set_row("a", Datum({"f1": 1.0}))
+    d.set_row("b", Datum({"f1": 1.1}))
+    d2 = NearestNeighborDriver(_nn_cfg("euclid_lsh"), dim_bits=12)
+    d2.unpack(d.pack())
+    assert sorted(d2.get_all_rows()) == ["a", "b"]
+    assert d2.neighbor_row_from_id("a", 1)[0][0] == "a"
+
+
+# ---------------------------------------------------------------------------
+# recommender engine
+# ---------------------------------------------------------------------------
+def _rec_cfg(method, **param):
+    cfg = {"converter": CONV, "method": method}
+    if param or method not in ("inverted_index", "inverted_index_euclid"):
+        cfg["parameter"] = param
+    return cfg
+
+
+def test_recommender_inverted_index_similarity():
+    r = RecommenderDriver(_rec_cfg("inverted_index"), dim_bits=12)
+    r.update_row("u1", Datum({"item_a": 1.0, "item_b": 1.0}))
+    r.update_row("u2", Datum({"item_a": 1.0, "item_b": 1.0}))
+    r.update_row("u3", Datum({"item_z": 1.0}))
+    sims = r.similar_row_from_id("u1", 3)
+    assert sims[0][1] == pytest.approx(1.0, abs=1e-5)  # u1 or u2 (tied)
+    ids = [s[0] for s in sims[:2]]
+    assert set(ids) == {"u1", "u2"}
+    # orthogonal row scores ~0
+    assert dict(sims).get("u3", 0.0) == pytest.approx(0.0, abs=1e-5)
+    assert r.calc_similarity(Datum({"a": 1.0}), Datum({"a": 1.0})) == pytest.approx(1.0)
+    assert r.calc_l2norm(Datum({"a": 3.0, "b": 4.0})) == pytest.approx(5.0)
+
+
+def test_recommender_complete_and_decode_row():
+    r = RecommenderDriver(_rec_cfg("inverted_index"), dim_bits=12)
+    r.update_row("u1", Datum({"x": 2.0, "y": 4.0}))
+    r.update_row("u2", Datum({"x": 2.0, "z": 8.0}))
+    dec = r.decode_row("u1")
+    assert dict(dec.num_values) == {"x": 2.0, "y": 4.0}
+    comp = r.complete_row_from_datum(Datum({"x": 2.0}))
+    nv = dict(comp.num_values)
+    assert nv.get("x", 0) > 0
+    # y and z both get partially filled from the similar rows
+    assert "y" in nv and "z" in nv
+    # update_row merges keys into the existing row
+    r.update_row("u1", Datum({"y": 9.0}))
+    assert dict(r.decode_row("u1").num_values) == {"x": 2.0, "y": 9.0}
+
+
+def test_recommender_clear_row_and_get_all():
+    r = RecommenderDriver(_rec_cfg("lsh", hash_num=64), dim_bits=12)
+    r.update_row("a", Datum({"f": 1.0}))
+    r.update_row("b", Datum({"f": 2.0}))
+    assert r.clear_row("a")
+    assert r.get_all_rows() == ["b"]
+    r.clear()
+    assert r.get_all_rows() == []
+
+
+def test_recommender_nn_recommender_method():
+    cfg = {"converter": CONV, "method": "nearest_neighbor_recommender",
+           "parameter": {"method": "euclid_lsh",
+                         "parameter": {"hash_num": 128}}}
+    r = RecommenderDriver(cfg, dim_bits=12)
+    r.update_row("a", Datum({"f1": 1.0}))
+    r.update_row("b", Datum({"f1": 1.05}))
+    r.update_row("c", Datum({"f1": 30.0}))
+    sims = r.similar_row_from_id("a", 2)
+    assert [s[0] for s in sims] == ["a", "b"]
+
+
+def test_recommender_save_load_keeps_datums():
+    r = RecommenderDriver(_rec_cfg("inverted_index"), dim_bits=12)
+    r.update_row("a", Datum({"x": 1.0}))
+    r2 = RecommenderDriver(_rec_cfg("inverted_index"), dim_bits=12)
+    r2.unpack(r.pack())
+    assert dict(r2.decode_row("a").num_values) == {"x": 1.0}
+
+
+# ---------------------------------------------------------------------------
+# anomaly engine
+# ---------------------------------------------------------------------------
+ANOMALY_CFG = {
+    "converter": CONV,
+    "method": "lof",
+    "parameter": {"nearest_neighbor_num": 3,
+                  "reverse_nearest_neighbor_num": 9,
+                  "method": "euclid_lsh",
+                  "parameter": {"hash_num": 256}},
+}
+
+
+def test_anomaly_outlier_scores_higher(rng):
+    a = AnomalyDriver(ANOMALY_CFG, dim_bits=12)
+    for i in range(20):
+        a.add(Datum({"x": float(rng.normal()), "y": float(rng.normal())}))
+    inlier = a.calc_score(Datum({"x": 0.0, "y": 0.0}))
+    outlier = a.calc_score(Datum({"x": 40.0, "y": 40.0}))
+    assert outlier > inlier
+    assert outlier > 1.5
+
+
+def test_anomaly_add_update_overwrite_clear():
+    a = AnomalyDriver(ANOMALY_CFG, dim_bits=12)
+    rid, score = a.add(Datum({"x": 1.0}))
+    assert rid == "0"
+    rid2, _ = a.add(Datum({"x": 1.1}))
+    assert rid2 == "1"
+    s = a.update(rid, Datum({"x": 1.05}))
+    assert isinstance(s, float)
+    with pytest.raises(KeyError):
+        a.update("nope", Datum({"x": 0.0}))
+    a.overwrite("77", Datum({"x": 2.0}))  # overwrite may create
+    assert "77" in a.get_all_rows()
+    assert a.clear_row("77")
+    a.clear()
+    assert a.get_all_rows() == []
+
+
+def test_anomaly_save_load():
+    a = AnomalyDriver(ANOMALY_CFG, dim_bits=12)
+    for i in range(5):
+        a.add(Datum({"x": float(i)}))
+    a2 = AnomalyDriver(ANOMALY_CFG, dim_bits=12)
+    a2.unpack(a.pack())
+    assert sorted(a2.get_all_rows()) == sorted(a.get_all_rows())
+    # id generator resumes past loaded rows
+    rid, _ = a2.add(Datum({"x": 9.0}))
+    assert rid == "5"
