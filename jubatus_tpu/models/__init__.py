@@ -8,7 +8,10 @@ reference's wire protocol.
 
 from jubatus_tpu.models.anomaly import AnomalyDriver  # noqa: F401
 from jubatus_tpu.models.bandit import BanditDriver  # noqa: F401
+from jubatus_tpu.models.burst import BurstDriver  # noqa: F401
 from jubatus_tpu.models.classifier import ClassifierDriver  # noqa: F401
+from jubatus_tpu.models.clustering import ClusteringDriver  # noqa: F401
+from jubatus_tpu.models.graph import GraphDriver  # noqa: F401
 from jubatus_tpu.models.nearest_neighbor import NearestNeighborDriver  # noqa: F401
 from jubatus_tpu.models.recommender import RecommenderDriver  # noqa: F401
 from jubatus_tpu.models.regression import RegressionDriver  # noqa: F401

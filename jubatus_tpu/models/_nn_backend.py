@@ -38,9 +38,6 @@ HASH_METHODS = ("lsh", "minhash", "euclid_lsh")
 EXACT_METHODS = ("inverted_index", "euclid")
 METHODS = HASH_METHODS + EXACT_METHODS
 
-# methods where the natural score is a similarity (largest-first)
-_SIMILARITY_NATIVE = {"inverted_index"}
-
 
 class NNBackend:
     def __init__(self, method: str, *, dim: int, hash_num: int = 64,
@@ -85,11 +82,16 @@ class NNBackend:
 
     # -- signature maintenance -----------------------------------------------
     def _flush(self) -> None:
-        if self._sigs is None or not self._pending:
+        if self._sigs is None:
             return
+        # keep the signature table sized to the store even when nothing is
+        # pending — capacity can grow via set_row and then drain via removes
         if self._sigs.shape[0] != self.store.capacity:
             pad = self.store.capacity - self._sigs.shape[0]
             self._sigs = np.pad(self._sigs, ((0, pad), (0, 0)))
+            self._sig_dev = None
+        if not self._pending:
+            return
         items = [(rid, vec) for rid, vec in self._pending.items()
                  if rid in self.store.slots]
         self._pending.clear()

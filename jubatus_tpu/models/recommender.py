@@ -124,15 +124,20 @@ class RecommenderDriver(DriverBase):
         return self.backend.similar(self.converter.convert(row), size)
 
     def _complete(self, vec: SparseVector) -> Datum:
-        sims = self.backend.similar(vec, _COMPLETE_TOP_K)
+        # aggregation weights must be positive: cosine/hash similarities are
+        # used as-is (dropping anti-correlated rows), but the euclid family's
+        # similarity is a negated distance, so weight by 1/(1+d) instead
+        neighbors = self.backend.neighbors(vec, _COMPLETE_TOP_K)
+        euclid = self.backend.method in ("euclid_lsh", "euclid")
         acc: Dict[int, float] = {}
         total = 0.0
-        for rid, sim in sims:
-            if sim <= 0:
+        for rid, dist in neighbors:
+            w = 1.0 / (1.0 + dist) if euclid else 1.0 - dist
+            if w <= 0:
                 continue
-            total += sim
+            total += w
             for i, v in self.backend.store.get_row(rid) or []:
-                acc[i] = acc.get(i, 0.0) + sim * v
+                acc[i] = acc.get(i, 0.0) + w * v
         if total <= 0:
             return Datum()
         string_values: List[Tuple[str, str]] = []

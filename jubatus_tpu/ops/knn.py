@@ -27,8 +27,8 @@ TPU design (not a port):
 - euclid_lsh: Johnson-Lindenstrauss projection to hash_num floats with the
   same derived-gaussian trick; distance estimate = ||p_q - p_r|| / sqrt(H).
 
-All kernels return full [C]-sized score vectors; top-k extraction is
-jax.lax.top_k at the call site (drivers mask dead slots first).
+All kernels return full [C]-sized score vectors; the drivers extract top-k
+host-side after masking dead slots.
 """
 
 from __future__ import annotations
@@ -213,23 +213,3 @@ def euclid_lsh_distances_batch(q_projs, row_projs, *, hash_num: int):
     cross = q_projs @ row_projs.T
     return jnp.sqrt(jnp.maximum(qn - 2.0 * cross + rn, 0.0)) \
         / jnp.sqrt(float(hash_num))
-
-
-# ---------------------------------------------------------------------------
-# top-k
-# ---------------------------------------------------------------------------
-def top_k_ids(scores, live_mask, k: int, *, largest: bool):
-    """Top-k over live slots. scores [C] (similarity if largest else
-    distance), live_mask [C] bool → (values [k], slots [k]); dead slots are
-    pushed to the far end and report slot -1."""
-    s = jnp.asarray(scores)
-    if largest:
-        masked = jnp.where(live_mask, s, -jnp.inf)
-        vals, slots = jax.lax.top_k(masked, k)
-        ok = jnp.isfinite(vals)
-    else:
-        masked = jnp.where(live_mask, s, jnp.inf)
-        vals, slots = jax.lax.top_k(-masked, k)
-        vals = -vals
-        ok = jnp.isfinite(vals)
-    return jnp.where(ok, vals, 0.0), jnp.where(ok, slots, -1)

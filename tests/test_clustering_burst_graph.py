@@ -1,0 +1,343 @@
+"""Clustering, burst, and graph engine tests (API parity with
+clustering.idl / burst.idl / graph.idl; kernels checked on separable data)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.models import BurstDriver, ClusteringDriver, GraphDriver
+from jubatus_tpu.models.clustering import NotClusteredError
+from jubatus_tpu.ops import clustering as cops
+from jubatus_tpu.parallel import LocalMixGroup
+
+CONV = {
+    "string_rules": [{"key": "*", "type": "str", "sample_weight": "bin",
+                      "global_weight": "bin"}],
+    "num_rules": [{"key": "*", "type": "num"}],
+}
+
+
+# ---------------------------------------------------------------------------
+# clustering kernels
+# ---------------------------------------------------------------------------
+def _three_blobs(rng, n_per=30):
+    centers = np.array([[0.0, 0.0], [10.0, 0.0], [0.0, 10.0]], np.float32)
+    pts = np.concatenate([
+        c + rng.normal(scale=0.5, size=(n_per, 2)).astype(np.float32)
+        for c in centers
+    ])
+    return pts, centers
+
+
+def test_kmeans_recovers_blobs(rng):
+    x, true_centers = _three_blobs(rng)
+    w = np.ones(len(x), np.float32)
+    centers, assign = cops.kmeans_fit(jnp.asarray(x), jnp.asarray(w), k=3, seed=1)
+    centers = np.asarray(centers)
+    # every true center has a fitted center within 1.0
+    for tc in true_centers:
+        assert np.min(np.linalg.norm(centers - tc, axis=1)) < 1.0
+    # assignment is consistent within blobs
+    a = np.asarray(assign)
+    for b in range(3):
+        blob = a[b * 30:(b + 1) * 30]
+        assert (blob == np.bincount(blob).argmax()).mean() > 0.9
+
+
+def test_gmm_recovers_blobs(rng):
+    x, true_centers = _three_blobs(rng)
+    w = np.ones(len(x), np.float32)
+    state, assign = cops.gmm_fit(jnp.asarray(x), jnp.asarray(w), k=3, seed=0)
+    means = np.asarray(state.means)
+    for tc in true_centers:
+        assert np.min(np.linalg.norm(means - tc, axis=1)) < 1.0
+    assert np.asarray(state.pi).sum() == pytest.approx(1.0, abs=1e-5)
+
+
+def test_dbscan_labels_blobs_and_noise(rng):
+    x, _ = _three_blobs(rng)
+    x = np.vstack([x, np.array([[100.0, 100.0]], np.float32)])  # noise point
+    w = np.ones(len(x), np.float32)
+    labels = np.asarray(cops.dbscan_fit(jnp.asarray(x), jnp.asarray(w), 2.0,
+                                        min_core_point=3))
+    assert labels[-1] == -1  # isolated point = noise
+    # three distinct clusters among the blobs
+    blob_labels = {int(np.bincount(labels[i*30:(i+1)*30][labels[i*30:(i+1)*30] >= 0]).argmax())
+                   for i in range(3)}
+    assert len({l for l in labels[:90] if l >= 0}) >= 3 or len(blob_labels) == 3
+
+
+# ---------------------------------------------------------------------------
+# clustering engine
+# ---------------------------------------------------------------------------
+def _push_blobs(d, rng, n_per=20):
+    i = 0
+    pts = []
+    for cx, cy in [(0, 0), (30, 0), (0, 30)]:
+        for _ in range(n_per):
+            pts.append((f"p{i}", Datum({"x": cx + float(rng.normal()),
+                                        "y": cy + float(rng.normal())})))
+            i += 1
+    d.push(pts)
+
+
+def test_clustering_kmeans_engine(rng):
+    cfg = {"converter": CONV, "method": "kmeans",
+           "parameter": {"k": 3, "seed": 0},
+           "compressor_method": "simple",
+           "compressor_parameter": {"bucket_size": 60}}
+    d = ClusteringDriver(cfg, dim_bits=12)
+    with pytest.raises(NotClusteredError):
+        d.get_k_center()
+    assert d.get_revision() == 0
+    _push_blobs(d, rng)
+    assert d.get_revision() == 1
+    centers = d.get_k_center()
+    assert len(centers) == 3
+    near = d.get_nearest_center(Datum({"x": 30.0, "y": 0.0}))
+    nv = dict(near.num_values)
+    assert nv["x"] == pytest.approx(30.0, abs=2.0)
+    members = d.get_nearest_members_light(Datum({"x": 0.0, "y": 30.0}))
+    ids = {rid for _, rid in members}
+    assert ids & {f"p{i}" for i in range(40, 60)}
+    core = d.get_core_members()
+    assert sum(len(c) for c in core) == 60
+    d.clear()
+    assert d.get_revision() == 0
+
+
+def test_clustering_dbscan_engine(rng):
+    cfg = {"converter": CONV, "method": "dbscan",
+           "parameter": {"eps": 3.0, "min_core_point": 3},
+           "compressor_method": "simple",
+           "compressor_parameter": {"bucket_size": 60}}
+    d = ClusteringDriver(cfg, dim_bits=12)
+    _push_blobs(d, rng)
+    centers = d.get_k_center()
+    assert len(centers) >= 3
+
+
+def test_clustering_compressive_caps_points(rng):
+    cfg = {"converter": CONV, "method": "kmeans",
+           "parameter": {"k": 2, "seed": 0},
+           "compressor_method": "compressive",
+           "compressor_parameter": {"bucket_size": 20,
+                                    "compressed_bucket_size": 30}}
+    d = ClusteringDriver(cfg, dim_bits=12)
+    for batch in range(5):
+        d.push([(f"b{batch}_{i}", Datum({"x": float(rng.normal(batch * 5))}))
+                for i in range(20)])
+    st = d.get_status()
+    assert st["num_points"] <= 30
+    # total weight is conserved through downsampling
+    total_w = sum(w for mem in d.get_core_members_light() for w, _ in mem)
+    assert total_w == pytest.approx(100.0)
+
+
+def test_clustering_mix_replicates_points(rng):
+    cfg = {"converter": CONV, "method": "kmeans",
+           "parameter": {"k": 2, "seed": 0},
+           "compressor_method": "simple",
+           "compressor_parameter": {"bucket_size": 10}}
+    a = ClusteringDriver(cfg, dim_bits=12)
+    b = ClusteringDriver(cfg, dim_bits=12)
+    a.push([(f"a{i}", Datum({"x": float(i)})) for i in range(5)])
+    b.push([(f"b{i}", Datum({"x": float(100 + i)})) for i in range(5)])
+    LocalMixGroup([a, b]).mix()
+    assert a.get_status()["num_points"] == 10
+    assert b.get_status()["num_points"] == 10
+
+
+def test_clustering_save_load(rng):
+    cfg = {"converter": CONV, "method": "kmeans",
+           "parameter": {"k": 2, "seed": 0},
+           "compressor_method": "simple",
+           "compressor_parameter": {"bucket_size": 10}}
+    d = ClusteringDriver(cfg, dim_bits=12)
+    d.push([(f"p{i}", Datum({"x": float(i % 2 * 50)})) for i in range(10)])
+    d2 = ClusteringDriver(cfg, dim_bits=12)
+    d2.unpack(d.pack())
+    assert d2.get_revision() == d.get_revision()
+    assert len(d2.get_k_center()) == 2
+
+
+# ---------------------------------------------------------------------------
+# burst engine
+# ---------------------------------------------------------------------------
+BURST_CFG = {"parameter": {"window_batch_size": 5, "batch_interval": 10,
+                           "max_reuse_batch_num": 5, "costcut_threshold": -1,
+                           "result_window_rotate_size": 5}}
+
+
+def test_burst_detects_burst_window():
+    b = BurstDriver(BURST_CFG)
+    assert b.add_keyword("fire", scaling_param=2.0, gamma=1.0)
+    assert not b.add_keyword("fire", scaling_param=2.0, gamma=1.0)
+    # 5 batches of 20 docs; background keyword rate 10%, batch 3 bursts at 90%
+    docs = []
+    for batch in range(5):
+        for i in range(20):
+            relevant = (i < 18) if batch == 3 else (i < 2)
+            docs.append((batch * 10 + 0.5,
+                         "fire alarm" if relevant else "calm day"))
+    assert b.add_documents(docs) == 100
+    win = b.get_result("fire")
+    assert win["start_pos"] == 0.0
+    assert len(win["batches"]) == 5
+    assert win["batches"][3]["relevant_data_count"] == 18
+    assert win["batches"][3]["burst_weight"] > 0
+    assert win["batches"][0]["burst_weight"] == 0.0
+    allres = b.get_all_bursted_results()
+    assert "fire" in allres
+    kws = b.get_all_keywords()
+    assert kws[0]["keyword"] == "fire"
+
+
+def test_burst_result_at_and_remove():
+    b = BurstDriver(BURST_CFG)
+    b.add_keyword("x", 2.0, 1.0)
+    b.add_documents([(p, "x") for p in range(0, 100, 2)])
+    win = b.get_result_at("x", 45.0)
+    assert win["start_pos"] == 0.0
+    win2 = b.get_result_at("x", 95.0)
+    assert win2["start_pos"] == 50.0
+    assert b.remove_keyword("x")
+    with pytest.raises(KeyError):
+        b.get_result("x")
+    b.add_keyword("y", 2.0, 1.0)
+    b.remove_all_keywords()
+    assert b.get_all_keywords() == []
+
+
+def test_burst_mix_sums_counts():
+    a = BurstDriver(BURST_CFG)
+    b = BurstDriver(BURST_CFG)
+    for d in (a, b):
+        d.add_keyword("k", 2.0, 1.0)
+    a.add_documents([(5.0, "k here")] * 3)
+    b.add_documents([(5.0, "k there")] * 4 + [(5.0, "nothing")] * 2)
+    LocalMixGroup([a, b]).mix()
+    for d in (a, b):
+        win = d.get_result("k")
+        last = win["batches"][-1]
+        assert last["all_data_count"] == 9
+        assert last["relevant_data_count"] == 7
+    # second mix must not double-count
+    LocalMixGroup([a, b]).mix()
+    assert a.get_result("k")["batches"][-1]["all_data_count"] == 9
+
+
+def test_burst_save_load():
+    b = BurstDriver(BURST_CFG)
+    b.add_keyword("k", 2.0, 1.0)
+    b.add_documents([(5.0, "k")] * 5)
+    b2 = BurstDriver(BURST_CFG)
+    b2.unpack(b.pack())
+    assert b2.get_result("k")["batches"][-1]["relevant_data_count"] == 5
+
+
+# ---------------------------------------------------------------------------
+# graph engine
+# ---------------------------------------------------------------------------
+GRAPH_CFG = {"method": "graph_wo_index",
+             "parameter": {"damping_factor": 0.9, "landmark_num": 5}}
+EMPTY_Q = ([], [])
+
+
+def _diamond():
+    """a -> b -> d, a -> c -> d plus a hub z pointed at by everyone."""
+    g = GraphDriver(GRAPH_CFG)
+    ids = {}
+    for name in "abcdz":
+        ids[name] = g.create_node()
+        g.update_node(ids[name], {"name": name})
+    for s, t in [("a", "b"), ("b", "d"), ("a", "c"), ("c", "d"),
+                 ("a", "z"), ("b", "z"), ("c", "z"), ("d", "z")]:
+        g.create_edge(ids[s], ids[s], ids[t])
+    return g, ids
+
+
+def test_graph_crud_and_get_node_edge():
+    g, ids = _diamond()
+    node = g.get_node(ids["a"])
+    assert node["property"] == {"name": "a"}
+    assert len(node["out_edges"]) == 3
+    eid = node["out_edges"][0]
+    e = g.get_edge(ids["a"], eid)
+    assert e["source"] == ids["a"]
+    g.update_edge(ids["a"], eid, {"w": "2"})
+    assert g.get_edge(ids["a"], eid)["property"] == {"w": "2"}
+    assert g.remove_edge(ids["a"], eid)
+    assert len(g.get_node(ids["a"])["out_edges"]) == 2
+    assert g.remove_node(ids["b"])
+    with pytest.raises(KeyError):
+        g.get_node(ids["b"])
+    # edges touching b are gone
+    assert all(ids["b"] not in (e[0], e[1]) for e in
+               [(s, t) for (s, t, _) in g.edges.values()])
+
+
+def test_graph_pagerank_centrality():
+    g, ids = _diamond()
+    g.add_centrality_query(EMPTY_Q)
+    g.update_index()
+    z = g.get_centrality(ids["z"], 0, EMPTY_Q)
+    a = g.get_centrality(ids["a"], 0, EMPTY_Q)
+    assert z > a  # everyone points at z
+    with pytest.raises(ValueError):
+        g.get_centrality(ids["z"], 0, ([], [("name", "a")]))
+
+
+def test_graph_shortest_path_bounded():
+    g, ids = _diamond()
+    g.add_shortest_path_query(EMPTY_Q)
+    path = g.get_shortest_path(ids["a"], ids["d"], 10, EMPTY_Q)
+    assert path[0] == ids["a"] and path[-1] == ids["d"]
+    assert len(path) == 3
+    assert g.get_shortest_path(ids["d"], ids["a"], 10, EMPTY_Q) == []
+    assert g.get_shortest_path(ids["a"], ids["d"], 1, EMPTY_Q) == []
+
+
+def test_graph_preset_query_filters():
+    g = GraphDriver(GRAPH_CFG)
+    n1, n2, n3 = (g.create_node() for _ in range(3))
+    g.update_node(n1, {"kind": "x"})
+    g.update_node(n2, {"kind": "x"})
+    g.update_node(n3, {"kind": "y"})
+    g.create_edge(n1, n1, n2, {"rel": "f"})
+    g.create_edge(n2, n2, n3, {"rel": "f"})
+    q = ([], [("kind", "x")])
+    g.add_shortest_path_query(q)
+    # n3 filtered out -> no path to it
+    assert g.get_shortest_path(n1, n3, 5, q) == []
+    assert g.get_shortest_path(n1, n2, 5, q) == [n1, n2]
+
+
+def test_graph_internal_rpcs_and_mix():
+    a = GraphDriver(GRAPH_CFG)
+    b = GraphDriver(GRAPH_CFG)
+    assert a.create_node_here("100")
+    a.update_node("100", {"k": "v"})
+    nb = b.create_node()
+    b.update_node(nb, {"k2": "v2"})
+    LocalMixGroup([a, b]).mix()
+    assert "100" in a.nodes and "100" in b.nodes
+    assert b.nodes["100"] == {"k": "v"}
+    assert nb in a.nodes
+    # node created after mix gets an id that doesn't collide with "100"
+    fresh = a.create_node()
+    assert int(fresh) > 100
+
+
+def test_graph_save_load():
+    g, ids = _diamond()
+    g.add_centrality_query(EMPTY_Q)
+    g2 = GraphDriver(GRAPH_CFG)
+    g2.unpack(g.pack())
+    assert g2.get_node(ids["a"])["property"] == {"name": "a"}
+    assert len(g2.edges) == len(g.edges)
+    g2.update_index()
+    # same scores as the pre-save graph
+    assert g2.get_centrality(ids["z"], 0, EMPTY_Q) == pytest.approx(
+        g.get_centrality(ids["z"], 0, EMPTY_Q))
