@@ -1,0 +1,188 @@
+"""Engine service definitions (≙ jubatus/server/server/*.idl).
+
+The reference generates server bindings, proxy routing, and clients from
+msgpack-IDL files with three decorators per RPC — routing (#@random /
+#@broadcast / #@cht(n) / #@internal), lock (#@update / #@analysis / #@nolock),
+and aggregator (#@pass / #@all_and / #@all_or / #@merge / #@concat)
+(tools/jenerator/src/syntax.ml:41-66). Here the same information is a data
+table: one `Method` per RPC, transcribed from each engine's .idl (cited
+per-service below). The table drives:
+
+- `jubatus_tpu.server.service` — binding driver methods onto RpcServer,
+- `jubatus_tpu.server.proxy`  — routing + aggregation per method,
+- `jubatus_tpu.client`        — typed client stubs.
+
+`jubatus_tpu.codegen` can regenerate this module from the .idl files; the
+checked-in table keeps the framework free of a build-time codegen step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+RANDOM, BROADCAST, CHT, INTERNAL = "random", "broadcast", "cht", "internal"
+
+
+@dataclass(frozen=True)
+class Method:
+    name: str
+    #: wire argument names AFTER the leading cluster-name string every
+    #: jubatus call carries (client.hpp:30-87)
+    args: Tuple[str, ...]
+    routing: str = RANDOM
+    #: CHT successor count for routing == "cht" (#@cht defaults to 2,
+    #: recommender_proxy.cpp:21-45; #@cht(1) where the idl says so)
+    cht_n: int = 2
+    #: update → model write lock; analysis → read; nolock (server decides)
+    lock: str = "nolock"
+    #: broadcast/cht reducer (framework/aggregators.hpp)
+    aggregator: str = "pass"
+
+
+def _m(name, args=(), routing=RANDOM, cht_n=2, lock="nolock", agg="pass"):
+    return Method(name, tuple(args), routing, cht_n, lock, agg)
+
+
+#: engine name → RPC surface. Source: the .idl file named per key.
+SERVICES: Dict[str, Tuple[Method, ...]] = {
+    # classifier.idl:40-81
+    "classifier": (
+        _m("train", ("data",), RANDOM, lock="update"),
+        _m("classify", ("data",), RANDOM, lock="analysis"),
+        _m("get_labels", (), RANDOM, lock="analysis"),
+        _m("set_label", ("new_label",), BROADCAST, lock="update", agg="all_and"),
+        _m("clear", (), BROADCAST, lock="update", agg="all_and"),
+        _m("delete_label", ("target_label",), BROADCAST, lock="update", agg="all_or"),
+    ),
+    # regression.idl
+    "regression": (
+        _m("train", ("train_data",), RANDOM, lock="update"),
+        _m("estimate", ("estimate_data",), RANDOM, lock="analysis"),
+        _m("clear", (), BROADCAST, lock="update", agg="all_and"),
+    ),
+    # recommender.idl
+    "recommender": (
+        _m("clear_row", ("id",), CHT, 2, "update", "all_and"),
+        _m("update_row", ("id", "row"), CHT, 2, "update", "all_and"),
+        _m("clear", (), BROADCAST, lock="update", agg="all_and"),
+        _m("complete_row_from_id", ("id",), CHT, 2, "analysis"),
+        _m("complete_row_from_datum", ("row",), RANDOM, lock="analysis"),
+        _m("similar_row_from_id", ("id", "size"), CHT, 2, "analysis"),
+        _m("similar_row_from_datum", ("row", "size"), RANDOM, lock="analysis"),
+        _m("decode_row", ("id",), CHT, 2, "analysis"),
+        _m("get_all_rows", (), RANDOM, lock="analysis"),
+        _m("calc_similarity", ("lhs", "rhs"), RANDOM, lock="analysis"),
+        _m("calc_l2norm", ("row",), RANDOM, lock="analysis"),
+    ),
+    # nearest_neighbor.idl
+    "nearest_neighbor": (
+        _m("clear", (), BROADCAST, lock="update", agg="all_and"),
+        _m("set_row", ("id", "d"), CHT, 1, "update"),
+        _m("neighbor_row_from_id", ("id", "size"), RANDOM),
+        _m("neighbor_row_from_datum", ("query", "size"), RANDOM),
+        _m("similar_row_from_id", ("id", "ret_num"), RANDOM),
+        _m("similar_row_from_datum", ("query", "ret_num"), RANDOM),
+        _m("get_all_rows", (), RANDOM),
+    ),
+    # anomaly.idl
+    "anomaly": (
+        _m("clear_row", ("id",), CHT, 2, "update", "all_and"),
+        _m("add", ("row",), RANDOM),
+        _m("update", ("id", "row"), CHT, 2, "update"),
+        _m("overwrite", ("id", "row"), CHT, 2, "update"),
+        _m("clear", (), BROADCAST, lock="update", agg="all_and"),
+        _m("calc_score", ("row",), RANDOM, lock="analysis"),
+        _m("get_all_rows", (), RANDOM, lock="analysis"),
+    ),
+    # graph.idl
+    "graph": (
+        _m("create_node", (), RANDOM),
+        _m("remove_node", ("node_id",), CHT, 2),
+        _m("update_node", ("node_id", "property"), CHT, 2, "update", "all_and"),
+        _m("create_edge", ("node_id", "e"), CHT, 1),
+        _m("update_edge", ("node_id", "edge_id", "e"), CHT, 2, "update", "all_and"),
+        _m("remove_edge", ("node_id", "edge_id"), CHT, 2, "update", "all_and"),
+        _m("get_centrality", ("node_id", "centrality_type", "query"), RANDOM, lock="analysis"),
+        _m("add_centrality_query", ("query",), BROADCAST, lock="update", agg="all_and"),
+        _m("add_shortest_path_query", ("query",), BROADCAST, lock="update", agg="all_and"),
+        _m("remove_centrality_query", ("query",), BROADCAST, lock="update", agg="all_and"),
+        _m("remove_shortest_path_query", ("query",), BROADCAST, lock="update", agg="all_and"),
+        _m("get_shortest_path", ("query",), RANDOM, lock="analysis"),
+        _m("update_index", (), BROADCAST, lock="update", agg="all_and"),
+        _m("clear", (), BROADCAST, lock="update", agg="all_and"),
+        _m("get_node", ("node_id",), CHT, 2, "analysis"),
+        _m("get_edge", ("node_id", "edge_id"), CHT, 2, "analysis"),
+        _m("create_node_here", ("node_id",), INTERNAL, lock="update"),
+        _m("remove_global_node", ("node_id",), INTERNAL, lock="update"),
+        _m("create_edge_here", ("edge_id", "e"), INTERNAL, lock="update"),
+    ),
+    # burst.idl
+    "burst": (
+        _m("add_documents", ("data",), BROADCAST, lock="update", agg="add"),
+        _m("get_result", ("keyword",), CHT, 2, "analysis"),
+        _m("get_result_at", ("keyword", "pos"), CHT, 2, "analysis"),
+        _m("get_all_bursted_results", (), BROADCAST, lock="analysis", agg="merge"),
+        _m("get_all_bursted_results_at", ("pos",), BROADCAST, lock="analysis", agg="merge"),
+        _m("get_all_keywords", (), RANDOM, lock="analysis"),
+        _m("add_keyword", ("keyword",), BROADCAST, lock="update", agg="all_and"),
+        _m("remove_keyword", ("keyword",), BROADCAST, lock="update", agg="all_and"),
+        _m("remove_all_keywords", (), BROADCAST, lock="update", agg="all_and"),
+        _m("clear", (), BROADCAST, lock="update", agg="all_and"),
+    ),
+    # clustering.idl
+    "clustering": (
+        _m("push", ("points",), RANDOM, lock="update"),
+        _m("get_revision", (), RANDOM, lock="analysis"),
+        _m("get_core_members", (), RANDOM, lock="analysis"),
+        _m("get_core_members_light", (), RANDOM, lock="analysis"),
+        _m("get_k_center", (), RANDOM, lock="analysis"),
+        _m("get_nearest_center", ("point",), RANDOM, lock="analysis"),
+        _m("get_nearest_members", ("point",), RANDOM, lock="analysis"),
+        _m("get_nearest_members_light", ("point",), RANDOM, lock="analysis"),
+        _m("clear", (), BROADCAST, lock="update", agg="all_and"),
+    ),
+    # stat.idl
+    "stat": (
+        _m("push", ("key", "value"), CHT, 1, "update", "all_and"),
+        _m("sum", ("key",), CHT, 1, "analysis"),
+        _m("stddev", ("key",), CHT, 1, "analysis"),
+        _m("max", ("key",), CHT, 1, "analysis"),
+        _m("min", ("key",), CHT, 1, "analysis"),
+        _m("entropy", ("key",), CHT, 1, "analysis"),
+        _m("moment", ("key", "degree", "center"), CHT, 1, "analysis"),
+        _m("clear", (), BROADCAST, lock="update", agg="all_and"),
+    ),
+    # bandit.idl
+    "bandit": (
+        _m("register_arm", ("arm_id",), BROADCAST, lock="update", agg="all_and"),
+        _m("delete_arm", ("arm_id",), BROADCAST, lock="update", agg="all_and"),
+        _m("select_arm", ("player_id",), CHT, 1, "update"),
+        _m("register_reward", ("player_id", "arm_id", "reward"), CHT, 1, "update", "all_and"),
+        _m("get_arm_info", ("player_id",), CHT, 1, "analysis"),
+        _m("reset", ("player_id",), BROADCAST, lock="update", agg="all_or"),
+        _m("clear", (), BROADCAST, lock="update", agg="all_and"),
+    ),
+    # weight.idl
+    "weight": (
+        _m("update", ("d",), RANDOM),
+        _m("calc_weight", ("d",), RANDOM),
+        _m("clear", (), BROADCAST, agg="all_and"),
+    ),
+}
+
+#: engines whose proxies route by CHT (use_cht=true in *_impl.cpp)
+USES_CHT = frozenset(
+    e
+    for e, methods in SERVICES.items()
+    if any(m.routing == CHT for m in methods)
+)
+
+ENGINES: Tuple[str, ...] = tuple(sorted(SERVICES))
+
+
+def get_service(engine: str) -> Tuple[Method, ...]:
+    try:
+        return SERVICES[engine]
+    except KeyError:
+        raise KeyError(f"unknown engine {engine!r}; known: {', '.join(ENGINES)}")

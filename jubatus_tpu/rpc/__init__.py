@@ -1,0 +1,33 @@
+"""MessagePack-RPC transport (≙ jubatus/server/common/mprpc/, SURVEY.md §2.2).
+
+Wire-compatible with the reference's msgpack-rpc dialect so existing jubatus
+clients work unchanged:
+
+- request  = [0, msgid, method, params]
+- response = [1, msgid, error, result]
+- notify   = [2, method, params]
+
+Two planes (SURVEY.md §2.2 "TPU equivalent"):
+
+- the *client/ingest plane* is this module: ``RpcServer`` (threaded dispatcher
+  with typed invokers) and ``RpcClient`` / ``RpcMClient`` (parallel fan-out +
+  reducer fold, the reference's rpc_mclient.hpp:261-312);
+- the *mix plane* does NOT use RPC fan-out on a pod: it is an XLA collective
+  (``jubatus_tpu.parallel.mix``). ``RpcMClient`` remains for multi-host
+  control traffic and the degraded/elastic gossip path.
+"""
+
+from jubatus_tpu.rpc.errors import (  # noqa: F401
+    RpcError,
+    RpcMethodNotFound,
+    RpcTypeError,
+    RpcCallError,
+    RpcIoError,
+    RpcTimeoutError,
+    RpcNoResult,
+    RpcNoClient,
+    HostError,
+    MultiRpcError,
+)
+from jubatus_tpu.rpc.server import RpcServer  # noqa: F401
+from jubatus_tpu.rpc.client import RpcClient, RpcMClient  # noqa: F401

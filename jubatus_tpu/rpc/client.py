@@ -1,0 +1,166 @@
+"""MessagePack-RPC clients (≙ mprpc/rpc_mclient.{hpp,cpp} + client plumbing).
+
+``RpcClient`` — one-host sync client with reconnect, msgid correlation, and
+timeout (the reference's per-call msgpack-rpc session).
+
+``RpcMClient`` — parallel fan-out: fire the same call at N hosts, then either
+fold the results pairwise through a reducer (rpc_mclient.hpp:261-312 — this
+fold IS the allreduce combiner the mix plane replaces with psum) or collect
+per-host results+errors (rpc_result_object, rpc_mclient.hpp:314-318).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import msgpack
+
+from jubatus_tpu.rpc.errors import (
+    HostError,
+    MultiRpcError,
+    RpcIoError,
+    RpcNoClient,
+    RpcNoResult,
+    RpcTimeoutError,
+    wire_to_error,
+)
+from jubatus_tpu.rpc.server import REQUEST, RESPONSE, _to_wire
+
+
+class RpcClient:
+    def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._msgid = 0
+        self._lock = threading.Lock()
+
+    # -- connection ----------------------------------------------------------
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            try:
+                s = socket.create_connection((self.host, self.port), timeout=self.timeout)
+            except OSError as e:
+                raise RpcIoError(f"connect {self.host}:{self.port}: {e}") from e
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- calls ---------------------------------------------------------------
+    def call(self, method: str, *args: Any) -> Any:
+        with self._lock:
+            self._msgid = (self._msgid + 1) & 0xFFFFFFFF
+            msgid = self._msgid
+            payload = msgpack.packb(
+                [REQUEST, msgid, method, list(args)], default=_to_wire
+            )
+            sock = self._connect()
+            try:
+                sock.sendall(payload)
+                msg = self._read_response(sock, msgid)
+            except socket.timeout as e:
+                self.close()
+                raise RpcTimeoutError(f"{method} @ {self.host}:{self.port}") from e
+            except OSError as e:
+                self.close()
+                raise RpcIoError(f"{method} @ {self.host}:{self.port}: {e}") from e
+        _, _, error, result = msg
+        if error is not None:
+            raise wire_to_error(error, method)
+        return result
+
+    def notify(self, method: str, *args: Any) -> None:
+        payload = msgpack.packb([2, method, list(args)], default=_to_wire)
+        with self._lock:
+            sock = self._connect()
+            try:
+                sock.sendall(payload)
+            except OSError as e:
+                self.close()
+                raise RpcIoError(str(e)) from e
+
+    def _read_response(self, sock: socket.socket, msgid: int) -> Any:
+        unpacker = msgpack.Unpacker(raw=False, strict_map_key=False)
+        while True:
+            data = sock.recv(65536)
+            if not data:
+                self.close()
+                raise RpcIoError(f"connection closed by {self.host}:{self.port}")
+            unpacker.feed(data)
+            for msg in unpacker:
+                if (
+                    isinstance(msg, (list, tuple))
+                    and len(msg) == 4
+                    and msg[0] == RESPONSE
+                    and msg[1] == msgid
+                ):
+                    return msg
+                # stale response from a timed-out earlier call: drop it
+
+
+class RpcMClient:
+    """Parallel fan-out with reducer fold (≙ rpc_mclient)."""
+
+    def __init__(
+        self, hosts: Sequence[Tuple[str, int]], timeout: float = 10.0
+    ) -> None:
+        if not hosts:
+            raise RpcNoClient("empty host list")
+        self.hosts = list(hosts)
+        self.timeout = timeout
+
+    def _fan_out(self, method: str, args: Sequence[Any]):
+        results: List[Tuple[Tuple[str, int], Any]] = []
+        errors: List[HostError] = []
+
+        def one(hp: Tuple[str, int]):
+            with RpcClient(hp[0], hp[1], self.timeout) as c:
+                return c.call(method, *args)
+
+        with ThreadPoolExecutor(max_workers=min(len(self.hosts), 64)) as pool:
+            futs = {pool.submit(one, hp): hp for hp in self.hosts}
+            for fut, hp in futs.items():
+                try:
+                    results.append((hp, fut.result()))
+                except Exception as e:  # noqa: BLE001 — per-host failure is data
+                    errors.append(HostError(hp[0], hp[1], e))
+        return results, errors
+
+    def call_fold(
+        self,
+        method: str,
+        *args: Any,
+        reducer: Callable[[Any, Any], Any],
+    ) -> Any:
+        """Fold all successful results pairwise left-to-right
+        (rpc_mclient::join_ — '(4+(3+(2+1)))' order per linear_mixer_test)."""
+        results, errors = self._fan_out(method, args)
+        if not results:
+            raise MultiRpcError(errors) if errors else RpcNoResult(method)
+        acc = results[0][1]
+        for _, r in results[1:]:
+            acc = reducer(acc, r)
+        return acc
+
+    def call_collect(self, method: str, *args: Any):
+        """Raw per-host results + errors (≙ rpc_result_object)."""
+        return self._fan_out(method, args)

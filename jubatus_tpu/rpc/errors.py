@@ -1,0 +1,87 @@
+"""RPC error taxonomy (≙ mprpc/rpc_error.hpp + mprpc/exception.hpp).
+
+The reference maps msgpack-rpc failures to typed exceptions
+(rpc_mclient.hpp:36-93 JUBATUS_MSGPACKRPC_EXCEPTION_DEFAULT_HANDLER); we keep
+the same taxonomy so server/proxy code can branch on failure class, and the
+same on-wire integer codes as the msgpack-rpc C++ implementation for
+method-not-found (1) and argument errors (2) so reference clients see the
+errors they expect.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+#: on-wire error codes (msgpack-rpc convention, used by the reference servers)
+NO_METHOD_ERROR = 1
+ARGUMENT_ERROR = 2
+
+
+class RpcError(RuntimeError):
+    """Base of all RPC failures (≙ mprpc/exception.hpp rpc_error)."""
+
+
+class RpcMethodNotFound(RpcError):
+    def __init__(self, method: str = "") -> None:
+        super().__init__(f"method not found: {method}")
+        self.method = method
+
+
+class RpcTypeError(RpcError):
+    """Argument arity/type mismatch (≙ rpc_type_error)."""
+
+
+class RpcCallError(RpcError):
+    """Server raised while executing the method (≙ rpc_call_error)."""
+
+
+class RpcIoError(RpcError):
+    """Connection failed / reset mid-call (≙ rpc_io_error)."""
+
+
+class RpcTimeoutError(RpcError):
+    """Call exceeded the client timeout (≙ rpc_timeout_error)."""
+
+
+class RpcNoResult(RpcError):
+    """Fan-out completed but produced no usable result (≙ rpc_no_result)."""
+
+
+class RpcNoClient(RpcError):
+    """No host reachable for a fan-out call (≙ rpc_no_client)."""
+
+
+class HostError(RpcError):
+    """One host's failure inside a fan-out (≙ rpc_error{host, port, exc})."""
+
+    def __init__(self, host: str, port: int, cause: BaseException) -> None:
+        super().__init__(f"{host}:{port}: {cause}")
+        self.host = host
+        self.port = port
+        self.cause = cause
+
+
+class MultiRpcError(RpcError):
+    """Aggregate of per-host failures (≙ error_multi_rpc)."""
+
+    def __init__(self, errors: List[HostError]) -> None:
+        super().__init__("; ".join(str(e) for e in errors) or "all hosts failed")
+        self.errors = errors
+
+
+def error_to_wire(exc: BaseException) -> Any:
+    """Server-side: map an exception to the response 'error' field."""
+    if isinstance(exc, RpcMethodNotFound):
+        return NO_METHOD_ERROR
+    if isinstance(exc, (RpcTypeError, TypeError)):
+        return ARGUMENT_ERROR
+    return str(exc)
+
+
+def wire_to_error(err: Any, method: str = "") -> RpcError:
+    """Client-side: map the response 'error' field to a typed exception."""
+    if err == NO_METHOD_ERROR:
+        return RpcMethodNotFound(method)
+    if err == ARGUMENT_ERROR:
+        return RpcTypeError(f"argument error calling {method}")
+    return RpcCallError(f"{method}: {err!r}")

@@ -1,0 +1,17 @@
+"""Engine servers (≙ jubatus/server/server/ + framework/, SURVEY.md §2.3-2.4).
+
+`EngineServer` is the reference's server_base + server_helper collapsed: it
+owns a driver, a mixer, and the RPC binding, and serves the engine's IDL
+surface plus the built-ins (get_config/save/load/get_status/do_mix) over
+MessagePack-RPC, wire-compatible with jubatus clients.
+
+Boot path (≙ run_server<Impl,Serv>, server_util.hpp:139-176):
+
+    python -m jubatus_tpu.server classifier -f config.json -p 9199
+    python -m jubatus_tpu.server classifier -f config.json --name c1 \
+        --coordinator /tmp/cluster   # distributed: join + background mix
+"""
+
+from jubatus_tpu.server.factory import create_driver, DRIVER_CLASSES  # noqa: F401
+from jubatus_tpu.server.base import EngineServer  # noqa: F401
+from jubatus_tpu.server.args import ServerArgs, parse_server_args  # noqa: F401

@@ -1,0 +1,53 @@
+"""Engine → driver factory (≙ per-engine factories, e.g.
+classifier_factory::create_classifier at classifier_serv.cpp:108-109).
+
+Config is the reference's JSON config verbatim (config/<engine>/*.json):
+{"method": ..., "converter": {...}, "parameter": {...}} for model engines,
+engine-specific top-level keys for the rest.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Type
+
+from jubatus_tpu.models import (
+    AnomalyDriver,
+    BanditDriver,
+    BurstDriver,
+    ClassifierDriver,
+    ClusteringDriver,
+    GraphDriver,
+    NearestNeighborDriver,
+    RecommenderDriver,
+    RegressionDriver,
+    StatDriver,
+    WeightDriver,
+)
+
+DRIVER_CLASSES: Dict[str, Type] = {
+    "anomaly": AnomalyDriver,
+    "bandit": BanditDriver,
+    "burst": BurstDriver,
+    "classifier": ClassifierDriver,
+    "clustering": ClusteringDriver,
+    "graph": GraphDriver,
+    "nearest_neighbor": NearestNeighborDriver,
+    "recommender": RecommenderDriver,
+    "regression": RegressionDriver,
+    "stat": StatDriver,
+    "weight": WeightDriver,
+}
+
+
+def create_driver(engine: str, config: Any):
+    """Instantiate the engine's driver from a JSON config (str or dict)."""
+    if isinstance(config, str):
+        config = json.loads(config)
+    try:
+        cls = DRIVER_CLASSES[engine]
+    except KeyError:
+        raise KeyError(
+            f"unknown engine {engine!r}; known: {', '.join(sorted(DRIVER_CLASSES))}"
+        )
+    return cls(config)

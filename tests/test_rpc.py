@@ -1,0 +1,118 @@
+"""Loopback RPC tests (≙ mprpc/rpc_client_test.cpp, SURVEY.md §4 tier 3).
+
+Real server on an ephemeral port; typed calls, arity errors, method-not-found,
+fan-out with reducers, per-host error collection.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from jubatus_tpu.rpc import (
+    RpcCallError,
+    RpcClient,
+    RpcIoError,
+    RpcMClient,
+    RpcMethodNotFound,
+    RpcServer,
+    RpcTypeError,
+)
+from jubatus_tpu.rpc import aggregators
+
+
+@pytest.fixture()
+def server():
+    srv = RpcServer()
+    srv.register("echo", lambda x: x)
+    srv.register("add2", lambda a, b: a + b)
+    srv.register("boom", lambda: (_ for _ in ()).throw(ValueError("kaboom")))
+    srv.register("dict_of", lambda k, v: {k: v})
+    port = srv.serve_background(0, nthreads=4, host="127.0.0.1")
+    yield ("127.0.0.1", port), srv
+    srv.stop()
+
+
+def test_typed_calls(server):
+    (host, port), _ = server
+    with RpcClient(host, port) as c:
+        assert c.call("echo", "hello") == "hello"
+        assert c.call("add2", 2, 3) == 5
+        assert c.call("dict_of", "k", [1, 2]) == {"k": [1, 2]}
+
+
+def test_pipelined_calls_one_connection(server):
+    (host, port), _ = server
+    with RpcClient(host, port) as c:
+        for i in range(50):
+            assert c.call("add2", i, i) == 2 * i
+
+
+def test_method_not_found(server):
+    (host, port), _ = server
+    with RpcClient(host, port) as c:
+        with pytest.raises(RpcMethodNotFound):
+            c.call("nope")
+
+
+def test_arity_error(server):
+    (host, port), _ = server
+    with RpcClient(host, port) as c:
+        with pytest.raises(RpcTypeError):
+            c.call("add2", 1)
+
+
+def test_call_error(server):
+    (host, port), _ = server
+    with RpcClient(host, port) as c:
+        with pytest.raises(RpcCallError, match="kaboom"):
+            c.call("boom")
+
+
+def test_connect_refused():
+    c = RpcClient("127.0.0.1", 1)  # nothing listens on port 1
+    with pytest.raises(RpcIoError):
+        c.call("echo", 1)
+
+
+def _spawn(value):
+    srv = RpcServer()
+    srv.register("value", lambda: value)
+    srv.register("concat_val", lambda: [value])
+    port = srv.serve_background(0, host="127.0.0.1")
+    return srv, ("127.0.0.1", port)
+
+
+def test_mclient_fold_order():
+    """Fold order matches the reference: (((1+2)+3)+4) left fold over the
+    host list (linear_mixer_test.cpp '(4+(3+(2+1)))' is the same associativity
+    seen from the other end)."""
+    servers = [_spawn(v) for v in (1, 2, 3, 4)]
+    try:
+        mc = RpcMClient([hp for _, hp in servers])
+        assert mc.call_fold("value", reducer=aggregators.add) == 10
+        got = mc.call_fold("concat_val", reducer=aggregators.concat)
+        assert sorted(got) == [1, 2, 3, 4]
+    finally:
+        for srv, _ in servers:
+            srv.stop()
+
+
+def test_mclient_partial_failure():
+    srv, hp = _spawn(7)
+    try:
+        mc = RpcMClient([hp, ("127.0.0.1", 1)], timeout=2.0)
+        # fold skips failed hosts (linear_mixer.cpp:470-504 semantics)
+        assert mc.call_fold("value", reducer=aggregators.add) == 7
+        results, errors = mc.call_collect("value")
+        assert [r for _, r in results] == [7]
+        assert len(errors) == 1 and errors[0].port == 1
+    finally:
+        srv.stop()
+
+
+def test_aggregators():
+    assert aggregators.merge({"a": 1}, {"b": 2}) == {"a": 1, "b": 2}
+    assert aggregators.concat([1], [2]) == [1, 2]
+    assert aggregators.pass_("x", "y") == "x"
+    assert aggregators.all_and(True, False) is False
+    assert aggregators.all_or(True, False) is True
