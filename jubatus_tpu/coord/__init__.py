@@ -1,0 +1,54 @@
+"""Coordination / cluster membership (≙ jubatus/server/common/, SURVEY.md §2.1).
+
+The reference coordinates replicas through ZooKeeper behind a `lock_service`
+ABC (common/lock_service.hpp:33-118). We keep the same seam — a small
+`Coordinator` interface for ephemeral membership, config storage, locks, and
+id minting — with two built-in backends:
+
+- `MemoryCoordinator` — in-process, for tests and single-process clusters
+  (the mock the reference admits it never wrote, common/zk.hpp:36);
+- `FileCoordinator` — a shared directory for multi-process single-host (and
+  NFS-backed multi-host) clusters: ephemeral nodes are lease files refreshed
+  by a heartbeat thread, locks are O_EXCL lease files, ids are a counter file
+  under flock.
+
+On a TPU pod the *data plane* needs no coordinator at all — the mesh is
+static and mix is a collective (parallel/mix.py). The coordinator carries the
+*control plane*: membership for proxies and the RPC mixer, config
+distribution (jubaconfig), and actor registration (jubactl/jubavisor).
+A ZooKeeper backend can be slotted in behind the same interface unchanged.
+"""
+
+from jubatus_tpu.coord.base import (  # noqa: F401
+    Coordinator,
+    CoordinatorError,
+    NodeInfo,
+)
+from jubatus_tpu.coord.memory import MemoryCoordinator  # noqa: F401
+from jubatus_tpu.coord.file import FileCoordinator  # noqa: F401
+from jubatus_tpu.coord.membership import (  # noqa: F401
+    ACTOR_BASE,
+    register_actor,
+    register_active,
+    unregister_active,
+    get_all_nodes,
+    get_all_actives,
+)
+from jubatus_tpu.coord.cht import CHT, make_hash  # noqa: F401
+from jubatus_tpu.coord.idgen import IdGenerator  # noqa: F401
+
+
+def create_coordinator(spec: str) -> Coordinator:
+    """Build a coordinator from a locator string (≙ create_lock_service).
+
+    "" → None-like in the reference means standalone; callers handle that.
+    "memory" / "memory://"        → process-local MemoryCoordinator
+    "/path" / "file:///path"      → FileCoordinator on that directory
+    """
+    if spec in ("memory", "memory://"):
+        return MemoryCoordinator.shared()
+    if spec.startswith("file://"):
+        return FileCoordinator(spec[len("file://") :])
+    if spec.startswith("/") or spec.startswith("."):
+        return FileCoordinator(spec)
+    raise CoordinatorError(f"unsupported coordinator spec {spec!r}")

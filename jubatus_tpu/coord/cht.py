@@ -1,0 +1,82 @@
+"""Consistent hashing (≙ common/cht.{hpp,cpp}).
+
+Same construction as the reference: an MD5 ring with 8 virtual nodes per
+server (cht.hpp:36, ring entries md5(f"{node}_{i}"), cht.cpp:77-93);
+`find(key, n)` returns the n distinct servers succeeding md5(key) clockwise
+(cht.cpp:107-143).
+
+Design difference: the reference materializes the ring in ZooKeeper (every
+node writes its vnode hashes under .../cht) so all parties agree; here the
+ring is a pure function of the member list — every observer of the same
+membership computes the identical ring, so nothing needs storing. On a TPU
+mesh the same idea degenerates further: keys → static shard index
+(`shard_for`), the mesh replacing the ring.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence, Tuple
+
+from jubatus_tpu.coord.base import Coordinator, NodeInfo
+from jubatus_tpu.coord import membership
+
+NUM_VSERV = 8  # common/cht.hpp:36
+
+
+def make_hash(key: str) -> str:
+    """Hex MD5 — the reference's ring coordinate (cht.cpp make_hash)."""
+    return hashlib.md5(key.encode("utf-8")).hexdigest()
+
+
+class CHT:
+    def __init__(self, members: Sequence[NodeInfo]) -> None:
+        self.members = list(members)
+        ring: List[Tuple[str, NodeInfo]] = []
+        for m in self.members:
+            for i in range(NUM_VSERV):
+                ring.append((make_hash(f"{m.name}_{i}"), m))
+        ring.sort(key=lambda e: e[0])
+        self._ring = ring
+
+    @classmethod
+    def from_coordinator(
+        cls, coord: Coordinator, engine: str, name: str, actives_only: bool = True
+    ) -> "CHT":
+        get = membership.get_all_actives if actives_only else membership.get_all_nodes
+        return cls(get(coord, engine, name))
+
+    def find(self, key: str, n: int = 2) -> List[NodeInfo]:
+        """n distinct successors of md5(key) on the ring (cht.cpp:107-143).
+        Fewer than n members → all members, primary first."""
+        if not self._ring:
+            return []
+        h = make_hash(key)
+        # first ring entry with hash > h, wrapping
+        lo, hi = 0, len(self._ring)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._ring[mid][0] <= h:
+                lo = mid + 1
+            else:
+                hi = mid
+        out: List[NodeInfo] = []
+        seen = set()
+        for i in range(len(self._ring)):
+            node = self._ring[(lo + i) % len(self._ring)][1]
+            if node.name not in seen:
+                seen.add(node.name)
+                out.append(node)
+                if len(out) == n:
+                    break
+        return out
+
+    def primary(self, key: str) -> Optional[NodeInfo]:
+        found = self.find(key, 1)
+        return found[0] if found else None
+
+
+def shard_for(key: str, n_shards: int) -> int:
+    """Static mesh placement: the TPU-plane replacement for the ring —
+    stable key → shard mapping over a fixed device mesh."""
+    return int(make_hash(key)[:8], 16) % max(1, n_shards)

@@ -1,0 +1,91 @@
+"""Cluster registry path schema (≙ common/membership.{hpp,cpp}).
+
+Same tree as the reference (membership.hpp:32-36, membership.cpp:59-66):
+
+    /jubatus/actors/<type>/<name>/nodes/<ip>_<port>     all booted servers
+    /jubatus/actors/<type>/<name>/actives/<ip>_<port>   mix-current servers
+    /jubatus/actors/<type>/<name>/master_lock           per-round mix master
+    /jubatus/actors/<type>/<name>/id_generator          cluster id counter
+    /jubatus/config/<type>/<name>                       engine JSON config
+    /jubatus/supervisors/<ip>_<port>                    jubavisor daemons
+    /jubatus/jubaproxies/<ip>_<port>                    proxies
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jubatus_tpu.coord.base import Coordinator, NodeInfo
+
+JUBATUS_BASE = "/jubatus"
+ACTOR_BASE = f"{JUBATUS_BASE}/actors"
+CONFIG_BASE = f"{JUBATUS_BASE}/config"
+SUPERVISOR_BASE = f"{JUBATUS_BASE}/supervisors"
+PROXY_BASE = f"{JUBATUS_BASE}/jubaproxies"
+
+
+def actor_path(engine: str, name: str) -> str:
+    return f"{ACTOR_BASE}/{engine}/{name}"
+
+
+def config_path(engine: str, name: str) -> str:
+    return f"{CONFIG_BASE}/{engine}/{name}"
+
+
+def register_actor(
+    coord: Coordinator, engine: str, name: str, host: str, port: int
+) -> str:
+    """Ephemeral registration under nodes/ (membership.cpp:68-112).
+    Returns the node path so the caller can arm a suicide watcher."""
+    path = f"{actor_path(engine, name)}/nodes/{NodeInfo(host, port).name}"
+    coord.create(path, ephemeral=True)
+    return path
+
+
+def register_active(
+    coord: Coordinator, engine: str, name: str, host: str, port: int
+) -> str:
+    """Join the actives list (membership.cpp:115-145) — proxies route only
+    to actives; the mixer drives transitions on put_diff success/failure."""
+    path = f"{actor_path(engine, name)}/actives/{NodeInfo(host, port).name}"
+    coord.create(path, ephemeral=True)
+    return path
+
+
+def unregister_active(
+    coord: Coordinator, engine: str, name: str, host: str, port: int
+) -> bool:
+    return coord.remove(
+        f"{actor_path(engine, name)}/actives/{NodeInfo(host, port).name}"
+    )
+
+
+def _nodes_under(coord: Coordinator, path: str) -> List[NodeInfo]:
+    out = []
+    for child in coord.list(path):
+        try:
+            out.append(NodeInfo.from_name(child))
+        except (ValueError, IndexError):
+            continue
+    return out
+
+
+def get_all_nodes(coord: Coordinator, engine: str, name: str) -> List[NodeInfo]:
+    """All booted members (membership get_all_nodes)."""
+    return _nodes_under(coord, f"{actor_path(engine, name)}/nodes")
+
+
+def get_all_actives(coord: Coordinator, engine: str, name: str) -> List[NodeInfo]:
+    return _nodes_under(coord, f"{actor_path(engine, name)}/actives")
+
+
+def register_proxy(coord: Coordinator, host: str, port: int) -> str:
+    path = f"{PROXY_BASE}/{NodeInfo(host, port).name}"
+    coord.create(path, ephemeral=True)
+    return path
+
+
+def register_supervisor(coord: Coordinator, host: str, port: int) -> str:
+    path = f"{SUPERVISOR_BASE}/{NodeInfo(host, port).name}"
+    coord.create(path, ephemeral=True)
+    return path

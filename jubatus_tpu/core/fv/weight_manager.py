@@ -71,7 +71,8 @@ class WeightManager:
 
     def put_diff(self, diff) -> bool:
         self._df_master += np.asarray(diff["df"])
-        self._ndocs_master += float(diff["ndocs"])
+        # wire round-trips can deliver the scalar as a shape-(1,) array
+        self._ndocs_master += float(np.asarray(diff["ndocs"]).reshape(()))
         self._df_diff[:] = 0.0
         self._ndocs_diff = 0.0
         return True

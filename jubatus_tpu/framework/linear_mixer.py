@@ -1,0 +1,391 @@
+"""Distributed linear mixer over RPC (≙ mixer/linear_mixer.{hpp,cpp}).
+
+The multi-host control-plane mix loop, for deployments that are N independent
+server processes rather than one SPMD pod program. (Within a pod, mix is the
+collective in parallel/mix.py — no master, no RPC. A multi-host TPU fleet
+composes the two: each host mixes its local replicas via collective, hosts
+mix with each other through this loop over DCN.)
+
+Round semantics mirror the reference exactly (linear_mixer.cpp:437-559):
+
+  1. elect a per-round master (coordinator master_lock try_lock, :386);
+  2. schema sync — engines whose diff arrays are row-keyed by a dynamic
+     vocabulary (classifier labels, stat keys) first agree on the sorted
+     union schema (fan-out get_schema → union → fan-out sync_schema), so
+     per-replica diff arrays are row-aligned before any fold. Engines with
+     no schema skip this (two cheap no-op fan-outs);
+  3. master fans out ``get_diff`` to every member — including itself, through
+     the same path, so all diffs are wire-canonical;
+  4. folds diffs pairwise per mixable (custom ``mix`` or elementwise add);
+  5. broadcasts ``put_diff``; each member applies it under its model lock;
+  6. put_diff success drives the actives list (:658-681): valid → register
+     active, obsolete → unregister + full-model recovery via ``get_model``
+     from a random peer (:598-632).
+
+The ``LinearCommunication`` seam makes rounds testable without sockets
+(reference linear_communication_stub, linear_mixer_test.cpp:65-112).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from jubatus_tpu.coord import membership
+from jubatus_tpu.coord.base import Coordinator, NodeInfo
+from jubatus_tpu.framework.mixer import IntervalMixer
+from jubatus_tpu.parallel.mix import tree_sum
+from jubatus_tpu.rpc.client import RpcClient, RpcMClient
+from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
+
+log = logging.getLogger(__name__)
+
+#: mixer protocol version — mismatch forces shutdown (linear_mixer.cpp:618-624)
+PROTOCOL_VERSION = 1
+
+
+class LinearCommunication:
+    """Communication seam (≙ linear_communication, linear_mixer.hpp:35-72)."""
+
+    def update_members(self) -> List[NodeInfo]:
+        raise NotImplementedError
+
+    def try_lock(self) -> bool:
+        raise NotImplementedError
+
+    def unlock(self) -> None:
+        raise NotImplementedError
+
+    def get_schemas(self) -> List[List[str]]:
+        """Fan out get_schema; per-host row vocabularies (default: none)."""
+        return []
+
+    def sync_schema(self, union: List[str]) -> None:
+        """Broadcast the union schema for pre-diff row alignment."""
+
+    def get_diff(self) -> List[Tuple[NodeInfo, bytes]]:
+        """Fan out get_diff; per-host packed diffs (failures skipped)."""
+        raise NotImplementedError
+
+    def put_diff(self, packed: bytes) -> Dict[str, bool]:
+        """Broadcast the reduced diff; host name → accepted."""
+        raise NotImplementedError
+
+    def get_model(self, member: NodeInfo) -> bytes:
+        raise NotImplementedError
+
+    def register_active(self, node: NodeInfo, active: bool) -> None:
+        pass
+
+
+class RpcLinearCommunication(LinearCommunication):
+    def __init__(
+        self,
+        coord: Coordinator,
+        engine: str,
+        name: str,
+        timeout: float = 10.0,
+    ) -> None:
+        self.coord = coord
+        self.engine = engine
+        self.name = name
+        self.timeout = timeout
+        self._members: List[NodeInfo] = []
+        self._mc: Optional[RpcMClient] = None  # persistent session pool
+
+    def update_members(self) -> List[NodeInfo]:
+        self._members = membership.get_all_nodes(self.coord, self.engine, self.name)
+        if self._members:
+            hosts = self._hosts()
+            if self._mc is None:
+                self._mc = RpcMClient(hosts, self.timeout)
+            else:
+                self._mc.set_hosts(hosts)
+        return self._members
+
+    def _lock_path(self) -> str:
+        return f"{membership.actor_path(self.engine, self.name)}/master_lock"
+
+    def try_lock(self) -> bool:
+        return self.coord.try_lock(self._lock_path())
+
+    def unlock(self) -> None:
+        self.coord.unlock(self._lock_path())
+
+    def _hosts(self) -> List[Tuple[str, int]]:
+        return [(m.host, m.port) for m in self._members]
+
+    def get_schemas(self) -> List[List[str]]:
+        results, errors = self._mc.call_collect("mix_get_schema", self.name)
+        for e in errors:
+            # a host missing schema sync would contribute row-misaligned
+            # diffs; surface it loudly (its get_diff may still succeed)
+            log.warning("get_schema failed: %s", e)
+        return [r for _, r in results]
+
+    def sync_schema(self, union: List[str]) -> None:
+        _results, errors = self._mc.call_collect("mix_sync_schema", self.name, union)
+        for e in errors:
+            log.warning("sync_schema failed: %s", e)
+
+    def get_diff(self) -> List[Tuple[NodeInfo, bytes]]:
+        results, errors = self._mc.call_collect("mix_get_diff", self.name)
+        for e in errors:
+            log.warning("get_diff failed: %s", e)
+        return [(NodeInfo(h, p), r) for (h, p), r in results]
+
+    def put_diff(self, packed: bytes) -> Dict[str, bool]:
+        results, errors = self._mc.call_collect("mix_put_diff", self.name, packed)
+        for e in errors:
+            log.warning("put_diff failed: %s", e)
+        out = {f"{h}_{p}": bool(r) for (h, p), r in results}
+        for e in errors:
+            out[f"{e.host}_{e.port}"] = False
+        return out
+
+    def get_model(self, member: NodeInfo) -> bytes:
+        with RpcClient(member.host, member.port, self.timeout) as c:
+            return c.call("mix_get_model", self.name)
+
+    def close(self) -> None:
+        if self._mc is not None:
+            self._mc.close()
+            self._mc = None
+
+    def register_active(self, node: NodeInfo, active: bool) -> None:
+        # The master only DEMOTES failed members (removal is session-less).
+        # Promotion happens on the member itself via on_active — an actives
+        # entry must be an ephemeral owned by the member's own session, or it
+        # dies with the master instead of with the member.
+        if not active:
+            membership.unregister_active(
+                self.coord, self.engine, self.name, node.host, node.port
+            )
+
+
+class RpcLinearMixer:
+    """Drives one driver's participation in the cluster mix."""
+
+    def __init__(
+        self,
+        driver: Any,
+        comm: LinearCommunication,
+        *,
+        self_node: Optional[NodeInfo] = None,
+        interval_sec: float = 16.0,
+        interval_count: int = 512,
+    ) -> None:
+        self.driver = driver
+        self.comm = comm
+        self.self_node = self_node
+        self._scheduler = IntervalMixer(
+            self._mix_round,
+            interval_sec=interval_sec,
+            interval_count=interval_count,
+        )
+        self.mix_count = 0
+        self.bytes_sent = 0
+        self._obsolete = False
+        #: set by the owning server: called with True/False after each
+        #: locally-applied put_diff so the member (re)registers ITSELF in the
+        #: actives list through its own coordinator session
+        self.on_active: Optional[Any] = None
+
+    # -- RPC surface served by the owning server (linear_mixer.cpp:270-290) --
+    def register_api(self, rpc_server, name_check: str = "") -> None:
+        rpc_server.register("mix_get_schema", lambda _name: self.local_get_schema())
+        rpc_server.register(
+            "mix_sync_schema", lambda _name, union: self.local_sync_schema(union)
+        )
+        rpc_server.register("mix_get_diff", lambda _name: self.local_get_diff())
+        rpc_server.register(
+            "mix_put_diff", lambda _name, packed: self.local_put_diff(packed)
+        )
+        rpc_server.register("mix_get_model", lambda _name: self.local_get_model())
+        # do_mix itself is served by the engine server (it delegates here)
+
+    def local_get_schema(self) -> List[str]:
+        with self.driver.lock:
+            return (
+                self.driver.get_schema() if hasattr(self.driver, "get_schema") else []
+            )
+
+    def local_sync_schema(self, union) -> bool:
+        with self.driver.lock:
+            if hasattr(self.driver, "sync_schema"):
+                self.driver.sync_schema([
+                    s.decode() if isinstance(s, bytes) else s for s in union
+                ])
+        return True
+
+    def local_get_diff(self) -> bytes:
+        """Serve my diff (model read lock; linear_mixer.cpp:562-579)."""
+        with self.driver.lock:
+            diffs = {
+                name: m.get_diff() for name, m in self.driver.get_mixables().items()
+            }
+            schema = (
+                self.driver.get_schema() if hasattr(self.driver, "get_schema") else []
+            )
+        return pack_obj(
+            {"protocol": PROTOCOL_VERSION, "schema": schema, "diffs": diffs}
+        )
+
+    def local_put_diff(self, packed: bytes) -> bool:
+        msg = unpack_obj(packed)
+        if msg.get("protocol") != PROTOCOL_VERSION:
+            log.error("mix protocol mismatch: %s", msg.get("protocol"))
+            return False
+        with self.driver.lock:
+            if msg.get("schema") and hasattr(self.driver, "sync_schema"):
+                self.driver.sync_schema(list(msg["schema"]))
+            ok = True
+            mixables = self.driver.get_mixables()
+            for name, diff in msg["diffs"].items():
+                m = mixables.get(name)
+                if m is not None:
+                    ok = bool(m.put_diff(diff)) and ok
+        self._obsolete = not ok
+        if self.on_active is not None:
+            try:
+                self.on_active(ok)
+            except Exception:  # noqa: BLE001
+                log.exception("active-list transition failed")
+        if not ok:
+            # pull a full model from a peer once the round settles
+            # (linear_mixer.cpp:404-424 runs this from the stabilizer loop)
+            threading.Thread(
+                target=self._recover_soon, daemon=True, name="mix-recover"
+            ).start()
+        return ok
+
+    def _recover_soon(self) -> None:
+        time.sleep(0.2)  # let the master finish broadcasting this round
+        try:
+            self.maybe_recover()
+        except Exception:  # noqa: BLE001 — retried on the next round
+            log.exception("model recovery failed")
+
+    def local_get_model(self) -> bytes:
+        with self.driver.lock:
+            return pack_obj(
+                {"protocol": PROTOCOL_VERSION, "model": self.driver.pack()}
+            )
+
+    # -- scheduling (≙ stabilizer_loop) --------------------------------------
+    def start(self) -> None:
+        self._scheduler.start()
+
+    def stop(self) -> None:
+        self._scheduler.stop()
+        if hasattr(self.comm, "close"):
+            self.comm.close()
+
+    def updated(self, n: int = 1) -> None:
+        self._scheduler.updated(n)
+
+    def mix_now(self) -> Optional[Dict[str, Any]]:
+        return self._scheduler.mix_now()
+
+    def _has_schema(self) -> bool:
+        """True iff the driver class overrides DriverBase.get_schema — only
+        those engines pay the two schema fan-outs per round."""
+        from jubatus_tpu.framework.driver import DriverBase
+
+        cls_fn = getattr(type(self.driver), "get_schema", None)
+        return cls_fn is not None and cls_fn is not DriverBase.get_schema
+
+    # -- the round (≙ linear_mixer::mix) -------------------------------------
+    def _mix_round(self) -> Optional[Dict[str, Any]]:
+        if self._obsolete:
+            self.maybe_recover()
+        members = self.comm.update_members()
+        if len(members) < 2 and self.self_node is not None:
+            return None  # nothing to mix with
+        if not self.comm.try_lock():
+            return None  # someone else is master this round
+        try:
+            return self._run_as_master(members)
+        finally:
+            self.comm.unlock()
+
+    def _run_as_master(self, members: Sequence[NodeInfo]) -> Optional[Dict[str, Any]]:
+        t0 = time.monotonic()
+        # phase 1: schema alignment (classifier label vocab, stat keys) —
+        # skipped entirely for engines that don't define a row schema
+        schemas = self.comm.get_schemas() if self._has_schema() else []
+        schema_union: List[str] = sorted(
+            set().union(*(set(s) for s in schemas))
+        ) if schemas else []
+        schema_union = [
+            s.decode() if isinstance(s, bytes) else s for s in schema_union
+        ]
+        if schema_union:
+            self.comm.sync_schema(schema_union)
+        # phase 2: pull row-aligned diffs
+        replies = self.comm.get_diff()
+        if not replies:
+            log.error("mix aborted: all get_diffs failed")
+            return None
+        payloads = [unpack_obj(p) for _, p in replies]
+        payloads = [p for p in payloads if p.get("protocol") == PROTOCOL_VERSION]
+        if not payloads:
+            return None
+        # phase 3: pairwise fold per mixable (linear_mixer.cpp:481-499)
+        mixables = self.driver.get_mixables()
+        totals: Dict[str, Any] = {}
+        for name, mixable in mixables.items():
+            diffs = [p["diffs"][name] for p in payloads if name in p["diffs"]]
+            if not diffs:
+                continue
+            custom_mix = getattr(mixable, "mix", None)
+            if custom_mix is not None:
+                totals[name] = functools.reduce(custom_mix, diffs)
+            else:
+                totals[name] = tree_sum(diffs)
+        packed = pack_obj(
+            {"protocol": PROTOCOL_VERSION, "schema": schema_union, "diffs": totals}
+        )
+        acks = self.comm.put_diff(packed)
+        # active-list transitions (linear_mixer.cpp:658-681): master demotes
+        # failures; successes promote themselves via on_active
+        for member in members:
+            if not acks.get(member.name, False):
+                self.comm.register_active(member, False)
+        self.mix_count += 1
+        self.bytes_sent += len(packed)
+        log.info(
+            "mix round %d: %d members, %d bytes, %.3fs",
+            self.mix_count, len(members), len(packed), time.monotonic() - t0,
+        )
+        return {"members": len(members), "bytes": len(packed)}
+
+    # -- obsolete-model recovery (linear_mixer.cpp:404-424,598-632) ----------
+    def maybe_recover(self) -> bool:
+        if not self._obsolete:
+            return False
+        members = [
+            m for m in self.comm.update_members()
+            if self.self_node is None or m.name != self.self_node.name
+        ]
+        if not members:
+            return False
+        peer = random.choice(members)
+        packed = self.comm.get_model(peer)
+        msg = unpack_obj(packed)
+        if msg.get("protocol") != PROTOCOL_VERSION:
+            raise RuntimeError("protocol version mismatch on recovery — restart")
+        with self.driver.lock:
+            self.driver.unpack(msg["model"])
+        self._obsolete = False
+        log.info("recovered full model from %s", peer.name)
+        return True
+
+    def get_status(self) -> Dict[str, Any]:
+        st = self._scheduler.get_status()
+        st.update({"bytes_sent": self.bytes_sent, "obsolete": self._obsolete})
+        return st

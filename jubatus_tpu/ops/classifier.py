@@ -108,7 +108,11 @@ def decide_updates(s, labels, label_mask, x2, v, x2_vec, param, *, method):
     Inputs are already globally reduced where sharded: s [B, L] raw scores,
     x2/v [B] (= ||x||^2 and x'(Sig_c+Sig_w)x), x2_vec [B, K] *local* squared
     feature values (may be a shard's slice — dp is per-feature and local).
-    Returns (wrong [B], alpha [B], dp [B, K] or None).
+    Returns (wrong [B], alpha [B], alpha_w [B], dp [B, K] or None): alpha
+    scales the correct row's update, alpha_w the rival row's. When no rival
+    label exists (single-label model) the rival score is taken as 0 — the
+    reference still learns from the first label's examples — and alpha_w is
+    zeroed so nothing lands on the dead slot `wrong` points at.
     """
     B = s.shape[0]
     rows = jnp.arange(B)
@@ -117,14 +121,16 @@ def decide_updates(s, labels, label_mask, x2, v, x2_vec, param, *, method):
     s_masked = s.at[rows, labels].set(_NEG)
     s_wrong = jnp.max(s_masked, axis=1)
     wrong = jnp.argmax(s_masked, axis=1)
-    margin = s_correct - s_wrong
+    no_rival = s_wrong <= _NEG / 2
+    margin = s_correct - jnp.where(no_rival, 0.0, s_wrong)
     loss = jnp.maximum(0.0, 1.0 - margin)
-    live = (s_wrong > _NEG / 2) & (x2 > 0.0)
+    live = x2 > 0.0
     alpha, dp = _alpha_and_prec(method, param, margin, loss, x2, v, x2_vec)
     alpha = jnp.where(live, alpha, 0.0)
+    alpha_w = jnp.where(no_rival, 0.0, alpha)
     if dp is not None:
         dp = jnp.where((live & (alpha > 0.0))[:, None], dp, 0.0)
-    return wrong, alpha, dp
+    return wrong, alpha, alpha_w, dp
 
 
 @functools.partial(jax.jit, donate_argnums=())
@@ -233,28 +239,34 @@ def train_batch_parallel(
     # methods (their alpha ignores v).
     if confidence:
         # first pass for `wrong` (alpha ignored), then exact v
-        wrong0, _, _ = decide_updates(
+        wrong0, _, _, _ = decide_updates(
             s, labels, label_mask, x2, jnp.zeros_like(x2), x2_vec, param,
             method=method,
         )
         p_w = jnp.take_along_axis(p_g, wrong0[None, :, None], axis=0)[0]
-        sig_w = 1.0 / p_w
+        # no rival label → `wrong0` points at a dead/arbitrary row; the
+        # nonexistent rival carries the unit precision prior, not that
+        # row's (possibly trained) precision
+        no_rival = jnp.sum(label_mask) < 2
+        sig_w = jnp.where(no_rival, 1.0, 1.0 / p_w)
         v = jnp.sum((sig_c + sig_w) * x2_vec, axis=1)              # [B]
     else:
         sig_w = jnp.ones_like(val)
         v = jnp.zeros_like(x2)
 
-    wrong, alpha, dp = decide_updates(
+    wrong, alpha, alpha_w, dp = decide_updates(
         s, labels, label_mask, x2, v, x2_vec, param, method=method
     )
 
     up_c = alpha[:, None] * sig_c * val                            # [B, K]
-    up_w = alpha[:, None] * sig_w * val
+    up_w = alpha_w[:, None] * sig_w * val
     dw = dw.at[labels[:, None], idx].add(up_c)
     dw = dw.at[wrong[:, None], idx].add(-up_w)
     if confidence:
         dprec = dprec.at[labels[:, None], idx].add(dp)
-        dprec = dprec.at[wrong[:, None], idx].add(dp)
+        dprec = dprec.at[wrong[:, None], idx].add(
+            jnp.where((alpha_w > 0.0)[:, None], dp, 0.0)
+        )
     return ClassifierState(w, dw, prec, dprec)
 
 
@@ -286,17 +298,20 @@ def train_batch_sequential(
         s_correct = s[e_label]
         s_wrong = jnp.max(s.at[e_label].set(_NEG))
         wrong = jnp.argmax(s.at[e_label].set(_NEG))
-        margin = s_correct - s_wrong
+        # no competitor label → rival score 0 (still learn; nothing lands on
+        # the dead slot `wrong` points at)
+        no_rival = s_wrong <= _NEG / 2
+        margin = s_correct - jnp.where(no_rival, 0.0, s_wrong)
         loss = jnp.maximum(0.0, 1.0 - margin)
-        # degenerate cases: no competitor label live, or empty example
         x2_vec = e_val * e_val
         x2 = jnp.sum(x2_vec)
-        live = (s_wrong > _NEG / 2) & (x2 > 0.0)
+        live = x2 > 0.0
 
         if confidence:
             p_g = jnp.take(prec, e_idx, axis=1) + jnp.take(dprec, e_idx, axis=1)
             sig_c = 1.0 / p_g[e_label]  # [K]
-            sig_w = 1.0 / p_g[wrong]
+            # nonexistent rival carries the unit precision prior
+            sig_w = jnp.where(no_rival, 1.0, 1.0 / p_g[wrong])
             v = jnp.sum((sig_c + sig_w) * x2_vec)
         else:
             sig_c = sig_w = 1.0
@@ -304,13 +319,16 @@ def train_batch_sequential(
 
         alpha, dp = _alpha_and_prec(method, param, margin, loss, x2, v, x2_vec)
         alpha = jnp.where(live, alpha, 0.0)
+        alpha_w = jnp.where(no_rival, 0.0, alpha)
 
         dw = dw.at[e_label, e_idx].add(alpha * sig_c * e_val)
-        dw = dw.at[wrong, e_idx].add(-alpha * sig_w * e_val)
+        dw = dw.at[wrong, e_idx].add(-alpha_w * sig_w * e_val)
         if confidence:
             dp = jnp.where(live & (alpha > 0.0), dp, 0.0)
             dprec = dprec.at[e_label, e_idx].add(dp)
-            dprec = dprec.at[wrong, e_idx].add(dp)
+            dprec = dprec.at[wrong, e_idx].add(
+                jnp.where(alpha_w > 0.0, dp, 0.0)
+            )
         return (w, dw, prec, dprec), alpha > 0.0
 
     (w, dw, prec, dprec), updated = jax.lax.scan(

@@ -101,30 +101,34 @@ def make_spmd_train_step(mesh: Mesh, *, method: str = "AROW", param: float = 1.0
             p_c = jnp.take_along_axis(pg, labels[None, :, None], axis=0)[0]
             sig_c = jnp.where(owned, 1.0 / p_c, 0.0)
             # first pass only to identify the competing label for sigma_w
-            wrong0, _, _ = decide_updates(
+            wrong0, _, _, _ = decide_updates(
                 s, labels, label_mask, x2, jnp.zeros_like(x2), x2_vec_l,
                 param, method=method,
             )
             p_w = jnp.take_along_axis(pg, wrong0[None, :, None], axis=0)[0]
-            sig_w = jnp.where(owned, 1.0 / p_w, 0.0)
+            # nonexistent rival carries the unit precision prior
+            no_rival = jnp.sum(label_mask) < 2
+            sig_w = jnp.where(owned, jnp.where(no_rival, 1.0, 1.0 / p_w), 0.0)
             v = _shard_psum(jnp.sum((sig_c + sig_w) * x2_vec_l, axis=1))
         else:
             sig_c = sig_w = jnp.where(owned, 1.0, 0.0)
             v = jnp.zeros_like(x2)
 
         # the one shared decision kernel (ops/classifier.decide_updates)
-        wrong, alpha, dp = decide_updates(
+        wrong, alpha, alpha_w, dp = decide_updates(
             s, labels, label_mask, x2, v, x2_vec_l, param, method=method
         )
 
         up_c = alpha[:, None] * sig_c * lv
-        up_w = alpha[:, None] * sig_w * lv
+        up_w = alpha_w[:, None] * sig_w * lv
         dw = dw.at[labels[:, None], li].add(jnp.where(owned, up_c, 0.0))
         dw = dw.at[wrong[:, None], li].add(jnp.where(owned, -up_w, 0.0))
         if confidence:
             dp = jnp.where(owned, dp, 0.0)
             dprec = dprec.at[labels[:, None], li].add(dp)
-            dprec = dprec.at[wrong[:, None], li].add(dp)
+            dprec = dprec.at[wrong[:, None], li].add(
+                jnp.where((alpha_w > 0.0)[:, None], dp, 0.0)
+            )
 
         if mix:
             # THE mix round: one AllReduce over the replica axis

@@ -118,31 +118,65 @@ class RpcClient:
 
 
 class RpcMClient:
-    """Parallel fan-out with reducer fold (≙ rpc_mclient)."""
+    """Parallel fan-out with reducer fold (≙ rpc_mclient).
+
+    Keeps one persistent connection per host across calls (the reference's
+    session_pool) — call ``close()`` when done, or use as a context manager.
+    ``set_hosts`` reshapes the pool on membership change without dropping
+    still-valid sessions.
+    """
 
     def __init__(
         self, hosts: Sequence[Tuple[str, int]], timeout: float = 10.0
     ) -> None:
+        self.timeout = timeout
+        self._pool: dict = {}
+        self.hosts: List[Tuple[str, int]] = []
+        self._executor = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="rpc-fanout"
+        )
+        self.set_hosts(hosts)
+
+    def set_hosts(self, hosts: Sequence[Tuple[str, int]]) -> None:
         if not hosts:
             raise RpcNoClient("empty host list")
+        hosts = [tuple(h) for h in hosts]
+        for hp in list(self._pool):
+            if hp not in hosts:
+                self._pool.pop(hp).close()
         self.hosts = list(hosts)
-        self.timeout = timeout
+
+    def close(self) -> None:
+        for c in self._pool.values():
+            c.close()
+        self._pool.clear()
+        self._executor.shutdown(wait=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _client(self, hp: Tuple[str, int]) -> RpcClient:
+        c = self._pool.get(hp)
+        if c is None:
+            c = self._pool[hp] = RpcClient(hp[0], hp[1], self.timeout)
+        return c
 
     def _fan_out(self, method: str, args: Sequence[Any]):
         results: List[Tuple[Tuple[str, int], Any]] = []
         errors: List[HostError] = []
 
         def one(hp: Tuple[str, int]):
-            with RpcClient(hp[0], hp[1], self.timeout) as c:
-                return c.call(method, *args)
+            return self._client(hp).call(method, *args)
 
-        with ThreadPoolExecutor(max_workers=min(len(self.hosts), 64)) as pool:
-            futs = {pool.submit(one, hp): hp for hp in self.hosts}
-            for fut, hp in futs.items():
-                try:
-                    results.append((hp, fut.result()))
-                except Exception as e:  # noqa: BLE001 — per-host failure is data
-                    errors.append(HostError(hp[0], hp[1], e))
+        futs = {self._executor.submit(one, hp): hp for hp in self.hosts}
+        for fut, hp in futs.items():
+            try:
+                results.append((hp, fut.result()))
+            except Exception as e:  # noqa: BLE001 — per-host failure is data
+                errors.append(HostError(hp[0], hp[1], e))
         return results, errors
 
     def call_fold(

@@ -1,0 +1,42 @@
+"""Server main (≙ run_server<Impl,Serv>, server_util.hpp:139-176).
+
+    python -m jubatus_tpu.server classifier -f config/classifier/arow.json -p 9199
+    python -m jubatus_tpu.server classifier --config-test -f conf.json
+    python -m jubatus_tpu.server classifier -z /shared/cluster -n c1   # distributed
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import sys
+
+from jubatus_tpu.server.args import parse_server_args
+from jubatus_tpu.server.base import EngineServer
+
+
+def main(argv=None) -> int:
+    args = parse_server_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s %(levelname)s [{args.engine}:{args.rpc_port}] %(message)s",
+    )
+    if args.config_test:
+        # dry-construct and exit (server_util.hpp:142-152)
+        try:
+            EngineServer.from_args(args)
+        except Exception as e:  # noqa: BLE001
+            print(f"config error: {e}", file=sys.stderr)
+            return 1
+        print("config ok")
+        return 0
+    server = EngineServer.from_args(args)
+    signal.signal(signal.SIGTERM, lambda *_: server.stop())
+    signal.signal(signal.SIGINT, lambda *_: server.stop())
+    server.start()
+    server.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,106 @@
+"""Server command-line flags (≙ server_argv, framework/server_util.cpp:183-378).
+
+Same flag names and defaults as the reference's servers, with `--zookeeper`
+generalized to `--coordinator` (a locator string: a shared directory or
+"memory"; see jubatus_tpu.coord.create_coordinator). `-z` stays as an alias.
+Standalone mode ⇔ empty coordinator (server_util.hpp:100-102).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import socket
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ServerArgs:
+    engine: str = ""
+    rpc_port: int = 9199                # -p (server_util.cpp:188)
+    listen_addr: str = ""               # -b
+    thread: int = 2                     # -c (server_util.cpp:193-194)
+    timeout: float = 10.0               # -t
+    datadir: str = "/tmp"               # -d
+    logdir: str = ""                    # -l
+    configpath: str = ""                # -f
+    model_file: str = ""                # -m
+    daemon: bool = False                # -D
+    config_test: bool = False
+    coordinator: str = ""               # -z; "" = standalone
+    name: str = ""                      # -n cluster name
+    mixer: str = "linear_mixer"         # -x
+    interval_sec: float = 16.0          # (server_util.cpp:223-225)
+    interval_count: int = 512           # (server_util.cpp:226-228)
+    coordinator_timeout: float = 10.0   # --zookeeper_timeout
+    interconnect_timeout: float = 10.0
+
+    @property
+    def is_standalone(self) -> bool:
+        return self.coordinator == ""
+
+    @property
+    def bind_host(self) -> str:
+        return self.listen_addr or "0.0.0.0"
+
+    @property
+    def eth(self) -> str:
+        """Our address as seen by peers (reference common/network get_ip)."""
+        if self.listen_addr and self.listen_addr != "0.0.0.0":
+            return self.listen_addr
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.connect(("10.255.255.255", 1))
+            ip = s.getsockname()[0]
+            s.close()
+            return ip
+        except OSError:
+            return "127.0.0.1"
+
+    def flags_status(self) -> Dict[str, Any]:
+        """Flag dump for get_status (server_helper.hpp:119-219)."""
+        return {f"argv.{f.name}": getattr(self, f.name)
+                for f in dataclasses.fields(self)}
+
+
+def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog=prog, description="jubatus_tpu engine server"
+    )
+    p.add_argument("engine", help="engine type (classifier, recommender, ...)")
+    p.add_argument("-p", "--rpc-port", type=int, default=9199)
+    p.add_argument("-b", "--listen-addr", default="")
+    p.add_argument("-c", "--thread", type=int, default=2)
+    p.add_argument("-t", "--timeout", type=float, default=10.0)
+    p.add_argument("-d", "--datadir", default="/tmp")
+    p.add_argument("-l", "--logdir", default="")
+    p.add_argument("-f", "--configpath", default="")
+    p.add_argument("-m", "--model-file", default="")
+    p.add_argument("-D", "--daemon", action="store_true")
+    p.add_argument("--config-test", action="store_true")
+    p.add_argument("-z", "--coordinator", default="",
+                   help="coordination backend: shared dir path or 'memory'; "
+                        "empty = standalone")
+    p.add_argument("-n", "--name", default="")
+    p.add_argument("-x", "--mixer", default="linear_mixer",
+                   choices=["linear_mixer", "skip_mixer", "dummy_mixer"])
+    p.add_argument("-s", "--interval-sec", type=float, default=16.0)
+    p.add_argument("-i", "--interval-count", type=int, default=512)
+    p.add_argument("--coordinator-timeout", "--zookeeper-timeout",
+                   dest="coordinator_timeout", type=float, default=10.0)
+    p.add_argument("--interconnect-timeout", type=float, default=10.0)
+    return p
+
+
+def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
+    ns = build_parser().parse_args(argv)
+    args = ServerArgs(**{
+        f.name: getattr(ns, f.name) for f in dataclasses.fields(ServerArgs)
+    })
+    if args.thread < 1:
+        raise SystemExit("--thread must be >= 1")
+    if args.rpc_port < 0 or args.rpc_port > 65535:
+        raise SystemExit("--rpc-port out of range")
+    if not args.is_standalone and not args.name:
+        raise SystemExit("distributed mode (-z) requires --name")
+    return args

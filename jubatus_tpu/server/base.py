@@ -1,0 +1,219 @@
+"""EngineServer — lifecycle + built-ins (≙ framework/server_base.{hpp,cpp} +
+server_helper.{hpp,cpp} collapsed into one class).
+
+Owns: driver, mixer, RPC server, optional coordinator session. Serves the
+engine's IDL methods (bound by server/service.py) plus the reference's
+built-ins — get_config / save / load / get_status / do_mix — and, when
+distributed, the mixer's internal API and membership registration with the
+suicide watcher (server_helper.cpp:96-112).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from jubatus_tpu.coord import create_coordinator, membership
+from jubatus_tpu.coord.base import Coordinator, NodeInfo
+from jubatus_tpu.framework.linear_mixer import RpcLinearCommunication, RpcLinearMixer
+from jubatus_tpu.framework.save_load import load_model, save_model
+from jubatus_tpu.rpc.server import RpcServer
+from jubatus_tpu.server.args import ServerArgs
+from jubatus_tpu.server.factory import create_driver
+from jubatus_tpu.version import __version__
+
+log = logging.getLogger(__name__)
+
+
+class EngineServer:
+    def __init__(
+        self,
+        engine: str,
+        config: Any,
+        args: Optional[ServerArgs] = None,
+        coord: Optional[Coordinator] = None,
+    ) -> None:
+        self.engine = engine
+        self.args = args or ServerArgs(engine=engine)
+        if isinstance(config, dict):
+            config = json.dumps(config)
+        self.config_json: str = config
+        self.driver = create_driver(engine, json.loads(config))
+        self.start_time = time.time()
+        self.last_saved = 0.0
+        self.last_loaded = 0.0
+        self.rpc = RpcServer(timeout=self.args.timeout)
+        self._stop_event = threading.Event()
+
+        # distributed wiring (server_helper ctor path, server_helper.cpp:48-78)
+        self.coord = coord
+        self.mixer: Optional[RpcLinearMixer] = None
+        if not self.args.is_standalone or coord is not None:
+            if self.coord is None:
+                self.coord = create_coordinator(self.args.coordinator)
+            comm = RpcLinearCommunication(
+                self.coord, engine, self.args.name,
+                timeout=self.args.interconnect_timeout,
+            )
+            self.mixer = RpcLinearMixer(
+                self.driver, comm,
+                self_node=NodeInfo(self.args.eth, self.args.rpc_port),
+                interval_sec=self.args.interval_sec,
+                interval_count=self.args.interval_count,
+            )
+            # count updates into the mixer (server_base.cpp:214-219)
+            driver_event = self.driver.event_model_updated
+
+            def chained(n: int = 1) -> None:
+                driver_event(n)
+                self.mixer.updated(n)
+
+            self.driver.event_model_updated = chained  # type: ignore[assignment]
+
+    # -- construction from files/argv (run_server, server_util.hpp:139-176) --
+    @classmethod
+    def from_args(cls, args: ServerArgs) -> "EngineServer":
+        if args.configpath:
+            with open(args.configpath) as f:
+                config = f.read()
+        elif not args.is_standalone:
+            coord = create_coordinator(args.coordinator)
+            raw = coord.read(membership.config_path(args.engine, args.name))
+            if raw is None:
+                raise RuntimeError(
+                    f"no config registered for {args.engine}/{args.name} "
+                    "(use jubaconfig to write one)"
+                )
+            return cls(args.engine, raw.decode(), args, coord=coord)
+        else:
+            raise RuntimeError("standalone mode requires -f/--configpath")
+        srv = cls(args.engine, config, args)
+        if args.model_file:
+            srv.load_file(args.model_file)
+        return srv
+
+    # -- built-in RPCs (server_base.hpp:41-109, client.hpp:30-87) ------------
+    def get_config(self, _name: str = "") -> str:
+        return self.config_json
+
+    def model_path(self, model_id: str) -> str:
+        """<datadir>/<ip>_<port>_<type>_<id>.jubatus (server_base.cpp:41-49)."""
+        node = NodeInfo(self.args.eth, self.args.rpc_port)
+        return os.path.join(
+            self.args.datadir, f"{node.name}_{self.engine}_{model_id}.jubatus"
+        )
+
+    def save(self, _name: str, model_id: str) -> Dict[str, str]:
+        path = self.model_path(model_id)
+        with self.driver.lock:
+            save_model(path, self.driver, model_id=model_id,
+                       config=self.config_json)
+        self.last_saved = time.time()
+        node = NodeInfo(self.args.eth, self.args.rpc_port)
+        return {node.name: path}
+
+    def load(self, _name: str, model_id: str) -> bool:
+        self.load_file(self.model_path(model_id))
+        return True
+
+    def load_file(self, path: str) -> None:
+        with self.driver.lock:
+            load_model(path, self.driver, expected_config=self.config_json)
+        self.last_loaded = time.time()
+
+    def do_mix(self, _name: str = "") -> bool:
+        if self.mixer is None:
+            return False
+        return self.mixer.mix_now() is not None
+
+    def get_status(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
+        """≙ server_helper::get_status (server_helper.hpp:119-219): one map
+        keyed by <ip>_<port> with uptime/memory/flags/counters."""
+        st: Dict[str, Any] = {
+            "timestamp": int(time.time()),
+            "uptime": int(time.time() - self.start_time),
+            "type": self.engine,
+            "name": self.args.name,
+            "version": __version__,
+            "update_count": self.driver.update_count,
+            "last_saved": self.last_saved,
+            "last_loaded": self.last_loaded,
+            "rpc_port": self.rpc.port or self.args.rpc_port,
+        }
+        try:
+            with open("/proc/self/statm") as f:
+                pages = f.read().split()
+            page = os.sysconf("SC_PAGE_SIZE")
+            st["VIRT"] = int(pages[0]) * page
+            st["RSS"] = int(pages[1]) * page
+            st["SHR"] = int(pages[2]) * page
+        except (OSError, IndexError, ValueError):
+            pass
+        try:
+            st["loadavg"] = os.getloadavg()[0]
+        except OSError:
+            pass
+        st.update(self.args.flags_status())
+        st.update({f"driver.{k}": v for k, v in self.driver.get_status().items()})
+        if self.mixer is not None:
+            st.update({f"mixer.{k}": v for k, v in self.mixer.get_status().items()})
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        return {node.name: st}
+
+    # -- lifecycle (server_helper::start, server_helper.hpp:221-262) ---------
+    def start(self, port: Optional[int] = None, background: bool = True) -> int:
+        from jubatus_tpu.server.service import bind_engine  # cycle-free import
+
+        bind_engine(self.rpc, self)
+        if self.mixer is not None:
+            self.mixer.register_api(self.rpc)
+        actual = self.rpc.serve_background(
+            port if port is not None else self.args.rpc_port,
+            nthreads=self.args.thread,
+            host=self.args.bind_host,
+        )
+        self.args.rpc_port = actual
+        if self.coord is not None and self.mixer is not None:
+            node = NodeInfo(self.args.eth, actual)
+            # ephemeral-port binds (start(0)) resolve only now
+            self.mixer.self_node = node
+            path = membership.register_actor(
+                self.coord, self.engine, self.args.name, node.host, node.port
+            )
+            membership.register_active(
+                self.coord, self.engine, self.args.name, node.host, node.port
+            )
+            # put_diff outcome drives my own actives entry (through MY
+            # coordinator session, so it dies with me, not with the master)
+            def on_active(ok: bool, _n=node) -> None:
+                if ok:
+                    membership.register_active(
+                        self.coord, self.engine, self.args.name, _n.host, _n.port
+                    )
+                else:
+                    membership.unregister_active(
+                        self.coord, self.engine, self.args.name, _n.host, _n.port
+                    )
+
+            self.mixer.on_active = on_active
+            # suicide watcher (server_helper.cpp:91-94,105-109)
+            self.coord.watch_delete(path, lambda _p: self.stop())
+            self.mixer.start()
+        log.info("%s server listening on %s:%d", self.engine,
+                 self.args.bind_host, actual)
+        return actual
+
+    def join(self) -> None:
+        self._stop_event.wait()
+
+    def stop(self) -> None:
+        if self.mixer is not None:
+            self.mixer.stop()
+        if self.coord is not None:
+            self.coord.close()
+        self.rpc.stop()
+        self._stop_event.set()

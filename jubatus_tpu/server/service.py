@@ -1,0 +1,346 @@
+"""Wire adapters: bind drivers onto RpcServer (≙ generated *_impl.cpp).
+
+One binder per engine converts between msgpack wire types (datum 3-tuples,
+[k,v] pair lists) and driver types (Datum, tuples), registers each IDL method
+under its wire name with the leading cluster-name param every jubatus call
+carries, calls driver.event_model_updated() after update methods (the
+reference's generated impls do this via lock decorators + serv methods,
+classifier_impl.cpp:56-59 → classifier_serv.cpp:127-146), and registers the
+built-ins (get_config/save/load/get_status/do_mix, client.hpp:30-87).
+
+Update methods run under the driver lock (JWLOCK_); the built-ins take it
+where the reference does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.rpc.server import RpcServer
+
+# -- wire ↔ driver conversions ----------------------------------------------
+
+
+def _datum(obj: Any) -> Datum:
+    return Datum.from_msgpack(obj)
+
+
+def _datums(objs: Any) -> List[Datum]:
+    return [Datum.from_msgpack(o) for o in objs]
+
+
+def _wire_datum(d: Datum):
+    return d.to_msgpack()
+
+
+def _scored(results: List) -> List:
+    """[(id, score)] → [[id, score]] (id_with_score wire shape)."""
+    return [[i, float(s)] for i, s in results]
+
+
+# -- binder registry ---------------------------------------------------------
+
+_BINDERS: Dict[str, Callable[[RpcServer, Any], None]] = {}
+
+
+def _binder(engine: str):
+    def deco(fn):
+        _BINDERS[engine] = fn
+        return fn
+
+    return deco
+
+
+def bind_engine(rpc: RpcServer, server: Any) -> None:
+    """Register built-ins + the engine's IDL surface on the RPC server."""
+    rpc.register("get_config", server.get_config, arity=1)
+    rpc.register("save", server.save, arity=2)
+    rpc.register("load", server.load, arity=2)
+    rpc.register("get_status", server.get_status, arity=1)
+    rpc.register("do_mix", server.do_mix, arity=1)
+    _BINDERS[server.engine](rpc, server)
+
+
+def _updating(server: Any, fn: Callable, count: Callable[[Any], int] = lambda r: 1):
+    """Wrap an update method: driver lock + event_model_updated (the
+    reference's JWLOCK_ + serv-side bookkeeping). Most driver methods bump
+    the counter themselves; the wrapper only adds the event when the driver
+    didn't, so updates are never double-counted."""
+
+    def wrapped(*args):
+        with server.driver.lock:
+            before = server.driver.update_count
+            result = fn(*args)
+            if server.driver.update_count == before:
+                n = count(result)
+                if n:
+                    server.driver.event_model_updated(n)
+        return result
+
+    return wrapped
+
+
+# -- per-engine binders -------------------------------------------------------
+
+
+@_binder("classifier")
+def _bind_classifier(rpc: RpcServer, server: Any) -> None:
+    d = server.driver
+    rpc.register(
+        "train",
+        lambda name, data: _updating(
+            server,
+            lambda: d.train([(lbl, _datum(dat)) for lbl, dat in data]),
+            count=lambda r: r,
+        )(),
+        arity=2,
+    )
+    rpc.register(
+        "classify",
+        lambda name, data: [_scored(r) for r in d.classify(_datums(data))],
+        arity=2,
+    )
+    rpc.register("get_labels", lambda name: {k: int(v) for k, v in d.get_labels().items()}, arity=1)
+    rpc.register("set_label", _updating(server, lambda name, lbl: d.set_label(lbl)), arity=2)
+    rpc.register("delete_label", _updating(server, lambda name, lbl: d.delete_label(lbl)), arity=2)
+    rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
+
+
+@_binder("regression")
+def _bind_regression(rpc: RpcServer, server: Any) -> None:
+    d = server.driver
+    rpc.register(
+        "train",
+        lambda name, data: _updating(
+            server,
+            lambda: d.train([(float(s), _datum(dat)) for s, dat in data]),
+            count=lambda r: r,
+        )(),
+        arity=2,
+    )
+    rpc.register(
+        "estimate",
+        lambda name, data: [float(x) for x in d.estimate(_datums(data))],
+        arity=2,
+    )
+    rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
+
+
+@_binder("recommender")
+def _bind_recommender(rpc: RpcServer, server: Any) -> None:
+    d = server.driver
+    rpc.register("clear_row", _updating(server, lambda name, rid: d.clear_row(rid)), arity=2)
+    rpc.register(
+        "update_row",
+        _updating(server, lambda name, rid, row: d.update_row(rid, _datum(row))),
+        arity=3,
+    )
+    rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
+    rpc.register("complete_row_from_id", lambda name, rid: _wire_datum(d.complete_row_from_id(rid)), arity=2)
+    rpc.register("complete_row_from_datum", lambda name, row: _wire_datum(d.complete_row_from_datum(_datum(row))), arity=2)
+    rpc.register("similar_row_from_id", lambda name, rid, size: _scored(d.similar_row_from_id(rid, int(size))), arity=3)
+    rpc.register("similar_row_from_datum", lambda name, row, size: _scored(d.similar_row_from_datum(_datum(row), int(size))), arity=3)
+    rpc.register("decode_row", lambda name, rid: _wire_datum(d.decode_row(rid)), arity=2)
+    rpc.register("get_all_rows", lambda name: d.get_all_rows(), arity=1)
+    rpc.register("calc_similarity", lambda name, lhs, rhs: float(d.calc_similarity(_datum(lhs), _datum(rhs))), arity=3)
+    rpc.register("calc_l2norm", lambda name, row: float(d.calc_l2norm(_datum(row))), arity=2)
+
+
+@_binder("nearest_neighbor")
+def _bind_nearest_neighbor(rpc: RpcServer, server: Any) -> None:
+    d = server.driver
+    rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
+    rpc.register("set_row", _updating(server, lambda name, rid, dat: d.set_row(rid, _datum(dat))), arity=3)
+    rpc.register("neighbor_row_from_id", lambda name, rid, size: _scored(d.neighbor_row_from_id(rid, int(size))), arity=3)
+    rpc.register("neighbor_row_from_datum", lambda name, q, size: _scored(d.neighbor_row_from_datum(_datum(q), int(size))), arity=3)
+    rpc.register("similar_row_from_id", lambda name, rid, n: _scored(d.similar_row_from_id(rid, int(n))), arity=3)
+    rpc.register("similar_row_from_datum", lambda name, q, n: _scored(d.similar_row_from_datum(_datum(q), int(n))), arity=3)
+    rpc.register("get_all_rows", lambda name: d.get_all_rows(), arity=1)
+
+
+@_binder("anomaly")
+def _bind_anomaly(rpc: RpcServer, server: Any) -> None:
+    d = server.driver
+    rpc.register("clear_row", _updating(server, lambda name, rid: d.clear_row(rid)), arity=2)
+    rpc.register(
+        "add",
+        lambda name, row: list(_updating(server, lambda: d.add(_datum(row)))()),
+        arity=2,
+    )
+    rpc.register("update", _updating(server, lambda name, rid, row: float(d.update(rid, _datum(row)))), arity=3)
+    rpc.register("overwrite", _updating(server, lambda name, rid, row: float(d.overwrite(rid, _datum(row)))), arity=3)
+    rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
+    rpc.register("calc_score", lambda name, row: float(d.calc_score(_datum(row))), arity=2)
+    rpc.register("get_all_rows", lambda name: d.get_all_rows(), arity=1)
+
+
+@_binder("graph")
+def _bind_graph(rpc: RpcServer, server: Any) -> None:
+    d = server.driver
+
+    def edge_parts(e):
+        """wire edge [property_map, source, target] → driver arg order
+        (source, target, properties)."""
+        return e[1], e[2], dict(e[0])
+
+    rpc.register("create_node", _updating(server, lambda name: d.create_node()), arity=1)
+    rpc.register("remove_node", _updating(server, lambda name, nid: d.remove_node(nid)), arity=2)
+    rpc.register("update_node", _updating(server, lambda name, nid, prop: d.update_node(nid, dict(prop))), arity=3)
+    rpc.register(
+        "create_edge",
+        _updating(server, lambda name, nid, e: d.create_edge(nid, *edge_parts(e))),
+        arity=3,
+    )
+    rpc.register(
+        "update_edge",
+        _updating(server, lambda name, nid, eid, e: d.update_edge(nid, int(eid), *edge_parts(e))),
+        arity=4,
+    )
+    rpc.register("remove_edge", _updating(server, lambda name, nid, eid: d.remove_edge(nid, int(eid))), arity=3)
+    rpc.register("get_centrality", lambda name, nid, ct, q: float(d.get_centrality(nid, int(ct), q)), arity=4)
+    rpc.register("add_centrality_query", _updating(server, lambda name, q: d.add_centrality_query(q)), arity=2)
+    rpc.register("add_shortest_path_query", _updating(server, lambda name, q: d.add_shortest_path_query(q)), arity=2)
+    rpc.register("remove_centrality_query", _updating(server, lambda name, q: d.remove_centrality_query(q)), arity=2)
+    rpc.register("remove_shortest_path_query", _updating(server, lambda name, q: d.remove_shortest_path_query(q)), arity=2)
+    rpc.register(
+        "get_shortest_path",
+        lambda name, q: d.get_shortest_path(q[0], q[1], int(q[2]), q[3] if len(q) > 3 else None),
+        arity=2,
+    )
+    rpc.register("update_index", _updating(server, lambda name: d.update_index()), arity=1)
+    rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
+    rpc.register(
+        "get_node",
+        lambda name, nid: (lambda n: [n["property"], n["in_edges"], n["out_edges"]])(d.get_node(nid)),
+        arity=2,
+    )
+    rpc.register(
+        "get_edge",
+        lambda name, nid, eid: (lambda e: [e["property"], e["source"], e["target"]])(d.get_edge(nid, int(eid))),
+        arity=3,
+    )
+    rpc.register("create_node_here", _updating(server, lambda name, nid: d.create_node_here(nid)), arity=2)
+    rpc.register("remove_global_node", _updating(server, lambda name, nid: d.remove_global_node(nid)), arity=2)
+    rpc.register(
+        "create_edge_here",
+        _updating(server, lambda name, eid, e: d.create_edge_here(int(eid), *edge_parts(e))),
+        arity=3,
+    )
+
+
+@_binder("burst")
+def _bind_burst(rpc: RpcServer, server: Any) -> None:
+    d = server.driver
+
+    def wire_window(w):
+        """driver window dict → wire [start_pos, [[all, rel, weight]...]]."""
+        return [w["start_pos"], [[b["all_data_count"], b["relevant_data_count"], b["burst_weight"]] for b in w["batches"]]]
+
+    rpc.register(
+        "add_documents",
+        lambda name, docs: _updating(
+            server,
+            lambda: d.add_documents([(float(p), t) for p, t in docs]),
+            count=lambda r: r,
+        )(),
+        arity=2,
+    )
+    rpc.register("get_result", lambda name, kw: wire_window(d.get_result(kw)), arity=2)
+    rpc.register("get_result_at", lambda name, kw, pos: wire_window(d.get_result_at(kw, float(pos))), arity=3)
+    rpc.register(
+        "get_all_bursted_results",
+        lambda name: {k: wire_window(w) for k, w in d.get_all_bursted_results().items()},
+        arity=1,
+    )
+    rpc.register(
+        "get_all_bursted_results_at",
+        lambda name, pos: {k: wire_window(w) for k, w in d.get_all_bursted_results_at(float(pos)).items()},
+        arity=2,
+    )
+    rpc.register(
+        "get_all_keywords",
+        lambda name: [[k["keyword"], k["scaling_param"], k["gamma"]] for k in d.get_all_keywords()],
+        arity=1,
+    )
+    rpc.register(
+        "add_keyword",
+        _updating(server, lambda name, kw: d.add_keyword(kw[0], float(kw[1]), float(kw[2]))),
+        arity=2,
+    )
+    rpc.register("remove_keyword", _updating(server, lambda name, kw: d.remove_keyword(kw)), arity=2)
+    rpc.register("remove_all_keywords", _updating(server, lambda name: d.remove_all_keywords()), arity=1)
+    rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
+
+
+@_binder("clustering")
+def _bind_clustering(rpc: RpcServer, server: Any) -> None:
+    d = server.driver
+
+    def wd(pair):  # (weight, Datum) → wire weighted_datum
+        return [float(pair[0]), _wire_datum(pair[1])]
+
+    def wi(pair):  # (weight, id) → wire weighted_index
+        return [float(pair[0]), pair[1]]
+
+    rpc.register(
+        "push",
+        _updating(server, lambda name, points: d.push([(p[0], _datum(p[1])) for p in points])),
+        arity=2,
+    )
+    rpc.register("get_revision", lambda name: int(d.get_revision()), arity=1)
+    rpc.register("get_core_members", lambda name: [[wd(p) for p in c] for c in d.get_core_members()], arity=1)
+    rpc.register("get_core_members_light", lambda name: [[wi(p) for p in c] for c in d.get_core_members_light()], arity=1)
+    rpc.register("get_k_center", lambda name: [_wire_datum(c) for c in d.get_k_center()], arity=1)
+    rpc.register("get_nearest_center", lambda name, p: _wire_datum(d.get_nearest_center(_datum(p))), arity=2)
+    rpc.register("get_nearest_members", lambda name, p: [wd(x) for x in d.get_nearest_members(_datum(p))], arity=2)
+    rpc.register("get_nearest_members_light", lambda name, p: [wi(x) for x in d.get_nearest_members_light(_datum(p))], arity=2)
+    rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
+
+
+@_binder("stat")
+def _bind_stat(rpc: RpcServer, server: Any) -> None:
+    d = server.driver
+    rpc.register("push", _updating(server, lambda name, key, val: d.push(key, float(val))), arity=3)
+    rpc.register("sum", lambda name, key: float(d.sum(key)), arity=2)
+    rpc.register("stddev", lambda name, key: float(d.stddev(key)), arity=2)
+    rpc.register("max", lambda name, key: float(d.max(key)), arity=2)
+    rpc.register("min", lambda name, key: float(d.min(key)), arity=2)
+    rpc.register("entropy", lambda name, key: float(d.entropy(key)), arity=2)
+    rpc.register("moment", lambda name, key, deg, center: float(d.moment(key, int(deg), float(center))), arity=4)
+    rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
+
+
+@_binder("bandit")
+def _bind_bandit(rpc: RpcServer, server: Any) -> None:
+    d = server.driver
+    rpc.register("register_arm", _updating(server, lambda name, a: d.register_arm(a)), arity=2)
+    rpc.register("delete_arm", _updating(server, lambda name, a: d.delete_arm(a)), arity=2)
+    rpc.register("select_arm", _updating(server, lambda name, p: d.select_arm(p)), arity=2)
+    rpc.register("register_reward", _updating(server, lambda name, p, a, r: d.register_reward(p, a, float(r))), arity=4)
+    rpc.register(
+        "get_arm_info",
+        lambda name, p: {
+            arm: [int(info["trial_count"]), float(info["weight"])]
+            for arm, info in d.get_arm_info(p).items()
+        },
+        arity=2,
+    )
+    rpc.register("reset", _updating(server, lambda name, p: d.reset(p)), arity=2)
+    rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
+
+
+@_binder("weight")
+def _bind_weight(rpc: RpcServer, server: Any) -> None:
+    d = server.driver
+    rpc.register(
+        "update",
+        lambda name, dat: [[k, float(v)] for k, v in _updating(server, lambda: d.update(_datum(dat)))()],
+        arity=2,
+    )
+    rpc.register(
+        "calc_weight",
+        lambda name, dat: [[k, float(v)] for k, v in d.calc_weight(_datum(dat))],
+        arity=2,
+    )
+    rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)

@@ -87,15 +87,18 @@ def test_dead_labels_never_predicted(rng):
     assert int(jnp.max(jnp.argmax(s, axis=1))) <= 1
 
 
-def test_single_label_no_update(rng):
-    """With one live label there is no competitor: train must be a no-op
-    (reference margin over 'other labels' is empty)."""
+def test_single_label_still_learns(rng):
+    """With one live label the rival score is 0 (jubatus_core calc_margin
+    initializes the incorrect score to 0 when no other label exists), so the
+    correct row still gets its update — and nothing lands on dead slots."""
     vectors, labels = make_blobs(rng, 10, n_classes=1)
     idx, val, y = batchify(vectors, labels)
     mask = jnp.array([True, False, False, False])
     state = C.init_state(L, DIM, False)
     state = C.train_batch(state, idx, val, y, mask, 1.0, method="PA")
-    assert float(jnp.abs(state.dw).max()) == 0.0
+    dw = np.asarray(state.dw)
+    assert np.abs(dw[0]).max() > 0.0       # the live label learned
+    assert np.abs(dw[1:]).max() == 0.0     # dead slots untouched
 
 
 def test_padding_is_noop(rng):

@@ -1,0 +1,176 @@
+"""Coordination tests (≙ common/*_test.cpp tier 1 + the ZK mock the
+reference never wrote, SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from jubatus_tpu.coord import (
+    CHT,
+    FileCoordinator,
+    IdGenerator,
+    MemoryCoordinator,
+    NodeInfo,
+    membership,
+)
+from jubatus_tpu.coord.cht import shard_for
+
+
+@pytest.fixture(params=["memory", "file"])
+def coord_factory(request, tmp_path):
+    """Yields a factory producing sessions on one shared store."""
+    if request.param == "memory":
+        from jubatus_tpu.coord.memory import _Store
+
+        store = _Store()
+        yield lambda: MemoryCoordinator(store)
+    else:
+        root = str(tmp_path / "cluster")
+        made = []
+
+        def make():
+            c = FileCoordinator(root)
+            made.append(c)
+            return c
+
+        yield make
+        for c in made:
+            c.close()
+
+
+def test_crud(coord_factory):
+    c = coord_factory()
+    assert c.create("/a/b/c", b"hello")
+    assert not c.create("/a/b/c", b"again")
+    assert c.read("/a/b/c") == b"hello"
+    assert c.exists("/a/b/c")
+    assert c.set("/a/b/c", b"world")
+    assert c.read("/a/b/c") == b"world"
+    assert "b" in c.list("/a")
+    assert c.list("/a/b") == ["c"]
+    assert c.remove("/a/b/c")
+    assert not c.exists("/a/b/c")
+    assert c.read("/a/b/c") is None
+
+
+def test_ephemeral_dies_with_session(coord_factory):
+    s1, s2 = coord_factory(), coord_factory()
+    s1.create("/eph/node1", b"x", ephemeral=True)
+    s1.create("/perm", b"y")
+    assert s2.exists("/eph/node1")
+    s1.close()
+    assert not s2.exists("/eph/node1")
+    assert s2.exists("/perm")
+
+
+def test_locks(coord_factory):
+    s1, s2 = coord_factory(), coord_factory()
+    assert s1.try_lock("/jubatus/m/master_lock")
+    assert not s2.try_lock("/jubatus/m/master_lock")
+    assert s1.try_lock("/jubatus/m/master_lock")  # reentrant for holder
+    assert s1.unlock("/jubatus/m/master_lock")
+    assert s2.try_lock("/jubatus/m/master_lock")
+    s2.unlock("/jubatus/m/master_lock")
+
+
+def test_lock_released_on_close(coord_factory):
+    s1, s2 = coord_factory(), coord_factory()
+    assert s1.try_lock("/lk")
+    s1.close()
+    assert s2.try_lock("/lk")
+
+
+def test_create_id_unique_across_sessions(coord_factory):
+    sessions = [coord_factory() for _ in range(4)]
+    ids = []
+    lock = threading.Lock()
+
+    def mint(c):
+        got = [c.create_id("/idg") for _ in range(25)]
+        with lock:
+            ids.extend(got)
+
+    threads = [threading.Thread(target=mint, args=(s,)) for s in sessions]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(ids) == 100
+    assert len(set(ids)) == 100  # cluster-unique (global_id_generator_zk)
+
+
+def test_membership_registry(coord_factory):
+    c1, c2 = coord_factory(), coord_factory()
+    membership.register_actor(c1, "classifier", "cl", "10.0.0.1", 9199)
+    membership.register_actor(c2, "classifier", "cl", "10.0.0.2", 9199)
+    membership.register_active(c1, "classifier", "cl", "10.0.0.1", 9199)
+    nodes = membership.get_all_nodes(c1, "classifier", "cl")
+    assert {n.name for n in nodes} == {"10.0.0.1_9199", "10.0.0.2_9199"}
+    actives = membership.get_all_actives(c2, "classifier", "cl")
+    assert [n.name for n in actives] == ["10.0.0.1_9199"]
+    # session death removes the member (ZK ephemeral semantics)
+    c1.close()
+    nodes = membership.get_all_nodes(c2, "classifier", "cl")
+    assert {n.name for n in nodes} == {"10.0.0.2_9199"}
+
+
+def test_watch_delete(coord_factory):
+    import time
+
+    c1, c2 = coord_factory(), coord_factory()
+    c1.create("/watched", b"")
+    fired = threading.Event()
+    c2.watch_delete("/watched", lambda p: fired.set())
+    c1.remove("/watched")
+    assert fired.wait(3.0)  # file backend polls at 0.5s
+    del time
+
+
+def test_cht_ring_properties():
+    members = [NodeInfo(f"10.0.0.{i}", 9199) for i in range(5)]
+    ring = CHT(members)
+    # deterministic: same members → same assignment
+    assert ring.find("key1", 2) == CHT(members).find("key1", 2)
+    # n distinct successors, primary first
+    found = ring.find("key1", 3)
+    assert len(found) == 3
+    assert len({f.name for f in found}) == 3
+    # single-node ring returns that node
+    assert CHT(members[:1]).find("anything", 2) == [members[0]]
+    # empty ring
+    assert CHT([]).find("x", 1) == []
+
+
+def test_cht_stability_under_member_change():
+    """Removing one member only remaps keys owned by it (the consistent-
+    hashing property the reference relies on for low churn)."""
+    members = [NodeInfo(f"10.0.0.{i}", 9199) for i in range(8)]
+    ring_a = CHT(members)
+    ring_b = CHT(members[:-1])  # drop one
+    moved = 0
+    total = 200
+    for i in range(total):
+        key = f"key-{i}"
+        pa = ring_a.primary(key)
+        if pa.name == members[-1].name:
+            continue  # owned by removed node — must move
+        if ring_b.primary(key).name != pa.name:
+            moved += 1
+    assert moved == 0  # keys not owned by the removed node never move
+
+
+def test_shard_for_static_mesh():
+    assert shard_for("k", 8) == shard_for("k", 8)
+    assert 0 <= shard_for("k", 8) < 8
+    spread = {shard_for(f"key{i}", 8) for i in range(100)}
+    assert len(spread) == 8  # all shards hit
+
+
+def test_idgen_standalone_vs_coordinated(coord_factory):
+    standalone = IdGenerator()
+    assert [standalone.generate() for _ in range(3)] == [1, 2, 3]
+    c = coord_factory()
+    g1, g2 = IdGenerator(c, "/g"), IdGenerator(coord_factory(), "/g")
+    assert g1.generate() != g2.generate()

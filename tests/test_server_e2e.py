@@ -1,0 +1,219 @@
+"""End-to-end server/client tests (≙ client_test/*.cpp, SURVEY.md §4 tier 6).
+
+A real EngineServer on an ephemeral port, driven through the typed client
+over the wire protocol — train/query round-trips, built-ins, save/load.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from jubatus_tpu.client import (
+    BanditClient,
+    ClassifierClient,
+    Datum,
+    NearestNeighborClient,
+    RecommenderClient,
+    RegressionClient,
+    StatClient,
+    WeightClient,
+)
+from jubatus_tpu.server import EngineServer
+
+NAME = "e2e"
+
+CLASSIFIER_CONF = {
+    "method": "AROW",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {
+        "string_rules": [
+            {"key": "*", "type": "str", "sample_weight": "bin", "global_weight": "bin"}
+        ],
+        "num_rules": [{"key": "*", "type": "num"}],
+    },
+}
+
+
+def _serve(engine, conf):
+    srv = EngineServer(engine, conf)
+    port = srv.start(0)
+    return srv, port
+
+
+@pytest.fixture()
+def classifier():
+    srv, port = _serve("classifier", CLASSIFIER_CONF)
+    with ClassifierClient("127.0.0.1", port, NAME) as c:
+        yield c, srv
+    srv.stop()
+
+
+def test_classifier_roundtrip(classifier):
+    c, _srv = classifier
+    n = c.train(
+        [
+            ["spam", Datum({"subject": "win money now"})],
+            ["ham", Datum({"subject": "meeting at noon"})],
+        ]
+        * 5
+    )
+    assert n == 10
+    results = c.classify([Datum({"subject": "win money"})])
+    assert len(results) == 1
+    best = max(results[0], key=lambda ls: ls[1])
+    assert best[0] == "spam"
+    labels = c.get_labels()
+    assert set(labels) == {"spam", "ham"}
+    assert c.set_label("neutral") is True
+    assert c.delete_label("neutral") is True
+    assert c.clear() is True
+    assert c.get_labels() == {}
+
+
+def test_builtins_and_save_load(classifier, tmp_path):
+    c, srv = classifier
+    srv.args.datadir = str(tmp_path)
+    import json
+
+    assert json.loads(c.get_config())["method"] == "AROW"
+    c.train([["a", Datum({"x": 1.0})], ["b", Datum({"x": -1.0})]])
+    status = c.get_status()
+    (node_status,) = status.values()
+    assert node_status["type"] == "classifier"
+    assert node_status["update_count"] >= 2
+    assert "RSS" in node_status
+    paths = c.save("m1")
+    assert len(paths) == 1 and list(paths.values())[0].endswith(".jubatus")
+    before = c.classify([Datum({"x": 1.0})])
+    assert c.clear()
+    assert c.load("m1") is True
+    after = c.classify([Datum({"x": 1.0})])
+    assert [r[:][0] for r in before] == [r[:][0] for r in after]
+    # standalone server: do_mix is a no-op returning False
+    assert c.do_mix() is False
+
+
+def test_regression_roundtrip():
+    srv, port = _serve(
+        "regression",
+        {
+            "method": "PA",
+            "parameter": {"sensitivity": 0.1, "regularization_weight": 3.0},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+        },
+    )
+    try:
+        with RegressionClient("127.0.0.1", port, NAME) as r:
+            data = [[float(2 * x), Datum({"x": float(x)})] for x in range(1, 30)]
+            assert r.train(data) == 29
+            (est,) = r.estimate([Datum({"x": 10.0})])
+            assert est == pytest.approx(20.0, rel=0.35)
+            assert r.clear() is True
+    finally:
+        srv.stop()
+
+
+def test_recommender_roundtrip():
+    srv, port = _serve(
+        "recommender",
+        {
+            "method": "inverted_index",
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+        },
+    )
+    try:
+        with RecommenderClient("127.0.0.1", port, NAME) as r:
+            assert r.update_row("r1", Datum({"a": 1.0, "b": 0.5}))
+            assert r.update_row("r2", Datum({"a": 0.9, "b": 0.6}))
+            assert r.update_row("r3", Datum({"a": -1.0, "c": 2.0}))
+            assert sorted(r.get_all_rows()) == ["r1", "r2", "r3"]
+            sims = r.similar_row_from_id("r1", 2)
+            assert sims[0][0] == "r1"
+            assert {s[0] for s in sims[:2]} == {"r1", "r2"}
+            assert r.calc_similarity(
+                Datum({"a": 1.0}), Datum({"a": 1.0})
+            ) == pytest.approx(1.0, abs=1e-5)
+            assert r.clear_row("r3")
+            assert sorted(r.get_all_rows()) == ["r1", "r2"]
+    finally:
+        srv.stop()
+
+
+def test_nearest_neighbor_roundtrip():
+    srv, port = _serve(
+        "nearest_neighbor",
+        {
+            "method": "euclid_lsh",
+            "parameter": {"hash_num": 128},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+        },
+    )
+    try:
+        with NearestNeighborClient("127.0.0.1", port, NAME) as nn:
+            nn.set_row("p1", Datum({"x": 0.0, "y": 0.0}))
+            nn.set_row("p2", Datum({"x": 1.0, "y": 0.0}))
+            nn.set_row("p3", Datum({"x": 10.0, "y": 10.0}))
+            got = nn.neighbor_row_from_datum(Datum({"x": 0.1, "y": 0.0}), 2)
+            assert got[0][0] == "p1"
+            assert {g[0] for g in got} == {"p1", "p2"}
+    finally:
+        srv.stop()
+
+
+def test_stat_roundtrip():
+    srv, port = _serve("stat", {"window_size": 100})
+    try:
+        with StatClient("127.0.0.1", port, NAME) as s:
+            for v in (1.0, 2.0, 3.0, 4.0):
+                assert s.push("k", v)
+            assert s.sum("k") == pytest.approx(10.0)
+            assert s.max("k") == pytest.approx(4.0)
+            assert s.min("k") == pytest.approx(1.0)
+            assert s.stddev("k") == pytest.approx(1.118, abs=1e-2)
+            assert s.moment("k", 1, 0.0) == pytest.approx(2.5)
+    finally:
+        srv.stop()
+
+
+def test_bandit_roundtrip():
+    srv, port = _serve(
+        "bandit",
+        {"method": "epsilon_greedy", "parameter": {"epsilon": 0.0,
+                                                   "assume_unrewarded": False}},
+    )
+    try:
+        with BanditClient("127.0.0.1", port, NAME) as b:
+            assert b.register_arm("a1")
+            assert b.register_arm("a2")
+            for _ in range(5):
+                arm = b.select_arm("p")
+                b.register_reward("p", arm, 1.0 if arm == "a1" else 0.0)
+            info = b.get_arm_info("p")
+            assert set(info) == {"a1", "a2"}
+            assert all(len(v) == 2 for v in info.values())
+            assert b.reset("p")
+    finally:
+        srv.stop()
+
+
+def test_weight_roundtrip():
+    srv, port = _serve(
+        "weight",
+        {"converter": {"num_rules": [{"key": "*", "type": "num"}]}},
+    )
+    try:
+        with WeightClient("127.0.0.1", port, NAME) as w:
+            feats = w.update(Datum({"x": 2.0}))
+            assert feats and feats[0][1] == pytest.approx(2.0)
+            feats = w.calc_weight(Datum({"x": 3.0}))
+            assert feats and feats[0][1] == pytest.approx(3.0)
+    finally:
+        srv.stop()
+
+
+def test_wrong_engine_method_404(classifier):
+    c, _ = classifier
+    from jubatus_tpu.rpc import RpcMethodNotFound
+
+    with pytest.raises(RpcMethodNotFound):
+        c.client.call("similar_row_from_id", NAME, "x", 3)
