@@ -37,7 +37,8 @@ class RpcClient:
         self.timeout = timeout
         self._sock: Optional[socket.socket] = None
         self._msgid = 0
-        self._lock = threading.Lock()
+        # RLock: call() holds it and calls close() on failure paths
+        self._lock = threading.RLock()
 
     # -- connection ----------------------------------------------------------
     def _connect(self) -> socket.socket:

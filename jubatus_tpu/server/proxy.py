@@ -1,0 +1,341 @@
+"""Query-routing proxy tier (≙ framework/proxy.{hpp,cpp} + proxy_common.{hpp,cpp}).
+
+The reference's ``juba<engine>_proxy`` binaries are async RPC servers whose
+methods are registered by routing class — random (1 active node), broadcast
+(all actives + reducer fold), cht (N ring successors of the key + reducer)
+(proxy.hpp:64-186,229-286) — with built-ins save/load/get_status/
+get_proxy_status (proxy.cpp:43-66). Member lookup reads the coordination
+store's ``actives`` list through a watch-invalidated cache (proxy_common.cpp:
+73-114, cached_zk). Sessions to backend servers live in a pool with expiry
+(proxy.hpp:502-593).
+
+Here one ``Proxy`` class serves any engine: the routing/aggregator table
+comes from ``framework.idl.SERVICES`` (what the reference bakes into the
+generated ``*_proxy.cpp``). Wire behavior matches: same method names, same
+leading cluster-name param, same reducer semantics, per-host failures
+tolerated as long as one backend answers (proxy.hpp:325-392).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from jubatus_tpu.coord import create_coordinator, membership
+from jubatus_tpu.coord.base import Coordinator, NodeInfo
+from jubatus_tpu.coord.cht import CHT
+from jubatus_tpu.framework.idl import INTERNAL, get_service
+from jubatus_tpu.rpc import aggregators
+from jubatus_tpu.rpc.client import RpcClient
+from jubatus_tpu.rpc.errors import HostError, MultiRpcError, RpcNoClient, RpcNoResult
+from jubatus_tpu.rpc.server import RpcServer
+from jubatus_tpu.version import __version__
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ProxyArgs:
+    """≙ proxy_argv (server_util.cpp:440-557). Same defaults: 4 worker
+    threads vs the server's 2, 10 s timeouts, session-pool knobs."""
+
+    engine: str = ""
+    rpc_port: int = 9199
+    listen_addr: str = ""
+    thread: int = 4
+    timeout: float = 10.0
+    coordinator: str = ""
+    coordinator_timeout: float = 10.0
+    interconnect_timeout: float = 10.0
+    session_pool_expire: float = 60.0   # --pool_expire
+    session_pool_size: int = 0          # --pool_size, 0 = unbounded
+    daemon: bool = False
+
+    @property
+    def bind_host(self) -> str:
+        return self.listen_addr or "0.0.0.0"
+
+    def flags_status(self) -> Dict[str, Any]:
+        return {f"argv.{f.name}": getattr(self, f.name)
+                for f in dataclasses.fields(self)}
+
+
+class MemberCache:
+    """Watch-invalidated actives cache (≙ cached_zk, common/cached_zk.hpp:
+    31-59): one entry per cluster name, cleared when the coordinator signals
+    a child change, with a TTL backstop for coordinators whose watches are
+    best-effort."""
+
+    def __init__(self, coord: Coordinator, engine: str, ttl: float = 2.0) -> None:
+        self._coord = coord
+        self._engine = engine
+        self._ttl = ttl
+        self._lock = threading.Lock()
+        self._cache: Dict[str, Tuple[float, List[NodeInfo]]] = {}
+        self._watched: set = set()
+
+    def actives(self, name: str) -> List[NodeInfo]:
+        now = time.monotonic()
+        with self._lock:
+            hit = self._cache.get(name)
+            if hit is not None and now - hit[0] < self._ttl:
+                return hit[1]
+        nodes = membership.get_all_actives(self._coord, self._engine, name)
+        with self._lock:
+            self._cache[name] = (now, nodes)
+            need_watch = name not in self._watched
+            if need_watch:
+                self._watched.add(name)
+        if need_watch:  # outside the lock: watchers may fire synchronously
+            path = f"{membership.actor_path(self._engine, name)}/actives"
+            try:
+                self._coord.watch_children(path, lambda _p, n=name: self.invalidate(n))
+            except NotImplementedError:
+                pass
+        return nodes
+
+    def invalidate(self, name: str) -> None:
+        with self._lock:
+            self._cache.pop(name, None)
+
+
+class _Session:
+    __slots__ = ("client", "last_used")
+
+    def __init__(self, client: RpcClient) -> None:
+        self.client = client
+        self.last_used = time.monotonic()
+
+
+class Proxy:
+    """One engine's routing proxy. listen → start → join, like the servers."""
+
+    def __init__(self, args: ProxyArgs, coord: Optional[Coordinator] = None) -> None:
+        if not args.engine:
+            raise ValueError("ProxyArgs.engine required")
+        self.args = args
+        self.engine = args.engine
+        self.coord = coord or create_coordinator(args.coordinator)
+        self.members = MemberCache(self.coord, self.engine)
+        self.rpc = RpcServer(timeout=args.timeout)
+        self.start_time = time.time()
+        self._pool: Dict[Tuple[str, int], _Session] = {}
+        self._pool_lock = threading.Lock()
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(8, args.thread * 4), thread_name_prefix="proxy-fanout"
+        )
+        self._stop_event = threading.Event()
+        # counters (proxy_common.cpp:126-182)
+        self._counters_lock = threading.Lock()
+        self.request_counts: Dict[str, int] = {}
+        self.forward_count = 0
+        self.forward_errors = 0
+        self._register_methods()
+
+    # -- session pool (proxy.hpp:502-593) ------------------------------------
+    def _client(self, node: NodeInfo) -> RpcClient:
+        key = (node.host, node.port)
+        with self._pool_lock:
+            sess = self._pool.get(key)
+            if sess is None:
+                sess = self._pool[key] = _Session(
+                    RpcClient(node.host, node.port,
+                              timeout=self.args.interconnect_timeout)
+                )
+            sess.last_used = time.monotonic()
+            return sess.client
+
+    def _expire_sessions(self) -> None:
+        horizon = time.monotonic() - self.args.session_pool_expire
+        with self._pool_lock:
+            for key in [k for k, s in self._pool.items() if s.last_used < horizon]:
+                self._pool.pop(key).client.close()
+            if self.args.session_pool_size > 0:
+                by_age = sorted(self._pool.items(), key=lambda kv: kv[1].last_used)
+                while len(by_age) > self.args.session_pool_size:
+                    key, sess = by_age.pop(0)
+                    sess.client.close()
+                    self._pool.pop(key, None)
+
+    # -- fan-out core (async_task, proxy.hpp:296-495) ------------------------
+    def _fan(
+        self,
+        nodes: Sequence[NodeInfo],
+        method: str,
+        args: Sequence[Any],
+        reducer: Callable[[Any, Any], Any],
+    ) -> Any:
+        """Call all nodes in parallel; fold successes left-to-right through
+        the reducer; per-host errors are tolerated unless every host fails
+        (proxy.hpp:325-392)."""
+        if not nodes:
+            raise RpcNoClient(f"no active {self.engine} servers")
+        with self._counters_lock:
+            self.forward_count += len(nodes)
+        if len(nodes) == 1:
+            return self._one(nodes[0], method, args)
+
+        def call(n: NodeInfo) -> Any:
+            return self._one(n, method, args)
+
+        futs = [(n, self._executor.submit(call, n)) for n in nodes]
+        results: List[Any] = []
+        errors: List[HostError] = []
+        for n, fut in futs:
+            try:
+                results.append(fut.result(timeout=self.args.timeout + 1.0))
+            except Exception as e:  # noqa: BLE001 — per-host failure is data
+                errors.append(HostError(n.host, n.port, e))
+        if errors:
+            with self._counters_lock:
+                self.forward_errors += len(errors)
+        if not results:
+            raise MultiRpcError(errors) if errors else RpcNoResult(method)
+        acc = results[0]
+        for r in results[1:]:
+            acc = reducer(acc, r)
+        return acc
+
+    def _one(self, node: NodeInfo, method: str, args: Sequence[Any]) -> Any:
+        try:
+            return self._client(node).call(method, *args)
+        except Exception:
+            # dead backend: drop its session and let the caller decide
+            with self._pool_lock:
+                sess = self._pool.pop((node.host, node.port), None)
+            if sess is not None:
+                sess.client.close()
+            self.members.invalidate(str(args[0]) if args else "")
+            raise
+
+    # -- routing handlers (register_async_{random,broadcast,cht}) -------------
+    def _count(self, method: str) -> None:
+        with self._counters_lock:
+            self.request_counts[method] = self.request_counts.get(method, 0) + 1
+
+    def _handler(self, name: str, routing: str, cht_n: int,
+                 reducer: Callable[[Any, Any], Any]) -> Callable[..., Any]:
+        def handle(*params: Any) -> Any:
+            self._count(name)
+            self._expire_sessions()
+            actives = self.members.actives(str(params[0]))
+            if routing == "broadcast":
+                nodes: Sequence[NodeInfo] = actives
+            elif routing == "cht":
+                if len(params) < 2:
+                    raise TypeError(f"{name}: cht routing needs a key param")
+                nodes = CHT(actives).find(str(params[1]), cht_n)
+            else:  # random (proxy.hpp:229-247)
+                nodes = [random.choice(actives)] if actives else []
+            return self._fan(nodes, name, params, reducer)
+
+        return handle
+
+    def _register(self, name: str, arity: int, routing: str,
+                  reducer: Callable[[Any, Any], Any], cht_n: int = 2) -> None:
+        self.rpc.register(name, self._handler(name, routing, cht_n, reducer),
+                          arity=arity)
+
+    def _register_methods(self) -> None:
+        for m in get_service(self.engine):
+            if m.routing == INTERNAL:
+                continue  # create_node_here etc. are server↔server only
+            self._register(m.name, len(m.args) + 1, m.routing,
+                           aggregators.BY_NAME.get(m.aggregator, aggregators.pass_),
+                           m.cht_n)
+        # built-ins (proxy.cpp:43-66; get_config routes like any analysis call)
+        self._register("get_config", 1, "random", aggregators.pass_)
+        self._register("save", 2, "broadcast", aggregators.merge)
+        self._register("load", 2, "broadcast", aggregators.all_and)
+        self._register("get_status", 1, "broadcast", aggregators.merge)
+        self._register("do_mix", 1, "random", aggregators.pass_)
+        self.rpc.register("get_proxy_status", self.get_proxy_status, arity=1)
+
+    # -- own status (proxy_common::get_status) --------------------------------
+    def get_proxy_status(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
+        node = NodeInfo(self.args.bind_host, self.rpc.port or self.args.rpc_port)
+        with self._counters_lock:
+            st: Dict[str, Any] = {
+                "timestamp": int(time.time()),
+                "uptime": int(time.time() - self.start_time),
+                "type": f"{self.engine}_proxy",
+                "version": __version__,
+                "forward_count": self.forward_count,
+                "forward_errors": self.forward_errors,
+                "session_pool_size": len(self._pool),
+            }
+            st.update({f"request.{k}": v for k, v in self.request_counts.items()})
+        st.update(self.args.flags_status())
+        return {node.name: st}
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self, port: Optional[int] = None) -> int:
+        actual = self.rpc.serve_background(
+            port if port is not None else self.args.rpc_port,
+            nthreads=self.args.thread,
+            host=self.args.bind_host,
+        )
+        self.args.rpc_port = actual
+        try:
+            membership.register_proxy(self.coord, self.args.bind_host, actual)
+        except Exception:  # noqa: BLE001 — registry is informational for proxies
+            log.debug("proxy registration failed", exc_info=True)
+        log.info("%s proxy listening on %s:%d", self.engine, self.args.bind_host, actual)
+        return actual
+
+    def join(self) -> None:
+        self._stop_event.wait()
+
+    def stop(self) -> None:
+        self.rpc.stop()
+        with self._pool_lock:
+            for sess in self._pool.values():
+                sess.client.close()
+            self._pool.clear()
+        self._executor.shutdown(wait=False)
+        self.coord.close()
+        self._stop_event.set()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m jubatus_tpu.server.proxy <engine> -z <coord> [-p PORT]``
+    (≙ juba<engine>_proxy binaries)."""
+    import argparse
+    import signal
+    import sys
+
+    p = argparse.ArgumentParser(prog="jubatus_tpu.server.proxy")
+    p.add_argument("engine")
+    p.add_argument("-p", "--rpc-port", type=int, default=9199)
+    p.add_argument("-b", "--listen-addr", default="")
+    p.add_argument("-c", "--thread", type=int, default=4)
+    p.add_argument("-t", "--timeout", type=float, default=10.0)
+    p.add_argument("-z", "--coordinator", required=True)
+    p.add_argument("--interconnect-timeout", type=float, default=10.0)
+    p.add_argument("--pool-expire", dest="session_pool_expire", type=float, default=60.0)
+    p.add_argument("--pool-size", dest="session_pool_size", type=int, default=0)
+    ns = p.parse_args(argv)
+    args = ProxyArgs(**{f.name: getattr(ns, f.name)
+                        for f in dataclasses.fields(ProxyArgs)
+                        if hasattr(ns, f.name)})
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s %(levelname)s [{args.engine}_proxy:{args.rpc_port}] %(message)s",
+    )
+    proxy = Proxy(args)
+    signal.signal(signal.SIGTERM, lambda *_: proxy.stop())
+    signal.signal(signal.SIGINT, lambda *_: proxy.stop())
+    proxy.start()
+    proxy.join()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

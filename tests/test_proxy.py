@@ -1,0 +1,185 @@
+"""Proxy tier tests (≙ the routing behavior baked into generated *_proxy.cpp
+and exercised by jubatest cluster runs — here in-process).
+
+Covers: random routing reaches exactly one backend, broadcast folds with the
+method's aggregator, cht routing pins a key to the same backend(s) across
+calls, built-ins (save broadcast+merge, get_status merge, get_proxy_status),
+dead-backend tolerance, and clients talking *through* the proxy unchanged
+(same wire protocol either way, client/common/client.hpp).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from jubatus_tpu.client import ClassifierClient, Datum, StatClient
+from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+from jubatus_tpu.server import EngineServer
+from jubatus_tpu.server.args import ServerArgs
+from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+NAME = "pcl"
+
+CLASSIFIER_CONF = {
+    "method": "PA",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+
+
+def _boot(engine, conf, n, store):
+    servers = []
+    for _ in range(n):
+        args = ServerArgs(
+            engine=engine, coordinator="(shared)", name=NAME,
+            listen_addr="127.0.0.1", interval_sec=1e9, interval_count=1 << 30,
+        )
+        srv = EngineServer(engine, conf, args, coord=MemoryCoordinator(store))
+        srv.start(0)
+        servers.append(srv)
+    return servers
+
+
+def _proxy(engine, store, **kw):
+    args = ProxyArgs(engine=engine, listen_addr="127.0.0.1", **kw)
+    p = Proxy(args, coord=MemoryCoordinator(store))
+    p.start(0)
+    return p
+
+
+@pytest.fixture()
+def classifier_cluster():
+    store = _Store()
+    servers = _boot("classifier", CLASSIFIER_CONF, 3, store)
+    proxy = _proxy("classifier", store)
+    yield servers, proxy, store
+    proxy.stop()
+    for s in servers:
+        s.stop()
+
+
+def test_random_routing_single_backend(classifier_cluster):
+    servers, proxy, _ = classifier_cluster
+    c = ClassifierClient("127.0.0.1", proxy.args.rpc_port, NAME)
+    # train goes to exactly ONE backend per call (random routing)
+    assert c.train([["pos", Datum({"x": 1.0})]]) == 1
+    total = sum(s.driver.update_count for s in servers)
+    assert total == 1
+    c.close()
+
+
+def test_broadcast_clear_reaches_all(classifier_cluster):
+    servers, proxy, _ = classifier_cluster
+    # seed every backend directly
+    for s in servers:
+        d = ClassifierClient("127.0.0.1", s.args.rpc_port, NAME)
+        d.train([["pos", Datum({"x": 1.0})]])
+        d.close()
+    assert all(s.driver.update_count == 1 for s in servers)
+    c = ClassifierClient("127.0.0.1", proxy.args.rpc_port, NAME)
+    assert c.clear() is True  # all_and over 3 backends
+    for s in servers:
+        d = ClassifierClient("127.0.0.1", s.args.rpc_port, NAME)
+        assert d.get_labels() == {}
+        d.close()
+    c.close()
+
+
+def test_get_status_merges_all_nodes(classifier_cluster):
+    servers, proxy, _ = classifier_cluster
+    c = ClassifierClient("127.0.0.1", proxy.args.rpc_port, NAME)
+    st = c.get_status()
+    assert len(st) == 3  # one entry per backend, merged
+    assert {int(k.rsplit("_", 1)[1]) for k in st} == {
+        s.args.rpc_port for s in servers
+    }
+    c.close()
+
+
+def test_save_broadcast_merge(classifier_cluster, tmp_path):
+    servers, proxy, _ = classifier_cluster
+    for s in servers:
+        s.args.datadir = str(tmp_path)
+    c = ClassifierClient("127.0.0.1", proxy.args.rpc_port, NAME)
+    paths = c.save("m1")
+    assert len(paths) == 3  # per-server path map, merged (proxy.cpp:48-54)
+    c.close()
+
+
+def test_proxy_status_counters(classifier_cluster):
+    _, proxy, _ = classifier_cluster
+    c = ClassifierClient("127.0.0.1", proxy.args.rpc_port, NAME)
+    c.train([["a", Datum({"x": 1.0})]])
+    c.get_labels()
+    st = c.get_proxy_status()
+    (node_st,) = st.values()
+    assert node_st["type"] == "classifier_proxy"
+    assert node_st["request.train"] == 1
+    assert node_st["request.get_labels"] == 1
+    assert node_st["forward_count"] >= 2
+    c.close()
+
+
+def test_dead_backend_tolerated_on_broadcast(classifier_cluster):
+    servers, proxy, store = classifier_cluster
+    # kill one backend but leave its actives entry: proxy must still answer
+    dead = servers.pop()
+    dead.rpc.stop()
+    c = ClassifierClient("127.0.0.1", proxy.args.rpc_port, NAME)
+    st = c.get_status()
+    assert len(st) == 2  # merged over the 2 live nodes, error tolerated
+    c.close()
+    dead.stop()
+
+
+def test_no_actives_raises(tmp_path):
+    store = _Store()
+    proxy = _proxy("classifier", store)
+    c = ClassifierClient("127.0.0.1", proxy.args.rpc_port, NAME, timeout=2.0)
+    with pytest.raises(Exception) as ei:
+        c.get_labels()
+    assert "no active" in str(ei.value)
+    c.close()
+    proxy.stop()
+
+
+def test_cht_routing_pins_key():
+    """stat push/sum route by key: the same key must land on the same
+    backend every time (stat_proxy.cpp:21-36, #@cht(1))."""
+    store = _Store()
+    servers = _boot("stat", {"window_size": 64}, 3, store)
+    proxy = _proxy("stat", store)
+    try:
+        c = StatClient("127.0.0.1", proxy.args.rpc_port, NAME)
+        for v in (1.0, 2.0, 3.0):
+            c.push("alpha", v)
+        # all three pushes hit one backend; sum through the proxy sees them
+        assert c.sum("alpha") == pytest.approx(6.0)
+        holders = [s for s in servers if s.driver.update_count == 3]
+        assert len(holders) == 1
+        assert all(s.driver.update_count in (0, 3) for s in servers)
+        # a different key may land elsewhere but must also be consistent
+        c.push("beta", 10.0)
+        assert c.sum("beta") == pytest.approx(10.0)
+        c.close()
+    finally:
+        proxy.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_member_cache_invalidation():
+    """New server joining becomes visible to the proxy (cached_zk watch or
+    TTL refresh)."""
+    store = _Store()
+    servers = _boot("classifier", CLASSIFIER_CONF, 1, store)
+    proxy = _proxy("classifier", store)
+    try:
+        assert len(proxy.members.actives(NAME)) == 1
+        servers += _boot("classifier", CLASSIFIER_CONF, 1, store)
+        proxy.members.invalidate(NAME)
+        assert len(proxy.members.actives(NAME)) == 2
+    finally:
+        proxy.stop()
+        for s in servers:
+            s.stop()
