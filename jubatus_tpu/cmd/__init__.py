@@ -1,0 +1,26 @@
+"""Ops CLIs (≙ jubatus/server/cmd/ + jubavisor/, SURVEY.md §2.6).
+
+- ``jubactl``    — cluster control: start/stop via supervisors, save/load/
+                   status via the servers themselves (cmd/jubactl.cpp).
+- ``jubaconfig`` — validate + write/read/delete/list engine configs in the
+                   coordination store (cmd/jubaconfig.cpp).
+- ``jubaconv``   — offline json→datum→fv conversion debugger
+                   (cmd/jubaconv.cpp).
+- ``jubavisor``  — per-host process supervisor daemon, RPC-controlled
+                   (jubavisor/jubavisor.{hpp,cpp}).
+
+Each module exposes ``main(argv)`` and runs via
+``python -m jubatus_tpu.cmd.<tool>``. The coordinator location comes from
+``-z`` or the ``ZK``/``JUBATUS_COORDINATOR`` environment variables (the
+reference honors ``ZK``, jubactl.cpp:121-127).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def resolve_coordinator(flag: str) -> Optional[str]:
+    """-z flag, else $JUBATUS_COORDINATOR, else $ZK (reference order)."""
+    return flag or os.environ.get("JUBATUS_COORDINATOR") or os.environ.get("ZK")

@@ -1,0 +1,159 @@
+"""Cluster control CLI (≙ cmd/jubactl.cpp).
+
+    jubactl -c start  -t classifier -s jubaclassifier -n c1 -N 4 -z /shared
+    jubactl -c stop   -t classifier -s jubaclassifier -n c1 -z /shared
+    jubactl -c save   -t classifier -n c1 -z /shared [-i model_id]
+    jubactl -c load   -t classifier -n c1 -z /shared [-i model_id]
+    jubactl -c status -t classifier -n c1 -z /shared
+
+start/stop fan out to every jubavisor under /jubatus/supervisors,
+distributing N processes round-robin (N/visors each, remainder to the
+first ones; N=0 → one per visor — jubactl.cpp:133-142,240-260). save/load
+RPC every registered server of the cluster (send2server). status prints
+the nodes/actives registries. Server flags (-C/-T/-D/-X/-S/-I/...) are
+forwarded to visor-spawned processes (jubactl.cpp:90-110).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Dict, List, Optional
+
+from jubatus_tpu.cmd import resolve_coordinator
+from jubatus_tpu.coord import create_coordinator, membership
+from jubatus_tpu.coord.base import Coordinator, NodeInfo
+from jubatus_tpu.rpc.client import RpcClient
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="jubactl")
+    p.add_argument("-c", "--cmd", required=True,
+                   choices=["start", "stop", "save", "load", "status"])
+    p.add_argument("-s", "--server", default="",
+                   help="server name forwarded to jubavisor "
+                        "(jubaclassifier or plain engine name)")
+    p.add_argument("-t", "--type", required=True, help="engine type")
+    p.add_argument("-n", "--name", required=True, help="cluster name")
+    p.add_argument("-N", "--num", type=int, default=0,
+                   help="total processes across the cluster (0 = one per visor)")
+    p.add_argument("-z", "--coordinator", default="")
+    p.add_argument("-i", "--id", default="", help="[save|load] model id")
+    # forwarded server flags (jubactl.cpp:90-110)
+    p.add_argument("-B", "--listen-if", dest="listen_if", default="")
+    p.add_argument("-C", "--thread", type=int, default=2)
+    p.add_argument("-T", "--timeout", type=int, default=10)
+    p.add_argument("-D", "--datadir", default="/tmp")
+    p.add_argument("-L", "--logdir", default="")
+    p.add_argument("-X", "--mixer", default="linear_mixer")
+    p.add_argument("-S", "--interval-sec", dest="interval_sec", type=int, default=16)
+    p.add_argument("-I", "--interval-count", dest="interval_count", type=int, default=512)
+    p.add_argument("-Z", "--zookeeper-timeout", dest="zookeeper_timeout",
+                   type=int, default=10)
+    p.add_argument("-R", "--interconnect-timeout", dest="interconnect_timeout",
+                   type=int, default=10)
+    return p
+
+
+def _visors(coord: Coordinator) -> List[NodeInfo]:
+    out = []
+    for child in coord.list(membership.SUPERVISOR_BASE):
+        try:
+            out.append(NodeInfo.from_name(child))
+        except (ValueError, IndexError):
+            continue
+    return out
+
+
+def send2supervisor(coord: Coordinator, cmd: str, engine: str, name: str,
+                    num: int, argv: Dict[str, Any]) -> int:
+    """Distribute start/stop over all visors (jubactl.cpp:240-280)."""
+    visors = _visors(coord)
+    if not visors:
+        print(f"no supervisor to {cmd} {name}", file=sys.stderr)
+        return -1
+    total = num if num > 0 else len(visors)
+    per, extra = divmod(total, len(visors))
+    rc = 0
+    for i, visor in enumerate(visors):
+        n = per + (1 if i < extra else 0)
+        if n == 0 and cmd == "start":
+            continue
+        print(f"sending {cmd} / {name} to {visor.name}...", end="", flush=True)
+        with RpcClient(visor.host, visor.port, timeout=10.0) as c:
+            if cmd == "start":
+                r = c.call("start", name, n, argv)
+            else:
+                r = c.call("stop", name, n)
+        print("ok." if r == 0 else "failed.")
+        rc = rc or r
+    return rc
+
+
+def send2server(coord: Coordinator, cmd: str, engine: str, name: str,
+                model_id: str) -> int:
+    """save/load on every registered server of the cluster (send2server)."""
+    nodes = membership.get_all_nodes(coord, engine, name)
+    if not nodes:
+        print(f"no server of {engine}/{name}", file=sys.stderr)
+        return -1
+    rc = 0
+    for node in nodes:
+        print(f"sending {cmd} / {name} to {node.name}...", end="", flush=True)
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                r = c.call(cmd, name, model_id)
+            ok = bool(r)
+        except Exception as e:  # noqa: BLE001 — report per-host, keep going
+            print(f"failed. ({e})")
+            rc = -1
+            continue
+        print("ok." if ok else "failed.")
+        rc = rc if ok else -1
+    return rc
+
+
+def show_status(coord: Coordinator, engine: str, name: str) -> int:
+    nodes = membership.get_all_nodes(coord, engine, name)
+    actives = {n.name for n in membership.get_all_actives(coord, engine, name)}
+    print(f"{engine}/{name}: {len(nodes)} node(s), {len(actives)} active")
+    for node in nodes:
+        mark = "active" if node.name in actives else "standby"
+        print(f"  {node.name}  [{mark}]")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ns = _parser().parse_args(argv)
+    spec = resolve_coordinator(ns.coordinator)
+    if not spec:
+        print("no coordinator: pass -z or set JUBATUS_COORDINATOR/ZK",
+              file=sys.stderr)
+        return 1
+    coord = create_coordinator(spec)
+    try:
+        if ns.cmd == "status":
+            return show_status(coord, ns.type, ns.name)
+        if ns.cmd in ("start", "stop"):
+            server = ns.server or ns.type
+            name = f"{server}/{ns.name}"
+            server_argv = {
+                "listen_if": ns.listen_if, "thread": ns.thread,
+                "timeout": ns.timeout, "datadir": ns.datadir,
+                "logdir": ns.logdir, "mixer": ns.mixer,
+                "interval_sec": ns.interval_sec,
+                "interval_count": ns.interval_count,
+                "zookeeper_timeout": ns.zookeeper_timeout,
+                "interconnect_timeout": ns.interconnect_timeout,
+            } if ns.cmd == "start" else {}
+            return send2supervisor(coord, ns.cmd, ns.type, name, ns.num,
+                                   server_argv)
+        # save / load ('name' is the default id, jubactl.cpp:144-149)
+        model_id = ns.id or ns.name
+        return send2server(coord, ns.cmd, ns.type, ns.name, model_id)
+    finally:
+        coord.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: process-level integration tests (forked servers)"
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -1,0 +1,154 @@
+"""Ops tooling tests (≙ the reference's jubavisor_test + manual CLI flows).
+
+jubaconfig/jubaconv run fully in-process; the jubavisor/jubactl integration
+boots a REAL visor which forks a REAL server subprocess (the reference's
+process-level test tier, clustering_test.cpp fork_process pattern).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import sys
+import time
+
+import pytest
+
+from jubatus_tpu.cmd import jubaconfig, jubaconv
+from jubatus_tpu.coord import create_coordinator, membership
+
+CONF = {
+    "method": "PA",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+
+
+# -- jubaconfig ---------------------------------------------------------------
+
+
+def test_jubaconfig_roundtrip(tmp_path, capsys):
+    conf_file = tmp_path / "conf.json"
+    conf_file.write_text(json.dumps(CONF))
+    coord_dir = str(tmp_path / "coord")
+    base = ["-z", coord_dir, "-t", "classifier", "-n", "c1"]
+    assert jubaconfig.main(["-c", "write", "-f", str(conf_file)] + base) == 0
+    assert jubaconfig.main(["-c", "read"] + base) == 0
+    out = capsys.readouterr().out
+    assert '"method": "PA"' in out
+    assert jubaconfig.main(["-c", "list", "-z", coord_dir]) == 0
+    assert "classifier/c1" in capsys.readouterr().out
+    assert jubaconfig.main(["-c", "delete"] + base) == 0
+    assert jubaconfig.main(["-c", "read"] + base) == 1  # gone
+
+
+def test_jubaconfig_rejects_bad_json(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    rc = jubaconfig.main(["-c", "write", "-f", str(bad), "-z",
+                          str(tmp_path / "coord"), "-t", "classifier", "-n", "x"])
+    assert rc == 1
+
+
+def test_jubaconfig_rejects_unknown_engine(tmp_path):
+    f = tmp_path / "ok.json"
+    f.write_text("{}")
+    rc = jubaconfig.main(["-c", "write", "-f", str(f), "-z",
+                          str(tmp_path / "coord"), "-t", "nonsense", "-n", "x"])
+    assert rc == 1
+
+
+# -- jubaconv -----------------------------------------------------------------
+
+
+def test_jubaconv_json_to_datum():
+    out = io.StringIO()
+    rc = jubaconv.main(["-o", "datum"],
+                       stdin=io.StringIO('{"user": "alice", "age": 31, '
+                                         '"tags": ["a", "b"], '
+                                         '"meta": {"ok": true}}'),
+                       stdout=out)
+    assert rc == 0
+    doc = json.loads(out.getvalue())
+    assert ["user", "alice"] in doc["string_values"]
+    assert ["tags[0]", "a"] in doc["string_values"]
+    assert ["age", 31.0] in doc["num_values"]
+    assert ["meta/ok", 1.0] in doc["num_values"]
+
+
+def test_jubaconv_datum_to_fv(tmp_path):
+    conf = tmp_path / "conv.json"
+    conf.write_text(json.dumps(CONF))
+    out = io.StringIO()
+    rc = jubaconv.main(["-i", "datum", "-o", "fv", "-c", str(conf)],
+                       stdin=io.StringIO('{"num_values": [["x", 2.0]]}'),
+                       stdout=out)
+    assert rc == 0
+    assert "x" in out.getvalue()
+    assert "2" in out.getvalue()
+
+
+def test_jubaconv_fv_requires_conf():
+    rc = jubaconv.main(["-o", "fv"], stdin=io.StringIO("{}"),
+                       stdout=io.StringIO())
+    assert rc == 1
+
+
+# -- jubavisor + jubactl (process-level integration) --------------------------
+
+
+@pytest.mark.slow
+def test_visor_spawns_and_jubactl_controls(tmp_path):
+    from jubatus_tpu.cmd import jubactl
+    from jubatus_tpu.cmd.jubavisor import Jubavisor
+
+    coord_dir = str(tmp_path / "coord")
+    conf_file = tmp_path / "conf.json"
+    conf_file.write_text(json.dumps(CONF))
+    assert jubaconfig.main(["-c", "write", "-f", str(conf_file),
+                            "-z", coord_dir, "-t", "classifier", "-n", "v1"]) == 0
+
+    visor = Jubavisor(coord_dir, port=0, max_children=3,
+                      logfile=str(tmp_path / "children.log"))
+    visor.start(0)
+    try:
+        view = create_coordinator(coord_dir)
+        # jubactl start → visor spawns one real server subprocess
+        rc = jubactl.main(["-c", "start", "-t", "classifier",
+                           "-s", "jubaclassifier", "-n", "v1", "-N", "1",
+                           "-z", coord_dir, "-S", "1000000", "-I", "1000000000",
+                           "-D", str(tmp_path)])
+        assert rc == 0
+        assert visor.status() == {"jubaclassifier/v1": [visor.port + 1]}
+        # wait for the child to boot and register (jax import is slow)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if membership.get_all_nodes(view, "classifier", "v1"):
+                break
+            time.sleep(0.5)
+        nodes = membership.get_all_nodes(view, "classifier", "v1")
+        assert len(nodes) == 1, "server child never registered"
+
+        # train through it, then jubactl save
+        from jubatus_tpu.client import ClassifierClient, Datum
+
+        with ClassifierClient(nodes[0].host, nodes[0].port, "v1",
+                              timeout=30.0) as c:
+            assert c.train([["pos", Datum({"x": 1.0})]]) == 1
+        assert jubactl.main(["-c", "save", "-t", "classifier", "-n", "v1",
+                             "-z", coord_dir, "-i", "snap"]) == 0
+        saved = list(tmp_path.glob("*_classifier_snap.jubatus"))
+        assert len(saved) == 1
+
+        # jubactl status shows the node
+        assert jubactl.main(["-c", "status", "-t", "classifier", "-n", "v1",
+                             "-z", coord_dir]) == 0
+
+        # jubactl stop → visor kills the child, port recycled
+        assert jubactl.main(["-c", "stop", "-t", "classifier",
+                             "-s", "jubaclassifier", "-n", "v1",
+                             "-z", coord_dir]) == 0
+        assert visor.status() == {}
+        view.close()
+    finally:
+        visor.stop()
