@@ -1,0 +1,29 @@
+"""IDL compiler (≙ tools/jenerator/, OCaml — rebuilt in Python).
+
+The reference generates server bindings, proxy routing tables, and client
+libraries for five languages from msgpack-IDL files with three decorator
+groups per RPC — routing / lock / aggregator
+(tools/jenerator/src/syntax.ml:41-66, README.rst:34-47). Here:
+
+- ``parser``  — parse the same .idl dialect into an AST,
+- ``emit``    — emit the framework's routing table (framework/idl.py
+  SERVICES entries) and typed Python client modules.
+
+The checked-in ``framework.idl`` table is cross-validated against the
+reference .idl files by tests/test_codegen.py, which replaces the
+reference's build-time codegen step with a parity test.
+"""
+
+from jubatus_tpu.codegen.parser import (  # noqa: F401
+    IdlFile,
+    Message,
+    MethodDecl,
+    Service,
+    parse_idl,
+    parse_idl_file,
+)
+from jubatus_tpu.codegen.emit import (  # noqa: F401
+    emit_python_client,
+    emit_service_table,
+    to_methods,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from jubatus_tpu.codegen.emit import main
+
+sys.exit(main())

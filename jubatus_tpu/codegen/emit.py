@@ -1,0 +1,135 @@
+"""Emitters (≙ jenerator's cpp.ml/python.ml backends, Python-targeted).
+
+``to_methods``         — AST service → framework.idl Method tuple (the
+                         routing table the server/proxy/client consume).
+``emit_service_table`` — source text for a SERVICES entry.
+``emit_python_client`` — a standalone typed client module for one service,
+                         mirroring the reference's generated clients
+                         (client/common/client.hpp base + per-RPC methods).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from jubatus_tpu.codegen.parser import IdlFile, MethodDecl, Service
+from jubatus_tpu.framework.idl import Method
+
+
+def to_methods(service: Service) -> Tuple[Method, ...]:
+    out = []
+    for d in service.methods:
+        out.append(Method(
+            name=d.name,
+            args=tuple(a.name for a in d.args),
+            routing=d.routing,
+            cht_n=d.cht_n,
+            lock={"update": "update", "analysis": "analysis"}.get(d.lock, "nolock"),
+            aggregator=d.aggregator,
+        ))
+    return tuple(out)
+
+
+def emit_service_table(service: Service) -> str:
+    """SERVICES-entry source for framework/idl.py."""
+    lines = [f'    "{service.name}": (']
+    for d in service.methods:
+        inner = ", ".join(f'"{a.name}"' for a in d.args)
+        args = f"({inner},)" if len(d.args) == 1 else f"({inner})"
+        parts = [f'"{d.name}"', args, d.routing.upper()
+                 if d.routing in ("random", "broadcast", "cht") else '"internal"']
+        if d.routing == "cht":
+            parts.append(str(d.cht_n))
+        parts.append(f'lock="{d.lock}"')
+        if d.aggregator != "pass":
+            parts.append(f'agg="{d.aggregator}"')
+        lines.append(f"        _m({', '.join(parts)}),")
+    lines.append("    ),")
+    return "\n".join(lines)
+
+
+def _py_type(idl_type: str) -> str:
+    """IDL type → Python annotation (documentation only; wire is msgpack)."""
+    prim = {"string": "str", "int": "int", "long": "int", "ulong": "int",
+            "uint": "int", "short": "int", "ushort": "int", "byte": "int",
+            "double": "float", "float": "float", "bool": "bool",
+            "datum": "Datum", "void": "None", "raw": "bytes"}
+    t = idl_type.strip()
+    if t in prim:
+        return prim[t]
+    if t.startswith("list<") and t.endswith(">"):
+        return f"List[{_py_type(t[5:-1])}]"
+    if t.startswith("map<") and t.endswith(">"):
+        k, _, v = t[4:-1].partition(",")
+        return f"Dict[{_py_type(k)}, {_py_type(v)}]"
+    if t.startswith("tuple<") and t.endswith(">"):
+        inner = ", ".join(_py_type(x) for x in t[6:-1].split(","))
+        return f"Tuple[{inner}]"
+    return "Any"  # message types travel as msgpack lists
+
+
+def emit_python_client(idl: IdlFile, service_name: str) -> str:
+    """A generated, static, typed client module (≙ jenerator python.ml)."""
+    svc = idl.service(service_name)
+    cls = service_name.title().replace("_", "")
+    out = [
+        f'"""Generated {service_name} client — jubatus_tpu.codegen, from '
+        f'{service_name}.idl. Do not edit."""',
+        "",
+        "from __future__ import annotations",
+        "",
+        "from typing import Any, Dict, List, Tuple",
+        "",
+        "from jubatus_tpu.client import ClientBase",
+        "from jubatus_tpu.core.datum import Datum  # noqa: F401",
+        "",
+        "",
+        f"class {cls}Client(ClientBase):",
+        f'    ENGINE = "{service_name}"',
+        "",
+    ]
+    for d in svc.methods:
+        params = "".join(
+            f", {a.name}: {_py_type(a.type)}" for a in d.args
+        )
+        ret = _py_type(d.return_type)
+        call_args = "".join(f", {a.name}" for a in d.args)
+        out += [
+            f"    def {d.name}(self{params}) -> {ret}:",
+            f'        """#{d.routing}'
+            + (f"({d.cht_n})" if d.routing == "cht" else "")
+            + f" #{d.lock} #{d.aggregator}\"\"\"",
+            f'        return self.client.call("{d.name}", self.name{call_args})',
+            "",
+        ]
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m jubatus_tpu.codegen <file.idl> [--client SERVICE |
+    --table SERVICE]`` — prints generated source to stdout."""
+    import argparse
+    import sys
+
+    from jubatus_tpu.codegen.parser import parse_idl_file
+
+    p = argparse.ArgumentParser(prog="jubatus_tpu.codegen")
+    p.add_argument("idl")
+    p.add_argument("--client", default="", metavar="SERVICE")
+    p.add_argument("--table", default="", metavar="SERVICE")
+    ns = p.parse_args(argv)
+    idl = parse_idl_file(ns.idl)
+    if ns.client:
+        sys.stdout.write(emit_python_client(idl, ns.client))
+    elif ns.table:
+        sys.stdout.write(emit_service_table(idl.service(ns.table)))
+    else:
+        for svc in idl.services:
+            sys.stdout.write(emit_service_table(svc) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

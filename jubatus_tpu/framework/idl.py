@@ -119,7 +119,10 @@ SERVICES: Dict[str, Tuple[Method, ...]] = {
     ),
     # burst.idl
     "burst": (
-        _m("add_documents", ("data",), BROADCAST, lock="update", agg="add"),
+        # broadcast to every node (each processes only its CHT-assigned
+        # keywords); the reply is the first node's count — #@pass, NOT a sum
+        # (burst.idl:40-41, burst_proxy.cpp:21-23)
+        _m("add_documents", ("data",), BROADCAST, lock="update", agg="pass"),
         _m("get_result", ("keyword",), CHT, 2, "analysis"),
         _m("get_result_at", ("keyword", "pos"), CHT, 2, "analysis"),
         _m("get_all_bursted_results", (), BROADCAST, lock="analysis", agg="merge"),

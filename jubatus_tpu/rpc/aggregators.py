@@ -1,7 +1,9 @@
 """Fan-out reducers (≙ framework/aggregators.hpp:27-63).
 
 Used by the proxy's broadcast/cht routes and by RpcMClient.call_fold. The IDL
-decorators #@merge/#@concat/#@pass/#@add/#@all_and/#@all_or name these.
+decorators #@merge/#@concat/#@pass/#@all_and/#@all_or name these; `add`
+exists in the reference's aggregator library (aggregators.hpp:51) but no
+shipped .idl uses it.
 """
 
 from __future__ import annotations

@@ -1,0 +1,127 @@
+"""Codegen tests: parse the REAL reference .idl files and cross-validate the
+checked-in routing table (framework/idl.py SERVICES) against them — the
+parity check that replaces the reference's build-time jenerator step.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from jubatus_tpu.codegen import (
+    emit_python_client,
+    emit_service_table,
+    parse_idl,
+    to_methods,
+)
+from jubatus_tpu.codegen.parser import parse_reference_idls
+from jubatus_tpu.framework.idl import SERVICES
+
+REFERENCE_IDL_DIR = "/root/reference/jubatus/server/server"
+
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_IDL_DIR), reason="reference tree not mounted"
+)
+
+SAMPLE = """
+message labeled_datum {
+  0: string label
+  1: datum data
+}
+
+service classifier {
+  #- doc line
+  #@random #@nolock #@pass
+  int train(0: list<labeled_datum> data)
+
+  #@cht(1) #@update #@all_and
+  bool push(0: string key, 1: double value)
+
+  #@cht #@analysis #@pass
+  map<string, ulong> get_labels()
+
+  #@broadcast #@update #@all_and
+  bool clear()
+}
+"""
+
+
+def test_parse_sample():
+    idl = parse_idl(SAMPLE)
+    assert [m.name for m in idl.messages] == ["labeled_datum"]
+    assert idl.messages[0].fields[1].type == "datum"
+    svc = idl.service("classifier")
+    train, push, get_labels, clear = svc.methods
+    assert (train.routing, train.lock, train.aggregator) == ("random", "nolock", "pass")
+    assert train.return_type == "int"
+    assert train.args[0].type == "list<labeled_datum>"
+    assert (push.routing, push.cht_n) == ("cht", 1)
+    assert get_labels.cht_n == 2  # bare #@cht defaults to 2
+    assert get_labels.return_type == "map<string, ulong>"
+    assert (clear.routing, clear.aggregator) == ("broadcast", "all_and")
+
+
+def test_message_alias():
+    idl = parse_idl('message node("jubatus::core::graph::node_info") {\n'
+                    "  0: string prop\n}\n")
+    assert idl.messages[0].alias == "jubatus::core::graph::node_info"
+
+
+def test_to_methods_and_emit():
+    idl = parse_idl(SAMPLE)
+    methods = to_methods(idl.service("classifier"))
+    assert methods[0].name == "train"
+    assert methods[1].routing == "cht"
+    table = emit_service_table(idl.service("classifier"))
+    assert '"classifier": (' in table
+    assert '_m("push", ("key", "value"), CHT, 1' in table
+
+
+def test_emit_python_client_compiles():
+    idl = parse_idl(SAMPLE)
+    src = emit_python_client(idl, "classifier")
+    ns: dict = {}
+    exec(compile(src, "<generated>", "exec"), ns)  # noqa: S102 — own output
+    cls = ns["ClassifierClient"]
+    assert cls.ENGINE == "classifier"
+    assert hasattr(cls, "train") and hasattr(cls, "clear")
+
+
+# -- parity with the reference ------------------------------------------------
+
+
+@needs_reference
+def test_all_reference_idls_parse():
+    idls = parse_reference_idls(REFERENCE_IDL_DIR)
+    assert set(idls) == set(SERVICES)
+    for engine, idl in idls.items():
+        assert idl.service(engine).methods, engine
+
+
+@needs_reference
+def test_checked_in_table_matches_reference_idls():
+    """Every method in framework/idl.py must match the reference .idl:
+    same name set, same arity, same routing class, same cht fan-out, same
+    aggregator. (Lock decorators intentionally differ: our table records
+    model-lock semantics, the IDL's #@nolock is an RPC-layer detail.)"""
+    idls = parse_reference_idls(REFERENCE_IDL_DIR)
+    mismatches = []
+    for engine, methods in SERVICES.items():
+        ref = {d.name: d for d in idls[engine].service(engine).methods}
+        ours = {m.name: m for m in methods}
+        if set(ref) != set(ours):
+            mismatches.append(f"{engine}: methods {set(ref) ^ set(ours)}")
+            continue
+        for name, d in ref.items():
+            m = ours[name]
+            if len(d.args) != len(m.args):
+                mismatches.append(f"{engine}.{name}: arity {len(d.args)} != {len(m.args)}")
+            if d.routing != m.routing:
+                mismatches.append(f"{engine}.{name}: routing {d.routing} != {m.routing}")
+            if d.routing == "cht" and d.cht_n != m.cht_n:
+                mismatches.append(f"{engine}.{name}: cht_n {d.cht_n} != {m.cht_n}")
+            if d.routing in ("broadcast", "cht") and d.aggregator != m.aggregator:
+                mismatches.append(
+                    f"{engine}.{name}: agg {d.aggregator} != {m.aggregator}")
+    assert not mismatches, "\n".join(mismatches)
