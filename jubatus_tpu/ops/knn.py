@@ -134,12 +134,22 @@ def lsh_signature(idx, val, *, hash_num: int, seed: int = 0):
 
 
 @functools.partial(jax.jit, static_argnames=("hash_num",))
-def hamming_distances(q_sig, row_sigs, *, hash_num: int):
-    """Normalized Hamming distance in [0,1]: XOR + popcount over uint32
-    lanes. q_sig [W], row_sigs [C, W] → [C]."""
+def _hamming_distances_xla(q_sig, row_sigs, *, hash_num: int):
     x = jnp.bitwise_xor(row_sigs, q_sig[None, :])
     pops = jax.lax.population_count(x)
     return jnp.sum(pops, axis=1).astype(jnp.float32) / float(hash_num)
+
+
+def hamming_distances(q_sig, row_sigs, *, hash_num: int):
+    """Normalized Hamming distance in [0,1]: XOR + popcount over uint32
+    lanes. q_sig [W], row_sigs [C, W] → [C]. On TPU the scan runs as a
+    pallas kernel (ops/pallas_kernels.py); XLA path elsewhere."""
+    from jubatus_tpu.ops import pallas_kernels
+
+    if pallas_kernels.enabled():
+        return pallas_kernels.hamming_distances(q_sig, row_sigs,
+                                                hash_num=hash_num)
+    return _hamming_distances_xla(q_sig, row_sigs, hash_num=hash_num)
 
 
 # ---------------------------------------------------------------------------
@@ -162,10 +172,18 @@ def minhash_signature(idx, val, *, hash_num: int, seed: int = 0):
 
 
 @jax.jit
-def minhash_distances(q_sig, row_sigs):
-    """1 - (matching lane fraction). q_sig [H], row_sigs [C, H] → [C]."""
+def _minhash_distances_xla(q_sig, row_sigs):
     match = (row_sigs == q_sig[None, :]).astype(jnp.float32)
     return 1.0 - jnp.mean(match, axis=1)
+
+
+def minhash_distances(q_sig, row_sigs):
+    """1 - (matching lane fraction). q_sig [H], row_sigs [C, H] → [C]."""
+    from jubatus_tpu.ops import pallas_kernels
+
+    if pallas_kernels.enabled():
+        return pallas_kernels.minhash_distances(q_sig, row_sigs)
+    return _minhash_distances_xla(q_sig, row_sigs)
 
 
 # ---------------------------------------------------------------------------
@@ -190,18 +208,35 @@ def euclid_lsh_distances(q_proj, row_projs, *, hash_num: int):
 # batched (query-batch × row-store) distances — used by LOF's lrd cache
 # ---------------------------------------------------------------------------
 @functools.partial(jax.jit, static_argnames=("hash_num",))
-def hamming_distances_batch(q_sigs, row_sigs, *, hash_num: int):
-    """q_sigs [B, W], row_sigs [C, W] → [B, C] normalized Hamming."""
+def _hamming_distances_batch_xla(q_sigs, row_sigs, *, hash_num: int):
     x = jnp.bitwise_xor(q_sigs[:, None, :], row_sigs[None, :, :])
     return jnp.sum(jax.lax.population_count(x), axis=-1).astype(jnp.float32) \
         / float(hash_num)
 
 
+def hamming_distances_batch(q_sigs, row_sigs, *, hash_num: int):
+    """q_sigs [B, W], row_sigs [C, W] → [B, C] normalized Hamming."""
+    from jubatus_tpu.ops import pallas_kernels
+
+    if pallas_kernels.enabled():
+        return pallas_kernels.hamming_distances_batch(q_sigs, row_sigs,
+                                                      hash_num=hash_num)
+    return _hamming_distances_batch_xla(q_sigs, row_sigs, hash_num=hash_num)
+
+
 @jax.jit
-def minhash_distances_batch(q_sigs, row_sigs):
-    """q_sigs [B, H], row_sigs [C, H] → [B, C]."""
+def _minhash_distances_batch_xla(q_sigs, row_sigs):
     match = (q_sigs[:, None, :] == row_sigs[None, :, :]).astype(jnp.float32)
     return 1.0 - jnp.mean(match, axis=-1)
+
+
+def minhash_distances_batch(q_sigs, row_sigs):
+    """q_sigs [B, H], row_sigs [C, H] → [B, C]."""
+    from jubatus_tpu.ops import pallas_kernels
+
+    if pallas_kernels.enabled():
+        return pallas_kernels.minhash_distances_batch(q_sigs, row_sigs)
+    return _minhash_distances_batch_xla(q_sigs, row_sigs)
 
 
 @functools.partial(jax.jit, static_argnames=("hash_num",))
