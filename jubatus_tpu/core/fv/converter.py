@@ -106,9 +106,15 @@ def _build_string_type(name: str, params: Dict[str, str]) -> Splitter:
     if method == "regexp":
         return _make_regexp_splitter(params["pattern"], int(params.get("group", "0")))
     if method == "dynamic":
+        # registry first (register_string_type), then load by path —
+        # the so_factory dlopen path (plugins.py)
         plug = params.get("function") or params.get("path", "")
         if plug in _STRING_TYPE_PLUGINS:
             return _STRING_TYPE_PLUGINS[plug](params)
+        if params.get("path"):
+            from jubatus_tpu.core.fv.plugins import load_string_plugin
+
+            return load_string_plugin(params)
         raise ConverterError(f"unknown dynamic string type plugin: {plug!r}")
     raise ConverterError(f"unknown string type method {method!r} for {name!r}")
 
@@ -211,13 +217,30 @@ class ConverterConfig:
             method = params.get("method")
             if method == "dynamic":
                 plug = params.get("function") or params.get("path", "")
-                if plug not in _NUM_TYPE_PLUGINS:
-                    raise ConverterError(f"unknown dynamic num type plugin: {plug!r}")
-                self.num_type_fns[name] = _NUM_TYPE_PLUGINS[plug](params)
+                if plug in _NUM_TYPE_PLUGINS:
+                    self.num_type_fns[name] = _NUM_TYPE_PLUGINS[plug](params)
+                elif params.get("path"):
+                    from jubatus_tpu.core.fv.plugins import load_feature_plugin
+
+                    self.num_type_fns[name] = load_feature_plugin(params)
+                else:
+                    raise ConverterError(
+                        f"unknown dynamic num type plugin: {plug!r}")
             elif method in ("num", "log", "str"):
                 self.num_types[name] = method
             else:
                 raise ConverterError(f"unknown num type method {method!r}")
+
+        # binary types are dynamic plugins only (the reference's sole binary
+        # consumer is the image_feature plugin, plugin/src/fv_converter)
+        self.binary_type_fns: Dict[str, Callable] = {}
+        for name, params in (raw.get("binary_types") or {}).items():
+            if params.get("method") != "dynamic" or not params.get("path"):
+                raise ConverterError(
+                    f"binary type {name!r}: only dynamic plugins supported")
+            from jubatus_tpu.core.fv.plugins import load_feature_plugin
+
+            self.binary_type_fns[name] = load_feature_plugin(params)
 
         self.string_rules = [
             StringRule(
@@ -236,6 +259,9 @@ class ConverterConfig:
         self.num_filter_rules = [
             FilterRule(r["key"], r["type"], r["suffix"])
             for r in (raw.get("num_filter_rules") or [])
+        ]
+        self.binary_rules = [
+            NumRule(r["key"], r["type"]) for r in (raw.get("binary_rules") or [])
         ]
         # combination types: built-ins mul/add, or named with method mul/add
         self.combination_types: Dict[str, str] = {"mul": "mul", "add": "add"}
@@ -256,6 +282,9 @@ class ConverterConfig:
         for r in self.num_rules:
             if r.type_name not in self.num_types and r.type_name not in self.num_type_fns:
                 raise ConverterError(f"num rule references unknown type {r.type_name!r}")
+        for r in self.binary_rules:
+            if r.type_name not in self.binary_type_fns:
+                raise ConverterError(f"binary rule references unknown type {r.type_name!r}")
         for r in self.string_filter_rules:
             if r.type_name not in self.string_filters:
                 raise ConverterError(f"string filter rule references unknown type {r.type_name!r}")
@@ -358,6 +387,15 @@ class DatumToFVConverter:
                     name = f"{key}${_format_num(value)}@{tname}"
                     features[name] = features.get(name, 0.0) + 1.0
 
+        # binary rules (image_feature-style plugins)
+        for rule in cfg.binary_rules:
+            fn = cfg.binary_type_fns[rule.type_name]
+            for key, value in datum.binary_values:
+                if not rule.matcher(key):
+                    continue
+                for name, v in fn(key, value):
+                    features[name] = features.get(name, 0.0) + v
+
         # combination features over the features produced so far. Each rule
         # emits each unordered pair once (canonical name order), regardless of
         # which side matched which matcher; values accumulate across rules.
@@ -391,12 +429,13 @@ class DatumToFVConverter:
         idf lookup.
         """
         named = self._named_features(datum)
-        # hash + resolve global weight per feature
+        # hash (one native batch call when built) + resolve global weights
         hashed: Dict[int, float] = {}
         idf_indices = []
         entries: List[Tuple[int, float, str]] = []
-        for name, value in named.items():
-            idx = self.hasher.index(name)
+        names = list(named.keys())
+        for idx, name in zip(self.hasher.index_many(names), names):
+            value = named[name]
             gw_kind = _global_weight_kind(name)
             entries.append((idx, value, gw_kind))
             if gw_kind == "idf":

@@ -1,0 +1,66 @@
+// jubatus_tpu native runtime helpers.
+//
+// The reference's entire serving stack is C++; in this framework the
+// device plane is XLA and the wire plane is msgpack (already C), so the
+// profitable native surface is the host-side ingest hot loop: hashing
+// feature-name batches into the fixed 2^k index space (the hashing
+// trick replacing core::fv_converter's string-keyed sfv maps).
+//
+// CRC-32 here is bit-identical to zlib's (IEEE reflected, poly
+// 0xEDB88320) so native and Python paths may be mixed freely — the
+// checkpoint envelope (framework/save_load.py) and FeatureHasher
+// (core/fv/hashing.py) both depend on this exact function.
+//
+// Build: `make -C native` → build/libjt_native.so; loaded via ctypes by
+// jubatus_tpu/native/__init__.py (no pybind11 in this image).
+
+#include <cstdint>
+
+namespace {
+
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) {
+        c = (c & 1u) ? (0xEDB88320u ^ (c >> 1)) : (c >> 1);
+      }
+      t[i] = c;
+    }
+  }
+};
+
+const Crc32Table kTable;
+
+inline uint32_t crc32_update(uint32_t c, const uint8_t* p, int64_t len) {
+  for (int64_t i = 0; i < len; ++i) {
+    c = kTable.t[(c ^ p[i]) & 0xFFu] ^ (c >> 8);
+  }
+  return c;
+}
+
+}  // namespace
+
+extern "C" {
+
+// zlib-compatible one-shot CRC-32.
+uint32_t jt_crc32(const uint8_t* data, int64_t len) {
+  return crc32_update(0xFFFFFFFFu, data, len) ^ 0xFFFFFFFFu;
+}
+
+// Hash a batch of utf-8 feature names (concatenated in `buf`, delimited by
+// `offsets`, length n+1) into [1, mask] — crc32 & mask with the zero slot
+// remapped to 1 (index 0 is the padding slot, core/fv/hashing.py).
+void jt_hash_names(const char* buf, const int64_t* offsets, int64_t n,
+                   uint32_t mask, uint32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(buf) + offsets[i];
+    uint32_t c =
+        crc32_update(0xFFFFFFFFu, p, offsets[i + 1] - offsets[i]) ^ 0xFFFFFFFFu;
+    uint32_t h = c & mask;
+    out[i] = h ? h : 1u;
+  }
+}
+
+}  // extern "C"

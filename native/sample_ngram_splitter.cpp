@@ -1,0 +1,65 @@
+// Sample native fv_converter splitter plugin — the C ABI counterpart of
+// the reference's dlopen'd word splitters (plugin/src/fv_converter,
+// extern "C" create pattern, mecab_splitter.cpp:203-230).
+//
+// ABI (consumed by jubatus_tpu.native.load_native_splitter via ctypes):
+//
+//   void* jt_splitter_create(const char* const* keys,
+//                            const char* const* vals, int n);
+//   int64_t jt_splitter_split(void* handle, const char* text, int64_t len,
+//                             int64_t* begins, int64_t* ends, int64_t cap);
+//       → number of tokens found; writes up to cap byte ranges. If the
+//         return value exceeds cap the caller retries with a larger buffer.
+//   void jt_splitter_destroy(void* handle);
+//
+// This sample emits byte n-grams (param "char_num", default 1) — ASCII
+// text only; a production tokenizer would walk utf-8 boundaries.
+//
+// Build: `make -C native` → build/libsample_ngram_splitter.so
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+struct Ngram {
+  int64_t n;
+};
+}  // namespace
+
+extern "C" {
+
+void* jt_splitter_create(const char* const* keys, const char* const* vals,
+                         int n) {
+  Ngram* s = new Ngram{1};
+  for (int i = 0; i < n; ++i) {
+    if (std::strcmp(keys[i], "char_num") == 0) {
+      long v = std::strtol(vals[i], nullptr, 10);
+      if (v < 1) {
+        delete s;
+        return nullptr;
+      }
+      s->n = v;
+    }
+  }
+  return s;
+}
+
+int64_t jt_splitter_split(void* handle, const char* text, int64_t len,
+                          int64_t* begins, int64_t* ends, int64_t cap) {
+  const Ngram* s = static_cast<const Ngram*>(handle);
+  int64_t count = len - s->n + 1;
+  if (count < 0) count = 0;
+  int64_t emit = count < cap ? count : cap;
+  for (int64_t i = 0; i < emit; ++i) {
+    begins[i] = i;
+    ends[i] = i + s->n;
+  }
+  return count;
+}
+
+void jt_splitter_destroy(void* handle) {
+  delete static_cast<Ngram*>(handle);
+}
+
+}  // extern "C"

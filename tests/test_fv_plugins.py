@@ -1,0 +1,173 @@
+"""fv_converter plugin system tests (≙ plugin/src/fv_converter/*_test.cpp).
+
+Covers: path-based loading (the dlopen seam), builtin-name resolution,
+ux_splitter trie extraction, binary rules, error paths, module caching.
+mecab/image plugins are exercised only if their backing libraries exist
+(same gating as the reference's optional plugin builds).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.core.fv import plugins
+from jubatus_tpu.core.fv.converter import ConverterError, make_fv_converter
+
+
+@pytest.fixture(autouse=True)
+def _clear_plugin_cache():
+    plugins.clear_cache()
+    yield
+    plugins.clear_cache()
+
+
+def test_load_plugin_from_path(tmp_path):
+    plug = tmp_path / "shout_splitter.py"
+    plug.write_text(
+        "def create(params):\n"
+        "    suffix = params.get('suffix', '!')\n"
+        "    return lambda text: [w + suffix for w in text.split()]\n"
+    )
+    conf = {
+        "string_types": {
+            "shout": {"method": "dynamic", "path": str(plug),
+                      "function": "create", "suffix": "!!"},
+        },
+        "string_rules": [{"key": "*", "type": "shout",
+                          "sample_weight": "bin", "global_weight": "bin"}],
+    }
+    conv = make_fv_converter(conf)
+    named = conv.convert_named(Datum({"msg": "hello world"}))
+    assert any("hello!!" in k for k in named)
+    assert any("world!!" in k for k in named)
+
+
+def test_plugin_object_with_split_method(tmp_path):
+    plug = tmp_path / "obj_splitter.py"
+    plug.write_text(
+        "class S:\n"
+        "    def split(self, text):\n"
+        "        return list(text)\n"
+        "def create(params):\n"
+        "    return S()\n"
+    )
+    conf = {
+        "string_types": {"chars": {"method": "dynamic", "path": str(plug)}},
+        "string_rules": [{"key": "*", "type": "chars",
+                          "sample_weight": "tf", "global_weight": "bin"}],
+    }
+    named = make_fv_converter(conf).convert_named(Datum({"k": "aab"}))
+    tf = {k: v for k, v in named.items()}
+    assert any(v == 2.0 for v in tf.values())  # 'a' twice
+
+
+def test_ux_splitter_builtin_by_name(tmp_path):
+    kw = tmp_path / "kw.txt"
+    kw.write_text("jubatus\ntpu\nbat\n")
+    conf = {
+        "string_types": {
+            "ux": {"method": "dynamic", "path": "ux_splitter",
+                   "function": "create", "dict_path": str(kw)},
+        },
+        "string_rules": [{"key": "*", "type": "ux",
+                          "sample_weight": "bin", "global_weight": "bin"}],
+    }
+    named = make_fv_converter(conf).convert_named(
+        Datum({"t": "jubatus on tpu"}))
+    terms = {k.split("$")[1].split("@")[0] for k in named}
+    assert terms == {"jubatus", "tpu", "bat"}  # 'bat' inside 'jubatus'
+
+
+def test_num_plugin(tmp_path):
+    plug = tmp_path / "squarer.py"
+    plug.write_text(
+        "def create(params):\n"
+        "    return lambda key, value: [(key + '@sq', value * value)]\n"
+    )
+    conf = {
+        "num_types": {"sq": {"method": "dynamic", "path": str(plug)}},
+        "num_rules": [{"key": "*", "type": "sq"}],
+    }
+    named = make_fv_converter(conf).convert_named(Datum({"x": 3.0}))
+    assert named["x@sq"] == 9.0
+
+
+def test_binary_plugin(tmp_path):
+    plug = tmp_path / "bytecount.py"
+    plug.write_text(
+        "def create(params):\n"
+        "    return lambda key, data: [(key + '$len', float(len(data)))]\n"
+    )
+    conf = {
+        "binary_types": {"len": {"method": "dynamic", "path": str(plug)}},
+        "binary_rules": [{"key": "*", "type": "len"}],
+    }
+    d = Datum()
+    d.add("blob", b"12345")
+    named = make_fv_converter(conf).convert_named(d)
+    assert named["blob$len"] == 5.0
+
+
+def test_missing_plugin_path_raises():
+    conf = {
+        "string_types": {"x": {"method": "dynamic", "path": "/nope/missing.py"}},
+        "string_rules": [{"key": "*", "type": "x"}],
+    }
+    with pytest.raises(ConverterError, match="not found"):
+        make_fv_converter(conf)
+
+
+def test_plugin_without_factory_raises(tmp_path):
+    plug = tmp_path / "empty.py"
+    plug.write_text("x = 1\n")
+    conf = {"string_types": {"x": {"method": "dynamic", "path": str(plug)}},
+            "string_rules": [{"key": "*", "type": "x"}]}
+    with pytest.raises(ConverterError, match="factory"):
+        make_fv_converter(conf)
+
+
+def test_module_cache_reused(tmp_path):
+    plug = tmp_path / "counted.py"
+    plug.write_text(
+        "CALLS = []\n"
+        "def create(params):\n"
+        "    CALLS.append(1)\n"
+        "    return lambda text: [text]\n"
+    )
+    p = {"method": "dynamic", "path": str(plug)}
+    s1 = plugins.load_string_plugin(dict(p))
+    s2 = plugins.load_string_plugin(dict(p))
+    assert s1("a") == s2("a") == ["a"]
+    mod = plugins._load_module(str(plug))
+    assert len(mod.CALLS) == 2  # two factory calls, ONE module import
+
+
+def test_binary_rule_unknown_type_rejected():
+    conf = {"binary_rules": [{"key": "*", "type": "ghost"}]}
+    with pytest.raises(ConverterError, match="binary rule"):
+        make_fv_converter(conf)
+
+
+def test_mecab_plugin_if_available():
+    pytest.importorskip("MeCab")
+    from jubatus_tpu.plugins.mecab_splitter import create
+
+    sp = create({"ngram": "1", "base": "false"})
+    assert isinstance(sp.split("これはテストです"), list)
+
+
+def test_image_plugin_if_available(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    import numpy as np
+
+    from jubatus_tpu.plugins.image_feature import create
+
+    img = (np.random.default_rng(0).random((32, 32)) * 255).astype("uint8")
+    ok, buf = cv2.imencode(".png", img)
+    assert ok
+    feats = list(create({"algorithm": "dense", "resize": "true",
+                         "width": "8", "height": "8"}).extract("im", buf.tobytes()))
+    assert len(feats) == 64
