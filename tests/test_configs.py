@@ -1,0 +1,66 @@
+"""Every shipped config example must construct a working driver — the
+--config_test contract (server_util.hpp:142-152) applied to our own
+config/ tree, plus spot checks that a trained flow runs on each engine's
+default config.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import pytest
+
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.server.factory import create_driver
+
+CONFIG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "config")
+
+ALL_CONFIGS = sorted(glob.glob(os.path.join(CONFIG_ROOT, "*", "*.json")))
+
+
+def test_config_tree_covers_all_engines():
+    engines = {os.path.basename(os.path.dirname(p)) for p in ALL_CONFIGS}
+    assert engines == {
+        "anomaly", "bandit", "burst", "classifier", "clustering", "graph",
+        "nearest_neighbor", "recommender", "regression", "stat", "weight",
+    }
+
+
+@pytest.mark.parametrize("path", ALL_CONFIGS,
+                         ids=[os.path.relpath(p, CONFIG_ROOT) for p in ALL_CONFIGS])
+def test_config_constructs_driver(path):
+    engine = os.path.basename(os.path.dirname(path))
+    with open(path) as f:
+        config = json.load(f)
+    driver = create_driver(engine, config)
+    assert driver.get_status() is not None
+
+
+def _default(engine):
+    with open(os.path.join(CONFIG_ROOT, engine, "default.json")) as f:
+        return json.load(f)
+
+
+def test_classifier_default_flow():
+    d = create_driver("classifier", _default("classifier"))
+    d.train([("pos", Datum({"x": 1.0})), ("neg", Datum({"x": -1.0}))])
+    (scores,) = d.classify([Datum({"x": 1.0})])
+    assert max(scores, key=lambda s: s[1])[0] == "pos"
+
+
+def test_recommender_default_flow():
+    d = create_driver("recommender", _default("recommender"))
+    d.update_row("a", Datum({"x": 1.0, "y": 0.0}))
+    d.update_row("b", Datum({"x": 0.9, "y": 0.1}))
+    sim = d.similar_row_from_id("a", 2)
+    assert sim and sim[0][0] in ("a", "b")
+
+
+def test_stat_default_flow():
+    d = create_driver("stat", _default("stat"))
+    d.push("k", 2.0)
+    d.push("k", 4.0)
+    assert d.sum("k") == 6.0
