@@ -10,6 +10,7 @@ from jubatus_tpu.models.anomaly import AnomalyDriver  # noqa: F401
 from jubatus_tpu.models.bandit import BanditDriver  # noqa: F401
 from jubatus_tpu.models.burst import BurstDriver  # noqa: F401
 from jubatus_tpu.models.classifier import ClassifierDriver  # noqa: F401
+from jubatus_tpu.models.classifier_nn import ClassifierNNDriver  # noqa: F401
 from jubatus_tpu.models.clustering import ClusteringDriver  # noqa: F401
 from jubatus_tpu.models.graph import GraphDriver  # noqa: F401
 from jubatus_tpu.models.nearest_neighbor import NearestNeighborDriver  # noqa: F401

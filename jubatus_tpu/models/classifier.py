@@ -27,10 +27,10 @@ from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.core.fv import make_fv_converter
 from jubatus_tpu.core.sparse import SparseBatch
 from jubatus_tpu.framework.driver import DriverBase, locked
+from jubatus_tpu.models.classifier_nn import NN_METHODS as _NN_METHODS
 from jubatus_tpu.ops import classifier as ops
 
 _LINEAR_METHODS = set(ops.METHODS)
-_NN_METHODS = {"NN", "cosine", "euclidean"}
 _INITIAL_CAPACITY = 8
 
 

@@ -50,4 +50,11 @@ def create_driver(engine: str, config: Any):
         raise KeyError(
             f"unknown engine {engine!r}; known: {', '.join(sorted(DRIVER_CLASSES))}"
         )
+    # classifier splits by method family: linear (PA/.../NHERD) vs
+    # instance-based (NN/cosine/euclidean), like classifier_factory
+    if engine == "classifier":
+        from jubatus_tpu.models.classifier_nn import NN_METHODS, ClassifierNNDriver
+
+        if isinstance(config, dict) and config.get("method") in NN_METHODS:
+            return ClassifierNNDriver(config)
     return cls(config)

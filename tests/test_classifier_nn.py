@@ -1,0 +1,154 @@
+"""Instance-based classifier tests (methods NN / cosine / euclidean —
+config/classifier/{nn,cosine,euclidean}.json).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.models.classifier_nn import ClassifierNNDriver
+from jubatus_tpu.server.factory import create_driver
+
+CONV = {"num_rules": [{"key": "*", "type": "num"}]}
+
+
+def _conf(clf_method, **param):
+    base = {"nearest_neighbor_num": 3, "local_sensitivity": 0.5}
+    base.update(param)
+    return {"method": clf_method, "converter": CONV, "parameter": base}
+
+
+TRAIN = [
+    ("pos", Datum({"x": 1.0, "y": 1.0})),
+    ("pos", Datum({"x": 0.9, "y": 0.8})),
+    ("pos", Datum({"x": 1.1, "y": 0.9})),
+    ("neg", Datum({"x": -1.0, "y": -1.0})),
+    ("neg", Datum({"x": -0.8, "y": -1.1})),
+    ("neg", Datum({"x": -1.2, "y": -0.9})),
+]
+
+
+@pytest.mark.parametrize("method,param", [
+    ("cosine", {}),
+    ("euclidean", {}),
+    ("NN", {"method": "euclid_lsh", "parameter": {"hash_num": 128}}),
+    ("NN", {"method": "lsh", "parameter": {"hash_num": 128}}),
+])
+def test_classify_separable(method, param):
+    d = ClassifierNNDriver(_conf(method, **param))
+    assert d.train(TRAIN) == 6
+    results = d.classify([Datum({"x": 1.0, "y": 0.9}),
+                          Datum({"x": -1.0, "y": -0.95})])
+    assert max(results[0], key=lambda s: s[1])[0] == "pos"
+    assert max(results[1], key=lambda s: s[1])[0] == "neg"
+    # scores exist for every known label
+    assert {lab for lab, _ in results[0]} == {"pos", "neg"}
+
+
+def test_factory_routes_nn_methods():
+    d = create_driver("classifier", _conf("cosine"))
+    assert isinstance(d, ClassifierNNDriver)
+
+
+def test_labels_and_delete():
+    d = ClassifierNNDriver(_conf("euclidean"))
+    d.train(TRAIN)
+    assert d.get_labels() == {"pos": 3, "neg": 3}
+    assert d.set_label("zzz") is True
+    assert d.set_label("zzz") is False  # already known
+    assert d.get_labels()["zzz"] == 0
+    assert d.delete_label("pos") is True
+    assert "pos" not in d.get_labels()
+    (res,) = d.classify([Datum({"x": 1.0, "y": 1.0})])
+    assert {lab for lab, _ in res} == {"neg", "zzz"}
+    assert d.delete_label("ghost") is False
+
+
+def test_clear():
+    d = ClassifierNNDriver(_conf("cosine"))
+    d.train(TRAIN)
+    d.clear()
+    assert d.get_labels() == {}
+    (res,) = d.classify([Datum({"x": 1.0})])
+    assert res == []
+
+
+def test_pack_unpack_roundtrip():
+    d = ClassifierNNDriver(_conf("euclidean"))
+    d.train(TRAIN)
+    d.set_label("extra")
+    from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
+
+    blob = pack_obj(d.pack())
+    d2 = ClassifierNNDriver(_conf("euclidean"))
+    d2.unpack(unpack_obj(blob))
+    assert d2.get_labels() == {"pos": 3, "neg": 3, "extra": 0}
+    (res,) = d2.classify([Datum({"x": 1.0, "y": 1.0})])
+    assert max(res, key=lambda s: s[1])[0] == "pos"
+
+
+def test_mix_merges_examples():
+    """Two nodes train different classes; folding their row diffs teaches
+    both (the linear-mix seam, like the linear classifier's weight mix)."""
+    a = ClassifierNNDriver(_conf("euclidean"))
+    b = ClassifierNNDriver(_conf("euclidean"))
+    a.train([(lab, d) for lab, d in TRAIN if lab == "pos"])
+    b.train([(lab, d) for lab, d in TRAIN if lab == "neg"])
+    ma, mb = a.get_mixables()["rows"], b.get_mixables()["rows"]
+    folded = ma.mix(ma.get_diff(), mb.get_diff())
+    ma.put_diff(folded)
+    mb.put_diff(folded)
+    for drv in (a, b):
+        res = drv.classify([Datum({"x": 1.0, "y": 1.0}),
+                            Datum({"x": -1.0, "y": -1.0})])
+        assert max(res[0], key=lambda s: s[1])[0] == "pos"
+        assert max(res[1], key=lambda s: s[1])[0] == "neg"
+
+
+def test_set_label_propagates_via_mix():
+    """A label registered on one replica (no examples yet) reaches the
+    other through the labels mixable."""
+    a = ClassifierNNDriver(_conf("cosine"))
+    b = ClassifierNNDriver(_conf("cosine"))
+    a.set_label("early")
+    ml_a, ml_b = a.get_mixables()["labels"], b.get_mixables()["labels"]
+    folded = ml_a.mix(ml_a.get_diff(), ml_b.get_diff())
+    ml_b.put_diff(folded)
+    assert b.get_labels() == {"early": 0}
+
+
+def test_local_sensitivity_sharpness():
+    """Smaller local_sensitivity concentrates weight on the closest
+    neighbor; scores must still rank correctly near the boundary."""
+    sharp = ClassifierNNDriver(_conf("euclidean", local_sensitivity=0.05))
+    sharp.train(TRAIN)
+    (res,) = sharp.classify([Datum({"x": 0.95, "y": 0.9})])
+    assert max(res, key=lambda s: s[1])[0] == "pos"
+
+
+def test_bad_config_rejected():
+    with pytest.raises(ValueError):
+        ClassifierNNDriver(_conf("cosine", nearest_neighbor_num=0))
+    with pytest.raises(ValueError):
+        ClassifierNNDriver(_conf("cosine", local_sensitivity=0))
+    with pytest.raises(ValueError):
+        ClassifierNNDriver({"method": "what", "converter": CONV})
+
+
+def test_server_e2e_nn_classifier():
+    """Full wire path: EngineServer + client over a real socket."""
+    from jubatus_tpu.client import ClassifierClient
+    from jubatus_tpu.server import EngineServer
+
+    srv = EngineServer("classifier", _conf("cosine"))
+    port = srv.start(0)
+    try:
+        c = ClassifierClient("127.0.0.1", port, "")
+        assert c.train([[lab, d] for lab, d in TRAIN]) == 6
+        (res,) = c.classify([Datum({"x": 1.0, "y": 1.0})])
+        assert max(res, key=lambda s: s[1])[0] == "pos"
+        assert c.get_labels() == {"pos": 3, "neg": 3}
+        c.close()
+    finally:
+        srv.stop()
