@@ -1,0 +1,230 @@
+"""Gossip (push) mixers + candidate strategies (≙ mixer/push_mixer.{hpp,cpp}
++ broadcast_mixer / random_mixer / skip_mixer headers).
+
+The reference's push mixers skip master election: each node, on its own
+interval, picks candidate peers via a strategy and exchanges model state
+pairwise (push_mixer.cpp:342-429). Strategies (mixer_factory.cpp:41-97):
+
+- broadcast: every other member               (broadcast_mixer.hpp:46-55)
+- random:    one uniformly random member      (random_mixer.hpp:45-58)
+- skip:      Chord-style finger peers at offsets +1, +2, +4, ... around
+             the name-sorted member ring      (skip_mixer.hpp:46-57)
+
+Round semantics here: for each candidate, pull her packed diff
+(``mix_get_diff`` — the same RPC surface the linear mixer serves, so push
+and linear nodes interoperate), fold it with my own diff per mixable, and
+apply the fold on BOTH sides (``mix_put_diff``). Each exchange is exactly
+a 2-party linear mix; repeated gossip rounds converge the cluster without
+any per-round master, trading the linear mixer's O(N) master fan-out for
+elastic, leaderless propagation. Schema-bearing engines piggyback the
+vocabulary union inside the packed diff (local_put_diff syncs schema
+before applying).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import random
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from jubatus_tpu.coord.base import NodeInfo
+from jubatus_tpu.framework.linear_mixer import (
+    PROTOCOL_VERSION,
+    LinearCommunication,
+    RpcLinearCommunication,
+    RpcLinearMixer,
+)
+from jubatus_tpu.parallel.mix import tree_sum
+from jubatus_tpu.rpc.client import RpcClient
+from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
+
+log = logging.getLogger(__name__)
+
+
+# -- candidate strategies -----------------------------------------------------
+
+
+def broadcast_candidates(members: Sequence[NodeInfo],
+                         self_node: Optional[NodeInfo]) -> List[NodeInfo]:
+    return [m for m in members
+            if self_node is None or m.name != self_node.name]
+
+
+def random_candidates(members: Sequence[NodeInfo],
+                      self_node: Optional[NodeInfo]) -> List[NodeInfo]:
+    others = broadcast_candidates(members, self_node)
+    return [random.choice(others)] if others else []
+
+
+def skip_candidates(members: Sequence[NodeInfo],
+                    self_node: Optional[NodeInfo]) -> List[NodeInfo]:
+    """Finger peers on the name-sorted ring: offsets 1, 2, 4, ... from my
+    position (skip_mixer.hpp:46-57)."""
+    ring = sorted(members, key=lambda m: m.name)
+    if self_node is None:
+        return list(ring)
+    try:
+        me = next(i for i, m in enumerate(ring) if m.name == self_node.name)
+    except StopIteration:
+        return broadcast_candidates(members, self_node)
+    n = len(ring)
+    out, offset = [], 1
+    while offset < n:
+        peer = ring[(me + offset) % n]
+        if peer.name != self_node.name and peer.name not in {p.name for p in out}:
+            out.append(peer)
+        offset <<= 1
+    return out
+
+
+STRATEGIES = {
+    "broadcast_mixer": broadcast_candidates,
+    "random_mixer": random_candidates,
+    "skip_mixer": skip_candidates,
+}
+
+
+# -- per-peer communication ---------------------------------------------------
+
+
+class PushCommunication(RpcLinearCommunication):
+    """Adds single-peer exchange calls to the membership/session plumbing
+    (≙ push_communication, push_mixer.hpp)."""
+
+    def peer_get_diff(self, member: NodeInfo) -> bytes:
+        with RpcClient(member.host, member.port, self.timeout) as c:
+            return c.call("mix_get_diff", self.name)
+
+    def peer_put_diff(self, member: NodeInfo, packed: bytes) -> bool:
+        with RpcClient(member.host, member.port, self.timeout) as c:
+            return bool(c.call("mix_put_diff", self.name, packed))
+
+    def peer_get_schema(self, member: NodeInfo) -> List[str]:
+        with RpcClient(member.host, member.port, self.timeout) as c:
+            return c.call("mix_get_schema", self.name)
+
+    def peer_sync_schema(self, member: NodeInfo, union: List[str]) -> bool:
+        with RpcClient(member.host, member.port, self.timeout) as c:
+            return bool(c.call("mix_sync_schema", self.name, union))
+
+
+class RpcPushMixer(RpcLinearMixer):
+    """Leaderless gossip rounds; serves the same mix_* RPC surface as the
+    linear mixer (register_api inherited)."""
+
+    def __init__(self, driver: Any, comm: LinearCommunication, *,
+                 strategy: str = "random_mixer", **kwargs) -> None:
+        super().__init__(driver, comm, **kwargs)
+        if strategy not in STRATEGIES:
+            raise ValueError(f"unknown push strategy {strategy!r}")
+        self.strategy = strategy
+        self._select = STRATEGIES[strategy]
+
+    # -- the round (≙ push_mixer::mix, push_mixer.cpp:342-429) ---------------
+    def _mix_round(self) -> Optional[Dict[str, Any]]:
+        if self._obsolete:
+            self.maybe_recover()
+        members = self.comm.update_members()
+        candidates = self._select(members, self.self_node)
+        if not candidates:
+            return None
+        t0 = time.monotonic()
+        exchanged = 0
+        total_bytes = 0
+        for peer in candidates:
+            try:
+                total_bytes += self._exchange(peer)
+                exchanged += 1
+            except Exception as e:  # noqa: BLE001 — gossip shrugs off a peer
+                log.warning("push exchange with %s failed: %s", peer.name, e)
+        if not exchanged:
+            return None
+        self.mix_count += 1
+        self.bytes_sent += total_bytes
+        log.info("push mix round %d (%s): %d/%d peers, %d bytes, %.3fs",
+                 self.mix_count, self.strategy, exchanged, len(candidates),
+                 total_bytes, time.monotonic() - t0)
+        return {"members": exchanged, "bytes": total_bytes}
+
+    def _exchange(self, peer: NodeInfo) -> int:
+        """One pairwise linear mix: align schemas, fold my diff with the
+        peer's, apply the fold on both sides."""
+        # phase 1: schema alignment — row-keyed diffs (classifier labels,
+        # stat keys) must agree on the row vocabulary BEFORE diffing, same
+        # as the linear round's phase 1
+        schema: List[str] = []
+        if self._has_schema():
+            mine_schema = self.local_get_schema()
+            hers_schema = self.comm.peer_get_schema(peer)
+            schema = sorted(
+                {s.decode() if isinstance(s, bytes) else s
+                 for s in list(mine_schema) + list(hers_schema)}
+            )
+            if schema:
+                self.local_sync_schema(schema)
+                self.comm.peer_sync_schema(peer, schema)
+        # phase 2: row-aligned diffs
+        mine = unpack_obj(self.local_get_diff())
+        hers = unpack_obj(self.comm.peer_get_diff(peer))
+        if hers.get("protocol") != PROTOCOL_VERSION:
+            raise RuntimeError(f"protocol mismatch from {peer.name}")
+        mixables = self.driver.get_mixables()
+        totals: Dict[str, Any] = {}
+        for name, mixable in mixables.items():
+            diffs = [p["diffs"][name] for p in (mine, hers)
+                     if name in p["diffs"]]
+            if not diffs:
+                continue
+            custom_mix = getattr(mixable, "mix", None)
+            totals[name] = (functools.reduce(custom_mix, diffs)
+                            if custom_mix is not None else tree_sum(diffs))
+        packed = pack_obj({"protocol": PROTOCOL_VERSION, "schema": schema,
+                           "diffs": totals})
+        self.local_put_diff(packed)
+        self.comm.peer_put_diff(peer, packed)
+        return len(packed)
+
+
+class DummyMixer:
+    """Standalone no-op (≙ dummy_mixer when built without ZK,
+    mixer_factory.cpp:24-31)."""
+
+    def __init__(self, *_a, **_k) -> None:
+        self.mix_count = 0
+
+    def register_api(self, rpc_server, name_check: str = "") -> None:
+        pass
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def updated(self, n: int = 1) -> None:
+        pass
+
+    def mix_now(self) -> None:
+        return None
+
+    def get_status(self) -> Dict[str, Any]:
+        return {"mix_count": 0, "counter": 0, "mixer": "dummy"}
+
+
+def create_mixer(name: str, driver: Any, comm: LinearCommunication, *,
+                 self_node: Optional[NodeInfo] = None,
+                 interval_sec: float = 16.0, interval_count: int = 512):
+    """Mixer factory (≙ create_mixer, mixer_factory.cpp:41-97): selects by
+    the --mixer flag."""
+    kwargs = dict(self_node=self_node, interval_sec=interval_sec,
+                  interval_count=interval_count)
+    if name == "linear_mixer":
+        return RpcLinearMixer(driver, comm, **kwargs)
+    if name in STRATEGIES:
+        return RpcPushMixer(driver, comm, strategy=name, **kwargs)
+    if name == "dummy_mixer":
+        return DummyMixer()
+    raise ValueError(f"unknown mixer {name!r}; known: linear_mixer, "
+                     f"{', '.join(sorted(STRATEGIES))}, dummy_mixer")

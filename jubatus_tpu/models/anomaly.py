@@ -76,7 +76,14 @@ class AnomalyDriver(DriverBase):
                       if param.get("unlearner") == "lru" else None),
         )
         self._next_id = 0
+        #: cluster-wide id minting (≙ ZK global_id_generator, anomaly_serv
+        #: .cpp:160) — set by the server in distributed mode so ids minted on
+        #: different nodes never collide when row diffs merge in a mix round
+        self.idgen = None
         self._lrd_cache: Optional[Tuple[int, np.ndarray, np.ndarray]] = None
+
+    def set_id_generator(self, gen) -> None:
+        self.idgen = gen
 
     # -- lrd support structure -------------------------------------------------
     def _support(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -129,14 +136,18 @@ class AnomalyDriver(DriverBase):
         return float(nbr_lrd.mean() / lrd_q)
 
     # -- updates ---------------------------------------------------------------
-    @locked
     def add(self, row: Datum) -> Tuple[str, float]:
-        vec = self.converter.convert(row, update_weights=True)
-        score = self._score(vec)
-        row_id = str(self._next_id)
-        self._next_id += 1
-        self.backend.set_row(row_id, vec)
-        self.event_model_updated()
+        # mint the cluster id BEFORE taking the model lock: the coordinator
+        # round-trip must not stall other RPC threads or a mix round
+        row_id = str(self.idgen.generate()) if self.idgen is not None else None
+        with self.lock:
+            if row_id is None:
+                row_id = str(self._next_id)
+                self._next_id += 1
+            vec = self.converter.convert(row, update_weights=True)
+            score = self._score(vec)
+            self.backend.set_row(row_id, vec)
+            self.event_model_updated()
         return row_id, score
 
     @locked

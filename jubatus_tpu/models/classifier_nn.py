@@ -47,15 +47,24 @@ def _as_label(x: Any) -> str:
 
 
 class _LabelSetMixable:
-    """Union-mix of the registered-label set, so set_label calls propagate
-    between replicas even before any example of the label exists (examples
-    themselves travel in the row diff)."""
+    """Union-mix of labels registered SINCE THE LAST MIX, so set_label
+    calls propagate between replicas even before any example of the label
+    exists (examples themselves travel in the row diff).
+
+    The diff is a delta, not the full set: shipping the full set every
+    round would have idle replicas resurrect a label the cluster just
+    delete_label-ed (deletes are broadcast RPCs, like the reference's
+    #@broadcast #@all_or routing — the mix plane must not fight them).
+    A replica that was down during a delete still resurrects on rejoin,
+    matching the reference's replicated-model semantics."""
 
     def __init__(self, driver: "ClassifierNNDriver") -> None:
         self._d = driver
 
     def get_diff(self):
-        return sorted(self._d.registered)
+        pending = sorted(self._d._label_diff_pending)
+        self._d._label_diff_pending.clear()
+        return pending
 
     @staticmethod
     def mix(acc, diff):
@@ -128,6 +137,8 @@ class ClassifierNNDriver(DriverBase):
         )
         #: labels registered via set_label before any example arrived
         self.registered: set = set()
+        #: labels registered since the last mix (shipped by _LabelSetMixable)
+        self._label_diff_pending: set = set()
         #: memoized label→example-count map; every mutation path (driver
         #: methods, mixable put_diff, LRU eviction inside those) invalidates
         self._counts_cache: Dict[str, int] = None  # type: ignore[assignment]
@@ -142,6 +153,7 @@ class ClassifierNNDriver(DriverBase):
             vec = self.converter.convert(datum, update_weights=True)
             self.backend.set_row(uuid.uuid4().hex, vec, datum=str(label))
             self.registered.add(str(label))
+            self._label_diff_pending.add(str(label))
         self._invalidate_counts()
         self.event_model_updated(len(data))
         return len(data)
@@ -183,12 +195,16 @@ class ClassifierNNDriver(DriverBase):
         if label in self._label_counts():
             return False
         self.registered.add(str(label))
+        self._label_diff_pending.add(str(label))
         self._invalidate_counts()
         self.event_model_updated()
         return True
 
     @locked
     def delete_label(self, label: str) -> bool:
+        """Deletes are cluster-wide only through proxy broadcast (the
+        reference's #@broadcast routing); a single-replica delete is
+        resurrected by peers' row diffs, by design."""
         if label not in self._label_counts():
             return False
         doomed = [rid for rid, lab in list(self.backend.store.datums.items())
@@ -196,6 +212,7 @@ class ClassifierNNDriver(DriverBase):
         for rid in doomed:
             self.backend.remove_row(rid)
         self.registered.discard(label)
+        self._label_diff_pending.discard(label)
         self._invalidate_counts()
         self.event_model_updated()
         return True
@@ -204,6 +221,7 @@ class ClassifierNNDriver(DriverBase):
     def clear(self) -> None:
         self.backend.clear()
         self.registered.clear()
+        self._label_diff_pending.clear()
         self._invalidate_counts()
         self.converter.weights.clear()
         self.update_count = 0

@@ -88,6 +88,9 @@ class GraphDriver(DriverBase):
         self.edges: Dict[int, Tuple[str, str, Dict[str, str]]] = {}
         self._next_node_id = 0
         self._next_edge_id = 0
+        #: cluster-wide id minting (≙ ZK global_id_generator,
+        #: graph_serv.cpp:109-126) — set by the server in distributed mode
+        self.idgen = None
         self.centrality_queries: set = set()
         self.shortest_path_queries: set = set()
         self._pagerank_cache: Dict[PresetQuery, Dict[str, float]] = {}
@@ -95,11 +98,18 @@ class GraphDriver(DriverBase):
         self._mix_log: Dict[str, Any] = {"nodes": {}, "edges": {}}
 
     # -- node / edge CRUD -------------------------------------------------------
-    @locked
+    def set_id_generator(self, gen) -> None:
+        self.idgen = gen
+
     def create_node(self) -> str:
-        node_id = str(self._next_node_id)
-        self._next_node_id += 1
-        self._create_node(node_id)
+        # coordinator id minting happens OUTSIDE the model lock (a slow
+        # coordinator must not stall serving threads or mix rounds)
+        node_id = str(self.idgen.generate()) if self.idgen is not None else None
+        with self.lock:
+            if node_id is None:
+                node_id = str(self._next_node_id)
+                self._next_node_id += 1
+            self._create_node(node_id)
         return node_id
 
     def _create_node(self, node_id: str) -> None:
@@ -147,12 +157,14 @@ class GraphDriver(DriverBase):
         (graph_serv.cpp:240-265)."""
         return self.remove_node(node_id)
 
-    @locked
     def create_edge(self, node_id: str, source: str, target: str,
                     properties: Optional[Dict[str, str]] = None) -> int:
-        eid = self._next_edge_id
-        self._next_edge_id += 1
-        self._create_edge(eid, source, target, properties or {})
+        eid = int(self.idgen.generate()) if self.idgen is not None else None
+        with self.lock:
+            if eid is None:
+                eid = self._next_edge_id
+                self._next_edge_id += 1
+            self._create_edge(eid, source, target, properties or {})
         return eid
 
     @locked

@@ -83,7 +83,8 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "empty = standalone")
     p.add_argument("-n", "--name", default="")
     p.add_argument("-x", "--mixer", default="linear_mixer",
-                   choices=["linear_mixer", "skip_mixer", "dummy_mixer"])
+                   choices=["linear_mixer", "random_mixer", "broadcast_mixer",
+                            "skip_mixer", "dummy_mixer"])
     p.add_argument("-s", "--interval-sec", type=float, default=16.0)
     p.add_argument("-i", "--interval-count", type=int, default=512)
     p.add_argument("--coordinator-timeout", "--zookeeper-timeout",

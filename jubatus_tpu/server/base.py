@@ -19,7 +19,9 @@ from typing import Any, Dict, Optional
 
 from jubatus_tpu.coord import create_coordinator, membership
 from jubatus_tpu.coord.base import Coordinator, NodeInfo
-from jubatus_tpu.framework.linear_mixer import RpcLinearCommunication, RpcLinearMixer
+from jubatus_tpu.coord.idgen import IdGenerator
+from jubatus_tpu.framework.linear_mixer import RpcLinearMixer
+from jubatus_tpu.framework.push_mixer import PushCommunication, create_mixer
 from jubatus_tpu.framework.save_load import load_model, save_model
 from jubatus_tpu.rpc.server import RpcServer
 from jubatus_tpu.server.args import ServerArgs
@@ -55,16 +57,24 @@ class EngineServer:
         if not self.args.is_standalone or coord is not None:
             if self.coord is None:
                 self.coord = create_coordinator(self.args.coordinator)
-            comm = RpcLinearCommunication(
+            comm = PushCommunication(
                 self.coord, engine, self.args.name,
                 timeout=self.args.interconnect_timeout,
             )
-            self.mixer = RpcLinearMixer(
-                self.driver, comm,
+            # mixer strategy by --mixer flag (≙ mixer_factory)
+            self.mixer = create_mixer(
+                self.args.mixer, self.driver, comm,
                 self_node=NodeInfo(self.args.eth, self.args.rpc_port),
                 interval_sec=self.args.interval_sec,
                 interval_count=self.args.interval_count,
             )
+            # cluster-unique id minting for the engines that mint ids
+            # (≙ global_id_generator_zk: anomaly add, graph create_node/edge)
+            if hasattr(self.driver, "set_id_generator"):
+                self.driver.set_id_generator(IdGenerator(
+                    self.coord,
+                    f"{membership.actor_path(engine, self.args.name)}/id_generator",
+                ))
             # count updates into the mixer (server_base.cpp:214-219)
             driver_event = self.driver.event_model_updated
 

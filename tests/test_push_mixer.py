@@ -1,0 +1,184 @@
+"""Push (gossip) mixer tests (≙ push_mixer_test / skip_mixer_test) plus
+cluster-unique id minting for anomaly/graph.
+
+Strategy selection is pure-function tested (the reference's
+skip_mixer_test verifies stride candidates the same way); full rounds run
+against real in-process clusters like the linear-mixer tests.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from jubatus_tpu.client import AnomalyClient, ClassifierClient, Datum
+from jubatus_tpu.coord.base import NodeInfo
+from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+from jubatus_tpu.framework.push_mixer import (
+    DummyMixer,
+    broadcast_candidates,
+    create_mixer,
+    random_candidates,
+    skip_candidates,
+)
+from jubatus_tpu.server import EngineServer
+from jubatus_tpu.server.args import ServerArgs
+
+NAME = "pm"
+
+CONF = {
+    "method": "PA",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+
+
+def _members(n):
+    return [NodeInfo("10.0.0.1", 9000 + i) for i in range(n)]
+
+
+def test_broadcast_candidates_excludes_self():
+    ms = _members(4)
+    assert broadcast_candidates(ms, ms[1]) == [ms[0], ms[2], ms[3]]
+
+
+def test_random_candidates_one_other():
+    ms = _members(5)
+    for _ in range(20):
+        (pick,) = random_candidates(ms, ms[0])
+        assert pick.name != ms[0].name
+    assert random_candidates([ms[0]], ms[0]) == []
+
+
+def test_skip_candidates_fingers():
+    """8 members sorted by name; node 0's fingers are offsets +1 +2 +4
+    (skip_mixer.hpp stride pattern)."""
+    ms = _members(8)  # names sort by port
+    picks = skip_candidates(ms, ms[0])
+    assert [p.port for p in picks] == [9001, 9002, 9004]
+    # wrap-around from the last member
+    picks = skip_candidates(ms, ms[7])
+    assert [p.port for p in picks] == [9000, 9001, 9003]
+
+
+def test_skip_candidates_unknown_self_falls_back():
+    ms = _members(3)
+    stranger = NodeInfo("9.9.9.9", 1)
+    assert skip_candidates(ms, stranger) == broadcast_candidates(ms, stranger)
+
+
+def test_factory_selects():
+    from jubatus_tpu.framework.linear_mixer import RpcLinearMixer
+    from jubatus_tpu.framework.push_mixer import RpcPushMixer
+
+    class _C:  # minimal comm stand-in
+        pass
+
+    class _D:
+        def get_mixables(self):
+            return {}
+
+    assert isinstance(create_mixer("linear_mixer", _D(), _C()), RpcLinearMixer)
+    m = create_mixer("skip_mixer", _D(), _C())
+    assert isinstance(m, RpcPushMixer) and m.strategy == "skip_mixer"
+    assert isinstance(create_mixer("dummy_mixer", _D(), _C()), DummyMixer)
+    with pytest.raises(ValueError, match="unknown mixer"):
+        create_mixer("nope", _D(), _C())
+
+
+# -- full gossip rounds over real servers ------------------------------------
+
+
+def _cluster(engine, conf, n, store, mixer):
+    servers = []
+    for _ in range(n):
+        args = ServerArgs(
+            engine=engine, coordinator="(shared)", name=NAME, mixer=mixer,
+            listen_addr="127.0.0.1", interval_sec=1e9, interval_count=1 << 30,
+        )
+        srv = EngineServer(engine, conf, args, coord=MemoryCoordinator(store))
+        srv.start(0)
+        servers.append(srv)
+    return servers
+
+
+@pytest.mark.parametrize("strategy", ["broadcast_mixer", "random_mixer",
+                                      "skip_mixer"])
+def test_push_mix_propagates(strategy):
+    store = _Store()
+    servers = _cluster("classifier", CONF, 2, store, strategy)
+    try:
+        c0 = ClassifierClient("127.0.0.1", servers[0].args.rpc_port, NAME)
+        c1 = ClassifierClient("127.0.0.1", servers[1].args.rpc_port, NAME)
+        for _ in range(10):
+            c0.train([["pos", Datum({"x": 1.0, "y": 0.2})]])
+            c1.train([["neg", Datum({"x": -1.0, "y": -0.2})]])
+        assert c0.do_mix() is True  # node 0 gossips with node 1
+        for c in (c0, c1):
+            assert set(c.get_labels()) == {"pos", "neg"}
+            (res,) = c.classify([Datum({"x": 1.0, "y": 0.2})])
+            assert max(res, key=lambda ls: ls[1])[0] == "pos"
+        c0.close(), c1.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_push_mix_three_nodes_broadcast_converges():
+    store = _Store()
+    servers = _cluster("classifier", CONF, 3, store, "broadcast_mixer")
+    try:
+        clients = [ClassifierClient("127.0.0.1", s.args.rpc_port, NAME)
+                   for s in servers]
+        labels = ["a", "b", "c"]
+        for c, lab, x in zip(clients, labels, (1.0, -1.0, 0.0)):
+            for _ in range(5):
+                c.train([[lab, Datum({"x": x, "y": x * 0.5 + 1.0})]])
+        # gossip is eventually consistent: one broadcast round per node
+        # guarantees full propagation (first exchange may predate later
+        # nodes' knowledge)
+        for c in clients:
+            c.do_mix()
+        for c in clients:
+            assert set(c.get_labels()) == set(labels)
+        for c in clients:
+            c.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -- cluster-unique id minting -------------------------------------------------
+
+
+def test_anomaly_ids_unique_across_nodes():
+    conf = {"method": "lof",
+            "parameter": {"nearest_neighbor_num": 3, "method": "euclid_lsh",
+                          "parameter": {"hash_num": 64}},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    store = _Store()
+    servers = _cluster("anomaly", conf, 2, store, "linear_mixer")
+    try:
+        a0 = AnomalyClient("127.0.0.1", servers[0].args.rpc_port, NAME)
+        a1 = AnomalyClient("127.0.0.1", servers[1].args.rpc_port, NAME)
+        ids = set()
+        for a in (a0, a1):
+            for i in range(5):
+                rid, _score = a.add(Datum({"x": float(i)}))
+                ids.add(rid)
+        assert len(ids) == 10, "id collision across cluster nodes"
+        a0.close(), a1.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_standalone_keeps_local_ids():
+    from jubatus_tpu.server.factory import create_driver
+
+    conf = {"method": "lof",
+            "parameter": {"nearest_neighbor_num": 3, "method": "euclid_lsh",
+                          "parameter": {"hash_num": 64}},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    d = create_driver("anomaly", conf)
+    rid, _ = d.add(Datum({"x": 1.0}))
+    assert rid == "0"  # local counter, standalone semantics unchanged
