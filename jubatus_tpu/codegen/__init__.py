@@ -24,6 +24,7 @@ from jubatus_tpu.codegen.parser import (  # noqa: F401
 )
 from jubatus_tpu.codegen.emit import (  # noqa: F401
     emit_python_client,
+    emit_rst,
     emit_service_table,
     to_methods,
 )

@@ -105,9 +105,40 @@ def emit_python_client(idl: IdlFile, service_name: str) -> str:
     return "\n".join(out)
 
 
+def emit_rst(idl: IdlFile, service_name: str) -> str:
+    """RST API documentation for one service (≙ tools/jubadoc rst_generator:
+    same inputs — the .idl with its '#-' doc comments — same RST target)."""
+    svc = idl.service(service_name)
+    title = f"{service_name} API"
+    out = [title, "=" * len(title), ""]
+    if idl.messages:
+        out += ["Data structures", "-" * len("Data structures"), ""]
+        for msg in idl.messages:
+            out.append(f".. describe:: {msg.name}")
+            out.append("")
+            for f in msg.fields:
+                out.append(f"   :{f.index}: ``{f.type}`` {f.name}")
+            out.append("")
+    out += ["Methods", "-------", ""]
+    for d in svc.methods:
+        sig = ", ".join(f"{a.type} {a.name}" for a in d.args)
+        out.append(f".. function:: {d.return_type} {d.name}({sig})")
+        out.append("")
+        routing = d.routing + (f"({d.cht_n})" if d.routing == "cht" else "")
+        out.append(f"   :routing: {routing}")
+        out.append(f"   :lock: {d.lock}")
+        out.append(f"   :aggregator: {d.aggregator}")
+        out.append("")
+        for line in d.docs:
+            out.append(f"   {line}" if line else "")
+        if d.docs:
+            out.append("")
+    return "\n".join(out) + "\n"
+
+
 def main(argv=None) -> int:
     """CLI: ``python -m jubatus_tpu.codegen <file.idl> [--client SERVICE |
-    --table SERVICE]`` — prints generated source to stdout."""
+    --table SERVICE | --rst SERVICE]`` — prints generated source to stdout."""
     import argparse
     import sys
 
@@ -117,10 +148,14 @@ def main(argv=None) -> int:
     p.add_argument("idl")
     p.add_argument("--client", default="", metavar="SERVICE")
     p.add_argument("--table", default="", metavar="SERVICE")
+    p.add_argument("--rst", default="", metavar="SERVICE",
+                   help="emit RST API docs (jubadoc)")
     ns = p.parse_args(argv)
     idl = parse_idl_file(ns.idl)
     if ns.client:
         sys.stdout.write(emit_python_client(idl, ns.client))
+    elif ns.rst:
+        sys.stdout.write(emit_rst(idl, ns.rst))
     elif ns.table:
         sys.stdout.write(emit_service_table(idl.service(ns.table)))
     else:

@@ -55,6 +55,9 @@ class MethodDecl:
     cht_n: int = 2
     lock: str = "nolock"
     aggregator: str = "pass"
+    #: '#-' doc comment lines preceding the decl (consumed by the RST
+    #: emitter, ≙ tools/jubadoc)
+    docs: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -112,6 +115,7 @@ def parse_idl(text: str, name: str = "<idl>") -> IdlFile:
     current_message: Optional[Message] = None
     current_service: Optional[Service] = None
     pending: List[Tuple[str, Optional[str]]] = []  # decorator (name, arg)
+    pending_docs: List[str] = []  # '#-' doc lines for the next decl
     # join continuation lines: a method/field spans until its parens balance
     buffer = ""
 
@@ -120,8 +124,11 @@ def parse_idl(text: str, name: str = "<idl>") -> IdlFile:
         if line.startswith("#@"):
             pending.extend((d, a or None) for d, a in _DECORATOR_RE.findall(line))
             continue
+        if line.startswith("#-"):
+            pending_docs.append(line[2:].lstrip(" "))
+            continue
         if not line or line.startswith("#"):
-            continue  # docs (#-) and comments
+            continue  # plain comments
         if line.startswith("%include"):
             continue  # C++ header pragma for the jenerator cpp backend
         # strip trailing comments (burst.idl has '...) # //@broadcast')
@@ -137,10 +144,12 @@ def parse_idl(text: str, name: str = "<idl>") -> IdlFile:
             m = _MESSAGE_RE.match(line)
             if m:
                 current_message = Message(m.group(1), alias=m.group(2) or "")
+                pending_docs = []  # block-level docs don't belong to a field
                 continue
             m = _SERVICE_RE.match(line)
             if m:
                 current_service = Service(m.group(1))
+                pending_docs = []  # service docs don't belong to method #1
                 continue
             raise IdlSyntaxError(f"{name}:{lineno}: unexpected {line!r}")
 
@@ -152,6 +161,7 @@ def parse_idl(text: str, name: str = "<idl>") -> IdlFile:
                 idl.services.append(current_service)
                 current_service = None
             pending = []
+            pending_docs = []
             continue
 
         if current_message is not None:
@@ -165,7 +175,9 @@ def parse_idl(text: str, name: str = "<idl>") -> IdlFile:
         m = _METHOD_RE.match(line)
         if not m:
             raise IdlSyntaxError(f"{name}:{lineno}: bad method {line!r}")
-        decl = MethodDecl(name=m.group(2), return_type=m.group(1).strip())
+        decl = MethodDecl(name=m.group(2), return_type=m.group(1).strip(),
+                          docs=pending_docs)
+        pending_docs = []
         decl.args = [_parse_field(a, decl.name) for a in _split_args(m.group(3))]
         for dec, arg in pending:
             if dec in ROUTINGS:

@@ -89,25 +89,43 @@ STRATEGIES = {
 # -- per-peer communication ---------------------------------------------------
 
 
+class PeerSession:
+    """One connection for a whole pairwise exchange (up to 4 calls), instead
+    of a TCP setup per call."""
+
+    def __init__(self, client: RpcClient, name: str) -> None:
+        self._c = client
+        self._name = name
+
+    def get_schema(self) -> List[str]:
+        return self._c.call("mix_get_schema", self._name)
+
+    def sync_schema(self, union: List[str]) -> bool:
+        return bool(self._c.call("mix_sync_schema", self._name, union))
+
+    def get_diff(self) -> bytes:
+        return self._c.call("mix_get_diff", self._name)
+
+    def put_diff(self, packed: bytes) -> bool:
+        return bool(self._c.call("mix_put_diff", self._name, packed))
+
+    def close(self) -> None:
+        self._c.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 class PushCommunication(RpcLinearCommunication):
-    """Adds single-peer exchange calls to the membership/session plumbing
-    (≙ push_communication, push_mixer.hpp)."""
+    """Adds the single-peer exchange session to the membership/session
+    plumbing (≙ push_communication, push_mixer.hpp)."""
 
-    def peer_get_diff(self, member: NodeInfo) -> bytes:
-        with RpcClient(member.host, member.port, self.timeout) as c:
-            return c.call("mix_get_diff", self.name)
-
-    def peer_put_diff(self, member: NodeInfo, packed: bytes) -> bool:
-        with RpcClient(member.host, member.port, self.timeout) as c:
-            return bool(c.call("mix_put_diff", self.name, packed))
-
-    def peer_get_schema(self, member: NodeInfo) -> List[str]:
-        with RpcClient(member.host, member.port, self.timeout) as c:
-            return c.call("mix_get_schema", self.name)
-
-    def peer_sync_schema(self, member: NodeInfo, union: List[str]) -> bool:
-        with RpcClient(member.host, member.port, self.timeout) as c:
-            return bool(c.call("mix_sync_schema", self.name, union))
+    def peer_session(self, member: NodeInfo) -> PeerSession:
+        return PeerSession(
+            RpcClient(member.host, member.port, self.timeout), self.name)
 
 
 class RpcPushMixer(RpcLinearMixer):
@@ -149,27 +167,32 @@ class RpcPushMixer(RpcLinearMixer):
         return {"members": exchanged, "bytes": total_bytes}
 
     def _exchange(self, peer: NodeInfo) -> int:
-        """One pairwise linear mix: align schemas, fold my diff with the
-        peer's, apply the fold on both sides."""
+        """One pairwise linear mix over a single peer connection: align
+        schemas, fold my diff with the peer's, apply the fold on both
+        sides."""
+        with self.comm.peer_session(peer) as sess:
+            return self._exchange_on(sess)
+
+    def _exchange_on(self, sess) -> int:
         # phase 1: schema alignment — row-keyed diffs (classifier labels,
         # stat keys) must agree on the row vocabulary BEFORE diffing, same
         # as the linear round's phase 1
         schema: List[str] = []
         if self._has_schema():
             mine_schema = self.local_get_schema()
-            hers_schema = self.comm.peer_get_schema(peer)
+            hers_schema = sess.get_schema()
             schema = sorted(
                 {s.decode() if isinstance(s, bytes) else s
                  for s in list(mine_schema) + list(hers_schema)}
             )
             if schema:
                 self.local_sync_schema(schema)
-                self.comm.peer_sync_schema(peer, schema)
+                sess.sync_schema(schema)
         # phase 2: row-aligned diffs
         mine = unpack_obj(self.local_get_diff())
-        hers = unpack_obj(self.comm.peer_get_diff(peer))
+        hers = unpack_obj(sess.get_diff())
         if hers.get("protocol") != PROTOCOL_VERSION:
-            raise RuntimeError(f"protocol mismatch from {peer.name}")
+            raise RuntimeError("protocol mismatch from peer")
         mixables = self.driver.get_mixables()
         totals: Dict[str, Any] = {}
         for name, mixable in mixables.items():
@@ -183,7 +206,7 @@ class RpcPushMixer(RpcLinearMixer):
         packed = pack_obj({"protocol": PROTOCOL_VERSION, "schema": schema,
                            "diffs": totals})
         self.local_put_diff(packed)
-        self.comm.peer_put_diff(peer, packed)
+        sess.put_diff(packed)
         return len(packed)
 
 

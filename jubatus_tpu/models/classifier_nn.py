@@ -47,31 +47,44 @@ def _as_label(x: Any) -> str:
 
 
 class _LabelSetMixable:
-    """Union-mix of labels registered SINCE THE LAST MIX, so set_label
-    calls propagate between replicas even before any example of the label
-    exists (examples themselves travel in the row diff).
+    """Last-writer-wins label-state mix, so set_label / delete_label
+    outcomes propagate between replicas even for labels with no examples
+    (examples themselves travel in the row diff).
 
-    The diff is a delta, not the full set: shipping the full set every
-    round would have idle replicas resurrect a label the cluster just
-    delete_label-ed (deletes are broadcast RPCs, like the reference's
-    #@broadcast #@all_or routing — the mix plane must not fight them).
-    A replica that was down during a delete still resurrects on rejoin,
-    matching the reference's replicated-model semantics."""
+    The diff is the FULL ``{label: [epoch, alive]}`` state map — shipping
+    full state is non-destructive (a failed exchange loses nothing) and
+    transitively propagating (a peer re-ships what it learned). Conflicts
+    resolve per label by highest epoch; on an epoch tie the tombstone
+    (alive=False) wins, so a cluster-wide delete is never resurrected by
+    an idle replica's old registration."""
 
     def __init__(self, driver: "ClassifierNNDriver") -> None:
         self._d = driver
 
     def get_diff(self):
-        pending = sorted(self._d._label_diff_pending)
-        self._d._label_diff_pending.clear()
-        return pending
+        return {label: [int(e), bool(a)]
+                for label, (e, a) in self._d._label_states.items()}
 
     @staticmethod
     def mix(acc, diff):
-        return sorted(set(acc) | set(diff))
+        out = {(_as_label(k)): [int(v[0]), bool(v[1])] for k, v in acc.items()}
+        for k, v in diff.items():
+            k = _as_label(k)
+            e, a = int(v[0]), bool(v[1])
+            cur = out.get(k)
+            if cur is None or e > cur[0] or (e == cur[0] and not a):
+                out[k] = [e, a]
+        return out
 
     def put_diff(self, diff) -> bool:
-        self._d.registered.update(_as_label(x) for x in diff)
+        states = self._d._label_states
+        for k, v in diff.items():
+            k = _as_label(k)
+            e, a = int(v[0]), bool(v[1])
+            cur = states.get(k)
+            if cur is None or e > cur[0] or (e == cur[0] and not a):
+                states[k] = (e, a)
+        self._d.registered = {k for k, (_e, a) in states.items() if a}
         self._d._invalidate_counts()
         return True
 
@@ -136,9 +149,10 @@ class ClassifierNNDriver(DriverBase):
             keep_datum=True,  # the datum slot holds the example's label
         )
         #: labels registered via set_label before any example arrived
+        #: (derived view of _label_states, kept for fast membership tests)
         self.registered: set = set()
-        #: labels registered since the last mix (shipped by _LabelSetMixable)
-        self._label_diff_pending: set = set()
+        #: label → (epoch, alive): the LWW state _LabelSetMixable mixes
+        self._label_states: Dict[str, Tuple[int, bool]] = {}
         #: memoized label→example-count map; every mutation path (driver
         #: methods, mixable put_diff, LRU eviction inside those) invalidates
         self._counts_cache: Dict[str, int] = None  # type: ignore[assignment]
@@ -146,14 +160,22 @@ class ClassifierNNDriver(DriverBase):
     def _invalidate_counts(self) -> None:
         self._counts_cache = None
 
+    def _mark_label(self, label: str, alive: bool) -> None:
+        epoch = max((e for e, _a in self._label_states.values()), default=0) + 1
+        self._label_states[label] = (epoch, alive)
+        if alive:
+            self.registered.add(label)
+        else:
+            self.registered.discard(label)
+
     # -- training -------------------------------------------------------------
     @locked
     def train(self, data: List[Tuple[str, Datum]]) -> int:
         for label, datum in data:
             vec = self.converter.convert(datum, update_weights=True)
             self.backend.set_row(uuid.uuid4().hex, vec, datum=str(label))
-            self.registered.add(str(label))
-            self._label_diff_pending.add(str(label))
+            if str(label) not in self.registered:
+                self._mark_label(str(label), True)
         self._invalidate_counts()
         self.event_model_updated(len(data))
         return len(data)
@@ -194,8 +216,7 @@ class ClassifierNNDriver(DriverBase):
     def set_label(self, label: str) -> bool:
         if label in self._label_counts():
             return False
-        self.registered.add(str(label))
-        self._label_diff_pending.add(str(label))
+        self._mark_label(str(label), True)
         self._invalidate_counts()
         self.event_model_updated()
         return True
@@ -211,8 +232,7 @@ class ClassifierNNDriver(DriverBase):
                   if _as_label(lab) == label]
         for rid in doomed:
             self.backend.remove_row(rid)
-        self.registered.discard(label)
-        self._label_diff_pending.discard(label)
+        self._mark_label(label, False)  # tombstone: survives future mixes
         self._invalidate_counts()
         self.event_model_updated()
         return True
@@ -221,7 +241,7 @@ class ClassifierNNDriver(DriverBase):
     def clear(self) -> None:
         self.backend.clear()
         self.registered.clear()
-        self._label_diff_pending.clear()
+        self._label_states.clear()
         self._invalidate_counts()
         self.converter.weights.clear()
         self.update_count = 0
@@ -237,7 +257,8 @@ class ClassifierNNDriver(DriverBase):
     def pack(self) -> Any:
         return {"method": self.method,
                 "backend": self.backend.pack(),
-                "registered": sorted(self.registered),
+                "label_states": {k: [e, a] for k, (e, a)
+                                 in self._label_states.items()},
                 "weights": self.converter.weights.pack()}
 
     @locked
@@ -249,7 +270,11 @@ class ClassifierNNDriver(DriverBase):
             raise ValueError(
                 f"checkpoint method {saved!r} != driver method {self.method!r}")
         self.backend.unpack(obj["backend"], datum_decoder=_as_label)
-        self.registered = {_as_label(r) for r in obj.get("registered", [])}
+        self._label_states = {
+            _as_label(k): (int(v[0]), bool(v[1]))
+            for k, v in (obj.get("label_states") or {}).items()
+        }
+        self.registered = {k for k, (_e, a) in self._label_states.items() if a}
         self._invalidate_counts()
         self.converter.weights.unpack(obj["weights"])
 

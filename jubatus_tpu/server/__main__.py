@@ -7,7 +7,6 @@
 
 from __future__ import annotations
 
-import logging
 import signal
 import sys
 
@@ -17,10 +16,11 @@ from jubatus_tpu.server.base import EngineServer
 
 def main(argv=None) -> int:
     args = parse_server_args(argv)
-    logging.basicConfig(
-        level=logging.INFO,
-        format=f"%(asctime)s %(levelname)s [{args.engine}:{args.rpc_port}] %(message)s",
-    )
+    from jubatus_tpu.utils.logger import install_sighup_reload, setup
+
+    setup(f"juba{args.engine}", args.eth, args.rpc_port,
+          logdir=args.logdir, log_config=args.log_config)
+    install_sighup_reload(args.log_config)
     if args.config_test:
         # dry-construct and exit (server_util.hpp:142-152)
         try:

@@ -23,6 +23,7 @@ class ServerArgs:
     timeout: float = 10.0               # -t
     datadir: str = "/tmp"               # -d
     logdir: str = ""                    # -l
+    log_config: str = ""                # -g (server_util.cpp:70-127)
     configpath: str = ""                # -f
     model_file: str = ""                # -m
     daemon: bool = False                # -D
@@ -74,6 +75,8 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
     p.add_argument("-t", "--timeout", type=float, default=10.0)
     p.add_argument("-d", "--datadir", default="/tmp")
     p.add_argument("-l", "--logdir", default="")
+    p.add_argument("-g", "--log-config", default="",
+                   help="logging dictConfig JSON; hot-reloaded on SIGHUP")
     p.add_argument("-f", "--configpath", default="")
     p.add_argument("-m", "--model-file", default="")
     p.add_argument("-D", "--daemon", action="store_true")

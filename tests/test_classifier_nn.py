@@ -106,16 +106,60 @@ def test_mix_merges_examples():
         assert max(res[1], key=lambda s: s[1])[0] == "neg"
 
 
+def _mix_labels(x, y):
+    """One label-mix round between two drivers (both apply the fold)."""
+    mx, my = x.get_mixables()["labels"], y.get_mixables()["labels"]
+    folded = mx.mix(mx.get_diff(), my.get_diff())
+    mx.put_diff(folded)
+    my.put_diff(folded)
+
+
 def test_set_label_propagates_via_mix():
     """A label registered on one replica (no examples yet) reaches the
     other through the labels mixable."""
     a = ClassifierNNDriver(_conf("cosine"))
     b = ClassifierNNDriver(_conf("cosine"))
     a.set_label("early")
-    ml_a, ml_b = a.get_mixables()["labels"], b.get_mixables()["labels"]
-    folded = ml_a.mix(ml_a.get_diff(), ml_b.get_diff())
-    ml_b.put_diff(folded)
+    _mix_labels(a, b)
     assert b.get_labels() == {"early": 0}
+
+
+def test_label_diff_is_not_destructive():
+    """get_diff ships full state: a failed exchange loses nothing and the
+    next round still delivers (the delta design dropped labels on peer
+    failure)."""
+    a = ClassifierNNDriver(_conf("cosine"))
+    a.set_label("x")
+    m = a.get_mixables()["labels"]
+    first = m.get_diff()
+    second = m.get_diff()  # e.g. retry after a dead peer
+    assert first == second and "x" in second
+
+
+def test_delete_label_tombstone_beats_stale_registration():
+    """A cluster-wide delete is not resurrected by an idle replica that
+    still ships the old registration in its full-state diff."""
+    a = ClassifierNNDriver(_conf("cosine"))
+    b = ClassifierNNDriver(_conf("cosine"))
+    a.set_label("spam")
+    _mix_labels(a, b)  # both replicas now know 'spam'
+    assert b.get_labels() == {"spam": 0}
+    a.delete_label("spam")  # higher epoch tombstone on a
+    _mix_labels(a, b)  # b's stale alive-state must lose
+    assert a.get_labels() == {} and b.get_labels() == {}
+    # and further idle rounds keep it dead
+    _mix_labels(b, a)
+    assert a.get_labels() == {}
+
+
+def test_label_propagates_transitively():
+    """Full-state diffs gossip transitively: a → b, then b → c, without a
+    ever talking to c."""
+    a, b, c = (ClassifierNNDriver(_conf("cosine")) for _ in range(3))
+    a.set_label("relay")
+    _mix_labels(a, b)
+    _mix_labels(b, c)
+    assert c.get_labels() == {"relay": 0}
 
 
 def test_local_sensitivity_sharpness():

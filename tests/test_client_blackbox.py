@@ -1,0 +1,76 @@
+"""Black-box cluster tests (≙ client_test/*.cpp driven by jubatest env
+vars, SURVEY.md §4 tier 6).
+
+Run against ANY live deployment — standalone server, cluster member, or
+proxy — selected entirely by environment variables, exactly like the
+reference's harness (client_test/util.hpp:24-55):
+
+    JUBATUS_HOST=127.0.0.1 JUBATUS_PORT=9199 JUBATUS_CLUSTER_NAME=c1 \\
+        python -m pytest tests/test_client_blackbox.py -q
+
+Skipped when JUBATUS_HOST/JUBATUS_PORT are unset (CI runs the in-process
+suites instead). Standalone vs cluster switches on an empty cluster name
+(util.hpp:52-54). JUBATUS_ENGINE picks the engine under test (default
+classifier).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+import pytest
+
+from jubatus_tpu.client import CLIENT_CLASSES, Datum
+
+HOST = os.environ.get("JUBATUS_HOST", "")
+PORT = int(os.environ.get("JUBATUS_PORT", "0") or 0)
+NAME = os.environ.get("JUBATUS_CLUSTER_NAME", "")
+ENGINE = os.environ.get("JUBATUS_ENGINE", "classifier")
+TIMEOUT = float(os.environ.get("JUBATUS_TIMEOUT", "10"))
+
+pytestmark = pytest.mark.skipif(
+    not HOST or not PORT,
+    reason="set JUBATUS_HOST/JUBATUS_PORT to run black-box cluster tests",
+)
+
+
+@pytest.fixture()
+def client():
+    c = CLIENT_CLASSES[ENGINE](HOST, PORT, NAME, timeout=TIMEOUT)
+    yield c
+    c.close()
+
+
+def test_get_config_is_json(client):
+    import json
+
+    conf = json.loads(client.get_config())
+    assert isinstance(conf, dict)
+
+
+def test_get_status_shape(client):
+    st = client.get_status()
+    assert st, "empty status map"
+    for node, entries in st.items():
+        assert "_" in node  # "<ip>_<port>"
+        assert "uptime" in entries
+
+
+def test_save_returns_path_map(client):
+    model_id = f"bb_{uuid.uuid4().hex[:8]}"
+    paths = client.save(model_id)
+    assert paths and all(model_id in p for p in paths.values())
+
+
+@pytest.mark.skipif(ENGINE != "classifier", reason="classifier-only flow")
+def test_classifier_train_classify_roundtrip(client):
+    """≙ client_test/classifier_test.cpp:26-66 train/classify round trip."""
+    lab_a, lab_b = f"a_{uuid.uuid4().hex[:6]}", f"b_{uuid.uuid4().hex[:6]}"
+    n = client.train([[lab_a, Datum({"bbx": 1.0})],
+                      [lab_b, Datum({"bbx": -1.0})]])
+    assert n == 2
+    labels = client.get_labels()
+    assert lab_a in labels and lab_b in labels
+    (res,) = client.classify([Datum({"bbx": 1.0})])
+    assert {lab for lab, _ in res} >= {lab_a, lab_b}

@@ -11,6 +11,7 @@ import pytest
 
 from jubatus_tpu.codegen import (
     emit_python_client,
+    emit_rst,
     emit_service_table,
     parse_idl,
     to_methods,
@@ -86,6 +87,29 @@ def test_emit_python_client_compiles():
     cls = ns["ClassifierClient"]
     assert cls.ENGINE == "classifier"
     assert hasattr(cls, "train") and hasattr(cls, "clear")
+
+
+def test_emit_rst_includes_docs():
+    idl = parse_idl(
+        "service s {\n"
+        "  #- Trains the thing.\n"
+        "  #@random #@nolock #@pass\n"
+        "  int train(0: string x)\n"
+        "}\n"
+    )
+    assert idl.service("s").methods[0].docs == ["Trains the thing."]
+    rst = emit_rst(idl, "s")
+    assert ".. function:: int train(string x)" in rst
+    assert ":routing: random" in rst
+    assert "Trains the thing." in rst
+
+
+@needs_reference
+def test_emit_rst_all_reference_services():
+    for engine, idl in parse_reference_idls(REFERENCE_IDL_DIR).items():
+        rst = emit_rst(idl, engine)
+        assert f"{engine} API" in rst
+        assert ".. function::" in rst
 
 
 # -- parity with the reference ------------------------------------------------

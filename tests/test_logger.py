@@ -1,0 +1,52 @@
+"""Logging setup tests (≙ the reference's log_config + SIGHUP contract)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+
+from jubatus_tpu.utils import logger as jlog
+
+
+def _cleanup():
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        root.removeHandler(h)
+
+
+def test_logdir_writes_file(tmp_path):
+    try:
+        jlog.setup("jubatest", "1.2.3.4", 9, logdir=str(tmp_path))
+        logging.getLogger("x").info("hello-logdir")
+        for h in logging.getLogger().handlers:
+            h.flush()
+        content = (tmp_path / "jubatest.log").read_text()
+        assert "hello-logdir" in content
+        assert "[jubatest:1.2.3.4:9]" in content
+    finally:
+        _cleanup()
+
+
+def test_log_config_and_sighup_reload(tmp_path):
+    conf = tmp_path / "log.json"
+
+    def write(level):
+        conf.write_text(json.dumps({
+            "version": 1,
+            "root": {"level": level, "handlers": []},
+        }))
+
+    try:
+        write("WARNING")
+        jlog.setup("jubatest", log_config=str(conf))
+        assert logging.getLogger().level == logging.WARNING
+        jlog.install_sighup_reload(str(conf))
+        write("DEBUG")
+        os.kill(os.getpid(), signal.SIGHUP)
+        assert logging.getLogger().level == logging.DEBUG
+    finally:
+        signal.signal(signal.SIGHUP, signal.SIG_DFL)
+        logging.getLogger().setLevel(logging.WARNING)
+        _cleanup()
