@@ -44,6 +44,8 @@ def create_coordinator(spec: str) -> Coordinator:
     "" → None-like in the reference means standalone; callers handle that.
     "memory" / "memory://"        → process-local MemoryCoordinator
     "/path" / "file:///path"      → FileCoordinator on that directory
+    "tcp://host:port", "host:port" → RemoteCoordinator session on the
+                                     coordination service (coord/server.py)
     """
     if spec in ("memory", "memory://"):
         return MemoryCoordinator.shared()
@@ -51,4 +53,9 @@ def create_coordinator(spec: str) -> Coordinator:
         return FileCoordinator(spec[len("file://") :])
     if spec.startswith("/") or spec.startswith("."):
         return FileCoordinator(spec)
+    if spec.startswith("tcp://") or (":" in spec and
+                                     spec.rpartition(":")[2].isdigit()):
+        from jubatus_tpu.coord.remote import RemoteCoordinator
+
+        return RemoteCoordinator.from_locator(spec)
     raise CoordinatorError(f"unsupported coordinator spec {spec!r}")

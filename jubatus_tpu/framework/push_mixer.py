@@ -171,9 +171,9 @@ class RpcPushMixer(RpcLinearMixer):
         schemas, fold my diff with the peer's, apply the fold on both
         sides."""
         with self.comm.peer_session(peer) as sess:
-            return self._exchange_on(sess)
+            return self._exchange_on(sess, peer.name)
 
-    def _exchange_on(self, sess) -> int:
+    def _exchange_on(self, sess, peer_name: str = "?") -> int:
         # phase 1: schema alignment — row-keyed diffs (classifier labels,
         # stat keys) must agree on the row vocabulary BEFORE diffing, same
         # as the linear round's phase 1
@@ -192,7 +192,7 @@ class RpcPushMixer(RpcLinearMixer):
         mine = unpack_obj(self.local_get_diff())
         hers = unpack_obj(sess.get_diff())
         if hers.get("protocol") != PROTOCOL_VERSION:
-            raise RuntimeError("protocol mismatch from peer")
+            raise RuntimeError(f"protocol mismatch from {peer_name}")
         mixables = self.driver.get_mixables()
         totals: Dict[str, Any] = {}
         for name, mixable in mixables.items():

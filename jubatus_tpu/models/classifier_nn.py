@@ -274,6 +274,9 @@ class ClassifierNNDriver(DriverBase):
             _as_label(k): (int(v[0]), bool(v[1]))
             for k, v in (obj.get("label_states") or {}).items()
         }
+        # checkpoints from before the LWW state map carried a plain list
+        for r in obj.get("registered", []):
+            self._label_states.setdefault(_as_label(r), (0, True))
         self.registered = {k for k, (_e, a) in self._label_states.items() if a}
         self._invalidate_counts()
         self.converter.weights.unpack(obj["weights"])

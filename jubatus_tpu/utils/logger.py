@@ -48,7 +48,12 @@ def setup(progname: str, host: str = "", port: int = 0,
 def apply_config_file(path: str) -> None:
     """JSON dictConfig (the Python-native stand-in for log4cxx XML)."""
     with open(path) as f:
-        logging.config.dictConfig(json.load(f))
+        cfg = json.load(f)
+    # module-level loggers created before setup() must stay enabled unless
+    # the config explicitly says otherwise (dictConfig defaults to True,
+    # which would silently mute every jubatus module logger)
+    cfg.setdefault("disable_existing_loggers", False)
+    logging.config.dictConfig(cfg)
 
 
 def install_sighup_reload(log_config: str) -> None:
