@@ -28,7 +28,10 @@ DIM_BITS = 20
 D = 1 << DIM_BITS
 L = 2
 K = 64
-BATCH = 4096
+# microbatch = bounded-staleness window (SURVEY.md §7 hard part b). 8192
+# measured ~12% faster than 4096 on v5e while keeping the window tighter
+# than one mix interval (512 updates/batch-count thresholds scale with it).
+BATCH = 8192
 WARMUP_STEPS = 2
 STEPS = 20
 BASELINE_EXAMPLES = 2000

@@ -85,6 +85,11 @@ class MemoryCoordinator(Coordinator):
 
     def create(self, path: str, payload: bytes = b"", ephemeral: bool = False) -> bool:
         with self._store.lock:
+            # a closed session must not leave ephemerals behind: close()
+            # already swept its nodes, so anything created after would be
+            # orphaned forever (dead member stuck in the registry)
+            if ephemeral and self._closed:
+                return False
             if path in self._store.nodes:
                 return False
             self._mkparents(path)
@@ -95,6 +100,8 @@ class MemoryCoordinator(Coordinator):
 
     def create_seq(self, path: str, payload: bytes = b"") -> Optional[str]:
         with self._store.lock:
+            if self._closed:
+                return None
             actual = f"{path}{next(self._store.seq):010d}"
             self._mkparents(actual)
             self._store.nodes[actual] = (payload, self._session)
@@ -153,6 +160,8 @@ class MemoryCoordinator(Coordinator):
     # -- locks ---------------------------------------------------------------
     def try_lock(self, path: str) -> bool:
         with self._store.lock:
+            if self._closed:
+                return False  # a dead session's lock would never release
             if path in self._store.locks:
                 return self._store.locks[path] == self._session
             self._store.locks[path] = self._session

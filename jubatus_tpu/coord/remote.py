@@ -85,8 +85,11 @@ class RemoteCoordinator(Coordinator):
         fire every delete watcher (suicide path), then close."""
         log.error("coordination session lost; firing delete watchers")
         with self._lock:
-            watchers = [(p, fn) for p, fns in self._delete_watchers.items()
-                        for fn in fns]
+            # take ownership atomically: the watch loop pops under the same
+            # lock, so no watcher can fire twice (once from each thread)
+            taken = self._delete_watchers
+            self._delete_watchers = {}
+        watchers = [(p, fn) for p, fns in taken.items() for fn in fns]
         for path, fn in watchers:
             try:
                 fn(path)
@@ -130,10 +133,12 @@ class RemoteCoordinator(Coordinator):
 
     # -- watchers (client-side polling) ---------------------------------------
     def _ensure_watch_thread(self) -> None:
-        if self._watch_thread is None:
+        with self._lock:
+            if self._watch_thread is not None:
+                return
             self._watch_thread = threading.Thread(
                 target=self._watch_loop, daemon=True, name="coord-remote-watch")
-            self._watch_thread.start()
+        self._watch_thread.start()
 
     def _watch_loop(self) -> None:
         while not self._hb_stop.wait(_WATCH_POLL_SEC):

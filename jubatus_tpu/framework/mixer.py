@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from jubatus_tpu.utils.tracing import span
+
 
 class IntervalMixer:
     POLL_SEC = 0.5  # linear_mixer.cpp:372-374
@@ -55,7 +57,7 @@ class IntervalMixer:
         """Execute one mix round WITHOUT holding the condition lock: updated()
         callers (the train hot path) must never block behind a collective.
         _mix_serialize keeps concurrent mix_now/loop rounds from overlapping."""
-        with self._mix_serialize:
+        with self._mix_serialize, span("mix.round"):
             with self._cond:
                 self._counter = 0
             start = time.monotonic()

@@ -27,6 +27,7 @@ from jubatus_tpu.rpc.errors import (
     RpcMethodNotFound,
     error_to_wire,
 )
+from jubatus_tpu.utils.tracing import span
 
 log = logging.getLogger(__name__)
 
@@ -179,7 +180,8 @@ class RpcServer:
         want = self._arity.get(method)
         if want is not None and len(params) != want:
             raise TypeError(f"{method}: expected {want} params, got {len(params)}")
-        return fn(*params)
+        with span(f"rpc.{method}"):
+            return fn(*params)
 
     def _invoke_silent(self, method: str, params: Any) -> None:
         try:

@@ -26,6 +26,7 @@ from jubatus_tpu.framework.save_load import load_model, save_model
 from jubatus_tpu.rpc.server import RpcServer
 from jubatus_tpu.server.args import ServerArgs
 from jubatus_tpu.server.factory import create_driver
+from jubatus_tpu.utils.tracing import trace_status
 from jubatus_tpu.version import __version__
 
 log = logging.getLogger(__name__)
@@ -50,6 +51,7 @@ class EngineServer:
         self.last_loaded = 0.0
         self.rpc = RpcServer(timeout=self.args.timeout)
         self._stop_event = threading.Event()
+        self._stop_once = threading.Lock()  # first stop() wins; rest no-op
 
         # distributed wiring (server_helper ctor path, server_helper.cpp:48-78)
         self.coord = coord
@@ -171,6 +173,8 @@ class EngineServer:
         st.update({f"driver.{k}": v for k, v in self.driver.get_status().items()})
         if self.mixer is not None:
             st.update({f"mixer.{k}": v for k, v in self.mixer.get_status().items()})
+        # span aggregates (SURVEY §5: tracing the reference never had)
+        st.update(trace_status())
         node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
         return {node.name: st}
 
@@ -221,9 +225,13 @@ class EngineServer:
         self._stop_event.wait()
 
     def stop(self) -> None:
+        # reentry-safe: the suicide watcher and a lost coordinator session
+        # can both call stop() concurrently from different threads
+        if not self._stop_once.acquire(blocking=False):
+            return
+        self._stop_event.set()
         if self.mixer is not None:
             self.mixer.stop()
         if self.coord is not None:
             self.coord.close()
         self.rpc.stop()
-        self._stop_event.set()

@@ -1,0 +1,84 @@
+"""Tracing & profiling (SURVEY.md §5: the reference has NONE — its closest
+facility is per-round mix timing logs. This subsystem is the first-class
+improvement the survey calls for).
+
+Two layers:
+
+- **Span aggregates** (always on, ~100 ns/span): every RPC dispatch and
+  every mix round records into per-name aggregates (count / total / max /
+  last seconds). ``trace_status()`` flattens them into the ``get_status``
+  map, so operators see p50-ish latencies per method cluster-wide through
+  the same RPC the reference exposes counters on.
+- **XLA device traces** (opt-in): ``device_trace()`` wraps
+  ``jax.profiler.trace`` when ``JUBATUS_TPU_TRACE_DIR`` is set (or a dir
+  is passed), capturing TensorBoard-viewable TPU timelines of the jitted
+  update/mix kernels. A no-op otherwise — zero cost in production.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional
+
+_lock = threading.Lock()
+_aggregates: Dict[str, Dict[str, float]] = {}
+
+
+@contextlib.contextmanager
+def span(name: str) -> Iterator[None]:
+    """Time a block into the process-wide aggregates."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record(name, time.perf_counter() - t0)
+
+
+def record(name: str, seconds: float) -> None:
+    """Record an externally-timed duration under a span name."""
+    with _lock:
+        agg = _aggregates.get(name)
+        if agg is None:
+            agg = _aggregates[name] = {
+                "count": 0, "total_s": 0.0, "max_s": 0.0, "last_s": 0.0}
+        agg["count"] += 1
+        agg["total_s"] += seconds
+        agg["last_s"] = seconds
+        if seconds > agg["max_s"]:
+            agg["max_s"] = seconds
+
+
+def trace_status(prefix: str = "trace") -> Dict[str, Any]:
+    """Flattened aggregates for get_status maps: trace.<name>.{count,
+    mean_ms,max_ms,last_ms}."""
+    out: Dict[str, Any] = {}
+    with _lock:
+        for name, agg in _aggregates.items():
+            n = int(agg["count"]) or 1
+            out[f"{prefix}.{name}.count"] = int(agg["count"])
+            out[f"{prefix}.{name}.mean_ms"] = round(agg["total_s"] / n * 1e3, 3)
+            out[f"{prefix}.{name}.max_ms"] = round(agg["max_s"] * 1e3, 3)
+            out[f"{prefix}.{name}.last_ms"] = round(agg["last_s"] * 1e3, 3)
+    return out
+
+
+def reset() -> None:
+    with _lock:
+        _aggregates.clear()
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: Optional[str] = None) -> Iterator[None]:
+    """XLA/TPU profiler capture around a block — TensorBoard format.
+    No-op unless a directory is given or JUBATUS_TPU_TRACE_DIR is set."""
+    trace_dir = trace_dir or os.environ.get("JUBATUS_TPU_TRACE_DIR", "")
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

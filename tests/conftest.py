@@ -8,6 +8,10 @@ virtual CPU devices via --xla_force_host_platform_device_count.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# subprocesses spawned by tests (jubavisor children) must not touch the
+# real TPU tunnel: their sitecustomize re-pins JAX_PLATFORMS=axon, so the
+# server main honors this override instead (server/__main__.py)
+os.environ["JUBATUS_TPU_PLATFORM"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,87 @@
+"""Tracing subsystem tests (SURVEY.md §5: the improvement over the
+reference's timing-log-only observability)."""
+
+from __future__ import annotations
+
+import pytest
+
+from jubatus_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    tracing.reset()
+    yield
+    tracing.reset()
+
+
+def test_span_aggregates():
+    for _ in range(3):
+        with tracing.span("unit.op"):
+            pass
+    st = tracing.trace_status()
+    assert st["trace.unit.op.count"] == 3
+    assert st["trace.unit.op.mean_ms"] >= 0.0
+    assert st["trace.unit.op.max_ms"] >= st["trace.unit.op.mean_ms"]
+
+
+def test_span_records_on_exception():
+    with pytest.raises(ValueError):
+        with tracing.span("unit.boom"):
+            raise ValueError("x")
+    assert tracing.trace_status()["trace.unit.boom.count"] == 1
+
+
+def test_record_external():
+    tracing.record("ext", 0.25)
+    st = tracing.trace_status()
+    assert st["trace.ext.last_ms"] == 250.0
+
+
+def test_device_trace_noop_without_dir(monkeypatch):
+    monkeypatch.delenv("JUBATUS_TPU_TRACE_DIR", raising=False)
+    with tracing.device_trace():
+        pass  # must not require jax profiler machinery
+
+
+def test_device_trace_writes_profile(tmp_path):
+    import jax.numpy as jnp
+
+    with tracing.device_trace(str(tmp_path)):
+        float(jnp.sum(jnp.ones((8, 8))))
+    assert list(tmp_path.rglob("*")), "no profile artifacts written"
+
+
+def test_rpc_dispatch_records_spans():
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.rpc.server import RpcServer
+
+    srv = RpcServer()
+    srv.register("ping", lambda: "pong", arity=0)
+    port = srv.serve_background(0, host="127.0.0.1")
+    try:
+        with RpcClient("127.0.0.1", port) as c:
+            assert c.call("ping") == "pong"
+        st = tracing.trace_status()
+        assert st["trace.rpc.ping.count"] == 1
+    finally:
+        srv.stop()
+
+
+def test_server_status_includes_traces():
+    from jubatus_tpu.server import EngineServer
+
+    conf = {"method": "PA", "parameter": {},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    srv = EngineServer("classifier", conf)
+    from jubatus_tpu.client import ClassifierClient, Datum
+
+    port = srv.start(0)
+    try:
+        c = ClassifierClient("127.0.0.1", port, "")
+        c.train([["a", Datum({"x": 1.0})]])
+        (node_st,) = c.get_status().values()
+        assert node_st["trace.rpc.train.count"] >= 1
+        c.close()
+    finally:
+        srv.stop()
