@@ -28,9 +28,10 @@ DIM_BITS = 20
 D = 1 << DIM_BITS
 L = 2
 K = 64
-# microbatch = bounded-staleness window (SURVEY.md §7 hard part b). 8192
-# measured ~12% faster than 4096 on v5e while keeping the window tighter
-# than one mix interval (512 updates/batch-count thresholds scale with it).
+# microbatch = bounded-staleness window (SURVEY.md §7 hard part b): all
+# examples in a batch score against the batch-start snapshot. 8192 measured
+# ~12% faster than 4096 on v5e; deployments trading staleness for
+# throughput should scale --interval-count along with their batch size.
 BATCH = 8192
 WARMUP_STEPS = 2
 STEPS = 20

@@ -1,0 +1,83 @@
+"""Generate docs/api/<engine>.rst from the framework's own routing tables
+(framework/idl.py) — run after changing the tables:
+
+    python docs/generate.py
+"""
+
+from __future__ import annotations
+
+import os
+
+from jubatus_tpu.framework.idl import SERVICES
+
+DESCRIPTIONS = {
+    "anomaly": "Online outlier detection (LOF / light-LOF over "
+               "approximate nearest-neighbor backends).",
+    "bandit": "Multi-armed bandit policies (epsilon-greedy, softmax, "
+              "Exp3, UCB1) keyed by player.",
+    "burst": "Kleinberg burst detection over keyword document streams.",
+    "classifier": "Online multi-class classification: linear "
+                  "(perceptron/PA/PA1/PA2/CW/AROW/NHERD) and "
+                  "instance-based (NN/cosine/euclidean) methods.",
+    "clustering": "Online clustering (k-means / GMM / DBSCAN) over "
+                  "weighted point buckets.",
+    "graph": "Distributed property graph with centrality and "
+             "shortest-path preset queries.",
+    "nearest_neighbor": "Approximate nearest neighbor search "
+                        "(LSH / minhash / euclid-LSH signatures).",
+    "recommender": "Similarity search and row completion over sparse "
+                   "feature rows.",
+    "regression": "Online linear regression (passive-aggressive).",
+    "stat": "Windowed per-key statistics (sum/stddev/max/min/entropy/"
+            "moment).",
+    "weight": "fv_converter weight inspection — debug the feature "
+              "extraction pipeline.",
+}
+
+BUILTINS = [
+    ("get_config() -> str", "the engine's JSON config"),
+    ("save(id) -> {server: path}", "checkpoint every server's model"),
+    ("load(id) -> bool", "restore a checkpoint"),
+    ("get_status() -> {server: {...}}", "uptime/memory/counters/trace spans"),
+    ("do_mix() -> bool", "trigger a mix round now"),
+]
+
+
+def emit(engine: str, methods) -> str:
+    title = f"{engine} service"
+    out = [title, "=" * len(title), "", DESCRIPTIONS[engine], "",
+           "Every call carries the cluster name as its first wire "
+           "parameter; the same client works against a standalone server, "
+           "a cluster member, or a proxy.", "",
+           "Methods", "-------", ""]
+    for m in methods:
+        if m.routing == "internal":
+            continue
+        args = ", ".join(m.args)
+        routing = m.routing + (f"({m.cht_n})" if m.routing == "cht" else "")
+        out.append(f"``{m.name}({args})``")
+        out.append(f"   routing **{routing}**"
+                   + (f", aggregator **{m.aggregator}**"
+                      if m.routing in ("broadcast", "cht") else "")
+                   + f", lock *{m.lock}*")
+        out.append("")
+    out += ["Built-ins", "---------", ""]
+    for sig, desc in BUILTINS:
+        out.append(f"``{sig}``")
+        out.append(f"   {desc}")
+        out.append("")
+    return "\n".join(out)
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    api = os.path.join(here, "api")
+    os.makedirs(api, exist_ok=True)
+    for engine, methods in sorted(SERVICES.items()):
+        with open(os.path.join(api, f"{engine}.rst"), "w") as f:
+            f.write(emit(engine, methods) + "\n")
+    print(f"wrote {len(SERVICES)} files to {api}")
+
+
+if __name__ == "__main__":
+    main()

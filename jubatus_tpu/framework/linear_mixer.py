@@ -276,6 +276,10 @@ class RpcLinearMixer:
                 {"protocol": PROTOCOL_VERSION, "model": self.driver.pack()}
             )
 
+    def set_trace_registry(self, registry) -> None:
+        """Route mix.round spans into the owning server's registry."""
+        self._scheduler.trace = registry
+
     # -- scheduling (≙ stabilizer_loop) --------------------------------------
     def start(self) -> None:
         self._scheduler.start()

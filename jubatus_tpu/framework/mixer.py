@@ -14,7 +14,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
-from jubatus_tpu.utils.tracing import span
+from jubatus_tpu.utils.tracing import Registry, default_registry
 
 
 class IntervalMixer:
@@ -30,6 +30,8 @@ class IntervalMixer:
         self._mix_fn = mix_fn
         self.interval_sec = interval_sec
         self.interval_count = interval_count
+        #: set by the owning server so mix spans land in ITS registry
+        self.trace: Registry = default_registry()
         self._counter = 0
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -57,7 +59,7 @@ class IntervalMixer:
         """Execute one mix round WITHOUT holding the condition lock: updated()
         callers (the train hot path) must never block behind a collective.
         _mix_serialize keeps concurrent mix_now/loop rounds from overlapping."""
-        with self._mix_serialize, span("mix.round"):
+        with self._mix_serialize, self.trace.span("mix.round"):
             with self._cond:
                 self._counter = 0
             start = time.monotonic()

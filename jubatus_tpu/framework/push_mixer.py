@@ -220,6 +220,9 @@ class DummyMixer:
     def register_api(self, rpc_server, name_check: str = "") -> None:
         pass
 
+    def set_trace_registry(self, registry) -> None:
+        pass
+
     def start(self) -> None:
         pass
 

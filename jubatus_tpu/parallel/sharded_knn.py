@@ -1,0 +1,79 @@
+"""Mesh-sharded similarity scans — the TPU replacement for CHT row
+sharding (SURVEY.md §5 "long-context": the reference scales a dimension
+across nodes with consistent-hash row placement, cht.cpp:107-143; on a
+pod the same capacity scaling is a static shard of the signature table
+over the mesh's ``shard`` axis).
+
+One query batch fans out to every shard implicitly (the table is sharded,
+the query replicated), each device scans its slice of the table with the
+same kernels the single-chip path uses (ops/knn; pallas on TPU), takes a
+LOCAL top-k, and one tiny all_gather of [k]-sized candidates merges the
+global top-k — O(shards·k) bytes over ICI instead of O(rows).
+
+Row placement: ``coord.cht.shard_for(row_id, n_shards)`` keeps placement
+stable and hash-based like the ring; slot index within the shard is the
+store's business. Global ids returned by queries are ``shard * capacity +
+local_slot`` — decode with ``divmod(gid, capacity)``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def shard_table(mesh: Mesh, table, axis: str = "shard"):
+    """Place [C, W] signature rows sharded over the mesh axis (C must be a
+    multiple of the axis size; pad the store capacity to match)."""
+    return jax.device_put(table, NamedSharding(mesh, P(axis, None)))
+
+
+def replicate(mesh: Mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "hash_num", "k", "axis"))
+def sharded_hamming_topk(
+    mesh: Mesh,
+    q_sigs: jax.Array,    # [B, W] uint32, replicated
+    row_sigs: jax.Array,  # [C, W] uint32, sharded over `axis`
+    *,
+    hash_num: int,
+    k: int,
+    axis: str = "shard",
+) -> Tuple[jax.Array, jax.Array]:
+    """Global top-k nearest (smallest hamming distance) over the sharded
+    table. Returns (distances [B, k], global row indices [B, k])."""
+    from jubatus_tpu.ops import knn
+
+    n_shards = mesh.shape[axis]
+    c_local = row_sigs.shape[0] // n_shards
+
+    def scan(q, rows):
+        # per-device: full scan of my slice + local top-k
+        d = knn._hamming_distances_batch_xla(q, rows, hash_num=hash_num)
+        kk = min(k, rows.shape[0])
+        neg, idx = jax.lax.top_k(-d, kk)                    # [B, kk]
+        shard_id = jax.lax.axis_index(axis)
+        gidx = idx + shard_id * c_local                     # global ids
+        # merge across shards: gather the tiny candidate sets
+        negs = jax.lax.all_gather(neg, axis, tiled=False)   # [S, B, kk]
+        gidxs = jax.lax.all_gather(gidx, axis, tiled=False)
+        s = negs.shape[0]
+        negs = jnp.transpose(negs, (1, 0, 2)).reshape(q.shape[0], s * kk)
+        gidxs = jnp.transpose(gidxs, (1, 0, 2)).reshape(q.shape[0], s * kk)
+        top_neg, pos = jax.lax.top_k(negs, min(k, s * kk))
+        return -top_neg, jnp.take_along_axis(gidxs, pos, axis=1)
+
+    spec_rows = P(axis, None)
+    fn = jax.shard_map(
+        scan, mesh=mesh,
+        in_specs=(P(), spec_rows),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return fn(q_sigs, row_sigs)

@@ -27,7 +27,7 @@ from jubatus_tpu.rpc.errors import (
     RpcMethodNotFound,
     error_to_wire,
 )
-from jubatus_tpu.utils.tracing import span
+from jubatus_tpu.utils.tracing import Registry
 
 log = logging.getLogger(__name__)
 
@@ -41,10 +41,14 @@ class RpcServer:
     rpc_server.hpp): ``serve_background()`` is listen+start, ``stop()`` is end.
     """
 
-    def __init__(self, timeout: float = 10.0) -> None:
+    def __init__(self, timeout: float = 10.0,
+                 trace: Optional[Registry] = None) -> None:
         self._methods: Dict[str, Callable[..., Any]] = {}
         self._arity: Dict[str, Optional[int]] = {}
         self.timeout = timeout
+        #: per-server span aggregates (multi-server processes must not
+        #: merge each other's counters)
+        self.trace = trace or Registry()
         self._sock: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -180,7 +184,7 @@ class RpcServer:
         want = self._arity.get(method)
         if want is not None and len(params) != want:
             raise TypeError(f"{method}: expected {want} params, got {len(params)}")
-        with span(f"rpc.{method}"):
+        with self.trace.span(f"rpc.{method}"):
             return fn(*params)
 
     def _invoke_silent(self, method: str, params: Any) -> None:

@@ -26,7 +26,6 @@ from jubatus_tpu.framework.save_load import load_model, save_model
 from jubatus_tpu.rpc.server import RpcServer
 from jubatus_tpu.server.args import ServerArgs
 from jubatus_tpu.server.factory import create_driver
-from jubatus_tpu.utils.tracing import trace_status
 from jubatus_tpu.version import __version__
 
 log = logging.getLogger(__name__)
@@ -70,6 +69,7 @@ class EngineServer:
                 interval_sec=self.args.interval_sec,
                 interval_count=self.args.interval_count,
             )
+            self.mixer.set_trace_registry(self.rpc.trace)
             # cluster-unique id minting for the engines that mint ids
             # (≙ global_id_generator_zk: anomaly add, graph create_node/edge)
             if hasattr(self.driver, "set_id_generator"):
@@ -173,8 +173,9 @@ class EngineServer:
         st.update({f"driver.{k}": v for k, v in self.driver.get_status().items()})
         if self.mixer is not None:
             st.update({f"mixer.{k}": v for k, v in self.mixer.get_status().items()})
-        # span aggregates (SURVEY §5: tracing the reference never had)
-        st.update(trace_status())
+        # span aggregates (SURVEY §5: tracing the reference never had) —
+        # this server's own registry, not the process default
+        st.update(self.rpc.trace.trace_status())
         node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
         return {node.name: st}
 
@@ -229,9 +230,11 @@ class EngineServer:
         # can both call stop() concurrently from different threads
         if not self._stop_once.acquire(blocking=False):
             return
-        self._stop_event.set()
         if self.mixer is not None:
             self.mixer.stop()
         if self.coord is not None:
             self.coord.close()
         self.rpc.stop()
+        # released LAST: join() must not return (ending main and killing the
+        # daemon threads mid-teardown) before the session closed cleanly
+        self._stop_event.set()

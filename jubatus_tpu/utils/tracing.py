@@ -23,51 +23,74 @@ import threading
 import time
 from typing import Any, Dict, Iterator, Optional
 
-_lock = threading.Lock()
-_aggregates: Dict[str, Dict[str, float]] = {}
+class Registry:
+    """One set of span aggregates. Each server owns its own so multi-server
+    processes (tests, embedded clusters) attribute spans per node; the
+    module-level functions use a process default."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._aggregates: Dict[str, Dict[str, float]] = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, time.perf_counter() - t0)
+
+    def record(self, name: str, seconds: float) -> None:
+        with self._lock:
+            agg = self._aggregates.get(name)
+            if agg is None:
+                agg = self._aggregates[name] = {
+                    "count": 0, "total_s": 0.0, "max_s": 0.0, "last_s": 0.0}
+            agg["count"] += 1
+            agg["total_s"] += seconds
+            agg["last_s"] = seconds
+            if seconds > agg["max_s"]:
+                agg["max_s"] = seconds
+
+    def trace_status(self, prefix: str = "trace") -> Dict[str, Any]:
+        """Flattened aggregates for get_status maps: trace.<name>.{count,
+        mean_ms,max_ms,last_ms}."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for name, agg in self._aggregates.items():
+                n = int(agg["count"]) or 1
+                out[f"{prefix}.{name}.count"] = int(agg["count"])
+                out[f"{prefix}.{name}.mean_ms"] = round(agg["total_s"] / n * 1e3, 3)
+                out[f"{prefix}.{name}.max_ms"] = round(agg["max_s"] * 1e3, 3)
+                out[f"{prefix}.{name}.last_ms"] = round(agg["last_s"] * 1e3, 3)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._aggregates.clear()
 
 
-@contextlib.contextmanager
-def span(name: str) -> Iterator[None]:
-    """Time a block into the process-wide aggregates."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        record(name, time.perf_counter() - t0)
+_default = Registry()
+
+
+def default_registry() -> Registry:
+    return _default
+
+
+def span(name: str):
+    return _default.span(name)
 
 
 def record(name: str, seconds: float) -> None:
-    """Record an externally-timed duration under a span name."""
-    with _lock:
-        agg = _aggregates.get(name)
-        if agg is None:
-            agg = _aggregates[name] = {
-                "count": 0, "total_s": 0.0, "max_s": 0.0, "last_s": 0.0}
-        agg["count"] += 1
-        agg["total_s"] += seconds
-        agg["last_s"] = seconds
-        if seconds > agg["max_s"]:
-            agg["max_s"] = seconds
+    _default.record(name, seconds)
 
 
 def trace_status(prefix: str = "trace") -> Dict[str, Any]:
-    """Flattened aggregates for get_status maps: trace.<name>.{count,
-    mean_ms,max_ms,last_ms}."""
-    out: Dict[str, Any] = {}
-    with _lock:
-        for name, agg in _aggregates.items():
-            n = int(agg["count"]) or 1
-            out[f"{prefix}.{name}.count"] = int(agg["count"])
-            out[f"{prefix}.{name}.mean_ms"] = round(agg["total_s"] / n * 1e3, 3)
-            out[f"{prefix}.{name}.max_ms"] = round(agg["max_s"] * 1e3, 3)
-            out[f"{prefix}.{name}.last_ms"] = round(agg["last_s"] * 1e3, 3)
-    return out
+    return _default.trace_status(prefix)
 
 
 def reset() -> None:
-    with _lock:
-        _aggregates.clear()
+    _default.reset()
 
 
 @contextlib.contextmanager

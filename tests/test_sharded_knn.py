@@ -1,0 +1,74 @@
+"""Mesh-sharded similarity scan tests on the virtual 8-device CPU mesh
+(the CHT-row-sharding replacement, SURVEY.md §5)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jubatus_tpu.ops import knn
+from jubatus_tpu.parallel.mesh import replica_mesh
+from jubatus_tpu.parallel.sharded_knn import (
+    replicate,
+    shard_table,
+    sharded_hamming_topk,
+)
+from jax.sharding import Mesh
+
+
+@pytest.fixture(scope="module")
+def shard_mesh():
+    devices = np.asarray(jax.devices()[:8])
+    return Mesh(devices, axis_names=("shard",))
+
+
+def test_sharded_topk_matches_single_device(shard_mesh, rng):
+    B, C, W, k = 4, 1024, 4, 8
+    hash_num = W * 32
+    q = jnp.asarray(rng.integers(0, 2**32, size=(B, W), dtype=np.uint32))
+    rows = jnp.asarray(rng.integers(0, 2**32, size=(C, W), dtype=np.uint32))
+
+    dist, gidx = sharded_hamming_topk(
+        shard_mesh, replicate(shard_mesh, q),
+        shard_table(shard_mesh, rows), hash_num=hash_num, k=k)
+
+    # ground truth: unsharded full scan
+    full = np.asarray(knn._hamming_distances_batch_xla(q, rows,
+                                                       hash_num=hash_num))
+    want = np.sort(full, axis=1)[:, :k]
+    np.testing.assert_allclose(np.sort(np.asarray(dist), axis=1), want,
+                               atol=1e-6)
+    # indices must actually point at rows with those distances
+    d = np.asarray(dist)
+    g = np.asarray(gidx)
+    for b in range(B):
+        for j in range(k):
+            assert full[b, g[b, j]] == pytest.approx(d[b, j], abs=1e-6)
+
+
+def test_sharded_topk_exact_match_row(shard_mesh, rng):
+    B, C, W = 1, 512, 2
+    rows = jnp.asarray(rng.integers(0, 2**32, size=(C, W), dtype=np.uint32))
+    q = rows[137:138]  # exact row → distance 0 at global index 137
+    dist, gidx = sharded_hamming_topk(
+        shard_mesh, replicate(shard_mesh, q),
+        shard_table(shard_mesh, rows), hash_num=64, k=3)
+    assert float(dist[0, 0]) == 0.0
+    assert int(gidx[0, 0]) == 137
+
+
+def test_sharded_topk_k_larger_than_shard(shard_mesh, rng):
+    """k greater than any single shard's row count still yields the global
+    best k (merge must not truncate per-shard)."""
+    B, C, W, k = 2, 64, 2, 16  # 8 rows per shard < k
+    q = jnp.asarray(rng.integers(0, 2**32, size=(B, W), dtype=np.uint32))
+    rows = jnp.asarray(rng.integers(0, 2**32, size=(C, W), dtype=np.uint32))
+    dist, _ = sharded_hamming_topk(
+        shard_mesh, replicate(shard_mesh, q),
+        shard_table(shard_mesh, rows), hash_num=64, k=k)
+    full = np.asarray(knn._hamming_distances_batch_xla(q, rows, hash_num=64))
+    np.testing.assert_allclose(np.sort(np.asarray(dist), axis=1),
+                               np.sort(full, axis=1)[:, :k], atol=1e-6)

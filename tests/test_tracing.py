@@ -62,10 +62,29 @@ def test_rpc_dispatch_records_spans():
     try:
         with RpcClient("127.0.0.1", port) as c:
             assert c.call("ping") == "pong"
-        st = tracing.trace_status()
+        st = srv.trace.trace_status()
         assert st["trace.rpc.ping.count"] == 1
     finally:
         srv.stop()
+
+
+def test_per_server_span_isolation():
+    """Two servers in one process must not merge each other's counters."""
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.rpc.server import RpcServer
+
+    a, b = RpcServer(), RpcServer()
+    a.register("hit", lambda: 1, arity=0)
+    b.register("hit", lambda: 2, arity=0)
+    pa = a.serve_background(0, host="127.0.0.1")
+    b.serve_background(0, host="127.0.0.1")
+    try:
+        with RpcClient("127.0.0.1", pa) as c:
+            c.call("hit")
+        assert a.trace.trace_status()["trace.rpc.hit.count"] == 1
+        assert "trace.rpc.hit.count" not in b.trace.trace_status()
+    finally:
+        a.stop(), b.stop()
 
 
 def test_server_status_includes_traces():
