@@ -7,8 +7,11 @@
 from __future__ import annotations
 
 import os
+import sys
 
-from jubatus_tpu.framework.idl import SERVICES
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from jubatus_tpu.framework.idl import SERVICES  # noqa: E402
 
 DESCRIPTIONS = {
     "anomaly": "Online outlier detection (LOF / light-LOF over "

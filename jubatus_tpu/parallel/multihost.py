@@ -1,0 +1,89 @@
+"""Multi-host runtime init — the DCN half of the communication backend
+(SURVEY.md §5 "distributed communication backend": control/ingest stays
+RPC; the mix plane is XLA collectives over ICI within a slice and DCN
+across slices/hosts).
+
+``initialize()`` wraps ``jax.distributed.initialize`` with the
+framework's conventions: the coordinator address can come from the same
+``-z`` locator servers already carry (the coordination service stores
+the JAX coordinator endpoint under /jubatus/jax_coordinator, so only
+process 0 needs static config). After init, ``jax.devices()`` spans all
+hosts and the existing mesh builders (parallel/mesh.py) and SPMD steps
+(parallel/spmd.py) work unchanged — collectives ride ICI within a slice
+and DCN across.
+
+Single-host (or already-initialized) calls are no-ops, so servers can
+call this unconditionally at boot.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+from jubatus_tpu.coord.base import Coordinator
+
+log = logging.getLogger(__name__)
+
+JAX_COORD_PATH = "/jubatus/jax_coordinator"
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    coord: Optional[Coordinator] = None,
+    resolve_timeout: float = 60.0,
+) -> bool:
+    """Join the multi-host JAX runtime. Returns True if distributed init
+    ran, False when single-host / already initialized.
+
+    Endpoint resolution order: explicit ``coordinator_address``, then the
+    coordination store (process 0 publishes, others poll until
+    ``resolve_timeout``), then give up (single-host).
+
+    NOTE: must run before anything initializes the XLA backend — even
+    ``jax.process_count()``/``jax.devices()`` would do that, which is why
+    the already-initialized check uses ``jax.distributed.is_initialized``.
+    """
+    if jax.distributed.is_initialized():
+        return False
+    if coord is not None:
+        if process_id == 0:
+            if not coordinator_address:
+                raise ValueError("process 0 must pass coordinator_address "
+                                 "(its own reachable host:port) to publish")
+            publish_endpoint(coord, coordinator_address)  # BEFORE peers join
+        elif coordinator_address is None:
+            # fleets boot unordered: poll until process 0 publishes
+            import time
+
+            deadline = time.monotonic() + resolve_timeout
+            while True:
+                raw = coord.read(JAX_COORD_PATH)
+                if raw:
+                    coordinator_address = raw.decode()
+                    break
+                if time.monotonic() >= deadline:
+                    log.warning(
+                        "no JAX coordinator endpoint published within %.0fs; "
+                        "falling back to single-host", resolve_timeout)
+                    break
+                time.sleep(0.5)
+    if not coordinator_address or not num_processes or num_processes <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info("joined multi-host runtime: process %d/%d via %s",
+             jax.process_index(), jax.process_count(), coordinator_address)
+    return True
+
+
+def publish_endpoint(coord: Coordinator, address: str) -> None:
+    """Process 0 publishes the JAX coordinator endpoint for the fleet."""
+    coord.set(JAX_COORD_PATH, address.encode())

@@ -230,11 +230,13 @@ class EngineServer:
         # can both call stop() concurrently from different threads
         if not self._stop_once.acquire(blocking=False):
             return
-        if self.mixer is not None:
-            self.mixer.stop()
-        if self.coord is not None:
-            self.coord.close()
-        self.rpc.stop()
-        # released LAST: join() must not return (ending main and killing the
-        # daemon threads mid-teardown) before the session closed cleanly
-        self._stop_event.set()
+        try:
+            if self.mixer is not None:
+                self.mixer.stop()
+            if self.coord is not None:
+                self.coord.close()
+            self.rpc.stop()
+        finally:
+            # set LAST (join() must not return mid-teardown) but ALWAYS
+            # (a teardown error must not leave join() blocked forever)
+            self._stop_event.set()

@@ -1,0 +1,39 @@
+"""Multi-host init helper tests — single-host no-op paths + endpoint
+publication through the coordination store. (Real multi-process init
+needs N hosts; the helper's resolution logic is what's testable here.)
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+from jubatus_tpu.parallel import multihost
+
+
+def test_single_host_noop():
+    assert multihost.initialize() is False
+    assert multihost.initialize(num_processes=1,
+                                coordinator_address="x:1") is False
+
+
+def test_process0_publishes_endpoint():
+    store = _Store()
+    coord = MemoryCoordinator(store)
+    with pytest.raises(ValueError):
+        multihost.initialize(coord=coord, process_id=0, num_processes=4)
+    # with an address, publication happens even though init is skipped
+    # (num_processes=1 short-circuits before jax.distributed)
+    multihost.initialize(coordinator_address="10.0.0.1:8476", coord=coord,
+                         process_id=0, num_processes=1)
+    assert coord.read(multihost.JAX_COORD_PATH) == b"10.0.0.1:8476"
+
+
+def test_worker_resolves_endpoint_from_store():
+    store = _Store()
+    coord = MemoryCoordinator(store)
+    multihost.publish_endpoint(coord, "10.0.0.1:8476")
+    # worker with no static address finds it; num_processes=1 keeps this a
+    # no-op instead of blocking on a real distributed join
+    assert multihost.initialize(coord=MemoryCoordinator(store), process_id=3,
+                                num_processes=1) is False

@@ -1,0 +1,118 @@
+"""Concurrency stress tests: many client threads hammering one server with
+mixed train/classify/status/mix traffic. The reference's locking story is
+decorators + convention (SURVEY.md §5 'race detection: by convention');
+this is the test the convention never had.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from jubatus_tpu.client import ClassifierClient, Datum, StatClient
+from jubatus_tpu.server import EngineServer
+
+CONF = {
+    "method": "AROW",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+
+
+@pytest.mark.slow
+def test_concurrent_train_classify_status_mix():
+    srv = EngineServer("classifier", CONF)
+    port = srv.start(0)
+    errors = []
+    stop = threading.Event()
+
+    def worker(kind: str, n: int) -> None:
+        try:
+            c = ClassifierClient("127.0.0.1", port, "", timeout=30.0)
+            for i in range(n):
+                if stop.is_set():
+                    break
+                if kind == "train":
+                    c.train([["pos", Datum({"x": 1.0, "i": float(i)})],
+                             ["neg", Datum({"x": -1.0, "i": -float(i)})]])
+                elif kind == "classify":
+                    c.classify([Datum({"x": 1.0})])
+                elif kind == "status":
+                    st = c.get_status()
+                    assert st
+                else:  # mix (standalone → returns False, must not crash)
+                    c.do_mix()
+            c.close()
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errors.append((kind, e))
+            stop.set()
+
+    threads = [threading.Thread(target=worker, args=(k, n)) for k, n in [
+        ("train", 40), ("train", 40), ("classify", 60), ("classify", 60),
+        ("status", 30), ("mix", 15),
+    ]]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads), "stress worker hung"
+    try:
+        # model is intact and usable after the storm
+        c = ClassifierClient("127.0.0.1", port, "")
+        (res,) = c.classify([Datum({"x": 1.0})])
+        assert max(res, key=lambda s: s[1])[0] == "pos"
+        total = c.get_labels()
+        assert total["pos"] == total["neg"] == 80
+        c.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_concurrent_cluster_mix_and_train():
+    """Trains racing against background mixes across a 2-node cluster."""
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.server.args import ServerArgs
+
+    store = _Store()
+    servers = []
+    for _ in range(2):
+        args = ServerArgs(engine="stat", coordinator="(shared)", name="st",
+                          listen_addr="127.0.0.1",
+                          interval_sec=0.2, interval_count=5)  # mix hard
+        s = EngineServer("stat", {"window_size": 256}, args,
+                         coord=MemoryCoordinator(store))
+        s.start(0)
+        s.mixer.start()
+        servers.append(s)
+    errors = []
+    try:
+        def pusher(port: int, key: str) -> None:
+            try:
+                c = StatClient("127.0.0.1", port, "st", timeout=30.0)
+                for i in range(150):
+                    c.push(key, float(i % 7))
+                c.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=pusher,
+                                    args=(s.args.rpc_port, f"k{j}"))
+                   for j, s in enumerate(servers) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        # data survived the mixing storm: each key answers sum() on the
+        # node that ingested it (stat is key-sharded; the proxy's cht
+        # routing pins queries there, test_proxy.py covers that hop)
+        for j, s in enumerate(servers):
+            c = StatClient("127.0.0.1", s.args.rpc_port, "st")
+            assert c.sum(f"k{j}") > 0
+            c.close()
+    finally:
+        for s in servers:
+            s.stop()
