@@ -41,8 +41,8 @@ def _compile(src: str, out: str) -> bool:
     try:
         os.makedirs(os.path.dirname(out), exist_ok=True)
         res = subprocess.run(
-            ["g++", "-O3", "-Wall", "-fPIC", "-std=c++17", "-shared",
-             "-o", out, src],
+            ["g++", "-O3", "-Wall", "-fPIC", "-std=c++17", "-pthread",
+             "-shared", "-o", out, src],
             capture_output=True, timeout=120,
         )
         return res.returncode == 0

@@ -57,7 +57,9 @@ def initialize(
                                  "(its own reachable host:port) to publish")
             publish_endpoint(coord, coordinator_address)  # BEFORE peers join
         elif coordinator_address is None:
-            # fleets boot unordered: poll until process 0 publishes
+            # fleets boot unordered: poll until process 0 publishes.
+            # Timing out RAISES — a silent single-host fallback would
+            # leave the rest of the fleet hanging in the init barrier.
             import time
 
             deadline = time.monotonic() + resolve_timeout
@@ -67,10 +69,9 @@ def initialize(
                     coordinator_address = raw.decode()
                     break
                 if time.monotonic() >= deadline:
-                    log.warning(
-                        "no JAX coordinator endpoint published within %.0fs; "
-                        "falling back to single-host", resolve_timeout)
-                    break
+                    raise TimeoutError(
+                        f"no JAX coordinator endpoint published within "
+                        f"{resolve_timeout:.0f}s (is process 0 up?)")
                 time.sleep(0.5)
     if not coordinator_address or not num_processes or num_processes <= 1:
         return False
@@ -85,5 +86,9 @@ def initialize(
 
 
 def publish_endpoint(coord: Coordinator, address: str) -> None:
-    """Process 0 publishes the JAX coordinator endpoint for the fleet."""
-    coord.set(JAX_COORD_PATH, address.encode())
+    """Process 0 publishes the JAX coordinator endpoint for the fleet.
+    The node is EPHEMERAL (owned by process 0's coordinator session): a
+    crashed fleet's endpoint disappears instead of pointing late-booting
+    workers at a dead coordinator from the previous incarnation."""
+    coord.remove(JAX_COORD_PATH)
+    coord.create(JAX_COORD_PATH, address.encode(), ephemeral=True)

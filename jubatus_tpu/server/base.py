@@ -23,7 +23,6 @@ from jubatus_tpu.coord.idgen import IdGenerator
 from jubatus_tpu.framework.linear_mixer import RpcLinearMixer
 from jubatus_tpu.framework.push_mixer import PushCommunication, create_mixer
 from jubatus_tpu.framework.save_load import load_model, save_model
-from jubatus_tpu.rpc.server import RpcServer
 from jubatus_tpu.server.args import ServerArgs
 from jubatus_tpu.server.factory import create_driver
 from jubatus_tpu.version import __version__
@@ -48,7 +47,11 @@ class EngineServer:
         self.start_time = time.time()
         self.last_saved = 0.0
         self.last_loaded = 0.0
-        self.rpc = RpcServer(timeout=self.args.timeout)
+        # transport: python sockets, or the C++ front-end when
+        # JUBATUS_TPU_NATIVE_RPC=1 (rpc/native_server.py)
+        from jubatus_tpu.rpc.native_server import create_rpc_server
+
+        self.rpc = create_rpc_server(timeout=self.args.timeout)
         self._stop_event = threading.Event()
         self._stop_once = threading.Lock()  # first stop() wins; rest no-op
 
@@ -231,12 +234,19 @@ class EngineServer:
         if not self._stop_once.acquire(blocking=False):
             return
         try:
-            if self.mixer is not None:
-                self.mixer.stop()
-            if self.coord is not None:
-                self.coord.close()
-            self.rpc.stop()
+            # each step independently: stop() is unretryable (_stop_once),
+            # so one failing step must not skip the others
+            for step in (
+                (self.mixer.stop if self.mixer is not None else None),
+                (self.coord.close if self.coord is not None else None),
+                self.rpc.stop,
+            ):
+                if step is None:
+                    continue
+                try:
+                    step()
+                except Exception:  # noqa: BLE001 — teardown must finish
+                    log.exception("shutdown step %r failed", step)
         finally:
             # set LAST (join() must not return mid-teardown) but ALWAYS
-            # (a teardown error must not leave join() blocked forever)
             self._stop_event.set()

@@ -1,0 +1,404 @@
+// Native MessagePack-RPC server front-end.
+//
+// The reference's transport plane is C++ (msgpack-rpc on the mpio event
+// loop, SURVEY.md §2.2); this is the equivalent for jubatus_tpu: sockets,
+// connection buffering, msgpack framing, and request-envelope parsing all
+// run in C++ threads. Only dispatch crosses into Python — a ctypes
+// callback receives (conn, msgid, method, raw params span) and later
+// hands back a fully-packed response buffer for the C++ side to write.
+//
+// ABI (consumed by jubatus_tpu/rpc/native_server.py):
+//   handle = jt_rpc_create(request_cb)
+//   port   = jt_rpc_listen(handle, port, backlog)   // 0 = ephemeral
+//   jt_rpc_respond(handle, conn_id, buf, len)       // any thread
+//   jt_rpc_stop(handle); jt_rpc_destroy(handle)
+//
+// The callback runs on a per-connection reader thread; ctypes acquires
+// the GIL for it. Malformed frames close the connection. The msgpack
+// parser here only SKIPS values (to find span boundaries) — decoding
+// happens in Python, so the full type zoo stays in one place.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- msgpack
+// Skip one msgpack object. Returns pointer past it, nullptr if the buffer
+// ends mid-object (caller waits for more bytes), (uint8_t*)-1 on garbage.
+
+const uint8_t* kIncomplete = nullptr;
+inline const uint8_t* malformed() { return reinterpret_cast<const uint8_t*>(-1); }
+
+const uint8_t* skip_object(const uint8_t* p, const uint8_t* end, int depth) {
+  if (depth > 64) return malformed();
+  if (p >= end) return kIncomplete;
+  uint8_t b = *p++;
+  auto need = [&](int64_t n) -> const uint8_t* {
+    return (end - p >= n) ? p + n : kIncomplete;
+  };
+  auto be16 = [&](const uint8_t* q) {
+    return (uint32_t(q[0]) << 8) | q[1];
+  };
+  auto be32 = [&](const uint8_t* q) {
+    return (uint32_t(q[0]) << 24) | (uint32_t(q[1]) << 16) |
+           (uint32_t(q[2]) << 8) | q[3];
+  };
+
+  if (b <= 0x7f || b >= 0xe0) return p;                 // fix ints
+  if (b >= 0xa0 && b <= 0xbf) return need(b & 0x1f);    // fixstr
+  if (b >= 0x80 && b <= 0x8f) {                         // fixmap
+    int64_t n = 2 * int64_t(b & 0x0f);
+    for (int64_t i = 0; i < n; ++i) {
+      p = skip_object(p, end, depth + 1);
+      if (p == kIncomplete || p == malformed()) return p;
+    }
+    return p;
+  }
+  if (b >= 0x90 && b <= 0x9f) {                         // fixarray
+    int64_t n = b & 0x0f;
+    for (int64_t i = 0; i < n; ++i) {
+      p = skip_object(p, end, depth + 1);
+      if (p == kIncomplete || p == malformed()) return p;
+    }
+    return p;
+  }
+  switch (b) {
+    case 0xc0: case 0xc2: case 0xc3: return p;          // nil/false/true
+    case 0xcc: case 0xd0: return need(1);
+    case 0xcd: case 0xd1: return need(2);
+    case 0xce: case 0xd2: case 0xca: return need(4);
+    case 0xcf: case 0xd3: case 0xcb: return need(8);
+    case 0xd4: return need(2);                           // fixext1
+    case 0xd5: return need(3);
+    case 0xd6: return need(5);
+    case 0xd7: return need(9);
+    case 0xd8: return need(17);
+    case 0xc4: case 0xd9: {                              // bin8/str8
+      if (end - p < 1) return kIncomplete;
+      int64_t n = *p;
+      return need(1 + n);
+    }
+    case 0xc5: case 0xda: {                              // bin16/str16
+      if (end - p < 2) return kIncomplete;
+      int64_t n = be16(p);
+      return need(2 + n);
+    }
+    case 0xc6: case 0xdb: {                              // bin32/str32
+      if (end - p < 4) return kIncomplete;
+      int64_t n = be32(p);
+      return need(4 + n);
+    }
+    case 0xc7: {                                         // ext8
+      if (end - p < 2) return kIncomplete;
+      int64_t n = *p;
+      return need(2 + n);
+    }
+    case 0xc8: {
+      if (end - p < 3) return kIncomplete;
+      int64_t n = be16(p);
+      return need(3 + n);
+    }
+    case 0xc9: {
+      if (end - p < 5) return kIncomplete;
+      int64_t n = be32(p);
+      return need(5 + n);
+    }
+    case 0xdc: case 0xdd: {                              // array16/32
+      int hdr = (b == 0xdc) ? 2 : 4;
+      if (end - p < hdr) return kIncomplete;
+      int64_t n = (b == 0xdc) ? be16(p) : be32(p);
+      p += hdr;
+      for (int64_t i = 0; i < n; ++i) {
+        p = skip_object(p, end, depth + 1);
+        if (p == kIncomplete || p == malformed()) return p;
+      }
+      return p;
+    }
+    case 0xde: case 0xdf: {                              // map16/32
+      int hdr = (b == 0xde) ? 2 : 4;
+      if (end - p < hdr) return kIncomplete;
+      int64_t n = (b == 0xde) ? be16(p) : be32(p);
+      p += hdr;
+      for (int64_t i = 0; i < 2 * n; ++i) {
+        p = skip_object(p, end, depth + 1);
+        if (p == kIncomplete || p == malformed()) return p;
+      }
+      return p;
+    }
+    default:
+      return malformed();
+  }
+}
+
+// Parse a positive int at *p (for type / msgid). False on non-int.
+bool read_uint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
+  if (p >= end) return false;
+  uint8_t b = *p++;
+  if (b <= 0x7f) { *out = b; return true; }
+  auto rd = [&](int n) -> bool {
+    if (end - p < n) return false;
+    uint64_t v = 0;
+    for (int i = 0; i < n; ++i) v = (v << 8) | *p++;
+    *out = v;
+    return true;
+  };
+  switch (b) {
+    case 0xcc: return rd(1);
+    case 0xcd: return rd(2);
+    case 0xce: return rd(4);
+    case 0xcf: return rd(8);
+    default: return false;
+  }
+}
+
+// Parse a str header; sets (data, len). False on non-str.
+bool read_str(const uint8_t*& p, const uint8_t* end,
+              const uint8_t** data, int64_t* len) {
+  if (p >= end) return false;
+  uint8_t b = *p++;
+  int64_t n;
+  if (b >= 0xa0 && b <= 0xbf) {
+    n = b & 0x1f;
+  } else if (b == 0xd9) {
+    if (end - p < 1) return false;
+    n = *p++;
+  } else if (b == 0xda) {
+    if (end - p < 2) return false;
+    n = (int64_t(p[0]) << 8) | p[1];
+    p += 2;
+  } else if (b == 0xdb) {
+    if (end - p < 4) return false;
+    n = (int64_t(p[0]) << 24) | (int64_t(p[1]) << 16) |
+        (int64_t(p[2]) << 8) | p[3];
+    p += 4;
+  } else {
+    return false;
+  }
+  if (end - p < n) return false;
+  *data = p;
+  *len = n;
+  p += n;
+  return true;
+}
+
+// ---------------------------------------------------------------- server
+
+typedef void (*request_cb)(uint64_t conn_id, uint64_t msgid,
+                           const char* method, int64_t method_len,
+                           const uint8_t* params, int64_t params_len);
+
+struct Conn {
+  int fd;
+  std::mutex write_mu;
+};
+
+struct Server {
+  int listen_fd = -1;
+  std::atomic<bool> running{false};
+  request_cb cb = nullptr;
+  std::thread accept_thread;
+  // readers are DETACHED (connection churn must not accumulate joinable
+  // threads); stop() waits for this count to reach zero instead of joining
+  std::atomic<int64_t> active_readers{0};
+  std::mutex conns_mu;
+  std::map<uint64_t, std::shared_ptr<Conn>> conns;
+  std::atomic<uint64_t> next_conn{1};
+};
+
+// msgid sentinel for notifications (no response expected).
+const uint64_t kNotifyMsgid = ~uint64_t(0);
+
+// One complete frame: request [0, msgid, method, params] (fixarray-4) or
+// notification [2, method, params] (fixarray-3); params is everything from
+// the last element to the frame end. Returns end-of-frame, kIncomplete, or
+// malformed().
+const uint8_t* parse_frame(Server* s, uint64_t conn_id, const uint8_t* p,
+                           const uint8_t* end) {
+  const uint8_t* frame_end = skip_object(p, end, 0);
+  if (frame_end == kIncomplete || frame_end == malformed()) return frame_end;
+  const uint8_t* q = p + 1;
+  uint64_t type = 0, msgid = kNotifyMsgid;
+  const uint8_t* mdata;
+  int64_t mlen;
+  if (*p == 0x94) {  // request
+    if (!read_uint(q, frame_end, &type) || type != 0) return malformed();
+    if (!read_uint(q, frame_end, &msgid) || msgid == kNotifyMsgid)
+      return malformed();
+  } else if (*p == 0x93) {  // notification
+    if (!read_uint(q, frame_end, &type) || type != 2) return malformed();
+  } else {
+    return malformed();
+  }
+  if (!read_str(q, frame_end, &mdata, &mlen)) return malformed();
+  s->cb(conn_id, msgid, reinterpret_cast<const char*>(mdata), mlen, q,
+        frame_end - q);
+  return frame_end;
+}
+
+void reader_loop(Server* s, uint64_t conn_id, std::shared_ptr<Conn> conn) {
+  struct Guard {
+    std::atomic<int64_t>* n;
+    ~Guard() { n->fetch_sub(1); }
+  } guard{&s->active_readers};
+  std::vector<uint8_t> buf;
+  buf.reserve(1 << 16);
+  uint8_t chunk[1 << 16];
+  while (s->running.load()) {
+    ssize_t n = ::recv(conn->fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) break;
+    buf.insert(buf.end(), chunk, chunk + n);
+    const uint8_t* p = buf.data();
+    const uint8_t* end = p + buf.size();
+    while (p < end) {
+      const uint8_t* next = parse_frame(s, conn_id, p, end);
+      if (next == kIncomplete) break;
+      if (next == malformed()) {
+        ::shutdown(conn->fd, SHUT_RDWR);
+        goto done;
+      }
+      p = next;
+    }
+    buf.erase(buf.begin(), buf.begin() + (p - buf.data()));
+  }
+done:
+  ::close(conn->fd);
+  std::lock_guard<std::mutex> g(s->conns_mu);
+  s->conns.erase(conn_id);
+}
+
+void accept_loop(Server* s) {
+  while (s->running.load()) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (!s->running.load()) return;
+      continue;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    auto conn = std::make_shared<Conn>();
+    conn->fd = fd;
+    uint64_t id = s->next_conn.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> g(s->conns_mu);
+      s->conns[id] = conn;
+    }
+    s->active_readers.fetch_add(1);
+    std::thread(reader_loop, s, id, conn).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* jt_rpc_create(request_cb cb) {
+  Server* s = new Server();
+  s->cb = cb;
+  return s;
+}
+
+// Returns the bound port, or -errno.
+int jt_rpc_listen(void* handle, const char* host, int port, int backlog) {
+  Server* s = static_cast<Server*>(handle);
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  addr.sin_addr.s_addr = INADDR_ANY;
+  if (host && *host) {
+    // getaddrinfo, not inet_addr: "-b localhost" must work like the
+    // Python transport's socket.bind
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr) {
+      ::close(fd);
+      return -EADDRNOTAVAIL;
+    }
+    addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    ::freeaddrinfo(res);
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  if (::listen(fd, backlog > 0 ? backlog : 128) < 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->listen_fd = fd;
+  s->running.store(true);
+  s->accept_thread = std::thread(accept_loop, s);
+  return ntohs(addr.sin_port);
+}
+
+// Write a fully-packed msgpack-rpc response on the connection. Thread-safe.
+// Returns 0 on success, -1 if the connection is gone.
+int jt_rpc_respond(void* handle, uint64_t conn_id, const uint8_t* data,
+                   int64_t len) {
+  Server* s = static_cast<Server*>(handle);
+  std::shared_ptr<Conn> conn;
+  {
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    auto it = s->conns.find(conn_id);
+    if (it == s->conns.end()) return -1;
+    conn = it->second;
+  }
+  std::lock_guard<std::mutex> g(conn->write_mu);
+  int64_t off = 0;
+  while (off < len) {
+    ssize_t n = ::send(conn->fd, data + off, size_t(len - off), MSG_NOSIGNAL);
+    if (n <= 0) return -1;
+    off += n;
+  }
+  return 0;
+}
+
+void jt_rpc_stop(void* handle) {
+  Server* s = static_cast<Server*>(handle);
+  if (!s->running.exchange(false)) return;
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  {
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    for (auto& kv : s->conns) ::shutdown(kv.second->fd, SHUT_RDWR);
+  }
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  // wait for detached readers to drain: no callback may run after stop
+  // returns (the Python side may be torn down next)
+  while (s->active_readers.load() > 0) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+}
+
+void jt_rpc_destroy(void* handle) {
+  jt_rpc_stop(handle);
+  delete static_cast<Server*>(handle);
+}
+
+}  // extern "C"

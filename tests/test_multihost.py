@@ -37,3 +37,20 @@ def test_worker_resolves_endpoint_from_store():
     # no-op instead of blocking on a real distributed join
     assert multihost.initialize(coord=MemoryCoordinator(store), process_id=3,
                                 num_processes=1) is False
+
+
+def test_worker_times_out_loudly():
+    """No published endpoint → raise, never a silent single-host split."""
+    store = _Store()
+    with pytest.raises(TimeoutError, match="process 0"):
+        multihost.initialize(coord=MemoryCoordinator(store), process_id=2,
+                             num_processes=4, resolve_timeout=0.1)
+
+
+def test_endpoint_is_ephemeral():
+    """A dead process 0's endpoint must vanish with its session."""
+    store = _Store()
+    p0 = MemoryCoordinator(store)
+    multihost.publish_endpoint(p0, "10.0.0.1:8476")
+    p0.close()  # fleet incarnation dies
+    assert MemoryCoordinator(store).read(multihost.JAX_COORD_PATH) is None

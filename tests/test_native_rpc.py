@@ -1,0 +1,117 @@
+"""Native C++ RPC front-end tests: same wire behavior as the Python
+transport, exercised with the ordinary Python client (the transport is
+invisible to callers, like the reference's mpio layer).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from jubatus_tpu.client import ClassifierClient, Datum
+from jubatus_tpu.rpc import native_server
+from jubatus_tpu.rpc.client import RpcClient
+from jubatus_tpu.rpc.errors import RpcMethodNotFound, RpcTypeError
+
+pytestmark = pytest.mark.skipif(
+    not native_server.available(),
+    reason="g++ unavailable / native rpc front-end build failed",
+)
+
+
+@pytest.fixture()
+def srv():
+    s = native_server.NativeRpcServer()
+    s.register("echo", lambda x: x, arity=1)
+    s.register("add", lambda a, b: a + b, arity=2)
+    s.register("boom", lambda: 1 / 0, arity=0)
+    s.serve_background(0, host="127.0.0.1")
+    yield s
+    s.stop()
+
+
+def test_roundtrip_types(srv):
+    with RpcClient("127.0.0.1", srv.port) as c:
+        assert c.call("add", 2, 3) == 5
+        assert c.call("echo", "héllo") == "héllo"
+        assert c.call("echo", [1, [2, {"k": "v"}], b"\x00\xff"]) == \
+            [1, [2, {"k": "v"}], b"\x00\xff"]
+        assert c.call("echo", None) is None
+        assert c.call("echo", 3.5) == 3.5
+
+
+def test_error_taxonomy(srv):
+    with RpcClient("127.0.0.1", srv.port) as c:
+        with pytest.raises(RpcMethodNotFound):
+            c.call("nope")
+        with pytest.raises(RpcTypeError):
+            c.call("add", 1)  # arity error
+        with pytest.raises(Exception, match="division"):
+            c.call("boom")
+        assert c.call("add", 1, 1) == 2  # connection survives errors
+
+
+def test_pipelining_same_connection(srv):
+    """Many requests down one connection; responses correlate by msgid."""
+    with RpcClient("127.0.0.1", srv.port) as c:
+        for i in range(200):
+            assert c.call("add", i, i) == 2 * i
+
+
+def test_concurrent_clients(srv):
+    errors = []
+
+    def hammer(n):
+        try:
+            with RpcClient("127.0.0.1", srv.port) as c:
+                for i in range(50):
+                    assert c.call("add", n, i) == n + i
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(j,)) for j in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+
+
+def test_notify_no_response(srv):
+    hits = []
+    srv.register("note", lambda x: hits.append(x), arity=1)
+    with RpcClient("127.0.0.1", srv.port) as c:
+        c.notify("note", "fire-and-forget")
+        # a request after the notify proves framing stayed aligned
+        assert c.call("add", 1, 2) == 3
+    assert hits == ["fire-and-forget"]
+
+
+def test_trace_spans_recorded(srv):
+    with RpcClient("127.0.0.1", srv.port) as c:
+        c.call("echo", "x")
+    assert srv.trace.trace_status()["trace.rpc.echo.count"] >= 1
+
+
+def test_engine_server_over_native_transport(monkeypatch):
+    """Full engine stack on the C++ transport via JUBATUS_TPU_NATIVE_RPC."""
+    monkeypatch.setenv("JUBATUS_TPU_NATIVE_RPC", "1")
+    from jubatus_tpu.server import EngineServer
+
+    conf = {"method": "PA", "parameter": {},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    s = EngineServer("classifier", conf)
+    assert isinstance(s.rpc, native_server.NativeRpcServer)
+    port = s.start(0)
+    try:
+        c = ClassifierClient("127.0.0.1", port, "")
+        assert c.train([["pos", Datum({"x": 1.0})],
+                        ["neg", Datum({"x": -1.0})]]) == 2
+        (res,) = c.classify([Datum({"x": 1.0})])
+        assert max(res, key=lambda sc: sc[1])[0] == "pos"
+        (st,) = c.get_status().values()
+        assert st["trace.rpc.train.count"] == 1
+        c.close()
+    finally:
+        s.stop()
