@@ -21,3 +21,23 @@ Architecture (TPU-first, not a port):
 """
 
 from jubatus_tpu.version import VERSION, __version__  # noqa: F401
+
+__all__ = ["VERSION", "__version__", "Datum", "EngineServer", "create_driver"]
+
+
+def __getattr__(name):
+    """Lazy top-level conveniences (importing jubatus_tpu stays cheap —
+    no jax import until an engine is actually constructed)."""
+    if name == "Datum":
+        from jubatus_tpu.core.datum import Datum
+
+        return Datum
+    if name == "EngineServer":
+        from jubatus_tpu.server import EngineServer
+
+        return EngineServer
+    if name == "create_driver":
+        from jubatus_tpu.server.factory import create_driver
+
+        return create_driver
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -50,6 +50,8 @@ def initialize(
     """
     if jax.distributed.is_initialized():
         return False
+    if not num_processes or num_processes <= 1:
+        return False  # single-host: never poll or raise
     if coord is not None:
         if process_id == 0:
             if not coordinator_address:
@@ -73,7 +75,7 @@ def initialize(
                         f"no JAX coordinator endpoint published within "
                         f"{resolve_timeout:.0f}s (is process 0 up?)")
                 time.sleep(0.5)
-    if not coordinator_address or not num_processes or num_processes <= 1:
+    if not coordinator_address:
         return False
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
@@ -91,4 +93,9 @@ def publish_endpoint(coord: Coordinator, address: str) -> None:
     crashed fleet's endpoint disappears instead of pointing late-booting
     workers at a dead coordinator from the previous incarnation."""
     coord.remove(JAX_COORD_PATH)
-    coord.create(JAX_COORD_PATH, address.encode(), ephemeral=True)
+    if not coord.create(JAX_COORD_PATH, address.encode(), ephemeral=True):
+        # a silent publish failure would surface as timeouts on every
+        # OTHER host — fail here, where the cause is
+        raise RuntimeError(
+            f"cannot publish JAX coordinator endpoint at {JAX_COORD_PATH} "
+            "(stale node owned by another session, or session closed)")

@@ -31,8 +31,8 @@ from typing import Any, Callable, Dict, Optional
 import msgpack
 
 from jubatus_tpu import native as native_build
-from jubatus_tpu.rpc.errors import RpcMethodNotFound, error_to_wire
-from jubatus_tpu.rpc.server import RESPONSE, RpcServer, _to_wire
+from jubatus_tpu.rpc.errors import error_to_wire
+from jubatus_tpu.rpc.server import RpcServer, build_response
 from jubatus_tpu.utils.tracing import Registry
 
 log = logging.getLogger(__name__)
@@ -104,6 +104,7 @@ class NativeRpcServer:
     register = RpcServer.register
     method_names = RpcServer.method_names
     _invoke = RpcServer._invoke
+    _execute = RpcServer._execute
 
     # -- C++ → Python dispatch ------------------------------------------------
     def _on_request(self, conn_id, msgid, method, method_len, params_ptr,
@@ -128,19 +129,16 @@ class NativeRpcServer:
 
     def _dispatch(self, conn_id: int, msgid: int, method: str,
                   raw: bytes) -> None:
-        error, result = None, None
         try:
             params = msgpack.unpackb(raw, raw=False, strict_map_key=False,
                                      use_list=True)
-            result = self._invoke(method, params)
-        except Exception as e:  # noqa: BLE001 — every failure must answer
-            if not isinstance(e, RpcMethodNotFound):
-                log.debug("rpc method %s raised", method, exc_info=True)
-            error = error_to_wire(e)
+        except Exception as e:  # noqa: BLE001 — undecodable params
+            error, result = error_to_wire(e), None
+        else:
+            error, result = self._execute(method, params)
         if msgid == self._NOTIFY:
             return  # notification: no response on the wire
-        payload = msgpack.packb([RESPONSE, msgid, error, result],
-                                default=_to_wire)
+        payload = build_response(msgid, error, result)
         self._lib.jt_rpc_respond(self._handle, conn_id, payload, len(payload))
 
     # -- lifecycle (RpcServer-compatible) -------------------------------------

@@ -162,19 +162,24 @@ class RpcServer:
                 self._pool.submit(self._invoke_silent, method, params)
 
     def _dispatch(self, conn, wlock, msgid, method, params) -> None:
-        error, result = None, None
-        try:
-            result = self._invoke(method, params)
-        except Exception as e:  # noqa: BLE001 — every failure must produce a response
-            if not isinstance(e, RpcMethodNotFound):
-                log.debug("rpc method %s raised", method, exc_info=True)
-            error = error_to_wire(e)
-        payload = msgpack.packb([RESPONSE, msgid, error, result], default=_to_wire)
+        error, result = self._execute(method, params)
+        payload = build_response(msgid, error, result)
         try:
             with wlock:
                 conn.sendall(payload)
         except OSError:
             pass
+
+    def _execute(self, method: str, params: Any):
+        """Invoke + error taxonomy, shared by every transport."""
+        error, result = None, None
+        try:
+            result = self._invoke(method, params)
+        except Exception as e:  # noqa: BLE001 — every failure must answer
+            if not isinstance(e, RpcMethodNotFound):
+                log.debug("rpc method %s raised", method, exc_info=True)
+            error = error_to_wire(e)
+        return error, result
 
     def _invoke(self, method: str, params: Any) -> Any:
         fn = self._methods.get(method)
@@ -192,6 +197,11 @@ class RpcServer:
             self._invoke(method, params)
         except Exception:  # noqa: BLE001
             log.debug("rpc notify %s raised", method, exc_info=True)
+
+
+def build_response(msgid: int, error: Any, result: Any) -> bytes:
+    """Pack one msgpack-rpc response message (shared by all transports)."""
+    return msgpack.packb([RESPONSE, msgid, error, result], default=_to_wire)
 
 
 def _to_wire(obj: Any) -> Any:

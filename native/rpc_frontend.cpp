@@ -225,23 +225,50 @@ struct Server {
 // msgid sentinel for notifications (no response expected).
 const uint64_t kNotifyMsgid = ~uint64_t(0);
 
-// One complete frame: request [0, msgid, method, params] (fixarray-4) or
-// notification [2, method, params] (fixarray-3); params is everything from
+// Array header of any spec-legal width (fixarray/array16/array32 — the
+// Python transport accepts non-minimal encodings, so must this one).
+bool read_array_header(const uint8_t*& p, const uint8_t* end, int64_t* n) {
+  if (p >= end) return false;
+  uint8_t b = *p++;
+  if (b >= 0x90 && b <= 0x9f) {
+    *n = b & 0x0f;
+    return true;
+  }
+  if (b == 0xdc) {
+    if (end - p < 2) return false;
+    *n = (int64_t(p[0]) << 8) | p[1];
+    p += 2;
+    return true;
+  }
+  if (b == 0xdd) {
+    if (end - p < 4) return false;
+    *n = (int64_t(p[0]) << 24) | (int64_t(p[1]) << 16) |
+         (int64_t(p[2]) << 8) | p[3];
+    p += 4;
+    return true;
+  }
+  return false;
+}
+
+// One complete frame: request [0, msgid, method, params] (4 elements) or
+// notification [2, method, params] (3 elements); params is everything from
 // the last element to the frame end. Returns end-of-frame, kIncomplete, or
 // malformed().
 const uint8_t* parse_frame(Server* s, uint64_t conn_id, const uint8_t* p,
                            const uint8_t* end) {
   const uint8_t* frame_end = skip_object(p, end, 0);
   if (frame_end == kIncomplete || frame_end == malformed()) return frame_end;
-  const uint8_t* q = p + 1;
+  const uint8_t* q = p;
+  int64_t count = 0;
+  if (!read_array_header(q, frame_end, &count)) return malformed();
   uint64_t type = 0, msgid = kNotifyMsgid;
   const uint8_t* mdata;
   int64_t mlen;
-  if (*p == 0x94) {  // request
+  if (count == 4) {  // request
     if (!read_uint(q, frame_end, &type) || type != 0) return malformed();
     if (!read_uint(q, frame_end, &msgid) || msgid == kNotifyMsgid)
       return malformed();
-  } else if (*p == 0x93) {  // notification
+  } else if (count == 3) {  // notification
     if (!read_uint(q, frame_end, &type) || type != 2) return malformed();
   } else {
     return malformed();
@@ -278,9 +305,14 @@ void reader_loop(Server* s, uint64_t conn_id, std::shared_ptr<Conn> conn) {
     buf.erase(buf.begin(), buf.begin() + (p - buf.data()));
   }
 done:
+  // erase BEFORE closing: once the fd is closed the kernel may recycle
+  // its number, and a stale map entry would let jt_rpc_stop shutdown()
+  // some unrelated socket that got the recycled fd
+  {
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    s->conns.erase(conn_id);
+  }
   ::close(conn->fd);
-  std::lock_guard<std::mutex> g(s->conns_mu);
-  s->conns.erase(conn_id);
 }
 
 void accept_loop(Server* s) {
@@ -288,6 +320,9 @@ void accept_loop(Server* s) {
     int fd = ::accept(s->listen_fd, nullptr, nullptr);
     if (fd < 0) {
       if (!s->running.load()) return;
+      // EMFILE/ENFILE etc. fail instantly — back off instead of
+      // busy-spinning a core until fds free up
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
       continue;
     }
     int one = 1;
@@ -382,13 +417,15 @@ int jt_rpc_respond(void* handle, uint64_t conn_id, const uint8_t* data,
 void jt_rpc_stop(void* handle) {
   Server* s = static_cast<Server*>(handle);
   if (!s->running.exchange(false)) return;
+  // shutdown unblocks accept(); close only AFTER the accept thread exits
+  // so it can never accept() on a recycled fd number
   ::shutdown(s->listen_fd, SHUT_RDWR);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
   ::close(s->listen_fd);
   {
     std::lock_guard<std::mutex> g(s->conns_mu);
     for (auto& kv : s->conns) ::shutdown(kv.second->fd, SHUT_RDWR);
   }
-  if (s->accept_thread.joinable()) s->accept_thread.join();
   // wait for detached readers to drain: no callback may run after stop
   // returns (the Python side may be torn down next)
   while (s->active_readers.load() > 0) {

@@ -17,16 +17,31 @@ def test_single_host_noop():
                                 coordinator_address="x:1") is False
 
 
-def test_process0_publishes_endpoint():
+def test_process0_requires_address():
     store = _Store()
     coord = MemoryCoordinator(store)
     with pytest.raises(ValueError):
         multihost.initialize(coord=coord, process_id=0, num_processes=4)
-    # with an address, publication happens even though init is skipped
-    # (num_processes=1 short-circuits before jax.distributed)
-    multihost.initialize(coordinator_address="10.0.0.1:8476", coord=coord,
-                         process_id=0, num_processes=1)
+
+
+def test_single_host_never_polls_or_publishes():
+    store = _Store()
+    coord = MemoryCoordinator(store)
+    # num_processes=1 short-circuits before any publish/poll/raise
+    assert multihost.initialize(coordinator_address="10.0.0.1:8476",
+                                coord=coord, process_id=0,
+                                num_processes=1) is False
+    assert coord.read(multihost.JAX_COORD_PATH) is None
+
+
+def test_publish_endpoint_and_failure():
+    store = _Store()
+    coord = MemoryCoordinator(store)
+    multihost.publish_endpoint(coord, "10.0.0.1:8476")
     assert coord.read(multihost.JAX_COORD_PATH) == b"10.0.0.1:8476"
+    coord.close()
+    with pytest.raises(RuntimeError, match="publish"):
+        multihost.publish_endpoint(coord, "10.0.0.1:9999")  # closed session
 
 
 def test_worker_resolves_endpoint_from_store():
