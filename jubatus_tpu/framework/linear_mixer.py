@@ -44,8 +44,40 @@ from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
 
 log = logging.getLogger(__name__)
 
-#: mixer protocol version — mismatch forces shutdown (linear_mixer.cpp:618-624)
-PROTOCOL_VERSION = 1
+#: mixer protocol version — mismatch forces shutdown (linear_mixer.cpp:618-624).
+#: v2: payloads carry the R/Z compression tag (pack_mix). A v1 peer cannot
+#: decode v2 payloads at all; v2 decodes v1 via the unpack_mix fallback and
+#: the version gate then rejects it cleanly.
+PROTOCOL_VERSION = 2
+
+#: payloads above this compress with zlib before hitting the wire — mix
+#: rounds cross hosts (DCN), where sparse/periodic diffs compress well;
+#: below it the header+cpu cost isn't worth it
+COMPRESS_THRESHOLD = 2048
+
+
+def pack_mix(obj) -> bytes:
+    """Pack a mix payload, zlib-compressed when large (1-byte tag)."""
+    raw = pack_obj(obj)
+    if len(raw) > COMPRESS_THRESHOLD:
+        import zlib
+
+        z = zlib.compress(raw, 1)
+        if len(z) + 1 < len(raw):
+            return b"Z" + z
+    return b"R" + raw
+
+
+def unpack_mix(data: bytes):
+    """Inverse of pack_mix; unprefixed payloads (older peers) pass through."""
+    tag = data[:1]
+    if tag == b"Z":
+        import zlib
+
+        return unpack_obj(zlib.decompress(data[1:]))
+    if tag == b"R":
+        return unpack_obj(data[1:])
+    return unpack_obj(data)
 
 
 class LinearCommunication:
@@ -222,8 +254,10 @@ class RpcLinearMixer:
                 ])
         return True
 
-    def local_get_diff(self) -> bytes:
-        """Serve my diff (model read lock; linear_mixer.cpp:562-579)."""
+    def local_diff_obj(self) -> Dict[str, Any]:
+        """My diff as a payload dict (model read lock;
+        linear_mixer.cpp:562-579) — in-process consumers (push exchange)
+        use this directly, skipping the wire compress/decompress."""
         with self.driver.lock:
             diffs = {
                 name: m.get_diff() for name, m in self.driver.get_mixables().items()
@@ -231,12 +265,13 @@ class RpcLinearMixer:
             schema = (
                 self.driver.get_schema() if hasattr(self.driver, "get_schema") else []
             )
-        return pack_obj(
-            {"protocol": PROTOCOL_VERSION, "schema": schema, "diffs": diffs}
-        )
+        return {"protocol": PROTOCOL_VERSION, "schema": schema, "diffs": diffs}
+
+    def local_get_diff(self) -> bytes:
+        return pack_mix(self.local_diff_obj())
 
     def local_put_diff(self, packed: bytes) -> bool:
-        msg = unpack_obj(packed)
+        msg = unpack_mix(packed)
         if msg.get("protocol") != PROTOCOL_VERSION:
             log.error("mix protocol mismatch: %s", msg.get("protocol"))
             return False
@@ -272,7 +307,7 @@ class RpcLinearMixer:
 
     def local_get_model(self) -> bytes:
         with self.driver.lock:
-            return pack_obj(
+            return pack_mix(
                 {"protocol": PROTOCOL_VERSION, "model": self.driver.pack()}
             )
 
@@ -335,7 +370,7 @@ class RpcLinearMixer:
         if not replies:
             log.error("mix aborted: all get_diffs failed")
             return None
-        payloads = [unpack_obj(p) for _, p in replies]
+        payloads = [unpack_mix(p) for _, p in replies]
         payloads = [p for p in payloads if p.get("protocol") == PROTOCOL_VERSION]
         if not payloads:
             return None
@@ -351,7 +386,7 @@ class RpcLinearMixer:
                 totals[name] = functools.reduce(custom_mix, diffs)
             else:
                 totals[name] = tree_sum(diffs)
-        packed = pack_obj(
+        packed = pack_mix(
             {"protocol": PROTOCOL_VERSION, "schema": schema_union, "diffs": totals}
         )
         acks = self.comm.put_diff(packed)
@@ -380,7 +415,7 @@ class RpcLinearMixer:
             return False
         peer = random.choice(members)
         packed = self.comm.get_model(peer)
-        msg = unpack_obj(packed)
+        msg = unpack_mix(packed)
         if msg.get("protocol") != PROTOCOL_VERSION:
             raise RuntimeError("protocol version mismatch on recovery — restart")
         with self.driver.lock:

@@ -32,13 +32,14 @@ from typing import Any, Dict, List, Optional, Sequence
 from jubatus_tpu.coord.base import NodeInfo
 from jubatus_tpu.framework.linear_mixer import (
     PROTOCOL_VERSION,
+    pack_mix,
+    unpack_mix,
     LinearCommunication,
     RpcLinearCommunication,
     RpcLinearMixer,
 )
 from jubatus_tpu.parallel.mix import tree_sum
 from jubatus_tpu.rpc.client import RpcClient
-from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
 
 log = logging.getLogger(__name__)
 
@@ -188,9 +189,9 @@ class RpcPushMixer(RpcLinearMixer):
             if schema:
                 self.local_sync_schema(schema)
                 sess.sync_schema(schema)
-        # phase 2: row-aligned diffs
-        mine = unpack_obj(self.local_get_diff())
-        hers = unpack_obj(sess.get_diff())
+        # phase 2: row-aligned diffs (mine stays in-process — no wire codec)
+        mine = self.local_diff_obj()
+        hers = unpack_mix(sess.get_diff())
         if hers.get("protocol") != PROTOCOL_VERSION:
             raise RuntimeError(f"protocol mismatch from {peer_name}")
         mixables = self.driver.get_mixables()
@@ -203,7 +204,7 @@ class RpcPushMixer(RpcLinearMixer):
             custom_mix = getattr(mixable, "mix", None)
             totals[name] = (functools.reduce(custom_mix, diffs)
                             if custom_mix is not None else tree_sum(diffs))
-        packed = pack_obj({"protocol": PROTOCOL_VERSION, "schema": schema,
+        packed = pack_mix({"protocol": PROTOCOL_VERSION, "schema": schema,
                            "diffs": totals})
         self.local_put_diff(packed)
         sess.put_diff(packed)

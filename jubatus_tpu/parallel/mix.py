@@ -64,25 +64,36 @@ def tree_sum(diffs: Sequence[Any]) -> Any:
     return acc
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
-def _psum_stacked(stacked, *, mesh: Mesh, axis: str):
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "compress"))
+def _psum_stacked(stacked, *, mesh: Mesh, axis: str, compress: bool):
     """psum a pytree whose leaves are stacked [n_replicas, ...] and sharded
-    over `axis`; result is replicated (every replica holds the total)."""
+    over `axis`; result is replicated (every replica holds the total).
+
+    compress=True moves f32 leaves over the interconnect as bfloat16 —
+    half the ICI/DCN bytes per mix round at ~3 decimal digits of diff
+    precision (the EQuARX-style quantized-allreduce tradeoff; additive
+    diffs tolerate it because put_diff folds into an f32 master)."""
 
     def body(local):
-        return jax.tree_util.tree_map(
-            lambda x: jax.lax.psum(jnp.sum(x, axis=0), axis), local
-        )
+        def one(x):
+            if compress and x.dtype == jnp.float32:
+                y = jnp.sum(x, axis=0).astype(jnp.bfloat16)
+                return jax.lax.psum(y, axis).astype(jnp.float32)
+            return jax.lax.psum(jnp.sum(x, axis=0), axis)
+
+        return jax.tree_util.tree_map(one, local)
 
     return jax.shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P())(stacked)
 
 
-def allreduce_diffs(per_replica_diffs: Sequence[Any], mesh: Mesh, axis: str = "replica"):
+def allreduce_diffs(per_replica_diffs: Sequence[Any], mesh: Mesh,
+                    axis: str = "replica", compress: bool = False):
     """Reduce per-replica diff pytrees to one total via an XLA collective.
 
     In production each replica contributes its local shard of the stacked
     array; in tests the stack is built host-side and sharded onto the mesh.
-    Returns the total diff (as held by replica 0).
+    Returns the total diff (as held by replica 0). ``compress=True``
+    quantizes f32 leaves to bf16 for the wire (see _psum_stacked).
     """
     n = mesh.shape[axis]
     if len(per_replica_diffs) != n:
@@ -94,7 +105,7 @@ def allreduce_diffs(per_replica_diffs: Sequence[Any], mesh: Mesh, axis: str = "r
     stacked = jax.tree_util.tree_map(
         lambda x: jax.device_put(x, sharding), stacked
     )
-    total = _psum_stacked(stacked, mesh=mesh, axis=axis)
+    total = _psum_stacked(stacked, mesh=mesh, axis=axis, compress=compress)
     return jax.tree_util.tree_map(lambda x: jax.device_get(x), total)
 
 

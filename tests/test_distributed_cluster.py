@@ -154,8 +154,9 @@ class _StubComm(LinearCommunication):
 
 def test_mixer_fold_with_stub():
     """Mix rounds run against canned diffs — no sockets, no coordinator."""
+    from jubatus_tpu.framework.linear_mixer import PROTOCOL_VERSION, unpack_mix
     from jubatus_tpu.server.factory import create_driver
-    from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
+    from jubatus_tpu.utils.serialization import pack_obj
 
     import numpy as np
 
@@ -164,14 +165,14 @@ def test_mixer_fold_with_stub():
     local = driver.get_mixables()["stat"].get_diff()
     remote = {"counts": np.asarray([2.0], dtype=np.float32)}
     canned = [
-        pack_obj({"protocol": 1, "schema": ["k"], "diffs": {"stat": local}}),
-        pack_obj({"protocol": 1, "schema": ["k"], "diffs": {"stat": remote}}),
+        pack_obj({"protocol": PROTOCOL_VERSION, "schema": ["k"], "diffs": {"stat": local}}),
+        pack_obj({"protocol": PROTOCOL_VERSION, "schema": ["k"], "diffs": {"stat": remote}}),
     ]
     comm = _StubComm(canned)
     mixer = RpcLinearMixer(driver, comm)
     result = mixer.mix_now()
     assert result is not None
     assert len(comm.put) == 1
-    folded = unpack_obj(comm.put[0])["diffs"]["stat"]
+    folded = unpack_mix(comm.put[0])["diffs"]["stat"]
     # stat diff = {"counts": per-key window counts}; 1 (local) + 2 (canned)
     assert folded["counts"][0] == pytest.approx(3.0)

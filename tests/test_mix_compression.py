@@ -1,0 +1,70 @@
+"""Mix-plane compression tests: zlib payload compression for the DCN RPC
+loop and bf16 quantized allreduce for the ICI collective (the EQuARX-style
+wire-byte tradeoffs)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from jubatus_tpu.framework.linear_mixer import (
+    COMPRESS_THRESHOLD,
+    pack_mix,
+    unpack_mix,
+)
+from jubatus_tpu.parallel.mesh import replica_mesh
+from jubatus_tpu.parallel.mix import allreduce_diffs
+from jubatus_tpu.utils.serialization import pack_obj
+
+
+def test_small_payload_uncompressed_roundtrip():
+    obj = {"protocol": 1, "diffs": {"a": 1}}
+    packed = pack_mix(obj)
+    assert packed[:1] == b"R"
+    assert unpack_mix(packed) == obj
+
+
+def test_large_payload_compresses():
+    # periodic/sparse diffs compress well; wire bytes must shrink
+    obj = {"protocol": 1,
+           "diffs": {"w": np.zeros(65536, dtype=np.float32)}}
+    packed = pack_mix(obj)
+    raw_len = len(pack_obj(obj))
+    assert packed[:1] == b"Z"
+    assert len(packed) < raw_len / 10
+    out = unpack_mix(packed)
+    np.testing.assert_array_equal(out["diffs"]["w"],
+                                  np.zeros(65536, dtype=np.float32))
+
+
+def test_incompressible_payload_stays_raw():
+    rng = np.random.default_rng(0)
+    obj = {"blob": rng.integers(0, 256, size=2 * COMPRESS_THRESHOLD,
+                                dtype=np.uint8).tobytes()}
+    packed = pack_mix(obj)
+    assert packed[:1] == b"R"  # zlib couldn't win → raw
+    assert unpack_mix(packed) == obj
+
+
+def test_unprefixed_legacy_payload_accepted():
+    obj = {"protocol": 1, "diffs": {}}
+    assert unpack_mix(pack_obj(obj)) == obj
+
+
+def test_bf16_allreduce_close_to_exact(rng):
+    mesh = replica_mesh(4, devices=jax.devices()[:4])
+    diffs = [{"w": rng.normal(size=256).astype(np.float32)} for _ in range(4)]
+    exact = allreduce_diffs(diffs, mesh)
+    quant = allreduce_diffs(diffs, mesh, compress=True)
+    want = sum(d["w"].astype(np.float64) for d in diffs)
+    np.testing.assert_allclose(np.asarray(exact["w"]), want,
+                               rtol=1e-4, atol=1e-5)
+    # bf16 wire: ~2-3 significant digits preserved
+    np.testing.assert_allclose(np.asarray(quant["w"]), want,
+                               rtol=0.05, atol=0.05)
+    # int leaves pass through exactly even when compressing
+    idiffs = [{"n": np.asarray([i + 1], dtype=np.int32)} for i in range(4)]
+    iq = allreduce_diffs(idiffs, mesh, compress=True)
+    assert int(iq["n"][0]) == 10
