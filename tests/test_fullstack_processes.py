@@ -1,0 +1,123 @@
+"""Highest-fidelity integration tier: every component a REAL OS process —
+coordination daemon (jubacoordd), two engine servers, one proxy — glued
+only by the tcp:// locator and the wire protocol, driven by a client.
+(The reference needs the external jubatest harness plus ZooKeeper for
+this; here it runs self-contained.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jubatus_tpu.client import ClassifierClient, Datum
+from jubatus_tpu.rpc.client import RpcClient
+
+CONF = {
+    "method": "PA",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+
+
+def _spawn(args, log_path):
+    out = open(log_path, "ab")
+    try:
+        return subprocess.Popen([sys.executable, "-m"] + args,
+                                stdout=out, stderr=out)
+    finally:
+        out.close()
+
+
+def _wait_port(port, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with RpcClient("127.0.0.1", port, timeout=2.0) as c:
+                c.call("coord_exists", "/")
+            return True
+        except Exception:  # noqa: BLE001
+            time.sleep(0.3)
+    return False
+
+
+@pytest.mark.slow
+def test_processes_cluster_end_to_end(tmp_path):
+    env_port = 21990 + (os.getpid() % 500)
+    locator = f"tcp://127.0.0.1:{env_port}"
+    procs = []
+    try:
+        # 1. coordination daemon
+        procs.append(_spawn(["jubatus_tpu.coord.server", "-p", str(env_port),
+                             "-b", "127.0.0.1"], tmp_path / "coordd.log"))
+        assert _wait_port(env_port), "coordination daemon never came up"
+
+        # 2. cluster config via jubaconfig (its own process too)
+        conf_file = tmp_path / "conf.json"
+        conf_file.write_text(json.dumps(CONF))
+        rc = subprocess.run(
+            [sys.executable, "-m", "jubatus_tpu.cmd.jubaconfig", "-c", "write",
+             "-t", "classifier", "-n", "fs", "-f", str(conf_file),
+             "-z", locator], capture_output=True, timeout=60)
+        assert rc.returncode == 0, rc.stderr
+
+        # 3. two servers + proxy
+        sport0, sport1, pport = env_port + 1, env_port + 2, env_port + 3
+        for sp in (sport0, sport1):
+            procs.append(_spawn(
+                ["jubatus_tpu.server", "classifier", "-z", locator, "-n", "fs",
+                 "-p", str(sp), "-b", "127.0.0.1", "-d", str(tmp_path),
+                 "-s", "1000000", "-i", "1000000000"],
+                tmp_path / f"server{sp}.log"))
+        procs.append(_spawn(
+            ["jubatus_tpu.server.proxy", "classifier", "-z", locator,
+             "-p", str(pport), "-b", "127.0.0.1"], tmp_path / "proxy.log"))
+
+        # wait for both servers to register (proxy routes only to actives)
+        c = ClassifierClient("127.0.0.1", pport, "fs", timeout=20.0)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            try:
+                if len(c.get_status()) == 2:
+                    break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.5)
+        assert len(c.get_status()) == 2, "servers never joined via tcp coord"
+
+        # 4. the actual workload through the proxy
+        for _ in range(10):
+            c.train([["pos", Datum({"x": 1.0})]])
+            c.train([["neg", Datum({"x": -1.0})]])
+        assert c.do_mix() is True
+        res = c.classify([Datum({"x": 1.0}), Datum({"x": -1.0})])
+        assert [max(r, key=lambda s: s[1])[0] for r in res] == ["pos", "neg"]
+
+        # 5. kill one server: ephemeral membership must shrink and the
+        #    proxy must keep answering from the survivor
+        procs[1].send_signal(signal.SIGTERM)
+        procs[1].wait(timeout=20)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if len(c.get_status()) == 1:
+                break
+            time.sleep(0.5)
+        assert len(c.get_status()) == 1, "dead server stuck in membership"
+        (res,) = c.classify([Datum({"x": 1.0})])
+        assert max(res, key=lambda s: s[1])[0] == "pos"
+        c.close()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
