@@ -24,3 +24,17 @@ from typing import Optional
 def resolve_coordinator(flag: str) -> Optional[str]:
     """-z flag, else $JUBATUS_COORDINATOR, else $ZK (reference order)."""
     return flag or os.environ.get("JUBATUS_COORDINATOR") or os.environ.get("ZK")
+
+
+def apply_platform_override() -> None:
+    """Honor JUBATUS_TPU_PLATFORM before any jax backend initializes.
+
+    The axon sandbox's sitecustomize pins JAX_PLATFORMS at interpreter
+    start, so subprocesses can't steer jax via the environment alone; any
+    entry point that may construct a driver (servers, jubaconfig's
+    dry-validation) calls this first."""
+    plat = os.environ.get("JUBATUS_TPU_PLATFORM", "")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

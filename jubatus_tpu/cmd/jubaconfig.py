@@ -17,7 +17,7 @@ import json
 import sys
 from typing import List, Optional
 
-from jubatus_tpu.cmd import resolve_coordinator
+from jubatus_tpu.cmd import apply_platform_override, resolve_coordinator
 from jubatus_tpu.coord import create_coordinator, membership
 from jubatus_tpu.framework.idl import ENGINES
 
@@ -55,13 +55,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                 with open(ns.file) as f:
                     raw = f.read()
                 try:
-                    json.loads(raw)
+                    parsed = json.loads(raw)
                 except json.JSONDecodeError as e:
                     print(f"invalid JSON in {ns.file}: {e}", file=sys.stderr)
                     return 1
                 if ns.type not in ENGINES:
                     print(f"unknown engine type {ns.type!r} "
                           f"(known: {', '.join(ENGINES)})", file=sys.stderr)
+                    return 1
+                # full semantic validation: dry-construct the driver, like
+                # the servers' --config-test (the reference validates via
+                # jsonconfig before writing, jubaconfig.cpp validate_config).
+                # Override BEFORE the factory import touches jax; env/import
+                # failures must not masquerade as config rejection.
+                apply_platform_override()
+                from jubatus_tpu.server.factory import create_driver
+
+                try:
+                    create_driver(ns.type, parsed)
+                except Exception as e:  # noqa: BLE001 — report any reason
+                    print(f"config rejected by {ns.type} driver: {e}",
+                          file=sys.stderr)
                     return 1
                 if not coord.create(path, raw.encode()):
                     coord.set(path, raw.encode())

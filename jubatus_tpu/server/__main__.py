@@ -15,16 +15,9 @@ from jubatus_tpu.server.base import EngineServer
 
 
 def main(argv=None) -> int:
-    # the axon sandbox's sitecustomize pins JAX_PLATFORMS at interpreter
-    # start; JUBATUS_TPU_PLATFORM lets a parent (tests, the visor) force a
-    # backend for spawned servers regardless (config update wins over env)
-    import os
+    from jubatus_tpu.cmd import apply_platform_override
 
-    plat = os.environ.get("JUBATUS_TPU_PLATFORM", "")
-    if plat:
-        import jax
-
-        jax.config.update("jax_platforms", plat)
+    apply_platform_override()
     args = parse_server_args(argv)
     from jubatus_tpu.utils.logger import install_sighup_reload, setup
 

@@ -58,6 +58,18 @@ def test_jubaconfig_rejects_unknown_engine(tmp_path):
     assert rc == 1
 
 
+def test_jubaconfig_rejects_semantically_bad_config(tmp_path, capsys):
+    """Valid JSON, known engine, but the driver refuses it (the dry-
+    construct validation jubaconfig.cpp does via jsonconfig)."""
+    f = tmp_path / "bad.json"
+    f.write_text(json.dumps({"method": "WARP_DRIVE", "converter": {}}))
+    rc = jubaconfig.main(["-c", "write", "-f", str(f), "-z",
+                          str(tmp_path / "coord"), "-t", "classifier",
+                          "-n", "x"])
+    assert rc == 1
+    assert "rejected" in capsys.readouterr().err
+
+
 # -- jubaconv -----------------------------------------------------------------
 
 
