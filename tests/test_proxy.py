@@ -96,13 +96,20 @@ def test_get_status_merges_all_nodes(classifier_cluster):
     c.close()
 
 
-def test_save_broadcast_merge(classifier_cluster, tmp_path):
+def test_save_broadcast_merge_and_load(classifier_cluster, tmp_path):
     servers, proxy, _ = classifier_cluster
     for s in servers:
         s.args.datadir = str(tmp_path)
     c = ClassifierClient("127.0.0.1", proxy.args.rpc_port, NAME)
+    c.train([["pos", Datum({"x": 1.0})]])
     paths = c.save("m1")
     assert len(paths) == 3  # per-server path map, merged (proxy.cpp:48-54)
+    # clear the cluster, then broadcast load restores every node's OWN
+    # snapshot (all_and) — only the node that got the random-routed train
+    # has the label again, exactly per-node save/load semantics
+    assert c.clear() is True
+    assert c.load("m1") is True
+    assert sum("pos" in s.driver.get_labels() for s in servers) == 1
     c.close()
 
 
