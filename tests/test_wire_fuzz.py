@@ -1,0 +1,103 @@
+"""Wire-robustness fuzz: garbage, truncated frames, and adversarial
+msgpack on raw sockets must never take a server down — the next valid
+client call still answers. Run against BOTH transports.
+"""
+
+from __future__ import annotations
+
+import socket
+
+import pytest
+
+from jubatus_tpu.rpc import native_server
+from jubatus_tpu.rpc.client import RpcClient
+from jubatus_tpu.rpc.server import RpcServer
+
+GARBAGE = [
+    b"\xff\xff\xff\xff",                      # invalid type bytes
+    b"\x94",                                   # truncated request envelope
+    b"\xdc\xff\xff",                           # array16 huge count, no body
+    b"\x94\x00\x01\xa3abc",                    # request missing params
+    b"\x91\x00",                               # 1-element array (bad envelope)
+    b"\xc1" * 64,                              # reserved bytes
+    bytes(range(256)),                         # everything
+    b"\x94\x02\x01\xa1m\x90",                  # response-typed on server
+]
+
+
+def _servers():
+    out = []
+    py = RpcServer()
+    py.register("ping", lambda: "pong", arity=0)
+    py.serve_background(0, host="127.0.0.1")
+    out.append(("python", py))
+    if native_server.available():
+        nat = native_server.NativeRpcServer()
+        nat.register("ping", lambda: "pong", arity=0)
+        nat.serve_background(0, host="127.0.0.1")
+        out.append(("native", nat))
+    return out
+
+
+@pytest.fixture(scope="module")
+def servers():
+    ss = _servers()
+    yield ss
+    for _, s in ss:
+        s.stop()
+
+
+def test_garbage_never_kills_server(servers):
+    for name, srv in servers:
+        for blob in GARBAGE:
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+            try:
+                s.sendall(blob)
+                s.settimeout(0.3)
+                try:
+                    s.recv(4096)  # server may close or stay silent — both fine
+                except (socket.timeout, OSError):
+                    pass
+            finally:
+                s.close()
+        # after all garbage, a clean client still gets service
+        with RpcClient("127.0.0.1", srv.port, timeout=5.0) as c:
+            assert c.call("ping") == "pong", f"{name} transport died"
+
+
+def test_partial_frame_then_completion(servers):
+    """A request split across many tiny writes must still be answered."""
+    import msgpack
+
+    payload = msgpack.packb([0, 7, "ping", []])
+    for name, srv in servers:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        try:
+            for i in range(len(payload)):
+                s.sendall(payload[i:i + 1])
+            s.settimeout(10)
+            buf = b""
+            unp = msgpack.Unpacker(raw=False)
+            got = None
+            while got is None:
+                chunk = s.recv(4096)
+                assert chunk, f"{name}: connection closed mid-response"
+                unp.feed(chunk)
+                for msg in unp:
+                    got = msg
+                    break
+            assert got[0] == 1 and got[1] == 7 and got[3] == "pong", name
+        finally:
+            s.close()
+
+
+def test_oversized_method_name(servers):
+    import msgpack
+
+    for name, srv in servers:
+        with RpcClient("127.0.0.1", srv.port, timeout=5.0) as c:
+            from jubatus_tpu.rpc.errors import RpcMethodNotFound
+
+            with pytest.raises(RpcMethodNotFound):
+                c.call("m" * 10000)
+            assert c.call("ping") == "pong", name
