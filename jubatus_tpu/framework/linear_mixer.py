@@ -222,6 +222,15 @@ class RpcLinearMixer:
         self.mix_count = 0
         self.bytes_sent = 0
         self._obsolete = False
+        #: mix epoch (≙ core::storage::version, linear_mixer.cpp:48,222-233):
+        #: bumped on every applied round. A node whose version is behind the
+        #: round's base missed history its peers hold only in their MASTER
+        #: arrays (diffs are deltas!), so applying the fold cannot catch it
+        #: up — it must pull a full model (the restart/joining case).
+        self.model_version = 0
+        #: the round base that declared us obsolete: recovery must pull a
+        #: model at least this current or keep trying
+        self._required_version = 0
         #: set by the owning server: called with True/False after each
         #: locally-applied put_diff so the member (re)registers ITSELF in the
         #: actives list through its own coordinator session
@@ -265,7 +274,8 @@ class RpcLinearMixer:
             schema = (
                 self.driver.get_schema() if hasattr(self.driver, "get_schema") else []
             )
-        return {"protocol": PROTOCOL_VERSION, "schema": schema, "diffs": diffs}
+        return {"protocol": PROTOCOL_VERSION, "schema": schema,
+                "version": self.model_version, "diffs": diffs}
 
     def local_get_diff(self) -> bytes:
         return pack_mix(self.local_diff_obj())
@@ -275,15 +285,27 @@ class RpcLinearMixer:
         if msg.get("protocol") != PROTOCOL_VERSION:
             log.error("mix protocol mismatch: %s", msg.get("protocol"))
             return False
-        with self.driver.lock:
-            if msg.get("schema") and hasattr(self.driver, "sync_schema"):
-                self.driver.sync_schema(list(msg["schema"]))
-            ok = True
-            mixables = self.driver.get_mixables()
-            for name, diff in msg["diffs"].items():
-                m = mixables.get(name)
-                if m is not None:
-                    ok = bool(m.put_diff(diff)) and ok
+        base_version = int(msg.get("base_version", 0))
+        if self.model_version < base_version:
+            # I missed rounds (fresh boot / restart): the fold is deltas
+            # only — reject it and pull a full model instead
+            # (linear_mixer.cpp:644-652 put_diff → not_obsolete=false)
+            log.warning("model obsolete (mine v%d < round base v%d); "
+                        "recovering", self.model_version, base_version)
+            self._required_version = base_version
+            ok = False
+        else:
+            with self.driver.lock:
+                if msg.get("schema") and hasattr(self.driver, "sync_schema"):
+                    self.driver.sync_schema(list(msg["schema"]))
+                ok = True
+                mixables = self.driver.get_mixables()
+                for name, diff in msg["diffs"].items():
+                    m = mixables.get(name)
+                    if m is not None:
+                        ok = bool(m.put_diff(diff)) and ok
+            if ok:
+                self.model_version = base_version + 1
         self._obsolete = not ok
         if self.on_active is not None:
             try:
@@ -308,7 +330,8 @@ class RpcLinearMixer:
     def local_get_model(self) -> bytes:
         with self.driver.lock:
             return pack_mix(
-                {"protocol": PROTOCOL_VERSION, "model": self.driver.pack()}
+                {"protocol": PROTOCOL_VERSION, "model": self.driver.pack(),
+                 "version": self.model_version}
             )
 
     def set_trace_registry(self, registry) -> None:
@@ -386,8 +409,14 @@ class RpcLinearMixer:
                 totals[name] = functools.reduce(custom_mix, diffs)
             else:
                 totals[name] = tree_sum(diffs)
+        # the round's base = the most advanced contributor; anyone behind it
+        # cannot be caught up by deltas and must recover a full model
+        base_version = max(
+            (int(p.get("version", 0)) for p in payloads), default=0
+        )
         packed = pack_mix(
-            {"protocol": PROTOCOL_VERSION, "schema": schema_union, "diffs": totals}
+            {"protocol": PROTOCOL_VERSION, "schema": schema_union,
+             "base_version": base_version, "diffs": totals}
         )
         acks = self.comm.put_diff(packed)
         # active-list transitions (linear_mixer.cpp:658-681): master demotes
@@ -413,18 +442,37 @@ class RpcLinearMixer:
         ]
         if not members:
             return False
-        peer = random.choice(members)
-        packed = self.comm.get_model(peer)
-        msg = unpack_mix(packed)
-        if msg.get("protocol") != PROTOCOL_VERSION:
-            raise RuntimeError("protocol version mismatch on recovery — restart")
-        with self.driver.lock:
-            self.driver.unpack(msg["model"])
-        self._obsolete = False
-        log.info("recovered full model from %s", peer.name)
-        return True
+        # a random member may be another stale joiner mid-recovery; try a
+        # few and accept only a model at least as current as the round base
+        # that declared us obsolete (the reference re-tries each stabilizer
+        # tick until current, linear_mixer.cpp:404-424)
+        random.shuffle(members)
+        for peer in members[:3]:
+            try:
+                packed = self.comm.get_model(peer)
+            except Exception as e:  # noqa: BLE001 — dead peer, try another
+                log.warning("recovery pull from %s failed: %s", peer.name, e)
+                continue
+            msg = unpack_mix(packed)
+            if msg.get("protocol") != PROTOCOL_VERSION:
+                raise RuntimeError(
+                    "protocol version mismatch on recovery — restart")
+            version = int(msg.get("version", 0))
+            if version < self._required_version:
+                log.info("peer %s model v%d < required v%d; trying another",
+                         peer.name, version, self._required_version)
+                continue
+            with self.driver.lock:
+                self.driver.unpack(msg["model"])
+            self.model_version = version
+            self._obsolete = False
+            log.info("recovered full model (v%d) from %s",
+                     version, peer.name)
+            return True
+        return False  # retried next stabilizer tick / round
 
     def get_status(self) -> Dict[str, Any]:
         st = self._scheduler.get_status()
-        st.update({"bytes_sent": self.bytes_sent, "obsolete": self._obsolete})
+        st.update({"bytes_sent": self.bytes_sent, "obsolete": self._obsolete,
+                   "model_version": self.model_version})
         return st

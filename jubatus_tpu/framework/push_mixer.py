@@ -172,9 +172,10 @@ class RpcPushMixer(RpcLinearMixer):
         schemas, fold my diff with the peer's, apply the fold on both
         sides."""
         with self.comm.peer_session(peer) as sess:
-            return self._exchange_on(sess, peer.name)
+            return self._exchange_on(sess, peer.name, peer=peer)
 
-    def _exchange_on(self, sess, peer_name: str = "?") -> int:
+    def _exchange_on(self, sess, peer_name: str = "?",
+                     peer: Optional[NodeInfo] = None) -> int:
         # phase 1: schema alignment — row-keyed diffs (classifier labels,
         # stat keys) must agree on the row vocabulary BEFORE diffing, same
         # as the linear round's phase 1
@@ -194,6 +195,23 @@ class RpcPushMixer(RpcLinearMixer):
         hers = unpack_mix(sess.get_diff())
         if hers.get("protocol") != PROTOCOL_VERSION:
             raise RuntimeError(f"protocol mismatch from {peer_name}")
+        # phase 2.5: version asymmetry. A node behind the pair's base has
+        # history its peer absorbed into MASTER arrays — deltas can't carry
+        # it. If I'M behind, adopt her full model now (my diff snapshot
+        # `mine` is folded back in below, so nothing local is lost). If
+        # SHE'S behind, apply the fold only on MY side: she catches up when
+        # her own round initiates — never demoted, no recovery storm.
+        mv = int(mine.get("version", 0))
+        hv = int(hers.get("version", 0))
+        if mv < hv and peer is not None:
+            model = unpack_mix(self.comm.get_model(peer))
+            if model.get("protocol") != PROTOCOL_VERSION:
+                raise RuntimeError(f"protocol mismatch from {peer_name}")
+            with self.driver.lock:
+                self.driver.unpack(model["model"])
+            self.model_version = mv = int(model.get("version", hv))
+            log.info("adopted full model v%d from %s before exchange",
+                     mv, peer_name)
         mixables = self.driver.get_mixables()
         totals: Dict[str, Any] = {}
         for name, mixable in mixables.items():
@@ -204,10 +222,15 @@ class RpcPushMixer(RpcLinearMixer):
             custom_mix = getattr(mixable, "mix", None)
             totals[name] = (functools.reduce(custom_mix, diffs)
                             if custom_mix is not None else tree_sum(diffs))
+        base_version = max(mv, hv)
         packed = pack_mix({"protocol": PROTOCOL_VERSION, "schema": schema,
-                           "diffs": totals})
-        self.local_put_diff(packed)
-        sess.put_diff(packed)
+                           "base_version": base_version, "diffs": totals})
+        self.local_put_diff(packed)  # mv == base here (adopted above if not)
+        if hv == base_version:
+            sess.put_diff(packed)
+        # else: she's behind — skipping her keeps the version gate from
+        # demoting a merely gossip-lagged member; her next initiated round
+        # adopts a full model (phase 2.5 on her side)
         return len(packed)
 
 

@@ -152,6 +152,45 @@ class _StubComm(LinearCommunication):
         raise AssertionError("not used")
 
 
+def test_late_joiner_recovers_full_model():
+    """A node joining AFTER the cluster has mixed is version-obsolete: the
+    next round's delta fold cannot teach it (peers' knowledge lives in
+    their master arrays), so it must pull a full model from a peer
+    (linear_mixer.cpp:598-632)."""
+    import time
+
+    store = _Store()
+    servers = _cluster("classifier", CONF, 2, store)
+    try:
+        c0 = ClassifierClient("127.0.0.1", servers[0].args.rpc_port, NAME)
+        for _ in range(10):
+            c0.train([["pos", Datum({"x": 1.0, "y": 0.2})]])
+            c0.train([["neg", Datum({"x": -1.0, "y": -0.2})]])
+        assert c0.do_mix() is True  # cluster now at model version 1
+        # late joiner: fresh model, version 0
+        servers += _cluster("classifier", CONF, 1, store)
+        late = servers[-1]
+        assert c0.do_mix() is True  # marks the joiner obsolete
+        cl = ClassifierClient("127.0.0.1", late.args.rpc_port, NAME)
+        deadline = time.time() + 20
+        top = None
+        while time.time() < deadline:
+            (res,) = cl.classify([Datum({"x": 1.0, "y": 0.2})])
+            if res:
+                top = max(res, key=lambda s: s[1])[0]
+                if top == "pos":
+                    break
+            time.sleep(0.2)
+        assert top == "pos", "late joiner never recovered the full model"
+        (st,) = cl.get_status().values()
+        assert st["mixer.model_version"] >= 1
+        assert st["mixer.obsolete"] is False
+        c0.close(), cl.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
 def test_mixer_fold_with_stub():
     """Mix rounds run against canned diffs — no sockets, no coordinator."""
     from jubatus_tpu.framework.linear_mixer import PROTOCOL_VERSION, unpack_mix

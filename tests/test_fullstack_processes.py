@@ -111,6 +111,38 @@ def test_processes_cluster_end_to_end(tmp_path):
         assert len(c.get_status()) == 1, "dead server stuck in membership"
         (res,) = c.classify([Datum({"x": 1.0})])
         assert max(res, key=lambda s: s[1])[0] == "pos"
+
+        # 6. restart it (the reference's clustering_test kill/restart tier):
+        #    it rejoins membership fresh, and a mix round teaches it the
+        #    surviving replica's model
+        procs.append(_spawn(
+            ["jubatus_tpu.server", "classifier", "-z", locator, "-n", "fs",
+             "-p", str(sport0), "-b", "127.0.0.1", "-d", str(tmp_path),
+             "-s", "1000000", "-i", "1000000000"],
+            tmp_path / "server_restarted.log"))
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            try:
+                if len(c.get_status()) == 2:
+                    break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.5)
+        assert len(c.get_status()) == 2, "restarted server never rejoined"
+        # the round marks the fresh node obsolete (version gate) and it
+        # pulls a full model from the survivor ASYNCHRONOUSLY — poll
+        assert c.do_mix() is True
+        with ClassifierClient("127.0.0.1", sport0, "fs", timeout=20.0) as d:
+            deadline = time.time() + 30
+            top = None
+            while time.time() < deadline:
+                (res,) = d.classify([Datum({"x": 1.0})])
+                if res:
+                    top = max(res, key=lambda s: s[1])[0]
+                    if top == "pos":
+                        break
+                time.sleep(0.5)
+            assert top == "pos", "restarted node never recovered the model"
         c.close()
     finally:
         for p in procs:

@@ -147,6 +147,33 @@ def test_push_mix_three_nodes_broadcast_converges():
             s.stop()
 
 
+def test_push_late_joiner_adopts_full_model():
+    """A node joining after gossip rounds ran is version-behind: when ITS
+    round initiates, it adopts the peer's full model before folding —
+    no actives demotion, no recovery storm (push_mixer phase 2.5)."""
+    store = _Store()
+    servers = _cluster("classifier", CONF, 2, store, "broadcast_mixer")
+    try:
+        c0 = ClassifierClient("127.0.0.1", servers[0].args.rpc_port, NAME)
+        for _ in range(10):
+            c0.train([["pos", Datum({"x": 1.0, "y": 0.2})]])
+            c0.train([["neg", Datum({"x": -1.0, "y": -0.2})]])
+        assert c0.do_mix() is True  # pair now at model version 1
+        late = _cluster("classifier", CONF, 1, store, "broadcast_mixer")[0]
+        servers.append(late)
+        cl = ClassifierClient("127.0.0.1", late.args.rpc_port, NAME)
+        assert cl.do_mix() is True  # late node initiates → adopts
+        (res,) = cl.classify([Datum({"x": 1.0, "y": 0.2})])
+        assert max(res, key=lambda s: s[1])[0] == "pos"
+        (st,) = cl.get_status().values()
+        assert st["mixer.model_version"] >= 1
+        assert st["mixer.obsolete"] is False
+        c0.close(), cl.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
 # -- cluster-unique id minting -------------------------------------------------
 
 

@@ -76,7 +76,6 @@ def test_partial_frame_then_completion(servers):
             for i in range(len(payload)):
                 s.sendall(payload[i:i + 1])
             s.settimeout(10)
-            buf = b""
             unp = msgpack.Unpacker(raw=False)
             got = None
             while got is None:
