@@ -137,9 +137,13 @@ def emit_rst(idl: IdlFile, service_name: str) -> str:
 
 
 def main(argv=None) -> int:
-    """CLI: ``python -m jubatus_tpu.codegen <file.idl> [--client SERVICE |
-    --table SERVICE | --rst SERVICE]`` — prints generated source to stdout."""
+    """CLI: ``python -m jubatus_tpu.codegen <file.idl> [--client SERVICE]
+    [--lang python|cpp|ruby|java|go] [--out DIR] [--table SERVICE]
+    [--rst SERVICE]`` — single-file output prints to stdout; multi-file
+    languages (cpp/ruby/java/go, ≙ jenerator's 5 client backends) write
+    into --out (default '.')."""
     import argparse
+    import os
     import sys
 
     from jubatus_tpu.codegen.parser import parse_idl_file
@@ -147,13 +151,36 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="jubatus_tpu.codegen")
     p.add_argument("idl")
     p.add_argument("--client", default="", metavar="SERVICE")
+    p.add_argument("--lang", default="python",
+                   choices=("python", "cpp", "ruby", "java", "go"),
+                   help="client language (with --client)")
+    p.add_argument("--out", default=".", metavar="DIR",
+                   help="output dir for multi-file languages")
     p.add_argument("--table", default="", metavar="SERVICE")
     p.add_argument("--rst", default="", metavar="SERVICE",
                    help="emit RST API docs (jubadoc)")
     ns = p.parse_args(argv)
     idl = parse_idl_file(ns.idl)
     if ns.client:
-        sys.stdout.write(emit_python_client(idl, ns.client))
+        if ns.lang == "python":
+            sys.stdout.write(emit_python_client(idl, ns.client))
+        else:
+            from jubatus_tpu.codegen.emit_clients import (
+                emit_go_client,
+                emit_java_client,
+                emit_ruby_client,
+            )
+            from jubatus_tpu.codegen.emit_cpp import emit_cpp_client
+
+            emitter = {"cpp": emit_cpp_client, "ruby": emit_ruby_client,
+                       "java": emit_java_client, "go": emit_go_client}[ns.lang]
+            files = emitter(idl, ns.client)
+            os.makedirs(ns.out, exist_ok=True)
+            for fn, src in files.items():
+                path = os.path.join(ns.out, fn)
+                with open(path, "w") as f:
+                    f.write(src)
+                print(path, file=sys.stderr)
     elif ns.rst:
         sys.stdout.write(emit_rst(idl, ns.rst))
     elif ns.table:

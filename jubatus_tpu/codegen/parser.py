@@ -85,10 +85,11 @@ _METHOD_RE = re.compile(r"^(.+?)\s+(\w+)\s*\((.*)\)$", re.S)
 _DECORATOR_RE = re.compile(r"#@(\w+)(?:\((\d+)\))?")
 
 
-def _split_args(argstr: str) -> List[str]:
-    """Split method args on commas outside <> nesting."""
+def split_top_commas(text: str) -> List[str]:
+    """Split on commas outside <> nesting — shared by the parser (method
+    arg lists) and every typed client emitter (template argument lists)."""
     out, depth, cur = [], 0, []
-    for ch in argstr:
+    for ch in text:
         if ch == "<":
             depth += 1
         elif ch == ">":
@@ -101,6 +102,9 @@ def _split_args(argstr: str) -> List[str]:
     if cur and "".join(cur).strip():
         out.append("".join(cur))
     return [a.strip() for a in out if a.strip()]
+
+
+_split_args = split_top_commas
 
 
 def _parse_field(text: str, where: str) -> Field:

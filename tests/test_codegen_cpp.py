@@ -1,0 +1,257 @@
+"""C++ client codegen tests (≙ jenerator cpp.ml client backend, SURVEY.md §2.7).
+
+Three tiers:
+  1. every reference .idl generates a client header that *compiles* (g++);
+  2. the embedded msgpack codec round-trips against the Python msgpack lib;
+  3. a compiled C++ driver binary runs a full train/classify/save/load
+     session against a live EngineServer over the wire (the strongest
+     cross-language parity check: reference clients are C++ too).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+import msgpack
+import pytest
+
+from jubatus_tpu.codegen.emit_cpp import emit_cpp_client, runtime_header
+from jubatus_tpu.codegen.parser import parse_reference_idls
+
+REFERENCE_IDL_DIR = "/root/reference/jubatus/server/server"
+
+gxx = shutil.which("g++")
+pytestmark = pytest.mark.skipif(gxx is None, reason="g++ not available")
+
+
+def _write_files(tmp_path, files):
+    for fn, src in files.items():
+        (tmp_path / fn).write_text(src)
+
+
+@pytest.fixture(scope="module")
+def idls():
+    if not os.path.isdir(REFERENCE_IDL_DIR):
+        pytest.skip("reference IDLs not present")
+    return parse_reference_idls(REFERENCE_IDL_DIR)
+
+
+def test_all_engines_generate_and_compile(idls, tmp_path):
+    for engine, idl in idls.items():
+        files = emit_cpp_client(idl, engine)
+        assert f"{engine}_client.hpp" in files
+        assert "jubatus_tpu_client.hpp" in files
+        _write_files(tmp_path, files)
+        r = subprocess.run(
+            [gxx, "-std=c++11", "-fsyntax-only", "-Wall", "-Wextra",
+             "-x", "c++", str(tmp_path / f"{engine}_client.hpp")],
+            capture_output=True, text=True)
+        assert r.returncode == 0, f"{engine}: {r.stderr[:2000]}"
+
+
+def test_generated_client_mirrors_reference_api(idls):
+    src = emit_cpp_client(idls["classifier"], "classifier")["classifier_client.hpp"]
+    # the reference's generated surface (classifier_client.hpp:19-60)
+    assert "namespace classifier {" in src
+    assert "class classifier : public jubatus_tpu::client::common::client" in src
+    for method in ("train", "classify", "get_labels", "set_label", "clear",
+                   "delete_label"):
+        assert f" {method}(" in src
+    assert "struct estimate_result" in src
+    assert "struct labeled_datum" in src
+
+
+def test_msgpack_codec_roundtrip(tmp_path):
+    """The embedded C++ codec must agree byte-level with python-msgpack:
+    C++ packs a torture-test value; Python unpacks it; Python packs it
+    back; C++ parses that and re-packs to the identical bytes."""
+    (tmp_path / "jubatus_tpu_client.hpp").write_text(runtime_header())
+    main = r"""
+#include "jubatus_tpu_client.hpp"
+#include <cstdio>
+using namespace jubatus_tpu;
+int main(int argc, char** argv) {
+  if (argc > 1 && std::string(argv[1]) == "reencode") {
+    std::string in, chunk;
+    char buf[4096]; size_t n;
+    while ((n = fread(buf, 1, sizeof(buf), stdin)) > 0) in.append(buf, n);
+    size_t pos = 0; mp::value v;
+    if (!mp::parse(in, pos, v) || pos != in.size()) return 2;
+    // the no-alloc completeness scan must agree with the real parser
+    size_t spos = 0;
+    if (!mp::skip(in, spos) || spos != pos) return 3;
+    std::string out; mp::pack(out, v);
+    fwrite(out.data(), 1, out.size(), stdout);
+    return 0;
+  }
+  bool legacy = (argc > 1 && std::string(argv[1]) == "legacy");
+  mp::value v = mp::v_arr();
+  v.a.push_back(mp::v_nil());
+  v.a.push_back(mp::v_bool(true));
+  v.a.push_back(mp::v_int(-7));
+  v.a.push_back(mp::v_int(-300));
+  v.a.push_back(mp::v_int(-70000));
+  v.a.push_back(mp::v_uint(0));
+  v.a.push_back(mp::v_uint(200));
+  v.a.push_back(mp::v_uint(70000));
+  v.a.push_back(mp::v_uint(1ULL << 40));
+  v.a.push_back(mp::v_double(3.25));
+  v.a.push_back(mp::v_str("hello"));
+  v.a.push_back(mp::v_str(std::string(300, 'x')));
+  v.a.push_back(mp::v_bin(std::string("\x00\x01\xff", 3)));
+  mp::value m = mp::v_map();
+  m.m.push_back(std::make_pair(mp::v_str("k"), mp::v_int(1)));
+  v.a.push_back(m);
+  std::string out; mp::pack(out, v, legacy);
+  fwrite(out.data(), 1, out.size(), stdout);
+  return 0;
+}
+"""
+    (tmp_path / "codec.cpp").write_text(main)
+    exe = tmp_path / "codec"
+    r = subprocess.run([gxx, "-std=c++11", "-O0", "-o", str(exe),
+                        str(tmp_path / "codec.cpp")],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[:2000]
+
+    blob = subprocess.run([str(exe)], capture_output=True).stdout
+    decoded = msgpack.unpackb(blob, raw=False, strict_map_key=False)
+    assert decoded[:11] == [None, True, -7, -300, -70000, 0, 200, 70000,
+                            1 << 40, 3.25, "hello"]
+    assert decoded[11] == "x" * 300
+    assert decoded[12] == b"\x00\x01\xff"
+    assert decoded[13] == {"k": 1}
+
+    # Python → C++ → bytes must survive (C++ parse of foreign encodings)
+    py_blob = msgpack.packb(decoded, use_bin_type=True)
+    r2 = subprocess.run([str(exe), "reencode"], input=py_blob,
+                        capture_output=True)
+    assert r2.returncode == 0
+    assert msgpack.unpackb(r2.stdout, raw=False) == decoded
+
+    # legacy mode (reference servers' pre-2.0 msgpack): no str8/bin type
+    # bytes anywhere — with this controlled payload none can occur in data
+    legacy = subprocess.run([str(exe), "legacy"], capture_output=True).stdout
+    for forbidden in (0xd9, 0xc4, 0xc5, 0xc6):
+        assert bytes([forbidden]) not in legacy, hex(forbidden)
+    relaxed = msgpack.unpackb(legacy, raw=True, strict_map_key=False)
+    assert relaxed[10] == b"hello"          # strings arrive as raw
+    assert relaxed[12] == b"\x00\x01\xff"   # binary arrives as raw too
+
+
+CPP_SESSION = r"""
+#include "classifier_client.hpp"
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+using namespace jubatus_tpu;
+using classifier::labeled_datum;
+using classifier::estimate_result;
+
+int main(int argc, char** argv) {
+  assert(argc == 3);
+  int port = atoi(argv[1]);
+  classifier::client::classifier c("127.0.0.1", port, "cpp_e2e", 10.0);
+
+  // train two separable classes
+  std::vector<labeled_datum> batch;
+  for (int i = 0; i < 50; ++i) {
+    labeled_datum pos, neg;
+    pos.label = "pos";
+    pos.data.add_number("x", 1.0 + 0.01 * i).add_string("tag", "p");
+    neg.label = "neg";
+    neg.data.add_number("x", -1.0 - 0.01 * i).add_string("tag", "n");
+    batch.push_back(pos);
+    batch.push_back(neg);
+  }
+  int64_t trained = c.train(batch);
+  assert(trained == 100);
+
+  std::vector<datum> queries;
+  datum q1, q2;
+  q1.add_number("x", 0.9).add_string("tag", "p");
+  q2.add_number("x", -0.9).add_string("tag", "n");
+  queries.push_back(q1);
+  queries.push_back(q2);
+  std::vector<std::vector<estimate_result> > res = c.classify(queries);
+  assert(res.size() == 2);
+  std::string best1, best2;
+  double s1 = -1e30, s2 = -1e30;
+  for (size_t j = 0; j < res[0].size(); ++j)
+    if (res[0][j].score > s1) { s1 = res[0][j].score; best1 = res[0][j].label; }
+  for (size_t j = 0; j < res[1].size(); ++j)
+    if (res[1][j].score > s2) { s2 = res[1][j].score; best2 = res[1][j].label; }
+  assert(best1 == "pos");
+  assert(best2 == "neg");
+
+  std::map<std::string, uint64_t> labels = c.get_labels();
+  assert(labels.size() == 2);
+  assert(labels.count("pos") == 1 && labels.count("neg") == 1);
+
+  assert(c.set_label("extra"));
+  labels = c.get_labels();
+  assert(labels.size() == 3);
+  assert(c.delete_label("extra"));
+
+  // built-ins over the common base
+  std::string conf = c.get_config();
+  assert(conf.find("AROW") != std::string::npos);
+  std::map<std::string, std::string> saved = c.save(argv[2]);
+  assert(saved.size() == 1);
+  assert(c.load(argv[2]));
+  std::map<std::string, std::map<std::string, std::string> > st = c.get_status();
+  assert(st.size() == 1);
+  assert(st.begin()->second.count("uptime") == 1);
+
+  // error taxonomy: unknown method must throw, connection must survive
+  bool threw = false;
+  try {
+    c.get_client().call("no_such_method", std::vector<mp::value>());
+  } catch (const rpc_error&) {
+    threw = true;
+  }
+  assert(threw);
+  assert(c.do_mix() == false);  // standalone: no-op
+
+  printf("CPP_E2E_OK\n");
+  return 0;
+}
+"""
+
+
+@pytest.mark.slow
+def test_cpp_client_end_to_end(idls, tmp_path):
+    from jubatus_tpu.server import EngineServer
+
+    conf = {
+        "method": "AROW",
+        "parameter": {"regularization_weight": 1.0},
+        "converter": {
+            "string_rules": [{"key": "*", "type": "str",
+                              "sample_weight": "bin", "global_weight": "bin"}],
+            "num_rules": [{"key": "*", "type": "num"}],
+        },
+    }
+    _write_files(tmp_path, emit_cpp_client(idls["classifier"], "classifier"))
+    (tmp_path / "session.cpp").write_text(CPP_SESSION)
+    exe = tmp_path / "session"
+    r = subprocess.run(
+        [gxx, "-std=c++11", "-O0", "-I", str(tmp_path), "-o", str(exe),
+         str(tmp_path / "session.cpp")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[:3000]
+
+    from jubatus_tpu.server.args import ServerArgs
+
+    srv = EngineServer("classifier", conf,
+                       args=ServerArgs(engine="classifier", datadir=str(tmp_path)))
+    port = srv.start(0)
+    try:
+        run = subprocess.run([str(exe), str(port), "cppmodel"],
+                             capture_output=True, text=True, timeout=60)
+        assert run.returncode == 0, f"stdout={run.stdout}\nstderr={run.stderr}"
+        assert "CPP_E2E_OK" in run.stdout
+    finally:
+        srv.stop()
