@@ -178,7 +178,7 @@ def main(argv=None) -> int:
             os.makedirs(ns.out, exist_ok=True)
             for fn, src in files.items():
                 path = os.path.join(ns.out, fn)
-                with open(path, "w") as f:
+                with open(path, "w", encoding="utf-8") as f:
                     f.write(src)
                 print(path, file=sys.stderr)
     elif ns.rst:

@@ -427,6 +427,31 @@ def _java_template(t: str) -> str:
     return f"lookup({_camel(t)}.class)"  # @Message POJO
 
 
+def _emit_java_message(msg: Message, service_name: str) -> str:
+    """One public @Message POJO per file (Java allows a single public
+    top-level class per file — inline package-private classes would make
+    the client API uncallable from user packages)."""
+    name = _camel(msg.name)
+    out = [
+        f"// {name}.java — generated from {service_name}.idl by",
+        "// jubatus_tpu.codegen (--lang java). *** DO NOT EDIT ***",
+        f"package us.jubatus_tpu.{service_name};",
+        "",
+        "import java.util.List;",
+        "import java.util.Map;",
+        "import org.msgpack.annotation.Message;",
+        "import us.jubatus_tpu.common.Datum;",
+        "import us.jubatus_tpu.common.Tuple;",
+        "",
+        "@Message",
+        f"public class {name} {{",
+    ]
+    for f in sorted(msg.fields, key=lambda f: f.index):
+        out.append(f"  public {_java_type(f.type)} {_java_lower_camel(f.name)};")
+    out += ["}", ""]
+    return "\n".join(out)
+
+
 def emit_java_client(idl: IdlFile, service_name: str) -> Dict[str, str]:
     cls = f"{_camel(service_name)}Client"
     out = [
@@ -435,12 +460,11 @@ def emit_java_client(idl: IdlFile, service_name: str) -> Dict[str, str]:
         "//",
         "// Runs over org.msgpack (the stack the reference's generated Java",
         "// clients use); message classes are @Message POJOs packed as field",
-        "// arrays in IDL index order.",
+        "// arrays in IDL index order, one public class per file.",
         f"package us.jubatus_tpu.{service_name};",
         "",
         "import java.util.List;",
         "import java.util.Map;",
-        "import org.msgpack.annotation.Message;",
         "import org.msgpack.template.Templates;",
         "import us.jubatus_tpu.common.ClientBase;",
         "import us.jubatus_tpu.common.Datum;",
@@ -448,13 +472,6 @@ def emit_java_client(idl: IdlFile, service_name: str) -> Dict[str, str]:
         "import us.jubatus_tpu.common.TupleTemplate;",
         "",
     ]
-    for msg in idl.messages:
-        out.append("@Message")
-        out.append(f"class {_camel(msg.name)} {{")
-        for f in sorted(msg.fields, key=lambda f: f.index):
-            out.append(f"  public {_java_type(f.type)} {_java_lower_camel(f.name)};")
-        out.append("}")
-        out.append("")
     out.append(f"public class {cls} extends ClientBase {{")
     out.append(f"  public {cls}(String host, int port, String name, "
                "double timeoutSec) throws Exception {")
@@ -479,13 +496,16 @@ def emit_java_client(idl: IdlFile, service_name: str) -> Dict[str, str]:
         out.append("  }")
         out.append("")
     out += ["}", ""]
-    return {
+    files = {
         f"{cls}.java": "\n".join(out),
         "ClientBase.java": JAVA_CLIENT_BASE,
         "Datum.java": JAVA_DATUM,
         "Tuple.java": JAVA_TUPLE,
         "TupleTemplate.java": JAVA_TUPLE_TEMPLATE,
     }
+    for msg in idl.messages:
+        files[f"{_camel(msg.name)}.java"] = _emit_java_message(msg, service_name)
+    return files
 
 
 # ----------------------------------------------------------------------- Go

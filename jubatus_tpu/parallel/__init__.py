@@ -15,3 +15,8 @@ from jubatus_tpu.parallel.mix import (  # noqa: F401
     allreduce_diffs,
     tree_sum,
 )
+from jubatus_tpu.parallel.ring import (  # noqa: F401
+    ring_euclid_topk,
+    ring_hamming_topk,
+    ring_scan,
+)

@@ -66,7 +66,12 @@ def test_java_clients_all_engines(idls):
         assert f"public class {cls} extends ClientBase" in src
         assert src.count("{") == src.count("}"), f"{engine}: unbalanced braces"
         for msg in idl.messages:
-            assert f"class {_camel(msg.name)}" in src
+            # one PUBLIC top-level class per file, or user code can't name
+            # the types that appear in the client's public signatures
+            msrc = files[f"{_camel(msg.name)}.java"]
+            assert f"public class {_camel(msg.name)}" in msrc
+            assert "@Message" in msrc
+            assert msrc.count("{") == msrc.count("}")
         # common runtime classes ship alongside
         common = ("ClientBase.java", "Datum.java", "Tuple.java",
                   "TupleTemplate.java")
