@@ -29,12 +29,14 @@ D = 1 << DIM_BITS
 L = 2
 K = 64
 # microbatch = bounded-staleness window (SURVEY.md §7 hard part b): all
-# examples in a batch score against the batch-start snapshot. 8192 measured
-# ~12% faster than 4096 on v5e; deployments trading staleness for
-# throughput should scale --interval-count along with their batch size.
-BATCH = 8192
+# examples in a batch score against the batch-start snapshot. Measured on
+# v5e (same process, median of trials): 4096→8192 +12%, 8192→32768 +20%
+# (269k→322k samples/s) — gather/scatter launch overhead amortizes with
+# batch; beyond 32768 gains flatten (65536: +1.5%). Deployments trading
+# staleness for throughput should scale --interval-count with batch size.
+BATCH = 32768
 WARMUP_STEPS = 2
-STEPS = 20
+STEPS = 8
 BASELINE_EXAMPLES = 2000
 
 

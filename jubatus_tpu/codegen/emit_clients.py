@@ -504,7 +504,13 @@ def emit_java_client(idl: IdlFile, service_name: str) -> Dict[str, str]:
         "TupleTemplate.java": JAVA_TUPLE_TEMPLATE,
     }
     for msg in idl.messages:
-        files[f"{_camel(msg.name)}.java"] = _emit_java_message(msg, service_name)
+        fn = f"{_camel(msg.name)}.java"
+        if fn in files:  # would silently clobber the runtime/client file
+            raise ValueError(
+                f"message name {msg.name!r} collides with generated file "
+                f"{fn} (reserved: client class, ClientBase, Datum, Tuple, "
+                "TupleTemplate) — rename the message for the Java backend")
+        files[fn] = _emit_java_message(msg, service_name)
     return files
 
 

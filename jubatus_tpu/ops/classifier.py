@@ -258,6 +258,9 @@ def train_batch_parallel(
         s, labels, label_mask, x2, v, x2_vec, param, method=method
     )
 
+    # NB: a single fused [2B, K] scatter (concat correct+wrong updates) was
+    # measured numerically equivalent but throughput-neutral on v5e; two
+    # plain scatters stay for simplicity
     up_c = alpha[:, None] * sig_c * val                            # [B, K]
     up_w = alpha_w[:, None] * sig_w * val
     dw = dw.at[labels[:, None], idx].add(up_c)

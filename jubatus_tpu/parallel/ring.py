@@ -31,13 +31,9 @@ from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-
-def shard_rows(mesh: Mesh, x, axis: str = "shard"):
-    """Place [N, ...] arrays row-sharded over the mesh axis."""
-    return jax.device_put(
-        x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1)))))
+from jubatus_tpu.parallel.sharded_knn import shard_table as shard_rows  # noqa: F401
 
 
 def ring_scan(step_fn: Callable, carry, block, axis: str):
@@ -83,6 +79,10 @@ def _ring_topk(mesh, queries, blocks, local_scores, k: int, axis: str):
     sharded over ``axis``."""
     n_shards = mesh.shape[axis]
     c_local = jax.tree_util.tree_leaves(blocks)[0].shape[0] // n_shards
+    # never return more candidates than the table holds — padding slots
+    # would carry +inf distance but a fabricated row id 0
+    # (sharded_knn.sharded_hamming_topk clamps the same way)
+    k = min(k, c_local * n_shards)
 
     def shard_fn(q, blk):
         kk = min(k, c_local)

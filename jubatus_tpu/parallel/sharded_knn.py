@@ -27,9 +27,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def shard_table(mesh: Mesh, table, axis: str = "shard"):
-    """Place [C, W] signature rows sharded over the mesh axis (C must be a
-    multiple of the axis size; pad the store capacity to match)."""
-    return jax.device_put(table, NamedSharding(mesh, P(axis, None)))
+    """Place [C, ...] rows sharded over the mesh axis (C must be a multiple
+    of the axis size; pad the store capacity to match). Shared by the
+    all-gather (this module) and ring (parallel/ring.py) scan strategies."""
+    spec = P(axis, *([None] * (table.ndim - 1)))
+    return jax.device_put(table, NamedSharding(mesh, spec))
 
 
 def replicate(mesh: Mesh, x):

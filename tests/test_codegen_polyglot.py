@@ -101,6 +101,18 @@ def test_go_clients_all_engines(idls):
         assert "client.go" in files
 
 
+def test_java_reserved_message_name_rejected():
+    """A message whose camel-cased name collides with a runtime file must
+    error loudly instead of silently clobbering Datum.java et al."""
+    from jubatus_tpu.codegen.parser import parse_idl
+
+    idl = parse_idl(
+        "message datum {\n  0: string x\n}\n"
+        "service foo {\n  #@random #@nolock #@pass\n  bool ping()\n}\n")
+    with pytest.raises(ValueError, match="collides"):
+        emit_java_client(idl, "foo")
+
+
 def test_cli_lang_flag_writes_files(idls, tmp_path):
     idl_path = os.path.join(REFERENCE_IDL_DIR, "classifier.idl")
     for lang, expect in (("cpp", "classifier_client.hpp"),

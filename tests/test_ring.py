@@ -109,6 +109,24 @@ def test_ring_euclid_matches_dense(mesh, rng):
                                    rtol=1e-5, atol=1e-5)
 
 
+def test_ring_k_exceeding_table_clamps(mesh, rng):
+    """k > C must clamp to C (no +inf/fabricated-id padding columns)."""
+    hash_num, dim, nnz = 32, 1 << 10, 4
+    B, C = 8, 16
+    qi, qv = _sparse_rows(rng, B, nnz, dim)
+    ri, rv = _sparse_rows(rng, C, nnz, dim)
+    q_sigs = knn.lsh_signature(qi, qv, hash_num=hash_num)
+    row_sigs = knn.lsh_signature(ri, rv, hash_num=hash_num)
+    d, gidx = ring_hamming_topk(
+        mesh, shard_rows(mesh, q_sigs), shard_rows(mesh, row_sigs),
+        hash_num=hash_num, k=24,
+    )
+    assert d.shape == (B, C) and gidx.shape == (B, C)
+    assert np.isfinite(np.asarray(d)).all()
+    for b in range(B):
+        assert sorted(np.asarray(gidx)[b].tolist()) == list(range(C))
+
+
 def test_ring_k_larger_than_local_block(mesh, rng):
     """k spanning multiple blocks: the running merge must keep candidates
     from several origins (c_local = 2 here, k = 6)."""
