@@ -503,13 +503,16 @@ def emit_java_client(idl: IdlFile, service_name: str) -> Dict[str, str]:
         "Tuple.java": JAVA_TUPLE,
         "TupleTemplate.java": JAVA_TUPLE_TEMPLATE,
     }
+    reserved = set(files)
     for msg in idl.messages:
         fn = f"{_camel(msg.name)}.java"
-        if fn in files:  # would silently clobber the runtime/client file
+        if fn in files:  # would silently clobber an earlier file
+            what = ("reserved file (client class, ClientBase, Datum, Tuple, "
+                    "or TupleTemplate)" if fn in reserved
+                    else "another message that camel-cases to the same name")
             raise ValueError(
-                f"message name {msg.name!r} collides with generated file "
-                f"{fn} (reserved: client class, ClientBase, Datum, Tuple, "
-                "TupleTemplate) — rename the message for the Java backend")
+                f"message name {msg.name!r} collides with {what} at {fn} — "
+                "rename the message for the Java backend")
         files[fn] = _emit_java_message(msg, service_name)
     return files
 

@@ -1,0 +1,97 @@
+"""jubadump tests (≙ the reference's model-dump tool, man/en/jubadump.1)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from jubatus_tpu.cmd import jubadump
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.framework import save_model
+from jubatus_tpu.server.factory import create_driver
+
+STAT_CFG = {"window_size": 16}
+
+CLASSIFIER_CFG = {
+    "method": "PA",
+    "parameter": {},
+    "converter": {
+        "string_rules": [{"key": "*", "type": "space",
+                          "sample_weight": "bin", "global_weight": "bin"}],
+        "num_rules": [{"key": "*", "type": "num"}],
+    },
+}
+
+
+@pytest.fixture()
+def model_file(tmp_path):
+    d = create_driver("classifier", CLASSIFIER_CFG)
+    d.train([("spam", Datum({"w": "buy pills now"})),
+             ("ham", Datum({"w": "lunch at noon"}))])
+    path = str(tmp_path / "m.jubatus")
+    save_model(path, d, model_id="snap1", config=json.dumps(CLASSIFIER_CFG))
+    return path
+
+
+def test_dump_full(model_file):
+    out = jubadump.dump_file(model_file)
+    assert out["header"]["crc32_ok"] is True
+    assert out["header"]["format_version"] == 1
+    assert out["system"]["type"] == "classifier"
+    assert out["system"]["id"] == "snap1"
+    # config comes back structured, not as an escaped string
+    assert out["system"]["config"]["method"] == "PA"
+    assert "user_data" in out
+    json.dumps(out)  # fully JSON-serializable
+
+
+def test_dump_summary_digests_arrays(model_file):
+    out = jubadump.dump_file(model_file, summary=True)
+    blob = json.dumps(out)
+    # weight tables (2^20-ish floats) must be digested, not dumped
+    assert len(blob) < 100_000
+    assert "__array__" in blob
+
+
+def test_dump_rejects_non_model(tmp_path):
+    p = tmp_path / "junk.bin"
+    p.write_bytes(b"\x00" * 100)
+    with pytest.raises(ValueError, match="bad magic"):
+        jubadump.dump_file(str(p))
+    (tmp_path / "short.bin").write_bytes(b"xy")
+    with pytest.raises(ValueError, match="truncated"):
+        jubadump.dump_file(str(tmp_path / "short.bin"))
+
+
+def test_dump_detects_corruption(model_file):
+    raw = bytearray(open(model_file, "rb").read())
+    raw[-1] ^= 0xFF
+    open(model_file, "wb").write(bytes(raw))
+    out = jubadump.dump_file(model_file)
+    assert out["header"]["crc32_ok"] is False
+
+
+def test_cli_main(model_file, capsys):
+    assert jubadump.main(["-i", model_file, "--summary"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["system"]["type"] == "classifier"
+    assert jubadump.main(["-i", model_file + ".nope"]) == 1
+
+
+def test_genman_renders_all_pages(tmp_path):
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "docs/gen_man.py", str(tmp_path)],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert r.returncode == 0, r.stderr[:1500]
+    pages = sorted(p.name for p in tmp_path.iterdir())
+    assert "jubadump.1" in pages
+    assert "jubactl.8" in pages
+    assert "jubatus_server.8" in pages
+    for p in tmp_path.iterdir():
+        txt = p.read_text()
+        assert txt.startswith(".TH ")
+        assert ".SH SYNOPSIS" in txt and ".SH OPTIONS" in txt
