@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import struct
 import sys
 import zlib
 from typing import Any
@@ -71,11 +70,8 @@ def dump_file(path: str, *, summary: bool = False,
         raw = f.read()
     if len(raw) < _HEADER.size:
         raise ValueError(f"{path}: truncated header ({len(raw)} bytes)")
-    try:
-        magic, fmt, vmaj, vmin, vmaint, crc, ssize, usize = \
-            _HEADER.unpack_from(raw)
-    except struct.error as e:
-        raise ValueError(f"{path}: bad header: {e}")
+    magic, fmt, vmaj, vmin, vmaint, crc, ssize, usize = \
+        _HEADER.unpack_from(raw)
     if magic != MAGIC:
         raise ValueError(f"{path}: bad magic {magic!r} (not a model file)")
     body = raw[_HEADER.size:]
@@ -97,7 +93,13 @@ def dump_file(path: str, *, summary: bool = False,
         out["header"]["warning"] = (
             f"size mismatch: header says {ssize}+{usize}, file has {len(body)}")
         return out
-    system = unpack_obj(body[:ssize])
+    # corrupt bodies (the very case crc32_ok flags) must never lose the
+    # header report to an unpack traceback
+    try:
+        system = unpack_obj(body[:ssize])
+    except Exception as e:  # noqa: BLE001 — msgpack raises various types
+        out["system_error"] = f"cannot decode system container: {e}"
+        return out
     if isinstance(system, dict) and isinstance(system.get("config"), str):
         try:  # present the config as structured JSON, not an escaped string
             system = dict(system, config=json.loads(system["config"]))
@@ -105,7 +107,11 @@ def dump_file(path: str, *, summary: bool = False,
             pass
     out["system"] = _jsonable(system, summary)
     if not skip_user_data:
-        user_version, user_data = unpack_obj(body[ssize:ssize + usize])
+        try:
+            user_version, user_data = unpack_obj(body[ssize:ssize + usize])
+        except Exception as e:  # noqa: BLE001
+            out["user_data_error"] = f"cannot decode user data: {e}"
+            return out
         out["user_data_version"] = user_version
         out["user_data"] = _jsonable(user_data, summary)
     return out

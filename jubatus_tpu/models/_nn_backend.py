@@ -51,6 +51,9 @@ class NNBackend:
         self.seed = int(seed)
         self.store = RowStore(max_size=max_size, keep_datum=keep_datum)
         self._pending: Dict[str, SparseVector] = {}
+        self._mesh = None
+        self._mesh_axis = "shard"
+        self._mesh_dev = None
         self._init_sigs()
 
     def _init_sigs(self) -> None:
@@ -64,6 +67,7 @@ class NNBackend:
         else:
             self._sigs = None
         self._sig_dev: Optional[Tuple[int, Any]] = None
+        self._mesh_dev = None
 
     # -- writes ---------------------------------------------------------------
     def set_row(self, row_id: str, vec: SparseVector, datum: Any = None) -> None:
@@ -112,11 +116,80 @@ class NNBackend:
         for row, (rid, _) in enumerate(items):
             self._sigs[self.store.slots[rid]] = sigs[row]
         self._sig_dev = None
+        self._mesh_dev = None
 
     def _sig_view(self):
         if self._sig_dev is None or self._sig_dev[0] != self.store.version:
             self._sig_dev = (self.store.version, jnp.asarray(self._sigs))
         return self._sig_dev[1]
+
+    # -- mesh-sharded serving (≙ CHT row sharding, SURVEY.md §5) -------------
+    def attach_mesh(self, mesh, axis: str = "shard") -> None:
+        """Serve hash-method queries from a row-sharded signature table on
+        a device mesh (parallel/sharded_knn.py) instead of one device —
+        the capacity-scaling move the reference makes with CHT row
+        placement. Exact methods (inverted_index/euclid) keep the dense
+        path. Pass mesh=None to detach."""
+        if mesh is not None and self.method not in HASH_METHODS:
+            raise ValueError(
+                f"mesh-sharded serving supports hash methods {HASH_METHODS}, "
+                f"not {self.method!r}")
+        self._mesh = mesh
+        self._mesh_axis = axis
+        self._mesh_dev = None
+
+    def _mesh_view(self):
+        """(sharded sigs, sharded valid mask) — row count padded up to a
+        multiple of the shard axis, padding slots masked invalid."""
+        from jubatus_tpu.parallel.sharded_knn import shard_table
+
+        if self._mesh_dev is not None and \
+                self._mesh_dev[0] == self.store.version:
+            return self._mesh_dev[1:]
+        s = self._mesh.shape[self._mesh_axis]
+        c = self.store.capacity
+        pad = (-c) % s
+        sigs = np.pad(self._sigs, ((0, pad), (0, 0)))
+        valid = np.pad(self.store.live_mask(), (0, pad))
+        sigs = shard_table(self._mesh, jnp.asarray(sigs), self._mesh_axis)
+        valid = shard_table(self._mesh, jnp.asarray(valid), self._mesh_axis)
+        self._mesh_dev = (self.store.version, sigs, valid)
+        return sigs, valid
+
+    def _mesh_neighbors(self, vecs, k: int) -> List[List[Tuple[str, float]]]:
+        from jubatus_tpu.parallel import sharded_knn
+
+        self._flush()
+        k = min(k, len(self.store))
+        if k <= 0 or not vecs:
+            return [[] for _ in vecs]
+        sigs, valid = self._mesh_view()
+        sb = SparseBatch.from_vectors(vecs)
+        idx, val = jnp.asarray(sb.idx), jnp.asarray(sb.val)
+        if self.method == "lsh":
+            q = knn.lsh_signature(idx, val, hash_num=self.hash_num,
+                                  seed=self.seed)
+            d, gidx = sharded_knn.sharded_hamming_topk(
+                self._mesh, q, sigs, hash_num=self.hash_num, k=k,
+                axis=self._mesh_axis, valid=valid)
+        elif self.method == "minhash":
+            q = knn.minhash_signature(idx, val, hash_num=self.hash_num,
+                                      seed=self.seed)
+            d, gidx = sharded_knn.sharded_minhash_topk(
+                self._mesh, q, sigs, k=k, axis=self._mesh_axis, valid=valid)
+        else:
+            q = knn.euclid_projection(idx, val, hash_num=self.hash_num,
+                                      seed=self.seed)
+            d, gidx = sharded_knn.sharded_euclid_lsh_topk(
+                self._mesh, q, sigs, hash_num=self.hash_num, k=k,
+                axis=self._mesh_axis, valid=valid)
+        d, gidx = np.asarray(d), np.asarray(gidx)
+        out = []
+        for b in range(len(vecs)):
+            row = [(self.store.ids[int(s)], float(d[b, j]))
+                   for j, s in enumerate(gidx[b]) if np.isfinite(d[b, j])]
+            out.append(row)
+        return out
 
     # -- queries ---------------------------------------------------------------
     def _query_sig(self, vec: SparseVector):
@@ -168,6 +241,8 @@ class NNBackend:
 
     def neighbors(self, vec: SparseVector, k: int) -> List[Tuple[str, float]]:
         """k nearest as (id, distance), ascending."""
+        if self._mesh is not None:
+            return self._mesh_neighbors([vec], k)[0]
         d = self.distances(vec)
         k = min(k, len(self.store))
         if k <= 0:
@@ -175,6 +250,14 @@ class NNBackend:
         order = np.argpartition(d, k - 1)[:k]
         order = order[np.argsort(d[order])]
         return [(self.store.ids[s], float(d[s])) for s in order]
+
+    def neighbors_batch(self, vecs: List[SparseVector],
+                        k: int) -> List[List[Tuple[str, float]]]:
+        """Batched k-nearest: one sharded scan for the whole batch when a
+        mesh is attached, else per-query dense scans."""
+        if self._mesh is not None:
+            return self._mesh_neighbors(list(vecs), k)
+        return [self.neighbors(v, k) for v in vecs]
 
     def similar(self, vec: SparseVector, k: int) -> List[Tuple[str, float]]:
         """k most similar as (id, similarity), descending."""

@@ -27,7 +27,7 @@ instantiate it for the LSH/minhash and euclid_lsh engine backends.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -121,15 +121,28 @@ def ring_hamming_topk(
     hash_num: int,
     k: int,
     axis: str = "shard",
+    valid: Optional[jax.Array] = None,  # [C] bool, sharded over `axis`
 ) -> Tuple[jax.Array, jax.Array]:
     """Global top-k smallest hamming distance, both operands sharded.
-    Returns (distances [B, k], global row ids [B, k]), B-sharded."""
+    Returns (distances [B, k], global row ids [B, k]), B-sharded. ``valid``
+    masks dead/padding rows out (it rotates with the table blocks)."""
     from jubatus_tpu.ops import knn
 
-    def scores(q, blk):
-        return -knn._hamming_distances_batch_xla(q, blk, hash_num=hash_num)
+    if valid is None:
+        def scores(q, blk):
+            return -knn._hamming_distances_batch_xla(
+                q, blk, hash_num=hash_num)
 
-    neg, gidx = _ring_topk(mesh, q_sigs, row_sigs, scores, k, axis)
+        blocks = row_sigs
+    else:
+        def scores(q, blk):
+            sigs, v = blk
+            d = knn._hamming_distances_batch_xla(q, sigs, hash_num=hash_num)
+            return jnp.where(v[None, :], -d, -jnp.inf)
+
+        blocks = (row_sigs, valid)
+
+    neg, gidx = _ring_topk(mesh, q_sigs, blocks, scores, k, axis)
     return -neg, gidx
 
 

@@ -8,7 +8,13 @@ One query batch fans out to every shard implicitly (the table is sharded,
 the query replicated), each device scans its slice of the table with the
 same kernels the single-chip path uses (ops/knn; pallas on TPU), takes a
 LOCAL top-k, and one tiny all_gather of [k]-sized candidates merges the
-global top-k — O(shards·k) bytes over ICI instead of O(rows).
+global top-k — O(shards·k) bytes over ICI instead of O(rows). All three
+hash methods (lsh/minhash/euclid_lsh) ride the same driver; an optional
+``valid`` row mask keeps dead/padding slots out of the results (the
+single-chip path's live-mask, models/_nn_backend.py).
+
+For batches where the QUERIES don't fit replicated either, use the ring
+strategy (parallel/ring.py) instead.
 
 Row placement: ``coord.cht.shard_for(row_id, n_shards)`` keeps placement
 stable and hash-based like the ring; slot index within the shard is the
@@ -19,7 +25,7 @@ local_slot`` — decode with ``divmod(gid, capacity)``.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,7 +44,48 @@ def replicate(mesh: Mesh, x):
     return jax.device_put(x, NamedSharding(mesh, P()))
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "hash_num", "k", "axis"))
+def _sharded_topk(mesh, q, table, local_scores, k: int, axis: str,
+                  valid=None):
+    """Generic all-gather-merge driver. ``local_scores(q, rows) -> [B, c]``
+    (HIGHER = better; negate distances). Returns (scores [B, k'],
+    global ids [B, k']) replicated, k' = min(k, C)."""
+    n_shards = mesh.shape[axis]
+    c_local = table.shape[0] // n_shards
+    k = min(k, c_local * n_shards)
+
+    def scan(q, rows, *v):
+        sc = local_scores(q, rows).astype(jnp.float32)     # [B, c_local]
+        if v:
+            sc = jnp.where(v[0][None, :], sc, -jnp.inf)
+        kk = min(k, c_local)
+        neg, idx = jax.lax.top_k(sc, kk)                   # [B, kk]
+        shard_id = jax.lax.axis_index(axis)
+        gidx = idx + shard_id * c_local                    # global ids
+        # merge across shards: gather the tiny candidate sets
+        negs = jax.lax.all_gather(neg, axis, tiled=False)  # [S, B, kk]
+        gidxs = jax.lax.all_gather(gidx, axis, tiled=False)
+        s = negs.shape[0]
+        negs = jnp.transpose(negs, (1, 0, 2)).reshape(q.shape[0], s * kk)
+        gidxs = jnp.transpose(gidxs, (1, 0, 2)).reshape(q.shape[0], s * kk)
+        top_neg, pos = jax.lax.top_k(negs, min(k, s * kk))
+        return top_neg, jnp.take_along_axis(gidxs, pos, axis=1)
+
+    in_specs = [P(), P(axis, *([None] * (table.ndim - 1)))]
+    args = [q, table]
+    if valid is not None:
+        in_specs.append(P(axis))
+        args.append(valid)
+    fn = jax.shard_map(
+        scan, mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return fn(*args)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "hash_num", "k", "axis"))
 def sharded_hamming_topk(
     mesh: Mesh,
     q_sigs: jax.Array,    # [B, W] uint32, replicated
@@ -47,35 +94,56 @@ def sharded_hamming_topk(
     hash_num: int,
     k: int,
     axis: str = "shard",
+    valid: Optional[jax.Array] = None,  # [C] bool, sharded over `axis`
 ) -> Tuple[jax.Array, jax.Array]:
     """Global top-k nearest (smallest hamming distance) over the sharded
     table. Returns (distances [B, k], global row indices [B, k])."""
     from jubatus_tpu.ops import knn
 
-    n_shards = mesh.shape[axis]
-    c_local = row_sigs.shape[0] // n_shards
+    def scores(q, rows):
+        return -knn._hamming_distances_batch_xla(q, rows, hash_num=hash_num)
 
-    def scan(q, rows):
-        # per-device: full scan of my slice + local top-k
-        d = knn._hamming_distances_batch_xla(q, rows, hash_num=hash_num)
-        kk = min(k, rows.shape[0])
-        neg, idx = jax.lax.top_k(-d, kk)                    # [B, kk]
-        shard_id = jax.lax.axis_index(axis)
-        gidx = idx + shard_id * c_local                     # global ids
-        # merge across shards: gather the tiny candidate sets
-        negs = jax.lax.all_gather(neg, axis, tiled=False)   # [S, B, kk]
-        gidxs = jax.lax.all_gather(gidx, axis, tiled=False)
-        s = negs.shape[0]
-        negs = jnp.transpose(negs, (1, 0, 2)).reshape(q.shape[0], s * kk)
-        gidxs = jnp.transpose(gidxs, (1, 0, 2)).reshape(q.shape[0], s * kk)
-        top_neg, pos = jax.lax.top_k(negs, min(k, s * kk))
-        return -top_neg, jnp.take_along_axis(gidxs, pos, axis=1)
+    neg, gidx = _sharded_topk(mesh, q_sigs, row_sigs, scores, k, axis, valid)
+    return -neg, gidx
 
-    spec_rows = P(axis, None)
-    fn = jax.shard_map(
-        scan, mesh=mesh,
-        in_specs=(P(), spec_rows),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    return fn(q_sigs, row_sigs)
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "axis"))
+def sharded_minhash_topk(
+    mesh: Mesh,
+    q_sigs: jax.Array,    # [B, H] uint32, replicated
+    row_sigs: jax.Array,  # [C, H] uint32, sharded over `axis`
+    *,
+    k: int,
+    axis: str = "shard",
+    valid: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k smallest (1 - weighted-Jaccard estimate) distance."""
+    from jubatus_tpu.ops import knn
+
+    def scores(q, rows):
+        return -knn._minhash_distances_batch_xla(q, rows)
+
+    neg, gidx = _sharded_topk(mesh, q_sigs, row_sigs, scores, k, axis, valid)
+    return -neg, gidx
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "hash_num", "k", "axis"))
+def sharded_euclid_lsh_topk(
+    mesh: Mesh,
+    q_projs: jax.Array,   # [B, H] float32, replicated
+    row_projs: jax.Array, # [C, H] float32, sharded over `axis`
+    *,
+    hash_num: int,
+    k: int,
+    axis: str = "shard",
+    valid: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k smallest JL-estimated euclidean distance."""
+    from jubatus_tpu.ops import knn
+
+    def scores(q, rows):
+        return -knn.euclid_lsh_distances_batch(q, rows, hash_num=hash_num)
+
+    neg, gidx = _sharded_topk(mesh, q_projs, row_projs, scores, k, axis, valid)
+    return -neg, gidx

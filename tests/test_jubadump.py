@@ -72,6 +72,26 @@ def test_dump_detects_corruption(model_file):
     assert out["header"]["crc32_ok"] is False
 
 
+def test_dump_undecodable_body_keeps_header_report(tmp_path):
+    """A body that passes the size check but is not valid msgpack must
+    produce a JSON report with the header + an error, not a traceback."""
+    import struct
+    import zlib
+
+    from jubatus_tpu.framework.save_load import _HEADER, MAGIC
+
+    body = b"\xc1" * 32 + b"\xc1" * 16  # 0xc1 is the one invalid msgpack byte
+    header = _HEADER.pack(MAGIC, 1, 1, 0, 2,
+                          zlib.crc32(body) & 0xFFFFFFFF, 32, 16)
+    p = tmp_path / "garbage.jubatus"
+    p.write_bytes(header + body)
+    out = jubadump.dump_file(str(p))
+    assert out["header"]["crc32_ok"] is True
+    assert "system_error" in out
+    import json as _json
+    _json.dumps(out)
+
+
 def test_cli_main(model_file, capsys):
     assert jubadump.main(["-i", model_file, "--summary"]) == 0
     out = json.loads(capsys.readouterr().out)
@@ -80,12 +100,14 @@ def test_cli_main(model_file, capsys):
 
 
 def test_genman_renders_all_pages(tmp_path):
+    import pathlib
     import subprocess
     import sys
 
+    repo = pathlib.Path(__file__).resolve().parents[1]
     r = subprocess.run(
-        [sys.executable, "docs/gen_man.py", str(tmp_path)],
-        capture_output=True, text=True, cwd="/root/repo")
+        [sys.executable, str(repo / "docs" / "gen_man.py"), str(tmp_path)],
+        capture_output=True, text=True, cwd=str(repo))
     assert r.returncode == 0, r.stderr[:1500]
     pages = sorted(p.name for p in tmp_path.iterdir())
     assert "jubadump.1" in pages
@@ -95,3 +117,9 @@ def test_genman_renders_all_pages(tmp_path):
         txt = p.read_text()
         assert txt.startswith(".TH ")
         assert ".SH SYNOPSIS" in txt and ".SH OPTIONS" in txt
+        # exactly one OPTIONS section (argparse groups merge into it)
+        assert txt.count(".SH OPTIONS") == 1
+        # DESCRIPTION present only with body text, never empty
+        if ".SH DESCRIPTION" in txt:
+            after = txt.split(".SH DESCRIPTION", 1)[1].lstrip().splitlines()
+            assert after and not after[0].startswith(".SH")
