@@ -27,7 +27,12 @@ from typing import Any
 
 import numpy as np
 
-from jubatus_tpu.framework.save_load import _HEADER, FORMAT_VERSION, MAGIC
+from jubatus_tpu.framework.save_load import (
+    _HEADER,
+    FORMAT_VERSION,
+    MAGIC,
+    SaveLoadError,
+)
 from jubatus_tpu.utils.serialization import unpack_obj
 
 SUMMARY_ARRAY_LIMIT = 64  # arrays up to this many elements dump in full
@@ -65,7 +70,34 @@ def _jsonable(obj: Any, summary: bool) -> Any:
 
 def dump_file(path: str, *, summary: bool = False,
               skip_user_data: bool = False) -> dict:
-    """Parse + validate one model file into a JSON-ready dict."""
+    """Parse + validate one model file into a JSON-ready dict. A directory
+    is treated as a sharded checkpoint (framework/sharded_checkpoint.py):
+    the system sidecar plus per-array shape/dtype/partition metadata —
+    array bytes are never read (they may span a pod's worth of hosts)."""
+    import os
+
+    if os.path.isdir(path):
+        # offline metadata inspection needs no accelerator, but orbax
+        # queries jax's default backend — pin CPU so the dump works on
+        # hosts without the TPU plugin on PYTHONPATH
+        from jubatus_tpu.cmd import apply_platform_override
+
+        os.environ.setdefault("JUBATUS_TPU_PLATFORM", "cpu")
+        apply_platform_override()
+        from jubatus_tpu.framework.sharded_checkpoint import (
+            checkpoint_metadata,
+        )
+
+        out = checkpoint_metadata(path)
+        system = out.get("system")
+        if isinstance(system, dict) and isinstance(system.get("config"), str):
+            try:
+                out["system"] = dict(system,
+                                     config=json.loads(system["config"]))
+            except json.JSONDecodeError:
+                pass
+        return _jsonable(out, summary)
+
     with open(path, "rb") as f:
         raw = f.read()
     if len(raw) < _HEADER.size:
@@ -130,7 +162,7 @@ def main(argv=None) -> int:
     try:
         out = dump_file(ns.input, summary=ns.summary,
                         skip_user_data=ns.no_user_data)
-    except (OSError, ValueError) as e:
+    except (OSError, ValueError, SaveLoadError) as e:
         print(str(e), file=sys.stderr)
         return 1
     json.dump(out, sys.stdout, indent=2)

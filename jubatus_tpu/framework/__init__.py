@@ -6,4 +6,8 @@ mesh makes unnecessary (ZooKeeper master election, CHT ring maintenance).
 
 from jubatus_tpu.framework.driver import DriverBase  # noqa: F401
 from jubatus_tpu.framework.save_load import load_model, save_model  # noqa: F401
+from jubatus_tpu.framework.sharded_checkpoint import (  # noqa: F401
+    load_sharded,
+    save_sharded,
+)
 from jubatus_tpu.framework.mixer import IntervalMixer  # noqa: F401

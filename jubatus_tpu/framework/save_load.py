@@ -49,6 +49,28 @@ def _semantic_config_equal(a: str, b: str) -> bool:
         return a == b
 
 
+def read_envelope(raw: bytes, where: str):
+    """Validate the 48-byte header + CRC and split the body. Returns
+    (system_bytes, user_bytes). One implementation for every strict
+    consumer (load_model, sharded_checkpoint); jubadump keeps its own
+    non-throwing walk because it reports damage instead of refusing."""
+    if len(raw) < _HEADER.size:
+        raise SaveLoadError(f"{where}: truncated header")
+    magic, fmt, _, _, _, crc, ssize, usize = _HEADER.unpack_from(raw)
+    if magic != MAGIC:
+        raise SaveLoadError(f"{where}: bad magic {magic!r}")
+    if fmt != FORMAT_VERSION:
+        raise SaveLoadError(f"{where}: unsupported format version {fmt}")
+    body = raw[_HEADER.size:]
+    if len(body) != ssize + usize:
+        raise SaveLoadError(
+            f"{where}: size mismatch (header says {ssize}+{usize}, "
+            f"got {len(body)})")
+    if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+        raise SaveLoadError(f"{where}: CRC32 mismatch")
+    return body[:ssize], body[ssize:ssize + usize]
+
+
 def save_model(
     path: str,
     driver,
@@ -98,21 +120,8 @@ def load_model(
     validation failure, mirroring the reference's checks."""
     with open(path, "rb") as f:
         raw = f.read()
-    if len(raw) < _HEADER.size:
-        raise SaveLoadError(f"{path}: truncated header")
-    magic, fmt, vmaj, vmin, vmaint, crc, ssize, usize = _HEADER.unpack_from(raw)
-    if magic != MAGIC:
-        raise SaveLoadError(f"{path}: bad magic {magic!r}")
-    if fmt != FORMAT_VERSION:
-        raise SaveLoadError(f"{path}: unsupported format version {fmt}")
-    body = raw[_HEADER.size :]
-    if len(body) != ssize + usize:
-        raise SaveLoadError(
-            f"{path}: size mismatch (header says {ssize}+{usize}, got {len(body)})"
-        )
-    if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
-        raise SaveLoadError(f"{path}: CRC32 mismatch")
-    system = unpack_obj(body[:ssize])
+    system_bytes, user_bytes = read_envelope(raw, path)
+    system = unpack_obj(system_bytes)
     if system["type"] != driver.TYPE:
         raise SaveLoadError(
             f"{path}: model type {system['type']!r} != server type {driver.TYPE!r}"
@@ -121,7 +130,7 @@ def load_model(
         system.get("config", ""), expected_config
     ):
         raise SaveLoadError(f"{path}: saved config does not match server config")
-    user_version, user_data = unpack_obj(body[ssize : ssize + usize])
+    user_version, user_data = unpack_obj(user_bytes)
     if user_version != driver.USER_DATA_VERSION:
         raise SaveLoadError(
             f"{path}: user data version {user_version} != {driver.USER_DATA_VERSION}"

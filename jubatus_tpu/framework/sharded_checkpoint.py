@@ -1,0 +1,198 @@
+"""Sharded checkpoint/resume for pod-scale state (orbax/tensorstore-backed).
+
+The envelope path (framework/save_load.py, ≙ reference save_load.cpp)
+serializes through a host gather — right for single-chip models and
+byte-format parity, impossible for a sharded Criteo-scale table that
+exceeds any single host. Here every process writes only its addressable
+shards through orbax, and restore re-places arrays according to the
+template's NamedShardings — on a multi-host pod each host touches only
+its slice (jax.distributed must be initialized first;
+parallel/multihost.py does that).
+
+The reference envelope's system container (type, id, config, versions,
+CRC) is preserved as a ``system.jubatus`` sidecar written in the SAME
+48-byte-header format with an empty user-data section, so ``jubadump``
+and the semantic-config-match validation (save_load.cpp:104-109) work
+unchanged on checkpoint directories.
+
+Layout:
+
+    <dir>/system.jubatus   envelope header + system container, 0 user bytes
+    <dir>/state/           orbax checkpoint of the state pytree
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+
+from jubatus_tpu.framework.save_load import (
+    _HEADER,
+    FORMAT_VERSION,
+    MAGIC,
+    SaveLoadError,
+    _semantic_config_equal,
+    read_envelope,
+)
+from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
+
+SYSTEM_FILE = "system.jubatus"
+STATE_DIR = "state"
+
+
+def _write_system(path: str, system: dict) -> None:
+    import zlib
+
+    from jubatus_tpu.version import COMPAT_JUBATUS_VERSION
+
+    system_data = pack_obj(system)
+    crc = zlib.crc32(system_data) & 0xFFFFFFFF
+    header = _HEADER.pack(MAGIC, FORMAT_VERSION, *COMPAT_JUBATUS_VERSION,
+                          crc, len(system_data), 0)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(header)
+        f.write(system_data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_system(path: str) -> dict:
+    with open(path, "rb") as f:
+        raw = f.read()
+    system_bytes, _ = read_envelope(raw, path)
+    return unpack_obj(system_bytes)
+
+
+def abstract_like(state: Any):
+    """Pytree of ShapeDtypeStructs carrying the template's shardings —
+    what restore needs to re-place arrays on the mesh."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        state,
+    )
+
+
+def save_sharded(
+    dir_path: str,
+    state: Any,
+    *,
+    engine_type: str,
+    model_id: str = "",
+    config: str = "",
+    user_data_version: int = 0,
+) -> None:
+    """Checkpoint a (possibly sharded) state pytree into ``dir_path``.
+
+    Each file commits atomically (orbax finalization / tmp+rename), and a
+    pairing token written into BOTH the orbax metadata and the sidecar
+    makes a torn overwrite (crash between the two commits) detectable at
+    load instead of silently pairing new state with stale metadata. On a
+    multi-host pod the orbax save is collectively coordinated; the
+    sidecar is written by process 0 only."""
+    import binascii
+    import time
+
+    import orbax.checkpoint as ocp
+
+    dir_path = os.path.abspath(dir_path)
+    token = binascii.hexlify(os.urandom(8)).decode()
+    if jax.process_count() > 1:
+        # all hosts must agree on the token process 0 writes
+        from jax.experimental import multihost_utils
+
+        token = multihost_utils.broadcast_one_to_all(
+            jax.numpy.frombuffer(bytes.fromhex(token), dtype=jax.numpy.uint8)
+        ).tobytes().hex()
+    os.makedirs(dir_path, exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(dir_path, STATE_DIR), state, force=True,
+               custom_metadata={"pairing_token": token})
+    ckptr.wait_until_finished()
+    if jax.process_index() == 0:
+        _write_system(os.path.join(dir_path, SYSTEM_FILE), {
+            "version": FORMAT_VERSION,
+            "timestamp": int(time.time()),
+            "type": engine_type,
+            "id": model_id,
+            "config": config,
+            "user_data_version": user_data_version,
+            "sharded": True,
+            "pairing_token": token,
+        })
+
+
+def load_sharded(
+    dir_path: str,
+    template: Any,
+    *,
+    expected_type: Optional[str] = None,
+    expected_config: Optional[str] = None,
+) -> Tuple[dict, Any]:
+    """Restore a checkpoint into the template's shapes/dtypes/shardings.
+
+    ``template`` is a live state pytree or the result of
+    ``abstract_like``. Returns (system container, restored state); raises
+    SaveLoadError on metadata mismatch (same checks as the envelope
+    loader: engine type and semantic config equality)."""
+    import orbax.checkpoint as ocp
+
+    dir_path = os.path.abspath(dir_path)
+    system = _read_system(os.path.join(dir_path, SYSTEM_FILE))
+    if expected_type is not None and system.get("type") != expected_type:
+        raise SaveLoadError(
+            f"{dir_path}: model type {system.get('type')!r} != "
+            f"{expected_type!r}")
+    if expected_config is not None and not _semantic_config_equal(
+            system.get("config", ""), expected_config):
+        raise SaveLoadError(
+            f"{dir_path}: saved config does not match server config")
+    abstract = jax.tree_util.tree_map(
+        lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        template,
+    )
+    ckptr = ocp.StandardCheckpointer()
+    state_path = os.path.join(dir_path, STATE_DIR)
+    want_token = system.get("pairing_token")
+    if want_token is not None:
+        have_token = (ckptr.metadata(state_path).custom_metadata
+                      or {}).get("pairing_token")
+        if have_token != want_token:
+            raise SaveLoadError(
+                f"{dir_path}: state/metadata pairing mismatch "
+                "(interrupted overwrite?) — the sidecar describes a "
+                "different checkpoint than the state directory holds")
+    state = ckptr.restore(state_path, abstract)
+    return system, state
+
+
+def checkpoint_metadata(dir_path: str) -> dict:
+    """System container + per-array shape/dtype metadata without reading
+    array bytes (jubadump uses this for directory inputs)."""
+    import orbax.checkpoint as ocp
+
+    dir_path = os.path.abspath(dir_path)
+    out = {"system": _read_system(os.path.join(dir_path, SYSTEM_FILE))}
+    ckptr = ocp.StandardCheckpointer()
+    meta = ckptr.metadata(os.path.join(dir_path, STATE_DIR))
+    tree = meta.item_metadata.tree  # {leaf name: ArrayMetadata}
+    arrays = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        entry = {
+            "shape": list(getattr(leaf, "shape", ()) or ()),
+            "dtype": str(getattr(leaf, "dtype", "")),
+        }
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "partition_spec", None)
+        if spec is not None:
+            entry["partition_spec"] = [str(s) for s in spec]
+        arrays[key] = entry
+    out["arrays"] = arrays
+    return out
