@@ -71,24 +71,11 @@ def read_envelope(raw: bytes, where: str):
     return body[:ssize], body[ssize:ssize + usize]
 
 
-def save_model(
-    path: str,
-    driver,
-    *,
-    model_id: str = "",
-    config: str = "",
-) -> None:
-    """Atomic checkpoint write (tmp + rename; the reference additionally
-    flocks against concurrent saves, server_base.cpp:152-159)."""
-    system = {
-        "version": FORMAT_VERSION,
-        "timestamp": int(time.time()),
-        "type": driver.TYPE,
-        "id": model_id,
-        "config": config,
-    }
-    system_data = pack_obj(system)
-    user_data = pack_obj([driver.USER_DATA_VERSION, driver.pack()])
+def write_envelope(path: str, system_data: bytes,
+                   user_data: bytes = b"") -> None:
+    """Atomic envelope write: header + CRC, tmp + fsync + rename. Shared
+    by save_model and the sharded-checkpoint sidecar (the reference
+    additionally flocks against concurrent saves, server_base.cpp:152-159)."""
     crc = zlib.crc32(system_data + user_data) & 0xFFFFFFFF
     header = _HEADER.pack(
         MAGIC,
@@ -106,6 +93,27 @@ def save_model(
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def save_model(
+    path: str,
+    driver,
+    *,
+    model_id: str = "",
+    config: str = "",
+) -> None:
+    system = {
+        "version": FORMAT_VERSION,
+        "timestamp": int(time.time()),
+        "type": driver.TYPE,
+        "id": model_id,
+        "config": config,
+    }
+    write_envelope(
+        path,
+        pack_obj(system),
+        pack_obj([driver.USER_DATA_VERSION, driver.pack()]),
+    )
 
 
 def load_model(

@@ -29,12 +29,11 @@ from typing import Any, Optional, Tuple
 import jax
 
 from jubatus_tpu.framework.save_load import (
-    _HEADER,
     FORMAT_VERSION,
-    MAGIC,
     SaveLoadError,
     _semantic_config_equal,
     read_envelope,
+    write_envelope,
 )
 from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
 
@@ -43,21 +42,7 @@ STATE_DIR = "state"
 
 
 def _write_system(path: str, system: dict) -> None:
-    import zlib
-
-    from jubatus_tpu.version import COMPAT_JUBATUS_VERSION
-
-    system_data = pack_obj(system)
-    crc = zlib.crc32(system_data) & 0xFFFFFFFF
-    header = _HEADER.pack(MAGIC, FORMAT_VERSION, *COMPAT_JUBATUS_VERSION,
-                          crc, len(system_data), 0)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(header)
-        f.write(system_data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    write_envelope(path, pack_obj(system))
 
 
 def _read_system(path: str) -> dict:

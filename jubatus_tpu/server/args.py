@@ -35,6 +35,9 @@ class ServerArgs:
     interval_count: int = 512           # (server_util.cpp:226-228)
     coordinator_timeout: float = 10.0   # --zookeeper_timeout
     interconnect_timeout: float = 10.0
+    #: coalesce concurrent train RPCs into one device batch up to this
+    #: many examples (server/microbatch.py); 0 = direct per-RPC path
+    microbatch_max: int = 8192
 
     @property
     def is_standalone(self) -> bool:
@@ -93,6 +96,11 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
     p.add_argument("--coordinator-timeout", "--zookeeper-timeout",
                    dest="coordinator_timeout", type=float, default=10.0)
     p.add_argument("--interconnect-timeout", type=float, default=10.0)
+    p.add_argument("--microbatch-max", type=int, default=8192,
+                   help="coalesce concurrent train RPCs into one device "
+                        "batch up to this many examples; 0 = direct path. "
+                        "Depth is bounded by -c (RPC workers) — raise -c "
+                        "toward client concurrency for real batching")
     return p
 
 
@@ -103,6 +111,8 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
     })
     if args.thread < 1:
         raise SystemExit("--thread must be >= 1")
+    if args.microbatch_max < 0:
+        raise SystemExit("--microbatch-max must be >= 0")
     if args.rpc_port < 0 or args.rpc_port > 65535:
         raise SystemExit("--rpc-port out of range")
     if not args.is_standalone and not args.name:

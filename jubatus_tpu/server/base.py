@@ -47,6 +47,9 @@ class EngineServer:
         self.start_time = time.time()
         self.last_saved = 0.0
         self.last_loaded = 0.0
+        #: train-path microbatch coalescers by method name (service.py
+        #: populates; stats surface in get_status)
+        self.coalescers: Dict[str, Any] = {}
         # transport: python sockets, or the C++ front-end when
         # JUBATUS_TPU_NATIVE_RPC=1 (rpc/native_server.py)
         from jubatus_tpu.rpc.native_server import create_rpc_server
@@ -173,6 +176,9 @@ class EngineServer:
         except OSError:
             pass
         st.update(self.args.flags_status())
+        for nm, co in self.coalescers.items():
+            st.update({f"microbatch.{nm}.{k}": v
+                       for k, v in co.stats().items()})
         st.update({f"driver.{k}": v for k, v in self.driver.get_status().items()})
         if self.mixer is not None:
             st.update({f"mixer.{k}": v for k, v in self.mixer.get_status().items()})

@@ -1,0 +1,153 @@
+"""Adaptive microbatch coalescing for the ingest hot path.
+
+The reference applies each datum under a write lock as it arrives
+(classifier_serv.cpp:127-146) — fine when an update is a few hundred ns
+of pointer math, wrong on TPU where every kernel dispatch costs ~ms
+regardless of batch size. This queue is SURVEY.md §7 step 4's
+"microbatching queue into the JAX update loop": concurrent update RPCs
+coalesce into one device batch.
+
+Design — batching from backpressure, zero idle waiting: a submitter that
+finds no flush in progress becomes the flusher and processes its items
+IMMEDIATELY (a lone client never waits); while its flush occupies the
+device, later submitters enqueue and block on tickets; when the flusher
+finishes it drains everything that accumulated as ONE batch, and keeps
+draining until the queue is empty before handing off. Load creates
+batches; idleness creates latency-free pass-through.
+
+Exceptions from a flush propagate to exactly the tickets whose items
+were in that batch.
+
+Coalescing depth is bounded by RPC worker concurrency: with the
+reference-parity default of 2 worker threads (``-c``), at most one call
+can queue behind a flush, so flushes ≈ RPCs. TPU ingest deployments
+should raise ``-c`` toward their client concurrency — measured over
+loopback: 10 clients × ``-c 8`` turned 100 train RPCs into 37 flushes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Sequence
+
+__all__ = ["Coalescer"]
+
+
+class _Ticket:
+    __slots__ = ("event", "result", "error", "count")
+
+    def __init__(self, count: int) -> None:
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: BaseException | None = None
+        self.count = count
+
+
+class Coalescer:
+    """Coalesce concurrent ``submit(items)`` calls into batched
+    ``flush_fn(all_items)`` invocations.
+
+    ``flush_fn`` receives the concatenated item list and returns a value;
+    every contributing submitter gets that same return value (engines
+    here return accepted-count, which callers recompute from their own
+    len(items) — see ``submit``'s return). ``max_batch`` bounds one
+    flush; the rest stays queued for the next round.
+    """
+
+    def __init__(self, flush_fn: Callable[[List[Any]], Any],
+                 max_batch: int = 8192) -> None:
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self._flush = flush_fn
+        self._max_batch = max_batch
+        self._lock = threading.Lock()
+        self._pending_items: List[Any] = []
+        self._pending_tickets: List[_Ticket] = []
+        self._active = False
+        #: flush invocations / items flushed (observability; get_status)
+        self.flush_count = 0
+        self.item_count = 0
+
+    def submit(self, items: Sequence[Any],
+               timeout: float | None = 60.0) -> Any:
+        """Block until a flush containing ``items`` completes; returns
+        that flush's result. Raises whatever the flush raised.
+
+        ``timeout`` None or <= 0 waits forever. On timeout, items still
+        QUEUED are withdrawn first — a TimeoutError then guarantees the
+        model was not updated (same contract as a failed direct call); if
+        the items were already claimed by an in-flight flush they cannot
+        be recalled, so one more ``timeout`` is granted before giving up
+        with a message saying the update may still land."""
+        items = list(items)
+        if not items:
+            return self._flush([])
+        if timeout is not None and timeout <= 0:
+            timeout = None
+        ticket = _Ticket(len(items))
+        with self._lock:
+            self._pending_items.extend(items)
+            self._pending_tickets.append(ticket)
+            i_flush = not self._active
+            if i_flush:
+                self._active = True
+        if i_flush:
+            self._drain()
+        if not ticket.event.wait(timeout):
+            with self._lock:
+                if ticket in self._pending_tickets:
+                    i = self._pending_tickets.index(ticket)
+                    off = sum(t.count for t in self._pending_tickets[:i])
+                    del self._pending_items[off:off + ticket.count]
+                    self._pending_tickets.pop(i)
+                    raise TimeoutError(
+                        "microbatch flush did not start in time "
+                        "(items withdrawn; model NOT updated)")
+            if not ticket.event.wait(timeout):
+                raise TimeoutError(
+                    "microbatch flush still running after grace period — "
+                    "the update may still be applied; do not blind-retry")
+        if ticket.error is not None:
+            raise ticket.error
+        return ticket.result
+
+    def _drain(self) -> None:
+        while True:
+            with self._lock:
+                if not self._pending_tickets:
+                    self._active = False
+                    return
+                batch: List[Any] = []
+                tickets: List[_Ticket] = []
+                while self._pending_tickets and \
+                        len(batch) + self._pending_tickets[0].count \
+                        <= self._max_batch:
+                    t = self._pending_tickets.pop(0)
+                    tickets.append(t)
+                    batch.extend(self._pending_items[:t.count])
+                    del self._pending_items[:t.count]
+                if not tickets:  # one oversized submit: flush it alone
+                    t = self._pending_tickets.pop(0)
+                    tickets.append(t)
+                    batch.extend(self._pending_items[:t.count])
+                    del self._pending_items[:t.count]
+            try:
+                result = self._flush(batch)
+                for t in tickets:
+                    t.result = result
+            except BaseException as e:  # noqa: BLE001 — deliver to callers
+                for t in tickets:
+                    t.error = e
+            finally:
+                self.flush_count += 1
+                self.item_count += len(batch)
+                for t in tickets:
+                    t.event.set()
+
+    def stats(self) -> dict:
+        return {
+            "flush_count": self.flush_count,
+            "item_count": self.item_count,
+            "avg_batch": (self.item_count / self.flush_count
+                          if self.flush_count else 0.0),
+        }

@@ -84,18 +84,45 @@ def _updating(server: Any, fn: Callable, count: Callable[[Any], int] = lambda r:
 # -- per-engine binders -------------------------------------------------------
 
 
+def _register_train(rpc: RpcServer, server: Any, decode_pair,
+                    train_fn) -> None:
+    """Register "train" with microbatch coalescing (server/microbatch.py):
+    concurrent train RPCs merge into one driver/device batch — SURVEY.md
+    §7 step 4's ingest queue. ``--microbatch-max 0`` restores the direct
+    per-RPC path. Either way each caller's reply is its own item count
+    (the reference's per-call return, classifier_impl.cpp:56-59)."""
+    max_batch = getattr(server.args, "microbatch_max", 8192)
+    flush = _updating(server, train_fn, count=lambda r: r)
+    if not max_batch:
+        rpc.register(
+            "train",
+            lambda name, data: flush([decode_pair(p) for p in data]),
+            arity=2,
+        )
+        return
+    from jubatus_tpu.server.microbatch import Coalescer
+
+    co = Coalescer(flush, max_batch=max_batch)
+    server.coalescers["train"] = co
+
+    # -t 0 conventionally means "no timeout" — map to an unbounded wait
+    wait_s = server.args.timeout * 6 if server.args.timeout > 0 else None
+
+    def train(name, data):
+        pairs = [decode_pair(p) for p in data]
+        if not pairs:
+            return 0
+        co.submit(pairs, timeout=wait_s)
+        return len(pairs)
+
+    rpc.register("train", train, arity=2)
+
+
 @_binder("classifier")
 def _bind_classifier(rpc: RpcServer, server: Any) -> None:
     d = server.driver
-    rpc.register(
-        "train",
-        lambda name, data: _updating(
-            server,
-            lambda: d.train([(lbl, _datum(dat)) for lbl, dat in data]),
-            count=lambda r: r,
-        )(),
-        arity=2,
-    )
+    _register_train(rpc, server,
+                    lambda p: (p[0], _datum(p[1])), d.train)
     rpc.register(
         "classify",
         lambda name, data: [_scored(r) for r in d.classify(_datums(data))],
@@ -110,15 +137,8 @@ def _bind_classifier(rpc: RpcServer, server: Any) -> None:
 @_binder("regression")
 def _bind_regression(rpc: RpcServer, server: Any) -> None:
     d = server.driver
-    rpc.register(
-        "train",
-        lambda name, data: _updating(
-            server,
-            lambda: d.train([(float(s), _datum(dat)) for s, dat in data]),
-            count=lambda r: r,
-        )(),
-        arity=2,
-    )
+    _register_train(rpc, server,
+                    lambda p: (float(p[0]), _datum(p[1])), d.train)
     rpc.register(
         "estimate",
         lambda name, data: [float(x) for x in d.estimate(_datums(data))],

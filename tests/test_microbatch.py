@@ -1,0 +1,172 @@
+"""Microbatch coalescer tests (server/microbatch.py) — unit-level queue
+semantics plus a live-server test showing concurrent train RPCs really
+merge into fewer device flushes with no lost or double-counted items."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from jubatus_tpu.server.microbatch import Coalescer
+
+
+def test_lone_submit_is_passthrough():
+    seen = []
+    co = Coalescer(lambda b: (seen.append(list(b)), len(b))[1])
+    assert co.submit([1, 2, 3]) == 3
+    assert seen == [[1, 2, 3]]
+    assert co.stats()["flush_count"] == 1
+
+
+def test_empty_submit():
+    co = Coalescer(lambda b: len(b))
+    assert co.submit([]) == 0
+    assert co.stats()["flush_count"] == 0
+
+
+def test_concurrent_submits_coalesce_and_conserve():
+    flushed = []
+    gate = threading.Event()
+
+    def flush(batch):
+        if not gate.is_set():   # first flush blocks so the rest pile up
+            gate.set()
+            time.sleep(0.15)
+        flushed.append(list(batch))
+        return len(batch)
+
+    co = Coalescer(flush)
+    results = []
+
+    def worker(base):
+        results.append(co.submit([base * 10 + j for j in range(3)]))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+        time.sleep(0.005)
+    for t in threads:
+        t.join()
+
+    all_items = [x for b in flushed for x in b]
+    assert sorted(all_items) == sorted(i * 10 + j
+                                       for i in range(12) for j in range(3))
+    assert len(all_items) == 36
+    # piling up must have produced real coalescing
+    assert len(flushed) < 12
+    assert co.stats()["item_count"] == 36
+    assert max(len(b) for b in flushed) > 3
+
+
+def test_max_batch_splits():
+    sizes = []
+    gate = threading.Event()
+
+    def slow_first(batch):
+        if not gate.is_set():
+            gate.set()
+            time.sleep(0.1)
+        sizes.append(len(batch))
+
+    co = Coalescer(slow_first, max_batch=5)
+    threads = [threading.Thread(target=co.submit, args=([j, j, j],))
+               for j in range(6)]
+    for t in threads:
+        t.start()
+        time.sleep(0.005)
+    for t in threads:
+        t.join()
+    assert sum(sizes) == 18
+    assert all(s <= 5 or s == 3 for s in sizes)  # ≤ max, except lone-first
+
+
+def test_oversized_single_submit_flushes_alone():
+    sizes = []
+    co = Coalescer(lambda b: sizes.append(len(b)), max_batch=4)
+    co.submit(list(range(10)))
+    assert sizes == [10]
+
+
+def test_error_propagates_to_contributors_only():
+    def flush(batch):
+        if "bad" in batch:
+            raise RuntimeError("poison")
+        return len(batch)
+
+    co = Coalescer(flush)
+    with pytest.raises(RuntimeError, match="poison"):
+        co.submit(["bad"])
+    assert co.submit(["ok"]) == 1  # queue recovers after a failed flush
+
+
+def test_timeout_withdraws_queued_items():
+    """A timed-out submit whose items are still queued withdraws them —
+    TimeoutError then guarantees the model was NOT updated."""
+    gate = threading.Event()
+    release = threading.Event()
+
+    def flush(batch):
+        gate.set()
+        release.wait(5)
+        return len(batch)
+
+    co = Coalescer(flush)
+    t = threading.Thread(target=co.submit, args=([1],))
+    t.start()
+    assert gate.wait(2)
+    with pytest.raises(TimeoutError, match="NOT updated"):
+        co.submit([2], timeout=0.1)
+    release.set()
+    t.join()
+    assert co.stats()["item_count"] == 1  # withdrawn item never flushed
+
+
+def test_zero_timeout_means_wait_forever():
+    co = Coalescer(lambda b: len(b))
+    assert co.submit([1, 2], timeout=0) == 2
+
+
+@pytest.mark.slow
+def test_server_train_rpcs_coalesce():
+    """N concurrent clients training against one server: every example
+    lands exactly once and the device saw fewer flushes than RPCs."""
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.server import EngineServer
+
+    conf = {
+        "method": "PA",
+        "parameter": {},
+        "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+    }
+    srv = EngineServer("classifier", conf)
+    port = srv.start(0)
+    try:
+        n_clients, per_client = 8, 5
+
+        def client_work(ci):
+            with ClassifierClient("127.0.0.1", port, "mb") as c:
+                for j in range(per_client):
+                    lbl = "pos" if (ci + j) % 2 == 0 else "neg"
+                    got = c.train([(lbl, Datum({"x": float(ci - j)})),
+                                   (lbl, Datum({"x": float(j - ci)}))])
+                    assert got == 2
+
+        threads = [threading.Thread(target=client_work, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        total = n_clients * per_client * 2
+        assert srv.driver.update_count == total
+        st = next(iter(srv.get_status().values()))
+        assert st["microbatch.train.item_count"] == total
+        assert st["microbatch.train.flush_count"] <= n_clients * per_client
+        # model still serves
+        with ClassifierClient("127.0.0.1", port, "mb") as c:
+            assert len(c.classify([Datum({"x": 1.0}).to_msgpack()])) == 1
+    finally:
+        srv.stop()
