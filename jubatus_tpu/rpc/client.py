@@ -28,6 +28,7 @@ from jubatus_tpu.rpc.errors import (
     wire_to_error,
 )
 from jubatus_tpu.rpc.server import REQUEST, RESPONSE, _to_wire
+from jubatus_tpu.utils import faults
 
 
 class RpcClient:
@@ -44,8 +45,13 @@ class RpcClient:
     def _connect(self) -> socket.socket:
         if self._sock is None:
             try:
+                # injected connect faults take the same RpcIoError path a
+                # refused/reset connection would — callers see the real
+                # failure taxonomy
+                if faults.is_armed():
+                    faults.fire(f"rpc.connect.{self.host}:{self.port}")
                 s = socket.create_connection((self.host, self.port), timeout=self.timeout)
-            except OSError as e:
+            except (OSError, faults.FaultInjected) as e:
                 raise RpcIoError(f"connect {self.host}:{self.port}: {e}") from e
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
@@ -68,6 +74,10 @@ class RpcClient:
 
     # -- calls ---------------------------------------------------------------
     def call(self, method: str, *args: Any) -> Any:
+        # injection site (utils/faults.py): e.g. "rpc.call.mix_get_diff.*" —
+        # the is_armed() guard keeps the disarmed hot path at one flag read
+        if faults.is_armed():
+            faults.fire(f"rpc.call.{method}.{self.host}:{self.port}")
         with self._lock:
             self._msgid = (self._msgid + 1) & 0xFFFFFFFF
             msgid = self._msgid

@@ -1,0 +1,184 @@
+"""Fault injection registry — the framework the reference never had.
+
+SURVEY.md §5: the reference's failure handling (mix skipping dead hosts,
+actives demotion, suicide watchers, obsolete recovery) is real but has
+"no fault injection framework" to exercise it; its failure paths were
+only ever tested by killing whole processes. This registry makes failure
+deterministic and surgical: named sites in the RPC and mix planes call
+``fire(site, ...)``, and a test (or the ``JUBATUS_TPU_FAULTS`` env var,
+for subprocess servers) arms rules against them.
+
+Rule syntax (one per rule, comma-separated in the env var):
+
+    <site-glob>:error            raise FaultInjected at matching sites
+    <site-glob>:error:<p>        ... with probability p (seeded RNG)
+    <site-glob>:delay:<seconds>  sleep before proceeding
+    <site-glob>:error@<n>        ... only for the first n firings
+
+Sites are dotted names matched with fnmatch, e.g. ``rpc.call.get_diff``,
+``rpc.connect``, ``mix.put_diff``. ``fire`` is a no-op (one dict lookup
+on a module flag) when nothing is armed — safe on hot paths.
+
+    with faults.armed("rpc.call.get_diff:error@1"):
+        ...  # the next get_diff anywhere in this process fails once
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+__all__ = ["FaultInjected", "arm", "disarm", "disarm_all", "armed", "fire",
+           "is_armed", "stats"]
+
+
+class FaultInjected(RuntimeError):
+    """The error fault injection raises (subclasses RuntimeError so site
+    error taxonomies treat it like any runtime failure)."""
+
+
+class _Rule:
+    __slots__ = ("pattern", "action", "arg", "remaining", "prob", "hits")
+
+    def __init__(self, pattern: str, action: str, arg: float,
+                 remaining: Optional[int], prob: float) -> None:
+        self.pattern = pattern
+        self.action = action
+        self.arg = arg
+        self.remaining = remaining
+        self.prob = prob
+        self.hits = 0
+
+
+_lock = threading.Lock()
+_rules: List[_Rule] = []
+_armed = False  # fast-path flag: fire() returns immediately when False
+_rng = random.Random(0xFA017)
+_fired: Dict[str, int] = {}
+
+
+def parse_rule(text: str) -> _Rule:
+    # site patterns may themselves contain colons (host:port), so locate
+    # the action token from the RIGHT
+    parts = text.strip().split(":")
+    action_idx = None
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i].split("@", 1)[0] in ("error", "delay"):
+            action_idx = i
+            break
+    if action_idx is None or action_idx == 0:
+        raise ValueError(
+            f"bad fault rule {text!r} (want site:action[:arg], action in "
+            "{error, delay})")
+    pattern = ":".join(parts[:action_idx])
+    action = parts[action_idx]
+    extra = parts[action_idx + 1:]
+    remaining = None
+    if "@" in action:
+        action, n = action.split("@", 1)
+        remaining = int(n)
+    arg = 0.0
+    prob = 1.0
+    if action == "delay":
+        if not extra:
+            raise ValueError(f"delay rule needs seconds: {text!r}")
+        arg = float(extra[0])
+    elif extra:  # error with probability
+        prob = float(extra[0])
+    return _Rule(pattern, action, arg, remaining, prob)
+
+
+def arm(*rule_texts: str) -> List[_Rule]:
+    """Add rules (see module docstring for syntax). Returns the rule
+    objects so a scope can later remove exactly what it added."""
+    global _armed
+    parsed = [parse_rule(t) for t in rule_texts]
+    if not parsed:
+        return []
+    with _lock:
+        _rules.extend(parsed)
+        _armed = True
+    return parsed
+
+
+def disarm(rules: List[_Rule]) -> None:
+    """Remove specific rules (leaves others — env-armed, outer scopes —
+    in place)."""
+    global _armed
+    with _lock:
+        for r in rules:
+            if r in _rules:
+                _rules.remove(r)
+        _armed = bool(_rules)
+
+
+def disarm_all() -> None:
+    global _armed
+    with _lock:
+        _rules.clear()
+        _fired.clear()
+        _armed = False
+
+
+def is_armed() -> bool:
+    """Cheap hot-path guard: callers may skip building site names when
+    nothing is armed."""
+    return _armed
+
+
+@contextmanager
+def armed(*rule_texts: str):
+    """Scope rules to a with-block; removes ONLY the rules it added, so
+    nesting and env-armed rules compose."""
+    mine = arm(*rule_texts)
+    try:
+        yield
+    finally:
+        disarm(mine)
+
+
+def fire(site: str) -> None:
+    """Injection point. No-op unless rules are armed."""
+    if not _armed:
+        return
+    delay = 0.0
+    boom = False
+    with _lock:
+        for r in _rules:
+            if r.remaining is not None and r.remaining <= 0:
+                continue
+            if not fnmatch.fnmatch(site, r.pattern):
+                continue
+            if r.prob < 1.0 and _rng.random() >= r.prob:
+                continue
+            if r.remaining is not None:
+                r.remaining -= 1
+            r.hits += 1
+            _fired[site] = _fired.get(site, 0) + 1
+            if r.action == "delay":
+                delay = max(delay, r.arg)
+            else:
+                boom = True
+    if delay:
+        time.sleep(delay)
+    if boom:
+        raise FaultInjected(f"injected fault at {site}")
+
+
+def stats() -> Dict[str, int]:
+    with _lock:
+        return dict(_fired)
+
+
+def _arm_from_env() -> None:
+    spec = os.environ.get("JUBATUS_TPU_FAULTS", "")
+    if spec:
+        arm(*[s for s in spec.split(",") if s.strip()])
+
+
+_arm_from_env()

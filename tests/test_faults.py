@@ -1,0 +1,210 @@
+"""Fault-injection tests (utils/faults.py) — deterministic failures in
+the RPC/mix planes, exercising the tolerance paths SURVEY.md §5 lists
+(mix skips failed hosts, aborts only when all fail, demotes on put_diff
+failure) that the reference could only probe by killing processes."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from jubatus_tpu.client import ClassifierClient, Datum
+from jubatus_tpu.coord import membership
+from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+from jubatus_tpu.rpc.errors import RpcError
+from jubatus_tpu.server import EngineServer
+from jubatus_tpu.server.args import ServerArgs
+from jubatus_tpu.utils import faults
+
+CONF = {
+    "method": "PA",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+NAME = "chaos"
+
+
+# ---------------------------------------------------------------- registry --
+def test_rule_parsing_and_matching():
+    r = faults.parse_rule("rpc.call.mix_get_diff.*:error@2")
+    assert r.pattern == "rpc.call.mix_get_diff.*"
+    assert r.action == "error" and r.remaining == 2
+    r = faults.parse_rule("coord.*:delay:0.25")
+    assert r.action == "delay" and r.arg == 0.25
+    with pytest.raises(ValueError):
+        faults.parse_rule("no-action")
+    with pytest.raises(ValueError):
+        faults.parse_rule("site:explode")
+
+
+def test_fire_noop_when_disarmed():
+    faults.fire("anything.at.all")  # must not raise
+
+
+def test_armed_scope_and_count_limit():
+    with faults.armed("x.y:error@2"):
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("x.y")
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("x.y")
+        faults.fire("x.y")  # budget exhausted
+        assert faults.stats()["x.y"] == 2
+    faults.fire("x.y")  # disarmed on exit
+
+
+def test_delay_rule():
+    with faults.armed("slow.*:delay:0.05"):
+        t0 = time.monotonic()
+        faults.fire("slow.op")
+        assert time.monotonic() - t0 >= 0.05
+
+
+# ------------------------------------------------------------- mix chaos ---
+def _cluster(n, store):
+    servers = []
+    for _ in range(n):
+        args = ServerArgs(
+            engine="classifier", coordinator="(shared)", name=NAME,
+            listen_addr="127.0.0.1", interval_sec=1e9,
+            interval_count=1 << 30,
+        )
+        srv = EngineServer("classifier", CONF, args,
+                           coord=MemoryCoordinator(store))
+        srv.start(0)
+        servers.append(srv)
+    return servers
+
+
+@pytest.fixture()
+def cluster():
+    store = _Store()
+    servers = _cluster(3, store)
+    clients = [ClassifierClient("127.0.0.1", s.args.rpc_port, NAME)
+               for s in servers]
+    yield servers, clients, store
+    faults.disarm_all()
+    for c in clients:
+        c.close()
+    for s in servers:
+        s.stop()
+
+
+def _train_disjoint(clients):
+    for _ in range(10):
+        clients[0].train([["pos", Datum({"x": 1.0})]])
+        clients[1].train([["neg", Datum({"x": -1.0})]])
+
+
+@pytest.mark.slow
+def test_mix_survives_one_get_diff_failure(cluster):
+    """One member's diff pull fails: the round proceeds with the rest
+    (linear_mixer.cpp:470-504 — abort only if ALL fail)."""
+    servers, clients, _ = cluster
+    _train_disjoint(clients)
+    port1 = servers[1].args.rpc_port
+    with faults.armed(f"rpc.call.mix_get_diff.*:{port1}:error@1"):
+        assert clients[2].do_mix() is True
+    # node 1's contribution was skipped this round, node 0's landed
+    labels2 = clients[2].get_labels()
+    assert "pos" in labels2
+    # the next, fault-free round folds node 1 back in
+    assert clients[2].do_mix() is True
+    assert set(clients[2].get_labels()) == {"pos", "neg"}
+
+
+@pytest.mark.slow
+def test_mix_aborts_when_all_get_diffs_fail(cluster):
+    servers, clients, _ = cluster
+    _train_disjoint(clients)
+    with faults.armed("rpc.call.mix_get_diff.*:error"):
+        assert clients[2].do_mix() is False
+    # phase-1 schema sync precedes get_diff, so label NAMES may have
+    # propagated — but no diff was applied: all counts are zero
+    assert all(v == 0 for v in clients[2].get_labels().values())
+    assert clients[2].do_mix() is True    # recovers once faults clear
+    labels = clients[2].get_labels()
+    assert set(labels) == {"pos", "neg"}
+    assert sum(labels.values()) > 0
+
+
+@pytest.mark.slow
+def test_put_diff_failure_demotes_then_recovers(cluster):
+    """A member that misses the broadcast is demoted from actives by the
+    master (linear_mixer.cpp:658-681) and promotes itself after the next
+    successful round."""
+    servers, clients, store = cluster
+    _train_disjoint(clients)
+    view = MemoryCoordinator(store)
+    port1 = servers[1].args.rpc_port
+
+    def active_ports():
+        return {n.port for n in membership.get_all_actives(
+            view, "classifier", NAME)}
+
+    # a successful round first, so everyone is active
+    assert clients[2].do_mix() is True
+    assert port1 in active_ports()
+
+    with faults.armed(f"rpc.call.mix_put_diff.*:{port1}:error@1"):
+        assert clients[2].do_mix() is True
+    assert port1 not in active_ports()
+
+    # node 1 missed the broadcast but doesn't KNOW yet — the next round's
+    # put_diff (base ahead of its version) marks it obsolete and starts
+    # async full-model recovery (linear_mixer.cpp:404-424,644-652)
+    assert clients[2].do_mix() is True
+    assert port1 not in active_ports()  # still stale this round
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            servers[1].mixer.model_version < servers[2].mixer.model_version:
+        time.sleep(0.1)
+    assert servers[1].mixer.model_version == servers[2].mixer.model_version
+
+    # recovered: the round after promotes it back into actives
+    assert clients[2].do_mix() is True
+    assert port1 in active_ports()
+
+
+@pytest.mark.slow
+def test_mix_completes_under_injected_latency(cluster):
+    servers, clients, _ = cluster
+    _train_disjoint(clients)
+    with faults.armed("rpc.call.mix_get_diff.*:delay:0.1"):
+        t0 = time.monotonic()
+        assert clients[2].do_mix() is True
+        assert time.monotonic() - t0 >= 0.1
+    assert set(clients[2].get_labels()) == {"pos", "neg"}
+
+
+@pytest.mark.slow
+def test_client_sees_connect_fault_as_io_error(cluster):
+    """Injected connect faults surface through the SAME taxonomy a real
+    refused connection would (RpcIoError), so callers' error handling is
+    exercised faithfully."""
+    servers, _, _ = cluster
+    port = servers[0].args.rpc_port
+    with faults.armed(f"rpc.connect.*:{port}:error"):
+        c = ClassifierClient("127.0.0.1", port, NAME)
+        try:
+            with pytest.raises(RpcError):
+                c.get_status()
+        finally:
+            c.close()
+
+
+def test_armed_scopes_compose():
+    """Nested/outer rules survive an inner scope's exit; empty arming
+    never flips the hot-path flag."""
+    assert not faults.is_armed()
+    faults.arm()  # zero rules: stays disarmed
+    assert not faults.is_armed()
+    with faults.armed("outer.site:error"):
+        with faults.armed("inner.site:error"):
+            with pytest.raises(faults.FaultInjected):
+                faults.fire("inner.site")
+        # inner scope closed: outer rule still live
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("outer.site")
+        faults.fire("inner.site")  # inner rule gone
+    assert not faults.is_armed()
