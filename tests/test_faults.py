@@ -193,6 +193,39 @@ def test_client_sees_connect_fault_as_io_error(cluster):
             c.close()
 
 
+@pytest.mark.slow
+def test_proxy_broadcast_tolerates_injected_backend_failure(cluster):
+    """Broadcast-with-reducer through the proxy folds the surviving
+    hosts when one backend's calls fail (proxy.hpp:325-392), and the
+    forward-error counter records the loss."""
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+    servers, clients, store = cluster
+    _train_disjoint(clients)
+    assert clients[2].do_mix() is True
+    proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1"),
+                  coord=MemoryCoordinator(store))
+    pport = proxy.start(0)
+    pc = ClassifierClient("127.0.0.1", pport, NAME)
+    try:
+        port0 = servers[0].args.rpc_port
+        # baseline broadcast across all 3
+        assert len(pc.get_status()) == 3
+        with faults.armed(f"rpc.call.get_status.*:{port0}:error"):
+            st = pc.get_status()  # merged map from the 2 survivors
+            assert len(st) == 2
+            # specifically the faulted backend's entry is the missing one
+            assert f"127.0.0.1_{port0}" not in st
+        stats = pc.get_proxy_status()
+        (pstat,) = stats.values()
+        assert int(pstat["forward_errors"]) >= 1
+        # faults cleared: full fan-in returns
+        assert len(pc.get_status()) == 3
+    finally:
+        pc.close()
+        proxy.stop()
+
+
 def test_armed_scopes_compose():
     """Nested/outer rules survive an inner scope's exit; empty arming
     never flips the hot-path flag."""
