@@ -226,6 +226,63 @@ def test_proxy_broadcast_tolerates_injected_backend_failure(cluster):
         proxy.stop()
 
 
+# --------------------------------------------------------- coord chaos ----
+def test_heartbeat_loss_fires_suicide_watchers():
+    """Repeated heartbeat failure = ZK session loss: the client fires its
+    delete watchers (the suicide path, server_helper.cpp:91-94) and the
+    server side expires the session's ephemerals. The rpc.call site covers
+    the coordination plane for free — heartbeats ride the same client."""
+    from jubatus_tpu.coord.remote import RemoteCoordinator
+    from jubatus_tpu.coord.server import CoordServer
+
+    srv = CoordServer(lease_sec=1.0)
+    port = srv.start(0)
+    b = None
+    try:
+        a = RemoteCoordinator("127.0.0.1", port)
+        a.create("/chaos/me", ephemeral=True)
+        died = []
+        a.watch_delete("/chaos/me", lambda p: died.append(p))
+        # the pattern hits EVERY session's heartbeats on this port, so the
+        # observer client is created only after the fault window closes
+        with faults.armed(f"rpc.call.coord_heartbeat.*:{port}:error"):
+            deadline = time.time() + 15
+            while time.time() < deadline and not died:
+                time.sleep(0.1)
+        assert died == ["/chaos/me"], "suicide watcher never fired"
+        b = RemoteCoordinator("127.0.0.1", port)
+        deadline = time.time() + 10
+        while time.time() < deadline and b.exists("/chaos/me"):
+            time.sleep(0.1)
+        assert not b.exists("/chaos/me"), "ephemeral outlived its session"
+    finally:
+        if b is not None:
+            b.close()
+        srv.stop()
+
+
+def test_heartbeat_delay_below_lease_is_harmless():
+    """Latency under the lease doesn't expire anything."""
+    from jubatus_tpu.coord.remote import RemoteCoordinator
+    from jubatus_tpu.coord.server import CoordServer
+
+    srv = CoordServer(lease_sec=1.5)
+    port = srv.start(0)
+    a = b = None
+    try:
+        a = RemoteCoordinator("127.0.0.1", port)
+        b = RemoteCoordinator("127.0.0.1", port)
+        a.create("/slow/me", ephemeral=True)
+        with faults.armed("rpc.call.coord_heartbeat.*:delay:0.2"):
+            time.sleep(3.0)  # two lease periods of delayed heartbeats
+        assert b.exists("/slow/me")
+    finally:
+        for c in (a, b):
+            if c is not None:
+                c.close()
+        srv.stop()
+
+
 def test_armed_scopes_compose():
     """Nested/outer rules survive an inner scope's exit; empty arming
     never flips the hot-path flag."""
