@@ -61,12 +61,12 @@ def test_delay_rule():
 
 
 # ------------------------------------------------------------- mix chaos ---
-def _cluster(n, store):
+def _cluster(n, store, mixer="linear_mixer"):
     servers = []
     for _ in range(n):
         args = ServerArgs(
             engine="classifier", coordinator="(shared)", name=NAME,
-            listen_addr="127.0.0.1", interval_sec=1e9,
+            mixer=mixer, listen_addr="127.0.0.1", interval_sec=1e9,
             interval_count=1 << 30,
         )
         srv = EngineServer("classifier", CONF, args,
@@ -224,6 +224,41 @@ def test_proxy_broadcast_tolerates_injected_backend_failure(cluster):
     finally:
         pc.close()
         proxy.stop()
+
+
+@pytest.mark.slow
+def test_push_gossip_shrugs_off_failed_peer():
+    """Gossip (broadcast push mixer) skips a peer whose exchange fails —
+    the round still succeeds against the reachable peer, and the dead one
+    catches up once its faults clear (push_mixer.cpp's per-candidate
+    tolerance, tested deterministically)."""
+    store = _Store()
+    servers = _cluster(3, store, mixer="broadcast_mixer")
+    clients = [ClassifierClient("127.0.0.1", s.args.rpc_port, NAME)
+               for s in servers]
+    try:
+        for _ in range(5):
+            clients[0].train([["pos", Datum({"x": 1.0})]])
+            clients[1].train([["neg", Datum({"x": -1.0})]])
+        port1 = servers[1].args.rpc_port
+        with faults.armed(f"rpc.call.mix_get_schema.*:{port1}:error",
+                          f"rpc.call.mix_get_diff.*:{port1}:error"):
+            assert clients[0].do_mix() is True  # node1 unreachable, node2 ok
+        # the reachable pair exchanged: node2 got node0's class — but
+        # "neg" lives only on the skipped peer, so it went nowhere
+        assert set(clients[2].get_labels()) == {"pos"}
+        assert "pos" not in clients[1].get_labels()  # skipped peer untouched
+        # faults cleared: node 1's own round spreads its class and pulls
+        # in what it missed
+        assert clients[1].do_mix() is True
+        assert set(clients[1].get_labels()) == {"pos", "neg"}
+        assert set(clients[2].get_labels()) == {"pos", "neg"}
+    finally:
+        faults.disarm_all()
+        for c in clients:
+            c.close()
+        for s in servers:
+            s.stop()
 
 
 # --------------------------------------------------------- coord chaos ----
