@@ -112,6 +112,10 @@ def test_engine_server_over_native_transport(monkeypatch):
         assert max(res, key=lambda sc: sc[1])[0] == "pos"
         (st,) = c.get_status().values()
         assert st["trace.rpc.train.count"] == 1
+        # the microbatch coalescer serves the native transport too — the
+        # binders are transport-agnostic (server/microbatch.py)
+        assert st["microbatch.train.item_count"] == 2
+        assert st["microbatch.train.flush_count"] == 1
         c.close()
     finally:
         s.stop()
