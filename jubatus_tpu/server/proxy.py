@@ -33,7 +33,6 @@ from jubatus_tpu.framework.idl import INTERNAL, get_service
 from jubatus_tpu.rpc import aggregators
 from jubatus_tpu.rpc.client import RpcClient
 from jubatus_tpu.rpc.errors import HostError, MultiRpcError, RpcNoClient, RpcNoResult
-from jubatus_tpu.rpc.server import RpcServer
 from jubatus_tpu.version import __version__
 
 log = logging.getLogger(__name__)
@@ -122,7 +121,11 @@ class Proxy:
         self.engine = args.engine
         self.coord = coord or create_coordinator(args.coordinator)
         self.members = MemberCache(self.coord, self.engine)
-        self.rpc = RpcServer(timeout=args.timeout)
+        # same transport selection as the engine servers: the C++
+        # front-end when JUBATUS_TPU_NATIVE_RPC=1 (rpc/native_server.py)
+        from jubatus_tpu.rpc.native_server import create_rpc_server
+
+        self.rpc = create_rpc_server(timeout=args.timeout)
         self.start_time = time.time()
         self._pool: Dict[Tuple[str, int], _Session] = {}
         self._pool_lock = threading.Lock()

@@ -119,3 +119,39 @@ def test_engine_server_over_native_transport(monkeypatch):
         c.close()
     finally:
         s.stop()
+
+
+def test_proxy_over_native_transport(monkeypatch):
+    """The proxy tier honors JUBATUS_TPU_NATIVE_RPC like the engine
+    servers (same create_rpc_server factory)."""
+    monkeypatch.setenv("JUBATUS_TPU_NATIVE_RPC", "1")
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+    conf = {"method": "PA", "parameter": {},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    store = _Store()
+    srv = EngineServer(
+        "classifier", conf,
+        ServerArgs(engine="classifier", coordinator="(shared)", name="np",
+                   listen_addr="127.0.0.1", interval_sec=1e9,
+                   interval_count=1 << 30),
+        coord=MemoryCoordinator(store))
+    proxy = None
+    try:
+        srv.start(0)
+        proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1"),
+                      coord=MemoryCoordinator(store))
+        assert isinstance(proxy.rpc, native_server.NativeRpcServer)
+        pport = proxy.start(0)
+        c = ClassifierClient("127.0.0.1", pport, "np")
+        assert c.train([["pos", Datum({"x": 1.0})]]) == 1
+        (res,) = c.classify([Datum({"x": 1.0})])
+        assert res
+        c.close()
+    finally:
+        if proxy is not None:
+            proxy.stop()
+        srv.stop()
