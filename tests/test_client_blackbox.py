@@ -74,3 +74,85 @@ def test_classifier_train_classify_roundtrip(client):
     assert lab_a in labels and lab_b in labels
     (res,) = client.classify([Datum({"bbx": 1.0})])
     assert {lab for lab, _ in res} >= {lab_a, lab_b}
+
+
+@pytest.mark.skipif(ENGINE != "regression", reason="regression-only flow")
+def test_regression_train_estimate_roundtrip(client):
+    """≙ client_test/regression_test.cpp train/estimate round trip."""
+    n = client.train([[2.0, Datum({"bbx": 1.0, "bbb": 1.0})],
+                      [0.0, Datum({"bbx": -1.0, "bbb": 1.0})]])
+    assert n == 2
+    (est,) = client.estimate([Datum({"bbx": 1.0, "bbb": 1.0})])
+    assert isinstance(est, float)
+
+
+@pytest.mark.skipif(ENGINE != "recommender", reason="recommender-only flow")
+def test_recommender_row_roundtrip(client):
+    """≙ client_test/recommender_test.cpp update/similar/decode."""
+    rid = f"bb_{uuid.uuid4().hex[:8]}"
+    assert client.update_row(rid, Datum({"bbx": 1.0, "bby": 0.5}))
+    assert rid in client.get_all_rows()
+    sim = client.similar_row_from_id(rid, 5)
+    assert any(r == rid for r, _ in sim)
+    decoded = Datum.from_msgpack(client.decode_row(rid))
+    assert dict(decoded.num_values)["bbx"] == 1.0
+    assert client.clear_row(rid)
+
+
+@pytest.mark.skipif(ENGINE != "nearest_neighbor",
+                    reason="nearest_neighbor-only flow")
+def test_nearest_neighbor_row_roundtrip(client):
+    """≙ client_test/nearest_neighbor_test.cpp set/neighbor round trip."""
+    rid = f"bb_{uuid.uuid4().hex[:8]}"
+    assert client.set_row(rid, Datum({"bbx": 1.0, "bby": -1.0}))
+    assert rid in client.get_all_rows()
+    near = client.neighbor_row_from_id(rid, 5)
+    assert any(r == rid for r, _ in near)
+
+
+@pytest.mark.skipif(ENGINE != "anomaly", reason="anomaly-only flow")
+def test_anomaly_add_score_roundtrip(client):
+    """≙ client_test/anomaly_test.cpp add/calc_score."""
+    rid, score = client.add(Datum({"bbx": 0.0, "bby": 0.0}))
+    assert rid
+    s = client.calc_score(Datum({"bbx": 0.1, "bby": 0.0}))
+    assert isinstance(s, float)
+    assert rid in client.get_all_rows()
+
+
+@pytest.mark.skipif(ENGINE != "stat", reason="stat-only flow")
+def test_stat_push_aggregates(client):
+    """≙ client_test/stat_test.cpp push/sum/max/min."""
+    key = f"bb_{uuid.uuid4().hex[:8]}"
+    for v in (1.0, 2.0, 3.0):
+        assert client.push(key, v)
+    assert client.sum(key) == 6.0
+    assert client.max(key) == 3.0
+    assert client.min(key) == 1.0
+
+
+@pytest.mark.skipif(ENGINE != "clustering", reason="clustering-only flow")
+def test_clustering_push_revision(client):
+    """≙ client_test/clustering_test.cpp push/get_revision."""
+    before = client.get_revision()
+    pts = [[f"bb_{uuid.uuid4().hex[:6]}_{i}", Datum({"bbx": float(i % 3)})]
+           for i in range(12)]
+    assert client.push(pts)
+    assert client.get_revision() >= before
+
+
+@pytest.mark.skipif(ENGINE != "graph", reason="graph-only flow")
+def test_graph_node_edge_roundtrip(client):
+    """≙ client_test/graph_test.cpp node/edge lifecycle."""
+    a = client.create_node()
+    b = client.create_node()
+    assert client.update_node(a, {"side": "l"})
+    assert client.update_node(b, {"side": "r"})
+    # edge wire shape: [property map, source, target] (graph.idl:38-42)
+    eid = client.create_edge(a, [{"w": "1"}, a, b])
+    assert eid is not None  # 0 is a valid first edge id
+    edge = client.get_edge(a, eid)
+    assert edge[1] == a and edge[2] == b
+    node = client.get_node(a)
+    assert node  # [properties, in_edges, out_edges]
+    assert client.remove_node(b)
