@@ -1,5 +1,7 @@
 """Ruby / Java / Go client emitters (≙ jenerator's ruby.ml/java.ml/go.ml).
 
+codestyle: allow-tabs (the Go template below is tab-indented, as gofmt requires)
+
 The reference generates client libraries for five languages from the same
 IDL (tools/jenerator/src/{cpp,python,ruby,java,go}.ml); here C++ and Python
 have first-class runtimes (emit_cpp.py, emit.py) and these three emit
@@ -658,7 +660,8 @@ type Datum struct {
 }
 
 func NewDatum() *Datum {
-	return &Datum{StringValues: [][2]interface{}{}, NumValues: [][2]interface{}{}, BinaryValues: [][2]interface{}{}}
+	return &Datum{StringValues: [][2]interface{}{}, NumValues: [][2]interface{}{},
+		BinaryValues: [][2]interface{}{}}
 }
 
 func (d *Datum) AddString(key, value string) *Datum {

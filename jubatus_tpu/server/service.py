@@ -158,12 +158,16 @@ def _bind_recommender(rpc: RpcServer, server: Any) -> None:
     )
     rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
     rpc.register("complete_row_from_id", lambda name, rid: _wire_datum(d.complete_row_from_id(rid)), arity=2)
-    rpc.register("complete_row_from_datum", lambda name, row: _wire_datum(d.complete_row_from_datum(_datum(row))), arity=2)
-    rpc.register("similar_row_from_id", lambda name, rid, size: _scored(d.similar_row_from_id(rid, int(size))), arity=3)
-    rpc.register("similar_row_from_datum", lambda name, row, size: _scored(d.similar_row_from_datum(_datum(row), int(size))), arity=3)
+    rpc.register("complete_row_from_datum",
+                 lambda name, row: _wire_datum(d.complete_row_from_datum(_datum(row))), arity=2)
+    rpc.register("similar_row_from_id",
+                 lambda name, rid, size: _scored(d.similar_row_from_id(rid, int(size))), arity=3)
+    rpc.register("similar_row_from_datum",
+                 lambda name, row, size: _scored(d.similar_row_from_datum(_datum(row), int(size))), arity=3)
     rpc.register("decode_row", lambda name, rid: _wire_datum(d.decode_row(rid)), arity=2)
     rpc.register("get_all_rows", lambda name: d.get_all_rows(), arity=1)
-    rpc.register("calc_similarity", lambda name, lhs, rhs: float(d.calc_similarity(_datum(lhs), _datum(rhs))), arity=3)
+    rpc.register("calc_similarity", lambda name, lhs, rhs: float(d.calc_similarity(_datum(lhs), _datum(rhs))),
+                 arity=3)
     rpc.register("calc_l2norm", lambda name, row: float(d.calc_l2norm(_datum(row))), arity=2)
 
 
@@ -172,10 +176,14 @@ def _bind_nearest_neighbor(rpc: RpcServer, server: Any) -> None:
     d = server.driver
     rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
     rpc.register("set_row", _updating(server, lambda name, rid, dat: d.set_row(rid, _datum(dat))), arity=3)
-    rpc.register("neighbor_row_from_id", lambda name, rid, size: _scored(d.neighbor_row_from_id(rid, int(size))), arity=3)
-    rpc.register("neighbor_row_from_datum", lambda name, q, size: _scored(d.neighbor_row_from_datum(_datum(q), int(size))), arity=3)
-    rpc.register("similar_row_from_id", lambda name, rid, n: _scored(d.similar_row_from_id(rid, int(n))), arity=3)
-    rpc.register("similar_row_from_datum", lambda name, q, n: _scored(d.similar_row_from_datum(_datum(q), int(n))), arity=3)
+    rpc.register("neighbor_row_from_id",
+                 lambda name, rid, size: _scored(d.neighbor_row_from_id(rid, int(size))), arity=3)
+    rpc.register("neighbor_row_from_datum",
+                 lambda name, q, size: _scored(d.neighbor_row_from_datum(_datum(q), int(size))), arity=3)
+    rpc.register("similar_row_from_id", lambda name, rid, n: _scored(d.similar_row_from_id(rid, int(n))),
+                 arity=3)
+    rpc.register("similar_row_from_datum",
+                 lambda name, q, n: _scored(d.similar_row_from_datum(_datum(q), int(n))), arity=3)
     rpc.register("get_all_rows", lambda name: d.get_all_rows(), arity=1)
 
 
@@ -188,8 +196,10 @@ def _bind_anomaly(rpc: RpcServer, server: Any) -> None:
         lambda name, row: list(_updating(server, lambda: d.add(_datum(row)))()),
         arity=2,
     )
-    rpc.register("update", _updating(server, lambda name, rid, row: float(d.update(rid, _datum(row)))), arity=3)
-    rpc.register("overwrite", _updating(server, lambda name, rid, row: float(d.overwrite(rid, _datum(row)))), arity=3)
+    rpc.register("update", _updating(server, lambda name, rid, row: float(d.update(rid, _datum(row)))),
+                 arity=3)
+    rpc.register("overwrite", _updating(server, lambda name, rid, row: float(d.overwrite(rid, _datum(row)))),
+                 arity=3)
     rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
     rpc.register("calc_score", lambda name, row: float(d.calc_score(_datum(row))), arity=2)
     rpc.register("get_all_rows", lambda name: d.get_all_rows(), arity=1)
@@ -206,7 +216,8 @@ def _bind_graph(rpc: RpcServer, server: Any) -> None:
 
     rpc.register("create_node", _updating(server, lambda name: d.create_node()), arity=1)
     rpc.register("remove_node", _updating(server, lambda name, nid: d.remove_node(nid)), arity=2)
-    rpc.register("update_node", _updating(server, lambda name, nid, prop: d.update_node(nid, dict(prop))), arity=3)
+    rpc.register("update_node", _updating(server, lambda name, nid, prop: d.update_node(nid, dict(prop))),
+                 arity=3)
     rpc.register(
         "create_edge",
         _updating(server, lambda name, nid, e: d.create_edge(nid, *edge_parts(e))),
@@ -217,12 +228,17 @@ def _bind_graph(rpc: RpcServer, server: Any) -> None:
         _updating(server, lambda name, nid, eid, e: d.update_edge(nid, int(eid), *edge_parts(e))),
         arity=4,
     )
-    rpc.register("remove_edge", _updating(server, lambda name, nid, eid: d.remove_edge(nid, int(eid))), arity=3)
+    rpc.register("remove_edge", _updating(server, lambda name, nid, eid: d.remove_edge(nid, int(eid))),
+                 arity=3)
     rpc.register("get_centrality", lambda name, nid, ct, q: float(d.get_centrality(nid, int(ct), q)), arity=4)
-    rpc.register("add_centrality_query", _updating(server, lambda name, q: d.add_centrality_query(q)), arity=2)
-    rpc.register("add_shortest_path_query", _updating(server, lambda name, q: d.add_shortest_path_query(q)), arity=2)
-    rpc.register("remove_centrality_query", _updating(server, lambda name, q: d.remove_centrality_query(q)), arity=2)
-    rpc.register("remove_shortest_path_query", _updating(server, lambda name, q: d.remove_shortest_path_query(q)), arity=2)
+    rpc.register("add_centrality_query", _updating(server, lambda name, q: d.add_centrality_query(q)),
+                 arity=2)
+    rpc.register("add_shortest_path_query", _updating(server, lambda name, q: d.add_shortest_path_query(q)),
+                 arity=2)
+    rpc.register("remove_centrality_query", _updating(server, lambda name, q: d.remove_centrality_query(q)),
+                 arity=2)
+    rpc.register("remove_shortest_path_query", _updating(server,
+                 lambda name, q: d.remove_shortest_path_query(q)), arity=2)
     rpc.register(
         "get_shortest_path",
         lambda name, q: d.get_shortest_path(q[0], q[1], int(q[2]), q[3] if len(q) > 3 else None),
@@ -237,11 +253,13 @@ def _bind_graph(rpc: RpcServer, server: Any) -> None:
     )
     rpc.register(
         "get_edge",
-        lambda name, nid, eid: (lambda e: [e["property"], e["source"], e["target"]])(d.get_edge(nid, int(eid))),
+        lambda name, nid, eid: (lambda e: [e["property"], e["source"],
+                                           e["target"]])(d.get_edge(nid, int(eid))),
         arity=3,
     )
     rpc.register("create_node_here", _updating(server, lambda name, nid: d.create_node_here(nid)), arity=2)
-    rpc.register("remove_global_node", _updating(server, lambda name, nid: d.remove_global_node(nid)), arity=2)
+    rpc.register("remove_global_node", _updating(server, lambda name, nid: d.remove_global_node(nid)),
+                 arity=2)
     rpc.register(
         "create_edge_here",
         _updating(server, lambda name, eid, e: d.create_edge_here(int(eid), *edge_parts(e))),
@@ -255,7 +273,9 @@ def _bind_burst(rpc: RpcServer, server: Any) -> None:
 
     def wire_window(w):
         """driver window dict → wire [start_pos, [[all, rel, weight]...]]."""
-        return [w["start_pos"], [[b["all_data_count"], b["relevant_data_count"], b["burst_weight"]] for b in w["batches"]]]
+        return [w["start_pos"],
+                [[b["all_data_count"], b["relevant_data_count"],
+                  b["burst_weight"]] for b in w["batches"]]]
 
     rpc.register(
         "add_documents",
@@ -310,11 +330,14 @@ def _bind_clustering(rpc: RpcServer, server: Any) -> None:
     )
     rpc.register("get_revision", lambda name: int(d.get_revision()), arity=1)
     rpc.register("get_core_members", lambda name: [[wd(p) for p in c] for c in d.get_core_members()], arity=1)
-    rpc.register("get_core_members_light", lambda name: [[wi(p) for p in c] for c in d.get_core_members_light()], arity=1)
+    rpc.register("get_core_members_light",
+                 lambda name: [[wi(p) for p in c] for c in d.get_core_members_light()], arity=1)
     rpc.register("get_k_center", lambda name: [_wire_datum(c) for c in d.get_k_center()], arity=1)
     rpc.register("get_nearest_center", lambda name, p: _wire_datum(d.get_nearest_center(_datum(p))), arity=2)
-    rpc.register("get_nearest_members", lambda name, p: [wd(x) for x in d.get_nearest_members(_datum(p))], arity=2)
-    rpc.register("get_nearest_members_light", lambda name, p: [wi(x) for x in d.get_nearest_members_light(_datum(p))], arity=2)
+    rpc.register("get_nearest_members", lambda name, p: [wd(x) for x in d.get_nearest_members(_datum(p))],
+                 arity=2)
+    rpc.register("get_nearest_members_light",
+                 lambda name, p: [wi(x) for x in d.get_nearest_members_light(_datum(p))], arity=2)
     rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
 
 
@@ -327,7 +350,8 @@ def _bind_stat(rpc: RpcServer, server: Any) -> None:
     rpc.register("max", lambda name, key: float(d.max(key)), arity=2)
     rpc.register("min", lambda name, key: float(d.min(key)), arity=2)
     rpc.register("entropy", lambda name, key: float(d.entropy(key)), arity=2)
-    rpc.register("moment", lambda name, key, deg, center: float(d.moment(key, int(deg), float(center))), arity=4)
+    rpc.register("moment", lambda name, key, deg, center: float(d.moment(key, int(deg), float(center))),
+                 arity=4)
     rpc.register("clear", _updating(server, lambda name: (d.clear(), True)[1]), arity=1)
 
 
@@ -337,7 +361,8 @@ def _bind_bandit(rpc: RpcServer, server: Any) -> None:
     rpc.register("register_arm", _updating(server, lambda name, a: d.register_arm(a)), arity=2)
     rpc.register("delete_arm", _updating(server, lambda name, a: d.delete_arm(a)), arity=2)
     rpc.register("select_arm", _updating(server, lambda name, p: d.select_arm(p)), arity=2)
-    rpc.register("register_reward", _updating(server, lambda name, p, a, r: d.register_reward(p, a, float(r))), arity=4)
+    rpc.register("register_reward", _updating(server,
+                 lambda name, p, a, r: d.register_reward(p, a, float(r))), arity=4)
     rpc.register(
         "get_arm_info",
         lambda name, p: {

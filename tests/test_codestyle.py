@@ -1,0 +1,17 @@
+"""Style gate (≙ tools/codestyle cpplint pre-commit hook): the repo's own
+mechanical checker must pass clean over all Python sources."""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sys
+
+
+def test_codestyle_clean():
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    r = subprocess.run(
+        [sys.executable, str(repo / "tools" / "codestyle" / "check.py")],
+        capture_output=True, text=True, cwd=str(repo))
+    assert r.returncode == 0, \
+        f"style problems:\n{r.stdout[-4000:]}\n{r.stderr[-2000:]}"

@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Mechanical style gate (≙ tools/codestyle/run_cpplint.sh + pre-commit).
+
+Self-contained (no lint packages in the image): enforces the rules that
+never need judgment — UTF-8, LF endings, no tabs in Python, no trailing
+whitespace, newline at EOF, and a module docstring on every package
+module. Run directly or via the test suite:
+
+    python tools/codestyle/check.py [paths...]
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import sys
+from typing import List
+
+MAX_LINE = 110  # hard mechanical ceiling; idiomatic target is ~79
+
+
+def iter_files(roots: List[str]) -> List[str]:
+    out = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for p in glob.glob(os.path.join(root, "**", "*.py"), recursive=True):
+            if "/build/" not in p:
+                out.append(p)
+    return sorted(out)
+
+
+def check_file(path: str) -> List[str]:
+    problems = []
+    with open(path, "rb") as f:
+        raw = f.read()
+    try:
+        text = raw.decode("utf-8")
+    except UnicodeDecodeError as e:
+        return [f"{path}: not valid UTF-8 ({e})"]
+    if b"\r\n" in raw:
+        problems.append(f"{path}: CRLF line endings")
+    if raw and not raw.endswith(b"\n"):
+        problems.append(f"{path}: no newline at end of file")
+    # files embedding templates for tab-indented languages (Go) opt out
+    # of the tab rule with this pragma in their first 10 lines
+    allow_tabs = "codestyle: allow-tabs" in "\n".join(
+        text.splitlines()[:10])
+    for i, line in enumerate(text.splitlines(), 1):
+        if "\t" in line and not allow_tabs:
+            problems.append(f"{path}:{i}: tab character")
+        if line != line.rstrip():
+            problems.append(f"{path}:{i}: trailing whitespace")
+        if len(line) > MAX_LINE:
+            problems.append(f"{path}:{i}: line longer than {MAX_LINE} chars"
+                            f" ({len(line)})")
+    if path.endswith(".py") and "/jubatus_tpu/" in path.replace(os.sep, "/"):
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as e:
+            return problems + [f"{path}: syntax error {e}"]
+        if not os.path.basename(path) == "__main__.py" and \
+                ast.get_docstring(tree) is None and text.strip():
+            problems.append(f"{path}: missing module docstring")
+    return problems
+
+
+def main(argv: List[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    roots = args or [os.path.join(repo, "jubatus_tpu"),
+                     os.path.join(repo, "tests"),
+                     os.path.join(repo, "tools"),
+                     os.path.join(repo, "docs")]
+    files = iter_files(roots)
+    problems = []
+    for path in files:
+        problems.extend(check_file(path))
+    for p in problems:
+        print(p)
+    print(f"{len(problems)} problem(s) in {len(files)} files")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
