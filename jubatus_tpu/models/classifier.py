@@ -41,13 +41,31 @@ class ClassifierConfigError(ValueError):
 class ClassifierDriver(DriverBase):
     TYPE = "classifier"
 
-    def __init__(self, config: dict, dim_bits: int = 18, train_mode: str = "parallel"):
+    def __init__(self, config: dict, dim_bits: int = 18,
+                 train_mode: str = "parallel", mesh=None,
+                 mesh_axis: str = "shard"):
         super().__init__()
         self.config = config
         self.config_json = json.dumps(config)
         # "parallel" = vectorized microbatch (TPU hot path); "sequential" =
         # exact per-datum reference semantics (ops/classifier.py).
         self.train_mode = train_mode
+        # mesh: shard the feature dimension of every [L, D] table over the
+        # mesh axis — ONE server exploits all its local chips (GSPMD
+        # partitions the existing gathers/scatters/einsums; no kernel
+        # changes). Orthogonal to cross-server data parallelism via the
+        # mix plane (parallel/spmd.py stacks both for the pod path).
+        self.mesh = mesh
+        self._sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            n = mesh.shape[mesh_axis]
+            if (1 << dim_bits) % n:
+                raise ClassifierConfigError(
+                    f"feature dim 2^{dim_bits} not divisible by "
+                    f"{n} shard devices")
+            self._sharding = NamedSharding(mesh, P(None, mesh_axis))
         method = config.get("method")
         if method in _NN_METHODS:
             # instance-based classifier over the NN engine — separate driver
@@ -65,11 +83,26 @@ class ClassifierDriver(DriverBase):
         self._confidence = method in ops.CONFIDENCE_METHODS
         self._init_model()
 
+    def _place(self, state: ops.ClassifierState) -> ops.ClassifierState:
+        """Pin [L, D] leaves to the feature-sharded layout (no-op without
+        a mesh; (1,1) placeholders stay replicated)."""
+        if self._sharding is None:
+            return state
+        import jax
+
+        def put(a):
+            if a.ndim == 2 and a.shape[1] == self.converter.dim:
+                return jax.device_put(a, self._sharding)
+            return a
+
+        return ops.ClassifierState(*(put(leaf) for leaf in state))
+
     def _init_model(self) -> None:
         self.labels: List[str] = []           # slot -> label name
         self.label_slots: Dict[str, int] = {}  # label name -> slot
         self.capacity = _INITIAL_CAPACITY
-        self.state = ops.init_state(self.capacity, self.converter.dim, self._confidence)
+        self.state = self._place(
+            ops.init_state(self.capacity, self.converter.dim, self._confidence))
         self.label_counts = np.zeros(self.capacity, dtype=np.float32)
         self._dcounts = np.zeros(self.capacity, dtype=np.float32)
 
@@ -91,7 +124,8 @@ class ClassifierDriver(DriverBase):
             slot = free[0]
         else:
             self.capacity *= 2
-            self.state = ops.grow_labels(self.state, self.capacity)
+            self.state = self._place(
+                ops.grow_labels(self.state, self.capacity))
             self.label_counts = np.pad(self.label_counts, (0, self.capacity // 2))
             self._dcounts = np.pad(self._dcounts, (0, self.capacity // 2))
             slot = len(self.labels)
@@ -213,12 +247,12 @@ class ClassifierDriver(DriverBase):
             return jnp.asarray(out)
 
         st = self.state
-        self.state = ops.ClassifierState(
+        self.state = self._place(ops.ClassifierState(
             w=take_rows(st.w, 0.0),
             dw=take_rows(st.dw, 0.0),
             prec=take_rows(st.prec, 1.0),
             dprec=take_rows(st.dprec, 0.0),
-        )
+        ))
 
         def take_vec(v):
             out = np.zeros(new_cap, dtype=v.dtype)
@@ -270,9 +304,9 @@ class ClassifierDriver(DriverBase):
         self.label_slots = {lab: i for i, lab in enumerate(self.labels) if lab}
         w = jnp.asarray(obj["w"])
         prec = jnp.asarray(obj["prec"])
-        self.state = ops.ClassifierState(
+        self.state = self._place(ops.ClassifierState(
             w=w, dw=jnp.zeros_like(w), prec=prec, dprec=jnp.zeros_like(prec)
-        )
+        ))
         self.label_counts = np.asarray(obj["label_counts"], dtype=np.float32).copy()
         self._dcounts = np.zeros_like(self.label_counts)
         self.converter.weights.unpack(obj["weights"])

@@ -38,6 +38,9 @@ class ServerArgs:
     #: coalesce concurrent train RPCs into one device batch up to this
     #: many examples (server/microbatch.py); 0 = direct per-RPC path
     microbatch_max: int = 8192
+    #: feature-shard linear-classifier tables over this many local
+    #: devices (0/1 = single device)
+    shard_devices: int = 0
 
     @property
     def is_standalone(self) -> bool:
@@ -101,6 +104,9 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "batch up to this many examples; 0 = direct path. "
                         "Depth is bounded by -c (RPC workers) — raise -c "
                         "toward client concurrency for real batching")
+    p.add_argument("--shard-devices", type=int, default=0,
+                   help="feature-shard linear-classifier tables over this "
+                        "many local devices (0/1 = single device)")
     return p
 
 
@@ -113,6 +119,8 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
         raise SystemExit("--thread must be >= 1")
     if args.microbatch_max < 0:
         raise SystemExit("--microbatch-max must be >= 0")
+    if args.shard_devices < 0:
+        raise SystemExit("--shard-devices must be >= 0")
     if args.rpc_port < 0 or args.rpc_port > 65535:
         raise SystemExit("--rpc-port out of range")
     if not args.is_standalone and not args.name:

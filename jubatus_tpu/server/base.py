@@ -43,7 +43,18 @@ class EngineServer:
         if isinstance(config, dict):
             config = json.dumps(config)
         self.config_json: str = config
-        self.driver = create_driver(engine, json.loads(config))
+        mesh = None
+        if getattr(self.args, "shard_devices", 0) > 1:
+            import jax
+            from jax.sharding import Mesh
+
+            devs = jax.devices()[: self.args.shard_devices]
+            if len(devs) < self.args.shard_devices:
+                raise ValueError(
+                    f"--shard-devices {self.args.shard_devices} but only "
+                    f"{len(devs)} devices present")
+            mesh = Mesh(devs, axis_names=("shard",))
+        self.driver = create_driver(engine, json.loads(config), mesh=mesh)
         self.start_time = time.time()
         self.last_saved = 0.0
         self.last_loaded = 0.0

@@ -40,8 +40,12 @@ DRIVER_CLASSES: Dict[str, Type] = {
 }
 
 
-def create_driver(engine: str, config: Any):
-    """Instantiate the engine's driver from a JSON config (str or dict)."""
+def create_driver(engine: str, config: Any, mesh=None):
+    """Instantiate the engine's driver from a JSON config (str or dict).
+
+    ``mesh``: feature-shard the model tables over a local device mesh
+    (linear classifier only — ``--shard-devices``); other engines scale
+    capacity via ``NNBackend.attach_mesh`` / the mix plane instead."""
     if isinstance(config, str):
         config = json.loads(config)
     try:
@@ -56,5 +60,13 @@ def create_driver(engine: str, config: Any):
         from jubatus_tpu.models.classifier_nn import NN_METHODS, ClassifierNNDriver
 
         if isinstance(config, dict) and config.get("method") in NN_METHODS:
+            if mesh is not None:
+                raise ValueError(
+                    "--shard-devices applies to linear classifier methods; "
+                    "instance-based methods use NNBackend.attach_mesh")
             return ClassifierNNDriver(config)
+        return cls(config, mesh=mesh)
+    if mesh is not None:
+        raise ValueError(
+            f"--shard-devices is not supported for engine {engine!r}")
     return cls(config)

@@ -1,0 +1,140 @@
+"""Feature-sharded classifier driver (models/classifier.py mesh mode):
+one server's [L, D] tables span a local device mesh via GSPMD — results
+must match the single-device driver through the full lifecycle (train,
+classify, label churn, schema sync, save/load), and the state must
+actually be sharded."""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.models.classifier import ClassifierConfigError, ClassifierDriver
+
+CONF = {
+    "method": "AROW",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {
+        "string_rules": [{"key": "*", "type": "space",
+                          "sample_weight": "bin", "global_weight": "bin"}],
+        "num_rules": [{"key": "*", "type": "num"}],
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(jax.devices()[:8], axis_names=("shard",))
+
+
+
+def _train_both(a, b, rng, n=40):
+    for i in range(n):
+        x = float(rng.normal())
+        lbl = "pos" if x > 0 else "neg"
+        d = Datum({"x": x, "b": 1.0, "w": f"tok{i % 7}"})
+        a.train([(lbl, d)])
+        b.train([(lbl, d)])
+
+
+def test_sharded_matches_dense_lifecycle(mesh, rng):
+    dense = ClassifierDriver(CONF, dim_bits=12)
+    shard = ClassifierDriver(CONF, dim_bits=12, mesh=mesh)
+    # state really lives sharded
+    assert "shard" in str(shard.state.w.sharding)
+    assert len(shard.state.w.addressable_shards) == 8
+    _train_both(dense, shard, rng)
+    assert dense.get_labels() == shard.get_labels()
+    q = [Datum({"x": 0.7, "b": 1.0}), Datum({"x": -0.7, "b": 1.0})]
+    for rd, rs in zip(dense.classify(q), shard.classify(q)):
+        assert [l for l, _ in rd] == [l for l, _ in rs]
+        np.testing.assert_allclose([s for _, s in rd], [s for _, s in rs],
+                                   rtol=1e-5, atol=1e-6)
+
+    # label churn: grow past capacity (8) and delete — sharding must stick
+    for i in range(10):
+        shard.set_label(f"extra{i}")
+        dense.set_label(f"extra{i}")
+    assert shard.capacity == dense.capacity > 8
+    assert "shard" in str(shard.state.w.sharding)
+    shard.delete_label("extra3")
+    dense.delete_label("extra3")
+    assert dense.get_labels().keys() == shard.get_labels().keys()
+
+    # schema sync rebuild keeps placement
+    union = sorted(shard.get_labels())
+    shard.sync_schema(union)
+    dense.sync_schema(union)
+    assert "shard" in str(shard.state.w.sharding)
+    for rd, rs in zip(dense.classify(q), shard.classify(q)):
+        np.testing.assert_allclose(sorted(s for _, s in rd),
+                                   sorted(s for _, s in rs),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_save_load_roundtrip(mesh, rng, tmp_path):
+    from jubatus_tpu.framework import load_model, save_model
+
+    shard = ClassifierDriver(CONF, dim_bits=12, mesh=mesh)
+    dense = ClassifierDriver(CONF, dim_bits=12)
+    _train_both(dense, shard, rng, n=20)
+    path = str(tmp_path / "s.jubatus")
+    save_model(path, shard, config=json.dumps(CONF))
+    # a sharded checkpoint loads into a DENSE driver (envelope is host-side)
+    dense2 = ClassifierDriver(CONF, dim_bits=12)
+    load_model(path, dense2, expected_config=json.dumps(CONF))
+    q = [Datum({"x": 0.4, "b": 1.0})]
+    np.testing.assert_allclose(
+        [s for _, s in dense.classify(q)[0]],
+        [s for _, s in dense2.classify(q)[0]], rtol=1e-5, atol=1e-6)
+    # ... and back into a sharded one, which re-places the arrays
+    shard2 = ClassifierDriver(CONF, dim_bits=12, mesh=mesh)
+    load_model(path, shard2, expected_config=json.dumps(CONF))
+    assert "shard" in str(shard2.state.w.sharding)
+    np.testing.assert_allclose(
+        [s for _, s in shard.classify(q)[0]],
+        [s for _, s in shard2.classify(q)[0]], rtol=1e-5, atol=1e-6)
+
+
+def test_indivisible_dim_rejected(mesh):
+    with pytest.raises(ClassifierConfigError, match="not divisible"):
+        ClassifierDriver(CONF, dim_bits=2, mesh=mesh)  # 4 features / 8 devs
+
+
+def test_server_level_shard_devices(rng):
+    """EngineServer --shard-devices: full RPC stack on a sharded model."""
+    from jubatus_tpu.client import ClassifierClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    srv = EngineServer(
+        "classifier", CONF,
+        ServerArgs(engine="classifier", shard_devices=4))
+    assert len(srv.driver.state.w.addressable_shards) == 4
+    port = srv.start(0)
+    try:
+        with ClassifierClient("127.0.0.1", port, "sd") as c:
+            assert c.train([["up", Datum({"x": 1.0}).to_msgpack()],
+                            ["down", Datum({"x": -1.0}).to_msgpack()]]) == 2
+            (res,) = c.classify([Datum({"x": 0.9}).to_msgpack()])
+            assert max(res, key=lambda e: e[1])[0] == "up"
+    finally:
+        srv.stop()
+
+
+def test_factory_rejects_mesh_for_other_engines(mesh):
+    from jubatus_tpu.server.factory import create_driver
+
+    with pytest.raises(ValueError, match="not supported"):
+        create_driver("stat", {"window_size": 10}, mesh=mesh)
+    with pytest.raises(ValueError, match="attach_mesh"):
+        create_driver("classifier", {
+            "method": "NN", "parameter": {"method": "lsh",
+                                          "parameter": {"hash_num": 8}},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+        }, mesh=mesh)
