@@ -55,17 +55,12 @@ class ClassifierDriver(DriverBase):
         # partitions the existing gathers/scatters/einsums; no kernel
         # changes). Orthogonal to cross-server data parallelism via the
         # mix plane (parallel/spmd.py stacks both for the pod path).
-        self.mesh = mesh
         self._sharding = None
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from jubatus_tpu.parallel.mesh import make_feature_sharding
 
-            n = mesh.shape[mesh_axis]
-            if (1 << dim_bits) % n:
-                raise ClassifierConfigError(
-                    f"feature dim 2^{dim_bits} not divisible by "
-                    f"{n} shard devices")
-            self._sharding = NamedSharding(mesh, P(None, mesh_axis))
+            self._sharding = make_feature_sharding(
+                mesh, mesh_axis, dim_bits, ClassifierConfigError, rank=2)
         method = config.get("method")
         if method in _NN_METHODS:
             # instance-based classifier over the NN engine — separate driver

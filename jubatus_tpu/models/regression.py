@@ -28,7 +28,8 @@ class RegressionConfigError(ValueError):
 class RegressionDriver(DriverBase):
     TYPE = "regression"
 
-    def __init__(self, config: dict, dim_bits: int = 18):
+    def __init__(self, config: dict, dim_bits: int = 18, mesh=None,
+                 mesh_axis: str = "shard"):
         super().__init__()
         self.config = config
         self.config_json = json.dumps(config)
@@ -40,7 +41,23 @@ class RegressionDriver(DriverBase):
         self.sensitivity = float(param.get("sensitivity", 0.1))
         self.c = float(param.get("regularization_weight", 1.0))
         self.converter = make_fv_converter(config.get("converter"), dim_bits=dim_bits)
-        self.state = ops.init_state(self.converter.dim)
+        # feature sharding over local devices (--shard-devices), same GSPMD
+        # placement story as the classifier (models/classifier.py)
+        self._sharding = None
+        if mesh is not None:
+            from jubatus_tpu.parallel.mesh import make_feature_sharding
+
+            self._sharding = make_feature_sharding(
+                mesh, mesh_axis, dim_bits, RegressionConfigError, rank=1)
+        self.state = self._place(ops.init_state(self.converter.dim))
+
+    def _place(self, state: ops.RegressionState) -> ops.RegressionState:
+        if self._sharding is None:
+            return state
+        import jax
+
+        return ops.RegressionState(
+            *(jax.device_put(a, self._sharding) for a in state))
 
     @locked
     def train(self, data: Sequence[Tuple[float, Datum]]) -> int:
@@ -72,7 +89,7 @@ class RegressionDriver(DriverBase):
 
     @locked
     def clear(self) -> None:
-        self.state = ops.init_state(self.converter.dim)
+        self.state = self._place(ops.init_state(self.converter.dim))
         self.converter.weights.clear()
         self.update_count = 0
 
@@ -103,7 +120,8 @@ class RegressionDriver(DriverBase):
                 f"{self.converter.dim} (dim_bits mismatch)"
             )
         w = jnp.asarray(obj["w"])
-        self.state = ops.RegressionState(w=w, dw=jnp.zeros_like(w))
+        self.state = self._place(
+            ops.RegressionState(w=w, dw=jnp.zeros_like(w)))
         self.converter.weights.unpack(obj["weights"])
 
     def get_status(self) -> Dict[str, Any]:

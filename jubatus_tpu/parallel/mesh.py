@@ -34,6 +34,21 @@ def replica_mesh(n_replicas: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), axis_names=("replica",))
 
 
+def make_feature_sharding(mesh: Mesh, mesh_axis: str, dim_bits: int,
+                          err_cls=ValueError, rank: int = 2):
+    """NamedSharding placing the trailing (feature) dim of rank-``rank``
+    tables over ``mesh_axis`` — shared by the linear drivers'
+    ``--shard-devices`` mode; validates divisibility."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[mesh_axis]
+    if (1 << dim_bits) % n:
+        raise err_cls(
+            f"feature dim 2^{dim_bits} not divisible by {n} shard devices")
+    spec = P(*([None] * (rank - 1)), mesh_axis)
+    return NamedSharding(mesh, spec)
+
+
 def grid_mesh(replica: int, shard: int, devices=None) -> Mesh:
     """A 2-D (replica, shard) mesh: data-parallel groups of row-sharded
     stores — the TPU equivalent of N CHT-sharded servers with replication."""

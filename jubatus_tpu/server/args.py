@@ -38,7 +38,7 @@ class ServerArgs:
     #: coalesce concurrent train RPCs into one device batch up to this
     #: many examples (server/microbatch.py); 0 = direct per-RPC path
     microbatch_max: int = 8192
-    #: feature-shard linear-classifier tables over this many local
+    #: feature-shard linear classifier/regression tables over this many local
     #: devices (0/1 = single device)
     shard_devices: int = 0
 
@@ -105,7 +105,7 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "Depth is bounded by -c (RPC workers) — raise -c "
                         "toward client concurrency for real batching")
     p.add_argument("--shard-devices", type=int, default=0,
-                   help="feature-shard linear-classifier tables over this "
+                   help="feature-shard linear classifier/regression tables over this "
                         "many local devices (0/1 = single device)")
     return p
 

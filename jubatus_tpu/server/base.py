@@ -48,11 +48,13 @@ class EngineServer:
             import jax
             from jax.sharding import Mesh
 
-            devs = jax.devices()[: self.args.shard_devices]
+            # local_devices: on a multi-host runtime jax.devices() spans
+            # every process, and device_put on non-addressable devices fails
+            devs = jax.local_devices()[: self.args.shard_devices]
             if len(devs) < self.args.shard_devices:
                 raise ValueError(
                     f"--shard-devices {self.args.shard_devices} but only "
-                    f"{len(devs)} devices present")
+                    f"{len(devs)} local devices present")
             mesh = Mesh(devs, axis_names=("shard",))
         self.driver = create_driver(engine, json.loads(config), mesh=mesh)
         self.start_time = time.time()

@@ -44,8 +44,8 @@ def create_driver(engine: str, config: Any, mesh=None):
     """Instantiate the engine's driver from a JSON config (str or dict).
 
     ``mesh``: feature-shard the model tables over a local device mesh
-    (linear classifier only — ``--shard-devices``); other engines scale
-    capacity via ``NNBackend.attach_mesh`` / the mix plane instead."""
+    (linear classifier and regression — ``--shard-devices``); other
+    engines scale via ``NNBackend.attach_mesh`` / the mix plane."""
     if isinstance(config, str):
         config = json.loads(config)
     try:
@@ -65,6 +65,8 @@ def create_driver(engine: str, config: Any, mesh=None):
                     "--shard-devices applies to linear classifier methods; "
                     "instance-based methods use NNBackend.attach_mesh")
             return ClassifierNNDriver(config)
+        return cls(config, mesh=mesh)
+    if engine == "regression":
         return cls(config, mesh=mesh)
     if mesh is not None:
         raise ValueError(

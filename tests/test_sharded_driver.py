@@ -127,6 +127,28 @@ def test_server_level_shard_devices(rng):
         srv.stop()
 
 
+def test_sharded_regression_matches_dense(mesh, rng):
+    from jubatus_tpu.models.regression import RegressionDriver
+
+    cfg = {"method": "PA1",
+           "parameter": {"sensitivity": 0.1, "regularization_weight": 1.0},
+           "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    dense = RegressionDriver(cfg, dim_bits=12)
+    shard = RegressionDriver(cfg, dim_bits=12, mesh=mesh)
+    assert len(shard.state.w.addressable_shards) == 8
+    for _ in range(30):
+        x = float(rng.uniform(-1, 1))
+        d = Datum({"x": x, "b": 1.0})
+        dense.train([(2.0 * x + 1.0, d)])
+        shard.train([(2.0 * x + 1.0, d)])
+    q = [Datum({"x": 0.5, "b": 1.0}), Datum({"x": -0.5, "b": 1.0})]
+    np.testing.assert_allclose(shard.estimate(q), dense.estimate(q),
+                               rtol=1e-5, atol=1e-6)
+    shard.clear()
+    assert "shard" in str(shard.state.w.sharding)
+    assert shard.estimate(q) == [0.0, 0.0]
+
+
 def test_factory_rejects_mesh_for_other_engines(mesh):
     from jubatus_tpu.server.factory import create_driver
 
