@@ -43,9 +43,11 @@ DRIVER_CLASSES: Dict[str, Type] = {
 def create_driver(engine: str, config: Any, mesh=None):
     """Instantiate the engine's driver from a JSON config (str or dict).
 
-    ``mesh``: feature-shard the model tables over a local device mesh
-    (linear classifier and regression — ``--shard-devices``); other
-    engines scale via ``NNBackend.attach_mesh`` / the mix plane."""
+    ``mesh`` (``--shard-devices``): span the model over a local device
+    mesh — FEATURE-sharded [.., D] tables for the linear engines
+    (classifier/regression), ROW-sharded signature tables for the
+    neighbor-query engines with hash methods (nearest_neighbor,
+    recommender, instance classifier — ``NNBackend.attach_mesh``)."""
     if isinstance(config, str):
         config = json.loads(config)
     try:
@@ -60,15 +62,29 @@ def create_driver(engine: str, config: Any, mesh=None):
         from jubatus_tpu.models.classifier_nn import NN_METHODS, ClassifierNNDriver
 
         if isinstance(config, dict) and config.get("method") in NN_METHODS:
-            if mesh is not None:
-                raise ValueError(
-                    "--shard-devices applies to linear classifier methods; "
-                    "instance-based methods use NNBackend.attach_mesh")
-            return ClassifierNNDriver(config)
+            return _maybe_attach(ClassifierNNDriver(config), mesh)
         return cls(config, mesh=mesh)
     if engine == "regression":
         return cls(config, mesh=mesh)
+    if engine in ("nearest_neighbor", "recommender"):
+        return _maybe_attach(cls(config), mesh)
     if mesh is not None:
+        # anomaly deliberately excluded: LOF's scan paths (full distance
+        # vectors via backend.distances/distances_from_slots) do not ride
+        # the sharded top-k, so attaching a mesh there would change nothing
+        # while claiming it did
         raise ValueError(
             f"--shard-devices is not supported for engine {engine!r}")
     return cls(config)
+
+
+def _maybe_attach(driver, mesh):
+    """Row-shard an instance driver's NN backend over the mesh (hash
+    methods only — NNBackend.attach_mesh validates)."""
+    if mesh is not None:
+        backend = getattr(driver, "backend", None)
+        if backend is None:
+            raise ValueError(
+                "--shard-devices: this method has no shardable backend")
+        backend.attach_mesh(mesh)
+    return driver

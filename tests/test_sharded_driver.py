@@ -149,14 +149,64 @@ def test_sharded_regression_matches_dense(mesh, rng):
     assert shard.estimate(q) == [0.0, 0.0]
 
 
-def test_factory_rejects_mesh_for_other_engines(mesh):
+def test_factory_mesh_routing(mesh):
+    """--shard-devices routes per engine family: feature-sharding for the
+    linear engines, NNBackend row-sharding for instance engines with hash
+    methods, a clear error for everything else."""
     from jubatus_tpu.server.factory import create_driver
 
     with pytest.raises(ValueError, match="not supported"):
         create_driver("stat", {"window_size": 10}, mesh=mesh)
-    with pytest.raises(ValueError, match="attach_mesh"):
-        create_driver("classifier", {
-            "method": "NN", "parameter": {"method": "lsh",
-                                          "parameter": {"hash_num": 8}},
+    # instance engine + hash method → backend mesh attached
+    nn = create_driver("nearest_neighbor", {
+        "method": "lsh", "parameter": {"hash_num": 16},
+        "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+    }, mesh=mesh)
+    assert nn.backend._mesh is mesh
+    # instance-classifier hash method too
+    cnn = create_driver("classifier", {
+        "method": "NN", "parameter": {"method": "lsh",
+                                      "parameter": {"hash_num": 8}},
+        "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+    }, mesh=mesh)
+    assert cnn.backend._mesh is mesh
+    # exact methods have no sharded scan → NNBackend rejects
+    with pytest.raises(ValueError, match="hash methods"):
+        create_driver("recommender", {
+            "method": "inverted_index", "parameter": {},
             "converter": {"num_rules": [{"key": "*", "type": "num"}]},
         }, mesh=mesh)
+    # anomaly's LOF scans bypass the sharded top-k — attaching would be
+    # a silent no-op, so it must refuse
+    with pytest.raises(ValueError, match="not supported"):
+        create_driver("anomaly", {
+            "method": "lof",
+            "parameter": {"nearest_neighbor_num": 5,
+                          "reverse_nearest_neighbor_num": 10,
+                          "method": "lsh", "parameter": {"hash_num": 8}},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+        }, mesh=mesh)
+
+
+def test_sharded_nn_server_end_to_end(rng):
+    """--shard-devices on a nearest_neighbor server: rows are served from
+    the row-sharded table over RPC."""
+    from jubatus_tpu.client import NearestNeighborClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    conf = {"method": "lsh", "parameter": {"hash_num": 64},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    srv = EngineServer("nearest_neighbor", conf,
+                       ServerArgs(engine="nearest_neighbor", shard_devices=8))
+    assert srv.driver.backend._mesh is not None
+    port = srv.start(0)
+    try:
+        with NearestNeighborClient("127.0.0.1", port, "snn") as c:
+            for i in range(20):
+                c.set_row(f"r{i}", Datum({"x": float(i), "y": float(i % 5)}))
+            near = c.neighbor_row_from_id("r3", 5)
+            assert any(r == "r3" for r, _ in near)
+            assert len(near) == 5
+    finally:
+        srv.stop()
