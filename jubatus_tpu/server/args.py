@@ -38,8 +38,9 @@ class ServerArgs:
     #: coalesce concurrent train RPCs into one device batch up to this
     #: many examples (server/microbatch.py); 0 = direct per-RPC path
     microbatch_max: int = 8192
-    #: feature-shard linear classifier/regression tables over this many local
-    #: devices (0/1 = single device)
+    #: span the model over this many local devices (0/1 = single
+    #: device): feature-sharded tables for linear classifier/regression,
+    #: row-sharded signature tables for NN/recommender hash methods
     shard_devices: int = 0
 
     @property
@@ -105,8 +106,10 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "Depth is bounded by -c (RPC workers) — raise -c "
                         "toward client concurrency for real batching")
     p.add_argument("--shard-devices", type=int, default=0,
-                   help="feature-shard linear classifier/regression tables over this "
-                        "many local devices (0/1 = single device)")
+                   help="span the model over this many local devices (0/1 = "
+                        "single device): feature-sharded tables for linear "
+                        "classifier/regression, row-sharded signature "
+                        "tables for NN/recommender hash methods")
     return p
 
 

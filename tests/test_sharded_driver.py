@@ -210,3 +210,46 @@ def test_sharded_nn_server_end_to_end(rng):
             assert len(near) == 5
     finally:
         srv.stop()
+
+
+@pytest.mark.slow
+def test_sharded_servers_mix_across_cluster(rng):
+    """Intra-server feature sharding composes with cross-server mixing:
+    two servers, each spanning 4 local devices, average models over the
+    RPC mix plane and converge to shared knowledge."""
+    from jubatus_tpu.client import ClassifierClient
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    store = _Store()
+    servers = []
+    for _ in range(2):
+        args = ServerArgs(
+            engine="classifier", coordinator="(shared)", name="shmix",
+            listen_addr="127.0.0.1", shard_devices=4,
+            interval_sec=1e9, interval_count=1 << 30,
+        )
+        srv = EngineServer("classifier", CONF, args,
+                           coord=MemoryCoordinator(store))
+        srv.start(0)
+        servers.append(srv)
+    clients = [ClassifierClient("127.0.0.1", s.args.rpc_port, "shmix")
+               for s in servers]
+    try:
+        for _ in range(10):
+            clients[0].train([["pos", Datum({"x": 1.0}).to_msgpack()]])
+            clients[1].train([["neg", Datum({"x": -1.0}).to_msgpack()]])
+        assert clients[0].do_mix() is True
+        for c in clients:
+            assert set(c.get_labels()) == {"pos", "neg"}
+            (r,) = c.classify([Datum({"x": 1.0}).to_msgpack()])
+            assert max(r, key=lambda e: e[1])[0] == "pos"
+        # sharding survived the mix round's put_diff
+        for s in servers:
+            assert "shard" in str(s.driver.state.w.sharding)
+    finally:
+        for c in clients:
+            c.close()
+        for s in servers:
+            s.stop()
