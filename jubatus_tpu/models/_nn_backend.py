@@ -204,13 +204,27 @@ class NNBackend:
         return knn.euclid_projection(idx, val, hash_num=self.hash_num,
                                      seed=self.seed)[0]
 
+    def _mesh_distances(self, q_batch) -> np.ndarray:
+        """[B, C] full distances from the row-sharded table (truncated to
+        the unpadded capacity)."""
+        from jubatus_tpu.parallel import sharded_knn
+
+        sigs, _valid = self._mesh_view()
+        d = sharded_knn.sharded_distances(
+            self._mesh, q_batch, sigs, method=self.method,
+            hash_num=self.hash_num, axis=self._mesh_axis)
+        return np.asarray(d)[:, : self.store.capacity]
+
     def distances(self, vec: SparseVector) -> np.ndarray:
         """Distance of every live slot to the query; dead slots +inf. [C]."""
         self._flush()
         live = self.store.live_mask()
         if not live.any():
             return np.full(self.store.capacity, np.inf, np.float32)
-        if self.method in HASH_METHODS:
+        if self.method in HASH_METHODS and self._mesh is not None:
+            q = self._query_sig(vec)
+            d = self._mesh_distances(q[None])[0]
+        elif self.method in HASH_METHODS:
             q = self._query_sig(vec)
             sigs = self._sig_view()
             if self.method == "lsh":
@@ -277,7 +291,12 @@ class NNBackend:
         out = np.full((len(slots), c), np.inf, np.float32)
         if not live.any():
             return out
-        if self.method in HASH_METHODS:
+        if self.method in HASH_METHODS and self._mesh is not None:
+            for lo in range(0, len(slots), chunk):
+                sel = np.asarray(slots[lo:lo + chunk])
+                q = jnp.asarray(self._sigs[sel])
+                out[lo:lo + chunk] = self._mesh_distances(q)
+        elif self.method in HASH_METHODS:
             sigs = self._sig_view()
             for lo in range(0, len(slots), chunk):
                 sel = np.asarray(slots[lo:lo + chunk])

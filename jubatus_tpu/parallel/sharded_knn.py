@@ -85,6 +85,46 @@ def _sharded_topk(mesh, q, table, local_scores, k: int, axis: str,
 
 
 @functools.partial(jax.jit,
+                   static_argnames=("mesh", "method", "hash_num", "axis"))
+def sharded_distances(
+    mesh: Mesh,
+    q_sigs: jax.Array,    # [B, W/H] replicated
+    row_sigs: jax.Array,  # [C, W/H] sharded over `axis`
+    *,
+    method: str,
+    hash_num: int,
+    axis: str = "shard",
+) -> jax.Array:
+    """FULL distance matrix [B, C] from a sharded table — each device
+    scans its slice, one all_gather assembles the rows. For consumers
+    that need every distance (LOF's lrd cache), not just top-k: HBM holds
+    only C/S signature rows per device; the [B, C] float result is the
+    caller's to size."""
+    from jubatus_tpu.ops import knn
+
+    scorer = {
+        "lsh": lambda q, r: knn._hamming_distances_batch_xla(
+            q, r, hash_num=hash_num),
+        "minhash": lambda q, r: knn._minhash_distances_batch_xla(q, r),
+        "euclid_lsh": lambda q, r: knn.euclid_lsh_distances_batch(
+            q, r, hash_num=hash_num),
+    }[method]
+
+    def scan(q, rows):
+        d = scorer(q, rows).astype(jnp.float32)            # [B, c_local]
+        parts = jax.lax.all_gather(d, axis, tiled=False)   # [S, B, c_local]
+        return jnp.transpose(parts, (1, 0, 2)).reshape(q.shape[0], -1)
+
+    fn = jax.shard_map(
+        scan, mesh=mesh,
+        in_specs=(P(), P(axis, None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(q_sigs, row_sigs)
+
+
+@functools.partial(jax.jit,
                    static_argnames=("mesh", "hash_num", "k", "axis"))
 def sharded_hamming_topk(
     mesh: Mesh,

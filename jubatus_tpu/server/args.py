@@ -40,7 +40,8 @@ class ServerArgs:
     microbatch_max: int = 8192
     #: span the model over this many local devices (0/1 = single
     #: device): feature-sharded tables for linear classifier/regression,
-    #: row-sharded signature tables for NN/recommender hash methods
+    #: row-sharded signature tables for NN/recommender/anomaly hash
+    #: methods
     shard_devices: int = 0
 
     @property
@@ -109,7 +110,7 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                    help="span the model over this many local devices (0/1 = "
                         "single device): feature-sharded tables for linear "
                         "classifier/regression, row-sharded signature "
-                        "tables for NN/recommender hash methods")
+                        "tables for NN/recommender/anomaly hash methods")
     return p
 
 

@@ -46,8 +46,9 @@ def create_driver(engine: str, config: Any, mesh=None):
     ``mesh`` (``--shard-devices``): span the model over a local device
     mesh — FEATURE-sharded [.., D] tables for the linear engines
     (classifier/regression), ROW-sharded signature tables for the
-    neighbor-query engines with hash methods (nearest_neighbor,
-    recommender, instance classifier — ``NNBackend.attach_mesh``)."""
+    instance engines with hash methods (nearest_neighbor, recommender,
+    anomaly, instance classifier — ``NNBackend.attach_mesh``; anomaly's
+    LOF rides the full-distance sharded scan)."""
     if isinstance(config, str):
         config = json.loads(config)
     try:
@@ -66,13 +67,11 @@ def create_driver(engine: str, config: Any, mesh=None):
         return cls(config, mesh=mesh)
     if engine == "regression":
         return cls(config, mesh=mesh)
-    if engine in ("nearest_neighbor", "recommender"):
+    if engine in ("nearest_neighbor", "recommender", "anomaly"):
+        # anomaly rides sharded_distances (LOF needs full distance
+        # vectors); NN/recommender ride the sharded top-k
         return _maybe_attach(cls(config), mesh)
     if mesh is not None:
-        # anomaly deliberately excluded: LOF's scan paths (full distance
-        # vectors via backend.distances/distances_from_slots) do not ride
-        # the sharded top-k, so attaching a mesh there would change nothing
-        # while claiming it did
         raise ValueError(
             f"--shard-devices is not supported for engine {engine!r}")
     return cls(config)

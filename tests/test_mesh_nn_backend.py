@@ -117,3 +117,60 @@ def test_driver_level_mesh(mesh, rng):
     want_by_id = dict(d_dense.neighbor_row_from_datum(q, 20))
     for rid, d in got:
         np.testing.assert_allclose(d, want_by_id[rid], rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("method", HASH_METHODS)
+def test_mesh_full_distances_match_dense(method, mesh, rng):
+    """sharded_distances (LOF's full-vector path) must reproduce the
+    dense distances bit-for-bit, including the dead-slot +inf mask and
+    the batched distances_from_slots cache fill."""
+    dense = NNBackend(method, dim=DIM, hash_num=32)
+    shard = NNBackend(method, dim=DIM, hash_num=32)
+    for i in range(21):  # odd count exercises capacity padding
+        v = _vec(rng)
+        dense.set_row(f"r{i}", v)
+        shard.set_row(f"r{i}", v)
+    shard.attach_mesh(mesh)
+    dense.remove_row("r7")
+    shard.remove_row("r7")
+
+    # euclid_lsh's batch kernel uses the expanded ||q||²-2qr+||r||² form
+    # (one MXU matmul) whose cancellation error reaches ~1e-3 near zero;
+    # the dense single-query path subtracts directly
+    atol = 2e-3 if method == "euclid_lsh" else 1e-6
+    q = _vec(rng)
+    np.testing.assert_allclose(shard.distances(q), dense.distances(q),
+                               rtol=1e-4, atol=atol)
+    slots = np.asarray(sorted(dense.store.slots.values())[:6])
+    np.testing.assert_allclose(shard.distances_from_slots(slots),
+                               dense.distances_from_slots(slots),
+                               rtol=1e-4, atol=atol)
+
+
+def test_anomaly_driver_sharded_lof(mesh, rng):
+    """LOF scoring on a row-sharded backend matches the dense driver."""
+    from jubatus_tpu.core.datum import Datum
+    from jubatus_tpu.server.factory import create_driver
+
+    # euclid_lsh, as the reference's lof.json defaults: sign-LSH is
+    # magnitude-blind and cannot separate a directional outlier
+    cfg = {"method": "lof",
+           "parameter": {"nearest_neighbor_num": 5,
+                         "reverse_nearest_neighbor_num": 10,
+                         "method": "euclid_lsh",
+                         "parameter": {"hash_num": 64}},
+           "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    dense = create_driver("anomaly", cfg)
+    shard = create_driver("anomaly", cfg, mesh=mesh)
+    for i in range(30):
+        d = Datum({"x": float(rng.normal(0, 0.1)),
+                   "y": float(rng.normal(0, 0.1))})
+        dense.add(d)
+        shard.add(d)
+    q_in = Datum({"x": 0.02, "y": -0.03})
+    q_out = Datum({"x": 6.0, "y": -6.0})
+    np.testing.assert_allclose(shard.calc_score(q_in),
+                               dense.calc_score(q_in), rtol=1e-4)
+    np.testing.assert_allclose(shard.calc_score(q_out),
+                               dense.calc_score(q_out), rtol=1e-4)
+    assert shard.calc_score(q_out) > shard.calc_score(q_in)

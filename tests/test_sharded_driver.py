@@ -176,16 +176,15 @@ def test_factory_mesh_routing(mesh):
             "method": "inverted_index", "parameter": {},
             "converter": {"num_rules": [{"key": "*", "type": "num"}]},
         }, mesh=mesh)
-    # anomaly's LOF scans bypass the sharded top-k — attaching would be
-    # a silent no-op, so it must refuse
-    with pytest.raises(ValueError, match="not supported"):
-        create_driver("anomaly", {
-            "method": "lof",
-            "parameter": {"nearest_neighbor_num": 5,
-                          "reverse_nearest_neighbor_num": 10,
-                          "method": "lsh", "parameter": {"hash_num": 8}},
-            "converter": {"num_rules": [{"key": "*", "type": "num"}]},
-        }, mesh=mesh)
+    # anomaly rides sharded_distances (LOF needs full vectors)
+    an = create_driver("anomaly", {
+        "method": "lof",
+        "parameter": {"nearest_neighbor_num": 5,
+                      "reverse_nearest_neighbor_num": 10,
+                      "method": "lsh", "parameter": {"hash_num": 8}},
+        "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+    }, mesh=mesh)
+    assert an.backend._mesh is mesh
 
 
 def test_sharded_nn_server_end_to_end(rng):
