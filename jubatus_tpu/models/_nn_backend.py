@@ -101,7 +101,10 @@ class NNBackend:
         self._pending.clear()
         if not items:
             return
-        sb = SparseBatch.from_vectors([vec for _, vec in items])
+        # bucketed rows: pending-set sizes vary per flush; extra signature
+        # rows beyond len(items) are simply not written back
+        sb = SparseBatch.from_vectors([vec for _, vec in items],
+                                      batch_bucket=16)
         idx, val = jnp.asarray(sb.idx), jnp.asarray(sb.val)
         if self.method == "lsh":
             sigs = knn.lsh_signature(idx, val, hash_num=self.hash_num,

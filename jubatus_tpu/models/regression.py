@@ -64,13 +64,16 @@ class RegressionDriver(DriverBase):
         if not data:
             return 0
         vectors = [self.converter.convert(d, update_weights=True) for _, d in data]
-        targets = [float(y) for y, _ in data]
-        sb = SparseBatch.from_vectors(vectors)
+        # batch_bucket bounds distinct compiled shapes (coalesced sizes
+        # vary per flush); padded rows predict 0 for target 0 → loss 0 →
+        # no update
+        sb = SparseBatch.from_vectors(vectors, batch_bucket=16)
+        targets = sb.pad_aux([float(y) for y, _ in data], dtype=np.float32)
         self.state = ops.train_batch(
             self.state,
             jnp.asarray(sb.idx),
             jnp.asarray(sb.val),
-            jnp.asarray(targets, jnp.float32),
+            jnp.asarray(targets),
             self.sensitivity,
             self.c,
             method=self.method,
@@ -83,9 +86,9 @@ class RegressionDriver(DriverBase):
         if not data:
             return []
         vectors = [self.converter.convert(d) for d in data]
-        sb = SparseBatch.from_vectors(vectors)
+        sb = SparseBatch.from_vectors(vectors, batch_bucket=16)
         pred = ops.estimate(self.state, jnp.asarray(sb.idx), jnp.asarray(sb.val))
-        return [float(x) for x in np.asarray(pred)]
+        return [float(x) for x in np.asarray(pred)[: len(data)]]
 
     @locked
     def clear(self) -> None:
