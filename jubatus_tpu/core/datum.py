@@ -71,9 +71,17 @@ class Datum:
         def _s(x):
             return x.decode("utf-8", "replace") if isinstance(x, bytes) else x
 
+        def _b(x):
+            # legacy (pre-bin) clients pack binary values as old-raw, which
+            # the transports decode with surrogateescape; re-encoding with
+            # surrogateescape restores the exact original bytes
+            if isinstance(x, str):
+                return x.encode("utf-8", "surrogateescape")
+            return x
+
         d.string_values = [(_s(k), _s(v)) for k, v in sv]
         d.num_values = [(_s(k), float(v)) for k, v in nv]
-        d.binary_values = [(_s(k), v) for k, v in bv]
+        d.binary_values = [(_s(k), _b(v)) for k, v in bv]
         return d
 
     def __repr__(self) -> str:  # pragma: no cover

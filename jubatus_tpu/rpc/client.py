@@ -81,8 +81,12 @@ class RpcClient:
         with self._lock:
             self._msgid = (self._msgid + 1) & 0xFFFFFFFF
             msgid = self._msgid
+            # surrogateescape: params a proxy forwards may hold surrogate-
+            # bearing strings (legacy non-UTF8 raw decoded upstream); they
+            # must re-encode to the original bytes, not raise pre-send
             payload = msgpack.packb(
-                [REQUEST, msgid, method, list(args)], default=_to_wire
+                [REQUEST, msgid, method, list(args)], default=_to_wire,
+                unicode_errors="surrogateescape"
             )
             sock = self._connect()
             try:
@@ -100,7 +104,8 @@ class RpcClient:
         return result
 
     def notify(self, method: str, *args: Any) -> None:
-        payload = msgpack.packb([2, method, list(args)], default=_to_wire)
+        payload = msgpack.packb([2, method, list(args)], default=_to_wire,
+                                unicode_errors="surrogateescape")
         with self._lock:
             sock = self._connect()
             try:
@@ -110,7 +115,8 @@ class RpcClient:
                 raise RpcIoError(str(e)) from e
 
     def _read_response(self, sock: socket.socket, msgid: int) -> Any:
-        unpacker = msgpack.Unpacker(raw=False, strict_map_key=False)
+        unpacker = msgpack.Unpacker(raw=False, strict_map_key=False,
+                                    unicode_errors="surrogateescape")
         while True:
             data = sock.recv(65536)
             if not data:

@@ -86,9 +86,12 @@ class NativeRpcServer:
     """RpcServer drop-in over the C++ transport."""
 
     def __init__(self, timeout: float = 10.0,
-                 trace: Optional[Registry] = None) -> None:
+                 trace: Optional[Registry] = None,
+                 legacy_wire: bool = False) -> None:
         self._methods: Dict[str, Callable[..., Any]] = {}
         self._arity: Dict[str, Optional[int]] = {}
+        self.legacy_wire = legacy_wire
+        self._binary_methods: set = set()
         self.timeout = timeout
         self.trace = trace or Registry()
         self.port: Optional[int] = None
@@ -105,6 +108,7 @@ class NativeRpcServer:
     method_names = RpcServer.method_names
     _invoke = RpcServer._invoke
     _execute = RpcServer._execute
+    response_legacy = RpcServer.response_legacy
 
     # -- C++ → Python dispatch ------------------------------------------------
     def _on_request(self, conn_id, msgid, method, method_len, params_ptr,
@@ -131,14 +135,16 @@ class NativeRpcServer:
                   raw: bytes) -> None:
         try:
             params = msgpack.unpackb(raw, raw=False, strict_map_key=False,
-                                     use_list=True)
+                                     use_list=True,
+                                     unicode_errors="surrogateescape")
         except Exception as e:  # noqa: BLE001 — undecodable params
             error, result = error_to_wire(e), None
         else:
             error, result = self._execute(method, params)
         if msgid == self._NOTIFY:
             return  # notification: no response on the wire
-        payload = build_response(msgid, error, result)
+        payload = build_response(msgid, error, result,
+                                 legacy=self.response_legacy(method))
         self._lib.jt_rpc_respond(self._handle, conn_id, payload, len(payload))
 
     # -- lifecycle (RpcServer-compatible) -------------------------------------
@@ -174,12 +180,14 @@ class NativeRpcServer:
             pass
 
 
-def create_rpc_server(timeout: float = 10.0, trace: Optional[Registry] = None):
+def create_rpc_server(timeout: float = 10.0, trace: Optional[Registry] = None,
+                      legacy_wire: bool = False):
     """RpcServer factory: native transport when JUBATUS_TPU_NATIVE_RPC=1
     and the library builds, else the Python transport."""
     if os.environ.get("JUBATUS_TPU_NATIVE_RPC", "") in ("1", "true", "yes"):
         try:
-            return NativeRpcServer(timeout=timeout, trace=trace)
+            return NativeRpcServer(timeout=timeout, trace=trace,
+                                   legacy_wire=legacy_wire)
         except RuntimeError as e:
             log.warning("native rpc unavailable (%s); using python transport", e)
-    return RpcServer(timeout=timeout, trace=trace)
+    return RpcServer(timeout=timeout, trace=trace, legacy_wire=legacy_wire)

@@ -42,9 +42,18 @@ class RpcServer:
     """
 
     def __init__(self, timeout: float = 10.0,
-                 trace: Optional[Registry] = None) -> None:
+                 trace: Optional[Registry] = None,
+                 legacy_wire: bool = False) -> None:
         self._methods: Dict[str, Callable[..., Any]] = {}
         self._arity: Dict[str, Optional[int]] = {}
+        #: pack responses in the pre-str8/bin msgpack format old jubatus
+        #: clients understand (--legacy-wire; see rpc/legacy.py). Methods
+        #: registered with binary=True (mixer internals shipping packed
+        #: model bytes) keep the modern format — legacy clients never call
+        #: them, and old-raw would lose the str/bytes distinction for our
+        #: own peers.
+        self.legacy_wire = legacy_wire
+        self._binary_methods: set = set()
         self.timeout = timeout
         #: per-server span aggregates (multi-server processes must not
         #: merge each other's counters)
@@ -56,7 +65,11 @@ class RpcServer:
         self.port: Optional[int] = None
 
     # -- method table (≙ rpc_server::add<T>) --------------------------------
-    def register(self, name: str, fn: Callable[..., Any], arity: Optional[int] = None) -> None:
+    def register(self, name: str, fn: Callable[..., Any],
+                 arity: Optional[int] = None,
+                 binary: bool = False) -> None:
+        if binary:
+            self._binary_methods.add(name)
         if arity is None:
             try:
                 sig = inspect.signature(fn)
@@ -131,7 +144,12 @@ class RpcServer:
             t.start()
 
     def _conn_loop(self, conn: socket.socket) -> None:
-        unpacker = msgpack.Unpacker(raw=False, strict_map_key=False)
+        # surrogateescape: legacy clients pack datum binary_values as
+        # old-raw, which may not be UTF-8 — a decode error here would kill
+        # the connection with no error reply. Datum.from_msgpack re-encodes
+        # surrogate-bearing strings back to the exact original bytes.
+        unpacker = msgpack.Unpacker(raw=False, strict_map_key=False,
+                                    unicode_errors="surrogateescape")
         wlock = threading.Lock()
         try:
             while self._running:
@@ -163,7 +181,8 @@ class RpcServer:
 
     def _dispatch(self, conn, wlock, msgid, method, params) -> None:
         error, result = self._execute(method, params)
-        payload = build_response(msgid, error, result)
+        payload = build_response(msgid, error, result,
+                                 legacy=self.response_legacy(method))
         try:
             with wlock:
                 conn.sendall(payload)
@@ -198,10 +217,27 @@ class RpcServer:
         except Exception:  # noqa: BLE001
             log.debug("rpc notify %s raised", method, exc_info=True)
 
+    def response_legacy(self, method: str) -> bool:
+        """Whether this method's responses go out in the old wire format."""
+        return self.legacy_wire and method not in self._binary_methods
 
-def build_response(msgid: int, error: Any, result: Any) -> bytes:
-    """Pack one msgpack-rpc response message (shared by all transports)."""
-    return msgpack.packb([RESPONSE, msgid, error, result], default=_to_wire)
+
+def build_response(msgid: int, error: Any, result: Any,
+                   legacy: bool = False) -> bytes:
+    """Pack one msgpack-rpc response message (shared by all transports).
+
+    ``legacy=True`` packs in the pre-2013 format (no str8/bin type bytes:
+    strings and bytes both go out as old "raw") so the reference's vendored
+    msgpack — and therefore every deployed jubatus client — can parse it
+    (client/common/client.hpp:30-87 links that old library).
+    """
+    # surrogateescape mirrors the request-decode side: surrogate-bearing
+    # strings (legacy non-UTF8 raw admitted by the unpacker, e.g. stored
+    # as labels) must re-encode to their original bytes, not raise after
+    # dispatch with the client left hanging
+    return msgpack.packb([RESPONSE, msgid, error, result], default=_to_wire,
+                         use_bin_type=not legacy,
+                         unicode_errors="surrogateescape")
 
 
 def _to_wire(obj: Any) -> Any:

@@ -43,6 +43,10 @@ class ServerArgs:
     #: row-sharded signature tables for NN/recommender/anomaly hash
     #: methods
     shard_devices: int = 0
+    #: answer in the pre-str8/bin msgpack format deployed jubatus
+    #: clients require (their vendored msgpack predates those types);
+    #: mixer internals keep the modern format (rpc/legacy.py)
+    legacy_wire: bool = False
 
     @property
     def is_standalone(self) -> bool:
@@ -111,6 +115,10 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "single device): feature-sharded tables for linear "
                         "classifier/regression, row-sharded signature "
                         "tables for NN/recommender/anomaly hash methods")
+    p.add_argument("--legacy-wire", action="store_true",
+                   help="pack RPC responses in the pre-str8/bin msgpack "
+                        "format so unmodified legacy jubatus clients "
+                        "(vendored pre-2013 msgpack) can parse them")
     return p
 
 

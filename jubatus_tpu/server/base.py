@@ -67,7 +67,9 @@ class EngineServer:
         # JUBATUS_TPU_NATIVE_RPC=1 (rpc/native_server.py)
         from jubatus_tpu.rpc.native_server import create_rpc_server
 
-        self.rpc = create_rpc_server(timeout=self.args.timeout)
+        self.rpc = create_rpc_server(
+            timeout=self.args.timeout,
+            legacy_wire=getattr(self.args, "legacy_wire", False))
         self._stop_event = threading.Event()
         self._stop_once = threading.Lock()  # first stop() wins; rest no-op
 

@@ -54,6 +54,7 @@ class ProxyArgs:
     session_pool_expire: float = 60.0   # --pool_expire
     session_pool_size: int = 0          # --pool_size, 0 = unbounded
     daemon: bool = False
+    legacy_wire: bool = False           # --legacy-wire (see rpc/legacy.py)
 
     @property
     def bind_host(self) -> str:
@@ -125,7 +126,9 @@ class Proxy:
         # front-end when JUBATUS_TPU_NATIVE_RPC=1 (rpc/native_server.py)
         from jubatus_tpu.rpc.native_server import create_rpc_server
 
-        self.rpc = create_rpc_server(timeout=args.timeout)
+        self.rpc = create_rpc_server(
+            timeout=args.timeout,
+            legacy_wire=getattr(args, "legacy_wire", False))
         self.start_time = time.time()
         self._pool: Dict[Tuple[str, int], _Session] = {}
         self._pool_lock = threading.Lock()
@@ -322,6 +325,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--interconnect-timeout", type=float, default=10.0)
     p.add_argument("--pool-expire", dest="session_pool_expire", type=float, default=60.0)
     p.add_argument("--pool-size", dest="session_pool_size", type=int, default=0)
+    p.add_argument("--legacy-wire", action="store_true",
+                   help="pack responses in the pre-str8/bin msgpack format "
+                        "for unmodified legacy jubatus clients")
     ns = p.parse_args(argv)
     args = ProxyArgs(**{f.name: getattr(ns, f.name)
                         for f in dataclasses.fields(ProxyArgs)

@@ -1,0 +1,255 @@
+"""Legacy wire compatibility: an unmodified old jubatus client must parse
+every response (VERDICT round 1 gap — the reference's vendored msgpack
+predates str8/bin and REJECTS those type bytes;
+client/common/client.hpp:30-87).
+
+The "legacy client" here is a raw socket speaking old-format msgpack-rpc
+plus jubatus_tpu.rpc.legacy.unpackb — a faithful reimplementation of the
+pre-2013 unpacker including its rejection of post-2013 type bytes.
+"""
+
+from __future__ import annotations
+
+import socket
+
+import msgpack
+import pytest
+
+from jubatus_tpu.rpc import legacy
+from jubatus_tpu.rpc.server import RpcServer, build_response
+from jubatus_tpu.server import EngineServer
+from jubatus_tpu.server.args import ServerArgs
+
+NAME = "legacy"
+# get_config must round-trip a config whose JSON is far beyond 31 bytes —
+# the exact case that breaks old clients when packed as str8/raw-modern
+CLASSIFIER_CONF = {
+    "method": "AROW",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {
+        "string_rules": [
+            {"key": "*", "type": "str", "sample_weight": "bin",
+             "global_weight": "bin"}
+        ],
+        "num_rules": [{"key": "*", "type": "num"}],
+    },
+}
+
+
+class LegacyClient:
+    """Old-format msgpack-rpc: requests packed use_bin_type=False (raw
+    family only — byte-identical to what a pre-2013 client emits), responses
+    decoded with the legacy unpacker that rejects str8/bin/ext."""
+
+    def __init__(self, host, port):
+        self.sock = socket.create_connection((host, port), timeout=10)
+        self.msgid = 0
+        self.buf = b""
+
+    def close(self):
+        self.sock.close()
+
+    def call(self, method, *params):
+        self.msgid += 1
+        req = msgpack.packb([0, self.msgid, method, list(params)],
+                            use_bin_type=False)
+        self.sock.sendall(req)
+        return self._read_response()
+
+    def _read_response(self):
+        # frame by attempting a legacy decode over the accumulated bytes
+        while True:
+            if self.buf:
+                try:
+                    obj, off = legacy._decode(memoryview(self.buf), 0)
+                except legacy.LegacyFormatError as e:
+                    if "truncated" not in str(e):
+                        raise  # forbidden type byte — the actual assertion
+                else:
+                    self.buf = self.buf[off:]
+                    kind, msgid, error, result = obj
+                    assert kind == 1 and msgid == self.msgid
+                    if error is not None:
+                        raise RuntimeError(f"rpc error: {error!r}")
+                    return result
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("server closed")
+            self.buf += chunk
+
+
+def _datum(pairs_str, pairs_num):
+    # wire-format datum: ([[k, v]...], [[k, v]...])
+    return [list(map(list, pairs_str)), list(map(list, pairs_num))]
+
+
+@pytest.fixture()
+def legacy_server(tmp_path):
+    srv = EngineServer(
+        "classifier", CLASSIFIER_CONF,
+        args=ServerArgs(engine="classifier", legacy_wire=True,
+                        datadir=str(tmp_path)))
+    port = srv.start(0)
+    cli = LegacyClient("127.0.0.1", port)
+    yield cli, srv
+    cli.close()
+    srv.stop()
+
+
+def test_legacy_client_full_session(legacy_server):
+    """Every built-in + every classifier method parses under the old
+    unpacker — including >=32-byte strings (get_config, get_status)."""
+    cli, _srv = legacy_server
+    cfg = cli.call("get_config", NAME)
+    assert isinstance(cfg, bytes) and b"AROW" in cfg and len(cfg) > 32
+
+    n = cli.call("train", NAME, [
+        ["spam", _datum([["subject", "win money now"]], [])],
+        ["ham", _datum([["subject", "meeting at noon"]], [])],
+    ] * 5)
+    assert n == 10
+
+    res = cli.call("classify", NAME,
+                   [_datum([["subject", "win money now"]], [])])
+    # [[ [label, score], ... ]] — labels are old-raw bytes
+    labels = {lbl: score for lbl, score in res[0]}
+    assert b"spam" in labels and b"ham" in labels
+    assert labels[b"spam"] > labels[b"ham"]
+
+    labels = cli.call("get_labels", NAME)
+    assert set(labels) == {b"spam", b"ham"}
+    assert cli.call("set_label", NAME, "maybe") in (True, False)
+    assert cli.call("delete_label", NAME, "maybe") in (True, False)
+
+    st = cli.call("get_status", NAME)
+    (node_status,) = st.values()
+    assert b"classifier" == node_status[b"type"]
+    # flags maps contain >=32-byte strings (paths) — must arrive as raw
+    assert any(len(k) >= 32 or (isinstance(v, bytes) and len(v) >= 32)
+               for k, v in node_status.items())
+
+    paths = cli.call("save", NAME, "legacy_model")
+    assert all(v.endswith(b".jubatus") for v in paths.values())
+    assert cli.call("load", NAME, "legacy_model") is True
+    assert cli.call("do_mix", NAME) is False  # standalone: no mixer
+    assert cli.call("clear", NAME) is True
+
+
+def test_modern_mode_emits_str8_legacy_rejects():
+    """Sanity: without --legacy-wire the same response DOES contain type
+    bytes the old unpacker rejects (else the test above proves nothing)."""
+    long_s = "x" * 64
+    modern = build_response(1, None, long_s, legacy=False)
+    with pytest.raises(legacy.LegacyFormatError):
+        legacy.unpackb(modern)
+    old = build_response(1, None, long_s, legacy=True)
+    assert legacy.unpackb(old) == [1, 1, None, long_s.encode()]
+
+
+def test_binary_methods_keep_modern_format():
+    """Mixer internals ship packed bytes between OUR servers; they must
+    keep the modern bin type even under legacy_wire (old clients never
+    call them, and our peers need the str/bytes distinction)."""
+    srv = RpcServer(legacy_wire=True)
+    srv.register("mix_get_diff", lambda _n: b"\x00" * 40, binary=True)
+    srv.register("get_config", lambda _n: "y" * 40)
+    assert not srv.response_legacy("mix_get_diff")
+    assert srv.response_legacy("get_config")
+    payload = build_response(7, None, b"\x00" * 40,
+                             legacy=srv.response_legacy("mix_get_diff"))
+    out = msgpack.unpackb(payload, raw=False)
+    assert out[3] == b"\x00" * 40  # bin type survived
+
+
+def test_legacy_roundtrip_all_scalar_shapes():
+    """The legacy packer/unpacker pair covers the whole old type system."""
+    for v in [None, True, False, 0, 1, 127, 128, -1, -32, -33, 2**33,
+              -(2**33), 0.5, "", "short", "y" * 31, "z" * 32, "w" * 70000,
+              [1, [2, "three"]], {"k": [1.5, None]}, list(range(40))]:
+        buf = msgpack.packb(v, use_bin_type=False)
+        got = legacy.unpackb(buf)
+
+        def norm(x):
+            if isinstance(x, bytes):
+                return x.decode()
+            if isinstance(x, list):
+                return [norm(i) for i in x]
+            if isinstance(x, dict):
+                return {norm(k): norm(val) for k, val in x.items()}
+            return x
+        assert norm(got) == v
+
+
+def test_legacy_truncation_always_legacy_format_error():
+    """Every truncation point raises LegacyFormatError (never struct.error)
+    — the streaming framing loop keys on it to wait for more bytes."""
+    for v in [3.14, 2**40, -7, "y" * 300, [1, 2, [3, "four"]], {"k": 1.5}]:
+        buf = msgpack.packb(v, use_bin_type=False)
+        for cut in range(len(buf)):
+            with pytest.raises(legacy.LegacyFormatError):
+                legacy.unpackb(buf[:cut])
+
+
+def test_legacy_binary_datum_value_survives(legacy_server):
+    """A legacy client packing a non-UTF8 binary datum value as old-raw
+    must not kill the connection; the bytes must round-trip exactly
+    (code-review round 2 finding: UnicodeDecodeError closed the socket
+    with no reply)."""
+    cli, srv = legacy_server
+    blob = bytes(range(256))  # not valid UTF-8
+    n = cli.call("train", NAME, [
+        ["spam", [[["subject", "buy now"]], [], [["payload", blob]]]],
+    ])
+    assert n == 1
+    # the connection is still alive and the server decoded the datum
+    assert cli.call("get_labels", NAME)
+    # direct check that surrogateescape restored the exact bytes
+    from jubatus_tpu.core.datum import Datum
+    via_wire = blob.decode("utf-8", "surrogateescape")
+    d = Datum.from_msgpack([[["k", "v"]], [], [["bin", via_wire]]])
+    assert d.binary_values == [("bin", blob)]
+
+
+def test_legacy_surrogate_label_roundtrip(legacy_server):
+    """A legacy client may store a non-UTF8 label (old-raw); every later
+    response echoing it must re-encode to the ORIGINAL bytes, not raise
+    UnicodeEncodeError after dispatch (code-review finding: the client
+    would hang with no response)."""
+    cli, _srv = legacy_server
+    weird = b"\xff\xfelabel"
+    assert cli.call("set_label", NAME, weird) in (True, False)
+    labels = cli.call("get_labels", NAME)
+    assert weird in set(labels)
+
+
+def test_legacy_binary_datum_through_proxy():
+    """The binary-datum fix must survive the proxy hop: the proxy decodes
+    with surrogateescape and its forwarding client must re-encode the
+    original bytes (code-review finding: UnicodeEncodeError in
+    RpcClient.call was misclassified as a dead backend)."""
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+    store = _Store()
+    args = ServerArgs(engine="classifier", coordinator="(shared)",
+                      name=NAME, listen_addr="127.0.0.1",
+                      interval_sec=1e9, interval_count=1 << 30)
+    srv = EngineServer("classifier", CLASSIFIER_CONF, args,
+                       coord=MemoryCoordinator(store))
+    srv.start(0)
+    proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1",
+                            legacy_wire=True),
+                  coord=MemoryCoordinator(store))
+    proxy.start(0)
+    cli = LegacyClient("127.0.0.1", proxy.args.rpc_port)
+    try:
+        blob = bytes(range(256))
+        n = cli.call("train", NAME, [
+            ["spam", [[["subject", "buy now"]], [], [["payload", blob]]]],
+        ])
+        assert n == 1
+        assert cli.call("get_labels", NAME)  # proxy + backend still alive
+    finally:
+        cli.close()
+        proxy.stop()
+        srv.stop()
