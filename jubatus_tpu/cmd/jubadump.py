@@ -138,7 +138,11 @@ def dump_file(path: str, *, summary: bool = False,
         except json.JSONDecodeError:
             pass
     out["system"] = _jsonable(system, summary)
-    if not skip_user_data:
+    if usize == 0:
+        # sharded-checkpoint sidecars (system.jubatus) carry no user data;
+        # the model lives in the orbax state/ tree next to them
+        out["user_data"] = None
+    elif not skip_user_data:
         try:
             user_version, user_data = unpack_obj(body[ssize:ssize + usize])
         except Exception as e:  # noqa: BLE001

@@ -108,6 +108,13 @@ def save_sharded(
             "sharded": True,
             "pairing_token": token,
         })
+    if jax.process_count() > 1:
+        # barrier: no host may report the save complete (and let a reader
+        # observe state/ without its sidecar) before process 0 has written
+        # system.jubatus
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("jubatus_tpu:sharded_save")
 
 
 def load_sharded(

@@ -78,7 +78,12 @@ def _ring_topk(mesh, queries, blocks, local_scores, k: int, axis: str):
     pytrees whole). Returns (scores [B, k], global row ids [B, k]) with B
     sharded over ``axis``."""
     n_shards = mesh.shape[axis]
-    c_local = jax.tree_util.tree_leaves(blocks)[0].shape[0] // n_shards
+    c_total = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    if c_total % n_shards:
+        raise ValueError(
+            f"row count {c_total} not divisible by {n_shards} ring shards "
+            "(pad the table to a multiple and mask the padding rows)")
+    c_local = c_total // n_shards
     # never return more candidates than the table holds — padding slots
     # would carry +inf distance but a fabricated row id 0
     # (sharded_knn.sharded_hamming_topk clamps the same way)
@@ -155,14 +160,27 @@ def ring_euclid_topk(
     *,
     k: int,
     axis: str = "shard",
+    valid: Optional[jax.Array] = None,  # [C] bool, sharded over `axis`
 ) -> Tuple[jax.Array, jax.Array]:
     """Global top-k smallest euclidean distance over a sparse row table,
-    both operands sharded. Returns (distances [B, k], ids [B, k])."""
+    both operands sharded. Returns (distances [B, k], ids [B, k]).
+    ``valid`` masks dead/padding rows out (it rotates with the blocks),
+    mirroring ring_hamming_topk; masked-out slots surface as +inf."""
     from jubatus_tpu.ops import knn
 
-    def scores(q, blk):
-        idx, val = blk
-        return -jax.vmap(lambda q1: knn.euclid_distances(idx, val, q1))(q)
+    if valid is None:
+        def scores(q, blk):
+            idx, val = blk
+            return -jax.vmap(lambda q1: knn.euclid_distances(idx, val, q1))(q)
 
-    neg, gidx = _ring_topk(mesh, q_dense, (row_idx, row_val), scores, k, axis)
+        blocks = (row_idx, row_val)
+    else:
+        def scores(q, blk):
+            idx, val, v = blk
+            d = jax.vmap(lambda q1: knn.euclid_distances(idx, val, q1))(q)
+            return jnp.where(v[None, :], -d, -jnp.inf)
+
+        blocks = (row_idx, row_val, valid)
+
+    neg, gidx = _ring_topk(mesh, q_dense, blocks, scores, k, axis)
     return -neg, gidx

@@ -139,15 +139,17 @@ class Coalescer:
                 for t in tickets:
                     t.error = e
             finally:
-                self.flush_count += 1
-                self.item_count += len(batch)
+                with self._lock:
+                    self.flush_count += 1
+                    self.item_count += len(batch)
                 for t in tickets:
                     t.event.set()
 
     def stats(self) -> dict:
+        with self._lock:
+            flushes, items = self.flush_count, self.item_count
         return {
-            "flush_count": self.flush_count,
-            "item_count": self.item_count,
-            "avg_batch": (self.item_count / self.flush_count
-                          if self.flush_count else 0.0),
+            "flush_count": flushes,
+            "item_count": items,
+            "avg_batch": (items / flushes if flushes else 0.0),
         }

@@ -123,3 +123,20 @@ def test_genman_renders_all_pages(tmp_path):
         if ".SH DESCRIPTION" in txt:
             after = txt.split(".SH DESCRIPTION", 1)[1].lstrip().splitlines()
             assert after and not after[0].startswith(".SH")
+
+
+def test_dump_sharded_sidecar_reports_null_user_data(tmp_path, capsys):
+    """A sharded-checkpoint sidecar (usize == 0) is a valid dump target:
+    user_data must be null, not a spurious user_data_error (ADVICE r1)."""
+    import numpy as np
+
+    from jubatus_tpu.framework import sharded_checkpoint as sc
+
+    state = {"w": np.zeros((2, 8), np.float32)}
+    d = str(tmp_path / "ckpt")
+    sc.save_sharded(d, state, engine_type="classifier", model_id="s1",
+                    config=json.dumps(CLASSIFIER_CFG))
+    out = jubadump.dump_file(str(tmp_path / "ckpt" / "system.jubatus"))
+    assert "user_data_error" not in out
+    assert out["user_data"] is None
+    assert out["system"]["sharded"] is True

@@ -148,3 +148,43 @@ def test_ring_k_larger_than_local_block(mesh, rng):
         np.testing.assert_allclose(np.sort(d[b]), np.sort(dense[b])[:k],
                                    rtol=1e-6)
         assert len(set(gidx[b].tolist())) == k  # no duplicate winners
+
+
+def test_ring_euclid_valid_mask_hides_dead_rows(mesh, rng):
+    """Deleted/padding rows must never surface as finite euclid hits
+    (ADVICE round 1: ring_euclid_topk had no valid mask)."""
+    dim, nnz = 1 << 10, 6
+    B, C, k = 8, 32, 4
+    qi, qv = _sparse_rows(rng, B, nnz, dim)
+    ri, rv = _sparse_rows(rng, C, nnz, dim)
+    q_dense = jnp.stack([knn.densify(qi[b], qv[b], dim=dim) for b in range(B)])
+    valid = np.ones(C, bool)
+    valid[::3] = False
+
+    d, gidx = ring_euclid_topk(
+        mesh,
+        shard_rows(mesh, q_dense),
+        shard_rows(mesh, ri),
+        shard_rows(mesh, rv),
+        k=k,
+        valid=shard_rows(mesh, jnp.asarray(valid)),
+    )
+    d, gidx = np.asarray(d), np.asarray(gidx)
+    finite = np.isfinite(d)
+    assert valid[gidx[finite]].all(), "masked row surfaced as a finite hit"
+    for b in range(B):
+        dense = np.asarray(knn.euclid_distances(ri, rv, q_dense[b]))
+        want = np.sort(np.where(valid, dense, np.inf))[:k]
+        np.testing.assert_allclose(np.sort(d[b]), want, rtol=1e-5, atol=1e-5)
+
+
+def test_ring_rejects_indivisible_row_count(mesh, rng):
+    """C % shards != 0 must raise, not silently drop rows."""
+    dim, nnz, hash_num = 1 << 10, 4, 32
+    B, C = 8, 13  # 13 % 8 != 0
+    qi, qv = _sparse_rows(rng, B, nnz, dim)
+    ri, rv = _sparse_rows(rng, C, nnz, dim)
+    q_sigs = knn.lsh_signature(qi, qv, hash_num=hash_num)
+    row_sigs = knn.lsh_signature(ri, rv, hash_num=hash_num)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_hamming_topk(mesh, q_sigs, row_sigs, hash_num=hash_num, k=4)
