@@ -25,7 +25,7 @@ import numpy as np
 
 from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.core.fv import make_fv_converter
-from jubatus_tpu.core.sparse import SparseBatch
+from jubatus_tpu.core.sparse import SparseBatch, _bucket
 from jubatus_tpu.framework.driver import DriverBase, locked
 from jubatus_tpu.models.classifier_nn import NN_METHODS as _NN_METHODS
 from jubatus_tpu.ops import classifier as ops
@@ -198,6 +198,39 @@ class ClassifierDriver(DriverBase):
         )
         self.event_model_updated(len(data))
         return len(data)
+
+    @locked
+    def train_hashed(self, labels: Sequence[str], idx: np.ndarray,
+                     val: np.ndarray) -> int:
+        """Train on pre-hashed features (the native ingest fast path,
+        native/fast_ingest.cpp): ``idx``/``val`` are [B, K] arrays carrying
+        exactly what converter.convert would have produced. Bypasses the
+        converter entirely — callers must have established eligibility (no
+        idf/user global weights; jubatus_tpu/native/ingest.py gates)."""
+        if len(labels) == 0:
+            return 0
+        slots = [self._ensure_label(lb) for lb in labels]
+        for s in slots:
+            self._dcounts[s] += 1.0
+        b = idx.shape[0]
+        bsz = _bucket(b, 16)  # same shape buckets as the converter path
+        if bsz != b:
+            idx = np.pad(idx, ((0, bsz - b), (0, 0)))
+            val = np.pad(val, ((0, bsz - b), (0, 0)))
+        slots_arr = np.zeros(bsz, dtype=np.int32)
+        slots_arr[:len(slots)] = slots
+        self.state = ops.train_batch(
+            self.state,
+            jnp.asarray(idx),
+            jnp.asarray(val),
+            jnp.asarray(slots_arr),
+            self._mask(),
+            self.param,
+            method=self.method,
+            mode=self.train_mode,
+        )
+        self.event_model_updated(len(labels))
+        return len(labels)
 
     @locked
     def classify(self, data: Sequence[Datum]) -> List[List[Tuple[str, float]]]:

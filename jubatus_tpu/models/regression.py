@@ -16,7 +16,7 @@ import numpy as np
 
 from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.core.fv import make_fv_converter
-from jubatus_tpu.core.sparse import SparseBatch
+from jubatus_tpu.core.sparse import SparseBatch, _bucket
 from jubatus_tpu.framework.driver import DriverBase, locked
 from jubatus_tpu.ops import regression as ops
 
@@ -80,6 +80,33 @@ class RegressionDriver(DriverBase):
         )
         self.event_model_updated(len(data))
         return len(data)
+
+    @locked
+    def train_hashed(self, targets: np.ndarray, idx: np.ndarray,
+                     val: np.ndarray) -> int:
+        """Train on pre-hashed features (native ingest fast path); same
+        contract as ClassifierDriver.train_hashed with float targets."""
+        n = len(targets)
+        if n == 0:
+            return 0
+        b = idx.shape[0]
+        bsz = _bucket(b, 16)
+        if bsz != b:
+            idx = np.pad(idx, ((0, bsz - b), (0, 0)))
+            val = np.pad(val, ((0, bsz - b), (0, 0)))
+        tgt = np.zeros(bsz, dtype=np.float32)
+        tgt[:n] = targets
+        self.state = ops.train_batch(
+            self.state,
+            jnp.asarray(idx),
+            jnp.asarray(val),
+            jnp.asarray(tgt),
+            self.sensitivity,
+            self.c,
+            method=self.method,
+        )
+        self.event_model_updated(n)
+        return n
 
     @locked
     def estimate(self, data: Sequence[Datum]) -> List[float]:

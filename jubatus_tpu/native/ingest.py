@@ -1,0 +1,191 @@
+"""ctypes bindings for the native train-request parser (native/fast_ingest.cpp).
+
+``IngestParser`` turns the raw msgpack bytes of one train RPC
+([name, [[label, datum], ...]]) into the device kernel's input — padded
+int32/float32 [B, K] arrays + label strings — entirely in C++: no Datum
+objects, no per-feature Python strings, no GIL-held convert loop. The
+supported converter subset and the exact name/hash semantics are
+documented in the C++ file; ``from_converter_config`` decides eligibility
+and returns None when the config needs the Python converter.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from jubatus_tpu import native as nb
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_SRC = os.path.join(nb.NATIVE_DIR, "fast_ingest.cpp")
+_OUT = os.path.join(nb.BUILD_DIR, "libfast_ingest.so")
+
+
+class _Out(ctypes.Structure):
+    _fields_ = [
+        ("batch", ctypes.c_int32),
+        ("width", ctypes.c_int32),
+        ("labels_numeric", ctypes.c_int32),
+        ("idx", ctypes.POINTER(ctypes.c_int32)),
+        ("val", ctypes.POINTER(ctypes.c_float)),
+        ("labels", ctypes.POINTER(ctypes.c_uint8)),
+        ("label_off", ctypes.POINTER(ctypes.c_int32)),
+        ("targets", ctypes.POINTER(ctypes.c_float)),
+    ]
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        if nb._stale(_SRC, _OUT) and not nb._compile(_SRC, _OUT):
+            return None
+        try:
+            lib = ctypes.CDLL(_OUT)
+        except OSError:
+            return None
+        lib.jt_ingest_create.restype = ctypes.c_void_p
+        lib.jt_ingest_create.argtypes = [ctypes.c_char_p]
+        lib.jt_ingest_destroy.restype = None
+        lib.jt_ingest_destroy.argtypes = [ctypes.c_void_p]
+        lib.jt_ingest_parse.restype = ctypes.c_int
+        lib.jt_ingest_parse.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_uint32, ctypes.POINTER(_Out)]
+        lib.jt_ingest_free_out.restype = None
+        lib.jt_ingest_free_out.argtypes = [ctypes.POINTER(_Out)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def spec_from_converter_config(conv: dict) -> Optional[str]:
+    """Compile a converter config into the C++ rule spec, or None when the
+    config needs features the native parser does not implement (filters,
+    idf/user weights, plugins, ngram/regexp splitters, combinations,
+    binary rules) — the caller then stays on the Python converter."""
+    if not isinstance(conv, dict):
+        return None
+    for k in ("string_filter_rules", "num_filter_rules", "binary_rules",
+              "combination_rules", "binary_types"):
+        if conv.get(k):
+            return None
+    # type tables: only builtin names usable (no method params needed)
+    str_types = {"str": "str", "space": "space"}
+    for tname, params in (conv.get("string_types") or {}).items():
+        method = (params or {}).get("method")
+        if method in ("str", "space"):
+            str_types[tname] = method
+        else:
+            str_types[tname] = None  # unsupported; rules using it bail
+    num_types = {"num": "num", "log": "log", "str": "str"}
+    for tname, params in (conv.get("num_types") or {}).items():
+        method = (params or {}).get("method")
+        if method in ("num", "log", "str"):
+            num_types[tname] = method
+        else:
+            num_types[tname] = None
+    lines: List[str] = []
+    for r in conv.get("num_rules") or []:
+        kind = num_types.get(r.get("type"))
+        if kind is None:
+            return None
+        lines.append(f"num\t{kind}\t{r.get('key', '*')}")
+    for r in conv.get("string_rules") or []:
+        split = str_types.get(r.get("type"))
+        if split is None:
+            return None
+        sw = r.get("sample_weight", "bin")
+        gw = r.get("global_weight", "bin")
+        if sw not in ("bin", "tf", "log_tf") or gw != "bin":
+            return None
+        lines.append(f"str\t{split}\t{sw}\t{gw}\t{r.get('type')}\t"
+                     f"{r.get('key', '*')}")
+    if not lines:
+        return None
+    for ln in lines:  # keys with separators would corrupt the spec
+        if "\n" in ln.replace("\t", " ") or ln.count("\t") > 5:
+            return None
+    return "\n".join(lines)
+
+
+class IngestParser:
+    """One immutable parser handle per (converter config, dim)."""
+
+    def __init__(self, spec: str, dim_bits: int) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native ingest unavailable")
+        self._lib = lib
+        self._mask = (1 << dim_bits) - 1
+        self._handle = lib.jt_ingest_create(spec.encode())
+        if not self._handle:
+            raise ValueError(f"native ingest rejected spec: {spec!r}")
+
+    @classmethod
+    def from_converter_config(cls, conv: dict,
+                              dim_bits: int) -> Optional["IngestParser"]:
+        spec = spec_from_converter_config(conv)
+        if spec is None or not available():
+            return None
+        try:
+            return cls(spec, dim_bits)
+        except (ValueError, RuntimeError):
+            return None
+
+    def parse(self, raw: bytes):
+        """Raw train params msgpack -> (labels, idx [B,K] i32, val [B,K] f32).
+
+        ``labels`` is a list of strings (classifier) or a float32 array
+        (regression targets — numeric first slot on the wire). None when
+        the wire shape is not the expected train format (caller falls back
+        to the generic decode path)."""
+        out = _Out()
+        rc = self._lib.jt_ingest_parse(self._handle, raw, len(raw),
+                                       self._mask, ctypes.byref(out))
+        if rc != 0:
+            return None
+        try:
+            b, w = out.batch, out.width
+            idx = np.ctypeslib.as_array(out.idx, shape=(b, w)).copy() \
+                if b else np.zeros((0, 8), np.int32)
+            val = np.ctypeslib.as_array(out.val, shape=(b, w)).copy() \
+                if b else np.zeros((0, 8), np.float32)
+            if out.labels_numeric:
+                labels = np.ctypeslib.as_array(
+                    out.targets, shape=(b,)).copy() if b else \
+                    np.zeros(0, np.float32)
+            else:
+                offs = np.ctypeslib.as_array(out.label_off, shape=(b + 1,))
+                blob = bytes(np.ctypeslib.as_array(
+                    out.labels, shape=(max(int(offs[-1]), 1),)))
+                labels = [
+                    blob[offs[i]:offs[i + 1]].decode("utf-8",
+                                                     "surrogateescape")
+                    for i in range(b)
+                ]
+        finally:
+            self._lib.jt_ingest_free_out(ctypes.byref(out))
+        return labels, idx, val
+
+    def __del__(self):  # noqa: D105
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.jt_ingest_destroy(self._handle)
+                self._handle = None
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

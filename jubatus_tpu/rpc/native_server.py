@@ -92,6 +92,7 @@ class NativeRpcServer:
         self._arity: Dict[str, Optional[int]] = {}
         self.legacy_wire = legacy_wire
         self._binary_methods: set = set()
+        self._raw_methods: Dict[str, Callable[[bytes], Any]] = {}
         self.timeout = timeout
         self.trace = trace or Registry()
         self.port: Optional[int] = None
@@ -105,9 +106,11 @@ class NativeRpcServer:
 
     # -- method table (same contract as RpcServer.register) ------------------
     register = RpcServer.register
+    register_raw = RpcServer.register_raw
     method_names = RpcServer.method_names
     _invoke = RpcServer._invoke
     _execute = RpcServer._execute
+    _execute_fast = RpcServer._execute_fast
     response_legacy = RpcServer.response_legacy
 
     # -- C++ → Python dispatch ------------------------------------------------
@@ -133,6 +136,15 @@ class NativeRpcServer:
 
     def _dispatch(self, conn_id: int, msgid: int, method: str,
                   raw: bytes) -> None:
+        # raw fast path: the C++ front-end already isolated the params
+        # span; registered raw handlers consume it without Python decode
+        if method in self._raw_methods and msgid != self._NOTIFY:
+            error, result = self._execute_fast(method, raw)
+            payload = build_response(msgid, error, result,
+                                     legacy=self.response_legacy(method))
+            self._lib.jt_rpc_respond(self._handle, conn_id, payload,
+                                     len(payload))
+            return
         try:
             params = msgpack.unpackb(raw, raw=False, strict_map_key=False,
                                      use_list=True,

@@ -33,6 +33,44 @@ log = logging.getLogger(__name__)
 
 REQUEST, RESPONSE, NOTIFY = 0, 1, 2
 
+#: sentinel a raw-registered handler returns to decline the fast path —
+#: the request is then decoded generically and served by the normal handler
+RAW_FALLBACK = object()
+
+
+def _parse_envelope(raw: bytes):
+    """Request envelope without decoding params: ``[0, msgid, method, ...]``
+    -> (msgid, method, params_offset), or None for anything else (notify,
+    malformed, exotic headers) — those take the generic decode path."""
+    try:
+        if raw[0] != 0x94 or raw[1] != 0x00:  # fixarray(4), REQUEST
+            return None
+        i = 2
+        t = raw[i]
+        if t <= 0x7F:
+            msgid, i = t, i + 1
+        elif t == 0xCC:
+            msgid, i = raw[i + 1], i + 2
+        elif t == 0xCD:
+            msgid, i = int.from_bytes(raw[i + 1:i + 3], "big"), i + 3
+        elif t == 0xCE:
+            msgid, i = int.from_bytes(raw[i + 1:i + 5], "big"), i + 5
+        else:
+            return None
+        t = raw[i]
+        if 0xA0 <= t <= 0xBF:  # fixstr/fixraw
+            n, i = t & 0x1F, i + 1
+        elif t == 0xD9:        # str8
+            n, i = raw[i + 1], i + 2
+        elif t == 0xDA:        # raw16/str16
+            n, i = int.from_bytes(raw[i + 1:i + 3], "big"), i + 3
+        else:
+            return None
+        method = raw[i:i + n].decode("utf-8", "surrogateescape")
+        return msgid, method, i + n
+    except IndexError:
+        return None
+
 
 class RpcServer:
     """Dispatcher + listener. register() then listen() then start().
@@ -54,6 +92,10 @@ class RpcServer:
         #: own peers.
         self.legacy_wire = legacy_wire
         self._binary_methods: set = set()
+        #: raw-span fast paths: method -> fn(raw_params bytes) -> result
+        #: (or RAW_FALLBACK to decode generically). Served straight off the
+        #: wire framing without building Python param objects.
+        self._raw_methods: Dict[str, Callable[[bytes], Any]] = {}
         self.timeout = timeout
         #: per-server span aggregates (multi-server processes must not
         #: merge each other's counters)
@@ -89,6 +131,14 @@ class RpcServer:
                 arity = None
         self._methods[name] = fn
         self._arity[name] = arity
+
+    def register_raw(self, name: str, fn: Callable[[bytes], Any]) -> None:
+        """Fast path for ``name``: ``fn`` receives the request's raw params
+        msgpack bytes (no Python decode) and returns the result — or
+        ``RAW_FALLBACK`` to route the request through the generic decode +
+        registered handler (e.g. a wire shape the native parser rejects).
+        The generic handler must also be registered (fallback + arity)."""
+        self._raw_methods[name] = fn
 
     def method_names(self):
         return sorted(self._methods)
@@ -144,21 +194,37 @@ class RpcServer:
             t.start()
 
     def _conn_loop(self, conn: socket.socket) -> None:
-        # surrogateescape: legacy clients pack datum binary_values as
-        # old-raw, which may not be UTF-8 — a decode error here would kill
-        # the connection with no error reply. Datum.from_msgpack re-encodes
-        # surrogate-bearing strings back to the exact original bytes.
-        unpacker = msgpack.Unpacker(raw=False, strict_map_key=False,
-                                    unicode_errors="surrogateescape")
+        # Frame messages by span (Unpacker.skip + tell — C-speed, builds
+        # no objects), keep a mirror of the bytes, and decode per message:
+        # raw-registered methods get the params span directly (zero Python
+        # object churn on the hot path); everything else goes through one
+        # unpackb. surrogateescape: legacy clients pack datum binary_values
+        # as old-raw, which may not be UTF-8 — a decode error must not kill
+        # the connection. Datum.from_msgpack re-encodes surrogate-bearing
+        # strings back to the exact original bytes.
+        framer = msgpack.Unpacker()
+        buf = bytearray()
+        base = 0       # stream offset of buf[0]
+        msg_start = 0  # stream offset of the next undelivered message
         wlock = threading.Lock()
         try:
             while self._running:
                 data = conn.recv(65536)
                 if not data:
                     return
-                unpacker.feed(data)
-                for msg in unpacker:
-                    self._handle(conn, wlock, msg)
+                framer.feed(data)
+                buf += data
+                while True:
+                    try:
+                        framer.skip()
+                    except msgpack.OutOfData:
+                        break
+                    end = framer.tell()
+                    raw = bytes(buf[msg_start - base:end - base])
+                    msg_start = end
+                    self._handle_raw(conn, wlock, raw)
+                del buf[:msg_start - base]
+                base = msg_start
         except (OSError, ValueError, struct.error):
             pass
         finally:
@@ -166,6 +232,54 @@ class RpcServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _handle_raw(self, conn: socket.socket, wlock: threading.Lock,
+                    raw: bytes) -> None:
+        env = _parse_envelope(raw)
+        if env is not None:
+            msgid, method, off = env
+            if method in self._raw_methods and self._pool is not None:
+                self._pool.submit(self._dispatch_fast, conn, wlock, msgid,
+                                  method, raw[off:])
+                return
+        msg = msgpack.unpackb(raw, raw=False, strict_map_key=False,
+                              use_list=True,
+                              unicode_errors="surrogateescape")
+        self._handle(conn, wlock, msg)
+
+    def _dispatch_fast(self, conn, wlock, msgid, method,
+                       raw_params: bytes) -> None:
+        error, result = self._execute_fast(method, raw_params)
+        payload = build_response(msgid, error, result,
+                                 legacy=self.response_legacy(method))
+        try:
+            with wlock:
+                conn.sendall(payload)
+        except OSError:
+            pass
+
+    def _execute_fast(self, method: str, raw_params: bytes):
+        """Raw-span invoke; falls back to the generic decode + handler when
+        the fast fn declines (RAW_FALLBACK). The trace span is recorded
+        here only when the fast path served the request — fallbacks are
+        counted once, by _invoke's span."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            result = self._raw_methods[method](raw_params)
+            if result is not RAW_FALLBACK:
+                self.trace.record(f"rpc.{method}",
+                                  _time.perf_counter() - t0)
+                return None, result
+        except Exception as e:  # noqa: BLE001 — every failure must answer
+            log.debug("rpc raw method %s raised", method, exc_info=True)
+            self.trace.record(f"rpc.{method}", _time.perf_counter() - t0)
+            return error_to_wire(e), None
+        params = msgpack.unpackb(raw_params, raw=False, strict_map_key=False,
+                                 use_list=True,
+                                 unicode_errors="surrogateescape")
+        return self._execute(method, params)
 
     def _handle(self, conn: socket.socket, wlock: threading.Lock, msg: Any) -> None:
         if not isinstance(msg, (list, tuple)) or not msg:

@@ -118,11 +118,86 @@ def _register_train(rpc: RpcServer, server: Any, decode_pair,
     rpc.register("train", train, arity=2)
 
 
+def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
+    """Native ingest fast path for ``train`` (native/fast_ingest.cpp): the
+    request's raw msgpack params parse in C++ straight to pre-hashed [B, K]
+    arrays — no Datum objects, no Python convert loop. Registered only
+    when the transport exposes raw spans, the driver has ``train_hashed``,
+    and the converter config is expressible in the native parser
+    (jubatus_tpu/native/ingest.py gates); any request the parser declines
+    (unexpected wire shape, unrepresentable values) falls back to the
+    generic decode + converter path, so behavior is identical either way."""
+    import json as _json
+
+    driver = server.driver
+    if not hasattr(rpc, "register_raw") or not hasattr(driver, "train_hashed"):
+        return
+    try:
+        from jubatus_tpu.native.ingest import IngestParser
+
+        conv = _json.loads(server.config_json).get("converter")
+        parser = IngestParser.from_converter_config(
+            conv, driver.converter.hasher.dim_bits)
+    except Exception:  # noqa: BLE001 — fast path is strictly optional
+        return
+    if parser is None:
+        return
+    from jubatus_tpu.rpc.server import RAW_FALLBACK
+
+    import numpy as np
+
+    def flush_rows(rows):
+        if not rows:
+            return 0
+        kmax = max(r[1].shape[0] for r in rows)
+        b = len(rows)
+        idx = np.zeros((b, kmax), np.int32)
+        val = np.zeros((b, kmax), np.float32)
+        for i, (_lb, ir, vr) in enumerate(rows):
+            idx[i, :ir.shape[0]] = ir
+            val[i, :vr.shape[0]] = vr
+        if numeric:
+            labels = np.asarray([r[0] for r in rows], np.float32)
+        else:
+            labels = [r[0] for r in rows]
+        return driver.train_hashed(labels, idx, val)
+
+    flush = _updating(server, flush_rows, count=lambda r: r)
+    max_batch = getattr(server.args, "microbatch_max", 8192)
+    wait_s = server.args.timeout * 6 if server.args.timeout > 0 else None
+    if max_batch:
+        from jubatus_tpu.server.microbatch import Coalescer
+
+        co = Coalescer(flush, max_batch=max_batch)
+        server.coalescers["train_raw"] = co
+
+    def train_raw(raw_params: bytes):
+        parsed = parser.parse(raw_params)
+        if parsed is None:
+            return RAW_FALLBACK
+        labels, idx, val = parsed
+        if numeric != isinstance(labels, np.ndarray):
+            return RAW_FALLBACK  # label kind mismatch: let the generic
+            # path produce the proper type error
+        n = len(labels)
+        if n == 0:
+            return 0
+        rows = [(labels[i], idx[i], val[i]) for i in range(n)]
+        if max_batch:
+            co.submit(rows, timeout=wait_s)
+        else:
+            flush(rows)
+        return n
+
+    rpc.register_raw("train", train_raw)
+
+
 @_binder("classifier")
 def _bind_classifier(rpc: RpcServer, server: Any) -> None:
     d = server.driver
     _register_train(rpc, server,
                     lambda p: (p[0], _datum(p[1])), d.train)
+    _register_train_raw(rpc, server, numeric=False)
     rpc.register(
         "classify",
         lambda name, data: [_scored(r) for r in d.classify(_datums(data))],
@@ -139,6 +214,7 @@ def _bind_regression(rpc: RpcServer, server: Any) -> None:
     d = server.driver
     _register_train(rpc, server,
                     lambda p: (float(p[0]), _datum(p[1])), d.train)
+    _register_train_raw(rpc, server, numeric=True)
     rpc.register(
         "estimate",
         lambda name, data: [float(x) for x in d.estimate(_datums(data))],

@@ -1,0 +1,318 @@
+"""Native ingest fast path (native/fast_ingest.cpp + rpc raw spans).
+
+The C++ parser must be BIT-IDENTICAL to the Python converter pipeline
+(feature names, crc32 hashing, dedupe/sort, f64 accumulation -> f32) —
+these tests fuzz that parity and drive the full server fast path,
+including fallback behavior for wire shapes the parser declines.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import msgpack
+import numpy as np
+import pytest
+
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.core.fv.converter import make_fv_converter
+from jubatus_tpu.native import ingest
+
+pytestmark = pytest.mark.skipif(
+    not ingest.available(), reason="native toolchain unavailable")
+
+MIXED_CONV = {
+    "string_rules": [
+        {"key": "*", "type": "space", "sample_weight": "tf",
+         "global_weight": "bin"},
+        {"key": "s*", "type": "str", "sample_weight": "bin",
+         "global_weight": "bin"},
+    ],
+    "num_rules": [
+        {"key": "*", "type": "num"},
+        {"key": "n*", "type": "log"},
+        {"key": "*", "type": "str"},
+    ],
+}
+
+
+def _rand_datum(rng):
+    words = ["win", "money", "now", "meet", "lunch", "café", "日本語", ""]
+    sv = [(rng.choice(["subject", "sbody", "txt"]),
+           " ".join(rng.choice(words) for _ in range(rng.randint(0, 6))))
+          for _ in range(rng.randint(0, 3))]
+    nv = [(rng.choice(["n1", "num2", "f3"]),
+           rng.choice([0.0, 1.0, -2.5, 3.25, 7, 123456, 0.1, 1e16,
+                       -0.0001, rng.uniform(-10, 10)]))
+          for _ in range(rng.randint(0, 4))]
+    return Datum(string_values=sv, num_values=nv)
+
+
+def _expected(pyconv, datum):
+    return [(int(a), float(np.float32(b))) for a, b in pyconv.convert(datum)]
+
+
+def _got(idx_row, val_row):
+    return [(int(a), float(b)) for a, b in zip(idx_row, val_row) if a != 0]
+
+
+def test_parity_mixed_workload():
+    p = ingest.IngestParser(
+        ingest.spec_from_converter_config(MIXED_CONV), 20)
+    pyconv = make_fv_converter(MIXED_CONV, dim_bits=20)
+    rng = random.Random(7)
+    data = [("lab%d" % rng.randint(0, 3), _rand_datum(rng))
+            for _ in range(400)]
+    raw = msgpack.packb(["c", [[l, d.to_msgpack()] for l, d in data]])
+    labels, idx, val = p.parse(raw)
+    for i, (l, d) in enumerate(data):
+        assert labels[i] == l
+        assert _got(idx[i], val[i]) == _expected(pyconv, d), (i, l)
+
+
+def test_parity_legacy_wire_and_num_formats():
+    conv = {"num_rules": [{"key": "*", "type": "str"}],
+            "string_rules": [{"key": "*", "type": "space",
+                              "sample_weight": "log_tf",
+                              "global_weight": "bin"}]}
+    p = ingest.IngestParser(ingest.spec_from_converter_config(conv), 18)
+    pyconv = make_fv_converter(conv, dim_bits=18)
+    vals = [0.0, -0.0, 1.0, -1.0, 0.5, -0.0001, 0.0001, 1e-5, -1e-5, 1e16,
+            1e15 + 0.5, 123456789.125, 3.141592653589793, 2.5e-10, 9.9e15,
+            1.00000000001, 1e16 + 2.0, 4.5e18]
+    rng = random.Random(9)
+    vals += [rng.uniform(-1, 1) * 10 ** rng.randint(-15, 15)
+             for _ in range(200)]
+    data = [("x", Datum(num_values=[("k", v)],
+                        string_values=[("t", "a b b a")])) for v in vals]
+    for use_bin in (True, False):  # modern + legacy request wire
+        raw = msgpack.packb(["c", [[l, d.to_msgpack()] for l, d in data]],
+                            use_bin_type=use_bin)
+        labels, idx, val = p.parse(raw)
+        for i, (_, d) in enumerate(data):
+            assert _got(idx[i], val[i]) == _expected(pyconv, d), vals[i]
+
+
+def test_numeric_targets_regression_wire():
+    conv = {"num_rules": [{"key": "*", "type": "num"}]}
+    p = ingest.IngestParser(ingest.spec_from_converter_config(conv), 16)
+    data = [[1.5, Datum({"x": 2.0}).to_msgpack()],
+            [-0.25, Datum({"x": -1.0}).to_msgpack()]]
+    labels, idx, val = p.parse(msgpack.packb(["c", data]))
+    assert isinstance(labels, np.ndarray)
+    np.testing.assert_allclose(labels, [1.5, -0.25])
+    assert idx.shape == (2, 8)
+
+
+def test_huge_integral_and_mixed_labels_fall_back():
+    conv = {"num_rules": [{"key": "*", "type": "str"}]}
+    p = ingest.IngestParser(ingest.spec_from_converter_config(conv), 16)
+    raw = msgpack.packb(
+        ["c", [["x", Datum(num_values=[("k", 1e100)]).to_msgpack()]]])
+    assert p.parse(raw) is None  # str(int(1e100)) not reproducible in C++
+    mixed = msgpack.packb(
+        ["c", [["x", Datum({"k": 1.0}).to_msgpack()],
+               [3, Datum({"k": 1.0}).to_msgpack()]]])
+    assert p.parse(mixed) is None  # mixed label kinds
+
+
+def test_spec_rejects_unsupported_configs():
+    assert ingest.spec_from_converter_config(None) is None
+    assert ingest.spec_from_converter_config({}) is None
+    # idf global weight needs WeightManager state
+    assert ingest.spec_from_converter_config({
+        "string_rules": [{"key": "*", "type": "space",
+                          "sample_weight": "bin",
+                          "global_weight": "idf"}]}) is None
+    # filters change the datum before rules run
+    assert ingest.spec_from_converter_config({
+        "num_rules": [{"key": "*", "type": "num"}],
+        "num_filter_rules": [{"key": "*", "type": "x", "suffix": "y"}],
+    }) is None
+    # combination rules compose features
+    assert ingest.spec_from_converter_config({
+        "num_rules": [{"key": "*", "type": "num"}],
+        "combination_rules": [{"key_left": "*", "key_right": "*",
+                               "type": "mul"}]}) is None
+    # ngram splitters are unsupported (utf-8 code-point slicing)
+    assert ingest.spec_from_converter_config({
+        "string_types": {"bigram": {"method": "ngram", "char_num": "2"}},
+        "string_rules": [{"key": "*", "type": "bigram",
+                          "sample_weight": "bin",
+                          "global_weight": "bin"}]}) is None
+
+
+# -- server integration -------------------------------------------------------
+
+SERVER_CONV = {
+    "method": "AROW",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {
+        "string_rules": [{"key": "*", "type": "space",
+                          "sample_weight": "bin", "global_weight": "bin"}],
+        "num_rules": [{"key": "*", "type": "num"}],
+    },
+}
+
+
+def _train_data():
+    return [["spam", Datum({"t": "win money now", "n": 1.0})],
+            ["ham", Datum({"t": "meet at noon", "n": -1.0})]] * 8
+
+
+def test_server_fast_path_matches_converter_path():
+    """The same traffic through the fast server and a converter-only
+    server must produce identical models (classify scores equal)."""
+    from jubatus_tpu.client import ClassifierClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    fast = EngineServer("classifier", SERVER_CONV,
+                        args=ServerArgs(engine="classifier"))
+    fast_port = fast.start(0)
+    slow = EngineServer("classifier", SERVER_CONV,
+                        args=ServerArgs(engine="classifier"))
+    slow_port = slow.start(0)
+    slow.rpc._raw_methods.clear()  # force the converter path
+    try:
+        with ClassifierClient("127.0.0.1", fast_port, "t") as cf, \
+                ClassifierClient("127.0.0.1", slow_port, "t") as cs:
+            assert cf.train(_train_data()) == 16
+            assert cs.train(_train_data()) == 16
+            probe = [Datum({"t": "win money", "n": 0.5})]
+            (rf,), (rs,) = cf.classify(probe), cs.classify(probe)
+            assert sorted(rf) == sorted(rs)
+        st = next(iter(fast.get_status().values()))
+        assert st["microbatch.train_raw.item_count"] == 16
+        assert st["microbatch.train.item_count"] == 0
+        st2 = next(iter(slow.get_status().values()))
+        assert st2["microbatch.train.item_count"] == 16
+    finally:
+        fast.stop()
+        slow.stop()
+
+
+def test_server_fast_path_regression():
+    from jubatus_tpu.client import RegressionClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    conf = {"method": "PA", "parameter": {"sensitivity": 0.1,
+                                          "regularization_weight": 1.0},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    srv = EngineServer("regression", conf,
+                       args=ServerArgs(engine="regression"))
+    port = srv.start(0)
+    try:
+        with RegressionClient("127.0.0.1", port, "t") as c:
+            data = [[float(2 * x), Datum({"x": float(x)})]
+                    for x in range(-8, 9)] * 4
+            assert c.train(data) == len(data)
+            (est,) = c.estimate([Datum({"x": 3.0})])
+            assert 2.0 < est < 10.0
+        st = next(iter(srv.get_status().values()))
+        assert st["microbatch.train_raw.item_count"] == len(data) * 1
+    finally:
+        srv.stop()
+
+
+def test_server_ineligible_config_uses_converter_path():
+    """An idf config must keep the converter path (no raw registration)."""
+    from jubatus_tpu.client import ClassifierClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    conf = {"method": "PA", "parameter": {},
+            "converter": {"string_rules": [
+                {"key": "*", "type": "space", "sample_weight": "tf",
+                 "global_weight": "idf"}]}}
+    srv = EngineServer("classifier", conf,
+                       args=ServerArgs(engine="classifier"))
+    port = srv.start(0)
+    try:
+        assert "train" not in srv.rpc._raw_methods
+        with ClassifierClient("127.0.0.1", port, "t") as c:
+            assert c.train([["a", Datum({"t": "x y"})],
+                            ["b", Datum({"t": "y z"})]]) == 2
+        st = next(iter(srv.get_status().values()))
+        assert st["microbatch.train.item_count"] == 2
+    finally:
+        srv.stop()
+
+
+def test_server_fallback_on_undecodable_fast_wire():
+    """A train request whose first slot kind defies the engine (numeric
+    label on a classifier) must fall back to the generic path and behave
+    exactly as before the fast path existed."""
+    from jubatus_tpu.client import ClassifierClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    srv = EngineServer("classifier", SERVER_CONV,
+                       args=ServerArgs(engine="classifier"))
+    port = srv.start(0)
+    try:
+        with ClassifierClient("127.0.0.1", port, "t") as c:
+            n = c.client.call("train", "t", [[3, Datum({"n": 1.0}).to_msgpack()],
+                                             [4, Datum({"n": -1.0}).to_msgpack()]])
+            assert n == 2  # generic path accepts any hashable label
+            labels = c.get_labels()
+            assert set(labels) == {3, 4}
+    finally:
+        srv.stop()
+
+
+def test_hostile_lengths_error_not_abort():
+    """A tiny request claiming 2^32 array elements must return a parse
+    error (-> RPC error reply), never bad_alloc/terminate (code-review:
+    the pre-allocation aborted the whole server)."""
+    conv = {"num_rules": [{"key": "*", "type": "num"}]}
+    p = ingest.IngestParser(ingest.spec_from_converter_config(conv), 16)
+    # [name, [[label, [sv_claiming_4B_pairs ...]]]]
+    hostile = (b"\x92\xa1c\x91\x92\xa1x\x92"
+               b"\xdd\xff\xff\xff\xff")  # array32 len 0xffffffff, no body
+    assert p.parse(hostile) is None
+    hostile2 = b"\x92\xa1c\x91\x92\xa1x\x92\x90\xdd\xff\xff\xff\xff"
+    assert p.parse(hostile2) is None
+    # the handle still works afterwards
+    ok = msgpack.packb(["c", [["x", Datum({"k": 1.0}).to_msgpack()]]])
+    assert p.parse(ok) is not None
+
+
+def test_unicode_whitespace_tokenizes_like_python():
+    """str.split() splits on Unicode whitespace; the fast path must hash
+    the same tokens (code-review: isspace over bytes diverged on NBSP,
+    U+3000, \\x1c — silently different models per path)."""
+    conv = {"string_rules": [{"key": "*", "type": "space",
+                              "sample_weight": "tf", "global_weight": "bin"}]}
+    p = ingest.IngestParser(ingest.spec_from_converter_config(conv), 20)
+    pyconv = make_fv_converter(conv, dim_bits=20)
+    texts = ["a\x1cb", "a\xa0b", "a　b", "a b c", "x\x85y",
+             " lead", "trail ", "mixed \t 　 runs",
+             "café\xa0日本語", "plain space only"]
+    data = [("t", Datum(string_values=[("k", s)])) for s in texts]
+    raw = msgpack.packb(["c", [[l, d.to_msgpack()] for l, d in data]])
+    labels, idx, val = p.parse(raw)
+    for i, (_, d) in enumerate(data):
+        assert _got(idx[i], val[i]) == _expected(pyconv, d), repr(texts[i])
+
+
+def test_fallback_counts_trace_span_once():
+    """A RAW_FALLBACK request must appear once in trace.rpc.<m>.count
+    (code-review: fast attempt + generic invoke double-counted)."""
+    from jubatus_tpu.client import ClassifierClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    srv = EngineServer("classifier", SERVER_CONV,
+                       args=ServerArgs(engine="classifier"))
+    port = srv.start(0)
+    try:
+        with ClassifierClient("127.0.0.1", port, "t") as c:
+            # numeric labels -> parser declines -> generic path
+            c.client.call("train", "t", [[3, Datum({"n": 1.0}).to_msgpack()]])
+            (st,) = c.get_status().values()
+        assert st["trace.rpc.train.count"] == 1
+    finally:
+        srv.stop()
