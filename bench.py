@@ -141,6 +141,25 @@ def main():
     bi, bv, bl = make_data(rng, BASELINE_EXAMPLES)
     base_sps = numpy_arow_per_example(bi, bv, bl)
 
+    # --- mix plane (VERDICT r1 item 4: round time + bytes vs the <=1 s
+    # --- north star, like linear_mixer.cpp:553-558 logs) ---
+    extra = {}
+    try:
+        import bench_mix
+
+        extra.update(bench_mix.collect(dev))
+    except Exception as e:  # noqa: BLE001 — headline must still print
+        extra["mix_error"] = repr(e)[:200]
+
+    # --- end-to-end serving path (VERDICT r1 item 2: the product, not the
+    # --- kernel: RPC decode -> datum -> fv convert -> device) ---
+    try:
+        import bench_serving
+
+        extra.update(bench_serving.collect())
+    except Exception as e:  # noqa: BLE001
+        extra["e2e_error"] = repr(e)[:200]
+
     print(
         json.dumps(
             {
@@ -148,6 +167,7 @@ def main():
                 "value": round(tpu_sps, 1),
                 "unit": "samples/s",
                 "vs_baseline": round(tpu_sps / base_sps, 2),
+                "extra": extra,
             }
         )
     )

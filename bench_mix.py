@@ -1,0 +1,168 @@
+"""Mix-plane benchmark: one mix round on Criteo-shaped diffs vs the
+BASELINE.md north star (mix round <= 1 s).
+
+The reference logs per-round time + bytes (linear_mixer.cpp:553-558); this
+does the same for the TPU mix plane on two paths:
+
+- ``device_round``: the single-host production path (LocalMixGroup shape):
+  per-replica host diffs [L, D] f32 -> device_put -> jitted reduce + apply
+  into the master weights -> scalar fetch barrier. Run on whatever device
+  bench.py runs on (the real chip under the driver).
+- ``allreduce8``: the multi-replica collective path (`allreduce_diffs`,
+  psum over the mesh's replica axis), executed on an 8-device virtual CPU
+  mesh in a subprocess — the same path `dryrun_multichip` validates. Wall
+  time on virtual CPU devices is NOT an ICI number; it proves the
+  collective compiles + executes and bounds the host-side orchestration.
+
+Both paths report the f32 and bf16-compressed (half wire bytes) variants.
+
+Usage: python bench_mix.py        — prints one JSON dict of mix metrics.
+Also importable: bench.py folds `collect(...)` into its "extra" field.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+L = 2
+DIM_BITS = 20
+D = 1 << DIM_BITS
+N_REPLICAS = 2          # device_round: reference's smallest real cluster
+TRIALS = 5
+
+
+def _median(xs):
+    return float(np.median(np.asarray(xs)))
+
+
+def device_round(dev=None) -> dict:
+    """One full mix round, single-device reduce (replicas co-hosted)."""
+    import jax
+    import jax.numpy as jnp
+
+    if dev is None:
+        dev = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    diffs_host = [rng.normal(size=(L, D)).astype(np.float32)
+                  for _ in range(N_REPLICAS)]
+    master = jax.device_put(jnp.zeros((L, D), jnp.float32), dev)
+
+    @jax.jit
+    def reduce_apply(master, stacked):
+        return master + jnp.sum(stacked, axis=0)
+
+    @jax.jit
+    def reduce_apply_bf16(master, stacked):
+        # wire-compressed variant: replicas ship bf16 diffs (half the
+        # host->device and inter-replica bytes); master stays f32
+        return master + jnp.sum(stacked.astype(jnp.float32), axis=0)
+
+    out = {}
+    for name, fn, cast in (("f32", reduce_apply, np.float32),
+                           ("bf16", reduce_apply_bf16, None)):
+        if cast is None:
+            import ml_dtypes
+
+            ship = [d.astype(ml_dtypes.bfloat16) for d in diffs_host]
+        else:
+            ship = diffs_host
+        # warmup (compile)
+        stacked = jax.device_put(np.stack(ship), dev)
+        master = fn(master, stacked)
+        float(jnp.sum(master))
+        times = []
+        for _ in range(TRIALS):
+            t0 = time.perf_counter()
+            stacked = jax.device_put(np.stack(ship), dev)  # get_diff arrival
+            master = fn(master, stacked)
+            float(jnp.sum(master))                         # put_diff barrier
+            times.append(time.perf_counter() - t0)
+        bytes_moved = sum(x.nbytes for x in ship)
+        out[f"device_round_ms_{name}"] = round(_median(times) * 1e3, 2)
+        out[f"device_round_mb_{name}"] = round(bytes_moved / 2**20, 2)
+    return out
+
+
+def allreduce8() -> dict:
+    """allreduce_diffs on an 8-replica virtual CPU mesh (subprocess)."""
+    import jax
+    import jax.numpy as jnp
+
+    from jubatus_tpu.parallel.mesh import replica_mesh
+    from jubatus_tpu.parallel.mix import _psum_stacked
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = replica_mesh(8)
+    rng = np.random.default_rng(0)
+    stacked_host = {"w": rng.normal(size=(8, L, D)).astype(np.float32)}
+    sharding = NamedSharding(mesh, P("replica"))
+    stacked = jax.tree_util.tree_map(
+        lambda x: jax.device_put(jnp.asarray(x), sharding), stacked_host)
+
+    out = {}
+    for name, compress in (("f32", False), ("bf16", True)):
+        total = _psum_stacked(stacked, mesh=mesh, axis="replica",
+                              compress=compress)
+        jax.block_until_ready(total)
+        times = []
+        for _ in range(TRIALS):
+            t0 = time.perf_counter()
+            total = _psum_stacked(stacked, mesh=mesh, axis="replica",
+                                  compress=compress)
+            jax.block_until_ready(total)
+            times.append(time.perf_counter() - t0)
+        # ring allreduce wire bytes per replica: 2*(n-1)/n of the payload
+        payload = L * D * (2 if compress else 4)
+        out[f"allreduce8_ms_{name}"] = round(_median(times) * 1e3, 2)
+        out[f"allreduce8_wire_mb_per_replica_{name}"] = round(
+            payload * 2 * 7 / 8 / 2**20, 2)
+    return out
+
+
+def _allreduce8_subprocess() -> dict:
+    """Run allreduce8 with 8 virtual CPU devices regardless of parent env."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JUBATUS_TPU_PLATFORM"] = "cpu"
+    path = env.get("PYTHONPATH", "")
+    if repo not in path.split(os.pathsep):
+        env["PYTHONPATH"] = repo + (os.pathsep + path if path else "")
+    prog = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import json, bench_mix\n"
+        "print('MIXBENCH=' + json.dumps(bench_mix.allreduce8()))\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", prog], env=env, cwd=repo,
+                          capture_output=True, text=True, timeout=600)
+    for line in proc.stdout.splitlines():
+        if line.startswith("MIXBENCH="):
+            return json.loads(line[len("MIXBENCH="):])
+    return {"allreduce8_error": (proc.stderr or proc.stdout)[-300:]}
+
+
+def collect(dev=None) -> dict:
+    out = device_round(dev)
+    out.update(_allreduce8_subprocess())
+    # the north-star comparison: worst measured round vs the 1 s target
+    rounds = [v for k, v in out.items() if k.endswith("_ms_f32")
+              or k.endswith("_ms_bf16")]
+    if rounds:
+        out["mix_round_worst_ms"] = max(rounds)
+        out["mix_under_1s_target"] = bool(max(rounds) < 1000.0)
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(collect(), indent=1))

@@ -1,0 +1,145 @@
+"""End-to-end serving-plane benchmark: RPC train samples/s through a real
+EngineServer (VERDICT r1 item 2 — measure the product, not the kernel).
+
+Path measured: client msgpack encode -> TCP loopback -> transport framing ->
+native ingest parse (C++: datum decode + fv convert + feature hashing,
+native/fast_ingest.cpp) -> microbatch coalesce -> jitted AROW update on the
+bench device; the Python-converter path serves as the fallback A/B. This is
+the reference's hot ingest path (classifier_serv.cpp:127-146) reshaped for
+TPU (SURVEY.md §3.2).
+
+Clients are separate PROCESSES (their encode work must not share the
+server's GIL — in-process client threads understate the server by ~2x).
+A warmup phase triggers every bucket-shape compile before timing starts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+N_CLIENTS = 16
+CALL_BATCH = 500
+K = 32                  # numeric features per datum
+WARMUP_SECONDS = 12.0
+MEASURE_SECONDS = 12.0
+
+CONF = {
+    "method": "AROW",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+
+_CLIENT_PROG = r"""
+import os, sys, time
+import numpy as np
+port, call_batch, k, warmup, measure = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
+    float(sys.argv[4]), float(sys.argv[5]))
+from jubatus_tpu.client import ClassifierClient, Datum
+rng = np.random.default_rng(os.getpid())
+calls = []
+for _ in range(8):
+    batch = []
+    for _ in range(call_batch):
+        label = "a" if rng.random() < 0.5 else "b"
+        vals = {f"f{j}": float(v) for j, v in enumerate(rng.normal(size=k))}
+        batch.append([label, Datum(vals)])
+    calls.append(batch)
+c = ClassifierClient("127.0.0.1", port, "bench", timeout=120.0)
+deadline_warm = time.perf_counter() + warmup
+i = 0
+while time.perf_counter() < deadline_warm:
+    c.train(calls[i % len(calls)]); i += 1
+count = 0
+t0 = time.perf_counter()
+deadline = t0 + measure
+while time.perf_counter() < deadline:
+    c.train(calls[i % len(calls)]); i += 1; count += call_batch
+elapsed = time.perf_counter() - t0
+print(f"CLIENT {count} {elapsed:.4f}")
+"""
+
+
+def run(transport: str = "python") -> dict:
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    prev = os.environ.get("JUBATUS_TPU_NATIVE_RPC")
+    if transport == "native":
+        os.environ["JUBATUS_TPU_NATIVE_RPC"] = "1"
+    else:
+        os.environ.pop("JUBATUS_TPU_NATIVE_RPC", None)
+    try:
+        srv = EngineServer(
+            "classifier", CONF,
+            args=ServerArgs(engine="classifier", thread=N_CLIENTS,
+                            listen_addr="127.0.0.1"))
+        port = srv.start(0)
+    finally:
+        if prev is None:
+            os.environ.pop("JUBATUS_TPU_NATIVE_RPC", None)
+        else:
+            os.environ["JUBATUS_TPU_NATIVE_RPC"] = prev
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # clients never touch the device
+    env["JUBATUS_TPU_PLATFORM"] = "cpu"
+    path = env.get("PYTHONPATH", "")
+    if repo not in path.split(os.pathsep):
+        env["PYTHONPATH"] = repo + (os.pathsep + path if path else "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CLIENT_PROG, str(port), str(CALL_BATCH),
+             str(K), str(WARMUP_SECONDS), str(MEASURE_SECONDS)],
+            env=env, cwd=repo, stdout=subprocess.PIPE, text=True)
+        for _ in range(N_CLIENTS)
+    ]
+    total, elapsed_max = 0, 0.0
+    for p in procs:
+        out, _ = p.communicate(timeout=WARMUP_SECONDS + MEASURE_SECONDS + 240)
+        for line in out.splitlines():
+            if line.startswith("CLIENT "):
+                _, cnt, el = line.split()
+                total += int(cnt)
+                elapsed_max = max(elapsed_max, float(el))
+    stats = {}
+    for nm, co in srv.coalescers.items():
+        s = co.stats()
+        stats[nm] = s
+    srv.stop()
+    sps = total / elapsed_max if elapsed_max else 0.0
+    fast_items = stats.get("train_raw", {}).get("item_count", 0)
+    slow_items = stats.get("train", {}).get("item_count", 0)
+    avg_batch = 0.0
+    for s in stats.values():
+        if s.get("item_count"):
+            avg_batch = max(avg_batch, s.get("avg_batch", 0.0))
+    return {
+        f"e2e_rpc_train_samples_per_sec_{transport}": round(sps, 1),
+        f"e2e_avg_device_batch_{transport}": round(avg_batch, 1),
+        f"e2e_fast_path_fraction_{transport}": round(
+            fast_items / max(fast_items + slow_items, 1), 3),
+    }
+
+
+def collect() -> dict:
+    out = {"e2e_clients": N_CLIENTS, "e2e_call_batch": CALL_BATCH,
+           "e2e_features_per_datum": K}
+    out.update(run("python"))
+    try:
+        from jubatus_tpu.rpc import native_server
+
+        if native_server.available():
+            out.update(run("native"))
+    except Exception as e:  # noqa: BLE001
+        out["e2e_native_error"] = repr(e)[:200]
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(collect(), indent=1))
