@@ -23,9 +23,31 @@ class _Store:
         self.nodes: Dict[str, Tuple[bytes, Optional[int]]] = {"/": (b"", None)}
         self.locks: Dict[str, int] = {}  # lock path → owner session
         self.counters: Dict[str, int] = {}
-        self.seq = itertools.count()
+        self.seq = 0
+        #: hi-lo reservations already journaled (coord/journal.py); minting
+        #: below the reservation needs no IO
+        self.counter_res: Dict[str, int] = {}
+        self.seq_res = 0
+        #: durable-mutation hook (set by CoordServer to the journal's
+        #: append); called UNDER the store lock so record order matches
+        #: mutation order
+        self.on_durable: Optional[Callable[[tuple], None]] = None
         self.child_watchers: Dict[str, List[Callable[[str], None]]] = {}
         self.delete_watchers: Dict[str, List[Callable[[str], None]]] = {}
+
+    def durable(self, rec: tuple) -> None:
+        if self.on_durable is not None:
+            self.on_durable(rec)
+
+    def next_seq(self) -> int:
+        n = self.seq
+        self.seq += 1
+        if self.seq > self.seq_res and self.on_durable is not None:
+            from jubatus_tpu.coord.journal import RESERVE_BLOCK
+
+            self.seq_res = self.seq + RESERVE_BLOCK
+            self.on_durable(("seq", self.seq_res))
+        return n
 
     def fire_child(self, parent: str) -> None:
         for fn in list(self.child_watchers.get(parent, ())):
@@ -95,6 +117,8 @@ class MemoryCoordinator(Coordinator):
             self._mkparents(path)
             owner = self._session if ephemeral else None
             self._store.nodes[path] = (payload, owner)
+            if owner is None:
+                self._store.durable(("c", path, payload))
         self._store.fire_child(_parent(path))
         return True
 
@@ -102,7 +126,7 @@ class MemoryCoordinator(Coordinator):
         with self._store.lock:
             if self._closed:
                 return None
-            actual = f"{path}{next(self._store.seq):010d}"
+            actual = f"{path}{self._store.next_seq():010d}"
             self._mkparents(actual)
             self._store.nodes[actual] = (payload, self._session)
         self._store.fire_child(_parent(actual))
@@ -115,9 +139,12 @@ class MemoryCoordinator(Coordinator):
                 self._mkparents(path)
                 self._store.nodes[path] = (payload, None)
                 created = True
+                self._store.durable(("c", path, payload))
             else:
                 _, owner = self._store.nodes[path]
                 self._store.nodes[path] = (payload, owner)
+                if owner is None:
+                    self._store.durable(("c", path, payload))
         if created:
             self._store.fire_child(_parent(path))
         return True
@@ -129,8 +156,11 @@ class MemoryCoordinator(Coordinator):
 
     def remove(self, path: str) -> bool:
         with self._store.lock:
-            if self._store.nodes.pop(path, None) is None:
+            node = self._store.nodes.pop(path, None)
+            if node is None:
                 return False
+            if node[1] is None:
+                self._store.durable(("r", path))
         self._store.fire_delete(path)
         self._store.fire_child(_parent(path))
         return True
@@ -179,6 +209,13 @@ class MemoryCoordinator(Coordinator):
         with self._store.lock:
             nxt = self._store.counters.get(path, 0) + 1
             self._store.counters[path] = nxt
+            if nxt > self._store.counter_res.get(path, 0) \
+                    and self._store.on_durable is not None:
+                from jubatus_tpu.coord.journal import RESERVE_BLOCK
+
+                hi = nxt + RESERVE_BLOCK
+                self._store.counter_res[path] = hi
+                self._store.on_durable(("cnt", path, hi))
             return nxt
 
     # -- lifecycle -----------------------------------------------------------

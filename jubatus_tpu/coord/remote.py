@@ -5,11 +5,19 @@ Semantics match the ZooKeeper client the reference uses (common/zk.cpp):
 
 - ephemeral nodes and locks belong to a server-side session kept alive by
   a heartbeat thread (lease/3 cadence, ≙ ZK ticks);
-- repeated heartbeat failure or an expired-session reply means my
-  ephemerals are gone cluster-wide: the client fires its delete watchers
-  (→ the server's suicide watcher stops it) and closes, the same cleanup
-  contract as the reference's connection-loss stack
-  (zk push_cleanup(&shutdown_server), server_helper.cpp:56);
+- on heartbeat failure or an expired-session reply the client first tries
+  to RESUME: re-open a session and re-create its ephemerals from the
+  local registry, retrying for ``resume_window_sec`` (3 leases). This is
+  what lets a journaled coordd (coord/server.py --journal) restart
+  without losing cluster membership — the reference instead suicides on
+  ZK session expiry and relies on jubavisor to respawn the process.
+  Locks are NOT resumed (they were observably lost; holders re-acquire
+  per round, linear_mixer master_lock semantics);
+- only when resumption times out do my ephemerals count as gone
+  cluster-wide: the client fires its delete watchers (→ the server's
+  suicide watcher stops it) and closes, the same cleanup contract as the
+  reference's connection-loss stack (zk push_cleanup(&shutdown_server),
+  server_helper.cpp:56);
 - watches are client-side polls (0.5 s): child watchers diff list(path),
   delete watchers poll exists(path) — the cached_zk/file-backend pattern.
 """
@@ -30,12 +38,15 @@ _HEARTBEAT_FAILURE_LIMIT = 3
 
 
 class RemoteCoordinator(Coordinator):
-    def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 resume_window_sec: Optional[float] = None) -> None:
         self.host = host
         self.port = port
         self._client = RpcClient(host, port, timeout)
         self._lock = threading.Lock()
         self._closed = False
+        #: my live ephemerals (path → payload), re-created on session resume
+        self._ephemerals: Dict[str, bytes] = {}
         try:
             sid, lease = self._client.call("coord_open")
         except Exception as e:
@@ -43,10 +54,18 @@ class RemoteCoordinator(Coordinator):
                 f"cannot reach coordination service {host}:{port}: {e}") from e
         self._sid = int(sid)
         self.lease_sec = float(lease)
+        self.resume_window_sec = (resume_window_sec
+                                  if resume_window_sec is not None
+                                  else 3.0 * self.lease_sec)
         self._child_watchers: Dict[str, List[Callable[[str], None]]] = {}
         self._child_snapshot: Dict[str, Set[str]] = {}
         self._delete_watchers: Dict[str, List[Callable[[str], None]]] = {}
         self._watch_thread: Optional[threading.Thread] = None
+        #: set while the session is suspect (heartbeat failing / resuming):
+        #: delete-watcher polls pause, or a poll racing the resume would
+        #: see the restarted coordd before the ephemerals are re-created
+        #: and suicide a healthy server
+        self._suspect = threading.Event()
         self._hb_stop = threading.Event()
         self._hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
                                     name="coord-remote-hb")
@@ -68,17 +87,76 @@ class RemoteCoordinator(Coordinator):
             try:
                 ok = self._client.call("coord_heartbeat", self._sid)
             except Exception:  # noqa: BLE001 — connection trouble
+                self._suspect.set()
                 failures += 1
                 log.warning("coordinator heartbeat failed (%d/%d)",
                             failures, _HEARTBEAT_FAILURE_LIMIT)
                 if failures >= _HEARTBEAT_FAILURE_LIMIT:
+                    if self._try_resume():
+                        failures = 0
+                        self._suspect.clear()
+                        continue
+                    if self._closed:
+                        return  # intentional shutdown, not a lost session
                     self._session_lost()
                     return
                 continue
-            failures = 0
             if not ok:  # server says the session expired
+                self._suspect.set()
+                if self._try_resume():
+                    failures = 0
+                    self._suspect.clear()
+                    continue
+                if self._closed:
+                    return
                 self._session_lost()
                 return
+            failures = 0
+            self._suspect.clear()
+
+    def _try_resume(self) -> bool:
+        """Re-establish the session after a coordd restart/expiry: open a
+        fresh session and re-create my ephemerals, retrying for the resume
+        window. True = resumed (heartbeating continues on the new sid)."""
+        import time
+
+        deadline = time.monotonic() + self.resume_window_sec
+        old_sid = self._sid
+        while not self._closed and time.monotonic() < deadline:
+            try:
+                sid, lease = self._client.call("coord_open")
+            except Exception:  # noqa: BLE001 — coordd still down
+                if self._hb_stop.wait(min(1.0, self.lease_sec / 3)):
+                    return False
+                continue
+            try:
+                # same coordd, reaped-or-alive old session: free its
+                # ephemerals so the re-creates below can't collide
+                self._client.call("coord_close", old_sid)
+            except Exception:  # noqa: BLE001 — restarted coordd: no-op
+                pass
+            self._sid = int(sid)
+            self.lease_sec = float(lease)
+            with self._lock:
+                mine = dict(self._ephemerals)
+            ok = True
+            for path, payload in mine.items():
+                try:
+                    if not self._client.call("coord_create", self._sid, path,
+                                             payload, True):
+                        # someone else now owns the path (e.g. a replacement
+                        # node took my slot) — that is a real loss
+                        ok = False
+                except Exception:  # noqa: BLE001
+                    ok = False
+                    break
+            if ok:
+                log.warning("coordination session resumed (sid %d -> %d, "
+                            "%d ephemerals re-created)",
+                            old_sid, self._sid, len(mine))
+                return True
+            old_sid = self._sid  # free the half-resumed session next try
+        return False
 
     def _session_lost(self) -> None:
         """My ephemerals are gone cluster-wide — run the cleanup contract:
@@ -105,8 +183,12 @@ class RemoteCoordinator(Coordinator):
 
     # -- node CRUD ------------------------------------------------------------
     def create(self, path: str, payload: bytes = b"", ephemeral: bool = False) -> bool:
-        return bool(self._call("coord_create", self._sid, path, payload,
-                               ephemeral))
+        ok = bool(self._call("coord_create", self._sid, path, payload,
+                             ephemeral))
+        if ok and ephemeral:
+            with self._lock:
+                self._ephemerals[path] = payload
+        return ok
 
     def create_seq(self, path: str, payload: bytes = b"") -> Optional[str]:
         out = self._call("coord_create_seq", self._sid, path, payload)
@@ -122,6 +204,8 @@ class RemoteCoordinator(Coordinator):
         return out if isinstance(out, bytes) else str(out).encode()
 
     def remove(self, path: str) -> bool:
+        with self._lock:
+            self._ephemerals.pop(path, None)
         return bool(self._call("coord_remove", path))
 
     def exists(self, path: str) -> bool:
@@ -161,11 +245,25 @@ class RemoteCoordinator(Coordinator):
                         except Exception:  # noqa: BLE001
                             log.exception("child watcher failed for %s", path)
             for path in delete_paths:
+                if self._suspect.is_set():
+                    break  # session suspect: absence may be transient
                 try:
                     alive = self.exists(path)
                 except Exception:  # noqa: BLE001
                     continue
                 if not alive:
+                    # ZK semantics: watches only fire within a valid
+                    # session. A vanished node + a dead session means a
+                    # coordd restart the resume path will repair — firing
+                    # here would suicide a healthy server.
+                    try:
+                        if not self._client.call("coord_heartbeat",
+                                                 self._sid):
+                            self._suspect.set()
+                            continue
+                    except Exception:  # noqa: BLE001
+                        self._suspect.set()
+                        continue
                     with self._lock:
                         fns = self._delete_watchers.pop(path, [])
                     for fn in fns:

@@ -16,9 +16,15 @@ the failure detector of SURVEY.md §5). Every session is backed by a
 MemoryCoordinator on one shared store, so node/lock/watch semantics are
 identical to the in-process backend the tests use.
 
-Not a replicated quorum: one process, durable only in memory — the
-coordinator is a control-plane availability point like a single-node ZK.
-(The Coordinator ABC keeps the door open for a real quorum backend.)
+Durability (``--journal FILE``): persistent nodes (configs), id counters,
+and the sequence counter journal to disk (coord/journal.py) and recover
+on restart; ephemerals and locks die with their sessions, and clients
+RESUME sessions across a coordd restart (coord/remote.py re-opens and
+re-creates its ephemerals within the resume window), so a kill/restart
+of coordd loses neither configs nor membership. Availability model: a
+single process, like a one-node ZK — down during the restart (clients
+retry), journaled against process death, not host loss; a quorum backend
+remains possible behind the Coordinator ABC.
 """
 
 from __future__ import annotations
@@ -39,8 +45,20 @@ DEFAULT_LEASE_SEC = 10.0
 
 
 class CoordServer:
-    def __init__(self, lease_sec: float = DEFAULT_LEASE_SEC) -> None:
+    def __init__(self, lease_sec: float = DEFAULT_LEASE_SEC,
+                 journal_path: Optional[str] = None) -> None:
         self.store = _Store()
+        self.journal = None
+        if journal_path:
+            from jubatus_tpu.coord.journal import Journal
+
+            self.journal = Journal(journal_path)
+            n = self.journal.replay_into(self.store)
+            if n:
+                log.info("journal: recovered %d records from %s",
+                         n, journal_path)
+            self.journal.open_and_compact(self.store)
+            self.store.on_durable = self.journal.append
         self.lease_sec = lease_sec
         self.rpc = RpcServer()
         self._mu = threading.Lock()
@@ -165,6 +183,8 @@ class CoordServer:
             self._sessions.clear()
         for mc, _hb in sessions:
             mc.close()
+        if self.journal is not None:
+            self.journal.close()
 
 
 def main(argv=None) -> int:
@@ -173,10 +193,13 @@ def main(argv=None) -> int:
     p.add_argument("-p", "--rpc-port", type=int, default=2199)
     p.add_argument("-b", "--listen-addr", default="0.0.0.0")
     p.add_argument("--lease-sec", type=float, default=DEFAULT_LEASE_SEC)
+    p.add_argument("--journal", default="",
+                   help="journal durable state (configs, id counters) to "
+                        "this file and recover it on restart")
     ns = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s [jubacoordd] %(message)s")
-    srv = CoordServer(lease_sec=ns.lease_sec)
+    srv = CoordServer(lease_sec=ns.lease_sec, journal_path=ns.journal or None)
     signal.signal(signal.SIGTERM, lambda *_: srv.stop())
     signal.signal(signal.SIGINT, lambda *_: srv.stop())
     srv.start(ns.rpc_port, ns.listen_addr)
