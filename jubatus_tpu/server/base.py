@@ -72,6 +72,14 @@ class EngineServer:
             legacy_wire=getattr(self.args, "legacy_wire", False))
         self._stop_event = threading.Event()
         self._stop_once = threading.Lock()  # first stop() wins; rest no-op
+        #: pooled peer clients for server-side replicated writes
+        self._peers: Dict[str, Any] = {}
+        self._peer_lock = threading.Lock()
+        #: watch-invalidated CHT snapshot (cluster_cht)
+        self._cht_cache = None
+        self._cht_expiry = 0.0
+        self._cht_watched = False
+        self._cht_lock = threading.Lock()
 
         # distributed wiring (server_helper ctor path, server_helper.cpp:48-78)
         self.coord = coord
@@ -109,12 +117,14 @@ class EngineServer:
 
     # -- construction from files/argv (run_server, server_util.hpp:139-176) --
     @classmethod
-    def from_args(cls, args: ServerArgs) -> "EngineServer":
+    def from_args(cls, args: ServerArgs,
+                  coord: Optional[Coordinator] = None) -> "EngineServer":
         if args.configpath:
             with open(args.configpath) as f:
                 config = f.read()
         elif not args.is_standalone:
-            coord = create_coordinator(args.coordinator)
+            if coord is None:
+                coord = create_coordinator(args.coordinator)
             raw = coord.read(membership.config_path(args.engine, args.name))
             if raw is None:
                 raise RuntimeError(
@@ -124,10 +134,80 @@ class EngineServer:
             return cls(args.engine, raw.decode(), args, coord=coord)
         else:
             raise RuntimeError("standalone mode requires -f/--configpath")
-        srv = cls(args.engine, config, args)
+        srv = cls(args.engine, config, args, coord=coord)
         if args.model_file:
             srv.load_file(args.model_file)
         return srv
+
+    # -- peer RPC (server-side replicated writes, anomaly_serv.cpp:275-297) --
+    def self_nodeinfo(self) -> NodeInfo:
+        return NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+
+    def peer_client(self, node: NodeInfo):
+        """Pooled RPC client to a cluster peer (≙ the reference's
+        client-to-peer sessions in selective_update)."""
+        from jubatus_tpu.rpc.client import RpcClient
+
+        with self._peer_lock:
+            cli = self._peers.get(node.name)
+            if cli is None:
+                cli = RpcClient(node.host, node.port,
+                                self.args.interconnect_timeout)
+                self._peers[node.name] = cli
+            return cli
+
+    def _close_peers(self) -> None:
+        with self._peer_lock:
+            peers = list(self._peers.values())
+            self._peers.clear()
+        for cli in peers:
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+
+    def drop_peer_client(self, node: NodeInfo) -> None:
+        with self._peer_lock:
+            cli = self._peers.pop(node.name, None)
+        if cli is not None:
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def cluster_cht(self):
+        """CHT over the current actives (cht.cpp:107-143); None in
+        standalone mode. Cached: the ring is a pure function of
+        membership, so it rebuilds only when the membership watcher fires
+        (or on TTL expiry for coordinators with best-effort watches) —
+        never per write (replicated add/create_node is the ingest hot
+        path)."""
+        if self.coord is None:
+            return None
+        from jubatus_tpu.coord.cht import CHT
+
+        now = time.monotonic()
+        with self._cht_lock:
+            if self._cht_cache is not None and now < self._cht_expiry:
+                return self._cht_cache
+        cht = CHT.from_coordinator(self.coord, self.engine, self.args.name)
+        with self._cht_lock:
+            self._cht_cache = cht
+            self._cht_expiry = now + 2.0
+            if not self._cht_watched:
+                self._cht_watched = True
+                path = membership.actor_path(
+                    self.engine, self.args.name) + "/actives"
+                try:
+                    self.coord.watch_children(
+                        path, lambda _p: self._invalidate_cht())
+                except NotImplementedError:
+                    pass
+        return cht
+
+    def _invalidate_cht(self) -> None:
+        with self._cht_lock:
+            self._cht_cache = None
 
     # -- built-in RPCs (server_base.hpp:41-109, client.hpp:30-87) ------------
     def get_config(self, _name: str = "") -> str:
@@ -241,10 +321,46 @@ class EngineServer:
             self.mixer.on_active = on_active
             # suicide watcher (server_helper.cpp:91-94,105-109)
             self.coord.watch_delete(path, lambda _p: self.stop())
+            # keyword/key partitioning: drivers exposing set_assignment
+            # (burst) process only their CHT(2)-assigned keys, re-hashed
+            # on membership change (burst_serv.cpp:225-239, 264-290)
+            if hasattr(self.driver, "set_assignment"):
+                self._install_assignment(node)
             self.mixer.start()
         log.info("%s server listening on %s:%d", self.engine,
                  self.args.bind_host, actual)
         return actual
+
+    def _install_assignment(self, me: NodeInfo) -> None:
+        """Wire CHT keyword assignment into the driver and keep it fresh
+        across membership changes (≙ the reference's child watcher
+        re-hash, burst_serv.cpp:264-290). The predicate snapshots the
+        ring at (re)build time; each change swaps in a new snapshot."""
+        from jubatus_tpu.coord.cht import CHT
+
+        def rebuild(_path: str = "") -> None:
+            try:
+                cht = CHT.from_coordinator(
+                    self.coord, self.engine, self.args.name,
+                    actives_only=False)
+            except Exception:  # noqa: BLE001 — transient coord trouble
+                log.warning("assignment rebuild failed; keeping previous",
+                            exc_info=True)
+                return
+            if not cht.members:
+                return
+
+            def assigned(kw: str, _cht=cht, _me=me.name) -> bool:
+                return any(n.name == _me for n in _cht.find(kw, 2))
+
+            self.driver.set_assignment(assigned)
+
+        rebuild()
+        nodes_dir = membership.actor_path(self.engine, self.args.name) + "/nodes"
+        try:
+            self.coord.watch_children(nodes_dir, rebuild)
+        except NotImplementedError:
+            pass  # backends without watches: assignment stays static
 
     def join(self) -> None:
         self._stop_event.wait()
@@ -261,6 +377,7 @@ class EngineServer:
                 (self.mixer.stop if self.mixer is not None else None),
                 (self.coord.close if self.coord is not None else None),
                 self.rpc.stop,
+                self._close_peers,
             ):
                 if step is None:
                     continue

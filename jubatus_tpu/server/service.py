@@ -14,10 +14,13 @@ where the reference does.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, List
 
 from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.rpc.server import RpcServer
+
+log = logging.getLogger(__name__)
 
 # -- wire ↔ driver conversions ----------------------------------------------
 
@@ -263,15 +266,63 @@ def _bind_nearest_neighbor(rpc: RpcServer, server: Any) -> None:
     rpc.register("get_all_rows", lambda name: d.get_all_rows(), arity=1)
 
 
+def _replicated_write(server: Any, key: str, apply_local, apply_remote,
+                      replication: int = 2):
+    """Server-side CHT-replicated write (≙ anomaly_serv.cpp:178-211,
+    graph_serv.cpp:181-228): place ``key`` on its ``replication`` ring
+    successors — apply locally when a successor is me, RPC the peer
+    otherwise. The primary write must succeed (exceptions propagate);
+    replicas are best-effort (warn + continue). Returns the primary's
+    result. Falls back to a local-only apply when the ring is empty."""
+    cht = server.cluster_cht()
+    nodes = cht.find(key, replication) if cht is not None else []
+    if not nodes:
+        return apply_local()
+    me = server.self_nodeinfo()
+    result = None
+    for i, node in enumerate(nodes):
+        try:
+            if node.name == me.name:
+                out = apply_local()
+            else:
+                out = apply_remote(server.peer_client(node))
+            if i == 0:
+                result = out
+        except Exception:
+            if i == 0:
+                raise  # primary failure is the caller's failure
+            server.drop_peer_client(node)
+            log.warning("replica write to %s failed (best-effort)",
+                        node.name, exc_info=True)
+    return result
+
+
 @_binder("anomaly")
 def _bind_anomaly(rpc: RpcServer, server: Any) -> None:
     d = server.driver
     rpc.register("clear_row", _updating(server, lambda name, rid: d.clear_row(rid)), arity=2)
-    rpc.register(
-        "add",
-        lambda name, row: list(_updating(server, lambda: d.add(_datum(row)))()),
-        arity=2,
-    )
+
+    def add(name, row):
+        """Distributed add = mint id + CHT(2) placement + primary write +
+        best-effort replica, INSIDE the server — a direct-to-server add is
+        replicated immediately, not at the next mix (anomaly_serv.cpp:
+        155-211). Standalone keeps the driver's local add."""
+        if server.coord is None:
+            return list(_updating(server, lambda: d.add(_datum(row)))())
+        row_id = str(d.idgen.generate()) if getattr(d, "idgen", None) \
+            else None
+        if row_id is None:
+            return list(_updating(server, lambda: d.add(_datum(row)))())
+        score = _replicated_write(
+            server, row_id,
+            apply_local=_updating(
+                server, lambda: float(d.overwrite(row_id, _datum(row)))),
+            apply_remote=lambda cli: float(
+                cli.call("overwrite", name, row_id, row)),
+        )
+        return [row_id, float(score)]
+
+    rpc.register("add", add, arity=2)
     rpc.register("update", _updating(server, lambda name, rid, row: float(d.update(rid, _datum(row)))),
                  arity=3)
     rpc.register("overwrite", _updating(server, lambda name, rid, row: float(d.overwrite(rid, _datum(row)))),
@@ -290,7 +341,27 @@ def _bind_graph(rpc: RpcServer, server: Any) -> None:
         (source, target, properties)."""
         return e[1], e[2], dict(e[0])
 
-    rpc.register("create_node", _updating(server, lambda name: d.create_node()), arity=1)
+    def create_node(name):
+        """Distributed create_node = mint global id + create_node_here on
+        the CHT(2) successors via direct peer RPC (graph_serv.cpp:181-228)
+        — a direct-to-server create is visible on its replica before any
+        mix. Standalone keeps the local driver path."""
+        if server.coord is None:
+            return _updating(server, lambda: d.create_node())()
+        node_id = str(d.idgen.generate()) if getattr(d, "idgen", None) \
+            else None
+        if node_id is None:
+            return _updating(server, lambda: d.create_node())()
+        _replicated_write(
+            server, node_id,
+            apply_local=_updating(
+                server, lambda: d.create_node_here(node_id)),
+            apply_remote=lambda cli: cli.call(
+                "create_node_here", name, node_id),
+        )
+        return node_id
+
+    rpc.register("create_node", create_node, arity=1)
     rpc.register("remove_node", _updating(server, lambda name, nid: d.remove_node(nid)), arity=2)
     rpc.register("update_node", _updating(server, lambda name, nid, prop: d.update_node(nid, dict(prop))),
                  arity=3)

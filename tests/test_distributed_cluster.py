@@ -215,3 +215,72 @@ def test_mixer_fold_with_stub():
     folded = unpack_mix(comm.put[0])["diffs"]["stat"]
     # stat diff = {"counts": per-key window counts}; 1 (local) + 2 (canned)
     assert folded["counts"][0] == pytest.approx(3.0)
+
+
+def test_anomaly_direct_add_replicates_before_mix():
+    """Server-side replicated write (anomaly_serv.cpp:155-211): a
+    direct-to-server add must land on BOTH its CHT(2) nodes immediately —
+    not at the next mix round (mix intervals here are effectively off)."""
+    store = _Store()
+    conf = {"method": "lof",
+            "parameter": {"nearest_neighbor_num": 3,
+                          "reverse_nearest_neighbor_num": 6,
+                          "method": "euclid_lsh",
+                          "parameter": {"hash_num": 8}},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    servers = _cluster("anomaly", conf, 3, store)
+    try:
+        from jubatus_tpu.client import AnomalyClient, Datum
+
+        c = AnomalyClient("127.0.0.1", servers[0].args.rpc_port, NAME)
+        ids = []
+        for i in range(6):
+            rid, _score = c.add(Datum({"x": float(i), "y": float(-i)}))
+            ids.append(rid)
+        c.close()
+        from jubatus_tpu.coord.cht import CHT
+
+        cht = CHT.from_coordinator(MemoryCoordinator(store), "anomaly", NAME)
+        by_name = {s.self_nodeinfo().name: s for s in servers}
+        for rid in ids:
+            owners = [n.name for n in cht.find(rid, 2)]
+            assert len(owners) == 2
+            for owner in owners:
+                rows = by_name[owner].driver.get_all_rows()
+                assert rid in rows, (
+                    f"row {rid} missing on {owner} before any mix")
+        # and nowhere else (CHT placement, not broadcast)
+        for rid in ids:
+            owners = {n.name for n in cht.find(rid, 2)}
+            for nm, srv in by_name.items():
+                if nm not in owners:
+                    assert rid not in srv.driver.get_all_rows()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_graph_direct_create_node_replicates_before_mix():
+    """graph_serv.cpp:181-228: create_node lands on its CHT(2) nodes via
+    direct peer RPC (create_node_here), visible before any mix."""
+    store = _Store()
+    conf = {"method": "graph_wo_index", "parameter": {}}
+    servers = _cluster("graph", conf, 3, store)
+    try:
+        from jubatus_tpu.client import GraphClient
+
+        c = GraphClient("127.0.0.1", servers[0].args.rpc_port, NAME)
+        nids = [c.create_node() for _ in range(6)]
+        c.close()
+        from jubatus_tpu.coord.cht import CHT
+
+        cht = CHT.from_coordinator(MemoryCoordinator(store), "graph", NAME)
+        by_name = {s.self_nodeinfo().name: s for s in servers}
+        for nid in nids:
+            owners = [n.name for n in cht.find(nid, 2)]
+            for owner in owners:
+                assert nid in by_name[owner].driver.nodes, (
+                    f"node {nid} missing on {owner} before any mix")
+    finally:
+        for s in servers:
+            s.stop()
