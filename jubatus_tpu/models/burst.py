@@ -24,10 +24,18 @@ Semantics (reconstructed from the jubatus_core burst package):
   ``costcut_threshold`` when it is positive), else 0.
 - Batches older than (result_window_rotate_size + 1) windows are pruned.
 
-Distribution note: the reference broadcasts documents and CHT-assigns
-keywords to nodes (burst_serv.cpp:225-239). Here replicas ingest disjoint
-local streams and the mix sums (keyword, batch) count deltas — the additive
-data-parallel model the rest of the framework uses. The DP itself is a few
+Distribution model (burst_serv.cpp:225-239, 264-290): documents are
+BROADCAST to every replica (proxy routing, burst.idl), but each replica
+PROCESSES only the keywords its CHT(2) placement assigns to it —
+keyword memory and per-document matching cost scale with cluster size.
+The server wires the assignment via ``set_assignment`` and re-hashes on
+membership change (suicide-watcher-style child watcher); ``reassign``
+drops counts for keywords that moved away, and a newly assigned replica
+back-fills from its peer at the next mix. Because the two owners of a
+keyword count the SAME broadcast documents, the mix is an elementwise
+MAX of count totals (a semilattice merge, matching the reference's
+keep-the-larger-window mixable), not a sum of deltas — so distributed
+ingest must flow through the broadcast route. The DP itself is a few
 dozen scalar ops per query (no MXU work), so it runs on host.
 """
 
@@ -75,6 +83,37 @@ class BurstDriver(DriverBase):
         self._rel_m: Dict[str, Dict[int, int]] = {}
         self._rel_d: Dict[str, Dict[int, int]] = {}
         self._max_batch: Optional[int] = None
+        #: keyword -> am-I-assigned predicate; None = standalone (process
+        #: every keyword). Set by the server from CHT placement.
+        self._assigned = None
+        self._assigned_cache: Dict[str, bool] = {}
+
+    # -- keyword partitioning (burst_serv.cpp:86-102, 225-239) ---------------
+    def set_assignment(self, assigned) -> None:
+        """Install (or update) the CHT assignment predicate and drop
+        counts for keywords that are no longer mine — the re-hash step of
+        a membership change. Registry entries stay (the keyword list is
+        cluster-global); only count state is partitioned."""
+        with self.lock:
+            self._assigned = assigned
+            self._assigned_cache = {}
+            if assigned is None:
+                return
+            for kw in self.keywords:
+                if not self._is_assigned(kw):
+                    self._rel_m[kw] = {}
+                    self._rel_d[kw] = {}
+
+    def _is_assigned(self, kw: str) -> bool:
+        """Memoized per keyword: the predicate is a CHT ring walk (md5 +
+        bisect) and add_documents asks it per (document x keyword) — the
+        cache is cleared whenever the assignment changes."""
+        if self._assigned is None:
+            return True
+        hit = self._assigned_cache.get(kw)
+        if hit is None:
+            hit = self._assigned_cache[kw] = bool(self._assigned(kw))
+        return hit
 
     # -- keyword registry -------------------------------------------------------
     @locked
@@ -120,7 +159,7 @@ class BurstDriver(DriverBase):
             b = int(math.floor(float(pos) / self.batch_interval))
             self._all_d[b] = self._all_d.get(b, 0) + 1
             for kw in self.keywords:
-                if kw in text:
+                if self._is_assigned(kw) and kw in text:
                     rel = self._rel_d[kw]
                     rel[b] = rel.get(b, 0) + 1
             if self._max_batch is None or b > self._max_batch:
@@ -284,25 +323,43 @@ class BurstDriver(DriverBase):
 
 
 class _BurstMixable:
-    """Additive (keyword, batch) count deltas as nested sparse dicts."""
+    """(keyword, batch) count TOTALS merged by elementwise max.
+
+    Documents are broadcast, so a keyword's two CHT owners hold duplicate
+    counts — max is the correct replica merge (the reference's mixable
+    keeps the window with more data, mixable_burst semantics). Max over
+    totals is also idempotent and order-insensitive, which makes the fold
+    safe under retries and partial rounds. A replica newly assigned a
+    keyword (membership change) back-fills here: its zero counts max with
+    the surviving owner's totals."""
 
     def __init__(self, driver: BurstDriver):
         self._d = driver
 
     def get_diff(self):
         d = self._d
-        return {"all": dict(d._all_d),
-                "rel": {kw: dict(bs) for kw, bs in d._rel_d.items() if bs},
-                "max_batch": d._max_batch}
+        rel = {}
+        for kw in d.keywords:
+            if not d._is_assigned(kw):
+                continue
+            tot = {b: d._rel_m.get(kw, {}).get(b, 0) +
+                   d._rel_d.get(kw, {}).get(b, 0)
+                   for b in set(d._rel_m.get(kw, {})) |
+                   set(d._rel_d.get(kw, {}))}
+            if tot:
+                rel[kw] = tot
+        all_tot = {b: d._all_m.get(b, 0) + d._all_d.get(b, 0)
+                   for b in set(d._all_m) | set(d._all_d)}
+        return {"all": all_tot, "rel": rel, "max_batch": d._max_batch}
 
     @staticmethod
     def mix(acc, diff):
         for b, c in diff["all"].items():
-            acc["all"][b] = acc["all"].get(b, 0) + c
+            acc["all"][b] = max(acc["all"].get(b, 0), c)
         for kw, bs in diff["rel"].items():
             mine = acc["rel"].setdefault(kw, {})
             for b, c in bs.items():
-                mine[b] = mine.get(b, 0) + c
+                mine[b] = max(mine.get(b, 0), c)
         if diff["max_batch"] is not None and (
                 acc["max_batch"] is None or diff["max_batch"] > acc["max_batch"]):
             acc["max_batch"] = diff["max_batch"]
@@ -315,14 +372,18 @@ class _BurstMixable:
         d = self._d
         for b, c in diff["all"].items():
             b = int(b)
-            d._all_m[b] = d._all_m.get(b, 0) + int(c)
+            local = d._all_m.get(b, 0) + d._all_d.get(b, 0)
+            d._all_m[b] = max(local, int(c))
         for kw, bs in diff["rel"].items():
             kw = _s(kw)
-            if kw not in d.keywords:
-                continue  # keyword removed locally; drop its counts
-            mine = d._rel_m.setdefault(kw, {})
+            if kw not in d.keywords or not d._is_assigned(kw):
+                continue  # removed locally, or not my partition to hold
+            mine_m = d._rel_m.setdefault(kw, {})
+            mine_d = d._rel_d.get(kw, {})
             for b, c in bs.items():
-                mine[int(b)] = mine.get(int(b), 0) + int(c)
+                b = int(b)
+                local = mine_m.get(b, 0) + mine_d.get(b, 0)
+                mine_m[b] = max(local, int(c))
         mb = diff.get("max_batch")
         if mb is not None and (d._max_batch is None or mb > d._max_batch):
             d._max_batch = int(mb)

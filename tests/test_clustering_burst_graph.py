@@ -210,22 +210,63 @@ def test_burst_result_at_and_remove():
     assert b.get_all_keywords() == []
 
 
-def test_burst_mix_sums_counts():
+def test_burst_mix_merges_broadcast_counts():
+    """Documents are BROADCAST to every replica (burst.idl routing), so
+    replicas hold duplicate counts and the mix is a max-merge — counts
+    must converge, never double (the reference's keep-the-larger-window
+    mixable semantics)."""
     a = BurstDriver(BURST_CFG)
     b = BurstDriver(BURST_CFG)
     for d in (a, b):
         d.add_keyword("k", 2.0, 1.0)
-    a.add_documents([(5.0, "k here")] * 3)
-    b.add_documents([(5.0, "k there")] * 4 + [(5.0, "nothing")] * 2)
+    docs = [(5.0, "k here")] * 3 + [(5.0, "nothing")] * 2
+    a.add_documents(docs)
+    b.add_documents(docs)
     LocalMixGroup([a, b]).mix()
     for d in (a, b):
-        win = d.get_result("k")
-        last = win["batches"][-1]
-        assert last["all_data_count"] == 9
-        assert last["relevant_data_count"] == 7
-    # second mix must not double-count
+        last = d.get_result("k")["batches"][-1]
+        assert last["all_data_count"] == 5
+        assert last["relevant_data_count"] == 3
+    # idempotent: a second mix must not change anything
     LocalMixGroup([a, b]).mix()
-    assert a.get_result("k")["batches"][-1]["all_data_count"] == 9
+    assert a.get_result("k")["batches"][-1]["all_data_count"] == 5
+    # a replica that missed part of the broadcast (late joiner) back-fills
+    c = BurstDriver(BURST_CFG)
+    c.add_keyword("k", 2.0, 1.0)
+    c.add_documents(docs[:2])
+    LocalMixGroup([a, c]).mix()
+    last = c.get_result("k")["batches"][-1]
+    assert last["all_data_count"] == 5
+    assert last["relevant_data_count"] == 3
+
+
+def test_burst_assignment_partitions_processing():
+    """With a CHT assignment installed, a replica counts only its own
+    keywords; reassignment drops the moved keyword's counts and the next
+    mix back-fills the new owner (burst_serv.cpp:225-239, 264-290)."""
+    a = BurstDriver(BURST_CFG)
+    b = BurstDriver(BURST_CFG)
+    for d in (a, b):
+        d.add_keyword("k1", 2.0, 1.0)
+        d.add_keyword("k2", 2.0, 1.0)
+    a.set_assignment(lambda kw: kw == "k1")
+    b.set_assignment(lambda kw: kw == "k2")
+    docs = [(5.0, "k1 and k2 both")] * 4
+    a.add_documents(docs)
+    b.add_documents(docs)
+    assert a._rel_d["k1"] and not a._rel_d.get("k2")
+    assert b._rel_d["k2"] and not b._rel_d.get("k1")
+    # each owner answers for its keyword; the other holds no counts
+    assert a.get_result("k1")["batches"][-1]["relevant_data_count"] == 4
+    assert b.get_result("k2")["batches"][-1]["relevant_data_count"] == 4
+    LocalMixGroup([a, b]).mix()
+    # partitioning survives the mix: non-owners still hold nothing
+    assert not a._rel_m.get("k2") and not b._rel_m.get("k1")
+    # membership change: k2 moves to a; counts back-fill at the next mix
+    a.set_assignment(lambda kw: True)
+    b.set_assignment(lambda kw: kw == "k2")
+    LocalMixGroup([a, b]).mix()
+    assert a.get_result("k2")["batches"][-1]["relevant_data_count"] == 4
 
 
 def test_burst_save_load():
