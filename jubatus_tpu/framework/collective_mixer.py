@@ -1,0 +1,188 @@
+"""Collective mixer — the production mix as a device collective.
+
+``--mixer collective_mixer``: the control plane stays MessagePack-RPC
+(master election via the coordinator lock, schema sync, a two-phase
+prepare/commit), but the DIFF payload — the reference's get_diff fan-out,
+pairwise fold, and put_diff broadcast (linear_mixer.cpp:437-559) — moves
+onto the accelerator interconnect as one psum across the
+``jax.distributed`` world (parallel/collective.py). This is SURVEY.md §7
+step 3's north-star component: the fold IS the AllReduce combiner, so a
+Criteo-shaped round ships over ICI/DCN at interconnect bandwidth instead
+of TCP through msgpack.
+
+Round protocol (master = this round's lock holder):
+
+1. prepare(round, schema_union): every member syncs the schema, STAGES
+   its local diff under the model lock, and answers (version,
+   shape-signature). Nothing has entered a collective yet.
+2. The master verifies every member staged with identical signatures and
+   that the jax process world matches the member set — any mismatch
+   aborts the round (members discard their staged diff) and the round
+   falls back to the plain RPC mix, so the cluster always mixes.
+3. commit(round, base_version): every member (master included, via its
+   own RPC server) enters ``psum_pytree`` with its staged diff; all
+   replicas receive the identical total and apply it locally with the
+   same obsolete/active semantics as the RPC path.
+
+Failure model: prepare/commit are RPCs with timeouts; once a member has
+entered the collective it blocks until the world completes — a process
+that dies mid-collective is detected by the jax distributed runtime's
+heartbeat (which terminates the world), the same blast radius as losing
+a chip mid-allreduce in any SPMD training step. Engines whose mixables
+are not plain-sum (dict-shaped diffs: bandit, burst, row stores) are
+detected in prepare and served by the RPC fallback path unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from jubatus_tpu.coord.base import NodeInfo
+from jubatus_tpu.framework.linear_mixer import (
+    PROTOCOL_VERSION,
+    RpcLinearMixer,
+)
+
+log = logging.getLogger(__name__)
+
+
+def _summable(mixable: Any) -> bool:
+    return getattr(mixable, "mix", None) is None or \
+        getattr(mixable, "MIX_IS_SUM", False)
+
+
+def _signature(diffs: Dict[str, Any]) -> str:
+    """Canonical shape/dtype signature; every member must match before
+    anyone enters the collective (shape skew would wedge the psum).
+    64-bit leaves report "unsupported": a psum in f32 would be LESS exact
+    than the RPC fold, so those rounds take the fallback."""
+    import jax
+    import numpy as np
+
+    parts: List[str] = []
+    for name in sorted(diffs):
+        leaves, treedef = jax.tree_util.tree_flatten(diffs[name])
+        sigs = []
+        for x in leaves:
+            a = np.asarray(x)
+            if a.dtype in (np.float64, np.int64, np.uint64):
+                return "unsupported"
+            sigs.append(f"{a.shape}/{a.dtype}")
+        parts.append(f"{name}:{treedef}:{','.join(sigs)}")
+    return "|".join(parts)
+
+
+class CollectiveMixer(RpcLinearMixer):
+    """RpcLinearMixer whose round rides the device collective when it can,
+    and the RPC fan-out when it can't (non-sum mixables, world mismatch,
+    prepare failures)."""
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._staged_lock = threading.Lock()
+        self._staged: Dict[str, Dict[str, Any]] = {}
+        self._round_seq = 0
+        self.collective_rounds = 0
+        self.fallback_rounds = 0
+
+    # -- RPC surface ---------------------------------------------------------
+    def register_api(self, rpc_server, name_check: str = "") -> None:
+        super().register_api(rpc_server, name_check)
+        rpc_server.register(
+            "mix_prepare", lambda _n, rid, union: self.local_prepare(rid, union))
+        rpc_server.register(
+            "mix_commit", lambda _n, rid, base: self.local_commit(rid, base))
+        rpc_server.register(
+            "mix_abort", lambda _n, rid: self.local_abort(rid))
+
+    # -- member-side phases --------------------------------------------------
+    def local_prepare(self, rid, union) -> List[Any]:
+        rid = rid.decode() if isinstance(rid, bytes) else rid
+        union = [u.decode() if isinstance(u, bytes) else u for u in union]
+        with self.driver.lock:
+            if union and hasattr(self.driver, "sync_schema"):
+                self.driver.sync_schema(union)
+            mixables = self.driver.get_mixables()
+            if not all(_summable(m) for m in mixables.values()):
+                return [int(self.model_version), "unsupported"]
+            diffs = {name: m.get_diff() for name, m in mixables.items()}
+        with self._staged_lock:
+            # one staged round at a time: a newer prepare supersedes any
+            # stale round a dead master left behind
+            self._staged = {rid: {"diffs": diffs, "union": union}}
+        return [int(self.model_version), _signature(diffs)]
+
+    def local_commit(self, rid, base_version) -> bool:
+        rid = rid.decode() if isinstance(rid, bytes) else rid
+        with self._staged_lock:
+            entry = self._staged.pop(rid, None)
+        if entry is None:
+            log.warning("commit for unknown round %s", rid)
+            return False
+        from jubatus_tpu.parallel.collective import psum_pytree
+
+        totals = psum_pytree(entry["diffs"])
+        return self.local_put_obj({
+            "protocol": PROTOCOL_VERSION,
+            "schema": entry["union"],
+            "base_version": int(base_version),
+            "diffs": totals,
+        })
+
+    def local_abort(self, rid) -> bool:
+        rid = rid.decode() if isinstance(rid, bytes) else rid
+        with self._staged_lock:
+            return self._staged.pop(rid, None) is not None
+
+    # -- master round --------------------------------------------------------
+    def _run_as_master(self, members: Sequence[NodeInfo]) -> Optional[Dict[str, Any]]:
+        import time
+
+        import jax
+
+        if jax.process_count() != len(members):
+            # replicas are not one jax world (or not all joined yet):
+            # the collective cannot span them — mix over RPC
+            self.fallback_rounds += 1
+            return super()._run_as_master(members)
+        t0 = time.monotonic()
+        schemas = self.comm.get_schemas() if self._has_schema() else []
+        union: List[str] = sorted(
+            set().union(*(set(s) for s in schemas))) if schemas else []
+        union = [s.decode() if isinstance(s, bytes) else s for s in union]
+
+        self._round_seq += 1
+        rid = f"{self.self_node.name if self.self_node else 'm'}:{self._round_seq}"
+        results, errors = self.comm.collect("mix_prepare", rid, union)
+        sigs = {r[1] if not isinstance(r[1], bytes) else r[1].decode()
+                for _, r in results}
+        if errors or len(results) != len(members) or len(sigs) != 1 \
+                or "unsupported" in sigs:
+            self.comm.collect("mix_abort", rid)
+            self.fallback_rounds += 1
+            log.info("collective round %s not viable (%d errors, sigs %s); "
+                     "falling back to rpc mix", rid, len(errors), len(sigs))
+            return super()._run_as_master(members)
+        base_version = max(int(r[0]) for _, r in results)
+
+        acks_raw, commit_errors = self.comm.collect("mix_commit", rid,
+                                                    base_version)
+        acks = {f"{h}_{p}": bool(r) for (h, p), r in acks_raw}
+        for e in commit_errors:
+            acks[f"{e.host}_{e.port}"] = False
+        for member in members:
+            if not acks.get(member.name, False):
+                self.comm.register_active(member, False)
+        self.collective_rounds += 1
+        self.mix_count += 1
+        log.info("collective mix round %d: %d members, %.3fs",
+                 self.mix_count, len(members), time.monotonic() - t0)
+        return {"members": len(members), "collective": True}
+
+    def get_status(self) -> Dict[str, Any]:
+        st = super().get_status()
+        st.update(collective_rounds=self.collective_rounds,
+                  fallback_rounds=self.fallback_rounds)
+        return st

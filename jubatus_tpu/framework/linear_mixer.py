@@ -183,6 +183,11 @@ class RpcLinearCommunication(LinearCommunication):
         with RpcClient(member.host, member.port, self.timeout) as c:
             return c.call("mix_get_model", self.name)
 
+    def collect(self, method: str, *args):
+        """Generic parallel fan-out to all members (collective mixer's
+        prepare/commit/abort control RPCs); returns (results, errors)."""
+        return self._mc.call_collect(method, self.name, *args)
+
     def close(self) -> None:
         if self._mc is not None:
             self._mc.close()
@@ -286,7 +291,12 @@ class RpcLinearMixer:
         return pack_mix(self.local_diff_obj())
 
     def local_put_diff(self, packed: bytes) -> bool:
-        msg = unpack_mix(packed)
+        return self.local_put_obj(unpack_mix(packed))
+
+    def local_put_obj(self, msg) -> bool:
+        """Apply a reduced-diff message already in object form (the
+        collective mixer lands its psum result here without a wire
+        pack/unpack round-trip)."""
         if msg.get("protocol") != PROTOCOL_VERSION:
             log.error("mix protocol mismatch: %s", msg.get("protocol"))
             return False

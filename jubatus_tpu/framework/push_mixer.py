@@ -272,9 +272,14 @@ def create_mixer(name: str, driver: Any, comm: LinearCommunication, *,
                   interval_count=interval_count)
     if name == "linear_mixer":
         return RpcLinearMixer(driver, comm, **kwargs)
+    if name == "collective_mixer":
+        from jubatus_tpu.framework.collective_mixer import CollectiveMixer
+
+        return CollectiveMixer(driver, comm, **kwargs)
     if name in STRATEGIES:
         return RpcPushMixer(driver, comm, strategy=name, **kwargs)
     if name == "dummy_mixer":
         return DummyMixer()
     raise ValueError(f"unknown mixer {name!r}; known: linear_mixer, "
-                     f"{', '.join(sorted(STRATEGIES))}, dummy_mixer")
+                     f"collective_mixer, {', '.join(sorted(STRATEGIES))}, "
+                     "dummy_mixer")

@@ -1,0 +1,88 @@
+"""Cross-process diff reduction — the mix's data plane as an XLA collective.
+
+``psum_pytree`` reduces one pytree of numpy arrays across every process
+in the ``jax.distributed`` world: each process contributes its local
+replica's diff, the reduction runs as a single jitted shard_map psum over
+a one-device-per-process 'replica' mesh (ICI/DCN, not TCP fan-out), and
+every process reads back the identical total. This is SURVEY.md §7 step
+3's north-star shape: the reference's get_diff → pairwise fold →
+put_diff (linear_mixer.cpp:437-559) collapses into one AllReduce whose
+combiner IS the fold.
+
+Requirements: every process calls with the SAME treedef/shapes/dtypes in
+the same order (the collective mixer's prepare phase verifies this before
+anyone enters), and the jax runtime must be initialized across the world
+(jax.distributed.initialize — parallel/multihost.py). Works single-process
+too (world of 1: psum degenerates to identity), which is what the driver
+dry run exercises.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _world_mesh() -> Mesh:
+    """1-D 'replica' mesh with exactly one device per process (the first
+    local device of each), in process order — every process builds the
+    identical mesh."""
+    per_process: Dict[int, Any] = {}
+    for d in jax.devices():
+        p = d.process_index
+        if p not in per_process or d.id < per_process[p].id:
+            per_process[p] = d
+    devs = [per_process[p] for p in sorted(per_process)]
+    return Mesh(np.array(devs), axis_names=("replica",))
+
+
+@functools.lru_cache(maxsize=32)
+def _reduce_fn(mesh: Mesh, treedef, shapes: Tuple, dtypes: Tuple):
+    def body(stacked):
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(jnp.squeeze(x, 0), "replica"), stacked)
+
+    return jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=P("replica"), out_specs=P()),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+
+def psum_pytree(diff: Any) -> Any:
+    """AllReduce ``diff`` (pytree of arrays/scalars) across the process
+    world; returns the total as host numpy arrays. Every process must
+    call this with an identically-shaped pytree."""
+    mesh = _world_mesh()
+    n = mesh.shape["replica"]
+    me = jax.local_devices()[0]
+    sharding = NamedSharding(mesh, P("replica"))
+
+    leaves, treedef = jax.tree_util.tree_flatten(diff)
+    arrs = []
+    for leaf in leaves:
+        local = np.asarray(leaf)
+        if local.dtype in (np.float64, np.int64, np.uint64):
+            # a silent downcast would make the collective path less exact
+            # than the RPC fold; callers gate these to the fallback
+            # (collective_mixer._signature marks them unsupported)
+            raise ValueError(
+                f"64-bit leaf dtype {local.dtype} cannot ride the "
+                "collective exactly; use the RPC mix path")
+        shard = jax.device_put(local[None, ...], me)
+        arrs.append(jax.make_array_from_single_device_arrays(
+            (n,) + local.shape, sharding, [shard]))
+    stacked = jax.tree_util.tree_unflatten(treedef, arrs)
+    shapes = tuple(a.shape for a in arrs)
+    dtypes = tuple(str(a.dtype) for a in arrs)
+    total = _reduce_fn(mesh, treedef, shapes, dtypes)(stacked)
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x.addressable_shards[0].data), total)
+
+
+def world_size() -> int:
+    return jax.process_count()

@@ -24,6 +24,22 @@ def main(argv=None) -> int:
     setup(f"juba{args.engine}", args.eth, args.rpc_port,
           logdir=args.logdir, log_config=args.log_config)
     install_sighup_reload(args.log_config)
+    coord = None
+    if args.jax_processes > 1:
+        # must run BEFORE anything initializes the XLA backend. The
+        # coordinator session stays open and is handed to the server:
+        # process 0's published jax endpoint is an ephemeral owned by it.
+        from jubatus_tpu.coord import create_coordinator
+        from jubatus_tpu.parallel import multihost
+
+        coord = (create_coordinator(args.coordinator)
+                 if not args.is_standalone else None)
+        multihost.initialize(
+            coordinator_address=args.jax_coordinator or None,
+            num_processes=args.jax_processes,
+            process_id=args.jax_process_id,
+            coord=coord,
+        )
     if args.config_test:
         # dry-construct and exit (server_util.hpp:142-152)
         try:
@@ -33,7 +49,7 @@ def main(argv=None) -> int:
             return 1
         print("config ok")
         return 0
-    server = EngineServer.from_args(args)
+    server = EngineServer.from_args(args, coord=coord)
     signal.signal(signal.SIGTERM, lambda *_: server.stop())
     signal.signal(signal.SIGINT, lambda *_: server.stop())
     server.start()

@@ -47,6 +47,14 @@ class ServerArgs:
     #: clients require (their vendored msgpack predates those types);
     #: mixer internals keep the modern format (rpc/legacy.py)
     legacy_wire: bool = False
+    #: jax.distributed world for --mixer collective_mixer: every replica
+    #: process must join one runtime so the mix's diff psum can span them
+    #: (parallel/multihost.py). Process 0's address doubles as the
+    #: coordinator endpoint; peers may omit it when the coordination
+    #: store publishes it.
+    jax_coordinator: str = ""       # host:port of jax process 0
+    jax_processes: int = 0          # world size; 0 = no distributed init
+    jax_process_id: int = -1
 
     @property
     def is_standalone(self) -> bool:
@@ -98,7 +106,8 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "empty = standalone")
     p.add_argument("-n", "--name", default="")
     p.add_argument("-x", "--mixer", default="linear_mixer",
-                   choices=["linear_mixer", "random_mixer", "broadcast_mixer",
+                   choices=["linear_mixer", "collective_mixer",
+                            "random_mixer", "broadcast_mixer",
                             "skip_mixer", "dummy_mixer"])
     p.add_argument("-s", "--interval-sec", type=float, default=16.0)
     p.add_argument("-i", "--interval-count", type=int, default=512)
@@ -119,6 +128,15 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                    help="pack RPC responses in the pre-str8/bin msgpack "
                         "format so unmodified legacy jubatus clients "
                         "(vendored pre-2013 msgpack) can parse them")
+    p.add_argument("--jax-coordinator", default="",
+                   help="jax.distributed coordinator host:port (process "
+                        "0's reachable address) for --mixer "
+                        "collective_mixer")
+    p.add_argument("--jax-processes", type=int, default=0,
+                   help="jax.distributed world size (replica process "
+                        "count); 0 disables distributed jax init")
+    p.add_argument("--jax-process-id", type=int, default=-1,
+                   help="this process's rank in the jax world")
     return p
 
 

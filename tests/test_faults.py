@@ -263,10 +263,12 @@ def test_push_gossip_shrugs_off_failed_peer():
 
 # --------------------------------------------------------- coord chaos ----
 def test_heartbeat_loss_fires_suicide_watchers():
-    """Repeated heartbeat failure = ZK session loss: the client fires its
-    delete watchers (the suicide path, server_helper.cpp:91-94) and the
-    server side expires the session's ephemerals. The rpc.call site covers
-    the coordination plane for free — heartbeats ride the same client."""
+    """Heartbeat failure AND failed session resumption = ZK session loss:
+    the client fires its delete watchers (the suicide path,
+    server_helper.cpp:91-94) and the server side expires the session's
+    ephemerals. coord_open must be faulted too — with a reachable
+    coordinator the client now legitimately RESUMES instead of dying
+    (coord/remote.py _try_resume; test_coord_service covers that path)."""
     from jubatus_tpu.coord.remote import RemoteCoordinator
     from jubatus_tpu.coord.server import CoordServer
 
@@ -274,14 +276,15 @@ def test_heartbeat_loss_fires_suicide_watchers():
     port = srv.start(0)
     b = None
     try:
-        a = RemoteCoordinator("127.0.0.1", port)
+        a = RemoteCoordinator("127.0.0.1", port, resume_window_sec=2.0)
         a.create("/chaos/me", ephemeral=True)
         died = []
         a.watch_delete("/chaos/me", lambda p: died.append(p))
         # the pattern hits EVERY session's heartbeats on this port, so the
         # observer client is created only after the fault window closes
-        with faults.armed(f"rpc.call.coord_heartbeat.*:{port}:error"):
-            deadline = time.time() + 15
+        with faults.armed(f"rpc.call.coord_heartbeat.*:{port}:error",
+                          f"rpc.call.coord_open.*:{port}:error"):
+            deadline = time.time() + 20
             while time.time() < deadline and not died:
                 time.sleep(0.1)
         assert died == ["/chaos/me"], "suicide watcher never fired"
