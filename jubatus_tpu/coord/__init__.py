@@ -46,9 +46,16 @@ def create_coordinator(spec: str) -> Coordinator:
     "/path" / "file:///path"      → FileCoordinator on that directory
     "tcp://host:port", "host:port" → RemoteCoordinator session on the
                                      coordination service (coord/server.py)
+    "zk://host:port[,host:port...]" → ZkCoordinator session on a real
+                                     ZooKeeper ensemble (coord/zk.py) —
+                                     drop-in for existing deployments
     """
     if spec in ("memory", "memory://"):
         return MemoryCoordinator.shared()
+    if spec.startswith("zk://"):
+        from jubatus_tpu.coord.zk import ZkCoordinator
+
+        return ZkCoordinator.from_locator(spec)
     if spec.startswith("file://"):
         return FileCoordinator(spec[len("file://") :])
     if spec.startswith("/") or spec.startswith("."):

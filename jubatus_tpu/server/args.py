@@ -102,8 +102,10 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
     p.add_argument("-D", "--daemon", action="store_true")
     p.add_argument("--config-test", action="store_true")
     p.add_argument("-z", "--coordinator", default="",
-                   help="coordination backend: shared dir path or 'memory'; "
-                        "empty = standalone")
+                   help="coordination backend: tcp://host:port (coordd), "
+                        "zk://host:port[,host:port...] (a real ZooKeeper "
+                        "ensemble — drop-in for existing deployments), a "
+                        "shared dir path, or 'memory'; empty = standalone")
     p.add_argument("-n", "--name", default="")
     p.add_argument("-x", "--mixer", default="linear_mixer",
                    choices=["linear_mixer", "collective_mixer",

@@ -1,0 +1,288 @@
+"""Minimal in-process ZooKeeper server for protocol-level tests.
+
+Speaks the same jute wire as coord/zk.py's client: session handshake,
+create (persistent/ephemeral/sequence), delete, exists, getData,
+setData, getChildren, one-shot watches, ping, closeSession. One session
+per connection; a closed/dead connection drops its ephemerals and fires
+watches, like the real thing. Enough ZooKeeper to prove the client's
+encoding, watch re-arm, and session semantics without a live quorum —
+the real-ZK integration tests gate on JUBATUS_TPU_ZK.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+def _rd_i32(b, off):
+    return struct.unpack_from(">i", b, off)[0], off + 4
+
+
+def _rd_i64(b, off):
+    return struct.unpack_from(">q", b, off)[0], off + 8
+
+
+def _rd_str(b, off):
+    n, off = _rd_i32(b, off)
+    if n < 0:
+        return "", off
+    return b[off:off + n].decode(), off + n
+
+
+def _rd_buf(b, off):
+    n, off = _rd_i32(b, off)
+    if n < 0:
+        return b"", off
+    return bytes(b[off:off + n]), off + n
+
+
+def _w_str(s):
+    raw = s.encode()
+    return struct.pack(">i", len(raw)) + raw
+
+
+def _w_buf(v):
+    return struct.pack(">i", len(v)) + v
+
+
+def _w_stat(version=0, ephemeral_owner=0, num_children=0, data_len=0):
+    return (struct.pack(">qqqq", 0, 0, 0, 0)
+            + struct.pack(">iii", version, 0, 0)
+            + struct.pack(">q", ephemeral_owner)
+            + struct.pack(">ii", data_len, num_children)
+            + struct.pack(">q", 0))
+
+
+class _Node:
+    __slots__ = ("data", "owner", "version")
+
+    def __init__(self, data=b"", owner=0):
+        self.data = data
+        self.owner = owner
+        self.version = 0
+
+
+class FakeZkServer:
+    ZOK, ZNONODE, ZNODEEXISTS, ZNOTEMPTY = 0, -101, -110, -111
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.nodes: Dict[str, _Node] = {"/": _Node()}
+        self.seq = 0
+        #: (path, kind) -> list of (conn, wlock); kind "data" | "child"
+        self._watches: Dict[Tuple[str, str], List] = {}
+        self._sock: Optional[socket.socket] = None
+        self._next_session = 1
+        self.port: Optional[int] = None
+        self._running = False
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self, port: int = 0) -> int:
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", port))
+        s.listen(16)
+        self._sock = s
+        self.port = s.getsockname()[1]
+        self._running = True
+        threading.Thread(target=self._accept, daemon=True,
+                         name="fakezk-accept").start()
+        return self.port
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- wire -----------------------------------------------------------------
+    def _accept(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True,
+                             name="fakezk-conn").start()
+
+    @staticmethod
+    def _read_frame(conn) -> bytes:
+        hdr = b""
+        while len(hdr) < 4:
+            c = conn.recv(4 - len(hdr))
+            if not c:
+                raise OSError("closed")
+            hdr += c
+        (n,) = struct.unpack(">i", hdr)
+        body = b""
+        while len(body) < n:
+            c = conn.recv(n - len(body))
+            if not c:
+                raise OSError("closed")
+            body += c
+        return body
+
+    def _serve(self, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+        with self._lock:
+            session = self._next_session
+            self._next_session += 1
+        try:
+            req = self._read_frame(conn)
+            off = 0
+            _, off = _rd_i32(req, off)       # protocolVersion
+            _, off = _rd_i64(req, off)       # lastZxid
+            timeout, off = _rd_i32(req, off)
+            resp = (struct.pack(">i", 0) + struct.pack(">i", timeout)
+                    + struct.pack(">q", session)
+                    + struct.pack(">i", 16) + b"\x00" * 16)
+            with wlock:
+                conn.sendall(struct.pack(">i", len(resp)) + resp)
+            while True:
+                frame = self._read_frame(conn)
+                xid, off = _rd_i32(frame, 0)
+                op, off = _rd_i32(frame, off)
+                if op == 11:                 # ping
+                    self._reply(conn, wlock, -2, 0, b"")
+                    continue
+                if op == -11:                # closeSession
+                    self._reply(conn, wlock, xid, 0, b"")
+                    return
+                err, payload = self._dispatch(op, frame, off, session,
+                                              conn, wlock)
+                self._reply(conn, wlock, xid, err, payload)
+        except OSError:
+            pass
+        finally:
+            self._drop_session(session)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _reply(conn, wlock, xid, err, payload) -> None:
+        frame = struct.pack(">iqi", xid, 0, err) + payload
+        try:
+            with wlock:
+                conn.sendall(struct.pack(">i", len(frame)) + frame)
+        except OSError:
+            pass
+
+    def _notify(self, path: str, kind: str, ev_type: int) -> None:
+        with self._lock:
+            targets = self._watches.pop((path, kind), [])
+        ev = (struct.pack(">iqi", -1, 0, 0)
+              + struct.pack(">ii", ev_type, 3) + _w_str(path))
+        for conn, wlock in targets:
+            try:
+                with wlock:
+                    conn.sendall(struct.pack(">i", len(ev)) + ev)
+            except OSError:
+                pass
+
+    def _fire_for(self, path: str, ev_type: int) -> None:
+        self._notify(path, "data", ev_type)
+        parent = path.rsplit("/", 1)[0] or "/"
+        self._notify(parent, "child", 4)
+
+    # -- ops ------------------------------------------------------------------
+    def _dispatch(self, op, frame, off, session, conn, wlock):
+        if op == 1:                          # create
+            path, off = _rd_str(frame, off)
+            data, off = _rd_buf(frame, off)
+            nacl, off = _rd_i32(frame, off)
+            for _ in range(nacl):
+                _, off = _rd_i32(frame, off)
+                _, off = _rd_str(frame, off)
+                _, off = _rd_str(frame, off)
+            flags, off = _rd_i32(frame, off)
+            with self._lock:
+                parent = path.rsplit("/", 1)[0] or "/"
+                if parent not in self.nodes:
+                    return self.ZNONODE, b""
+                if flags & 2:                # sequence
+                    path = f"{path}{self.seq:010d}"
+                    self.seq += 1
+                if path in self.nodes:
+                    return self.ZNODEEXISTS, b""
+                self.nodes[path] = _Node(
+                    data, session if flags & 1 else 0)
+            self._fire_for(path, 1)
+            return 0, _w_str(path)
+        if op == 2:                          # delete
+            path, off = _rd_str(frame, off)
+            with self._lock:
+                if path not in self.nodes:
+                    return self.ZNONODE, b""
+                prefix = path + "/"
+                if any(p.startswith(prefix) for p in self.nodes):
+                    return self.ZNOTEMPTY, b""
+                del self.nodes[path]
+            self._fire_for(path, 2)
+            return 0, b""
+        if op == 3:                          # exists
+            path, off = _rd_str(frame, off)
+            watch = frame[off] != 0
+            with self._lock:
+                node = self.nodes.get(path)
+                if watch:
+                    self._watches.setdefault((path, "data"), []).append(
+                        (conn, wlock))
+            if node is None:
+                return self.ZNONODE, b""
+            return 0, _w_stat(node.version, node.owner,
+                              data_len=len(node.data))
+        if op == 4:                          # getData
+            path, off = _rd_str(frame, off)
+            watch = frame[off] != 0
+            with self._lock:
+                node = self.nodes.get(path)
+                if node is not None and watch:
+                    self._watches.setdefault((path, "data"), []).append(
+                        (conn, wlock))
+            if node is None:
+                return self.ZNONODE, b""
+            return 0, _w_buf(node.data) + _w_stat(node.version, node.owner,
+                                                  data_len=len(node.data))
+        if op == 5:                          # setData
+            path, off = _rd_str(frame, off)
+            data, off = _rd_buf(frame, off)
+            with self._lock:
+                node = self.nodes.get(path)
+                if node is None:
+                    return self.ZNONODE, b""
+                node.data = data
+                node.version += 1
+                version = node.version
+            self._notify(path, "data", 3)
+            return 0, _w_stat(version, 0, data_len=len(data))
+        if op == 8:                          # getChildren
+            path, off = _rd_str(frame, off)
+            watch = frame[off] != 0
+            with self._lock:
+                if path not in self.nodes:
+                    return self.ZNONODE, b""
+                prefix = path.rstrip("/") + "/"
+                kids = sorted({p[len(prefix):].split("/", 1)[0]
+                               for p in self.nodes if p.startswith(prefix)})
+                if watch:
+                    self._watches.setdefault((path, "child"), []).append(
+                        (conn, wlock))
+            out = struct.pack(">i", len(kids))
+            for k in kids:
+                out += _w_str(k)
+            return 0, out
+        return -6, b""                       # unimplemented
+
+    def _drop_session(self, session: int) -> None:
+        with self._lock:
+            mine = [p for p, n in self.nodes.items() if n.owner == session]
+            for p in mine:
+                del self.nodes[p]
+        for p in mine:
+            self._fire_for(p, 2)
