@@ -1,10 +1,10 @@
 """Collective mixer — the production mix as a device collective.
 
-``--mixer collective_mixer``: the control plane stays MessagePack-RPC
-(master election via the coordinator lock, schema sync, a two-phase
-prepare/commit), but the DIFF payload — the reference's get_diff fan-out,
-pairwise fold, and put_diff broadcast (linear_mixer.cpp:437-559) — moves
-onto the accelerator interconnect as one psum across the
+``--mixer collective_mixer``: the control plane stays on the coordinator
+and RPC (master election via the coordinator lock, schema sync, a
+two-phase prepare/GO), but the DIFF payload — the reference's get_diff
+fan-out, pairwise fold, and put_diff broadcast (linear_mixer.cpp:437-559)
+— moves onto the accelerator interconnect as one psum across the
 ``jax.distributed`` world (parallel/collective.py). This is SURVEY.md §7
 step 3's north-star component: the fold IS the AllReduce combiner, so a
 Criteo-shaped round ships over ICI/DCN at interconnect bandwidth instead
@@ -12,40 +12,57 @@ of TCP through msgpack.
 
 Round protocol (master = this round's lock holder):
 
-1. prepare(round, schema_union): every member syncs the schema, STAGES
-   its local diff under the model lock, and answers (version,
-   shape-signature). Nothing has entered a collective yet.
+1. ``mix_prepare(round, schema_union)`` (RPC): every member syncs the
+   schema, STAGES its local diff under the model lock, starts a GO
+   waiter, and answers (version, shape-signature). Nothing has entered a
+   collective yet.
 2. The master verifies every member staged with identical signatures and
    that the jax process world matches the member set — any mismatch
-   aborts the round (members discard their staged diff) and the round
-   falls back to the plain RPC mix, so the cluster always mixes.
-3. commit(round, base_version): every member (master included, via its
-   own RPC server) enters ``psum_pytree`` with its staged diff; all
-   replicas receive the identical total and apply it locally with the
-   same obsolete/active semantics as the RPC path.
+   aborts the round (members discard their staged diff; waiters exit)
+   and the round falls back to the plain RPC mix, so the cluster always
+   mixes.
+3. The master writes a GO marker into the COORDINATOR (not an RPC): a
+   member enters the collective only when it OBSERVES the marker, and
+   every live member polling shared state eventually observes it — no
+   single dropped message can leave part of the world inside the psum
+   (the failure the commit-RPC design had). Each member then enters
+   ``psum_pytree`` with its staged diff, applies the identical total
+   with the same obsolete/active semantics as the RPC path, and writes
+   an ack node the master folds into the actives transitions.
 
-Failure model: prepare/commit are RPCs with timeouts; once a member has
-entered the collective it blocks until the world completes — a process
-that dies mid-collective is detected by the jax distributed runtime's
-heartbeat (which terminates the world), the same blast radius as losing
-a chip mid-allreduce in any SPMD training step. Engines whose mixables
-are not plain-sum (dict-shaped diffs: bandit, burst, row stores) are
-detected in prepare and served by the RPC fallback path unchanged.
+Failure model, closed loop: a member that never observes GO times out
+and discards its stage (it never entered). A member that dies after
+entering is detected by the jax distributed runtime's heartbeat, which
+tears the world down and errors the psum out on everyone — the blast
+radius of losing a chip mid-allreduce in any SPMD step. A member that
+loses the coordinator stops via its own session handling, which is the
+same death the runtime then detects. Engines whose mixables are not
+plain-sum (dict-shaped diffs: bandit, burst, row stores) are detected in
+prepare and served by the RPC fallback path unchanged.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
+from jubatus_tpu.coord import membership
 from jubatus_tpu.coord.base import NodeInfo
 from jubatus_tpu.framework.linear_mixer import (
     PROTOCOL_VERSION,
     RpcLinearMixer,
 )
+from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
 
 log = logging.getLogger(__name__)
+
+#: how long a prepared member waits to observe the GO marker before
+#: discarding its staged diff (master write + coordinator poll latency;
+#: generous because nobody is blocked in a collective while waiting)
+GO_WAIT_SEC = 20.0
+_GO_POLL_SEC = 0.05
 
 
 def _summable(mixable: Any) -> bool:
@@ -87,13 +104,20 @@ class CollectiveMixer(RpcLinearMixer):
         self.collective_rounds = 0
         self.fallback_rounds = 0
 
+    # -- coordinator paths ----------------------------------------------------
+    def _go_path(self) -> str:
+        actor = membership.actor_path(self.comm.engine, self.comm.name)
+        return f"{actor}/collective_go"
+
+    def _ack_path(self, rid: str, node_name: str) -> str:
+        actor = membership.actor_path(self.comm.engine, self.comm.name)
+        return f"{actor}/collective_acks/{rid.replace('/', '_')}/{node_name}"
+
     # -- RPC surface ---------------------------------------------------------
     def register_api(self, rpc_server, name_check: str = "") -> None:
         super().register_api(rpc_server, name_check)
         rpc_server.register(
             "mix_prepare", lambda _n, rid, union: self.local_prepare(rid, union))
-        rpc_server.register(
-            "mix_commit", lambda _n, rid, base: self.local_commit(rid, base))
         rpc_server.register(
             "mix_abort", lambda _n, rid: self.local_abort(rid))
 
@@ -110,16 +134,68 @@ class CollectiveMixer(RpcLinearMixer):
             diffs = {name: m.get_diff() for name, m in mixables.items()}
         with self._staged_lock:
             # one staged round at a time: a newer prepare supersedes any
-            # stale round a dead master left behind
+            # stale round a dead master left behind (its waiter sees the
+            # stage gone and exits)
             self._staged = {rid: {"diffs": diffs, "union": union}}
+        threading.Thread(target=self._wait_for_go, args=(rid,), daemon=True,
+                         name="mix-go-wait").start()
         return [int(self.model_version), _signature(diffs)]
 
-    def local_commit(self, rid, base_version) -> bool:
+    def local_abort(self, rid) -> bool:
         rid = rid.decode() if isinstance(rid, bytes) else rid
+        with self._staged_lock:
+            return self._staged.pop(rid, None) is not None
+
+    def _wait_for_go(self, rid: str) -> None:
+        """Observe the GO marker, then enter the collective. Every live
+        prepared member runs this; entering only on OBSERVED shared state
+        is what makes partial entry impossible for live members."""
+        deadline = time.monotonic() + GO_WAIT_SEC
+        base: Optional[int] = None
+        while time.monotonic() < deadline:
+            with self._staged_lock:
+                if rid not in self._staged:
+                    return  # aborted or superseded
+            try:
+                raw = self.comm.coord.read(self._go_path())
+            except Exception:  # noqa: BLE001 — transient coordinator issue
+                raw = None
+            if raw:
+                try:
+                    msg = unpack_obj(raw)
+                except Exception:  # noqa: BLE001
+                    msg = None
+                if msg:
+                    got = msg.get("rid")
+                    got = got.decode() if isinstance(got, bytes) else got
+                    if got == rid:
+                        base = int(msg.get("base", 0))
+                        break
+            time.sleep(_GO_POLL_SEC)
+        if base is None:
+            with self._staged_lock:
+                dropped = self._staged.pop(rid, None)
+            if dropped is not None:
+                log.warning("round %s: no GO within %.0fs; staged diff "
+                            "discarded", rid, GO_WAIT_SEC)
+            return
+        ok = False
+        try:
+            ok = self._enter_collective(rid, base)
+        except Exception:  # noqa: BLE001 — world torn down mid-psum
+            log.exception("collective entry failed for round %s", rid)
+        if self.self_node is not None:
+            try:
+                self.comm.coord.set(
+                    self._ack_path(rid, self.self_node.name),
+                    b"1" if ok else b"0")
+            except Exception:  # noqa: BLE001
+                log.warning("ack write failed for round %s", rid)
+
+    def _enter_collective(self, rid: str, base_version: int) -> bool:
         with self._staged_lock:
             entry = self._staged.pop(rid, None)
         if entry is None:
-            log.warning("commit for unknown round %s", rid)
             return False
         from jubatus_tpu.parallel.collective import psum_pytree
 
@@ -127,19 +203,12 @@ class CollectiveMixer(RpcLinearMixer):
         return self.local_put_obj({
             "protocol": PROTOCOL_VERSION,
             "schema": entry["union"],
-            "base_version": int(base_version),
+            "base_version": base_version,
             "diffs": totals,
         })
 
-    def local_abort(self, rid) -> bool:
-        rid = rid.decode() if isinstance(rid, bytes) else rid
-        with self._staged_lock:
-            return self._staged.pop(rid, None) is not None
-
     # -- master round --------------------------------------------------------
     def _run_as_master(self, members: Sequence[NodeInfo]) -> Optional[Dict[str, Any]]:
-        import time
-
         import jax
 
         if jax.process_count() != len(members):
@@ -154,7 +223,7 @@ class CollectiveMixer(RpcLinearMixer):
         union = [s.decode() if isinstance(s, bytes) else s for s in union]
 
         self._round_seq += 1
-        rid = f"{self.self_node.name if self.self_node else 'm'}:{self._round_seq}"
+        rid = f"{self.self_node.name if self.self_node else 'm'}-{self._round_seq}-{self.model_version}"
         results, errors = self.comm.collect("mix_prepare", rid, union)
         sigs = {r[1] if not isinstance(r[1], bytes) else r[1].decode()
                 for _, r in results}
@@ -167,19 +236,36 @@ class CollectiveMixer(RpcLinearMixer):
             return super()._run_as_master(members)
         base_version = max(int(r[0]) for _, r in results)
 
-        acks_raw, commit_errors = self.comm.collect("mix_commit", rid,
-                                                    base_version)
-        acks = {f"{h}_{p}": bool(r) for (h, p), r in acks_raw}
-        for e in commit_errors:
-            acks[f"{e.host}_{e.port}"] = False
+        # GO rides the coordinator: every live prepared member observes it
+        self.comm.coord.set(self._go_path(),
+                            pack_obj({"rid": rid, "base": base_version}))
+        # collect acks — the members' waiters (this process included)
+        # enter, apply, and ack; psum completion is world-wide or nobody's
+        acks: Dict[str, bool] = {}
+        deadline = time.monotonic() + GO_WAIT_SEC + 10.0
+        while time.monotonic() < deadline and len(acks) < len(members):
+            for member in members:
+                if member.name in acks:
+                    continue
+                raw = self.comm.coord.read(self._ack_path(rid, member.name))
+                if raw is not None:
+                    acks[member.name] = raw == b"1"
+            if len(acks) < len(members):
+                time.sleep(_GO_POLL_SEC)
         for member in members:
+            self.comm.coord.remove(self._ack_path(rid, member.name))
             if not acks.get(member.name, False):
                 self.comm.register_active(member, False)
+        if not acks:
+            log.error("collective round %s: no member acked", rid)
+            return None
         self.collective_rounds += 1
         self.mix_count += 1
-        log.info("collective mix round %d: %d members, %.3fs",
-                 self.mix_count, len(members), time.monotonic() - t0)
-        return {"members": len(members), "collective": True}
+        log.info("collective mix round %d: %d members (%d acked), %.3fs",
+                 self.mix_count, len(members), sum(acks.values()),
+                 time.monotonic() - t0)
+        return {"members": len(members), "collective": True,
+                "acked": sum(acks.values())}
 
     def get_status(self) -> Dict[str, Any]:
         st = super().get_status()

@@ -24,6 +24,21 @@ def main(argv=None) -> int:
     setup(f"juba{args.engine}", args.eth, args.rpc_port,
           logdir=args.logdir, log_config=args.log_config)
     install_sighup_reload(args.log_config)
+    if args.config_test:
+        # dry-construct and exit (server_util.hpp:142-152) — a LOCAL
+        # check: never joins the jax world (that would block on the rest
+        # of the fleet booting)
+        srv = None
+        try:
+            srv = EngineServer.from_args(args)
+        except Exception as e:  # noqa: BLE001
+            print(f"config error: {e}", file=sys.stderr)
+            return 1
+        finally:
+            if srv is not None and srv.coord is not None:
+                srv.coord.close()
+        print("config ok")
+        return 0
     coord = None
     if args.jax_processes > 1:
         # must run BEFORE anything initializes the XLA backend. The
@@ -40,15 +55,6 @@ def main(argv=None) -> int:
             process_id=args.jax_process_id,
             coord=coord,
         )
-    if args.config_test:
-        # dry-construct and exit (server_util.hpp:142-152)
-        try:
-            EngineServer.from_args(args)
-        except Exception as e:  # noqa: BLE001
-            print(f"config error: {e}", file=sys.stderr)
-            return 1
-        print("config ok")
-        return 0
     server = EngineServer.from_args(args, coord=coord)
     signal.signal(signal.SIGTERM, lambda *_: server.stop())
     signal.signal(signal.SIGINT, lambda *_: server.stop())

@@ -170,3 +170,43 @@ def test_multiprocess_collective_mix(tmp_path):
         assert p.returncode == 0, f"child {i}:\n{out[-3000:]}"
         assert f"CHILD-{i}-OK" in out
     assert any("MASTER-ROUND" in o for o in outs)
+
+
+def test_prepared_member_discards_stage_without_go(monkeypatch):
+    """A member whose round never receives the GO marker must discard its
+    staged diff and never enter a collective (code-review: the commit-RPC
+    design could wedge live members inside the psum)."""
+    import jubatus_tpu.framework.collective_mixer as cm
+
+    monkeypatch.setattr(cm, "GO_WAIT_SEC", 0.4)
+    store = _Store()
+    args = ServerArgs(engine="classifier", coordinator="(shared)",
+                      name=NAME, listen_addr="127.0.0.1",
+                      mixer="collective_mixer",
+                      interval_sec=1e9, interval_count=1 << 30)
+    srv = EngineServer("classifier", CONF, args,
+                       coord=MemoryCoordinator(store))
+    srv.start(0)
+    try:
+        from jubatus_tpu.client import ClassifierClient, Datum
+
+        c = ClassifierClient("127.0.0.1", srv.args.rpc_port, NAME)
+        c.train([["pos", Datum({"a": 1.0})]])
+        entered = []
+        srv.mixer._enter_collective = \
+            lambda *a, **k: entered.append(a) or False
+        version, sig = srv.mixer.local_prepare("ghost-round", [])
+        assert sig != "unsupported"
+        assert "ghost-round" in srv.mixer._staged
+        deadline = time.time() + 5
+        while time.time() < deadline and srv.mixer._staged:
+            time.sleep(0.05)
+        assert not srv.mixer._staged, "staged diff not discarded"
+        assert not entered, "entered a collective without GO"
+        # and an aborted round exits the waiter immediately
+        srv.mixer.local_prepare("aborted-round", [])
+        assert srv.mixer.local_abort("aborted-round") is True
+        assert not srv.mixer._staged
+        c.close()
+    finally:
+        srv.stop()
