@@ -109,9 +109,22 @@ class CollectiveMixer(RpcLinearMixer):
         actor = membership.actor_path(self.comm.engine, self.comm.name)
         return f"{actor}/collective_go"
 
-    def _ack_path(self, rid: str, node_name: str) -> str:
+    def _ack_dir(self) -> str:
+        # ONE fixed parent for every round (a per-round directory would
+        # leak a durable node per round into the store/journal); leaves
+        # are EPHEMERAL and carry the rid in their name
         actor = membership.actor_path(self.comm.engine, self.comm.name)
-        return f"{actor}/collective_acks/{rid.replace('/', '_')}/{node_name}"
+        return f"{actor}/collective_acks"
+
+    def _ack_leaf(self, rid: str, node_name: str) -> str:
+        return f"{rid.replace('/', '_')}__{node_name}"
+
+    def _go_wait(self) -> float:
+        """Member GO deadline. Must exceed the prepare fan-out's RPC
+        timeout: GO is written at most one RPC timeout after the FIRST
+        member staged, so every staged member's deadline safely covers
+        the skew — no member can discard while another enters."""
+        return max(GO_WAIT_SEC, 3.0 * getattr(self.comm, "timeout", 10.0))
 
     # -- RPC surface ---------------------------------------------------------
     def register_api(self, rpc_server, name_check: str = "") -> None:
@@ -150,7 +163,7 @@ class CollectiveMixer(RpcLinearMixer):
         """Observe the GO marker, then enter the collective. Every live
         prepared member runs this; entering only on OBSERVED shared state
         is what makes partial entry impossible for live members."""
-        deadline = time.monotonic() + GO_WAIT_SEC
+        deadline = time.monotonic() + self._go_wait()
         base: Optional[int] = None
         while time.monotonic() < deadline:
             with self._staged_lock:
@@ -177,7 +190,7 @@ class CollectiveMixer(RpcLinearMixer):
                 dropped = self._staged.pop(rid, None)
             if dropped is not None:
                 log.warning("round %s: no GO within %.0fs; staged diff "
-                            "discarded", rid, GO_WAIT_SEC)
+                            "discarded", rid, self._go_wait())
             return
         ok = False
         try:
@@ -185,12 +198,20 @@ class CollectiveMixer(RpcLinearMixer):
         except Exception:  # noqa: BLE001 — world torn down mid-psum
             log.exception("collective entry failed for round %s", rid)
         if self.self_node is not None:
-            try:
-                self.comm.coord.set(
-                    self._ack_path(rid, self.self_node.name),
-                    b"1" if ok else b"0")
-            except Exception:  # noqa: BLE001
-                log.warning("ack write failed for round %s", rid)
+            # ephemeral (dies with this session; never journaled) and
+            # retried: a dropped ack demotes a healthy member
+            leaf = f"{self._ack_dir()}/{self._ack_leaf(rid, self.self_node.name)}"
+            payload = b"1" if ok else b"0"
+            for attempt in range(3):
+                try:
+                    if self.comm.coord.create(leaf, payload, ephemeral=True):
+                        break
+                    self.comm.coord.remove(leaf)  # stale same-name leaf
+                except Exception:  # noqa: BLE001
+                    if attempt == 2:
+                        log.warning("ack write failed for round %s", rid,
+                                    exc_info=True)
+                    time.sleep(0.1)
 
     def _enter_collective(self, rid: str, base_version: int) -> bool:
         with self._staged_lock:
@@ -223,7 +244,13 @@ class CollectiveMixer(RpcLinearMixer):
         union = [s.decode() if isinstance(s, bytes) else s for s in union]
 
         self._round_seq += 1
-        rid = f"{self.self_node.name if self.self_node else 'm'}-{self._round_seq}-{self.model_version}"
+        # globally unique rid: a restarted master reuses its name, seq,
+        # and version, and a stale durable GO marker matching a reused rid
+        # would trigger premature entry
+        import os as _os
+
+        rid = (f"{self.self_node.name if self.self_node else 'm'}"
+               f"-{self._round_seq}-{_os.urandom(6).hex()}")
         results, errors = self.comm.collect("mix_prepare", rid, union)
         sigs = {r[1] if not isinstance(r[1], bytes) else r[1].decode()
                 for _, r in results}
@@ -236,29 +263,64 @@ class CollectiveMixer(RpcLinearMixer):
             return super()._run_as_master(members)
         base_version = max(int(r[0]) for _, r in results)
 
-        # GO rides the coordinator: every live prepared member observes it
-        self.comm.coord.set(self._go_path(),
-                            pack_obj({"rid": rid, "base": base_version}))
+        # GO rides the coordinator: every live prepared member observes it.
+        # A failed write means nobody will enter — abort and mix over RPC.
+        try:
+            if not self.comm.coord.set(
+                    self._go_path(),
+                    pack_obj({"rid": rid, "base": base_version})):
+                raise RuntimeError("coordinator refused the GO write")
+        except Exception:  # noqa: BLE001
+            self.comm.collect("mix_abort", rid)
+            self.fallback_rounds += 1
+            log.warning("collective round %s: GO write failed; falling "
+                        "back to rpc mix", rid, exc_info=True)
+            return super()._run_as_master(members)
+
         # collect acks — the members' waiters (this process included)
-        # enter, apply, and ack; psum completion is world-wide or nobody's
+        # enter, apply, and ack; psum completion is world-wide or nobody's.
+        # One list() per poll (not N reads); once the FIRST ack appears the
+        # psum provably completed everywhere, so stragglers get only a
+        # short grace before a missing ack means a failed apply.
         acks: Dict[str, bool] = {}
-        deadline = time.monotonic() + GO_WAIT_SEC + 10.0
+        ack_dir = self._ack_dir()
+        deadline = time.monotonic() + self._go_wait() + 10.0
+        grace: Optional[float] = None
+        prefix = f"{rid.replace('/', '_')}__"
         while time.monotonic() < deadline and len(acks) < len(members):
-            for member in members:
-                if member.name in acks:
+            try:
+                leaves = [c for c in self.comm.coord.list(ack_dir)
+                          if c.startswith(prefix)]
+            except Exception:  # noqa: BLE001
+                leaves = []
+            for leaf in leaves:
+                name = leaf[len(prefix):]
+                if name in acks:
                     continue
-                raw = self.comm.coord.read(self._ack_path(rid, member.name))
+                raw = self.comm.coord.read(f"{ack_dir}/{leaf}")
                 if raw is not None:
-                    acks[member.name] = raw == b"1"
+                    acks[name] = raw == b"1"
+            if acks and grace is None:
+                grace = time.monotonic() + 5.0
+            if grace is not None and time.monotonic() > grace:
+                break
             if len(acks) < len(members):
                 time.sleep(_GO_POLL_SEC)
         for member in members:
-            self.comm.coord.remove(self._ack_path(rid, member.name))
-            if not acks.get(member.name, False):
-                self.comm.register_active(member, False)
+            try:
+                self.comm.coord.remove(
+                    f"{ack_dir}/{self._ack_leaf(rid, member.name)}")
+            except Exception:  # noqa: BLE001
+                pass
         if not acks:
+            # indistinguishable between nobody-entered and everyone-stuck:
+            # demoting the whole actives list would unroute the cluster,
+            # so report the failed round and let the next one retry
             log.error("collective round %s: no member acked", rid)
             return None
+        for member in members:
+            if not acks.get(member.name, False):
+                self.comm.register_active(member, False)
         self.collective_rounds += 1
         self.mix_count += 1
         log.info("collective mix round %d: %d members (%d acked), %.3fs",

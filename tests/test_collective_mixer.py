@@ -180,9 +180,10 @@ def test_prepared_member_discards_stage_without_go(monkeypatch):
 
     monkeypatch.setattr(cm, "GO_WAIT_SEC", 0.4)
     store = _Store()
+    # the effective wait is max(GO_WAIT_SEC, 3 * interconnect timeout)
     args = ServerArgs(engine="classifier", coordinator="(shared)",
                       name=NAME, listen_addr="127.0.0.1",
-                      mixer="collective_mixer",
+                      mixer="collective_mixer", interconnect_timeout=0.1,
                       interval_sec=1e9, interval_count=1 << 30)
     srv = EngineServer("classifier", CONF, args,
                        coord=MemoryCoordinator(store))
