@@ -34,13 +34,14 @@ __all__ = ["Coalescer"]
 
 
 class _Ticket:
-    __slots__ = ("event", "result", "error", "count")
+    __slots__ = ("event", "result", "error", "count", "weight")
 
-    def __init__(self, count: int) -> None:
+    def __init__(self, count: int, weight: int) -> None:
         self.event = threading.Event()
         self.result: Any = None
         self.error: BaseException | None = None
-        self.count = count
+        self.count = count    # item-list slots (queue bookkeeping)
+        self.weight = weight  # examples represented (max_batch accounting)
 
 
 class Coalescer:
@@ -55,11 +56,17 @@ class Coalescer:
     """
 
     def __init__(self, flush_fn: Callable[[List[Any]], Any],
-                 max_batch: int = 8192) -> None:
+                 max_batch: int = 8192,
+                 weigher: Callable[[Any], int] | None = None) -> None:
+        """``weigher(item) -> examples`` lets one item represent a whole
+        request's batch (the native fast path queues per-REQUEST array
+        triples — far less Python object churn than per-example rows);
+        max_batch then bounds examples, not items. Default: 1 per item."""
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self._flush = flush_fn
         self._max_batch = max_batch
+        self._weigher = weigher
         self._lock = threading.Lock()
         self._pending_items: List[Any] = []
         self._pending_tickets: List[_Ticket] = []
@@ -84,7 +91,9 @@ class Coalescer:
             return self._flush([])
         if timeout is not None and timeout <= 0:
             timeout = None
-        ticket = _Ticket(len(items))
+        weight = (sum(self._weigher(i) for i in items)
+                  if self._weigher is not None else len(items))
+        ticket = _Ticket(len(items), weight)
         with self._lock:
             self._pending_items.extend(items)
             self._pending_tickets.append(ticket)
@@ -119,16 +128,19 @@ class Coalescer:
                     return
                 batch: List[Any] = []
                 tickets: List[_Ticket] = []
+                batch_weight = 0
                 while self._pending_tickets and \
-                        len(batch) + self._pending_tickets[0].count \
+                        batch_weight + self._pending_tickets[0].weight \
                         <= self._max_batch:
                     t = self._pending_tickets.pop(0)
                     tickets.append(t)
+                    batch_weight += t.weight
                     batch.extend(self._pending_items[:t.count])
                     del self._pending_items[:t.count]
                 if not tickets:  # one oversized submit: flush it alone
                     t = self._pending_tickets.pop(0)
                     tickets.append(t)
+                    batch_weight += t.weight
                     batch.extend(self._pending_items[:t.count])
                     del self._pending_items[:t.count]
             try:
@@ -141,7 +153,7 @@ class Coalescer:
             finally:
                 with self._lock:
                     self.flush_count += 1
-                    self.item_count += len(batch)
+                    self.item_count += batch_weight  # examples, not items
                 for t in tickets:
                     t.event.set()
 

@@ -149,29 +149,39 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
 
     import numpy as np
 
-    def flush_rows(rows):
-        if not rows:
+    def flush_requests(reqs):
+        """Each item is one request's (labels, idx [B,K], val [B,K]) —
+        arrays concatenate at numpy speed (widths are already pow2-bucketed
+        by the parser, so pads are rare and small). Request-level items
+        keep per-example Python object churn out of the GIL-bound path."""
+        if not reqs:
             return 0
-        kmax = max(r[1].shape[0] for r in rows)
-        b = len(rows)
-        idx = np.zeros((b, kmax), np.int32)
-        val = np.zeros((b, kmax), np.float32)
-        for i, (_lb, ir, vr) in enumerate(rows):
-            idx[i, :ir.shape[0]] = ir
-            val[i, :vr.shape[0]] = vr
+        kmax = max(r[1].shape[1] for r in reqs)
+        parts_i, parts_v = [], []
+        for _lb, ir, vr in reqs:
+            if ir.shape[1] != kmax:
+                pad = kmax - ir.shape[1]
+                ir = np.pad(ir, ((0, 0), (0, pad)))
+                vr = np.pad(vr, ((0, 0), (0, pad)))
+            parts_i.append(ir)
+            parts_v.append(vr)
+        idx = np.concatenate(parts_i) if len(parts_i) > 1 else parts_i[0]
+        val = np.concatenate(parts_v) if len(parts_v) > 1 else parts_v[0]
         if numeric:
-            labels = np.asarray([r[0] for r in rows], np.float32)
+            labels = np.concatenate([r[0] for r in reqs]) \
+                if len(reqs) > 1 else reqs[0][0]
         else:
-            labels = [r[0] for r in rows]
+            labels = [lb for r in reqs for lb in r[0]]
         return driver.train_hashed(labels, idx, val)
 
-    flush = _updating(server, flush_rows, count=lambda r: r)
+    flush = _updating(server, flush_requests, count=lambda r: r)
     max_batch = getattr(server.args, "microbatch_max", 8192)
     wait_s = server.args.timeout * 6 if server.args.timeout > 0 else None
     if max_batch:
         from jubatus_tpu.server.microbatch import Coalescer
 
-        co = Coalescer(flush, max_batch=max_batch)
+        co = Coalescer(flush, max_batch=max_batch,
+                       weigher=lambda item: len(item[0]))
         server.coalescers["train_raw"] = co
 
     def train_raw(raw_params: bytes):
@@ -185,11 +195,10 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
         n = len(labels)
         if n == 0:
             return 0
-        rows = [(labels[i], idx[i], val[i]) for i in range(n)]
         if max_batch:
-            co.submit(rows, timeout=wait_s)
+            co.submit([(labels, idx, val)], timeout=wait_s)
         else:
-            flush(rows)
+            flush([(labels, idx, val)])
         return n
 
     rpc.register_raw("train", train_raw)
