@@ -249,6 +249,26 @@ class ClassifierDriver(DriverBase):
         return out
 
     @locked
+    def classify_hashed(self, idx: np.ndarray,
+                        val: np.ndarray) -> List[List[Tuple[str, float]]]:
+        """Classify pre-hashed features (native ingest fast path); same
+        output shape as classify()."""
+        n = idx.shape[0]
+        if n == 0:
+            return []
+        if not self.label_slots:
+            return [[] for _ in range(n)]
+        b = _bucket(n, 16)
+        if b != n:
+            idx = np.pad(idx, ((0, b - n), (0, 0)))
+            val = np.pad(val, ((0, b - n), (0, 0)))
+        sc = np.asarray(
+            ops.scores(self.state, jnp.asarray(idx), jnp.asarray(val),
+                       self._mask()))[:n]
+        return [[(lab, float(row[slot]))
+                 for lab, slot in self.label_slots.items()] for row in sc]
+
+    @locked
     def clear(self) -> None:
         self._init_model()
         self.converter.weights.clear()

@@ -118,6 +118,20 @@ class RegressionDriver(DriverBase):
         return [float(x) for x in np.asarray(pred)[: len(data)]]
 
     @locked
+    def estimate_hashed(self, idx: np.ndarray,
+                        val: np.ndarray) -> List[float]:
+        """Estimate on pre-hashed features (native ingest fast path)."""
+        n = idx.shape[0]
+        if n == 0:
+            return []
+        b = _bucket(n, 16)
+        if b != n:
+            idx = np.pad(idx, ((0, b - n), (0, 0)))
+            val = np.pad(val, ((0, b - n), (0, 0)))
+        pred = ops.estimate(self.state, jnp.asarray(idx), jnp.asarray(val))
+        return [float(x) for x in np.asarray(pred)[:n]]
+
+    @locked
     def clear(self) -> None:
         self.state = self._place(ops.init_state(self.converter.dim))
         self.converter.weights.clear()

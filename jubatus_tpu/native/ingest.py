@@ -63,6 +63,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.jt_ingest_parse.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
             ctypes.c_uint32, ctypes.POINTER(_Out)]
+        lib.jt_ingest_parse_datums.restype = ctypes.c_int
+        lib.jt_ingest_parse_datums.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_uint32, ctypes.POINTER(_Out)]
         lib.jt_ingest_free_out.restype = None
         lib.jt_ingest_free_out.argtypes = [ctypes.POINTER(_Out)]
         _lib = lib
@@ -147,6 +151,18 @@ class IngestParser:
         except (ValueError, RuntimeError):
             return None
 
+    @staticmethod
+    def _idx_val(out: "_Out"):
+        """Copy the [B, K] arrays out of a parse result (one place owns
+        the ctypes-extraction dance: shapes, .copy() before free, and the
+        empty-batch dtype fallback)."""
+        b, w = out.batch, out.width
+        idx = np.ctypeslib.as_array(out.idx, shape=(b, w)).copy() \
+            if b else np.zeros((0, 8), np.int32)
+        val = np.ctypeslib.as_array(out.val, shape=(b, w)).copy() \
+            if b else np.zeros((0, 8), np.float32)
+        return idx, val
+
     def parse(self, raw: bytes):
         """Raw train params msgpack -> (labels, idx [B,K] i32, val [B,K] f32).
 
@@ -160,11 +176,8 @@ class IngestParser:
         if rc != 0:
             return None
         try:
-            b, w = out.batch, out.width
-            idx = np.ctypeslib.as_array(out.idx, shape=(b, w)).copy() \
-                if b else np.zeros((0, 8), np.int32)
-            val = np.ctypeslib.as_array(out.val, shape=(b, w)).copy() \
-                if b else np.zeros((0, 8), np.float32)
+            b = out.batch
+            idx, val = self._idx_val(out)
             if out.labels_numeric:
                 labels = np.ctypeslib.as_array(
                     out.targets, shape=(b,)).copy() if b else \
@@ -181,6 +194,20 @@ class IngestParser:
         finally:
             self._lib.jt_ingest_free_out(ctypes.byref(out))
         return labels, idx, val
+
+    def parse_datums(self, raw: bytes):
+        """Raw classify/estimate params msgpack ([name, [datum, ...]]) ->
+        (idx [B,K] i32, val [B,K] f32), or None when the wire shape is
+        not a datum list."""
+        out = _Out()
+        rc = self._lib.jt_ingest_parse_datums(self._handle, raw, len(raw),
+                                              self._mask, ctypes.byref(out))
+        if rc != 0:
+            return None
+        try:
+            return self._idx_val(out)
+        finally:
+            self._lib.jt_ingest_free_out(ctypes.byref(out))
 
     def __del__(self):  # noqa: D105
         try:

@@ -203,6 +203,25 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
 
     rpc.register_raw("train", train_raw)
 
+    # the query path rides the same parser: [name, [datum, ...]] -> hashed
+    # batch -> snapshot-read scores, no Datum objects
+    if numeric and hasattr(driver, "estimate_hashed"):
+        def estimate_raw(raw_params: bytes):
+            parsed = parser.parse_datums(raw_params)
+            if parsed is None:
+                return RAW_FALLBACK
+            return driver.estimate_hashed(*parsed)
+
+        rpc.register_raw("estimate", estimate_raw)
+    elif not numeric and hasattr(driver, "classify_hashed"):
+        def classify_raw(raw_params: bytes):
+            parsed = parser.parse_datums(raw_params)
+            if parsed is None:
+                return RAW_FALLBACK
+            return [_scored(r) for r in driver.classify_hashed(*parsed)]
+
+        rpc.register_raw("classify", classify_raw)
+
 
 @_binder("classifier")
 def _bind_classifier(rpc: RpcServer, server: Any) -> None:

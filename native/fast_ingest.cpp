@@ -551,7 +551,7 @@ void jt_ingest_free_out(JtIngestOut* out) {
 }
 
 static int parse_impl(void* h, const uint8_t* buf, int64_t len,
-                      uint32_t mask, JtIngestOut* out) {
+                      uint32_t mask, int with_labels, JtIngestOut* out) {
   const Parser& ps = *static_cast<Parser*>(h);
   Reader rd{buf, buf + len};
 
@@ -582,23 +582,29 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
   };
 
   for (int64_t e = 0; e < n; ++e) {
-    int64_t pair = rd.array_len();  // [label, datum] / [target, datum]
-    if (rd.fail || pair != 2) return 1;
-    uint8_t lt = rd.peek();
-    bool is_raw = (lt & 0xE0) == 0xA0 || lt == 0xD9 || lt == 0xC4 ||
-                  lt == 0xDA || lt == 0xC5 || lt == 0xDB || lt == 0xC6;
-    if (labels_numeric == -1) labels_numeric = is_raw ? 0 : 1;
-    if (is_raw != (labels_numeric == 0)) return 1;  // mixed: not this wire
-    if (is_raw) {
-      const uint8_t* lb;
-      size_t lbn;
-      if (!rd.raw(&lb, &lbn)) return 1;
-      labels.insert(labels.end(), lb, lb + lbn);
-      label_off.push_back(int32_t(labels.size()));
+    if (with_labels) {
+      int64_t pair = rd.array_len();  // [label, datum] / [target, datum]
+      if (rd.fail || pair != 2) return 1;
+      uint8_t lt = rd.peek();
+      bool is_raw = (lt & 0xE0) == 0xA0 || lt == 0xD9 || lt == 0xC4 ||
+                    lt == 0xDA || lt == 0xC5 || lt == 0xDB || lt == 0xC6;
+      if (labels_numeric == -1) labels_numeric = is_raw ? 0 : 1;
+      if (is_raw != (labels_numeric == 0)) return 1;  // mixed: not this wire
+      if (is_raw) {
+        const uint8_t* lb;
+        size_t lbn;
+        if (!rd.raw(&lb, &lbn)) return 1;
+        labels.insert(labels.end(), lb, lb + lbn);
+        label_off.push_back(int32_t(labels.size()));
+      } else {
+        double t;
+        if (!rd.number(&t)) return 1;
+        targets.push_back(float(t));
+      }
     } else {
-      double t;
-      if (!rd.number(&t)) return 1;
-      targets.push_back(float(t));
+      labels_numeric = 0;  // classify/estimate: bare datum list, no labels
+      label_off.push_back(0);  // keep label_off at n+1 entries: the output
+                               // packing memcpys (n+1)*4 bytes from it
     }
 
     int64_t dlen = rd.array_len();  // [sv, nv, (bv)]
@@ -774,7 +780,18 @@ int jt_ingest_parse(void* h, const uint8_t* buf, int64_t len, uint32_t mask,
   // lengths, memory pressure) must surface as a parse error the caller
   // turns into an RPC error reply, never std::terminate
   try {
-    return parse_impl(h, buf, len, mask, out);
+    return parse_impl(h, buf, len, mask, 1, out);
+  } catch (...) {
+    return 4;
+  }
+}
+
+// classify/estimate wire: [name, [datum, ...]] — no label slot; only the
+// idx/val arrays of the result are meaningful
+int jt_ingest_parse_datums(void* h, const uint8_t* buf, int64_t len,
+                           uint32_t mask, JtIngestOut* out) {
+  try {
+    return parse_impl(h, buf, len, mask, 0, out);
   } catch (...) {
     return 4;
   }

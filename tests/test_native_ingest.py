@@ -316,3 +316,65 @@ def test_fallback_counts_trace_span_once():
         assert st["trace.rpc.train.count"] == 1
     finally:
         srv.stop()
+
+
+def test_parse_datums_matches_converter():
+    """The classify/estimate wire ([name, [datum, ...]]) parses to the
+    same hashed batch the Python converter produces."""
+    p = ingest.IngestParser(
+        ingest.spec_from_converter_config(MIXED_CONV), 20)
+    pyconv = make_fv_converter(MIXED_CONV, dim_bits=20)
+    rng = random.Random(11)
+    data = [_rand_datum(rng) for _ in range(100)]
+    raw = msgpack.packb(["c", [d.to_msgpack() for d in data]])
+    parsed = p.parse_datums(raw)
+    assert parsed is not None
+    idx, val = parsed
+    for i, d in enumerate(data):
+        assert _got(idx[i], val[i]) == _expected(pyconv, d), i
+    # a train-shaped wire is NOT a datum list
+    train_raw = msgpack.packb(["c", [["lb", data[0].to_msgpack()]]])
+    assert p.parse_datums(train_raw) is None
+
+
+def test_server_fast_classify_and_estimate_match_slow_path():
+    from jubatus_tpu.client import ClassifierClient, RegressionClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    srv = EngineServer("classifier", SERVER_CONV,
+                       args=ServerArgs(engine="classifier"))
+    port = srv.start(0)
+    slow = EngineServer("classifier", SERVER_CONV,
+                        args=ServerArgs(engine="classifier"))
+    sport = slow.start(0)
+    slow.rpc._raw_methods.clear()
+    try:
+        assert "classify" in srv.rpc._raw_methods
+        with ClassifierClient("127.0.0.1", port, "t") as cf, \
+                ClassifierClient("127.0.0.1", sport, "t") as cs:
+            cf.train(_train_data())
+            cs.train(_train_data())
+            probe = [Datum({"t": "win money", "n": 0.5}),
+                     Datum({"t": "meet at noon"})]
+            assert [sorted(r) for r in cf.classify(probe)] == \
+                [sorted(r) for r in cs.classify(probe)]
+    finally:
+        srv.stop()
+        slow.stop()
+
+    conf = {"method": "PA", "parameter": {"sensitivity": 0.1,
+                                          "regularization_weight": 1.0},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    rsrv = EngineServer("regression", conf,
+                        args=ServerArgs(engine="regression"))
+    rport = rsrv.start(0)
+    try:
+        assert "estimate" in rsrv.rpc._raw_methods
+        with RegressionClient("127.0.0.1", rport, "t") as c:
+            c.train([[float(2 * x), Datum({"x": float(x)})]
+                     for x in range(-8, 9)] * 4)
+            ests = c.estimate([Datum({"x": 3.0}), Datum({"x": -2.0})])
+            assert 2.0 < ests[0] < 10.0 and -8.0 < ests[1] < -1.0
+    finally:
+        rsrv.stop()
