@@ -240,13 +240,8 @@ class ClassifierDriver(DriverBase):
             return [[] for _ in data]
         vectors = [self.converter.convert(d) for d in data]
         sb = SparseBatch.from_vectors(vectors, batch_bucket=16)
-        scores = np.asarray(
-            ops.scores(self.state, jnp.asarray(sb.idx), jnp.asarray(sb.val), self._mask())
-        )[: len(data)]
-        out = []
-        for row in scores:
-            out.append([(lab, float(row[slot])) for lab, slot in self.label_slots.items()])
-        return out
+        # from_vectors already row-bucketed; slice its pad rows back off
+        return self.classify_hashed(sb.idx, sb.val)[: len(data)]
 
     @locked
     def classify_hashed(self, idx: np.ndarray,

@@ -114,8 +114,7 @@ class RegressionDriver(DriverBase):
             return []
         vectors = [self.converter.convert(d) for d in data]
         sb = SparseBatch.from_vectors(vectors, batch_bucket=16)
-        pred = ops.estimate(self.state, jnp.asarray(sb.idx), jnp.asarray(sb.val))
-        return [float(x) for x in np.asarray(pred)[: len(data)]]
+        return self.estimate_hashed(sb.idx, sb.val)[: len(data)]
 
     @locked
     def estimate_hashed(self, idx: np.ndarray,

@@ -1,0 +1,75 @@
+// Faithful C++ per-example AROW baseline for bench.py's vs_baseline.
+//
+// The reference publishes no benchmark figures (SURVEY.md §6) and its hot
+// loop is the per-datum C++ driver update under a write lock
+// (classifier_serv.cpp:127-146; the math lives in jubatus_core's
+// arow.cpp). Round 1 compared against a per-example numpy loop, which
+// undersells a real C++ deployment; this file is the same sequential
+// per-example AROW (binary, dense [2, D] weight + inverse-precision
+// tables, sparse examples) compiled with -O3 — the closest measurable
+// stand-in for the reference's single-core serving thread.
+//
+// ABI: double jt_arow_baseline(const int32_t* idx, const float* val,
+//                              const int32_t* labels, int n, int k,
+//                              int64_t dim, float r)
+// returns examples/second over the n examples (timed internally so the
+// ctypes call overhead is excluded).
+
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+double jt_arow_baseline(const int32_t* idx, const float* val,
+                        const int32_t* labels, int n, int k, int64_t dim,
+                        float r) {
+  std::vector<float> w(2 * size_t(dim), 0.0f);
+  std::vector<float> sigma(2 * size_t(dim), 1.0f);
+  auto t0 = std::chrono::steady_clock::now();
+  for (int i = 0; i < n; ++i) {
+    const int32_t* ii = idx + size_t(i) * k;
+    const float* vv = val + size_t(i) * k;
+    int y = labels[i];
+    int o = 1 - y;
+    float* wy = w.data() + size_t(y) * dim;
+    float* wo = w.data() + size_t(o) * dim;
+    float* sy = sigma.data() + size_t(y) * dim;
+    float* so = sigma.data() + size_t(o) * dim;
+    // margin = s[y] - s[o]
+    float s_y = 0.0f, s_o = 0.0f;
+    for (int j = 0; j < k; ++j) {
+      s_y += wy[ii[j]] * vv[j];
+      s_o += wo[ii[j]] * vv[j];
+    }
+    float margin = s_y - s_o;
+    float loss = 1.0f - margin;
+    if (loss <= 0.0f) continue;
+    float variance = 0.0f;
+    for (int j = 0; j < k; ++j) {
+      float x2 = vv[j] * vv[j];
+      variance += (sy[ii[j]] + so[ii[j]]) * x2;
+    }
+    float beta = 1.0f / (variance + r);
+    float alpha = loss * beta;
+    for (int j = 0; j < k; ++j) {
+      float x = vv[j];
+      wy[ii[j]] += alpha * sy[ii[j]] * x;
+      wo[ii[j]] -= alpha * so[ii[j]] * x;
+      float prec_inc = x * x / r;
+      sy[ii[j]] = 1.0f / (1.0f / sy[ii[j]] + prec_inc);
+      so[ii[j]] = 1.0f / (1.0f / so[ii[j]] + prec_inc);
+    }
+  }
+  auto dt = std::chrono::duration<double>(
+                std::chrono::steady_clock::now() - t0)
+                .count();
+  // keep the tables alive past the timer (defeats dead-code elimination)
+  volatile float sink = w[0] + sigma[size_t(dim)];
+  (void)sink;
+  return dt > 0.0 ? double(n) / dt : 0.0;
+}
+
+}  // extern "C"

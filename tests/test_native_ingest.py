@@ -378,3 +378,29 @@ def test_server_fast_classify_and_estimate_match_slow_path():
             assert 2.0 < ests[0] < 10.0 and -8.0 < ests[1] < -1.0
     finally:
         rsrv.stop()
+
+
+def test_parser_survives_mutation_fuzz():
+    """Randomly mutated request bytes must yield a clean parse or a clean
+    None — never a crash (the parser handles attacker-controlled bytes
+    before any auth layer)."""
+    p = ingest.IngestParser(
+        ingest.spec_from_converter_config(MIXED_CONV), 16)
+    rng = random.Random(13)
+    base = msgpack.packb(
+        ["c", [["lbl%d" % i, _rand_datum(rng).to_msgpack()]
+               for i in range(8)]])
+    for trial in range(1500):
+        raw = bytearray(base)
+        for _ in range(rng.randint(1, 6)):
+            pos = rng.randrange(len(raw))
+            raw[pos] = rng.randrange(256)
+        if rng.random() < 0.3:
+            raw = raw[:rng.randrange(len(raw))]
+        out = p.parse(bytes(raw))
+        if out is not None:
+            labels, idx, val = out
+            assert idx.shape == val.shape
+        out2 = p.parse_datums(bytes(raw))
+        if out2 is not None:
+            assert out2[0].shape == out2[1].shape
