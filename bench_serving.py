@@ -25,7 +25,7 @@ N_CLIENTS = 16
 CALL_BATCH = 500
 K = 32                  # numeric features per datum
 WARMUP_SECONDS = 12.0
-MEASURE_SECONDS = 12.0
+MEASURE_SECONDS = 20.0
 
 CONF = {
     "method": "AROW",
