@@ -199,6 +199,30 @@ class ClassifierDriver(DriverBase):
         self.event_model_updated(len(data))
         return len(data)
 
+    def _train_slots(self, slots: np.ndarray, idx: np.ndarray,
+                     val: np.ndarray, b: int) -> int:
+        """Shared pre-hashed dispatch tail: pow2 row bucketing (same shape
+        buckets as the converter path), padding, and the device step. Both
+        hashed entry points funnel here so their semantics cannot drift."""
+        bsz = _bucket(b, 16)
+        if bsz != b:
+            idx = np.pad(idx, ((0, bsz - b), (0, 0)))
+            val = np.pad(val, ((0, bsz - b), (0, 0)))
+        slots_arr = np.zeros(bsz, dtype=np.int32)
+        slots_arr[:b] = slots
+        self.state = ops.train_batch(
+            self.state,
+            jnp.asarray(idx),
+            jnp.asarray(val),
+            jnp.asarray(slots_arr),
+            self._mask(),
+            self.param,
+            method=self.method,
+            mode=self.train_mode,
+        )
+        self.event_model_updated(b)
+        return b
+
     @locked
     def train_hashed(self, labels: Sequence[str], idx: np.ndarray,
                      val: np.ndarray) -> int:
@@ -212,25 +236,26 @@ class ClassifierDriver(DriverBase):
         slots = [self._ensure_label(lb) for lb in labels]
         for s in slots:
             self._dcounts[s] += 1.0
-        b = idx.shape[0]
-        bsz = _bucket(b, 16)  # same shape buckets as the converter path
-        if bsz != b:
-            idx = np.pad(idx, ((0, bsz - b), (0, 0)))
-            val = np.pad(val, ((0, bsz - b), (0, 0)))
-        slots_arr = np.zeros(bsz, dtype=np.int32)
-        slots_arr[:len(slots)] = slots
-        self.state = ops.train_batch(
-            self.state,
-            jnp.asarray(idx),
-            jnp.asarray(val),
-            jnp.asarray(slots_arr),
-            self._mask(),
-            self.param,
-            method=self.method,
-            mode=self.train_mode,
-        )
-        self.event_model_updated(len(labels))
-        return len(labels)
+        return self._train_slots(np.asarray(slots, dtype=np.int32),
+                                 idx, val, len(labels))
+
+    @locked
+    def train_indexed(self, uniq_labels: Sequence[str], label_idx: np.ndarray,
+                      idx: np.ndarray, val: np.ndarray) -> int:
+        """Train on pre-hashed features with C++-deduplicated labels
+        (native/fast_ingest.cpp): ``uniq_labels`` are the distinct label
+        strings, ``label_idx`` the int32 [B] row->uniq mapping. The host
+        loops only over the distinct set — vocabulary work is O(uniq),
+        count bookkeeping is one bincount, so the GIL-bound cost per
+        sample is constant regardless of batch size."""
+        b = int(label_idx.shape[0])
+        if b == 0:
+            return 0
+        slots_u = np.array([self._ensure_label(lb) for lb in uniq_labels],
+                           dtype=np.int32)
+        counts = np.bincount(label_idx, minlength=len(uniq_labels))
+        self._dcounts[slots_u] += counts[:len(slots_u)]
+        return self._train_slots(slots_u[label_idx], idx, val, b)
 
     @locked
     def classify(self, data: Sequence[Datum]) -> List[List[Tuple[str, float]]]:

@@ -38,6 +38,8 @@ class _Out(ctypes.Structure):
         ("labels", ctypes.POINTER(ctypes.c_uint8)),
         ("label_off", ctypes.POINTER(ctypes.c_int32)),
         ("targets", ctypes.POINTER(ctypes.c_float)),
+        ("uniq", ctypes.c_int32),
+        ("label_idx", ctypes.POINTER(ctypes.c_int32)),
     ]
 
 
@@ -163,11 +165,13 @@ class IngestParser:
             if b else np.zeros((0, 8), np.float32)
         return idx, val
 
-    def parse(self, raw: bytes):
+    def parse_indexed(self, raw: bytes):
         """Raw train params msgpack -> (labels, idx [B,K] i32, val [B,K] f32).
 
-        ``labels`` is a list of strings (classifier) or a float32 array
-        (regression targets — numeric first slot on the wire). None when
+        ``labels`` is a float32 array for regression targets, or — for
+        string labels — a ``(uniq_labels, label_idx)`` pair: the DISTINCT
+        label strings plus an int32 [B] row->uniq index (the C++ parser
+        dedups, so the host never loops over B Python strings). None when
         the wire shape is not the expected train format (caller falls back
         to the generic decode path)."""
         out = _Out()
@@ -183,16 +187,33 @@ class IngestParser:
                     out.targets, shape=(b,)).copy() if b else \
                     np.zeros(0, np.float32)
             else:
-                offs = np.ctypeslib.as_array(out.label_off, shape=(b + 1,))
+                u = out.uniq
+                offs = np.ctypeslib.as_array(out.label_off, shape=(u + 1,))
                 blob = bytes(np.ctypeslib.as_array(
                     out.labels, shape=(max(int(offs[-1]), 1),)))
-                labels = [
+                uniq = [
                     blob[offs[i]:offs[i + 1]].decode("utf-8",
                                                      "surrogateescape")
-                    for i in range(b)
+                    for i in range(u)
                 ]
+                lidx = np.ctypeslib.as_array(
+                    out.label_idx, shape=(b,)).copy() if b else \
+                    np.zeros(0, np.int32)
+                labels = (uniq, lidx)
         finally:
             self._lib.jt_ingest_free_out(ctypes.byref(out))
+        return labels, idx, val
+
+    def parse(self, raw: bytes):
+        """Like parse_indexed but with per-row label strings (compat shape:
+        a list of B strings for classifiers, float32 array for targets)."""
+        parsed = self.parse_indexed(raw)
+        if parsed is None:
+            return None
+        labels, idx, val = parsed
+        if isinstance(labels, tuple):
+            uniq, lidx = labels
+            labels = [uniq[i] for i in lidx]
         return labels, idx, val
 
     def parse_datums(self, raw: bytes):

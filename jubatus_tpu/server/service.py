@@ -152,8 +152,10 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
     def flush_requests(reqs):
         """Each item is one request's (labels, idx [B,K], val [B,K]) —
         arrays concatenate at numpy speed (widths are already pow2-bucketed
-        by the parser, so pads are rare and small). Request-level items
-        keep per-example Python object churn out of the GIL-bound path."""
+        by the parser, so pads are rare and small). ``labels`` is a float32
+        target array (regression) or a (uniq_labels, label_idx) pair from
+        the C++ dedup — merging unions the uniq sets and remaps each
+        request's index array, so no per-example Python loop ever runs."""
         if not reqs:
             return 0
         kmax = max(r[1].shape[1] for r in reqs)
@@ -170,9 +172,17 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
         if numeric:
             labels = np.concatenate([r[0] for r in reqs]) \
                 if len(reqs) > 1 else reqs[0][0]
-        else:
-            labels = [lb for r in reqs for lb in r[0]]
-        return driver.train_hashed(labels, idx, val)
+            return driver.train_hashed(labels, idx, val)
+        label_map: dict = {}
+        parts_l = []
+        for lb, _ir, _vr in reqs:
+            uniq, lidx = lb
+            lut = np.empty(len(uniq), np.int32)
+            for j, u in enumerate(uniq):
+                lut[j] = label_map.setdefault(u, len(label_map))
+            parts_l.append(lut[lidx])
+        lidx = np.concatenate(parts_l) if len(parts_l) > 1 else parts_l[0]
+        return driver.train_indexed(list(label_map), lidx, idx, val)
 
     flush = _updating(server, flush_requests, count=lambda r: r)
     max_batch = getattr(server.args, "microbatch_max", 8192)
@@ -181,18 +191,18 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
         from jubatus_tpu.server.microbatch import Coalescer
 
         co = Coalescer(flush, max_batch=max_batch,
-                       weigher=lambda item: len(item[0]))
+                       weigher=lambda item: item[1].shape[0])
         server.coalescers["train_raw"] = co
 
     def train_raw(raw_params: bytes):
-        parsed = parser.parse(raw_params)
+        parsed = parser.parse_indexed(raw_params)
         if parsed is None:
             return RAW_FALLBACK
         labels, idx, val = parsed
         if numeric != isinstance(labels, np.ndarray):
             return RAW_FALLBACK  # label kind mismatch: let the generic
             # path produce the proper type error
-        n = len(labels)
+        n = idx.shape[0]
         if n == 0:
             return 0
         if max_batch:

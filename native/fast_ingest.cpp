@@ -459,9 +459,11 @@ struct JtIngestOut {
   int32_t labels_numeric;  // 1: targets[] is set (regression), 0: labels
   int32_t* idx;        // [batch, width], 0-padded
   float* val;          // [batch, width], 0-padded
-  uint8_t* labels;     // concatenated label bytes
-  int32_t* label_off;  // batch + 1 offsets into labels
+  uint8_t* labels;     // concatenated DISTINCT label bytes
+  int32_t* label_off;  // uniq + 1 offsets into labels
   float* targets;      // [batch] numeric targets (regression train)
+  int32_t uniq;        // distinct labels in labels/label_off
+  int32_t* label_idx;  // [batch] row -> distinct-label index
 };
 
 void* jt_ingest_create(const char* spec) {
@@ -543,11 +545,13 @@ void jt_ingest_free_out(JtIngestOut* out) {
   free(out->labels);
   free(out->label_off);
   free(out->targets);
+  free(out->label_idx);
   out->idx = nullptr;
   out->val = nullptr;
   out->labels = nullptr;
   out->label_off = nullptr;
   out->targets = nullptr;
+  out->label_idx = nullptr;
 }
 
 static int parse_impl(void* h, const uint8_t* buf, int64_t len,
@@ -563,13 +567,30 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
 
   std::vector<Feature> feats;       // all examples, concatenated
   std::vector<int64_t> offsets(1, 0);
-  std::vector<uint8_t> labels;
-  std::vector<int32_t> label_off(1, 0);
+  std::vector<uint8_t> labels;      // distinct label bytes, concatenated
+  std::vector<int32_t> label_off(1, 0);  // uniq + 1 offsets
+  std::vector<int32_t> label_idx;   // row -> distinct-label index
+  std::vector<std::pair<size_t, size_t>> uniq_spans;  // (off, len) in labels
   std::vector<float> targets;       // regression: numeric first slot
   int labels_numeric = -1;          // unknown until the first example
   std::string name;                 // scratch feature-name buffer
   std::vector<std::pair<const uint8_t*, size_t>> terms;  // scratch
   char numbuf[40];
+
+  // Schema cache for num rules: real ingest streams repeat one key schema
+  // (f0..fK in the same order every datum), so the (rule, position)->
+  // hashed-index outcome from the previous datum usually holds — one
+  // memcmp replaces name assembly + CRC-32 per feature. state: -1 unset,
+  // 0 no-match, 1 emit idx with v, 2 emit idx with log(max(1,v)),
+  // 3 value-dependent name (num "str" rule) — recompute.
+  struct PosEntry {
+    const uint8_t* key = nullptr;
+    uint32_t len = 0;
+    int8_t state = -1;
+    int32_t idx = 0;
+  };
+  std::vector<PosEntry> poscache;
+  size_t pos_stride = 0;  // kv slots per rule; grows to max nnv seen
 
   auto emit = [&](const std::string& nm, double v) {
     uint32_t c = crc32_update(0xFFFFFFFFu,
@@ -594,8 +615,26 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
         const uint8_t* lb;
         size_t lbn;
         if (!rd.raw(&lb, &lbn)) return 1;
-        labels.insert(labels.end(), lb, lb + lbn);
-        label_off.push_back(int32_t(labels.size()));
+        // dedup: linear scan over the distinct set (classification label
+        // sets are small); past 256 distinct, stop scanning and append —
+        // label_idx stays correct, rows just stop sharing entries
+        int32_t li = -1;
+        if (uniq_spans.size() <= 256) {
+          for (size_t u = 0; u < uniq_spans.size(); ++u) {
+            if (uniq_spans[u].second == lbn &&
+                0 == memcmp(labels.data() + uniq_spans[u].first, lb, lbn)) {
+              li = int32_t(u);
+              break;
+            }
+          }
+        }
+        if (li < 0) {
+          li = int32_t(uniq_spans.size());
+          uniq_spans.push_back({labels.size(), lbn});
+          labels.insert(labels.end(), lb, lb + lbn);
+          label_off.push_back(int32_t(labels.size()));
+        }
+        label_idx.push_back(li);
       } else {
         double t;
         if (!rd.number(&t)) return 1;
@@ -603,8 +642,6 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
       }
     } else {
       labels_numeric = 0;  // classify/estimate: bare datum list, no labels
-      label_off.push_back(0);  // keep label_off at n+1 entries: the output
-                               // packing memcpys (n+1)*4 bytes from it
     }
 
     int64_t dlen = rd.array_len();  // [sv, nv, (bv)]
@@ -688,31 +725,61 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
         }
       }
     }
-    // num rules (converter.py:369-388)
-    for (const NumRule& r : ps.num_rules) {
-      for (auto& kv : nvs) {
-        if (!r.m.match(kv.first.first, kv.first.second)) continue;
-        name.assign(reinterpret_cast<const char*>(kv.first.first),
-                    kv.first.second);
-        switch (r.kind) {
-          case NumRule::NUM:
+    // num rules (converter.py:369-388), schema-cached per (rule, position)
+    if (size_t(nnv) > pos_stride) {
+      // re-stride: invalidate (entries would alias across rules)
+      pos_stride = size_t(nnv);
+      poscache.assign(ps.num_rules.size() * pos_stride, PosEntry{});
+    }
+    for (size_t ri = 0; ri < ps.num_rules.size(); ++ri) {
+      const NumRule& r = ps.num_rules[ri];
+      PosEntry* row = poscache.data() + ri * pos_stride;
+      for (int64_t ki = 0; ki < nnv; ++ki) {
+        auto& kv = nvs[size_t(ki)];
+        const uint8_t* key = kv.first.first;
+        size_t keyn = kv.first.second;
+        PosEntry& pe = row[ki];
+        if (pe.state >= 0 && pe.len == keyn &&
+            (pe.key == key || 0 == memcmp(pe.key, key, keyn))) {
+          switch (pe.state) {
+            case 0:
+              continue;
+            case 1:
+              feats.push_back({pe.idx, kv.second});
+              continue;
+            case 2:
+              feats.push_back({pe.idx, std::log(std::max(1.0, kv.second))});
+              continue;
+            default:
+              break;  // state 3: value-dependent, fall through
+          }
+        } else {
+          pe.key = key;
+          pe.len = uint32_t(keyn);
+          if (!r.m.match(key, keyn)) {
+            pe.state = 0;
+            continue;
+          }
+          pe.state = r.kind == NumRule::NUM   ? 1
+                     : r.kind == NumRule::LOG ? 2
+                                              : 3;
+          if (pe.state != 3) {
+            name.assign(reinterpret_cast<const char*>(key), keyn);
             name += r.at_type;
-            emit(name, kv.second);
-            break;
-          case NumRule::LOG:
-            name += r.at_type;
-            emit(name, std::log(std::max(1.0, kv.second)));
-            break;
-          case NumRule::STR: {
-            size_t fn = format_num(kv.second, numbuf);
-            if (fn == 0) return 3;  // unrepresentable: Python path converts
-            name += '$';
-            name.append(numbuf, fn);
-            name += r.at_type;
-            emit(name, 1.0);
-            break;
+            emit(name, pe.state == 1 ? kv.second
+                                     : std::log(std::max(1.0, kv.second)));
+            pe.idx = feats.back().idx;  // emit() owns the name->index rule
+            continue;
           }
         }
+        // NumRule::STR — the term is the formatted value; uncacheable
+        size_t fn = format_num(kv.second, numbuf);
+        if (fn == 0) return 3;  // unrepresentable: Python path converts
+        name.assign(reinterpret_cast<const char*>(key), keyn);
+        name += '$';
+        name.append(numbuf, fn);
+        name += r.at_type;
+        emit(name, 1.0);
       }
     }
 
@@ -742,27 +809,29 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
   int32_t width = 8;
   while (width < max_nnz) width *= 2;
 
+  size_t uniq = uniq_spans.size();
   out->batch = int32_t(n);
   out->width = width;
   out->labels_numeric = labels_numeric == 1 ? 1 : 0;
+  out->uniq = int32_t(uniq);
   out->idx = static_cast<int32_t*>(calloc(size_t(n) * width, 4));
   out->val = static_cast<float*>(calloc(size_t(n) * width, 4));
   out->labels = static_cast<uint8_t*>(malloc(labels.size() ? labels.size() : 1));
-  out->label_off = static_cast<int32_t*>(malloc((size_t(n) + 1) * 4));
+  out->label_off = static_cast<int32_t*>(malloc((uniq + 1) * 4));
   out->targets = static_cast<float*>(malloc((size_t(n) + 1) * 4));
+  out->label_idx = static_cast<int32_t*>(malloc((size_t(n) + 1) * 4));
   if (!out->idx || !out->val || !out->labels || !out->label_off ||
-      !out->targets) {
+      !out->targets || !out->label_idx) {
     jt_ingest_free_out(out);
     return 2;
   }
   memcpy(out->labels, labels.data(), labels.size());
   if (labels_numeric == 1) {
     memcpy(out->targets, targets.data(), targets.size() * 4);
-    for (size_t i = targets.size(); i < size_t(n) + 1; ++i)
-      out->label_off[i] = 0;
     out->label_off[0] = 0;
   } else {
-    memcpy(out->label_off, label_off.data(), (size_t(n) + 1) * 4);
+    memcpy(out->label_off, label_off.data(), (uniq + 1) * 4);
+    memcpy(out->label_idx, label_idx.data(), label_idx.size() * 4);
   }
   for (int64_t e = 0; e < n; ++e) {
     int64_t s = offsets[e], cnt = offsets[e + 1] - offsets[e];

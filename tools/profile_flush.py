@@ -1,0 +1,79 @@
+"""Profile the serving flush path: where does the per-flush time go?
+
+Mimics service.py's train_raw flush at several batch sizes, separating:
+  host   — _ensure_label loop + padding + slot array build
+  disp   — jitted train_batch dispatch (async, no block)
+  step   — device step time (dispatch..block_until_ready)
+  pipe   — effective per-step time when N steps are dispatched back-to-back
+           before one block (does the runtime pipeline them?)
+"""
+import time
+import numpy as np
+
+import jax
+
+from jubatus_tpu.models.classifier import ClassifierDriver
+
+CONF = {
+    "method": "AROW",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+K = 32
+rng = np.random.default_rng(0)
+
+
+def make_batch(b):
+    labels = ["a" if x < 0.5 else "b" for x in rng.random(b)]
+    idx = rng.integers(0, 1 << 18, size=(b, K)).astype(np.int32)
+    val = rng.normal(size=(b, K)).astype(np.float32)
+    return labels, idx, val
+
+
+def main():
+    d = ClassifierDriver(CONF, dim_bits=18)
+    print("platform:", jax.devices()[0].platform)
+    for b in (512, 2048, 8192, 32768):
+        labels, idx, val = make_batch(b)
+        # warm the compile
+        d.train_hashed(labels, idx, val)
+        jax.block_until_ready(d.state.w)
+
+        # host-only portion: run everything except the device call
+        t0 = time.perf_counter()
+        for _ in range(5):
+            slots = [d._ensure_label(lb) for lb in labels]
+            for s in slots:
+                d._dcounts[s] += 1.0
+            sa = np.zeros(b, dtype=np.int32)
+            sa[: len(slots)] = slots
+            _ = d._mask()
+        host_ms = (time.perf_counter() - t0) / 5 * 1e3
+
+        # dispatch (async) vs blocked step
+        t0 = time.perf_counter()
+        for _ in range(5):
+            d.train_hashed(labels, idx, val)
+        disp_ms = (time.perf_counter() - t0) / 5 * 1e3
+        jax.block_until_ready(d.state.w)
+
+        t0 = time.perf_counter()
+        for _ in range(5):
+            d.train_hashed(labels, idx, val)
+            jax.block_until_ready(d.state.w)
+        step_ms = (time.perf_counter() - t0) / 5 * 1e3
+
+        # pipelined: 10 dispatches then one block
+        t0 = time.perf_counter()
+        for _ in range(10):
+            d.train_hashed(labels, idx, val)
+        jax.block_until_ready(d.state.w)
+        pipe_ms = (time.perf_counter() - t0) / 10 * 1e3
+
+        print(f"B={b:6d}  host={host_ms:7.2f}ms  disp={disp_ms:7.2f}ms  "
+              f"step={step_ms:7.2f}ms  pipe={pipe_ms:7.2f}ms  "
+              f"-> blocked {b/step_ms*1e3:9.0f}/s  piped {b/pipe_ms*1e3:9.0f}/s")
+
+
+if __name__ == "__main__":
+    main()
