@@ -130,9 +130,14 @@ class RemoteCoordinator(Coordinator):
                     return False
                 continue
             try:
-                # same coordd, reaped-or-alive old session: free its
-                # ephemerals so the re-creates below can't collide
-                self._client.call("coord_close", old_sid)
+                # same coordd, old session still alive: free its ephemerals
+                # so the re-creates below can't collide. Heartbeat-verify
+                # first — after a coordd restart old_sid is unknown (or, if
+                # ids could ever repeat, someone ELSE's session; coordd
+                # mints from a random 63-bit space to make that impossible,
+                # and this check keeps even a misconfigured store safe)
+                if self._client.call("coord_heartbeat", old_sid):
+                    self._client.call("coord_close", old_sid)
             except Exception:  # noqa: BLE001 — restarted coordd: no-op
                 pass
             self._sid = int(sid)
@@ -204,9 +209,13 @@ class RemoteCoordinator(Coordinator):
         return out if isinstance(out, bytes) else str(out).encode()
 
     def remove(self, path: str) -> bool:
+        # drop the resume-registry entry only after the server confirms:
+        # a failed RPC leaves the node alive server-side, and a later
+        # session resume must still know to re-create/track it
+        ok = bool(self._call("coord_remove", path))
         with self._lock:
             self._ephemerals.pop(path, None)
-        return bool(self._call("coord_remove", path))
+        return ok
 
     def exists(self, path: str) -> bool:
         return bool(self._call("coord_exists", path))

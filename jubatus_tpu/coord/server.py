@@ -67,7 +67,6 @@ class CoordServer:
         #: serves the sessionless ops (set/read/list/...) — never owns
         #: ephemerals or locks, so one shared instance is fine
         self._root = MemoryCoordinator(self.store)
-        self._next_sid = 1
         self._stop_event = threading.Event()
         self._reaper = threading.Thread(target=self._expire_loop, daemon=True,
                                         name="coord-expire")
@@ -90,9 +89,18 @@ class CoordServer:
 
     # -- session lifecycle ----------------------------------------------------
     def open_session(self) -> List:
+        import secrets
+
         with self._mu:
-            sid = self._next_sid
-            self._next_sid += 1
+            # random 63-bit ids: a restarted coordd must never mint a sid a
+            # previous incarnation handed out — a client resuming across the
+            # restart calls coord_close(old_sid), and with sequential ids
+            # that could close ANOTHER client's fresh session (membership
+            # flapping during recovery)
+            while True:
+                sid = secrets.randbits(63) or 1
+                if sid not in self._sessions:
+                    break
             self._sessions[sid] = (MemoryCoordinator(self.store),
                                    time.monotonic())
         log.info("session %d opened", sid)

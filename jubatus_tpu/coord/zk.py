@@ -23,8 +23,16 @@ Semantics mapped onto the ABC:
 
 Connection model: one socket; a reader thread demultiplexes replies by
 xid and delivers watch events (xid -1); a ping thread keeps the session
-alive at timeout/3. Loss of the connection fails all pending calls and
-fires delete watchers (session-lost contract, same as coord/remote.py).
+alive at timeout/3. Loss of the SOCKET is not loss of the SESSION: the
+reader reconnects across the host list re-presenting sessionId+passwd
+within the negotiated timeout — exactly libzookeeper's behavior
+(reference zk.cpp:88 session watcher, zk.cpp:139-150 connect-wait) — and
+the coordinator re-arms its watches, firing any delete that happened
+while disconnected. In-flight calls during the gap fail with a retryable
+connection-loss error (ZK cannot say whether they applied — same
+contract as ZCONNECTIONLOSS). Only a server-side session expiry (resume
+answered with session 0) fails all pending calls and fires delete
+watchers (session-lost contract, same as coord/remote.py).
 
 Tested against an in-process fake speaking the same wire
 (tests/fake_zk.py) always, and against a REAL ZooKeeper when
@@ -56,6 +64,7 @@ ZNONODE = -101
 ZNODEEXISTS = -110
 ZNOTEMPTY = -111
 ZBADVERSION = -103
+ZCONNECTIONLOSS = -4
 
 # event types
 EV_CREATED, EV_DELETED, EV_CHANGED, EV_CHILD = 1, 2, 3, 4
@@ -65,6 +74,9 @@ F_EPHEMERAL, F_SEQUENCE = 1, 2
 
 #: world:anyone ALL — the ACL the reference passes (ZOO_OPEN_ACL_UNSAFE)
 _OPEN_ACL = (31, "world", "anyone")
+
+#: event-queue sentinel: session resumed on a new socket, re-arm watches
+_RECONNECTED = object()
 
 
 class _Buf:
@@ -132,6 +144,10 @@ class ZkError(CoordinatorError):
         self.code = code
 
 
+class _SessionExpired(Exception):
+    """Resume handshake answered with session 0: ZK expired the session."""
+
+
 class ZkConnection:
     """One ZK session over one socket; thread-safe request dispatch."""
 
@@ -147,8 +163,17 @@ class ZkConnection:
         self._pending_lock = threading.Lock()
         self._closed = False
         self.session_id = 0
+        self._passwd = b"\x00" * 16
+        #: set while a live socket carries the session; cleared during
+        #: reconnect so call() can wait instead of failing spuriously
+        self._up = threading.Event()
         self.on_event: Optional[Callable[[int, int, str], None]] = None
         self.on_session_lost: Optional[Callable[[], None]] = None
+        #: fired (on the event-dispatch thread) after a successful
+        #: in-session reconnect — the coordinator re-arms its watches here
+        self.on_reconnected: Optional[Callable[[], None]] = None
+        #: successful in-session reconnects (observability + tests)
+        self.reconnect_count = 0
         #: events dispatch from their own thread — handlers re-arm watches
         #: with blocking calls, which would deadlock the reader (the reader
         #: is the only thread that can deliver those calls' replies)
@@ -167,7 +192,11 @@ class ZkConnection:
         self._pinger.start()
 
     # -- wiring ---------------------------------------------------------------
-    def _connect(self) -> None:
+    def _connect(self, resume: bool = False) -> None:
+        """Establish a socket carrying this session. ``resume=True``
+        re-presents sessionId+passwd (the reconnect path, ≙ libzookeeper's
+        in-timeout reconnect, zk.cpp:139-150); raises _SessionExpired when
+        ZK answers with session 0 — the session is genuinely gone."""
         last: Optional[Exception] = None
         for host, port in self.hosts:
             try:
@@ -178,23 +207,33 @@ class ZkConnection:
                     struct.pack(">i", 0),            # protocolVersion
                     struct.pack(">q", 0),            # lastZxidSeen
                     struct.pack(">i", self.session_timeout_ms),
-                    struct.pack(">q", 0),            # sessionId (new)
-                    struct.pack(">i", 16), b"\x00" * 16,  # passwd
+                    struct.pack(">q", self.session_id if resume else 0),
+                    struct.pack(">i", len(self._passwd) if resume else 16),
+                    self._passwd if resume else b"\x00" * 16,
                 ])
                 sock.sendall(struct.pack(">i", len(req)) + req)
                 resp = self._read_frame_from(sock)
                 rb = _Buf(resp)
                 rb.i32()                              # protocolVersion
-                self.negotiated_ms = rb.i32()
-                self.session_id = rb.i64()
-                if self.negotiated_ms <= 0:
+                negotiated = rb.i32()
+                sid = rb.i64()
+                passwd = rb.buf()
+                if negotiated <= 0 or sid == 0:
+                    if resume:
+                        sock.close()
+                        raise _SessionExpired()
                     raise CoordinatorError("zookeeper rejected the session")
+                self.negotiated_ms = negotiated
+                self.session_id = sid
+                if passwd:
+                    self._passwd = passwd
                 # the connect timeout must NOT persist: the reader blocks in
                 # recv between pings (interval = negotiated/3, which may
                 # exceed 10s), and a spurious socket.timeout there would
                 # fire the session-lost suicide path on a healthy session
                 sock.settimeout(None)
                 self._sock = sock
+                self._up.set()
                 return
             except (OSError, struct.error, CoordinatorError) as e:
                 last = e
@@ -219,8 +258,8 @@ class ZkConnection:
         return body
 
     def _read_loop(self) -> None:
-        try:
-            while not self._closed:
+        while not self._closed:
+            try:
                 frame = self._read_frame_from(self._sock)
                 rb = _Buf(frame)
                 xid = rb.i32()
@@ -234,21 +273,77 @@ class ZkConnection:
                     continue
                 if xid == XID_PING:
                     continue
-                with self._pending_lock:
-                    slot = self._pending.pop(xid, None)
-                if slot is not None:
-                    slot[1] = (err, rb)
-                    slot[0].set()
+            except Exception:  # noqa: BLE001 — a corrupt/truncated frame
+                # means the stream is unusable, exactly like a dead socket:
+                # resume the session on a fresh connection or die loudly —
+                # the reader must NEVER exit silently (call()s would all
+                # time out and the suicide contract would never fire)
+                if self._closed:
+                    break
+                log.warning("zookeeper stream error; reconnecting",
+                            exc_info=True)
+                if self._try_resume():
+                    continue
+                self._fail_all()
+                return
+            with self._pending_lock:
+                slot = self._pending.pop(xid, None)
+            if slot is not None:
+                slot[1] = (err, rb)
+                slot[0].set()
+
+    def _try_resume(self) -> bool:
+        """Socket died: reconnect across the host list with the existing
+        session credentials before the server expires the session. True =
+        the session lives on a fresh socket (watch re-arm is queued for
+        the dispatcher); False = expired or out of time — session is lost."""
+        import time as _time
+
+        self._up.clear()
+        try:
+            self._sock.close()
         except OSError:
             pass
-        finally:
-            self._fail_all()
+        # in-flight replies died with the socket; their outcome is unknown
+        # (ZCONNECTIONLOSS semantics — the op may or may not have applied)
+        with self._pending_lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for slot in pending:
+            slot[1] = (ZCONNECTIONLOSS, None)
+            slot[0].set()
+        deadline = _time.monotonic() + self.negotiated_ms / 1000.0
+        while not self._closed and _time.monotonic() < deadline:
+            try:
+                self._connect(resume=True)
+            except _SessionExpired:
+                log.error("zookeeper expired session 0x%x during reconnect",
+                          self.session_id)
+                return False
+            except (CoordinatorError, OSError, struct.error):
+                _time.sleep(0.2)
+                continue
+            log.warning("zookeeper session 0x%x resumed on a new socket",
+                        self.session_id)
+            self.reconnect_count += 1
+            # dispatcher thread re-arms watches (blocking calls would
+            # deadlock here: this IS the reader that delivers replies)
+            self._events.put(_RECONNECTED)
+            return True
+        return False
 
     def _event_loop(self) -> None:
         while True:
             ev = self._events.get()
             if ev is None:
                 return
+            if ev is _RECONNECTED:
+                if self.on_reconnected is not None:
+                    try:
+                        self.on_reconnected()
+                    except Exception:  # noqa: BLE001
+                        log.exception("zk reconnect re-arm failed")
+                continue
             if self.on_event is not None:
                 try:
                     self.on_event(*ev)
@@ -259,6 +354,7 @@ class ZkConnection:
         if self._closed:
             return
         self._closed = True
+        self._up.set()  # unblock call()s parked on the reconnect gate
         with self._pending_lock:
             pending = list(self._pending.values())
             self._pending.clear()
@@ -278,17 +374,29 @@ class ZkConnection:
             threading.Event().wait(interval)
             if self._closed:
                 return
+            if not self._up.is_set():
+                continue  # reconnect in progress; the reader owns recovery
+            sock = self._sock  # the socket THIS ping used: shutting down
+            # self._sock after a concurrent resume would kill the fresh one
             try:
                 hdr = struct.pack(">ii", XID_PING, OP_PING)
                 with self._wlock:
-                    self._sock.sendall(
-                        struct.pack(">i", len(hdr)) + hdr)
+                    sock.sendall(struct.pack(">i", len(hdr)) + hdr)
             except OSError:
-                self._fail_all()
-                return
+                # wake the reader (it may be blocked in recv on a socket
+                # that only fails on write); it drives resume-or-die
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
 
     # -- request plumbing -----------------------------------------------------
     def call(self, opcode: int, payload: bytes, timeout: float = 10.0):
+        if self._closed:
+            raise CoordinatorError("zookeeper session closed")
+        if not self._up.wait(timeout):
+            # mid-reconnect and it didn't come back in time
+            raise CoordinatorError("zookeeper connection lost (reconnecting)")
         if self._closed:
             raise CoordinatorError("zookeeper session closed")
         with self._xid_lock:
@@ -298,18 +406,32 @@ class ZkConnection:
         with self._pending_lock:
             self._pending[xid] = slot
         frame = struct.pack(">ii", xid, opcode) + payload
+        sock = self._sock  # shut down the socket WE failed on, never a
+        # fresh one a concurrent resume may have installed
         try:
             with self._wlock:
-                self._sock.sendall(struct.pack(">i", len(frame)) + frame)
+                sock.sendall(struct.pack(">i", len(frame)) + frame)
         except OSError as e:
-            self._fail_all()
-            raise CoordinatorError(f"zookeeper send failed: {e}") from e
+            # socket died under us: the reader notices and resumes the
+            # session; THIS call's outcome is unknown (connection loss)
+            with self._pending_lock:
+                self._pending.pop(xid, None)
+            try:
+                sock.shutdown(socket.SHUT_RDWR)  # wake the reader
+            except OSError:
+                pass
+            raise CoordinatorError(
+                f"zookeeper connection lost during send: {e}") from e
         if not slot[0].wait(timeout):
             with self._pending_lock:
                 self._pending.pop(xid, None)
             raise CoordinatorError("zookeeper request timed out")
         err, rb = slot[1]
         if rb is None:
+            if err == ZCONNECTIONLOSS:
+                raise CoordinatorError(
+                    "zookeeper connection lost mid-request (outcome "
+                    "unknown; session resuming)")
             raise CoordinatorError("zookeeper session lost")
         return err, rb
 
@@ -323,6 +445,7 @@ class ZkConnection:
         except OSError:
             pass
         self._closed = True
+        self._up.set()  # unblock call()s parked on the reconnect gate
         # fail any in-flight call immediately: a thread blocked in call()
         # must not sit out its full timeout reporting a bogus "timed out"
         # when the session was intentionally closed
@@ -347,6 +470,7 @@ class ZkCoordinator(Coordinator):
         self._conn = ZkConnection(hosts, session_timeout_ms)
         self._conn.on_event = self._on_event
         self._conn.on_session_lost = self._session_lost
+        self._conn.on_reconnected = self._on_reconnected
         self._lock = threading.Lock()
         self._child_watchers: Dict[str, List[Callable[[str], None]]] = {}
         self._delete_watchers: Dict[str, List[Callable[[str], None]]] = {}
@@ -407,6 +531,46 @@ class ZkCoordinator(Coordinator):
                     self._exists(path, watch=True)
                 except CoordinatorError:
                     pass
+
+    def _on_reconnected(self) -> None:
+        """The session survived a socket loss on a fresh connection: ZK
+        dropped our one-shot watches with the old socket, so re-arm every
+        registered watch, and deliver anything that changed while we were
+        away — a delete-watched node that vanished fires its handler NOW
+        (the event itself is gone forever), and child watchers get one
+        synthetic notification so membership readers resync."""
+        with self._lock:
+            child_paths = list(self._child_watchers)
+            del_paths = list(self._delete_watchers)
+        for p in del_paths:
+            try:
+                present = self._exists(p, watch=True) is not None
+            except CoordinatorError:
+                log.warning("delete-watch re-arm failed for %s", p,
+                            exc_info=True)
+                continue
+            if not present:
+                with self._lock:
+                    fns = self._delete_watchers.pop(p, [])
+                for fn in fns:
+                    try:
+                        fn(p)
+                    except Exception:  # noqa: BLE001
+                        log.exception("delete watcher failed for %s", p)
+        for p in child_paths:
+            try:
+                if self._get_children(p, watch=True) is None:
+                    self._exists(p, watch=True)
+            except CoordinatorError:
+                log.warning("child-watch re-arm failed for %s", p,
+                            exc_info=True)
+            with self._lock:
+                fns = list(self._child_watchers.get(p, ()))
+            for fn in fns:
+                try:
+                    fn(p)
+                except Exception:  # noqa: BLE001
+                    log.exception("child watcher failed for %s", p)
 
     def _session_lost(self) -> None:
         log.error("zookeeper session lost; firing delete watchers")
@@ -555,21 +719,24 @@ class ZkCoordinator(Coordinator):
         self._exists(path, watch=True)
 
     def try_lock(self, path: str) -> bool:
-        if path in self._held_locks:
-            return True
+        with self._lock:
+            if path in self._held_locks:
+                return True
         self._mkparents(path)
         err, _ = self._create(path, b"", F_EPHEMERAL)
         if err == ZOK:
-            self._held_locks.add(path)
+            with self._lock:
+                self._held_locks.add(path)
             return True
         if err == ZNODEEXISTS:
             return False
         raise ZkError(err, path)
 
     def unlock(self, path: str) -> bool:
-        if path not in self._held_locks:
-            return False
-        self._held_locks.discard(path)
+        with self._lock:
+            if path not in self._held_locks:
+                return False
+            self._held_locks.discard(path)
         return self.remove(path)
 
     def create_id(self, path: str) -> int:

@@ -31,14 +31,19 @@ Round protocol (master = this round's lock holder):
    an ack node the master folds into the actives transitions.
 
 Failure model, closed loop: a member that never observes GO times out
-and discards its stage (it never entered). A member that dies after
-entering is detected by the jax distributed runtime's heartbeat, which
-tears the world down and errors the psum out on everyone — the blast
-radius of losing a chip mid-allreduce in any SPMD step. A member that
-loses the coordinator stops via its own session handling, which is the
-same death the runtime then detects. Engines whose mixables are not
-plain-sum (dict-shaped diffs: bandit, burst, row stores) are detected in
-prepare and served by the RPC fallback path unchanged.
+and makes a FINAL verification read before discarding its stage — GO
+present after all: enter late (peers are waiting in the psum); GO
+verifiably absent: discard, nobody entered on this rid; coordinator
+UNREADABLE (absence unverifiable — peers may be inside the collective
+while this member cannot know): the member tears down its own
+jax.distributed world, which the runtime's heartbeat turns into an error
+on every peer's psum — bounded entry, never a silent wedge — and routes
+its future rounds to the RPC fallback. A member that dies after entering
+is detected the same way. A member that loses the coordinator stops via
+its own session handling, which is the same death the runtime then
+detects. Engines whose mixables are not plain-sum (dict-shaped diffs:
+bandit, burst, row stores) are detected in prepare and served by the RPC
+fallback path unchanged.
 """
 
 from __future__ import annotations
@@ -103,6 +108,10 @@ class CollectiveMixer(RpcLinearMixer):
         self._round_seq = 0
         self.collective_rounds = 0
         self.fallback_rounds = 0
+        #: set after this member had to tear the jax world down (GO-window
+        #: timeout with the coordinator unreadable): the collective plane
+        #: is gone for this process; every later round mixes over RPC
+        self.collective_dead = False
 
     # -- coordinator paths ----------------------------------------------------
     def _go_path(self) -> str:
@@ -142,7 +151,10 @@ class CollectiveMixer(RpcLinearMixer):
             if union and hasattr(self.driver, "sync_schema"):
                 self.driver.sync_schema(union)
             mixables = self.driver.get_mixables()
-            if not all(_summable(m) for m in mixables.values()):
+            if self.collective_dead or \
+                    not all(_summable(m) for m in mixables.values()):
+                # a dead world would fail the psum and demote this member;
+                # "unsupported" routes the whole round to the RPC mix
                 return [int(self.model_version), "unsupported"]
             diffs = {name: m.get_diff() for name, m in mixables.items()}
         with self._staged_lock:
@@ -186,12 +198,49 @@ class CollectiveMixer(RpcLinearMixer):
                         break
             time.sleep(_GO_POLL_SEC)
         if base is None:
+            # deadline passed without observing GO. Before discarding,
+            # VERIFY its absence — every poll above may have failed while
+            # peers observed GO and entered the psum; discarding blind
+            # would wedge them forever (the runtime detects process death,
+            # not non-participation).
             with self._staged_lock:
-                dropped = self._staged.pop(rid, None)
-            if dropped is not None:
-                log.warning("round %s: no GO within %.0fs; staged diff "
-                            "discarded", rid, self._go_wait())
-            return
+                still_staged = rid in self._staged
+            if not still_staged:
+                return  # aborted or superseded meanwhile
+            try:
+                raw = self.comm.coord.read(self._go_path())
+            except Exception:  # noqa: BLE001 — coordinator unreadable
+                raw = False  # sentinel: absence NOT verified
+            if raw not in (None, False, b""):
+                try:
+                    msg = unpack_obj(raw)
+                    got = msg.get("rid")
+                    got = got.decode() if isinstance(got, bytes) else got
+                    if got == rid:  # GO was there all along: enter late,
+                        base = int(msg.get("base", 0))  # peers are waiting
+                except Exception:  # noqa: BLE001
+                    pass
+            if base is None:
+                with self._staged_lock:
+                    dropped = self._staged.pop(rid, None)
+                if dropped is None:
+                    return
+                if raw is False:
+                    # unverifiable: peers may be inside the collective.
+                    # Bound their wait by killing this member's jax world —
+                    # the runtime errors the psum out on everyone (the
+                    # documented 'world torn down' model); this process
+                    # mixes over RPC from now on.
+                    log.error("round %s: no GO within %.0fs and the "
+                              "coordinator is unreadable; tearing down the "
+                              "jax distributed world to unblock any "
+                              "entered peers", rid, self._go_wait())
+                    self._kill_world()
+                else:
+                    log.warning("round %s: no GO within %.0fs (verified "
+                                "absent); staged diff discarded", rid,
+                                self._go_wait())
+                return
         ok = False
         try:
             ok = self._enter_collective(rid, base)
@@ -213,6 +262,15 @@ class CollectiveMixer(RpcLinearMixer):
                                     exc_info=True)
                     time.sleep(0.1)
 
+    def _kill_world(self) -> None:
+        self.collective_dead = True
+        try:
+            import jax
+
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 — already down is fine
+            log.debug("jax.distributed.shutdown raised", exc_info=True)
+
     def _enter_collective(self, rid: str, base_version: int) -> bool:
         with self._staged_lock:
             entry = self._staged.pop(rid, None)
@@ -232,9 +290,10 @@ class CollectiveMixer(RpcLinearMixer):
     def _run_as_master(self, members: Sequence[NodeInfo]) -> Optional[Dict[str, Any]]:
         import jax
 
-        if jax.process_count() != len(members):
-            # replicas are not one jax world (or not all joined yet):
-            # the collective cannot span them — mix over RPC
+        if self.collective_dead or jax.process_count() != len(members):
+            # world torn down by a bounded-entry timeout, or replicas are
+            # not one jax world (not all joined yet): the collective
+            # cannot span them — mix over RPC
             self.fallback_rounds += 1
             return super()._run_as_master(members)
         t0 = time.monotonic()

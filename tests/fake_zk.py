@@ -1,12 +1,18 @@
 """Minimal in-process ZooKeeper server for protocol-level tests.
 
-Speaks the same jute wire as coord/zk.py's client: session handshake,
-create (persistent/ephemeral/sequence), delete, exists, getData,
-setData, getChildren, one-shot watches, ping, closeSession. One session
-per connection; a closed/dead connection drops its ephemerals and fires
-watches, like the real thing. Enough ZooKeeper to prove the client's
-encoding, watch re-arm, and session semantics without a live quorum —
-the real-ZK integration tests gate on JUBATUS_TPU_ZK.
+Speaks the same jute wire as coord/zk.py's client: session handshake
+(including SESSION RESUMPTION: a ConnectRequest carrying a known live
+sessionId reattaches that session to the new socket, like a real
+ensemble member), create (persistent/ephemeral/sequence), delete,
+exists, getData, setData, getChildren, one-shot watches, ping,
+closeSession. By default a closed/dead connection drops its ephemerals
+and fires watches immediately (the historical behavior most tests
+rely on); setting ``session_grace`` to a number of seconds keeps an
+abruptly-disconnected session alive for that long awaiting resumption —
+the knob the reconnect chaos tests use. ``expire_session`` force-expires
+one. Enough ZooKeeper to prove the client's encoding, watch re-arm, and
+session semantics without a live quorum — the real-ZK integration tests
+gate on JUBATUS_TPU_ZK.
 """
 
 from __future__ import annotations
@@ -78,6 +84,13 @@ class FakeZkServer:
         self._next_session = 1
         self.port: Optional[int] = None
         self._running = False
+        #: sid -> {"token": <current connection's marker>, "timer": Timer?,
+        #:          "timeout": negotiated ms}
+        self.sessions: Dict[int, dict] = {}
+        #: None: abrupt disconnect expires the session at once (historic
+        #: behavior). A float: the session survives that many seconds
+        #: awaiting resumption — the real-ZK model, for reconnect tests.
+        self.session_grace: Optional[float] = None
 
     # -- lifecycle ------------------------------------------------------------
     def start(self, port: int = 0) -> int:
@@ -128,15 +141,40 @@ class FakeZkServer:
 
     def _serve(self, conn: socket.socket) -> None:
         wlock = threading.Lock()
-        with self._lock:
-            session = self._next_session
-            self._next_session += 1
+        session: Optional[int] = None
+        token = object()
+        clean = False
         try:
             req = self._read_frame(conn)
             off = 0
             _, off = _rd_i32(req, off)       # protocolVersion
             _, off = _rd_i64(req, off)       # lastZxid
             timeout, off = _rd_i32(req, off)
+            want_sid = 0
+            if len(req) > off:
+                want_sid, off = _rd_i64(req, off)
+            with self._lock:
+                if want_sid:
+                    sess = self.sessions.get(want_sid)
+                    if sess is None:
+                        # expired: answer session 0 / timeOut 0 and hang up
+                        resp = (struct.pack(">ii", 0, 0)
+                                + struct.pack(">q", 0)
+                                + struct.pack(">i", 16) + b"\x00" * 16)
+                        with wlock:
+                            conn.sendall(struct.pack(">i", len(resp)) + resp)
+                        return
+                    t = sess.pop("timer", None)
+                    if t is not None:
+                        t.cancel()
+                    sess["token"] = token
+                    session = want_sid
+                    timeout = sess["timeout"]
+                else:
+                    session = self._next_session
+                    self._next_session += 1
+                    self.sessions[session] = {"token": token,
+                                              "timeout": timeout}
             resp = (struct.pack(">i", 0) + struct.pack(">i", timeout)
                     + struct.pack(">q", session)
                     + struct.pack(">i", 16) + b"\x00" * 16)
@@ -150,6 +188,7 @@ class FakeZkServer:
                     self._reply(conn, wlock, -2, 0, b"")
                     continue
                 if op == -11:                # closeSession
+                    clean = True
                     self._reply(conn, wlock, xid, 0, b"")
                     return
                 err, payload = self._dispatch(op, frame, off, session,
@@ -158,11 +197,41 @@ class FakeZkServer:
         except OSError:
             pass
         finally:
-            self._drop_session(session)
+            if session is not None:
+                with self._lock:
+                    sess = self.sessions.get(session)
+                    owner = sess is not None and sess.get("token") is token
+                if owner:
+                    if clean or self.session_grace is None:
+                        self.expire_session(session, token)
+                    else:
+                        t = threading.Timer(self.session_grace,
+                                            self.expire_session,
+                                            args=(session, token))
+                        t.daemon = True
+                        with self._lock:
+                            sess["timer"] = t
+                        t.start()
             try:
                 conn.close()
             except OSError:
                 pass
+
+    def expire_session(self, session: int, token=None) -> None:
+        """Expire ``session`` now (test hook; also the grace-timer body).
+        With ``token``, only if that connection still owns the session —
+        a resumed session must not be killed by its dead predecessor."""
+        with self._lock:
+            sess = self.sessions.get(session)
+            if sess is None:
+                return
+            if token is not None and sess.get("token") is not token:
+                return
+            t = sess.pop("timer", None)
+            if t is not None:
+                t.cancel()
+            del self.sessions[session]
+        self._drop_session(session)
 
     @staticmethod
     def _reply(conn, wlock, xid, err, payload) -> None:

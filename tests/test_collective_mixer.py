@@ -211,3 +211,92 @@ def test_prepared_member_discards_stage_without_go(monkeypatch):
         c.close()
     finally:
         srv.stop()
+
+
+def test_go_timeout_with_unreadable_coordinator_tears_world_down(monkeypatch):
+    """Bounded entry (round-2 advisor): if the GO window expires and the
+    coordinator cannot even be READ, peers may be sitting inside the psum
+    already — the member must kill its jax world (erroring them out)
+    rather than discard silently, and must route later rounds to RPC."""
+    import jubatus_tpu.framework.collective_mixer as cm
+
+    monkeypatch.setattr(cm, "GO_WAIT_SEC", 0.4)
+    store = _Store()
+    args = ServerArgs(engine="classifier", coordinator="(shared)",
+                      name=NAME, listen_addr="127.0.0.1",
+                      mixer="collective_mixer", interconnect_timeout=0.1,
+                      interval_sec=1e9, interval_count=1 << 30)
+    srv = EngineServer("classifier", CONF, args,
+                       coord=MemoryCoordinator(store))
+    srv.start(0)
+    try:
+        from jubatus_tpu.client import ClassifierClient, Datum
+
+        c = ClassifierClient("127.0.0.1", srv.args.rpc_port, NAME)
+        c.train([["pos", Datum({"a": 1.0})]])
+        entered, killed = [], []
+        srv.mixer._enter_collective = \
+            lambda *a, **k: entered.append(a) or False
+        monkeypatch.setattr(srv.mixer, "_kill_world",
+                            lambda: killed.append(1) or setattr(
+                                srv.mixer, "collective_dead", True))
+
+        def dead_read(path):
+            raise RuntimeError("coordinator unreachable")
+
+        monkeypatch.setattr(srv.mixer.comm.coord, "read", dead_read)
+        srv.mixer.local_prepare("dark-round", [])
+        deadline = time.time() + 5
+        while time.time() < deadline and not killed:
+            time.sleep(0.05)
+        assert killed, "world not torn down on unverifiable GO absence"
+        assert not entered
+        assert not srv.mixer._staged
+        assert srv.mixer.collective_dead
+        # later rounds must refuse the collective plane up front
+        version, sig = srv.mixer.local_prepare("next-round", [])
+        assert sig == "unsupported"
+        srv.mixer.local_abort("next-round")
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_go_observed_only_at_final_check_still_enters(monkeypatch):
+    """Every in-window poll failing but GO being present at the final
+    verification read means peers ARE waiting: the member enters late
+    instead of discarding."""
+    import jubatus_tpu.framework.collective_mixer as cm
+    from jubatus_tpu.utils.serialization import pack_obj
+
+    monkeypatch.setattr(cm, "GO_WAIT_SEC", 0.4)
+    store = _Store()
+    args = ServerArgs(engine="classifier", coordinator="(shared)",
+                      name=NAME, listen_addr="127.0.0.1",
+                      mixer="collective_mixer", interconnect_timeout=0.1,
+                      interval_sec=1e9, interval_count=1 << 30)
+    srv = EngineServer("classifier", CONF, args,
+                       coord=MemoryCoordinator(store))
+    srv.start(0)
+    try:
+        from jubatus_tpu.client import ClassifierClient, Datum
+
+        c = ClassifierClient("127.0.0.1", srv.args.rpc_port, NAME)
+        c.train([["pos", Datum({"a": 1.0})]])
+        entered = []
+        srv.mixer._enter_collective = \
+            lambda rid, base: entered.append((rid, base)) or True
+        go = pack_obj({"rid": "late-round", "base": 7})
+        # zero window: the waiter skips straight to the final verification
+        # read, which is exactly the path under test
+        srv.mixer._go_wait = lambda: 0.0
+        monkeypatch.setattr(srv.mixer.comm.coord, "read", lambda p: go)
+        srv.mixer.local_prepare("late-round", [])
+        deadline = time.time() + 5
+        while time.time() < deadline and not entered:
+            time.sleep(0.05)
+        assert entered and entered[0] == ("late-round", 7)
+        assert not srv.mixer.collective_dead
+        c.close()
+    finally:
+        srv.stop()

@@ -219,3 +219,129 @@ def test_engine_cluster_over_zk():
         for s in servers:
             s.stop()
         srv.stop()
+
+
+# -- in-session reconnect (VERDICT r2 missing item 1) ------------------------
+# Fake-only: these need session_grace + expire_session + host-list surgery,
+# which a shared real ensemble can't offer.
+
+
+def _wait_until(cond, timeout=8.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_socket_loss_resumes_session_ephemerals_survive():
+    """A TCP reset is NOT session loss: the client reconnects with
+    sessionId+passwd inside the negotiated timeout (zk.cpp:139-150), the
+    ephemerals survive, and no delete/suicide watcher fires."""
+    srv = FakeZkServer()
+    srv.session_grace = 15.0
+    port = srv.start(0)
+    c = ZkCoordinator.from_locator(f"zk://127.0.0.1:{port}")
+    try:
+        assert c.create("/app/me", b"x", ephemeral=True)
+        fired = []
+        c.watch_delete("/app/me", fired.append)
+        sid = c._conn.session_id
+
+        c._conn._sock.shutdown(2)  # the network blip
+
+        assert _wait_until(lambda: c._conn.reconnect_count == 1
+                           and not c._conn._closed)
+        assert c._conn.session_id == sid          # same session, new socket
+        assert c.read("/app/me") == b"x"          # ephemeral survived
+        assert c.exists("/app/me")
+        time.sleep(0.3)
+        assert fired == []                        # no spurious suicide
+        # the session still works end to end
+        assert c.create("/app/me2", b"y", ephemeral=True)
+    finally:
+        c.close()
+        srv.stop()
+
+
+def test_watches_rearm_after_reconnect():
+    """One-shot watches die with the socket; after an in-session resume
+    the coordinator re-arms them, so changes made through ANOTHER client
+    still notify this one."""
+    srv = FakeZkServer()
+    srv.session_grace = 15.0
+    port = srv.start(0)
+    c = ZkCoordinator.from_locator(f"zk://127.0.0.1:{port}")
+    other = ZkCoordinator.from_locator(f"zk://127.0.0.1:{port}")
+    try:
+        kids = []
+        c.watch_children("/members", kids.append)
+        c._conn._sock.shutdown(2)
+        assert _wait_until(lambda: c._conn.reconnect_count == 1)
+        seen = len(kids)
+        other.create("/members/n1", b"")
+        assert _wait_until(lambda: len(kids) > seen)
+        assert c.list("/members") == ["n1"]
+    finally:
+        c.close()
+        other.close()
+        srv.stop()
+
+
+def test_delete_during_disconnect_fires_on_resume():
+    """A delete-watched node removed WHILE the socket is down can never
+    deliver its event; the re-arm pass detects the absence and fires the
+    handler on resume (no lost-deletion window)."""
+    srv = FakeZkServer()
+    srv.session_grace = 15.0
+    port = srv.start(0)
+    c = ZkCoordinator.from_locator(f"zk://127.0.0.1:{port}")
+    other = ZkCoordinator.from_locator(f"zk://127.0.0.1:{port}")
+    try:
+        other.create("/app/gone", b"")
+        fired = []
+        c.watch_delete("/app/gone", fired.append)
+        # force the reconnect loop to spin against a dead port while the
+        # other client deletes the node
+        real_hosts = c._conn.hosts
+        c._conn.hosts = [("127.0.0.1", 1)]
+        c._conn._sock.shutdown(2)
+        assert _wait_until(lambda: not c._conn._up.is_set())
+        other.remove("/app/gone")
+        c._conn.hosts = real_hosts
+        assert _wait_until(lambda: fired == ["/app/gone"])
+        assert not c._conn._closed                # session itself survived
+    finally:
+        c.close()
+        other.close()
+        srv.stop()
+
+
+def test_session_expiry_still_fires_session_lost():
+    """Genuine server-side expiry during the outage must still take the
+    suicide path: resume is answered with session 0, delete watchers
+    fire, and the coordinator is dead."""
+    srv = FakeZkServer()
+    srv.session_grace = 15.0
+    port = srv.start(0)
+    c = ZkCoordinator.from_locator(f"zk://127.0.0.1:{port}")
+    try:
+        c.create("/app/me", b"", ephemeral=True)
+        fired = []
+        c.watch_delete("/app/me", fired.append)
+        sid = c._conn.session_id
+        # block reconnects while we expire the session server-side
+        real_hosts = c._conn.hosts
+        c._conn.hosts = [("127.0.0.1", 1)]
+        c._conn._sock.shutdown(2)
+        assert _wait_until(lambda: not c._conn._up.is_set())
+        srv.expire_session(sid)
+        c._conn.hosts = real_hosts
+        assert _wait_until(lambda: fired == ["/app/me"], timeout=12.0)
+        assert c._conn._closed
+        with pytest.raises(Exception):
+            c.read("/app/me")
+    finally:
+        c.close()
+        srv.stop()
