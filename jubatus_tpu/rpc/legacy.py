@@ -44,6 +44,58 @@ def unpackb(buf: bytes) -> Any:
     return obj
 
 
+#: payload byte lengths of the fixed-width legacy scalar types
+_SCALAR_WIDTH = {0xC0: 0, 0xC2: 0, 0xC3: 0, 0xCA: 4, 0xCB: 8, 0xCC: 1,
+                 0xCD: 2, 0xCE: 4, 0xCF: 8, 0xD0: 1, 0xD1: 2, 0xD2: 4,
+                 0xD3: 8}
+
+
+def scan_is_legacy(buf: bytes) -> bool:
+    """Walk ONE msgpack object's type bytes without building any values:
+    True iff every type byte existed in pre-2013 msgpack (i.e. a vendored-
+    msgpack client could have produced the buffer). This is the skip-
+    style fingerprint the servers run on a connection's first request —
+    unpackb would construct a multi-megabyte object tree just to throw it
+    away on bulk train calls."""
+    b = memoryview(buf)
+    n = len(b)
+    i = 0
+    remaining = 1  # objects still to skip
+    while remaining:
+        if i >= n:
+            return False  # truncated: not a well-formed legacy object
+        t = b[i]
+        i += 1
+        remaining -= 1
+        if t <= 0x7F or t >= 0xE0:
+            continue
+        if 0x80 <= t <= 0x8F:          # fixmap
+            remaining += (t & 0x0F) * 2
+        elif 0x90 <= t <= 0x9F:        # fixarray
+            remaining += t & 0x0F
+        elif 0xA0 <= t <= 0xBF:        # fixraw
+            i += t & 0x1F
+        elif t in _SCALAR_WIDTH:
+            i += _SCALAR_WIDTH[t]
+        elif t == 0xDA or t == 0xDB:   # raw16/32
+            w = 2 if t == 0xDA else 4
+            if i + w > n:
+                return False
+            i += w + int.from_bytes(b[i:i + w], "big")
+        elif t in (0xDC, 0xDD, 0xDE, 0xDF):  # array16/32, map16/32
+            w = 2 if t in (0xDC, 0xDE) else 4
+            if i + w > n:
+                return False
+            count = int.from_bytes(b[i:i + w], "big")
+            if count > n - i:  # cannot possibly fit: hostile/corrupt
+                return False
+            i += w
+            remaining += count * (2 if t in (0xDE, 0xDF) else 1)
+        else:
+            return False  # post-2013 type byte (or reserved)
+    return i == n
+
+
 def _unpack(fmt: str, b: memoryview, i: int):
     """struct.unpack_from with the truncation contract this module
     documents: short input is LegacyFormatError, never struct.error

@@ -87,10 +87,19 @@ class NativeRpcServer:
 
     def __init__(self, timeout: float = 10.0,
                  trace: Optional[Registry] = None,
-                 legacy_wire: bool = False) -> None:
+                 legacy_wire: bool = False,
+                 wire_detect: bool = False) -> None:
         self._methods: Dict[str, Callable[..., Any]] = {}
         self._arity: Dict[str, Optional[int]] = {}
         self.legacy_wire = legacy_wire
+        self.wire_detect = wire_detect
+        #: conn_id -> first-request fingerprint ({"legacy": bool}).
+        #: Entries die with their connection (the C++ front-end announces
+        #: closes via the _CLOSE msgid sentinel); the size cap is only a
+        #: backstop against >4096 LIVE connections, where an eviction
+        #: costs a re-fingerprint on that connection's next request.
+        self._conn_wire: Dict[int, dict] = {}
+        self._wire_lock = threading.Lock()
         self._binary_methods: set = set()
         self._raw_methods: Dict[str, Callable[[bytes], Any]] = {}
         self.timeout = timeout
@@ -120,6 +129,10 @@ class NativeRpcServer:
         an executor hop measured ~35% slower; a slow handler only stalls
         its own connection (other clients have their own reader threads),
         matching one-request-at-a-time sync-client semantics."""
+        if msgid == self._CLOSE:
+            with self._wire_lock:
+                self._conn_wire.pop(conn_id, None)
+            return
         try:
             method_name = ctypes.string_at(method, method_len).decode(
                 "utf-8", "replace")
@@ -133,15 +146,33 @@ class NativeRpcServer:
 
     #: msgid sentinel the C++ side uses for notifications
     _NOTIFY = (1 << 64) - 1
+    #: msgid sentinel the C++ side sends when a connection closes
+    _CLOSE = (1 << 64) - 2
 
     def _dispatch(self, conn_id: int, msgid: int, method: str,
                   raw: bytes) -> None:
+        conn_state = None
+        if self.wire_detect and not self.legacy_wire:
+            with self._wire_lock:
+                conn_state = self._conn_wire.get(conn_id)
+            if conn_state is None:
+                from jubatus_tpu.rpc.server import wire_is_legacy
+
+                # the params span is a complete msgpack object; the
+                # envelope (fixints + a short fixstr method) can never
+                # carry modern type bytes, so params alone fingerprints
+                conn_state = {"legacy": wire_is_legacy(raw)}
+                with self._wire_lock:
+                    if len(self._conn_wire) >= 4096:
+                        self._conn_wire.pop(next(iter(self._conn_wire)))
+                    self._conn_wire[conn_id] = conn_state
         # raw fast path: the C++ front-end already isolated the params
         # span; registered raw handlers consume it without Python decode
         if method in self._raw_methods and msgid != self._NOTIFY:
             error, result = self._execute_fast(method, raw)
-            payload = build_response(msgid, error, result,
-                                     legacy=self.response_legacy(method))
+            payload = build_response(
+                msgid, error, result,
+                legacy=self.response_legacy(method, conn_state))
             self._lib.jt_rpc_respond(self._handle, conn_id, payload,
                                      len(payload))
             return
@@ -155,8 +186,9 @@ class NativeRpcServer:
             error, result = self._execute(method, params)
         if msgid == self._NOTIFY:
             return  # notification: no response on the wire
-        payload = build_response(msgid, error, result,
-                                 legacy=self.response_legacy(method))
+        payload = build_response(
+            msgid, error, result,
+            legacy=self.response_legacy(method, conn_state))
         self._lib.jt_rpc_respond(self._handle, conn_id, payload, len(payload))
 
     # -- lifecycle (RpcServer-compatible) -------------------------------------
@@ -193,13 +225,19 @@ class NativeRpcServer:
 
 
 def create_rpc_server(timeout: float = 10.0, trace: Optional[Registry] = None,
-                      legacy_wire: bool = False):
-    """RpcServer factory: native transport when JUBATUS_TPU_NATIVE_RPC=1
-    and the library builds, else the Python transport."""
+                      legacy_wire: bool = False, wire_detect: bool = True):
+    """RpcServer factory for the jubatus-facing planes (engine servers,
+    proxies): native transport when JUBATUS_TPU_NATIVE_RPC=1 and the
+    library builds, else the Python transport. Per-connection legacy-wire
+    autodetection defaults ON here — an unmodified deployed client works
+    with no flags; internal services construct RpcServer directly and
+    stay modern-only."""
     if os.environ.get("JUBATUS_TPU_NATIVE_RPC", "") in ("1", "true", "yes"):
         try:
             return NativeRpcServer(timeout=timeout, trace=trace,
-                                   legacy_wire=legacy_wire)
+                                   legacy_wire=legacy_wire,
+                                   wire_detect=wire_detect)
         except RuntimeError as e:
             log.warning("native rpc unavailable (%s); using python transport", e)
-    return RpcServer(timeout=timeout, trace=trace, legacy_wire=legacy_wire)
+    return RpcServer(timeout=timeout, trace=trace, legacy_wire=legacy_wire,
+                     wire_detect=wire_detect)

@@ -38,6 +38,22 @@ REQUEST, RESPONSE, NOTIFY = 0, 1, 2
 RAW_FALLBACK = object()
 
 
+def wire_is_legacy(raw: bytes) -> bool:
+    """Fingerprint one request: True when it contains NO post-2013 msgpack
+    type bytes — i.e. the reference's vendored-msgpack client could have
+    produced it. Such connections are answered in the legacy raw format,
+    which modern unpackers also accept (old raw16/raw32 are modern
+    str16/str32), so a false positive only costs the str/bytes distinction
+    a modern client never relied on for the jubatus API. A single modern
+    type byte (str8/bin/ext) proves a modern client and pins the
+    connection to the modern format. Skip-style scan — no values are
+    built, so a multi-megabyte first train call costs one type-byte
+    walk, not a throwaway decode."""
+    from jubatus_tpu.rpc import legacy as _legacy
+
+    return _legacy.scan_is_legacy(raw)
+
+
 def _parse_envelope(raw: bytes):
     """Request envelope without decoding params: ``[0, msgid, method, ...]``
     -> (msgid, method, params_offset), or None for anything else (notify,
@@ -81,7 +97,8 @@ class RpcServer:
 
     def __init__(self, timeout: float = 10.0,
                  trace: Optional[Registry] = None,
-                 legacy_wire: bool = False) -> None:
+                 legacy_wire: bool = False,
+                 wire_detect: bool = False) -> None:
         self._methods: Dict[str, Callable[..., Any]] = {}
         self._arity: Dict[str, Optional[int]] = {}
         #: pack responses in the pre-str8/bin msgpack format old jubatus
@@ -91,6 +108,15 @@ class RpcServer:
         #: them, and old-raw would lose the str/bytes distinction for our
         #: own peers.
         self.legacy_wire = legacy_wire
+        #: per-connection autodetection: fingerprint each connection's
+        #: FIRST request (wire_is_legacy) and answer legacy-format when it
+        #: carries no post-2013 type bytes — an unmodified deployed
+        #: jubatus client works against a server started with NO flags
+        #: (the reference speaks old-format on every connection,
+        #: client/common/client.hpp:30-87). Engine servers and proxies
+        #: enable this; internal planes (coordd) stay modern-only so bytes
+        #: payloads keep their type.
+        self.wire_detect = wire_detect
         self._binary_methods: set = set()
         #: raw-span fast paths: method -> fn(raw_params bytes) -> result
         #: (or RAW_FALLBACK to decode generically). Served straight off the
@@ -207,6 +233,10 @@ class RpcServer:
         base = 0       # stream offset of buf[0]
         msg_start = 0  # stream offset of the next undelivered message
         wlock = threading.Lock()
+        #: first request fingerprints the peer's wire era (skipped when
+        #: --legacy-wire already forces every answer legacy)
+        conn_state = {"legacy": False}
+        first = self.wire_detect and not self.legacy_wire
         try:
             while self._running:
                 data = conn.recv(65536)
@@ -222,7 +252,10 @@ class RpcServer:
                     end = framer.tell()
                     raw = bytes(buf[msg_start - base:end - base])
                     msg_start = end
-                    self._handle_raw(conn, wlock, raw)
+                    if first:
+                        first = False
+                        conn_state["legacy"] = wire_is_legacy(raw)
+                    self._handle_raw(conn, wlock, raw, conn_state)
                 del buf[:msg_start - base]
                 base = msg_start
         except (OSError, ValueError, struct.error):
@@ -234,24 +267,26 @@ class RpcServer:
                 pass
 
     def _handle_raw(self, conn: socket.socket, wlock: threading.Lock,
-                    raw: bytes) -> None:
+                    raw: bytes, conn_state: Optional[dict] = None) -> None:
         env = _parse_envelope(raw)
         if env is not None:
             msgid, method, off = env
             if method in self._raw_methods and self._pool is not None:
                 self._pool.submit(self._dispatch_fast, conn, wlock, msgid,
-                                  method, raw[off:])
+                                  method, raw[off:], conn_state)
                 return
         msg = msgpack.unpackb(raw, raw=False, strict_map_key=False,
                               use_list=True,
                               unicode_errors="surrogateescape")
-        self._handle(conn, wlock, msg)
+        self._handle(conn, wlock, msg, conn_state)
 
     def _dispatch_fast(self, conn, wlock, msgid, method,
-                       raw_params: bytes) -> None:
+                       raw_params: bytes,
+                       conn_state: Optional[dict] = None) -> None:
         error, result = self._execute_fast(method, raw_params)
-        payload = build_response(msgid, error, result,
-                                 legacy=self.response_legacy(method))
+        payload = build_response(
+            msgid, error, result,
+            legacy=self.response_legacy(method, conn_state))
         try:
             with wlock:
                 conn.sendall(payload)
@@ -281,22 +316,26 @@ class RpcServer:
                                  unicode_errors="surrogateescape")
         return self._execute(method, params)
 
-    def _handle(self, conn: socket.socket, wlock: threading.Lock, msg: Any) -> None:
+    def _handle(self, conn: socket.socket, wlock: threading.Lock, msg: Any,
+                conn_state: Optional[dict] = None) -> None:
         if not isinstance(msg, (list, tuple)) or not msg:
             return
         if msg[0] == REQUEST and len(msg) == 4:
             _, msgid, method, params = msg
             if self._pool is not None:
-                self._pool.submit(self._dispatch, conn, wlock, msgid, method, params)
+                self._pool.submit(self._dispatch, conn, wlock, msgid, method,
+                                  params, conn_state)
         elif msg[0] == NOTIFY and len(msg) == 3:
             _, method, params = msg
             if self._pool is not None:
                 self._pool.submit(self._invoke_silent, method, params)
 
-    def _dispatch(self, conn, wlock, msgid, method, params) -> None:
+    def _dispatch(self, conn, wlock, msgid, method, params,
+                  conn_state: Optional[dict] = None) -> None:
         error, result = self._execute(method, params)
-        payload = build_response(msgid, error, result,
-                                 legacy=self.response_legacy(method))
+        payload = build_response(
+            msgid, error, result,
+            legacy=self.response_legacy(method, conn_state))
         try:
             with wlock:
                 conn.sendall(payload)
@@ -331,9 +370,16 @@ class RpcServer:
         except Exception:  # noqa: BLE001
             log.debug("rpc notify %s raised", method, exc_info=True)
 
-    def response_legacy(self, method: str) -> bool:
-        """Whether this method's responses go out in the old wire format."""
-        return self.legacy_wire and method not in self._binary_methods
+    def response_legacy(self, method: str,
+                        conn_state: Optional[dict] = None) -> bool:
+        """Whether this method's responses go out in the old wire format:
+        forced globally by --legacy-wire, or detected per connection from
+        its first request's fingerprint (wire_detect)."""
+        if method in self._binary_methods:
+            return False
+        if self.legacy_wire:
+            return True
+        return bool(conn_state and conn_state.get("legacy"))
 
 
 def build_response(msgid: int, error: Any, result: Any,

@@ -43,10 +43,15 @@ class ServerArgs:
     #: row-sharded signature tables for NN/recommender/anomaly hash
     #: methods
     shard_devices: int = 0
-    #: answer in the pre-str8/bin msgpack format deployed jubatus
-    #: clients require (their vendored msgpack predates those types);
-    #: mixer internals keep the modern format (rpc/legacy.py)
+    #: FORCE every response into the pre-str8/bin msgpack format deployed
+    #: jubatus clients require (their vendored msgpack predates those
+    #: types); mixer internals keep the modern format (rpc/legacy.py).
+    #: Without it, servers AUTODETECT per connection from the first
+    #: request's fingerprint — unmodified old clients just work.
     legacy_wire: bool = False
+    #: disable the per-connection legacy-wire autodetection (answer every
+    #: non-forced connection in modern msgpack)
+    modern_wire: bool = False
     #: jax.distributed world for --mixer collective_mixer: every replica
     #: process must join one runtime so the mix's diff psum can span them
     #: (parallel/multihost.py). Process 0's address doubles as the
@@ -127,9 +132,15 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "classifier/regression, row-sharded signature "
                         "tables for NN/recommender/anomaly hash methods")
     p.add_argument("--legacy-wire", action="store_true",
-                   help="pack RPC responses in the pre-str8/bin msgpack "
-                        "format so unmodified legacy jubatus clients "
-                        "(vendored pre-2013 msgpack) can parse them")
+                   help="FORCE all RPC responses into the pre-str8/bin "
+                        "msgpack format legacy jubatus clients (vendored "
+                        "pre-2013 msgpack) parse; without it the server "
+                        "autodetects per connection from the first "
+                        "request's type bytes")
+    p.add_argument("--modern-wire", action="store_true",
+                   help="disable the per-connection legacy-wire "
+                        "autodetection: always answer in modern msgpack "
+                        "(str8/bin) unless --legacy-wire forces otherwise")
     p.add_argument("--jax-coordinator", default="",
                    help="jax.distributed coordinator host:port (process "
                         "0's reachable address) for --mixer "

@@ -69,7 +69,8 @@ class EngineServer:
 
         self.rpc = create_rpc_server(
             timeout=self.args.timeout,
-            legacy_wire=getattr(self.args, "legacy_wire", False))
+            legacy_wire=getattr(self.args, "legacy_wire", False),
+            wire_detect=not getattr(self.args, "modern_wire", False))
         self._stop_event = threading.Event()
         self._stop_once = threading.Lock()  # first stop() wins; rest no-op
         #: pooled peer clients for server-side replicated writes

@@ -55,6 +55,7 @@ class ProxyArgs:
     session_pool_size: int = 0          # --pool_size, 0 = unbounded
     daemon: bool = False
     legacy_wire: bool = False           # --legacy-wire (see rpc/legacy.py)
+    modern_wire: bool = False           # --modern-wire: no autodetection
 
     @property
     def bind_host(self) -> str:
@@ -128,7 +129,8 @@ class Proxy:
 
         self.rpc = create_rpc_server(
             timeout=args.timeout,
-            legacy_wire=getattr(args, "legacy_wire", False))
+            legacy_wire=getattr(args, "legacy_wire", False),
+            wire_detect=not getattr(args, "modern_wire", False))
         self.start_time = time.time()
         self._pool: Dict[Tuple[str, int], _Session] = {}
         self._pool_lock = threading.Lock()
@@ -326,8 +328,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--pool-expire", dest="session_pool_expire", type=float, default=60.0)
     p.add_argument("--pool-size", dest="session_pool_size", type=int, default=0)
     p.add_argument("--legacy-wire", action="store_true",
-                   help="pack responses in the pre-str8/bin msgpack format "
-                        "for unmodified legacy jubatus clients")
+                   help="FORCE responses into the pre-str8/bin msgpack "
+                        "format for unmodified legacy jubatus clients "
+                        "(otherwise autodetected per connection)")
+    p.add_argument("--modern-wire", action="store_true",
+                   help="disable per-connection legacy-wire autodetection")
     ns = p.parse_args(argv)
     args = ProxyArgs(**{f.name: getattr(ns, f.name)
                         for f in dataclasses.fields(ProxyArgs)

@@ -204,6 +204,12 @@ typedef void (*request_cb)(uint64_t conn_id, uint64_t msgid,
                            const char* method, int64_t method_len,
                            const uint8_t* params, int64_t params_len);
 
+// msgid sentinel announcing a connection CLOSED (method/params empty):
+// lets the Python side drop per-connection state (wire-era fingerprints)
+// deterministically instead of guessing with an eviction cap.
+// (~0ull is already taken by the notification sentinel.)
+constexpr uint64_t kCloseId = ~0ull - 1;
+
 struct Conn {
   int fd;
   std::mutex write_mu;
@@ -313,6 +319,8 @@ done:
     s->conns.erase(conn_id);
   }
   ::close(conn->fd);
+  // after the fd is gone: no response can race this notification
+  s->cb(conn_id, kCloseId, "", 0, nullptr, 0);
 }
 
 void accept_loop(Server* s) {

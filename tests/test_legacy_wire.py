@@ -83,11 +83,15 @@ def _datum(pairs_str, pairs_num):
     return [list(map(list, pairs_str)), list(map(list, pairs_num))]
 
 
-@pytest.fixture()
-def legacy_server(tmp_path):
+@pytest.fixture(params=["forced", "autodetect"])
+def legacy_server(tmp_path, request):
+    """Old client against (a) a server FORCED legacy with --legacy-wire,
+    and (b) a server started with NO flags — per-connection autodetection
+    (VERDICT r2 item 5) must make the same full session pass."""
     srv = EngineServer(
         "classifier", CLASSIFIER_CONF,
-        args=ServerArgs(engine="classifier", legacy_wire=True,
+        args=ServerArgs(engine="classifier",
+                        legacy_wire=(request.param == "forced"),
                         datadir=str(tmp_path)))
     port = srv.start(0)
     cli = LegacyClient("127.0.0.1", port)
@@ -133,6 +137,40 @@ def test_legacy_client_full_session(legacy_server):
     assert cli.call("load", NAME, "legacy_model") is True
     assert cli.call("do_mix", NAME) is False  # standalone: no mixer
     assert cli.call("clear", NAME) is True
+
+
+def test_autodetect_pins_modern_connection_modern(tmp_path):
+    """A first request carrying a post-2013 type byte (str8) proves a
+    modern client: that connection's responses stay modern (str8 present)
+    — autodetection must not degrade modern clients' wire."""
+    srv = EngineServer(
+        "classifier", CLASSIFIER_CONF,
+        args=ServerArgs(engine="classifier", datadir=str(tmp_path)))
+    port = srv.start(0)
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        # use_bin_type=True + a >=32-char param emits str8 in the request;
+        # the config string response is >32 bytes, so a MODERN response
+        # must contain str8/bin and the legacy unpacker must reject it
+        req = msgpack.packb([0, 1, "get_config", ["m" * 40]],
+                            use_bin_type=True)
+        sock.sendall(req)
+        buf = b""
+        while True:
+            try:
+                legacy._decode(memoryview(buf), 0)
+                pytest.fail("response parsed as legacy — connection was "
+                            "not pinned modern")
+            except legacy.LegacyFormatError as e:
+                if "truncated" not in str(e):
+                    break  # forbidden modern type byte: exactly right
+            chunk = sock.recv(65536)
+            if not chunk:
+                pytest.fail("no response")
+            buf += chunk
+    finally:
+        sock.close()
+        srv.stop()
 
 
 def test_modern_mode_emits_str8_legacy_rejects():
@@ -226,7 +264,8 @@ def test_legacy_binary_datum_through_proxy():
     """The binary-datum fix must survive the proxy hop: the proxy decodes
     with surrogateescape and its forwarding client must re-encode the
     original bytes (code-review finding: UnicodeEncodeError in
-    RpcClient.call was misclassified as a dead backend)."""
+    RpcClient.call was misclassified as a dead backend). The proxy runs
+    with NO flags — autodetection must recognize the old client."""
     from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
     from jubatus_tpu.server.proxy import Proxy, ProxyArgs
 
@@ -237,8 +276,7 @@ def test_legacy_binary_datum_through_proxy():
     srv = EngineServer("classifier", CLASSIFIER_CONF, args,
                        coord=MemoryCoordinator(store))
     srv.start(0)
-    proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1",
-                            legacy_wire=True),
+    proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1"),
                   coord=MemoryCoordinator(store))
     proxy.start(0)
     cli = LegacyClient("127.0.0.1", proxy.args.rpc_port)
@@ -253,3 +291,23 @@ def test_legacy_binary_datum_through_proxy():
         cli.close()
         proxy.stop()
         srv.stop()
+
+
+def test_scan_is_legacy_matches_unpackb_verdict():
+    """The skip-style fingerprint must agree with the full legacy decoder
+    on every shape: legal-legacy buffers scan True, any post-2013 type
+    byte scans False, truncation scans False."""
+    legal = [None, True, 0, -5, 2**40, 0.5, "s", "y" * 31, "z" * 70000,
+             [1, [2, "three"]], {"k": [1.5, None]}, list(range(40)),
+             [0, 1, "train", ["c", [["lb", [[["k", "v"]], [["n", 1.0]]]]]]]]
+    for v in legal:
+        buf = msgpack.packb(v, use_bin_type=False)
+        assert legacy.scan_is_legacy(buf), v
+        for cut in range(1, len(buf)):
+            assert not legacy.scan_is_legacy(buf[:cut])
+    modern = [b"\x00" * 4, "z" * 40, ["x", b"\x01"], {"k": "w" * 64}]
+    for v in modern:
+        buf = msgpack.packb(v, use_bin_type=True)
+        assert not legacy.scan_is_legacy(buf), v
+    # hostile: huge claimed array length must not loop forever
+    assert not legacy.scan_is_legacy(b"\xdd\x7f\xff\xff\xff" + b"\x01" * 8)
