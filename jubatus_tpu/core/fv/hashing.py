@@ -47,8 +47,13 @@ class FeatureHasher:
         self._reverse_capacity = reverse_capacity
 
     def index(self, name: str, remember: bool = True) -> int:
-        # crc32 is stable across processes/platforms (unlike Python's hash()).
-        h = zlib.crc32(name.encode("utf-8")) & self._mask
+        # crc32 is stable across processes/platforms (unlike Python's
+        # hash()). surrogateescape: legacy clients may carry non-UTF8
+        # string values (admitted wire-wide with surrogateescape), and the
+        # hash must cover the ORIGINAL bytes — the C++ ingest path hashes
+        # raw bytes, so strict encoding here would either crash (surrogates
+        # not allowed) or diverge from the native fast path.
+        h = zlib.crc32(name.encode("utf-8", "surrogateescape")) & self._mask
         if h == 0:
             h = 1  # index 0 is the padding slot
         if remember and len(self._reverse) < self._reverse_capacity:

@@ -90,12 +90,20 @@ def spec_from_converter_config(conv: dict) -> Optional[str]:
               "combination_rules", "binary_types"):
         if conv.get(k):
             return None
-    # type tables: only builtin names usable (no method params needed)
+    # type tables: builtin names plus parameterized ngram
     str_types = {"str": "str", "space": "space"}
     for tname, params in (conv.get("string_types") or {}).items():
         method = (params or {}).get("method")
         if method in ("str", "space"):
             str_types[tname] = method
+        elif method == "ngram":
+            try:
+                n = int((params or {}).get("char_num", ""))
+            except (TypeError, ValueError):
+                n = 0
+            # upper bound: C++ parses with atoi (int); a window wider than
+            # any realistic text must decline rather than risk divergence
+            str_types[tname] = f"ngram:{n}" if 1 <= n <= 65535 else None
         else:
             str_types[tname] = None  # unsupported; rules using it bail
     num_types = {"num": "num", "log": "log", "str": "str"}

@@ -109,8 +109,9 @@ struct NumRule {
 };
 
 struct StrRule {
-  enum Split { WHOLE, SPACE } split = WHOLE;
+  enum Split { WHOLE, SPACE, NGRAM } split = WHOLE;
   enum Sw { BIN, TF, LOG_TF } sw = BIN;
+  int ngram_n = 0;  // code points per ngram token (split == NGRAM)
   Matcher m;
   std::string suffix;  // "@<type>#<sw>/<gw>"
 };
@@ -323,50 +324,78 @@ struct Reader {
   }
 };
 
-// Python str.split() splits on Unicode whitespace (str.isspace): ASCII
-// 0x09-0x0d, 0x1c-0x1f, 0x20, plus NEL/NBSP and the Unicode space
-// separators. The fast path must tokenize exactly like the Python
-// converter or models diverge between paths. Decodes one UTF-8 code
-// point at txt[i]; *adv = its byte length (1 for invalid sequences,
-// which Python surfaces as non-space surrogates).
-inline bool is_py_space(const uint8_t* txt, size_t n, size_t i,
-                        size_t* adv) {
+// Decode one code point at txt[i] exactly like CPython's UTF-8 decoder
+// under surrogateescape: *adv = bytes consumed. A sequence is one code
+// point ONLY if it is shortest-form UTF-8 encoding a scalar value
+// (no overlongs — lead 0xC0/0xC1, 0xE0 with 2nd byte < 0xA0, 0xF0 with
+// 2nd byte < 0x90; no surrogates — 0xED with 2nd byte > 0x9F; nothing
+// past U+10FFFF — leads 0xF5+, 0xF4 with 2nd byte > 0x8F); any invalid,
+// truncated, or malformed byte decodes as ONE surrogate (adv 1, cp 0).
+// Both splitters slide in these units, or they diverge from the Python
+// converter on hostile bytes.
+inline bool utf8_decode(const uint8_t* txt, size_t n, size_t i,
+                        uint32_t* cp_out, size_t* adv) {
   uint8_t b = txt[i];
+  *cp_out = 0;
+  *adv = 1;
   if (b < 0x80) {
-    *adv = 1;
-    return (b >= 0x09 && b <= 0x0D) || (b >= 0x1C && b <= 0x1F) ||
-           b == 0x20;
+    *cp_out = b;
+    return true;
   }
-  uint32_t cp = 0;
   size_t len;
-  if ((b & 0xE0) == 0xC0) {
+  uint32_t cp;
+  uint8_t lo = 0x80, hi = 0xBF;  // valid range of the SECOND byte
+  if (b >= 0xC2 && b <= 0xDF) {
     len = 2;
     cp = b & 0x1F;
-  } else if ((b & 0xF0) == 0xE0) {
+  } else if (b >= 0xE0 && b <= 0xEF) {
     len = 3;
     cp = b & 0x0F;
-  } else if ((b & 0xF8) == 0xF0) {
+    if (b == 0xE0) lo = 0xA0;        // overlong
+    if (b == 0xED) hi = 0x9F;        // surrogate range
+  } else if (b >= 0xF0 && b <= 0xF4) {
     len = 4;
     cp = b & 0x07;
+    if (b == 0xF0) lo = 0x90;        // overlong
+    if (b == 0xF4) hi = 0x8F;        // > U+10FFFF
   } else {
-    *adv = 1;
-    return false;  // stray continuation byte
+    return false;  // stray continuation, 0xC0/0xC1 overlong, 0xF5+ lead
   }
-  if (i + len > n) {
-    *adv = 1;
-    return false;  // truncated sequence
-  }
-  for (size_t k = 1; k < len; ++k) {
-    if ((txt[i + k] & 0xC0) != 0x80) {
-      *adv = 1;
-      return false;  // malformed sequence
-    }
+  if (i + len > n) return false;  // truncated
+  if (txt[i + 1] < lo || txt[i + 1] > hi) return false;
+  cp = (cp << 6) | (txt[i + 1] & 0x3F);
+  for (size_t k = 2; k < len; ++k) {
+    if ((txt[i + k] & 0xC0) != 0x80) return false;
     cp = (cp << 6) | (txt[i + k] & 0x3F);
   }
   *adv = len;
+  *cp_out = cp;
+  return true;
+}
+
+// Python str.split() splits on Unicode whitespace (str.isspace): ASCII
+// 0x09-0x0d, 0x1c-0x1f, 0x20, plus NEL/NBSP and the Unicode space
+// separators. Invalid sequences decode as non-space surrogates.
+inline bool is_py_space(const uint8_t* txt, size_t n, size_t i,
+                        size_t* adv) {
+  uint32_t cp;
+  if (!utf8_decode(txt, n, i, &cp, adv)) return false;
+  if (cp < 0x80)
+    return (cp >= 0x09 && cp <= 0x0D) || (cp >= 0x1C && cp <= 0x1F) ||
+           cp == 0x20;
   return cp == 0x85 || cp == 0xA0 || cp == 0x1680 ||
          (cp >= 0x2000 && cp <= 0x200A) || cp == 0x2028 || cp == 0x2029 ||
          cp == 0x202F || cp == 0x205F || cp == 0x3000;
+}
+
+// Byte length of the code point at txt[i] under Python's surrogateescape
+// view of the bytes (utf8_decode rules): the ngram splitter slides in
+// exactly these units to match converter.py's text[i:i+n].
+inline size_t utf8_adv(const uint8_t* txt, size_t n, size_t i) {
+  uint32_t cp;
+  size_t adv;
+  utf8_decode(txt, n, i, &cp, &adv);
+  return adv;
 }
 
 // Python _format_num (converter.py:485-486): str(int(v)) when integral,
@@ -508,7 +537,14 @@ void* jt_ingest_create(const char* spec) {
         r.split = StrRule::WHOLE;
       else if (f[1] == "space")
         r.split = StrRule::SPACE;
-      else {
+      else if (f[1].rfind("ngram:", 0) == 0) {
+        r.split = StrRule::NGRAM;
+        r.ngram_n = atoi(f[1].c_str() + 6);
+        if (r.ngram_n < 1) {
+          delete ps;
+          return nullptr;
+        }
+      } else {
         delete ps;
         return nullptr;
       }
@@ -686,7 +722,8 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
         terms.clear();
         if (r.split == StrRule::WHOLE) {
           if (txtn) terms.push_back({txt, txtn});
-        } else {  // SPACE: Unicode whitespace runs (str.split())
+        } else if (r.split == StrRule::SPACE) {
+          // SPACE: Unicode whitespace runs (str.split())
           size_t i = 0;
           while (i < txtn) {
             size_t adv;
@@ -695,6 +732,19 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
             while (i < txtn && !is_py_space(txt, txtn, i, &adv)) i += adv;
             if (i > s) terms.push_back({txt + s, i - s});
           }
+        } else {  // NGRAM: sliding window of n CODE POINTS (converter.py
+          // _make_ngram slides over a surrogateescape-decoded str)
+          std::vector<size_t> cps;  // byte offset of each code point
+          size_t i = 0;
+          while (i < txtn) {
+            cps.push_back(i);
+            i += utf8_adv(txt, txtn, i);
+          }
+          cps.push_back(txtn);
+          size_t n_cp = cps.size() - 1;
+          for (size_t a = 0; a + size_t(r.ngram_n) <= n_cp; ++a)
+            terms.push_back(
+                {txt + cps[a], cps[a + size_t(r.ngram_n)] - cps[a]});
         }
         // counts per distinct term (small n: quadratic dedupe is fine
         // for realistic token counts; sorted spans would cost more)

@@ -272,7 +272,10 @@ const uint8_t* parse_frame(Server* s, uint64_t conn_id, const uint8_t* p,
   int64_t mlen;
   if (count == 4) {  // request
     if (!read_uint(q, frame_end, &type) || type != 0) return malformed();
-    if (!read_uint(q, frame_end, &msgid) || msgid == kNotifyMsgid)
+    // both sentinels are reserved: a wire msgid equal to kCloseId would
+    // spoof a connection-close notification into the Python layer
+    if (!read_uint(q, frame_end, &msgid) || msgid == kNotifyMsgid ||
+        msgid == kCloseId)
       return malformed();
   } else if (count == 3) {  // notification
     if (!read_uint(q, frame_end, &type) || type != 2) return malformed();

@@ -135,10 +135,16 @@ def test_spec_rejects_unsupported_configs():
         "num_rules": [{"key": "*", "type": "num"}],
         "combination_rules": [{"key_left": "*", "key_right": "*",
                                "type": "mul"}]}) is None
-    # ngram splitters are unsupported (utf-8 code-point slicing)
+    # ngram IS supported since round 3 (utf-8 code-point slicing in C++);
+    # regexp splitters still are not
     assert ingest.spec_from_converter_config({
         "string_types": {"bigram": {"method": "ngram", "char_num": "2"}},
         "string_rules": [{"key": "*", "type": "bigram",
+                          "sample_weight": "bin",
+                          "global_weight": "bin"}]}) is not None
+    assert ingest.spec_from_converter_config({
+        "string_types": {"rx": {"method": "regexp", "pattern": "a+"}},
+        "string_rules": [{"key": "*", "type": "rx",
                           "sample_weight": "bin",
                           "global_weight": "bin"}]}) is None
 
@@ -404,3 +410,80 @@ def test_parser_survives_mutation_fuzz():
         out2 = p.parse_datums(bytes(raw))
         if out2 is not None:
             assert out2[0].shape == out2[1].shape
+
+
+def test_parity_ngram_splitter():
+    """ngram string types (round-3 coverage extension): the C++ sliding
+    window must match converter.py's text[i:i+n] over a surrogateescape-
+    decoded str — code points, not bytes, including malformed UTF-8."""
+    conv = {
+        "string_types": {"bigram": {"method": "ngram", "char_num": "2"},
+                         "tri": {"method": "ngram", "char_num": "3"}},
+        "string_rules": [
+            {"key": "*", "type": "bigram", "sample_weight": "tf",
+             "global_weight": "bin"},
+            {"key": "t*", "type": "tri", "sample_weight": "log_tf",
+             "global_weight": "bin"},
+        ],
+    }
+    spec = ingest.spec_from_converter_config(conv)
+    assert spec is not None
+    p = ingest.IngestParser(spec, 18)
+    pyconv = make_fv_converter(conv, dim_bits=18)
+    texts = ["", "a", "ab", "abc", "ababab", "café au lait", "日本語のテキスト",
+             "mixed 日本 text", "aa" * 40,
+             b"bad\xffutf8\xc3(seq".decode("utf-8", "surrogateescape"),
+             b"\xe2\x82".decode("utf-8", "surrogateescape"),  # truncated
+             # shortest-form violations: CPython decodes each byte as one
+             # surrogate; the C++ walker must count the same code points
+             b"\xc0\x80a".decode("utf-8", "surrogateescape"),   # overlong NUL
+             b"\xe0\x80\x80b".decode("utf-8", "surrogateescape"),
+             b"\xed\xa0\x80c".decode("utf-8", "surrogateescape"),  # surrogate
+             b"\xf0\x80\x80\x80d".decode("utf-8", "surrogateescape"),
+             b"\xf4\x90\x80\x80e".decode("utf-8", "surrogateescape"),  # >10FFFF
+             b"\xf5\x80\x80\x80f".decode("utf-8", "surrogateescape"),
+             b"a\xc2 b\xe1\x80 c\xf3\x80\x80".decode("utf-8",
+                                                     "surrogateescape"),
+             # overlong-encoded SPACE (0xC0 0xA0): must NOT split as space
+             b"x\xc0\xa0y".decode("utf-8", "surrogateescape")]
+    rng = random.Random(21)
+    alphabet = "abφ語 \t"
+    texts += ["".join(rng.choice(alphabet) for _ in range(rng.randint(0, 30)))
+              for _ in range(120)]
+    data = [("L", Datum(string_values=[(rng.choice(["txt", "body"]), t)]))
+            for t in texts]
+    raw = msgpack.packb(["c", [[l, d.to_msgpack()] for l, d in data]],
+                        use_bin_type=True, unicode_errors="surrogateescape")
+    labels, idx, val = p.parse(raw)
+    for i, (_, d) in enumerate(data):
+        assert _got(idx[i], val[i]) == _expected(pyconv, d), texts[i]
+
+
+def test_parity_space_splitter_hostile_utf8():
+    """The SPACE splitter shares the validated decoder: overlong-encoded
+    whitespace (e.g. 0xC0 0xA0 for SPACE) must be treated as non-space
+    surrogates exactly like Python does."""
+    conv = {"string_rules": [{"key": "*", "type": "space",
+                              "sample_weight": "tf",
+                              "global_weight": "bin"}]}
+    p = ingest.IngestParser(ingest.spec_from_converter_config(conv), 18)
+    pyconv = make_fv_converter(conv, dim_bits=18)
+    texts = [b"x\xc0\xa0y".decode("utf-8", "surrogateescape"),
+             b"a\xe0\x80\x85b".decode("utf-8", "surrogateescape"),
+             b"u\xc2\x85v".decode("utf-8", "surrogateescape"),  # real NEL
+             b"q\xed\xa0\x80 r".decode("utf-8", "surrogateescape")]
+    data = [("L", Datum(string_values=[("t", t)])) for t in texts]
+    raw = msgpack.packb(["c", [[l, d.to_msgpack()] for l, d in data]],
+                        use_bin_type=True, unicode_errors="surrogateescape")
+    labels, idx, val = p.parse(raw)
+    for i, (_, d) in enumerate(data):
+        assert _got(idx[i], val[i]) == _expected(pyconv, d), texts[i]
+
+
+def test_ngram_bad_char_num_not_expressible():
+    for bad in ("0", "-1", "x", None, "4294967297"):
+        conv = {"string_types": {"g": {"method": "ngram", "char_num": bad}},
+                "string_rules": [{"key": "*", "type": "g",
+                                  "sample_weight": "bin",
+                                  "global_weight": "bin"}]}
+        assert ingest.spec_from_converter_config(conv) is None
