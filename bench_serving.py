@@ -198,6 +198,86 @@ def run(transport: str = "python", workload: str = "numeric",
     }
 
 
+def run_proxy(transport: str = "python",
+              measure: float = MEASURE_SECONDS) -> dict:
+    """Proxy-tier path (VERDICT r2 item 8): clients -> Proxy (random
+    routing, session pool) -> EngineServer, numeric workload. Proxy and
+    server share this process (the host has ONE core, so separate
+    processes would interleave on it exactly like threads do); the proxy
+    hop's real cost — decode, route, re-encode, second socket — is all
+    here. Reference shape: juba*_proxy, proxy.hpp:502-593."""
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+    prev = os.environ.get("JUBATUS_TPU_NATIVE_RPC")
+    if transport == "native":
+        os.environ["JUBATUS_TPU_NATIVE_RPC"] = "1"
+    else:
+        os.environ.pop("JUBATUS_TPU_NATIVE_RPC", None)
+    srv = proxy = None
+    procs = []
+    try:
+        store = _Store()
+        srv = EngineServer(
+            "classifier", CONF,
+            args=ServerArgs(engine="classifier", coordinator="(shared)",
+                            name="bench", listen_addr="127.0.0.1",
+                            thread=N_CLIENTS, interval_sec=1e9,
+                            interval_count=1 << 30),
+            coord=MemoryCoordinator(store))
+        srv.start(0)
+        proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1",
+                                thread=N_CLIENTS),
+                      coord=MemoryCoordinator(store))
+        pport = proxy.start(0)
+        if prev is None:
+            os.environ.pop("JUBATUS_TPU_NATIVE_RPC", None)
+        else:
+            os.environ["JUBATUS_TPU_NATIVE_RPC"] = prev
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JUBATUS_TPU_PLATFORM"] = "cpu"
+        path = env.get("PYTHONPATH", "")
+        if repo not in path.split(os.pathsep):
+            env["PYTHONPATH"] = repo + (os.pathsep + path if path else "")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _CLIENT_PROG, str(pport),
+                 str(CALL_BATCH), str(K), str(WARMUP_SECONDS), str(measure),
+                 "numeric"],
+                env=env, cwd=repo, stdout=subprocess.PIPE, text=True)
+            for _ in range(N_CLIENTS)
+        ]
+        total, elapsed_max = 0, 0.0
+        for p in procs:
+            out, _ = p.communicate(
+                timeout=WARMUP_SECONDS + measure + 240)
+            for line in out.splitlines():
+                if line.startswith("CLIENT "):
+                    _, cnt, el = line.split()
+                    total += int(cnt)
+                    elapsed_max = max(elapsed_max, float(el))
+    finally:
+        if prev is None:
+            os.environ.pop("JUBATUS_TPU_NATIVE_RPC", None)
+        else:
+            os.environ["JUBATUS_TPU_NATIVE_RPC"] = prev
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if proxy is not None:
+            proxy.stop()
+        if srv is not None:
+            srv.stop()
+    sps = total / elapsed_max if elapsed_max else 0.0
+    return {f"e2e_rpc_train_samples_per_sec_proxy_{transport}":
+            round(sps, 1)}
+
+
 def collect(trials: int = 2) -> dict:
     """Alternate transports and keep each one's best trial: run-to-run
     spread through the device tunnel is ~±10% (host scheduling + tunnel
@@ -236,6 +316,21 @@ def collect(trials: int = 2) -> dict:
                            measure=TEXT_MEASURE_SECONDS, tag=tag))
         except Exception as e:  # noqa: BLE001
             out[f"e2e_{tag}_error"] = repr(e)[:200]
+    # proxy tier: same numeric workload through the proxy hop (best of
+    # `trials`, symmetric with the direct metric's best-of selection)
+    pkey = f"e2e_rpc_train_samples_per_sec_proxy_{text_tr}"
+    for _ in range(trials):
+        try:
+            r = run_proxy(text_tr)
+        except Exception as e:  # noqa: BLE001
+            out["e2e_proxy_error"] = repr(e)[:200]
+            continue
+        if r.get(pkey, 0) > out.get(pkey, 0):
+            out.update(r)
+    direct = out.get(f"e2e_rpc_train_samples_per_sec_{text_tr}")
+    via = out.get(pkey)
+    if direct and via:
+        out["e2e_proxy_vs_direct"] = round(via / direct, 3)
     return out
 
 

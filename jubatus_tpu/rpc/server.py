@@ -54,6 +54,93 @@ def wire_is_legacy(raw: bytes) -> bool:
     return _legacy.scan_is_legacy(raw)
 
 
+#: payload widths of fixed-size msgpack types (modern family included)
+_MP_SCALAR_WIDTH = {0xC0: 0, 0xC2: 0, 0xC3: 0, 0xCA: 4, 0xCB: 8, 0xCC: 1,
+                    0xCD: 2, 0xCE: 4, 0xCF: 8, 0xD0: 1, 0xD1: 2, 0xD2: 4,
+                    0xD3: 8, 0xD4: 2, 0xD5: 3, 0xD6: 5, 0xD7: 9, 0xD8: 17}
+
+
+def msgpack_span_end(buf: bytes, i: int = 0) -> int:
+    """End offset of the msgpack object starting at ``buf[i]`` — a type-
+    byte walk that builds no values (the raw relay path needs to split an
+    envelope into spans without decoding multi-megabyte payloads).
+    Raises ValueError on truncated/unknown bytes."""
+    n = len(buf)
+    remaining = 1
+    while remaining:
+        if i >= n:
+            raise ValueError("truncated msgpack object")
+        t = buf[i]
+        i += 1
+        remaining -= 1
+        if t <= 0x7F or t >= 0xE0:
+            continue
+        if 0x80 <= t <= 0x8F:
+            remaining += (t & 0x0F) * 2
+        elif 0x90 <= t <= 0x9F:
+            remaining += t & 0x0F
+        elif 0xA0 <= t <= 0xBF:
+            i += t & 0x1F
+        elif t in _MP_SCALAR_WIDTH:
+            i += _MP_SCALAR_WIDTH[t]
+        elif t in (0xC4, 0xC7, 0xD9):     # bin8/ext8/str8
+            if i >= n:
+                raise ValueError("truncated msgpack object")
+            i += 1 + buf[i] + (1 if t == 0xC7 else 0)
+        elif t in (0xC5, 0xC8, 0xDA):     # bin16/ext16/str16
+            if i + 2 > n:
+                raise ValueError("truncated msgpack object")
+            i += 2 + int.from_bytes(buf[i:i + 2], "big") + \
+                (1 if t == 0xC8 else 0)
+        elif t in (0xC6, 0xC9, 0xDB):     # bin32/ext32/str32
+            if i + 4 > n:
+                raise ValueError("truncated msgpack object")
+            i += 4 + int.from_bytes(buf[i:i + 4], "big") + \
+                (1 if t == 0xC9 else 0)
+        elif t in (0xDC, 0xDD, 0xDE, 0xDF):
+            w = 2 if t in (0xDC, 0xDE) else 4
+            if i + w > n:
+                raise ValueError("truncated msgpack object")
+            count = int.from_bytes(buf[i:i + w], "big")
+            if count > n - i:
+                raise ValueError("impossible msgpack length")
+            i += w
+            remaining += count * (2 if t in (0xDE, 0xDF) else 1)
+        else:
+            raise ValueError(f"unknown msgpack type byte 0x{t:02x}")
+    if i > n:
+        raise ValueError("truncated msgpack object")
+    return i
+
+
+class RawResult:
+    """A handler result that is ALREADY msgpack-encoded (a relayed
+    backend response span): build_response splices it into the response
+    frame without a decode/encode round trip."""
+
+    __slots__ = ("span",)
+
+    def __init__(self, span: bytes) -> None:
+        self.span = span
+
+
+def _parse_response_envelope(raw: bytes) -> int:
+    """Offset of the ERROR object in a response frame
+    ``[1, msgid, error, result]``; ValueError on anything else."""
+    if len(raw) < 3 or raw[0] != 0x94 or raw[1] != 0x01:
+        raise ValueError("not a msgpack-rpc response frame")
+    t = raw[2]
+    if t <= 0x7F:
+        return 3
+    if t == 0xCC:
+        return 4
+    if t == 0xCD:
+        return 5
+    if t == 0xCE:
+        return 7
+    raise ValueError("unexpected msgid encoding")
+
+
 def _parse_envelope(raw: bytes):
     """Request envelope without decoding params: ``[0, msgid, method, ...]``
     -> (msgid, method, params_offset), or None for anything else (notify,
@@ -283,7 +370,7 @@ class RpcServer:
     def _dispatch_fast(self, conn, wlock, msgid, method,
                        raw_params: bytes,
                        conn_state: Optional[dict] = None) -> None:
-        error, result = self._execute_fast(method, raw_params)
+        error, result = self._execute_fast(method, raw_params, conn_state)
         payload = build_response(
             msgid, error, result,
             legacy=self.response_legacy(method, conn_state))
@@ -293,16 +380,27 @@ class RpcServer:
         except OSError:
             pass
 
-    def _execute_fast(self, method: str, raw_params: bytes):
+    def _execute_fast(self, method: str, raw_params: bytes,
+                      conn_state: Optional[dict] = None):
         """Raw-span invoke; falls back to the generic decode + handler when
-        the fast fn declines (RAW_FALLBACK). The trace span is recorded
+        the fast fn declines (RAW_FALLBACK). Handlers marked
+        ``modern_only`` (the proxy's verbatim relays) are skipped for
+        legacy-era connections — their spans must be decoded and
+        re-encoded modern, not forwarded as-is. The trace span is recorded
         here only when the fast path served the request — fallbacks are
         counted once, by _invoke's span."""
         import time as _time
 
+        fn = self._raw_methods[method]
+        if conn_state is not None and conn_state.get("legacy") and \
+                getattr(fn, "modern_only", False):
+            params = msgpack.unpackb(raw_params, raw=False,
+                                     strict_map_key=False, use_list=True,
+                                     unicode_errors="surrogateescape")
+            return self._execute(method, params)
         t0 = _time.perf_counter()
         try:
-            result = self._raw_methods[method](raw_params)
+            result = fn(raw_params)
             if result is not RAW_FALLBACK:
                 self.trace.record(f"rpc.{method}",
                                   _time.perf_counter() - t0)
@@ -391,6 +489,17 @@ def build_response(msgid: int, error: Any, result: Any,
     msgpack — and therefore every deployed jubatus client — can parse it
     (client/common/client.hpp:30-87 links that old library).
     """
+    if isinstance(result, RawResult):
+        if error is None and not legacy:
+            # splice the pre-encoded span: fixarray(4) + RESPONSE + msgid
+            # + nil error + the span, no decode/encode of the payload
+            return (b"\x94\x01" + msgpack.packb(msgid) + b"\xc0"
+                    + result.span)
+        # error path or legacy-era connection: materialize and fall
+        # through to the normal packer (legacy needs old-raw re-encoding)
+        result = msgpack.unpackb(result.span, raw=False,
+                                 strict_map_key=False, use_list=True,
+                                 unicode_errors="surrogateescape")
     # surrogateescape mirrors the request-decode side: surrogate-bearing
     # strings (legacy non-UTF8 raw admitted by the unpacker, e.g. stored
     # as labels) must re-encode to their original bytes, not raise after

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import msgpack
 import random
 import threading
 import time
@@ -32,7 +33,14 @@ from jubatus_tpu.coord.cht import CHT
 from jubatus_tpu.framework.idl import INTERNAL, get_service
 from jubatus_tpu.rpc import aggregators
 from jubatus_tpu.rpc.client import RpcClient
-from jubatus_tpu.rpc.errors import HostError, MultiRpcError, RpcNoClient, RpcNoResult
+from jubatus_tpu.rpc.errors import (
+    HostError,
+    MultiRpcError,
+    RpcIoError,
+    RpcNoClient,
+    RpcNoResult,
+    RpcTimeoutError,
+)
 from jubatus_tpu.version import __version__
 
 log = logging.getLogger(__name__)
@@ -105,6 +113,40 @@ class MemberCache:
             self._cache.pop(name, None)
 
 
+def _peek_cluster_name(raw_params: bytes) -> Optional[str]:
+    """First element of the params array when it is a string — WITHOUT
+    feeding the (possibly multi-megabyte) span through an unpacker copy.
+    None for any other wire shape."""
+    try:
+        t = raw_params[0]
+        if 0x90 <= t <= 0x9F:
+            if t == 0x90:
+                return None
+            i = 1
+        elif t == 0xDC:
+            if int.from_bytes(raw_params[1:3], "big") < 1:
+                return None
+            i = 3
+        elif t == 0xDD:
+            if int.from_bytes(raw_params[1:5], "big") < 1:
+                return None
+            i = 5
+        else:
+            return None
+        t = raw_params[i]
+        if 0xA0 <= t <= 0xBF:
+            n, i = t & 0x1F, i + 1
+        elif t == 0xD9:
+            n, i = raw_params[i + 1], i + 2
+        elif t == 0xDA:
+            n, i = int.from_bytes(raw_params[i + 1:i + 3], "big"), i + 3
+        else:
+            return None
+        return raw_params[i:i + n].decode("utf-8", "surrogateescape")
+    except (IndexError, ValueError):
+        return None
+
+
 class _Session:
     __slots__ = ("client", "last_used")
 
@@ -132,8 +174,9 @@ class Proxy:
             legacy_wire=getattr(args, "legacy_wire", False),
             wire_detect=not getattr(args, "modern_wire", False))
         self.start_time = time.time()
-        self._pool: Dict[Tuple[str, int], _Session] = {}
+        self._pool: Dict[Tuple[str, int], List[_Session]] = {}
         self._pool_lock = threading.Lock()
+        self._last_expiry = 0.0
         self._executor = ThreadPoolExecutor(
             max_workers=max(8, args.thread * 4), thread_name_prefix="proxy-fanout"
         )
@@ -146,29 +189,63 @@ class Proxy:
         self._register_methods()
 
     # -- session pool (proxy.hpp:502-593) ------------------------------------
-    def _client(self, node: NodeInfo) -> RpcClient:
+    # Borrow/return, like the reference's get/return session pool: each
+    # in-flight forward owns a connection, so N concurrent client calls
+    # ride N parallel backend connections instead of serializing their
+    # round trips through one socket. ``self._pool`` holds IDLE sessions.
+    def _checkout(self, node: NodeInfo) -> _Session:
         key = (node.host, node.port)
         with self._pool_lock:
-            sess = self._pool.get(key)
-            if sess is None:
-                sess = self._pool[key] = _Session(
-                    RpcClient(node.host, node.port,
-                              timeout=self.args.interconnect_timeout)
-                )
-            sess.last_used = time.monotonic()
-            return sess.client
+            lst = self._pool.get(key)
+            if lst:
+                return lst.pop()
+        return _Session(RpcClient(node.host, node.port,
+                                  timeout=self.args.interconnect_timeout))
+
+    def _checkin(self, node: NodeInfo, sess: _Session) -> None:
+        sess.last_used = time.monotonic()
+        with self._pool_lock:
+            self._pool.setdefault((node.host, node.port), []).append(sess)
 
     def _expire_sessions(self) -> None:
+        # throttled: expiry precision is seconds (pool_expire defaults to
+        # 60 s); walking the pool under its lock on EVERY forward is pure
+        # hot-path tax
+        now = time.monotonic()
+        if now - self._last_expiry < 1.0:
+            return
+        self._last_expiry = now
         horizon = time.monotonic() - self.args.session_pool_expire
+        dead: List[_Session] = []
         with self._pool_lock:
-            for key in [k for k, s in self._pool.items() if s.last_used < horizon]:
-                self._pool.pop(key).client.close()
+            for key, lst in list(self._pool.items()):
+                keep = [s for s in lst if s.last_used >= horizon]
+                dead.extend(s for s in lst if s.last_used < horizon)
+                if keep:
+                    self._pool[key] = keep
+                else:
+                    del self._pool[key]
             if self.args.session_pool_size > 0:
-                by_age = sorted(self._pool.items(), key=lambda kv: kv[1].last_used)
-                while len(by_age) > self.args.session_pool_size:
-                    key, sess = by_age.pop(0)
-                    sess.client.close()
-                    self._pool.pop(key, None)
+                flat = sorted(
+                    ((s.last_used, key, s)
+                     for key, lst in self._pool.items() for s in lst),
+                    key=lambda e: e[0])
+                excess = len(flat) - self.args.session_pool_size
+                for _, key, s in flat[:max(0, excess)]:
+                    self._pool[key].remove(s)
+                    dead.append(s)
+                for key in [k for k, v in self._pool.items() if not v]:
+                    del self._pool[key]
+        for s in dead:
+            s.client.close()
+
+    def _drop_sessions(self, node: NodeInfo) -> None:
+        """A backend failed: close its idle sessions (in-flight ones die
+        with their own errors)."""
+        with self._pool_lock:
+            lst = self._pool.pop((node.host, node.port), [])
+        for s in lst:
+            s.client.close()
 
     # -- fan-out core (async_task, proxy.hpp:296-495) ------------------------
     def _fan(
@@ -210,16 +287,18 @@ class Proxy:
         return acc
 
     def _one(self, node: NodeInfo, method: str, args: Sequence[Any]) -> Any:
+        sess = self._checkout(node)
         try:
-            return self._client(node).call(method, *args)
+            result = sess.client.call(method, *args)
         except Exception:
-            # dead backend: drop its session and let the caller decide
-            with self._pool_lock:
-                sess = self._pool.pop((node.host, node.port), None)
-            if sess is not None:
-                sess.client.close()
+            # dead backend: close this session, drop its idle siblings,
+            # and let the caller decide
+            sess.client.close()
+            self._drop_sessions(node)
             self.members.invalidate(str(args[0]) if args else "")
             raise
+        self._checkin(node, sess)
+        return result
 
     # -- routing handlers (register_async_{random,broadcast,cht}) -------------
     def _count(self, method: str) -> None:
@@ -244,10 +323,66 @@ class Proxy:
 
         return handle
 
+    def _raw_handler(self, name: str) -> Callable[[bytes], Any]:
+        """Zero-decode relay for RANDOM-routed methods: forward the raw
+        params span to one backend and splice its raw result span into the
+        response (rpc/server.py RawResult) — the multi-megabyte train/
+        classify payloads never materialize as Python objects at the
+        proxy, matching the reference proxy's C++ forwarding cost shape
+        (proxy.hpp:64-186). Anything irregular (no actives, backend
+        error/IO, undecodable name) declines to the generic path, which
+        owns retry and error taxonomy."""
+        from jubatus_tpu.rpc.server import RAW_FALLBACK, RawResult
+
+        def handle(raw_params: bytes) -> Any:
+            cluster = _peek_cluster_name(raw_params)
+            if cluster is None:
+                return RAW_FALLBACK  # odd wire: generic path decides
+            self._count(name)
+            self._expire_sessions()
+            actives = self.members.actives(cluster)
+            if not actives:
+                return RAW_FALLBACK  # generic path raises RpcNoClient
+            node = random.choice(actives)
+            with self._counters_lock:
+                self.forward_count += 1
+            sess = self._checkout(node)
+            try:
+                span = sess.client.call_raw(name, raw_params)
+            except (RpcIoError, RpcTimeoutError):
+                # transport failure AFTER the request may have reached the
+                # backend: a silent re-forward would double-apply a train
+                # batch, so propagate — exactly what the generic path does
+                # when its single target dies (_one re-raises). Tear the
+                # node's sessions down and let the client decide.
+                sess.client.close()
+                self._drop_sessions(node)
+                self.members.invalidate(cluster)
+                with self._counters_lock:
+                    self.forward_errors += 1
+                raise
+            except Exception:
+                # application error from a HEALTHY backend (non-nil error
+                # span): the connection read the full response — return it
+                # to the pool and relay the error as-is
+                self._checkin(node, sess)
+                raise
+            self._checkin(node, sess)
+            return RawResult(span)
+
+        # era-safe for every client: call_raw pins pooled backend
+        # connections MODERN via its str8 method encoding, so a legacy
+        # client's relayed span can never latch a shared connection
+        # legacy; legacy clients get their response re-encoded old-raw by
+        # build_response's RawResult materialization
+        return handle
+
     def _register(self, name: str, arity: int, routing: str,
                   reducer: Callable[[Any, Any], Any], cht_n: int = 2) -> None:
         self.rpc.register(name, self._handler(name, routing, cht_n, reducer),
                           arity=arity)
+        if routing == "random" and hasattr(self.rpc, "register_raw"):
+            self.rpc.register_raw(name, self._raw_handler(name))
 
     def _register_methods(self) -> None:
         for m in get_service(self.engine):
@@ -275,7 +410,8 @@ class Proxy:
                 "version": __version__,
                 "forward_count": self.forward_count,
                 "forward_errors": self.forward_errors,
-                "session_pool_size": len(self._pool),
+                "session_pool_size": sum(
+                    len(v) for v in self._pool.values()),
             }
             st.update({f"request.{k}": v for k, v in self.request_counts.items()})
         st.update(self.args.flags_status())
@@ -302,8 +438,9 @@ class Proxy:
     def stop(self) -> None:
         self.rpc.stop()
         with self._pool_lock:
-            for sess in self._pool.values():
-                sess.client.close()
+            for lst in self._pool.values():
+                for sess in lst:
+                    sess.client.close()
             self._pool.clear()
         self._executor.shutdown(wait=False)
         self.coord.close()
