@@ -15,11 +15,58 @@
 // returns examples/second over the n examples (timed internally so the
 // ctypes call overhead is excluded).
 
+#include <atomic>
 #include <chrono>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
+#include <thread>
 #include <vector>
+
+namespace {
+
+// One multiclass AROW example: score all L labels, update the correct
+// row and the best wrong row (jubatus_core's multiclass arow shape —
+// the per-example cost the reference pays is LINEAR in L because every
+// label's row is gathered for the scores).
+inline void arow_example_multi(float* w, float* sigma, const int32_t* ii,
+                               const float* vv, int y, int k, int64_t dim,
+                               int L, float r, float* scores) {
+  for (int l = 0; l < L; ++l) {
+    const float* wl = w + size_t(l) * dim;
+    float s = 0.0f;
+    for (int j = 0; j < k; ++j) s += wl[ii[j]] * vv[j];
+    scores[l] = s;
+  }
+  int o = y == 0 ? 1 : 0;
+  for (int l = 0; l < L; ++l)
+    if (l != y && scores[l] > scores[o]) o = l;
+  float margin = scores[y] - scores[o];
+  float loss = 1.0f - margin;
+  if (loss <= 0.0f) return;
+  float* wy = w + size_t(y) * dim;
+  float* wo = w + size_t(o) * dim;
+  float* sy = sigma + size_t(y) * dim;
+  float* so = sigma + size_t(o) * dim;
+  float variance = 0.0f;
+  for (int j = 0; j < k; ++j) {
+    float x2 = vv[j] * vv[j];
+    variance += (sy[ii[j]] + so[ii[j]]) * x2;
+  }
+  float beta = 1.0f / (variance + r);
+  float alpha = loss * beta;
+  for (int j = 0; j < k; ++j) {
+    float x = vv[j];
+    wy[ii[j]] += alpha * sy[ii[j]] * x;
+    wo[ii[j]] -= alpha * so[ii[j]] * x;
+    float prec_inc = x * x / r;
+    sy[ii[j]] = 1.0f / (1.0f / sy[ii[j]] + prec_inc);
+    so[ii[j]] = 1.0f / (1.0f / so[ii[j]] + prec_inc);
+  }
+}
+
+}  // namespace
 
 extern "C" {
 
@@ -67,6 +114,63 @@ double jt_arow_baseline(const int32_t* idx, const float* val,
                 std::chrono::steady_clock::now() - t0)
                 .count();
   // keep the tables alive past the timer (defeats dead-code elimination)
+  volatile float sink = w[0] + sigma[size_t(dim)];
+  (void)sink;
+  return dt > 0.0 ? double(n) / dt : 0.0;
+}
+
+// Multiclass sequential AROW: the reference's cost model is linear in L
+// (score gather touches every label row). Returns examples/second.
+double jt_arow_baseline_multi(const int32_t* idx, const float* val,
+                              const int32_t* labels, int n, int k,
+                              int64_t dim, int L, float r) {
+  std::vector<float> w(size_t(L) * dim, 0.0f);
+  std::vector<float> sigma(size_t(L) * dim, 1.0f);
+  std::vector<float> scores(L);
+  auto t0 = std::chrono::steady_clock::now();
+  for (int i = 0; i < n; ++i)
+    arow_example_multi(w.data(), sigma.data(), idx + size_t(i) * k,
+                       val + size_t(i) * k, labels[i], k, dim, L, r,
+                       scores.data());
+  auto dt = std::chrono::duration<double>(
+                std::chrono::steady_clock::now() - t0)
+                .count();
+  volatile float sink = w[0] + sigma[size_t(dim)];
+  (void)sink;
+  return dt > 0.0 ? double(n) / dt : 0.0;
+}
+
+// Concurrent-serving shape: nthreads ingest threads share ONE model
+// under one write lock (the reference's JWLOCK_ around every update,
+// classifier_serv.cpp:127-146). Returns aggregate examples/second —
+// updates serialize on the lock, so added threads buy contention, not
+// throughput (the chip's answer is batching, not locking).
+double jt_arow_baseline_locked(const int32_t* idx, const float* val,
+                               const int32_t* labels, int n, int k,
+                               int64_t dim, int L, float r, int nthreads) {
+  std::vector<float> w(size_t(L) * dim, 0.0f);
+  std::vector<float> sigma(size_t(L) * dim, 1.0f);
+  std::mutex mu;
+  std::atomic<int> next{0};
+  auto t0 = std::chrono::steady_clock::now();
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nthreads; ++t) {
+    ts.emplace_back([&] {
+      std::vector<float> scores(L);
+      while (true) {
+        int i = next.fetch_add(1);
+        if (i >= n) return;
+        std::lock_guard<std::mutex> g(mu);
+        arow_example_multi(w.data(), sigma.data(), idx + size_t(i) * k,
+                           val + size_t(i) * k, labels[i], k, dim, L, r,
+                           scores.data());
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  auto dt = std::chrono::duration<double>(
+                std::chrono::steady_clock::now() - t0)
+                .count();
   volatile float sink = w[0] + sigma[size_t(dim)];
   (void)sink;
   return dt > 0.0 ? double(n) / dt : 0.0;

@@ -134,41 +134,15 @@ print(f"CHILD-{pid}-OK", flush=True)
 
 
 @pytest.mark.slow
-def test_multiprocess_collective_mix(tmp_path):
+def test_multiprocess_collective_mix():
+    # one harness owns port pick / env scrub / concurrent pipe drain /
+    # cleanup for every jax.distributed multi-process launch
+    import bench_mix
+
     n = 3
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    jax_port = s.getsockname()[1]
-    s.close()
-    coord_dir = str(tmp_path / "coord")
-    os.makedirs(coord_dir)
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS",)}  # default 1 cpu device per process
-    env["JAX_PLATFORMS"] = "cpu"
-    env["JUBATUS_TPU_PLATFORM"] = "cpu"
-    path = env.get("PYTHONPATH", "")
-    if REPO not in path.split(os.pathsep):
-        env["PYTHONPATH"] = REPO + (os.pathsep + path if path else "")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _CHILD, str(i), str(n), str(jax_port),
-             coord_dir],
-            env=env, cwd=REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True)
-        for i in range(n)
-    ]
-    outs = []
-    for i, p in enumerate(procs):
-        try:
-            out, _ = p.communicate(timeout=180)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(out)
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"child {i}:\n{out[-3000:]}"
-        assert f"CHILD-{i}-OK" in out
+    outs = bench_mix.run_jax_world(_CHILD, n, timeout=180)
+    for i, out in enumerate(outs):
+        assert f"CHILD-{i}-OK" in out, f"child {i}:\n{out[-3000:]}"
     assert any("MASTER-ROUND" in o for o in outs)
 
 
