@@ -50,6 +50,25 @@ def _median(xs):
     return float(np.median(np.asarray(xs)))
 
 
+def scrub_child_env(env: dict) -> dict:
+    """Make a child-process env safe for CPU-only work: pin the platform
+    and drop the axon plugin from PYTHONPATH — its registration hook
+    initializes the device tunnel regardless of JAX_PLATFORMS, and a
+    wedged tunnel hangs the child forever. ONE owner for this scrub
+    (bench_serving and the tests import it) so the next plugin quirk is
+    fixed in one place."""
+    env = dict(env)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JUBATUS_TPU_PLATFORM"] = "cpu"
+    repo = os.path.dirname(os.path.abspath(__file__))
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p]
+    if repo not in parts:
+        parts.insert(0, repo)
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    return env
+
+
 def device_round(dim_bits: int, dev=None, trials: int = TRIALS,
                  tag: str = "") -> dict:
     """One full mix round, single-device reduce (replicas co-hosted).
@@ -164,6 +183,12 @@ def _allreduce8_subprocess() -> dict:
     path = env.get("PYTHONPATH", "")
     if repo not in path.split(os.pathsep):
         env["PYTHONPATH"] = repo + (os.pathsep + path if path else "")
+    # CPU-only children must not import the axon plugin: a wedged
+    # device tunnel hangs its registration hook at jax backend init
+    # regardless of JAX_PLATFORMS
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p)
     prog = (
         "import jax\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
@@ -239,9 +264,9 @@ print(f"CHILD-{pid}-DONE", flush=True)
 
 
 def run_jax_world(child_src: str, n: int, timeout: float = 300.0,
-                  extra_args: tuple = ()) -> list:
+                  extra_args: tuple = ()):
     """Spawn ``n`` jax.distributed CPU child processes (argv: pid, n,
-    jax_port, coord_dir, *extra) and return their combined outputs.
+    jax_port, coord_dir, *extra); returns (outputs, returncodes).
     Shared by this bench and tests/test_collective_mixer.py — one
     harness owns the port pick, env scrub, CONCURRENT pipe draining
     (a child blocked writing into a full pipe while the parent reads
@@ -257,12 +282,8 @@ def run_jax_world(child_src: str, n: int, timeout: float = 300.0,
     jax_port = s.getsockname()[1]
     s.close()
     coord_dir = tempfile.mkdtemp(prefix="mixbench_coord_")
-    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    env["JAX_PLATFORMS"] = "cpu"
-    env["JUBATUS_TPU_PLATFORM"] = "cpu"
-    path = env.get("PYTHONPATH", "")
-    if repo not in path.split(os.pathsep):
-        env["PYTHONPATH"] = repo + (os.pathsep + path if path else "")
+    env = scrub_child_env(
+        {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"})
     procs = []
     outs = [""] * n
     threads = []
@@ -283,14 +304,10 @@ def run_jax_world(child_src: str, n: int, timeout: float = 300.0,
             threads.append(t)
         deadline = time.monotonic() + timeout
         for p in procs:
-            left = max(0.1, deadline - time.monotonic())
-            try:
-                p.wait(timeout=left)
-            except subprocess.TimeoutExpired:
-                raise
+            p.wait(timeout=max(0.1, deadline - time.monotonic()))
         for t in threads:
             t.join(timeout=10)
-        return outs
+        return outs, [p.returncode for p in procs]
     finally:
         for p in procs:
             if p.poll() is None:
@@ -303,9 +320,12 @@ def collective_nproc(n: int = 4) -> dict:
     """Timed production collective round across ``n`` OS processes."""
     out: dict = {}
     try:
-        outs = run_jax_world(_COLLECTIVE_CHILD, n)
+        outs, rcs = run_jax_world(_COLLECTIVE_CHILD, n)
     except subprocess.TimeoutExpired:
         return {"collective_round_error": "timeout"}
+    if any(rc != 0 for rc in rcs):
+        return {"collective_round_error":
+                f"child exits {rcs}: {(''.join(outs))[-200:]}"}
     for text in outs:
         for line in text.splitlines():
             if line.startswith("COLLECTIVE="):

@@ -14,13 +14,20 @@ string-keyed map merge.
 from __future__ import annotations
 
 import math
+import threading
 from typing import Dict
 
 import numpy as np
 
 
 class WeightManager:
-    """Tracks df counts and user weights over the hashed feature space."""
+    """Tracks df counts and user weights over the hashed feature space.
+
+    ``lock`` serializes the native ingest path's in-place df mutation
+    (native/fast_ingest.cpp jt_ingest_parse_w writes ``_df_diff`` and
+    ``_ndocs_diff`` directly) against mixes/unpacks that swap or zero
+    these buffers. ``_ndocs_diff`` is a 1-element float64 array for the
+    same reason — C++ increments it through a pointer."""
 
     def __init__(self, dim: int):
         self.dim = dim
@@ -28,14 +35,16 @@ class WeightManager:
         self._df_master = np.zeros(dim, dtype=np.float32)
         self._df_diff = np.zeros(dim, dtype=np.float32)
         self._ndocs_master = 0.0
-        self._ndocs_diff = 0.0
+        self._ndocs_diff = np.zeros(1, dtype=np.float64)
         self._user_weights: Dict[int, float] = {}
+        self.lock = threading.Lock()
 
     # -- ingest -------------------------------------------------------------
     def observe(self, indices) -> None:
         """Record one document's feature occurrence (unique indices)."""
-        self._df_diff[np.asarray(list(indices), dtype=np.int64)] += 1.0
-        self._ndocs_diff += 1.0
+        with self.lock:
+            self._df_diff[np.asarray(list(indices), dtype=np.int64)] += 1.0
+            self._ndocs_diff[0] += 1.0
 
     def set_user_weight(self, index: int, weight: float) -> None:
         self._user_weights[index] = float(weight)
@@ -43,7 +52,7 @@ class WeightManager:
     # -- lookup -------------------------------------------------------------
     @property
     def ndocs(self) -> float:
-        return self._ndocs_master + self._ndocs_diff
+        return self._ndocs_master + float(self._ndocs_diff[0])
 
     def idf(self, index: int) -> float:
         n = self.ndocs
@@ -60,21 +69,23 @@ class WeightManager:
     MIX_IS_SUM = True
 
     def get_diff(self):
-        return {
-            "df": self._df_diff.copy(),
-            "ndocs": np.float32(self._ndocs_diff),
-        }
+        with self.lock:
+            return {
+                "df": self._df_diff.copy(),
+                "ndocs": np.float32(self._ndocs_diff[0]),
+            }
 
     @staticmethod
     def mix(lhs, rhs):
         return {"df": lhs["df"] + rhs["df"], "ndocs": lhs["ndocs"] + rhs["ndocs"]}
 
     def put_diff(self, diff) -> bool:
-        self._df_master += np.asarray(diff["df"])
-        # wire round-trips can deliver the scalar as a shape-(1,) array
-        self._ndocs_master += float(np.asarray(diff["ndocs"]).reshape(()))
-        self._df_diff[:] = 0.0
-        self._ndocs_diff = 0.0
+        with self.lock:
+            self._df_master += np.asarray(diff["df"])
+            # wire round-trips can deliver the scalar as a shape-(1,) array
+            self._ndocs_master += float(np.asarray(diff["ndocs"]).reshape(()))
+            self._df_diff[:] = 0.0
+            self._ndocs_diff[0] = 0.0
         return True
 
     # -- persistence --------------------------------------------------------
@@ -86,14 +97,18 @@ class WeightManager:
         }
 
     def unpack(self, obj) -> None:
-        self._df_master = np.asarray(obj["df"], dtype=np.float32).copy()
-        self._ndocs_master = float(obj["ndocs"])
-        self._df_diff[:] = 0.0
-        self._ndocs_diff = 0.0
-        self._user_weights = {int(k): float(v) for k, v in obj["user_weights"].items()}
+        with self.lock:
+            self._df_master = np.asarray(obj["df"], dtype=np.float32).copy()
+            self._ndocs_master = float(obj["ndocs"])
+            self._df_diff[:] = 0.0
+            self._ndocs_diff[0] = 0.0
+            self._user_weights = {int(k): float(v)
+                                  for k, v in obj["user_weights"].items()}
 
     def clear(self) -> None:
-        self._df_master[:] = 0.0
-        self._df_diff[:] = 0.0
-        self._ndocs_master = self._ndocs_diff = 0.0
-        self._user_weights.clear()
+        with self.lock:
+            self._df_master[:] = 0.0
+            self._df_diff[:] = 0.0
+            self._ndocs_master = 0.0
+            self._ndocs_diff[0] = 0.0
+            self._user_weights.clear()

@@ -69,6 +69,18 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.jt_ingest_parse_datums.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
             ctypes.c_uint32, ctypes.POINTER(_Out)]
+        _fp = ctypes.POINTER(ctypes.c_float)
+        _dp = ctypes.POINTER(ctypes.c_double)
+        lib.jt_ingest_parse_w.restype = ctypes.c_int
+        lib.jt_ingest_parse_w.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_uint32, _fp, _fp, ctypes.c_double, _dp, ctypes.c_int,
+            ctypes.POINTER(_Out)]
+        lib.jt_ingest_parse_datums_w.restype = ctypes.c_int
+        lib.jt_ingest_parse_datums_w.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_uint32, _fp, _fp, ctypes.c_double, _dp,
+            ctypes.POINTER(_Out)]
         lib.jt_ingest_free_out.restype = None
         lib.jt_ingest_free_out.argtypes = [ctypes.POINTER(_Out)]
         _lib = lib
@@ -125,7 +137,9 @@ def spec_from_converter_config(conv: dict) -> Optional[str]:
             return None
         sw = r.get("sample_weight", "bin")
         gw = r.get("global_weight", "bin")
-        if sw not in ("bin", "tf", "log_tf") or gw != "bin":
+        # idf rides the fast path too (the parser takes the WeightManager's
+        # dense df tables); user "weight" needs the user-weight map -> no
+        if sw not in ("bin", "tf", "log_tf") or gw not in ("bin", "idf"):
             return None
         lines.append(f"str\t{split}\t{sw}\t{gw}\t{r.get('type')}\t"
                      f"{r.get('key', '*')}")
@@ -138,7 +152,11 @@ def spec_from_converter_config(conv: dict) -> Optional[str]:
 
 
 class IngestParser:
-    """One immutable parser handle per (converter config, dim)."""
+    """One immutable parser handle per (converter config, dim).
+
+    ``needs_weights``: the spec carries idf rules — every parse must be
+    given the converter's WeightManager (and run under its lock: the C++
+    mutates the df tables in place on the train path)."""
 
     def __init__(self, spec: str, dim_bits: int) -> None:
         lib = _load()
@@ -146,6 +164,11 @@ class IngestParser:
             raise RuntimeError("native ingest unavailable")
         self._lib = lib
         self._mask = (1 << dim_bits) - 1
+        # field-positional, not a substring grep: a string TYPE named
+        # "idf" must not make a bin-weighted spec demand weight state
+        self.needs_weights = any(
+            ln.split("\t")[3] == "idf"
+            for ln in spec.split("\n") if ln.startswith("str\t"))
         self._handle = lib.jt_ingest_create(spec.encode())
         if not self._handle:
             raise ValueError(f"native ingest rejected spec: {spec!r}")
@@ -173,7 +196,17 @@ class IngestParser:
             if b else np.zeros((0, 8), np.float32)
         return idx, val
 
-    def parse_indexed(self, raw: bytes):
+    def _weight_args(self, weights):
+        import ctypes as ct
+
+        fp = ct.POINTER(ct.c_float)
+        dp = ct.POINTER(ct.c_double)
+        return (weights._df_master.ctypes.data_as(fp),
+                weights._df_diff.ctypes.data_as(fp),
+                float(weights._ndocs_master),
+                weights._ndocs_diff.ctypes.data_as(dp))
+
+    def parse_indexed(self, raw: bytes, weights=None):
         """Raw train params msgpack -> (labels, idx [B,K] i32, val [B,K] f32).
 
         ``labels`` is a float32 array for regression targets, or — for
@@ -181,10 +214,23 @@ class IngestParser:
         label strings plus an int32 [B] row->uniq index (the C++ parser
         dedups, so the host never loops over B Python strings). None when
         the wire shape is not the expected train format (caller falls back
-        to the generic decode path)."""
+        to the generic decode path).
+
+        ``weights``: the converter's WeightManager, REQUIRED for idf specs
+        (train path: documents are observed and values idf-scaled exactly
+        like converter.convert(update_weights=True)); caller must hold
+        ``weights.lock``."""
         out = _Out()
-        rc = self._lib.jt_ingest_parse(self._handle, raw, len(raw),
-                                       self._mask, ctypes.byref(out))
+        if self.needs_weights:
+            if weights is None:
+                return None
+            dfm, dfd, nm, nd = self._weight_args(weights)
+            rc = self._lib.jt_ingest_parse_w(
+                self._handle, raw, len(raw), self._mask, dfm, dfd, nm, nd,
+                1, ctypes.byref(out))
+        else:
+            rc = self._lib.jt_ingest_parse(self._handle, raw, len(raw),
+                                           self._mask, ctypes.byref(out))
         if rc != 0:
             return None
         try:
@@ -212,10 +258,10 @@ class IngestParser:
             self._lib.jt_ingest_free_out(ctypes.byref(out))
         return labels, idx, val
 
-    def parse(self, raw: bytes):
+    def parse(self, raw: bytes, weights=None):
         """Like parse_indexed but with per-row label strings (compat shape:
         a list of B strings for classifiers, float32 array for targets)."""
-        parsed = self.parse_indexed(raw)
+        parsed = self.parse_indexed(raw, weights=weights)
         if parsed is None:
             return None
         labels, idx, val = parsed
@@ -224,13 +270,23 @@ class IngestParser:
             labels = [uniq[i] for i in lidx]
         return labels, idx, val
 
-    def parse_datums(self, raw: bytes):
+    def parse_datums(self, raw: bytes, weights=None):
         """Raw classify/estimate params msgpack ([name, [datum, ...]]) ->
         (idx [B,K] i32, val [B,K] f32), or None when the wire shape is
-        not a datum list."""
+        not a datum list. For idf specs, ``weights`` is read (NOT
+        observed — queries never record documents; caller holds the
+        lock)."""
         out = _Out()
-        rc = self._lib.jt_ingest_parse_datums(self._handle, raw, len(raw),
-                                              self._mask, ctypes.byref(out))
+        if self.needs_weights:
+            if weights is None:
+                return None
+            dfm, dfd, nm, nd = self._weight_args(weights)
+            rc = self._lib.jt_ingest_parse_datums_w(
+                self._handle, raw, len(raw), self._mask, dfm, dfd, nm, nd,
+                ctypes.byref(out))
+        else:
+            rc = self._lib.jt_ingest_parse_datums(
+                self._handle, raw, len(raw), self._mask, ctypes.byref(out))
         if rc != 0:
             return None
         try:

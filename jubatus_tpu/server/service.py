@@ -194,8 +194,17 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
                        weigher=lambda item: item[1].shape[0])
         server.coalescers["train_raw"] = co
 
+    # idf specs observe documents + scale against the converter's df
+    # tables at parse time (in C++); the WeightManager lock serializes
+    # that in-place mutation against mixes/unpacks swapping the buffers
+    weights = driver.converter.weights if parser.needs_weights else None
+
     def train_raw(raw_params: bytes):
-        parsed = parser.parse_indexed(raw_params)
+        if weights is not None:
+            with weights.lock:
+                parsed = parser.parse_indexed(raw_params, weights=weights)
+        else:
+            parsed = parser.parse_indexed(raw_params)
         if parsed is None:
             return RAW_FALLBACK
         labels, idx, val = parsed
@@ -215,9 +224,15 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
 
     # the query path rides the same parser: [name, [datum, ...]] -> hashed
     # batch -> snapshot-read scores, no Datum objects
+    def _parse_datums(raw_params: bytes):
+        if weights is not None:
+            with weights.lock:  # queries read idf, never observe
+                return parser.parse_datums(raw_params, weights=weights)
+        return parser.parse_datums(raw_params)
+
     if numeric and hasattr(driver, "estimate_hashed"):
         def estimate_raw(raw_params: bytes):
-            parsed = parser.parse_datums(raw_params)
+            parsed = _parse_datums(raw_params)
             if parsed is None:
                 return RAW_FALLBACK
             return driver.estimate_hashed(*parsed)
@@ -225,7 +240,7 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
         rpc.register_raw("estimate", estimate_raw)
     elif not numeric and hasattr(driver, "classify_hashed"):
         def classify_raw(raw_params: bytes):
-            parsed = parser.parse_datums(raw_params)
+            parsed = _parse_datums(raw_params)
             if parsed is None:
                 return RAW_FALLBACK
             return [_scored(r) for r in driver.classify_hashed(*parsed)]

@@ -111,7 +111,8 @@ struct NumRule {
 struct StrRule {
   enum Split { WHOLE, SPACE, NGRAM } split = WHOLE;
   enum Sw { BIN, TF, LOG_TF } sw = BIN;
-  int ngram_n = 0;  // code points per ngram token (split == NGRAM)
+  bool idf = false;  // global_weight idf: value *= log(ndocs/df) at parse
+  int ngram_n = 0;   // code points per ngram token (split == NGRAM)
   Matcher m;
   std::string suffix;  // "@<type>#<sw>/<gw>"
 };
@@ -476,6 +477,7 @@ struct Feature {
   int32_t idx;
   double val;  // accumulate in double, cast to f32 once at pack time
                // (matches the Python converter's f64 sums -> f32 arrays)
+  uint8_t idf;  // produced by an idf-weighted rule (scaled pre-merge)
 };
 
 }  // namespace
@@ -558,7 +560,12 @@ void* jt_ingest_create(const char* spec) {
         delete ps;
         return nullptr;
       }
-      if (f[3] != "bin") {  // idf/weight need WeightManager state
+      if (f[3] == "idf") {
+        // idf needs the WeightManager's df table: the caller passes it
+        // into jt_ingest_parse_w; the unweighted entry points refuse
+        // specs carrying idf rules
+        r.idf = true;
+      } else if (f[3] != "bin") {  // "weight" needs the user-weight map
         delete ps;
         return nullptr;
       }
@@ -590,10 +597,29 @@ void jt_ingest_free_out(JtIngestOut* out) {
   out->label_idx = nullptr;
 }
 
+//: idf weighting context, or null dfm for the unweighted path. Mirrors
+//: converter.convert's order EXACTLY: per document, observe the distinct
+//: idf feature indices FIRST (df += 1 once per index, ndocs += 1), then
+//: value *= log(ndocs/df) (<=0 guards -> 1.0), THEN merge by index.
+//: ``observe`` is 0 on the query path (classify/estimate read idf
+//: without recording the document).
+struct IdfCtx {
+  const float* dfm = nullptr;  // df master (read)
+  float* dfd = nullptr;        // df diff (incremented on train)
+  double ndocs_m = 0.0;
+  double* ndocs_d = nullptr;   // incremented on train
+  int observe = 0;
+};
+
 static int parse_impl(void* h, const uint8_t* buf, int64_t len,
-                      uint32_t mask, int with_labels, JtIngestOut* out) {
+                      uint32_t mask, int with_labels, const IdfCtx* idf,
+                      JtIngestOut* out) {
   const Parser& ps = *static_cast<Parser*>(h);
   Reader rd{buf, buf + len};
+  bool has_idf_rule = false;
+  for (const StrRule& r : ps.str_rules) has_idf_rule |= r.idf;
+  if (has_idf_rule && (idf == nullptr || idf->dfm == nullptr))
+    return 5;  // spec needs weight state the caller did not supply
 
   int64_t top = rd.array_len();  // [name, data]
   if (rd.fail || top != 2) return 1;
@@ -611,6 +637,7 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
   int labels_numeric = -1;          // unknown until the first example
   std::string name;                 // scratch feature-name buffer
   std::vector<std::pair<const uint8_t*, size_t>> terms;  // scratch
+  std::vector<int32_t> idf_scratch;  // distinct idf indices per example
   char numbuf[40];
 
   // Schema cache for num rules: real ingest streams repeat one key schema
@@ -628,14 +655,14 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
   std::vector<PosEntry> poscache;
   size_t pos_stride = 0;  // kv slots per rule; grows to max nnv seen
 
-  auto emit = [&](const std::string& nm, double v) {
+  auto emit = [&](const std::string& nm, double v, bool idf = false) {
     uint32_t c = crc32_update(0xFFFFFFFFu,
                               reinterpret_cast<const uint8_t*>(nm.data()),
                               nm.size()) ^
                  0xFFFFFFFFu;
     uint32_t i = c & mask;
     if (i == 0) i = 1;  // padding slot is reserved
-    feats.push_back({int32_t(i), v});
+    feats.push_back({int32_t(i), v, uint8_t(idf)});
   };
 
   for (int64_t e = 0; e < n; ++e) {
@@ -771,7 +798,7 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
           name.append(reinterpret_cast<const char*>(terms[a].first),
                       terms[a].second);
           name += r.suffix;
-          emit(name, sw);
+          emit(name, sw, r.idf);
         }
       }
     }
@@ -830,6 +857,38 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
         name.append(numbuf, fn);
         name += r.at_type;
         emit(name, 1.0);
+      }
+    }
+
+    // idf (converter.py convert(): observe distinct indices, then scale,
+    // BEFORE the merge — a post-merge scale would mis-weight hash
+    // collisions between idf and non-idf features)
+    if (has_idf_rule) {
+      size_t start = size_t(offsets.back());
+      idf_scratch.clear();
+      for (size_t fi = start; fi < feats.size(); ++fi)
+        if (feats[fi].idf) idf_scratch.push_back(feats[fi].idx);
+      if (!idf_scratch.empty()) {
+        std::sort(idf_scratch.begin(), idf_scratch.end());
+        idf_scratch.erase(
+            std::unique(idf_scratch.begin(), idf_scratch.end()),
+            idf_scratch.end());
+        if (idf->observe) {
+          for (int32_t ix : idf_scratch) idf->dfd[ix] += 1.0f;
+          *idf->ndocs_d += 1.0;
+        }
+        double n = idf->ndocs_m + (idf->ndocs_d ? *idf->ndocs_d : 0.0);
+        for (size_t fi = start; fi < feats.size(); ++fi) {
+          if (!feats[fi].idf) continue;
+          int32_t ix = feats[fi].idx;
+          // f32 addition FIRST (then widen): WeightManager.idf does
+          // float(master[i] + diff[i]) — a double-precision sum here
+          // would diverge from the Python path once df saturates f32
+          double df = double(idf->dfm[ix] +
+                             (idf->dfd ? idf->dfd[ix] : 0.0f));
+          double w = (n <= 0.0 || df <= 0.0) ? 1.0 : std::log(n / df);
+          feats[fi].val *= w;
+        }
       }
     }
 
@@ -899,7 +958,7 @@ int jt_ingest_parse(void* h, const uint8_t* buf, int64_t len, uint32_t mask,
   // lengths, memory pressure) must surface as a parse error the caller
   // turns into an RPC error reply, never std::terminate
   try {
-    return parse_impl(h, buf, len, mask, 1, out);
+    return parse_impl(h, buf, len, mask, 1, nullptr, out);
   } catch (...) {
     return 4;
   }
@@ -910,7 +969,36 @@ int jt_ingest_parse(void* h, const uint8_t* buf, int64_t len, uint32_t mask,
 int jt_ingest_parse_datums(void* h, const uint8_t* buf, int64_t len,
                            uint32_t mask, JtIngestOut* out) {
   try {
-    return parse_impl(h, buf, len, mask, 0, out);
+    return parse_impl(h, buf, len, mask, 0, nullptr, out);
+  } catch (...) {
+    return 4;
+  }
+}
+
+// idf-weighted variants: the caller supplies the WeightManager's dense
+// df tables (master read-only, diff incremented per observed document)
+// and ndocs counters. ``observe`` 1 = train path (record documents),
+// 0 = query path (read-only idf lookup). The caller owns locking —
+// these mutate dfd/ndocs_d in place.
+int jt_ingest_parse_w(void* h, const uint8_t* buf, int64_t len,
+                      uint32_t mask, const float* dfm, float* dfd,
+                      double ndocs_m, double* ndocs_d, int observe,
+                      JtIngestOut* out) {
+  try {
+    IdfCtx ctx{dfm, dfd, ndocs_m, ndocs_d, observe};
+    return parse_impl(h, buf, len, mask, 1, &ctx, out);
+  } catch (...) {
+    return 4;
+  }
+}
+
+int jt_ingest_parse_datums_w(void* h, const uint8_t* buf, int64_t len,
+                             uint32_t mask, const float* dfm, float* dfd,
+                             double ndocs_m, double* ndocs_d,
+                             JtIngestOut* out) {
+  try {
+    IdfCtx ctx{dfm, dfd, ndocs_m, ndocs_d, 0};
+    return parse_impl(h, buf, len, mask, 0, &ctx, out);
   } catch (...) {
     return 4;
   }

@@ -140,8 +140,9 @@ def test_multiprocess_collective_mix():
     import bench_mix
 
     n = 3
-    outs = bench_mix.run_jax_world(_CHILD, n, timeout=180)
-    for i, out in enumerate(outs):
+    outs, rcs = bench_mix.run_jax_world(_CHILD, n, timeout=180)
+    for i, (out, rc) in enumerate(zip(outs, rcs)):
+        assert rc == 0, f"child {i} exit {rc}:\n{out[-3000:]}"
         assert f"CHILD-{i}-OK" in out, f"child {i}:\n{out[-3000:]}"
     assert any("MASTER-ROUND" in o for o in outs)
 

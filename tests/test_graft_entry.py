@@ -26,11 +26,13 @@ def _driver_env():
         if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "JUBATUS_TPU_PLATFORM",
                      "_JUBATUS_TPU_DRYRUN_CHILD")
     }
-    env["JAX_PLATFORMS"] = "cpu"  # no accelerator in the test sandbox
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-    path = env.get("PYTHONPATH", "")
-    if REPO not in path.split(os.pathsep):
-        env["PYTHONPATH"] = REPO + (os.pathsep + path if path else "")
+    import bench_mix
+
+    env = bench_mix.scrub_child_env(env)  # repo on path, axon plugin off
+    # driver shape: plain JAX_PLATFORMS, no JUBATUS_TPU_PLATFORM override
+    env.pop("JUBATUS_TPU_PLATFORM", None)
+    env["JAX_PLATFORMS"] = "cpu"  # no accelerator in the test sandbox
     return env
 
 

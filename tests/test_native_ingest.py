@@ -120,11 +120,16 @@ def test_huge_integral_and_mixed_labels_fall_back():
 def test_spec_rejects_unsupported_configs():
     assert ingest.spec_from_converter_config(None) is None
     assert ingest.spec_from_converter_config({}) is None
-    # idf global weight needs WeightManager state
+    # idf IS supported since round 3 (the parser takes the WeightManager's
+    # dense df tables); user "weight" still needs the user-weight map
     assert ingest.spec_from_converter_config({
         "string_rules": [{"key": "*", "type": "space",
                           "sample_weight": "bin",
-                          "global_weight": "idf"}]}) is None
+                          "global_weight": "idf"}]}) is not None
+    assert ingest.spec_from_converter_config({
+        "string_rules": [{"key": "*", "type": "space",
+                          "sample_weight": "bin",
+                          "global_weight": "weight"}]}) is None
     # filters change the datum before rules run
     assert ingest.spec_from_converter_config({
         "num_rules": [{"key": "*", "type": "num"}],
@@ -224,15 +229,19 @@ def test_server_fast_path_regression():
 
 
 def test_server_ineligible_config_uses_converter_path():
-    """An idf config must keep the converter path (no raw registration)."""
+    """A config the parser cannot express (regexp splitter) must keep the
+    converter path (no raw registration)."""
     from jubatus_tpu.client import ClassifierClient
     from jubatus_tpu.server import EngineServer
     from jubatus_tpu.server.args import ServerArgs
 
     conf = {"method": "PA", "parameter": {},
-            "converter": {"string_rules": [
-                {"key": "*", "type": "space", "sample_weight": "tf",
-                 "global_weight": "idf"}]}}
+            "converter": {
+                "string_types": {"rx": {"method": "regexp",
+                                        "pattern": "[a-z]+"}},
+                "string_rules": [
+                    {"key": "*", "type": "rx", "sample_weight": "tf",
+                     "global_weight": "bin"}]}}
     srv = EngineServer("classifier", conf,
                        args=ServerArgs(engine="classifier"))
     port = srv.start(0)
@@ -245,6 +254,50 @@ def test_server_ineligible_config_uses_converter_path():
         assert st["microbatch.train.item_count"] == 2
     finally:
         srv.stop()
+
+
+def test_server_idf_fast_path_matches_converter_path():
+    """An idf config rides the fast path now — and its model must stay
+    IDENTICAL to a converter-only server fed the same traffic (df
+    observation order and idf scaling replayed exactly in C++)."""
+    from jubatus_tpu.client import ClassifierClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    conf = {"method": "AROW", "parameter": {"regularization_weight": 1.0},
+            "converter": {"string_rules": [
+                {"key": "*", "type": "space", "sample_weight": "tf",
+                 "global_weight": "idf"}]}}
+    fast = EngineServer("classifier", conf,
+                        args=ServerArgs(engine="classifier"))
+    fast_port = fast.start(0)
+    slow = EngineServer("classifier", conf,
+                        args=ServerArgs(engine="classifier"))
+    slow_port = slow.start(0)
+    slow.rpc._raw_methods.clear()  # force the converter path
+    try:
+        assert "train" in fast.rpc._raw_methods
+        data = [["spam", Datum({"t": "win money now now"})],
+                ["ham", Datum({"t": "meet at noon"})],
+                ["spam", Datum({"t": "money money fast"})],
+                ["ham", Datum({"t": "noon lunch plan"})]]
+        with ClassifierClient("127.0.0.1", fast_port, "t") as cf, \
+                ClassifierClient("127.0.0.1", slow_port, "t") as cs:
+            for _ in range(5):
+                assert cf.train(data) == 4
+                assert cs.train(data) == 4
+            probe = [Datum({"t": "money now"}), Datum({"t": "noon plan"}),
+                     Datum({"t": "unseen words"})]
+            assert [sorted(r) for r in cf.classify(probe)] == \
+                [sorted(r) for r in cs.classify(probe)]
+        # fast server really used the raw path, and df state converged
+        assert fast.coalescers["train_raw"].stats()["item_count"] == 20
+        np.testing.assert_array_equal(
+            fast.driver.converter.weights._df_diff,
+            slow.driver.converter.weights._df_diff)
+    finally:
+        fast.stop()
+        slow.stop()
 
 
 def test_server_fallback_on_undecodable_fast_wire():
@@ -487,3 +540,59 @@ def test_ngram_bad_char_num_not_expressible():
                                   "sample_weight": "bin",
                                   "global_weight": "bin"}]}
         assert ingest.spec_from_converter_config(conv) is None
+
+
+def test_parity_idf_global_weight():
+    """idf rides the fast path (round 3): jt_ingest_parse_w must replay
+    converter.convert(update_weights=True)'s EXACT per-document protocol —
+    observe distinct idf indices first, then scale by log(ndocs/df), then
+    merge by hashed index — so a request-by-request sequence stays
+    bit-identical to the Python converter fed the same stream."""
+    conv = {"string_rules": [{"key": "*", "type": "space",
+                              "sample_weight": "tf",
+                              "global_weight": "idf"}],
+            "num_rules": [{"key": "*", "type": "num"}]}
+    spec = ingest.spec_from_converter_config(conv)
+    assert spec is not None
+    p = ingest.IngestParser(spec, 18)
+    assert p.needs_weights
+    pyconv = make_fv_converter(conv, dim_bits=18)
+    fast = make_fv_converter(conv, dim_bits=18)  # owns the fast path's df
+
+    rng = random.Random(33)
+    words = ["alpha", "beta", "gamma", "delta", "eps", "zeta", "eta"]
+    for req in range(6):
+        data = []
+        for _ in range(rng.randint(1, 30)):
+            text = " ".join(rng.choice(words)
+                            for _ in range(rng.randint(0, 8)))
+            nv = [("n", rng.uniform(-2, 2))] if rng.random() < 0.5 else []
+            data.append(("L%d" % rng.randint(0, 2),
+                         Datum(string_values=[("t", text)], num_values=nv)))
+        raw = msgpack.packb(["c", [[l, d.to_msgpack()] for l, d in data]])
+        with fast.weights.lock:
+            out = p.parse(raw, weights=fast.weights)
+        assert out is not None
+        labels, idx, val = out
+        for i, (_, d) in enumerate(data):
+            exp = [(int(a), float(np.float32(b)))
+                   for a, b in pyconv.convert(d, update_weights=True)]
+            assert _got(idx[i], val[i]) == exp, (req, i)
+    # df state identical after the whole stream
+    np.testing.assert_array_equal(fast.weights._df_diff,
+                                  pyconv.weights._df_diff)
+    assert fast.weights.ndocs == pyconv.weights.ndocs
+
+    # the QUERY path reads idf without observing
+    before = fast.weights.ndocs
+    qraw = msgpack.packb(["c", [Datum({"t": "alpha beta"}).to_msgpack()]])
+    with fast.weights.lock:
+        qi, qv = p.parse_datums(qraw, weights=fast.weights)
+    assert fast.weights.ndocs == before
+    exp = [(int(a), float(np.float32(b)))
+           for a, b in pyconv.convert(Datum({"t": "alpha beta"}))]
+    assert _got(qi[0], qv[0]) == exp
+
+    # an idf spec without weights must decline, not crash
+    assert p.parse(raw) is None
+    assert p.parse_datums(qraw) is None
