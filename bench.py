@@ -204,7 +204,7 @@ def main():
     float(jnp.sum(state.dw))
     tpu_sps = STEPS * BATCH / (time.perf_counter() - t0)
 
-    extra = {}
+    extra = {"bench_platform": dev.platform}  # "cpu" = tunnel-down fallback
     # crossover scale: the same kernel at Criteo-shaped D=2^24, where the
     # tables (512 MB with covariance) fit no CPU cache. Measured in a
     # SUBPROCESS with uncommitted inputs: committed (device_put) index

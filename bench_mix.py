@@ -247,9 +247,9 @@ if pid == 0:
         leaves, _ = jax.tree_util.tree_flatten(d)
         nbytes += sum(np.asarray(x).nbytes for x in leaves)
     print("COLLECTIVE=" + json.dumps(
-        {"collective_round_ms_nproc4": round(ms, 2),
+        {f"collective_round_ms_nproc{n}": round(ms, 2),
          "collective_round_payload_mb_per_replica": round(nbytes / 2**20, 2),
-         "collective_round_note": "4 jax.distributed CPU processes; "
+         "collective_round_note": f"{n} jax.distributed CPU processes; "
          "orchestration+psum cost, not interconnect bandwidth"}),
         flush=True)
 else:
