@@ -75,20 +75,31 @@ port, call_batch, k, warmup, measure, workload = (
 from jubatus_tpu.client import Datum
 rng = np.random.default_rng(os.getpid())
 VOCAB = [f"w{i:03d}" for i in range(400)]
+
+def mk_datum():
+    if workload.startswith("text"):
+        words = rng.choice(len(VOCAB), size=k)
+        return Datum({"body": " ".join(VOCAB[w] for w in words)})
+    return Datum({f"f{j}": float(v)
+                  for j, v in enumerate(rng.normal(size=k))})
+
 frames = []
+train_frames = []
 for _ in range(8):
     batch = []
     for _ in range(call_batch):
         label = "a" if rng.random() < 0.5 else "b"
-        if workload == "numeric":
-            d = Datum({f"f{j}": float(v)
-                       for j, v in enumerate(rng.normal(size=k))})
-        else:  # text: k-word messages from a 400-word vocabulary
-            words = rng.choice(len(VOCAB), size=k)
-            d = Datum({"body": " ".join(VOCAB[w] for w in words)})
-        batch.append([label, d.to_msgpack()])
-    frames.append(msgpack.packb([0, 1, "train", ["bench", batch]],
-                                use_bin_type=True))
+        batch.append([label, mk_datum().to_msgpack()])
+    train_frames.append(msgpack.packb([0, 1, "train", ["bench", batch]],
+                                      use_bin_type=True))
+if workload == "classify":
+    # query plane: read-mostly traffic against a model the warmup trains
+    for _ in range(8):
+        batch = [mk_datum().to_msgpack() for _ in range(call_batch)]
+        frames.append(msgpack.packb([0, 1, "classify", ["bench", batch]],
+                                    use_bin_type=True))
+else:
+    frames = train_frames
 sock = socket.create_connection(("127.0.0.1", port), timeout=120.0)
 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 unp = msgpack.Unpacker()
@@ -117,6 +128,11 @@ def call(frame):
         read_reply()
         in_flight -= 1
 
+if workload == "classify":
+    # give the model labels/weights before querying it
+    call(train_frames[0])
+    while in_flight:
+        read_reply(); in_flight -= 1
 deadline_warm = time.perf_counter() + warmup
 i = 0
 while time.perf_counter() < deadline_warm:
@@ -187,12 +203,13 @@ def run(transport: str = "python", workload: str = "numeric",
         if s.get("item_count"):
             avg_batch = max(avg_batch, s.get("avg_batch", 0.0))
     suffix = tag or transport
-    return {
-        f"e2e_rpc_train_samples_per_sec_{suffix}": round(sps, 1),
-        f"e2e_avg_device_batch_{suffix}": round(avg_batch, 1),
-        f"e2e_fast_path_fraction_{suffix}": round(
-            fast_items / max(fast_items + slow_items, 1), 3),
-    }
+    verb = "classify" if workload == "classify" else "train"
+    out = {f"e2e_rpc_{verb}_samples_per_sec_{suffix}": round(sps, 1)}
+    if verb == "train":  # coalescer stats are train-plane only
+        out[f"e2e_avg_device_batch_{suffix}"] = round(avg_batch, 1)
+        out[f"e2e_fast_path_fraction_{suffix}"] = round(
+            fast_items / max(fast_items + slow_items, 1), 3)
+    return out
 
 
 def run_proxy(transport: str = "python",
@@ -309,6 +326,13 @@ def collect(trials: int = 2) -> dict:
                            measure=TEXT_MEASURE_SECONDS, tag=tag))
         except Exception as e:  # noqa: BLE001
             out[f"e2e_{tag}_error"] = repr(e)[:200]
+    # query plane: classify samples/s against the trained numeric model
+    # (snapshot reads through the raw classify handler — no coalescer)
+    try:
+        out.update(run(text_tr, workload="classify",
+                       measure=TEXT_MEASURE_SECONDS))
+    except Exception as e:  # noqa: BLE001
+        out["e2e_classify_error"] = repr(e)[:200]
     # proxy tier: same numeric workload through the proxy hop (best of
     # `trials`, symmetric with the direct metric's best-of selection)
     pkey = f"e2e_rpc_train_samples_per_sec_proxy_{text_tr}"

@@ -93,15 +93,48 @@ def available() -> bool:
 
 def spec_from_converter_config(conv: dict) -> Optional[str]:
     """Compile a converter config into the C++ rule spec, or None when the
-    config needs features the native parser does not implement (filters,
-    idf/user weights, plugins, ngram/regexp splitters, combinations,
-    binary rules) — the caller then stays on the Python converter."""
+    config needs features the native parser does not implement (STRING
+    filters, user "weight" global weights, plugins, regexp splitters,
+    combinations, binary rules) — the caller then stays on the Python
+    converter. num filters, ngram splitters, and idf global weights all
+    compile to the native spec since round 3."""
     if not isinstance(conv, dict):
         return None
-    for k in ("string_filter_rules", "num_filter_rules", "binary_rules",
+    for k in ("string_filter_rules", "binary_rules",
               "combination_rules", "binary_types"):
         if conv.get(k):
             return None
+    # num filters: pure-math transforms appending (key+suffix, f(value)) —
+    # expressible in C++ since round 3. Param validity (max > min, std > 0)
+    # is the converter's job at server start; unknown methods decline.
+    nf_lines: List[str] = []
+    if conv.get("num_filter_rules"):
+        kinds = {}
+        for tname, params in (conv.get("num_filter_types") or {}).items():
+            p = params or {}
+            try:
+                if p.get("method") == "add":
+                    kinds[tname] = ("add", float(p["value"]), 0.0)
+                elif p.get("method") == "linear_normalization":
+                    kinds[tname] = ("linear", float(p["min"]),
+                                    float(p["max"]))
+                elif p.get("method") == "gaussian_normalization":
+                    kinds[tname] = ("gauss", float(p["average"]),
+                                    float(p["standard_deviation"]))
+                elif p.get("method") == "sigmoid_normalization":
+                    kinds[tname] = ("sigmoid", float(p["gain"]),
+                                    float(p["bias"]))
+            except (KeyError, TypeError, ValueError):
+                pass  # missing/odd params: rules using it decline below
+        for r in conv.get("num_filter_rules"):
+            k = kinds.get(r.get("type"))
+            if k is None:
+                return None
+            suffix = r.get("suffix", "")
+            if "\t" in suffix or "\n" in suffix:
+                return None
+            nf_lines.append(f"nf\t{k[0]}\t{k[1]!r}\t{k[2]!r}\t"
+                            f"{r.get('key', '*')}\t{suffix}")
     # type tables: builtin names plus parameterized ngram
     str_types = {"str": "str", "space": "space"}
     for tname, params in (conv.get("string_types") or {}).items():
@@ -145,6 +178,7 @@ def spec_from_converter_config(conv: dict) -> Optional[str]:
                      f"{r.get('key', '*')}")
     if not lines:
         return None
+    lines = nf_lines + lines  # filters are declared ahead of rules
     for ln in lines:  # keys with separators would corrupt the spec
         if "\n" in ln.replace("\t", " ") or ln.count("\t") > 5:
             return None

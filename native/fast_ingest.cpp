@@ -40,6 +40,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <string>
 #include <vector>
 
@@ -117,7 +118,43 @@ struct StrRule {
   std::string suffix;  // "@<type>#<sw>/<gw>"
 };
 
+struct NumFilter {
+  // ≙ converter.py _build_num_filter: pure f64 math, so parity with the
+  // Python lambdas is exact (same libm)
+  enum Kind { ADD, LINEAR, GAUSS, SIGMOID } kind = ADD;
+  double a = 0.0, b = 0.0;  // add: (value, -) linear: (lo, hi)
+                            // gauss: (mean, std) sigmoid: (gain, bias)
+  Matcher m;
+  std::string suffix;  // appended key = key + suffix
+
+  // *ok = false only where the PYTHON path would raise instead of
+  // producing a value: math.exp raises OverflowError on +inf (CPython
+  // checks isinf of the libm result), so a sigmoid whose exp overflows
+  // must abort the fast path and let the converter raise the same error
+  // — silently emitting 0.0 here would make the two paths disagree.
+  double apply(double x, bool* ok) const {
+    switch (kind) {
+      case ADD:
+        return x + a;
+      case LINEAR:
+        return (std::min(std::max(x, a), b) - a) / (b - a);
+      case GAUSS:
+        return (x - a) / b;
+      case SIGMOID: {
+        double e = std::exp(-a * (x - b));
+        if (e == HUGE_VAL) {
+          *ok = false;
+          return 0.0;
+        }
+        return 1.0 / (1.0 + e);
+      }
+    }
+    return x;
+  }
+};
+
 struct Parser {
+  std::vector<NumFilter> num_filters;
   std::vector<NumRule> num_rules;
   std::vector<StrRule> str_rules;
 };
@@ -518,7 +555,30 @@ void* jt_ingest_create(const char* spec) {
       f.push_back(line.substr(start, tab - start));
       start = tab + 1;
     }
-    if (f[0] == "num" && f.size() == 3) {
+    if (f[0] == "nf" && f.size() == 6) {
+      // "nf\t<kind>\t<a>\t<b>\t<pattern>\t<suffix>"
+      NumFilter nf;
+      if (f[1] == "add")
+        nf.kind = NumFilter::ADD;
+      else if (f[1] == "linear")
+        nf.kind = NumFilter::LINEAR;
+      else if (f[1] == "gauss")
+        nf.kind = NumFilter::GAUSS;
+      else if (f[1] == "sigmoid")
+        nf.kind = NumFilter::SIGMOID;
+      else {
+        delete ps;
+        return nullptr;
+      }
+      // from_chars: locale-INDEPENDENT ("5.5" must not parse as 5.0
+      // under an LC_NUMERIC with a comma separator smuggled in by some
+      // other module in the host process)
+      std::from_chars(f[2].data(), f[2].data() + f[2].size(), nf.a);
+      std::from_chars(f[3].data(), f[3].data() + f[3].size(), nf.b);
+      nf.m = Matcher::make(f[4]);
+      nf.suffix = f[5];
+      ps->num_filters.push_back(std::move(nf));
+    } else if (f[0] == "num" && f.size() == 3) {
       NumRule r;
       if (f[1] == "num")
         r.kind = NumRule::NUM;
@@ -638,6 +698,9 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
   std::string name;                 // scratch feature-name buffer
   std::vector<std::pair<const uint8_t*, size_t>> terms;  // scratch
   std::vector<int32_t> idf_scratch;  // distinct idf indices per example
+  // filter-appended keys; deque = stable addresses, and it must outlive
+  // every example (the schema cache memcmps prior examples' pointers)
+  std::deque<std::string> key_arena;
   char numbuf[40];
 
   // Schema cache for num rules: real ingest streams repeat one key schema
@@ -646,9 +709,11 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
   // memcmp replaces name assembly + CRC-32 per feature. state: -1 unset,
   // 0 no-match, 1 emit idx with v, 2 emit idx with log(max(1,v)),
   // 3 value-dependent name (num "str" rule) — recompute.
+  // entries OWN their key bytes (copied on miss): filter-appended keys
+  // live in a per-example arena, so a borrowed pointer would dangle into
+  // the previous example's scratch
   struct PosEntry {
-    const uint8_t* key = nullptr;
-    uint32_t len = 0;
+    std::string key;
     int8_t state = -1;
     int32_t idx = 0;
   };
@@ -738,6 +803,31 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
     }
     if (dlen == 3) rd.skip();  // binary_values: no binary rules here
 
+    // num filters (converter.py _apply_filters): each rule snapshots the
+    // CURRENT list and appends (key+suffix, f(value)) — later filters see
+    // earlier filters' output, exactly like the Python loop. Appended
+    // keys live in a deque (stable addresses) for the whole parse call.
+    key_arena.clear();  // per-example scratch (cache entries own copies)
+    for (const NumFilter& nf : ps.num_filters) {
+      size_t cur = nvs.size();
+      for (size_t fi = 0; fi < cur; ++fi) {
+        auto kv = nvs[fi];  // by value: push_back below may reallocate
+        if (!nf.m.match(kv.first.first, kv.first.second)) continue;
+        key_arena.emplace_back();
+        std::string& nk = key_arena.back();
+        nk.assign(reinterpret_cast<const char*>(kv.first.first),
+                  kv.first.second);
+        nk += nf.suffix;
+        bool ok = true;
+        double fv = nf.apply(kv.second, &ok);
+        if (!ok) return 3;  // Python path raises here: fall back to it
+        nvs.push_back(
+            {{reinterpret_cast<const uint8_t*>(nk.data()), nk.size()},
+             fv});
+      }
+    }
+    nnv = int64_t(nvs.size());
+
     // string rules (converter.py:346-366)
     for (const StrRule& r : ps.str_rules) {
       for (auto& kv : svs) {
@@ -816,8 +906,8 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
         const uint8_t* key = kv.first.first;
         size_t keyn = kv.first.second;
         PosEntry& pe = row[ki];
-        if (pe.state >= 0 && pe.len == keyn &&
-            (pe.key == key || 0 == memcmp(pe.key, key, keyn))) {
+        if (pe.state >= 0 && pe.key.size() == keyn &&
+            0 == memcmp(pe.key.data(), key, keyn)) {
           switch (pe.state) {
             case 0:
               continue;
@@ -831,8 +921,7 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
               break;  // state 3: value-dependent, fall through
           }
         } else {
-          pe.key = key;
-          pe.len = uint32_t(keyn);
+          pe.key.assign(reinterpret_cast<const char*>(key), keyn);
           if (!r.m.match(key, keyn)) {
             pe.state = 0;
             continue;

@@ -596,3 +596,71 @@ def test_parity_idf_global_weight():
     # an idf spec without weights must decline, not crash
     assert p.parse(raw) is None
     assert p.parse_datums(qraw) is None
+
+
+def test_parity_num_filters():
+    """num filters ride the fast path (round 3): every builtin transform,
+    applied sequentially over the GROWING kv list (a later filter sees an
+    earlier filter's appended output), bit-identical to converter.py."""
+    conv = {
+        "num_filter_types": {
+            "a5": {"method": "add", "value": "5.5"},
+            "lin": {"method": "linear_normalization", "min": "-2",
+                    "max": "3"},
+            "gz": {"method": "gaussian_normalization", "average": "0.5",
+                   "standard_deviation": "2.0"},
+            "sig": {"method": "sigmoid_normalization", "gain": "1.5",
+                    "bias": "0.25"},
+        },
+        "num_filter_rules": [
+            {"key": "x*", "type": "a5", "suffix": "+5"},
+            {"key": "*+5", "type": "sig", "suffix": "$s"},  # chained
+            {"key": "y", "type": "lin", "suffix": "_n"},
+            {"key": "*", "type": "gz", "suffix": "@g"},
+        ],
+        "num_rules": [{"key": "*", "type": "num"},
+                      {"key": "*_n", "type": "str"}],
+    }
+    spec = ingest.spec_from_converter_config(conv)
+    assert spec is not None
+    p = ingest.IngestParser(spec, 18)
+    pyconv = make_fv_converter(conv, dim_bits=18)
+    rng = random.Random(44)
+    data = []
+    for _ in range(150):
+        nv = [(rng.choice(["x1", "x2", "y", "z"]),
+               rng.choice([0.0, -3.0, 2.5, 7.25,
+                           rng.uniform(-10, 10)]))
+              for _ in range(rng.randint(0, 5))]
+        data.append(("L", Datum(num_values=nv)))
+    raw = msgpack.packb(["c", [[l, d.to_msgpack()] for l, d in data]])
+    out = p.parse(raw)
+    assert out is not None
+    labels, idx, val = out
+    for i, (_, d) in enumerate(data):
+        assert _got(idx[i], val[i]) == _expected(pyconv, d), (i, d.num_values)
+
+
+def test_num_filter_unknown_method_declines():
+    conv = {"num_filter_types": {"w": {"method": "wavelet"}},
+            "num_filter_rules": [{"key": "*", "type": "w", "suffix": "#"}],
+            "num_rules": [{"key": "*", "type": "num"}]}
+    assert ingest.spec_from_converter_config(conv) is None
+
+
+def test_sigmoid_overflow_falls_back_like_python_raises():
+    """math.exp raises OverflowError past ~709; the C++ path must decline
+    (fall back) so both paths fail the request identically instead of the
+    fast path silently emitting 0.0."""
+    conv = {"num_filter_types": {"s": {"method": "sigmoid_normalization",
+                                       "gain": "1.5", "bias": "0"}},
+            "num_filter_rules": [{"key": "*", "type": "s", "suffix": "#"}],
+            "num_rules": [{"key": "*", "type": "num"}]}
+    p = ingest.IngestParser(ingest.spec_from_converter_config(conv), 16)
+    pyconv = make_fv_converter(conv, dim_bits=16)
+    ok = msgpack.packb(["c", [["x", Datum({"k": -400.0}).to_msgpack()]]])
+    assert p.parse(ok) is not None  # exp(600) is finite
+    bad = msgpack.packb(["c", [["x", Datum({"k": -500.0}).to_msgpack()]]])
+    assert p.parse(bad) is None     # exp(750) overflows -> decline
+    with pytest.raises(OverflowError):
+        pyconv.convert(Datum({"k": -500.0}))
