@@ -100,3 +100,86 @@ def test_oversized_method_name(servers):
             with pytest.raises(RpcMethodNotFound):
                 c.call("m" * 10000)
             assert c.call("ping") == "pong", name
+
+
+def test_proxy_raw_relay_survives_garbage_and_recovers():
+    """The proxy's zero-decode relay path (round 3) faces client bytes
+    before any generic validation: garbage, truncated frames, and
+    odd-shaped params must never kill the proxy or the backend, and a
+    valid call must still route afterwards."""
+    import random as _random
+
+    import msgpack
+
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+    store = _Store()
+    conf = {"method": "PA", "parameter": {"regularization_weight": 1.0},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    srv = EngineServer("classifier", conf,
+                       args=ServerArgs(engine="classifier",
+                                       coordinator="(shared)", name="fz",
+                                       listen_addr="127.0.0.1",
+                                       interval_sec=1e9,
+                                       interval_count=1 << 30),
+                       coord=MemoryCoordinator(store))
+    srv.start(0)
+    proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1"),
+                  coord=MemoryCoordinator(store))
+    pport = proxy.start(0)
+    try:
+        # garbage frames straight at the proxy
+        for g in GARBAGE:
+            s = socket.create_connection(("127.0.0.1", pport), timeout=5)
+            try:
+                s.sendall(g)
+                s.settimeout(0.3)
+                try:
+                    s.recv(4096)
+                except (socket.timeout, OSError):
+                    pass  # close/RST or silence — both acceptable
+            finally:
+                s.close()
+        # odd but well-formed params through the relay: wrong name type,
+        # empty params, non-array params, mutated train bytes
+        base = msgpack.packb(
+            [0, 1, "train",
+             ["fz", [["a", Datum({"x": 1.0}).to_msgpack()]]]],
+            use_bin_type=True)
+        rng = _random.Random(5)
+        odd = [
+            msgpack.packb([0, 1, "train", [7, []]]),
+            msgpack.packb([0, 1, "train", []]),
+            msgpack.packb([0, 1, "train", "notarray"]),
+            msgpack.packb([0, 1, "classify", ["fz", "x"]]),
+        ]
+        for _ in range(60):
+            raw = bytearray(base)
+            for _ in range(rng.randint(1, 5)):
+                raw[rng.randrange(len(raw))] = rng.randrange(256)
+            odd.append(bytes(raw))
+        for payload in odd:
+            s = socket.create_connection(("127.0.0.1", pport), timeout=5)
+            try:
+                s.sendall(payload)
+                s.settimeout(0.5)
+                try:
+                    s.recv(4096)  # error reply, silence, or reset — all ok
+                except (socket.timeout, OSError):
+                    pass
+            finally:
+                s.close()
+        # the tier still works end to end
+        with ClassifierClient("127.0.0.1", pport, "fz",
+                              timeout=10.0) as c:
+            assert c.train([["pos", Datum({"a": 1.0})],
+                            ["neg", Datum({"b": 1.0})]]) == 2
+            (r,) = c.classify([Datum({"a": 1.0})])
+            assert dict(r)["pos"] > dict(r)["neg"]
+    finally:
+        proxy.stop()
+        srv.stop()
