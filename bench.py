@@ -253,6 +253,22 @@ def main():
     except Exception as e:  # noqa: BLE001 — headline must still print
         extra["mix_error"] = repr(e)[:200]
 
+    # --- chip-advantage axes (VERDICT r2 item 7): L-scaling flat-vs-linear
+    # --- and the CPU lock-contention row, captured by the driver itself ---
+    try:
+        import sys as _sys
+
+        _tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools")
+        if _tools not in _sys.path:
+            _sys.path.insert(0, _tools)
+        import bench_chip_axes
+
+        extra.update(bench_chip_axes.cpu_axes())
+        extra.update(bench_chip_axes.chip_l_sweep())
+    except Exception as e:  # noqa: BLE001
+        extra["chip_axes_error"] = repr(e)[:200]
+
     # --- end-to-end serving path (VERDICT r1 item 2: the product, not the
     # --- kernel: RPC decode -> datum -> fv convert -> device) ---
     try:
