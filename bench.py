@@ -263,11 +263,20 @@ def main():
         if _tools not in _sys.path:
             _sys.path.insert(0, _tools)
         import bench_chip_axes
-
-        extra.update(bench_chip_axes.cpu_axes())
-        extra.update(bench_chip_axes.chip_l_sweep())
     except Exception as e:  # noqa: BLE001
         extra["chip_axes_error"] = repr(e)[:200]
+        bench_chip_axes = None
+    if bench_chip_axes is not None:
+        # independent trys: a toolchain-less host loses only the CPU rows,
+        # never the device-side sweep (and vice versa)
+        try:
+            extra.update(bench_chip_axes.cpu_axes())
+        except Exception as e:  # noqa: BLE001
+            extra["cpu_axes_error"] = repr(e)[:200]
+        try:
+            extra.update(bench_chip_axes.chip_l_sweep())
+        except Exception as e:  # noqa: BLE001
+            extra["chip_l_error"] = repr(e)[:200]
 
     # --- end-to-end serving path (VERDICT r1 item 2: the product, not the
     # --- kernel: RPC decode -> datum -> fv convert -> device) ---
