@@ -257,36 +257,51 @@ class ClassifierDriver(DriverBase):
         self._dcounts[slots_u] += counts[:len(slots_u)]
         return self._train_slots(slots_u[label_idx], idx, val, b)
 
-    @locked
     def classify(self, data: Sequence[Datum]) -> List[List[Tuple[str, float]]]:
+        # deliberately NOT @locked: the convert loop touches no driver
+        # state and classify_hashed takes the lock for exactly the
+        # dispatch window — concurrent Datum-path queries overlap too
         if not data:
             return []
-        if not self.label_slots:
-            return [[] for _ in data]
         vectors = [self.converter.convert(d) for d in data]
         sb = SparseBatch.from_vectors(vectors, batch_bucket=16)
+        out = self.classify_hashed(sb.idx, sb.val)
+        if not out:
+            return [[] for _ in data]
         # from_vectors already row-bucketed; slice its pad rows back off
-        return self.classify_hashed(sb.idx, sb.val)[: len(data)]
+        return out[: len(data)]
 
-    @locked
     def classify_hashed(self, idx: np.ndarray,
                         val: np.ndarray) -> List[List[Tuple[str, float]]]:
         """Classify pre-hashed features (native ingest fast path); same
-        output shape as classify()."""
+        output shape as classify().
+
+        Dispatch-under-lock, wait-unlocked: the scores computation is
+        ENQUEUED while the driver lock guarantees no train step can
+        donate the state buffers first (train_batch donates for in-place
+        scatters — dispatching against an already-donated Array raises
+        "Array has been deleted"); once enqueued, the runtime keeps the
+        buffers alive for the pending read, so the device round trip and
+        result wait run unlocked and concurrent queries overlap instead
+        of serializing. ≙ the reference's JRLOCK_ shared reads."""
         n = idx.shape[0]
         if n == 0:
             return []
-        if not self.label_slots:
-            return [[] for _ in range(n)]
         b = _bucket(n, 16)
         if b != n:
             idx = np.pad(idx, ((0, b - n), (0, 0)))
             val = np.pad(val, ((0, b - n), (0, 0)))
-        sc = np.asarray(
-            ops.scores(self.state, jnp.asarray(idx), jnp.asarray(val),
-                       self._mask()))[:n]
+        # H2D transfers touch no driver state: stage them unlocked so the
+        # critical section is just the enqueue
+        didx, dval = jnp.asarray(idx), jnp.asarray(val)
+        with self.lock:
+            if not self.label_slots:
+                return [[] for _ in range(n)]
+            slots = list(self.label_slots.items())
+            pending = ops.scores(self.state, didx, dval, self._mask())
+        sc = np.asarray(pending)[:n]
         return [[(lab, float(row[slot]))
-                 for lab, slot in self.label_slots.items()] for row in sc]
+                 for lab, slot in slots] for row in sc]
 
     @locked
     def clear(self) -> None:

@@ -108,18 +108,20 @@ class RegressionDriver(DriverBase):
         self.event_model_updated(n)
         return n
 
-    @locked
     def estimate(self, data: Sequence[Datum]) -> List[float]:
+        # NOT @locked: estimate_hashed locks only its dispatch window
         if not data:
             return []
         vectors = [self.converter.convert(d) for d in data]
         sb = SparseBatch.from_vectors(vectors, batch_bucket=16)
         return self.estimate_hashed(sb.idx, sb.val)[: len(data)]
 
-    @locked
     def estimate_hashed(self, idx: np.ndarray,
                         val: np.ndarray) -> List[float]:
-        """Estimate on pre-hashed features (native ingest fast path)."""
+        """Estimate on pre-hashed features (native ingest fast path).
+        Dispatch-under-lock, wait-unlocked (see classify_hashed): enqueue
+        while no train can donate the state, then overlap the device
+        round trip (≙ the reference's JRLOCK_ reads)."""
         n = idx.shape[0]
         if n == 0:
             return []
@@ -127,8 +129,10 @@ class RegressionDriver(DriverBase):
         if b != n:
             idx = np.pad(idx, ((0, b - n), (0, 0)))
             val = np.pad(val, ((0, b - n), (0, 0)))
-        pred = ops.estimate(self.state, jnp.asarray(idx), jnp.asarray(val))
-        return [float(x) for x in np.asarray(pred)[:n]]
+        didx, dval = jnp.asarray(idx), jnp.asarray(val)  # staged unlocked
+        with self.lock:
+            pending = ops.estimate(self.state, didx, dval)
+        return [float(x) for x in np.asarray(pending)[:n]]
 
     @locked
     def clear(self) -> None:
