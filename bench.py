@@ -109,10 +109,52 @@ def cpp_arow_baseline(idx, val, labels, r=1.0, dim=None):
     return (sps, "cpp -O3") if sps > 0 else (None, "zero result")
 
 
+def _tunnel_alive(probe_timeout_s: float = 120.0) -> bool:
+    """Ask a FRESH subprocess whether the device tunnel answers.
+
+    Once backend init hangs in a process that process is lost for device
+    work (later jax calls join the same init lock), so liveness must be
+    probed out-of-process. The child runs its own watchdog thread and
+    exits cleanly via os._exit — it is never killed mid-device-op, which
+    is what wedges the tunnel in the first place."""
+    import subprocess
+    import sys
+
+    prog = (
+        "import os, threading\n"
+        "res = {}\n"
+        "def probe():\n"
+        "    try:\n"
+        "        import jax, jax.numpy as jnp\n"
+        "        d = jax.devices()[0]\n"
+        "        res['p'] = d.platform\n"
+        "        float(jnp.arange(4).sum())\n"
+        "        res['ok'] = True\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "t = threading.Thread(target=probe, daemon=True)\n"
+        "t.start(); t.join(%f)\n"
+        "print('ALIVE' if res.get('ok') and res.get('p') != 'cpu'"
+        " else 'DEAD')\n"
+        "os._exit(0)\n" % (probe_timeout_s - 10)
+    )
+    env = dict(os.environ)
+    env.pop("JUBATUS_TPU_PLATFORM", None)  # probe the real platform
+    try:
+        proc = subprocess.run([sys.executable, "-c", prog], env=env,
+                              capture_output=True, text=True,
+                              timeout=probe_timeout_s)
+        return "ALIVE" in proc.stdout
+    except Exception:  # noqa: BLE001
+        return False
+
+
 def _probe_device(timeout_s: float = None):  # type: ignore[assignment]
     """Backend init under a watchdog: the axon tunnel can hang
     indefinitely, and a bench that never prints its JSON line is worse
-    than a degraded one. On timeout, re-exec on CPU (sitecustomize pins
+    than a degraded one. On a hang, retry with backoff via fresh
+    subprocess probes (the wedge is often transient between processes);
+    only when the tunnel stays dead re-exec on CPU (sitecustomize pins
     JAX_PLATFORMS at interpreter start, so a fresh process + config
     update is the reliable switch)."""
     import os
@@ -142,10 +184,30 @@ def _probe_device(timeout_s: float = None):  # type: ignore[assignment]
         print(f"device init failed even on CPU: {result.get('err', 'hung')}",
               file=sys.stderr)
         sys.exit(1)
-    print(f"device init did not complete in {timeout_s:.0f}s "
-          f"({result.get('err', 'hung')}); re-running on CPU",
-          file=sys.stderr)
-    os.environ["JUBATUS_TPU_PLATFORM"] = "cpu"
+    # this process is lost (init hung holds the backend lock); decide the
+    # NEXT process's platform by probing the tunnel with backoff
+    attempts = int(os.environ.get("JUBATUS_BENCH_PROBE_ATTEMPTS", "3"))
+    reexecs = int(os.environ.get("_JUBATUS_BENCH_CHIP_REEXECS", "0"))
+    revived = False
+    if reexecs < 2:  # bounded: never exec-loop on a flapping tunnel
+        for i in range(attempts):
+            if i:
+                time.sleep(min(60.0 * i, 180.0))
+            print(f"probe attempt {i + 1}/{attempts} (subprocess)...",
+                  file=sys.stderr)
+            if _tunnel_alive():
+                revived = True
+                break
+    if revived:
+        print("tunnel answered a fresh process; re-running on the chip",
+              file=sys.stderr)
+        os.environ["_JUBATUS_BENCH_CHIP_REEXECS"] = str(reexecs + 1)
+    else:
+        print(f"device init did not complete in {timeout_s:.0f}s and "
+              f"{attempts} fresh-process probes failed "
+              f"({result.get('err', 'hung')}); re-running on CPU",
+              file=sys.stderr)
+        os.environ["JUBATUS_TPU_PLATFORM"] = "cpu"
     # keep argv: a --d24-probe child that falls back to CPU must remain
     # the probe, not re-exec into the full benchmark
     os.execv(sys.executable,
@@ -161,7 +223,7 @@ def d24_probe() -> None:
     program is insensitive). Letting XLA pick input layouts is the
     production shape — the serving path feeds jnp.asarray too."""
     rng = np.random.default_rng(0)
-    _probe_device()
+    dev = _probe_device()
     big_d = 1 << 24
     val = jnp.asarray(rng.normal(size=(BATCH, K)).astype(np.float32))
     labels = jnp.asarray(rng.integers(0, L, size=BATCH).astype(np.int32))
@@ -176,7 +238,10 @@ def d24_probe() -> None:
     for i in range(1, 4):
         st = C.train_batch(st, idxs[i], val, labels, mask, 1.0, method="AROW")
     float(jnp.sum(st.dw))
-    print(f"D24={3 * BATCH / (time.perf_counter() - t0):.1f}")
+    # the parent keys the result by THIS platform — a CPU-fallback child
+    # must never surface under a tpu_* key (VERDICT r3)
+    print(f"D24={3 * BATCH / (time.perf_counter() - t0):.1f} "
+          f"PLAT={dev.platform}")
 
 
 def main():
@@ -215,14 +280,42 @@ def main():
         import subprocess
         import sys
 
+        # the child inherits the PARENT's platform verdict: a CPU-fallback
+        # parent pins the child to CPU (no 240 s re-probe of a wedged
+        # tunnel), and either way the child gets NO subprocess-probe
+        # retries — its worst-case budget must stay far inside the 900 s
+        # watchdog, because a timeout-SIGKILL mid-backend-init is exactly
+        # the wedge trigger (memory: axon-tunnel-wedge)
+        child_env = dict(os.environ)
+        child_env["JUBATUS_BENCH_PROBE_ATTEMPTS"] = "0"
+        if dev.platform == "cpu":
+            child_env["JUBATUS_TPU_PLATFORM"] = "cpu"
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--d24-probe"],
-            capture_output=True, text=True, timeout=600,
+            capture_output=True, text=True, timeout=900, env=child_env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
+        got = False
         for line in proc.stdout.splitlines():
             if line.startswith("D24="):
-                extra["tpu_d2^24_samples_per_sec"] = float(line[4:])
-        if "tpu_d2^24_samples_per_sec" not in extra:
+                sps_s, _, plat_s = line[4:].partition(" PLAT=")
+                plat = plat_s.strip() or "unknown"
+                # key carries the platform that produced the number: only
+                # a run on the real chip (the axon tunnel device) may mint
+                # the tpu_ key; cpu is the tunnel-down fallback; anything
+                # else is recorded under its own name, never as tpu
+                if plat in ("tpu", "axon"):
+                    extra["tpu_d2^24_samples_per_sec"] = float(sps_s)
+                elif plat == "cpu":
+                    extra["cpu_jax_d2^24_samples_per_sec"] = float(sps_s)
+                    extra["tpu_d2^24_error"] = \
+                        "tunnel down; child fell back to cpu"
+                else:
+                    extra[f"{plat}_jax_d2^24_samples_per_sec"] = \
+                        float(sps_s)
+                    extra["tpu_d2^24_error"] = \
+                        f"unexpected platform {plat!r}; chip key withheld"
+                got = True
+        if not got:
             extra["tpu_d2^24_error"] = (proc.stderr or "no output")[-160:]
     except Exception as e:  # noqa: BLE001
         extra["tpu_d2^24_error"] = repr(e)[:160]

@@ -209,6 +209,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 pid = int(sys.argv[1]); n = int(sys.argv[2])
 jax_port, coord_dir = sys.argv[3], sys.argv[4]
+dim_bits = int(sys.argv[5]) if len(sys.argv) > 5 else 0
 jax.distributed.initialize(f"127.0.0.1:{jax_port}", num_processes=n,
                            process_id=pid)
 from jubatus_tpu.client import ClassifierClient, Datum
@@ -216,25 +217,49 @@ from jubatus_tpu.coord import membership
 from jubatus_tpu.server import EngineServer
 from jubatus_tpu.server.args import ServerArgs
 
-CONF = {"method": "PA", "parameter": {"regularization_weight": 1.0},
-        "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+# dim_bits > 0: the north-star-scale round — AROW (w + sigma diffs, the
+# reference's confidence-weighted shape) at hash_max_size-pinned dim
+if dim_bits:
+    CONF = {"method": "AROW", "parameter": {"regularization_weight": 1.0},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}],
+                          "hash_max_size": 1 << dim_bits}}
+else:
+    CONF = {"method": "PA", "parameter": {"regularization_weight": 1.0},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
 args = ServerArgs(engine="classifier", coordinator=coord_dir, name="mb",
                   listen_addr="127.0.0.1", mixer="collective_mixer",
-                  interval_sec=1e9, interval_count=1 << 30)
+                  interval_sec=1e9, interval_count=1 << 30,
+                  # north-star payloads (256 MB diffs) need a mixer-plane
+                  # timeout matched to the transfer, like the reference's
+                  # --interconnect_timeout knob for big models
+                  interconnect_timeout=180.0 if dim_bits else 10.0,
+                  timeout=180.0 if dim_bits else 10.0)
 srv = EngineServer("classifier", CONF, args)
 srv.start(0)
-c = ClassifierClient("127.0.0.1", srv.args.rpc_port, "mb", timeout=120)
+c = ClassifierClient("127.0.0.1", srv.args.rpc_port, "mb", timeout=300)
 for _ in range(4):
     c.train([["pos", Datum({f"x{pid}": 1.0})],
              ["neg", Datum({f"x{pid}": -1.0})]])
-deadline = time.time() + 120
+# budget starts AFTER training: at north-star dims the d2^24 train
+# compiles eat minutes of one time-sliced core, and a peer whose wait
+# expires calls srv.stop() — tearing its listener down right under the
+# master's mix fan-out (connection refused on every peer)
+deadline = time.time() + (120 if not dim_bits else 600)
 while time.time() < deadline:
     if len(membership.get_all_nodes(srv.coord, "classifier", "mb")) == n:
         break
     time.sleep(0.2)
 if pid == 0:
-    time.sleep(1.5)  # peers finish training + registration
-    out = srv.mixer.mix_now()          # warmup round (compiles the psum)
+    time.sleep(1.5 if not dim_bits else 5.0)  # peers finish training
+    # warmup until the COLLECTIVE path engages (compiles the psum): big
+    # models boot slowly on a time-sliced host and a transient prepare
+    # failure routes one round to the RPC fallback — retry, don't abort
+    for attempt in range(4):
+        out = srv.mixer.mix_now()
+        if out and out.get("collective"):
+            break
+        print(f"warmup attempt {attempt}: {out!r}", flush=True)
+        time.sleep(3.0)
     assert out and out.get("collective"), out
     t0 = time.perf_counter()
     out = srv.mixer.mix_now()          # measured round
@@ -246,11 +271,15 @@ if pid == 0:
     for d in diffs.values():
         leaves, _ = jax.tree_util.tree_flatten(d)
         nbytes += sum(np.asarray(x).nbytes for x in leaves)
+    plat = jax.devices()[0].platform
+    tag = f"_d{dim_bits}" if dim_bits else ""
     print("COLLECTIVE=" + json.dumps(
-        {f"collective_round_ms_nproc{n}": round(ms, 2),
-         "collective_round_payload_mb_per_replica": round(nbytes / 2**20, 2),
-         "collective_round_note": f"{n} jax.distributed CPU processes; "
-         "orchestration+psum cost, not interconnect bandwidth"}),
+        {f"collective_round_ms_nproc{n}{tag}": round(ms, 2),
+         f"collective_round{tag}_payload_mb_per_replica":
+             round(nbytes / 2**20, 2),
+         f"collective_round{tag}_platform": plat,
+         f"collective_round{tag}_note": f"{n} jax.distributed {plat} "
+         "processes; orchestration+psum cost, not interconnect bandwidth"}),
         flush=True)
 else:
     while time.time() < deadline:
@@ -316,41 +345,69 @@ def run_jax_world(child_src: str, n: int, timeout: float = 300.0,
         shutil.rmtree(coord_dir, ignore_errors=True)
 
 
-def collective_nproc(n: int = 4) -> dict:
-    """Timed production collective round across ``n`` OS processes."""
+def collective_nproc(n: int = 4, dim_bits: int = 0,
+                     timeout: float = 300.0) -> dict:
+    """Timed production collective round across ``n`` OS processes.
+    ``dim_bits`` > 0 runs the north-star-scale variant (AROW diffs at
+    D=2^dim_bits — w + sigma, 2^dim_bits * L * 2 * 4 bytes f32 per
+    replica)."""
     out: dict = {}
+    err_key = f"collective_round{f'_d{dim_bits}' if dim_bits else ''}_error"
+    extra = (str(dim_bits),) if dim_bits else ()
     try:
-        outs, rcs = run_jax_world(_COLLECTIVE_CHILD, n)
+        outs, rcs = run_jax_world(_COLLECTIVE_CHILD, n, timeout=timeout,
+                                  extra_args=extra)
     except subprocess.TimeoutExpired:
-        return {"collective_round_error": "timeout"}
+        return {err_key: "timeout"}
     if any(rc != 0 for rc in rcs):
-        return {"collective_round_error":
-                f"child exits {rcs}: {(''.join(outs))[-200:]}"}
+        return {err_key: f"child exits {rcs}: {(''.join(outs))[-200:]}"}
     for text in outs:
         for line in text.splitlines():
             if line.startswith("COLLECTIVE="):
                 out.update(json.loads(line[len("COLLECTIVE="):]))
     if not out:
-        out["collective_round_error"] = "no master output"
+        out[err_key] = "no master output"
     return out
 
 
 def collect(dev=None) -> dict:
+    import jax
+
     out = device_round(20, dev, tag="d20")
     out.update(device_round(NORTH_STAR_BITS, dev, trials=3, tag="d24"))
+    # the platform the single-device rounds ran on (a cpu here means the
+    # tunnel was down and every mix_round_ms_* above is host CPU)
+    out["mix_platform"] = (dev.platform if dev is not None
+                           else jax.devices()[0].platform)
     out.update(_allreduce8_subprocess())
     out.update(collective_nproc(4))
-    # the north-star comparison (BASELINE.md): worst measured DEVICE round
-    # AT NORTH-STAR SCALE (D=2^24) vs the 1 s target — d20 rounds are
-    # reported but do not gate (round 2 was dinged for claiming the box at
-    # 1/16th scale). The nproc4 collective round is reported alongside but
-    # does not gate either: 4 OS processes time-slicing this host's ONE
-    # core is an orchestration-correctness artifact, not a deployment
-    # shape (real replicas have their own cores and ride ICI/DCN).
+    out.update(collective_nproc(4, dim_bits=NORTH_STAR_BITS, timeout=900))
     gates = [v for k, v in out.items() if k.startswith("mix_round_ms_d24_")]
     if gates:
         out["mix_round_worst_ms"] = max(gates)
-        out["mix_under_1s_target"] = bool(max(gates) < 1000.0)
+    # the north-star flag (BASELINE.md: mix round <= 1 s at D=2^24) is
+    # computed ONLY from the measurement that includes BOTH the scale and
+    # the multi-process transport: the nproc4 collective round shipping
+    # d24 AROW diffs, labeled with the platform that ran it (VERDICT r3:
+    # a single-device psum on the CPU fallback checks no box).
+    ns_key = f"collective_round_ms_nproc4_d{NORTH_STAR_BITS}"
+    if ns_key in out:
+        ms = out[ns_key]
+        plat = out.get(f"collective_round_d{NORTH_STAR_BITS}_platform",
+                       "cpu")
+        out["mix_under_1s_target"] = bool(ms < 1000.0)
+        out["mix_under_1s_platform"] = plat
+        if plat == "cpu" and ms >= 1000.0:
+            payload = out.get(
+                f"collective_round_d{NORTH_STAR_BITS}"
+                "_payload_mb_per_replica", 0.0)
+            wire = payload * 2 * 3 / 4  # ring allreduce, n=4
+            out["mix_under_1s_note"] = (
+                f"fails on cpu orchestration (4 processes time-slicing one "
+                f"core, loopback transport); passing needs real chips: "
+                f"~{wire:.0f} MB/replica on the wire per round, i.e. ICI "
+                f"must sustain >= {wire / 1000:.1f} GB/s per link with "
+                f"host orchestration off the critical path")
     return out
 
 

@@ -65,6 +65,36 @@ TEXT_IDF_CONF = {
          "global_weight": "idf"}]},
 }
 
+#: combination rules (≙ config/classifier/arow_combinational_feature.json):
+#: native-expressible since round 4 — the C++ parser runs the named cross
+#: product (K numeric features -> K*(K-1)/2 extra pairs per datum)
+COMBO_CONF = {
+    "method": "AROW",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {
+        "num_rules": [{"key": "*", "type": "num"}],
+        "combination_rules": [
+            {"key_left": "*", "key_right": "*", "type": "mul"}],
+    },
+}
+
+#: string filters (regexp) are NOT native-expressible (std::regex vs
+#: Python `re` divergence risk) — this row PRICES the Python-converter
+#: fallback honestly (e2e_fast_path_fraction_text_filter = 0.0)
+TEXT_FILTER_CONF = {
+    "method": "AROW",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {
+        "string_filter_types": {
+            "strip_digits": {"method": "regexp", "pattern": "[0-9]+",
+                             "replace": ""}},
+        "string_filter_rules": [
+            {"key": "*", "type": "strip_digits", "suffix": "-nodigit"}],
+        "string_rules": [
+            {"key": "*", "type": "space", "sample_weight": "tf",
+             "global_weight": "bin"}]},
+}
+
 _CLIENT_PROG = r"""
 import os, socket, sys, time
 import numpy as np
@@ -175,26 +205,36 @@ def run(transport: str = "python", workload: str = "numeric",
     from bench_mix import scrub_child_env  # one owner for the env scrub
 
     env = scrub_child_env(os.environ)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _CLIENT_PROG, str(port), str(CALL_BATCH),
-             str(K), str(WARMUP_SECONDS), str(measure), workload],
-            env=env, cwd=repo, stdout=subprocess.PIPE, text=True)
-        for _ in range(N_CLIENTS)
-    ]
+    procs = []
     total, elapsed_max = 0, 0.0
-    for p in procs:
-        out, _ = p.communicate(timeout=WARMUP_SECONDS + measure + 240)
-        for line in out.splitlines():
-            if line.startswith("CLIENT "):
-                _, cnt, el = line.split()
-                total += int(cnt)
-                elapsed_max = max(elapsed_max, float(el))
     stats = {}
-    for nm, co in srv.coalescers.items():
-        s = co.stats()
-        stats[nm] = s
-    srv.stop()
+    # try/finally like run_proxy: a communicate() timeout or client crash
+    # must not leak the server + up to N_CLIENTS load generators into the
+    # next trial's measurement window (they'd share the single bench core)
+    try:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _CLIENT_PROG, str(port),
+                 str(CALL_BATCH), str(K), str(WARMUP_SECONDS), str(measure),
+                 workload],
+                env=env, cwd=repo, stdout=subprocess.PIPE, text=True)
+            for _ in range(N_CLIENTS)
+        ]
+        for p in procs:
+            out, _ = p.communicate(timeout=WARMUP_SECONDS + measure + 240)
+            for line in out.splitlines():
+                if line.startswith("CLIENT "):
+                    _, cnt, el = line.split()
+                    total += int(cnt)
+                    elapsed_max = max(elapsed_max, float(el))
+        for nm, co in srv.coalescers.items():
+            stats[nm] = co.stats()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        srv.stop()
     sps = total / elapsed_max if elapsed_max else 0.0
     fast_items = stats.get("train_raw", {}).get("item_count", 0)
     slow_items = stats.get("train", {}).get("item_count", 0)
@@ -320,9 +360,12 @@ def collect(trials: int = 2) -> dict:
     # tokenized shape and the idf variant — BOTH on the native fast path
     # since round 3 (idf rides the C++ parser with the df tables)
     text_tr = "native" if "native" in transports else "python"
-    for tag, conf in (("text", TEXT_CONF), ("text_idf", TEXT_IDF_CONF)):
+    for tag, conf, wl in (("text", TEXT_CONF, "text"),
+                          ("text_idf", TEXT_IDF_CONF, "text"),
+                          ("combo", COMBO_CONF, "numeric"),
+                          ("text_filter", TEXT_FILTER_CONF, "text")):
         try:
-            out.update(run(text_tr, workload="text", conf=conf,
+            out.update(run(text_tr, workload=wl, conf=conf,
                            measure=TEXT_MEASURE_SECONDS, tag=tag))
         except Exception as e:  # noqa: BLE001
             out[f"e2e_{tag}_error"] = repr(e)[:200]

@@ -275,6 +275,19 @@ class ConverterConfig:
             for r in (raw.get("combination_rules") or [])
         ]
 
+        # "hash_max_size": caps the hashed feature space (reference core's
+        # converter_config optional member; there hash % size, here the
+        # next power of two NOT EXCEEDING it so the [L, D] tables keep the
+        # mask-indexed layout — the memory cap the option exists for holds)
+        hms = raw.get("hash_max_size")
+        if hms is not None:
+            if not isinstance(hms, int) or hms < 16:
+                raise ConverterError(
+                    f"hash_max_size must be an int >= 16, got {hms!r}")
+            self.dim_bits: Optional[int] = hms.bit_length() - 1
+        else:
+            self.dim_bits = None
+
         # validate referenced type names exist
         for r in self.string_rules:
             if r.type_name not in self.string_types:
@@ -498,7 +511,13 @@ def make_fv_converter(
     weights: Optional[WeightManager] = None,
 ) -> DatumToFVConverter:
     """Factory mirroring core::fv_converter::make_fv_converter
-    (reference usage: jubatus/server/server/classifier_serv.cpp:110)."""
+    (reference usage: jubatus/server/server/classifier_serv.cpp:110).
+
+    A "hash_max_size" in the converter block overrides ``dim_bits`` — the
+    config is the deployment's statement of model scale, same as the
+    reference core's converter_config member."""
     config = ConverterConfig(converter_block)
+    if config.dim_bits is not None:
+        dim_bits = config.dim_bits
     hasher = FeatureHasher(dim_bits=dim_bits)
     return DatumToFVConverter(config, hasher, weights or WeightManager(hasher.dim))

@@ -55,12 +55,6 @@ class ClassifierDriver(DriverBase):
         # partitions the existing gathers/scatters/einsums; no kernel
         # changes). Orthogonal to cross-server data parallelism via the
         # mix plane (parallel/spmd.py stacks both for the pod path).
-        self._sharding = None
-        if mesh is not None:
-            from jubatus_tpu.parallel.mesh import make_feature_sharding
-
-            self._sharding = make_feature_sharding(
-                mesh, mesh_axis, dim_bits, ClassifierConfigError, rank=2)
         method = config.get("method")
         if method in _NN_METHODS:
             # instance-based classifier over the NN engine — separate driver
@@ -75,6 +69,15 @@ class ClassifierDriver(DriverBase):
         param = config.get("parameter") or {}
         self.param = float(param.get("regularization_weight", 1.0))
         self.converter = make_fv_converter(config.get("converter"), dim_bits=dim_bits)
+        # sharding derives from the converter's dim, not the dim_bits
+        # argument — a config-side "hash_max_size" overrides the latter
+        self._sharding = None
+        if mesh is not None:
+            from jubatus_tpu.parallel.mesh import make_feature_sharding
+
+            self._sharding = make_feature_sharding(
+                mesh, mesh_axis, self.converter.hasher.dim_bits,
+                ClassifierConfigError, rank=2)
         self._confidence = method in ops.CONFIDENCE_METHODS
         self._init_model()
 
@@ -254,7 +257,10 @@ class ClassifierDriver(DriverBase):
         slots_u = np.array([self._ensure_label(lb) for lb in uniq_labels],
                            dtype=np.int32)
         counts = np.bincount(label_idx, minlength=len(uniq_labels))
-        self._dcounts[slots_u] += counts[:len(slots_u)]
+        # np.add.at, not fancy-index +=: the C++ parser MAY emit duplicate
+        # uniq labels (past 256 distinct it appends without scanning), and
+        # += keeps only the last write per duplicated slot
+        np.add.at(self._dcounts, slots_u, counts[:len(slots_u)])
         return self._train_slots(slots_u[label_idx], idx, val, b)
 
     def classify(self, data: Sequence[Datum]) -> List[List[Tuple[str, float]]]:

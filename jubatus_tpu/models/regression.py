@@ -47,8 +47,11 @@ class RegressionDriver(DriverBase):
         if mesh is not None:
             from jubatus_tpu.parallel.mesh import make_feature_sharding
 
+            # converter's dim, not the dim_bits argument: a config-side
+            # "hash_max_size" overrides the latter
             self._sharding = make_feature_sharding(
-                mesh, mesh_axis, dim_bits, RegressionConfigError, rank=1)
+                mesh, mesh_axis, self.converter.hasher.dim_bits,
+                RegressionConfigError, rank=1)
         self.state = self._place(ops.init_state(self.converter.dim))
 
     def _place(self, state: ops.RegressionState) -> ops.RegressionState:

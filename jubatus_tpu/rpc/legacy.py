@@ -50,18 +50,28 @@ _SCALAR_WIDTH = {0xC0: 0, 0xC2: 0, 0xC3: 0, 0xCA: 4, 0xCB: 8, 0xCC: 1,
                  0xD3: 8}
 
 
-def scan_is_legacy(buf: bytes) -> bool:
+def scan_is_legacy(buf: bytes, budget: int = 1 << 14) -> bool:
     """Walk ONE msgpack object's type bytes without building any values:
-    True iff every type byte existed in pre-2013 msgpack (i.e. a vendored-
-    msgpack client could have produced the buffer). This is the skip-
-    style fingerprint the servers run on a connection's first request —
-    unpackb would construct a multi-megabyte object tree just to throw it
-    away on bulk train calls."""
+    True iff every type byte seen existed in pre-2013 msgpack (i.e. a
+    vendored-msgpack client could have produced the buffer). This is the
+    skip-style fingerprint the servers run per request while a connection
+    is provisionally legacy — unpackb would construct a multi-megabyte
+    object tree just to throw it away on bulk train calls.
+
+    ``budget`` caps the walk at that many type bytes; on exhaustion the
+    verdict is True ("no modern evidence so far") — sound, because a
+    vendored client can never emit a modern byte ANYWHERE, so sampling a
+    prefix can only delay a modern client's upgrade to a later (usually
+    small) request, never mislabel a legacy one. Keeps the per-request
+    cost on bulk ingest O(budget), not O(elements)."""
     b = memoryview(buf)
     n = len(b)
     i = 0
     remaining = 1  # objects still to skip
     while remaining:
+        budget -= 1
+        if budget < 0:
+            return True  # prefix shows no modern byte; cost cap reached
         if i >= n:
             return False  # truncated: not a well-formed legacy object
         t = b[i]

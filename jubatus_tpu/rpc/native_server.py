@@ -41,10 +41,13 @@ from jubatus_tpu.utils.tracing import Registry
 log = logging.getLogger(__name__)
 
 # method is POINTER(c_char), NOT c_char_p: the span is not NUL-terminated
-# (params bytes follow immediately) and c_char_p would strlen past it
+# (params bytes follow immediately) and c_char_p would strlen past it.
+# Trailing c_int32: envelope_modern — the C++ framer saw a str8 method
+# name, proof of a post-2013 client (RpcClient.call_raw's era pin).
 _REQUEST_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_uint64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_char),
-    ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64)
+    ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ctypes.c_int32)
 
 _lib_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -138,7 +141,7 @@ class NativeRpcServer:
 
     # -- C++ → Python dispatch ------------------------------------------------
     def _on_request(self, conn_id, msgid, method, method_len, params_ptr,
-                    params_len) -> None:
+                    params_len, envelope_modern) -> None:
         """Runs on the connection's C++ reader thread. Small requests
         dispatch INLINE (an executor hop measured ~35% slower for
         ping-sized sync traffic); bulk requests hop to the worker pool in
@@ -154,7 +157,8 @@ class NativeRpcServer:
         except Exception:  # noqa: BLE001 — never raise into C++
             return
         try:
-            self._dispatch(conn_id, msgid, method_name, raw)
+            self._dispatch(conn_id, msgid, method_name, raw,
+                           bool(envelope_modern))
         except Exception:  # noqa: BLE001 — never raise into C++
             log.exception("native rpc dispatch failed for %s", method_name)
 
@@ -180,22 +184,30 @@ class NativeRpcServer:
             log.exception("native rpc bulk dispatch failed for %s", method)
 
     def _dispatch(self, conn_id: int, msgid: int, method: str,
-                  raw: bytes) -> None:
+                  raw: bytes, envelope_modern: bool = False) -> None:
         conn_state = None
         if self.wire_detect and not self.legacy_wire:
             with self._wire_lock:
                 conn_state = self._conn_wire.get(conn_id)
-            if conn_state is None:
+            if conn_state is None or conn_state.get("legacy"):
                 from jubatus_tpu.rpc.server import wire_is_legacy
 
-                # the params span is a complete msgpack object; the
-                # envelope (fixints + a short fixstr method) can never
-                # carry modern type bytes, so params alone fingerprints
-                conn_state = {"legacy": wire_is_legacy(raw)}
-                with self._wire_lock:
-                    if len(self._conn_wire) >= 4096:
-                        self._conn_wire.pop(next(iter(self._conn_wire)))
-                    self._conn_wire[conn_id] = conn_state
+                # Fingerprint = envelope evidence (str8 method name — the
+                # C++ framer strips the envelope, so it reports the era
+                # pin RpcClient.call_raw relies on) OR a modern type byte
+                # in the params span. A legacy verdict stays PROVISIONAL:
+                # the connection is re-scanned until a modern byte
+                # appears (same upgrade rule as the Python transport) —
+                # only the modern verdict latches.
+                legacy = (not envelope_modern) and wire_is_legacy(raw)
+                if conn_state is None:
+                    conn_state = {"legacy": legacy}
+                    with self._wire_lock:
+                        if len(self._conn_wire) >= 4096:
+                            self._conn_wire.pop(next(iter(self._conn_wire)))
+                        self._conn_wire[conn_id] = conn_state
+                else:
+                    conn_state["legacy"] = legacy
         # raw fast path: the C++ front-end already isolated the params
         # span; registered raw handlers consume it without Python decode
         if method in self._raw_methods and msgid != self._NOTIFY:

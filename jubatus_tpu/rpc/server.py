@@ -47,8 +47,9 @@ def wire_is_legacy(raw: bytes) -> bool:
     a modern client never relied on for the jubatus API. A single modern
     type byte (str8/bin/ext) proves a modern client and pins the
     connection to the modern format. Skip-style scan — no values are
-    built, so a multi-megabyte first train call costs one type-byte
-    walk, not a throwaway decode."""
+    built, and the walk is budget-capped (scan_is_legacy), so a
+    provisionally-legacy connection pays a small bounded cost per
+    request, not an O(elements) walk of every bulk train call."""
     from jubatus_tpu.rpc import legacy as _legacy
 
     return _legacy.scan_is_legacy(raw)
@@ -320,10 +321,16 @@ class RpcServer:
         base = 0       # stream offset of buf[0]
         msg_start = 0  # stream offset of the next undelivered message
         wlock = threading.Lock()
-        #: first request fingerprints the peer's wire era (skipped when
-        #: --legacy-wire already forces every answer legacy)
+        #: requests fingerprint the peer's wire era (skipped when
+        #: --legacy-wire already forces every answer legacy). A legacy
+        #: verdict is PROVISIONAL: a modern client whose early calls are
+        #: all fixtypes (short method, small args — e.g. get_status) emits
+        #: zero post-2013 bytes, so the connection keeps being re-scanned
+        #: and upgrades to modern the first time ANY request carries a
+        #: modern type byte. Only the modern verdict latches — a vendored-
+        #: msgpack client can never send one.
         conn_state = {"legacy": False}
-        first = self.wire_detect and not self.legacy_wire
+        scanning = self.wire_detect and not self.legacy_wire
         try:
             while self._running:
                 data = conn.recv(65536)
@@ -339,9 +346,9 @@ class RpcServer:
                     end = framer.tell()
                     raw = bytes(buf[msg_start - base:end - base])
                     msg_start = end
-                    if first:
-                        first = False
+                    if scanning:
                         conn_state["legacy"] = wire_is_legacy(raw)
+                        scanning = conn_state["legacy"]
                     self._handle_raw(conn, wlock, raw, conn_state)
                 del buf[:msg_start - base]
                 base = msg_start

@@ -338,11 +338,13 @@ class Proxy:
             cluster = _peek_cluster_name(raw_params)
             if cluster is None:
                 return RAW_FALLBACK  # odd wire: generic path decides
-            self._count(name)
             self._expire_sessions()
             actives = self.members.actives(cluster)
             if not actives:
                 return RAW_FALLBACK  # generic path raises RpcNoClient
+            # counted only once we own the request: every RAW_FALLBACK
+            # re-enters the generic handler, which counts it there
+            self._count(name)
             node = random.choice(actives)
             with self._counters_lock:
                 self.forward_count += 1

@@ -200,9 +200,16 @@ bool read_str(const uint8_t*& p, const uint8_t* end,
 
 // ---------------------------------------------------------------- server
 
+// envelope_modern: 1 when the envelope itself proves a post-2013 client
+// (the method name arrived as str8 — fixraw/raw16/raw32 are the only
+// encodings a vendored-msgpack client can emit). The Python layer ORs it
+// into the wire-era fingerprint; without it, clients that deliberately
+// pin the era via a str8 method name (RpcClient.call_raw) would be
+// fingerprinted from the params span alone.
 typedef void (*request_cb)(uint64_t conn_id, uint64_t msgid,
                            const char* method, int64_t method_len,
-                           const uint8_t* params, int64_t params_len);
+                           const uint8_t* params, int64_t params_len,
+                           int32_t envelope_modern);
 
 // msgid sentinel announcing a connection CLOSED (method/params empty):
 // lets the Python side drop per-connection state (wire-era fingerprints)
@@ -282,9 +289,10 @@ const uint8_t* parse_frame(Server* s, uint64_t conn_id, const uint8_t* p,
   } else {
     return malformed();
   }
+  const int32_t envelope_modern = (q < frame_end && *q == 0xd9) ? 1 : 0;
   if (!read_str(q, frame_end, &mdata, &mlen)) return malformed();
   s->cb(conn_id, msgid, reinterpret_cast<const char*>(mdata), mlen, q,
-        frame_end - q);
+        frame_end - q, envelope_modern);
   return frame_end;
 }
 
@@ -323,7 +331,7 @@ done:
   }
   ::close(conn->fd);
   // after the fd is gone: no response can race this notification
-  s->cb(conn_id, kCloseId, "", 0, nullptr, 0);
+  s->cb(conn_id, kCloseId, "", 0, nullptr, 0, 0);
 }
 
 void accept_loop(Server* s) {

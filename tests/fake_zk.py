@@ -212,6 +212,16 @@ class FakeZkServer:
                         with self._lock:
                             sess["timer"] = t
                         t.start()
+            # a real ensemble's watches die with the connection (clients
+            # re-arm on resume); prune this conn's entries so watch-table
+            # growth in tests measures CLIENT leaks, not dead sockets
+            with self._lock:
+                for key in list(self._watches):
+                    kept = [t for t in self._watches[key] if t[0] is not conn]
+                    if kept:
+                        self._watches[key] = kept
+                    else:
+                        del self._watches[key]
             try:
                 conn.close()
             except OSError:

@@ -190,3 +190,33 @@ def test_reference_config_files_parse():
             cfg = json.load(f)
         if "converter" in cfg:
             make_fv_converter(cfg["converter"], dim_bits=10)
+
+
+def test_hash_max_size_caps_dimension():
+    """"hash_max_size" in the converter block (reference core's
+    converter_config optional member) pins the hashed feature space,
+    overriding the driver-side dim_bits default; non-power-of-two caps
+    round DOWN (the memory cap the option exists for must hold)."""
+    conv = make_fv_converter(
+        {"num_rules": [{"key": "*", "type": "num"}],
+         "hash_max_size": 1 << 14},
+        dim_bits=20)
+    assert conv.hasher.dim == 1 << 14
+    fv = conv.convert(Datum({"x": 2.0}))
+    assert all(0 < i < (1 << 14) for i, _ in fv)
+    # non-power-of-two: capped below, never above
+    conv2 = make_fv_converter({"hash_max_size": 1000})
+    assert conv2.hasher.dim == 512
+    with pytest.raises(ConverterError):
+        make_fv_converter({"hash_max_size": 4})
+
+
+def test_hash_max_size_flows_through_driver():
+    from jubatus_tpu.models.classifier import ClassifierDriver
+
+    d = ClassifierDriver(
+        {"method": "PA", "parameter": {"regularization_weight": 1.0},
+         "converter": {"num_rules": [{"key": "*", "type": "num"}],
+                       "hash_max_size": 1 << 12}})
+    assert d.converter.dim == 1 << 12
+    assert d.state.w.shape[-1] == 1 << 12

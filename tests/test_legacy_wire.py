@@ -173,6 +173,106 @@ def test_autodetect_pins_modern_connection_modern(tmp_path):
         srv.stop()
 
 
+def _read_one_frame(sock, buf=b""):
+    """Accumulate bytes until one complete msgpack object; returns
+    (frame_bytes, leftover)."""
+    while True:
+        if buf:
+            u = msgpack.Unpacker()
+            u.feed(buf)
+            try:
+                u.skip()
+                end = u.tell()
+                return buf[:end], buf[end:]
+            except msgpack.OutOfData:
+                pass
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("server closed")
+        buf += chunk
+
+
+def _frame_is_legacy_format(frame: bytes) -> bool:
+    try:
+        legacy.unpackb(frame)
+        return True
+    except legacy.LegacyFormatError:
+        return False
+
+
+@pytest.mark.parametrize("transport", ["python", "native"])
+def test_autodetect_upgrades_on_later_modern_byte(tmp_path, monkeypatch,
+                                                  transport):
+    """A modern client whose FIRST call is all-fixtype (short method, tiny
+    args — zero post-2013 bytes) must not be latched legacy forever: the
+    first request that does carry a modern type byte upgrades the
+    connection, and it stays modern afterwards (ADVICE r3). Both
+    transports share the rule."""
+    if transport == "native":
+        from jubatus_tpu.rpc import native_server
+        if not native_server.available():
+            pytest.skip("native rpc front-end unavailable")
+    monkeypatch.setenv("JUBATUS_TPU_NATIVE_RPC",
+                       "1" if transport == "native" else "0")
+    srv = EngineServer(
+        "classifier", CLASSIFIER_CONF,
+        args=ServerArgs(engine="classifier", datadir=str(tmp_path)))
+    port = srv.start(0)
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    buf = b""
+    try:
+        # 1: a modern client's small first call — indistinguishable from
+        # legacy on the wire, so the response is (provisionally) legacy
+        sock.sendall(msgpack.packb([0, 1, "get_config", ["m"]],
+                                   use_bin_type=True))
+        frame, buf = _read_one_frame(sock, buf)
+        assert _frame_is_legacy_format(frame)
+        # 2: a later call carries str8 — proof of a modern client; the
+        # connection upgrades and answers modern
+        sock.sendall(msgpack.packb([0, 2, "get_config", ["m" * 40]],
+                                   use_bin_type=True))
+        frame, buf = _read_one_frame(sock, buf)
+        assert not _frame_is_legacy_format(frame)
+        # 3: modern latches: an all-fixtype request no longer downgrades
+        sock.sendall(msgpack.packb([0, 3, "get_config", ["m"]],
+                                   use_bin_type=True))
+        frame, buf = _read_one_frame(sock, buf)
+        assert not _frame_is_legacy_format(frame)
+    finally:
+        sock.close()
+        srv.stop()
+
+
+def test_native_str8_envelope_pins_modern(tmp_path, monkeypatch):
+    """RpcClient.call_raw pins pooled proxy->backend connections modern by
+    encoding the METHOD name as str8. The C++ front-end strips the
+    envelope before Python sees the request, so it must forward the
+    envelope's era evidence explicitly (ADVICE r3: without it, a legacy
+    client's relayed first frame latches the pooled connection legacy)."""
+    from jubatus_tpu.rpc import native_server
+    if not native_server.available():
+        pytest.skip("native rpc front-end unavailable")
+    monkeypatch.setenv("JUBATUS_TPU_NATIVE_RPC", "1")
+    srv = EngineServer(
+        "classifier", CLASSIFIER_CONF,
+        args=ServerArgs(engine="classifier", datadir=str(tmp_path)))
+    port = srv.start(0)
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        # hand-built call_raw wire shape: [0, msgid, str8-method, params]
+        # where the params span itself is pure legacy bytes
+        m = b"get_config"
+        req = (b"\x94\x00\x01\xd9" + bytes([len(m)]) + m
+               + msgpack.packb(["m"], use_bin_type=False))
+        sock.sendall(req)
+        frame, _ = _read_one_frame(sock)
+        assert not _frame_is_legacy_format(frame), \
+            "str8 envelope must pin the native-transport connection modern"
+    finally:
+        sock.close()
+        srv.stop()
+
+
 def test_modern_mode_emits_str8_legacy_rejects():
     """Sanity: without --legacy-wire the same response DOES contain type
     bytes the old unpacker rejects (else the test above proves nothing)."""

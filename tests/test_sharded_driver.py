@@ -101,9 +101,13 @@ def test_sharded_save_load_roundtrip(mesh, rng, tmp_path):
         [s for _, s in shard2.classify(q)[0]], rtol=1e-5, atol=1e-6)
 
 
-def test_indivisible_dim_rejected(mesh):
+def test_indivisible_dim_rejected():
+    import jax
+    from jax.sharding import Mesh
+
+    mesh3 = Mesh(np.array(jax.devices()[:3]), axis_names=("shard",))
     with pytest.raises(ClassifierConfigError, match="not divisible"):
-        ClassifierDriver(CONF, dim_bits=2, mesh=mesh)  # 4 features / 8 devs
+        ClassifierDriver(CONF, dim_bits=4, mesh=mesh3)  # 16 features / 3 devs
 
 
 def test_server_level_shard_devices(rng):
