@@ -281,9 +281,16 @@ if pid == 0:
          f"collective_round{tag}_note": f"{n} jax.distributed {plat} "
          "processes; orchestration+psum cost, not interconnect bandwidth"}),
         flush=True)
+    # explicit completion marker (SIBLING of the coordinator dir — the
+    # file coordinator owns everything inside): peers must NOT key off
+    # model_version — failed warmup attempts still run RPC-fallback
+    # rounds that bump it, and a peer leaving early tears its listener
+    # down under the master's next fan-out
+    open(coord_dir.rstrip("/") + ".done", "w").close()
 else:
+    done = coord_dir.rstrip("/") + ".done"
     while time.time() < deadline:
-        if srv.mixer.model_version >= 2:
+        if os.path.exists(done):
             break
         time.sleep(0.2)
 c.close()
@@ -343,6 +350,10 @@ def run_jax_world(child_src: str, n: int, timeout: float = 300.0,
                 p.kill()
                 p.wait()
         shutil.rmtree(coord_dir, ignore_errors=True)
+        try:  # the children's sibling completion marker
+            os.unlink(coord_dir.rstrip("/") + ".done")
+        except OSError:
+            pass
 
 
 def collective_nproc(n: int = 4, dim_bits: int = 0,

@@ -424,8 +424,20 @@ class _ClassifierMixable:
         self._d = driver
 
     def get_diff(self):
-        diff = ops.get_diff(self._d.state)
-        diff["label_counts"] = self._d._dcounts.copy()
+        d = self._d
+        diff = ops.get_diff(d.state)
+        # ship only the ACTIVE label rows: the [capacity, D] tables are
+        # pow2-padded (and capacities can diverge across replicas after
+        # deletes), while the slot assignment is cluster-identical after
+        # the round's schema sync — [n, D] is the same shape everywhere
+        # and cuts the wire 4x at the bench shape (8-slot capacity, 2
+        # labels). n = highest slot in use + 1, NOT len(labels): the
+        # labels list is ""-padded to capacity by sync_schema. Slicing
+        # clamps the (1, 1) no-confidence placeholders untouched.
+        n = max(d.label_slots.values(), default=0) + 1
+        if n < diff["dw"].shape[0]:
+            diff = dict(diff, dw=diff["dw"][:n], dprec=diff["dprec"][:n])
+        diff["label_counts"] = d._dcounts[:n].copy()
         return diff
 
     def put_diff(self, diff) -> bool:
@@ -435,7 +447,8 @@ class _ClassifierMixable:
         d.state = ops.put_diff(d.state, array_diff)
         counts = diff.get("label_counts")
         if counts is not None:
-            d.label_counts = d.label_counts + np.asarray(counts)
+            counts = np.asarray(counts)
+            d.label_counts[:len(counts)] += counts
             d._dcounts[:] = 0.0
         return True
 

@@ -95,15 +95,35 @@ def spec_from_converter_config(conv: dict) -> Optional[str]:
     """Compile a converter config into the C++ rule spec, or None when the
     config needs features the native parser does not implement (STRING
     filters, user "weight" global weights, plugins, regexp splitters,
-    combinations, binary rules) — the caller then stays on the Python
-    converter. num filters, ngram splitters, and idf global weights all
-    compile to the native spec since round 3."""
+    binary rules) — the caller then stays on the Python converter. num
+    filters, ngram splitters, and idf global weights compile to the
+    native spec since round 3; combination rules (mul/add over the named
+    cross product) since round 4, except combined with idf."""
     if not isinstance(conv, dict):
         return None
-    for k in ("string_filter_rules", "binary_rules",
-              "combination_rules", "binary_types"):
+    for k in ("string_filter_rules", "binary_rules", "binary_types"):
         if conv.get(k):
             return None
+    combo_lines: List[str] = []
+    if conv.get("combination_rules"):
+        kinds = {"mul": "mul", "add": "add"}
+        for tname, params in (conv.get("combination_types") or {}).items():
+            m = (params or {}).get("method")
+            kinds[tname] = m if m in ("mul", "add") else None
+        for r in conv.get("combination_rules"):
+            kind = kinds.get(r.get("type"))
+            if kind is None:
+                return None
+            kl, kr = r.get("key_left", "*"), r.get("key_right", "*")
+            if any("\t" in k or "\n" in k for k in (kl, kr)):
+                return None
+            combo_lines.append(f"combo\t{kind}\t{kl}\t{kr}")
+        # combos run over pre-hash NAMES; idf weights hashed indices —
+        # composing both stays on the Python converter (C++ create also
+        # refuses, belt and suspenders)
+        for r in conv.get("string_rules") or []:
+            if r.get("global_weight") == "idf":
+                return None
     # num filters: pure-math transforms appending (key+suffix, f(value)) —
     # expressible in C++ since round 3. Param validity (max > min, std > 0)
     # is the converter's job at server start; unknown methods decline.
@@ -178,7 +198,7 @@ def spec_from_converter_config(conv: dict) -> Optional[str]:
                      f"{r.get('key', '*')}")
     if not lines:
         return None
-    lines = nf_lines + lines  # filters are declared ahead of rules
+    lines = nf_lines + lines + combo_lines  # filters first, combos last
     for ln in lines:  # keys with separators would corrupt the spec
         if "\n" in ln.replace("\t", " ") or ln.count("\t") > 5:
             return None

@@ -398,11 +398,17 @@ def mix_diffs(lhs, rhs):
 def put_diff(state: ClassifierState, diff) -> ClassifierState:
     """Absorb the summed cross-replica diff into the master (average weights,
     sum precision — precision is additive information like the reference's
-    confidence merge) and reset local diffs."""
+    confidence merge) and reset local diffs.
+
+    Accepts ROW-TRIMMED diffs: the mix plane ships only the active label
+    rows ([n_labels, D], not the pow2-padded [capacity, D] tables — a 4x
+    wire cut at the bench shape), applied here to the leading rows; a
+    full-shape diff is the n == capacity case of the same update."""
     n = jnp.maximum(diff["count"], 1.0)
+    rows = diff["dw"].shape[0]
     return ClassifierState(
-        w=state.w + diff["dw"] / n,
+        w=state.w.at[:rows].add(diff["dw"] / n),
         dw=jnp.zeros_like(state.dw),
-        prec=state.prec + diff["dprec"],
+        prec=state.prec.at[:diff["dprec"].shape[0]].add(diff["dprec"]),
         dprec=jnp.zeros_like(state.dprec),
     )

@@ -57,10 +57,39 @@ class Mixable(Protocol):
 
 def tree_sum(diffs: Sequence[Any]) -> Any:
     """Host-side fold of diff pytrees (the reference's pairwise fold —
-    associative here, so order is irrelevant)."""
+    associative here, so order is irrelevant).
+
+    Leaves whose LEADING dimension disagrees are zero-padded to the
+    larger row count before adding: row-trimmed label diffs
+    (models/classifier.py _ClassifierMixable) can legitimately differ by
+    a row when a replica trained a novel label between the round's
+    schema sync and its get_diff — the pad reproduces the old
+    full-capacity semantics (absent rows contribute zeros) instead of
+    aborting the round on a shape error."""
+
+    def add(a, b):
+        an = getattr(a, "shape", None)
+        bn = getattr(b, "shape", None)
+        if an and bn and len(an) == len(bn) and an != bn and \
+                an[1:] == bn[1:]:
+            import numpy as _np
+
+            rows = max(an[0], bn[0])
+            if an[0] < rows:
+                a = _np.concatenate(
+                    [_np.asarray(a),
+                     _np.zeros((rows - an[0],) + tuple(an[1:]),
+                               _np.asarray(a).dtype)])
+            if bn[0] < rows:
+                b = _np.concatenate(
+                    [_np.asarray(b),
+                     _np.zeros((rows - bn[0],) + tuple(bn[1:]),
+                               _np.asarray(b).dtype)])
+        return a + b
+
     acc = diffs[0]
     for d in diffs[1:]:
-        acc = jax.tree_util.tree_map(lambda a, b: a + b, acc, d)
+        acc = jax.tree_util.tree_map(add, acc, d)
     return acc
 
 

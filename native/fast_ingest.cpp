@@ -14,8 +14,10 @@
 //
 // Supported converter subset (service.py checks eligibility and falls
 // back to the Python converter otherwise): num rules {num, log, str},
-// string rules with {str, space} splitters, sample_weight {bin, tf,
-// log_tf}, global_weight bin; no filters, no combinations, no plugins.
+// num filters, string rules with {str, space, ngram} splitters,
+// sample_weight {bin, tf, log_tf}, global_weight {bin, idf}, and
+// combination rules (mul/add; not combinable with idf); no string
+// filters, no "weight" global weight, no plugins.
 // Semantics mirror core/fv/converter.py: feature names
 //   "<key>@<type>"                      (num/log)
 //   "<key>$<fmt(value)>@<type>"         (num str)
@@ -27,6 +29,8 @@
 //   void* jt_ingest_create(const char* spec)   rules, one per line:
 //       "num\t<kind>\t<pattern>"
 //       "str\t<splitter>\t<sample_weight>\t<global_weight>\t<type>\t<pattern>"
+//       "nf\t<kind>\t<a>\t<b>\t<pattern>\t<suffix>"
+//       "combo\t<mul|add>\t<key_left>\t<key_right>"
 //   int jt_ingest_parse(handle, buf, len, mask, JtIngestOut*)  0 = ok
 //   void jt_ingest_free_out(JtIngestOut*)       frees the arrays
 //   void jt_ingest_destroy(handle)
@@ -154,10 +158,19 @@ struct NumFilter {
   }
 };
 
+struct ComboRule {
+  // ≙ converter.py combination rules: the cross product of the example's
+  // NAMED features (pre-hash), each unordered pair once in canonical
+  // name order, value mul/add, name "<a>&<b>"
+  enum Op { MUL, ADD } op = MUL;
+  Matcher left, right;
+};
+
 struct Parser {
   std::vector<NumFilter> num_filters;
   std::vector<NumRule> num_rules;
   std::vector<StrRule> str_rules;
+  std::vector<ComboRule> combos;
 };
 
 // ---- minimal msgpack reader (modern + legacy raw families) -------------
@@ -633,11 +646,34 @@ void* jt_ingest_create(const char* spec) {
       r.suffix = "@" + f[4] + "#" + f[2] + "/" + f[3];
       r.m = Matcher::make(f[5]);
       ps->str_rules.push_back(std::move(r));
+    } else if (f[0] == "combo" && f.size() == 4) {
+      // "combo\t<mul|add>\t<key_left>\t<key_right>"
+      ComboRule cr;
+      if (f[1] == "mul")
+        cr.op = ComboRule::MUL;
+      else if (f[1] == "add")
+        cr.op = ComboRule::ADD;
+      else {
+        delete ps;
+        return nullptr;
+      }
+      cr.left = Matcher::make(f[2]);
+      cr.right = Matcher::make(f[3]);
+      ps->combos.push_back(std::move(cr));
     } else {
       delete ps;
       return nullptr;
     }
   }
+  // combos iterate the pre-hash NAMED features; the idf path weights
+  // hashed indices pre-merge — composing them here would need the full
+  // name->weight pipeline, so such specs stay on the Python converter
+  if (!ps->combos.empty())
+    for (const StrRule& r : ps->str_rules)
+      if (r.idf) {
+        delete ps;
+        return nullptr;
+      }
   return ps;
 }
 
@@ -729,7 +765,25 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
   std::vector<PosEntry> poscache;
   size_t pos_stride = 0;  // kv slots per rule; grows to max nnv seen
 
-  auto emit = [&](const std::string& nm, double v, bool idf = false) {
+  // combo mode: features accumulate by NAME first (converter.py
+  // _named_features dict), the combination cross product runs over that
+  // map, and only then is everything hashed. The term/pos memos are
+  // bypassed (they exist to skip name assembly, which combos need).
+  const bool combo_mode = !ps.combos.empty();
+  std::vector<std::pair<std::string, double>> named;  // insertion order
+  std::unordered_map<std::string, size_t> named_ix;
+
+  auto add_named = [&](const std::string& nm, double v) {
+    auto it = named_ix.find(nm);
+    if (it == named_ix.end()) {
+      named_ix.emplace(nm, named.size());
+      named.push_back({nm, v});
+    } else {
+      named[it->second].second += v;
+    }
+  };
+
+  auto hash_push = [&](const std::string& nm, double v, bool idf) {
     uint32_t c = crc32_update(0xFFFFFFFFu,
                               reinterpret_cast<const uint8_t*>(nm.data()),
                               nm.size()) ^
@@ -737,6 +791,13 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
     uint32_t i = c & mask;
     if (i == 0) i = 1;  // padding slot is reserved
     feats.push_back({int32_t(i), v, uint8_t(idf)});
+  };
+
+  auto emit = [&](const std::string& nm, double v, bool idf = false) {
+    if (combo_mode)
+      add_named(nm, v);  // idf+combos declined at create
+    else
+      hash_push(nm, v, idf);
   };
 
   for (int64_t e = 0; e < n; ++e) {
@@ -817,6 +878,10 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
     // earlier filters' output, exactly like the Python loop. Appended
     // keys live in a deque (stable addresses) for the whole parse call.
     key_arena.clear();  // per-example scratch (cache entries own copies)
+    if (combo_mode) {
+      named.clear();
+      named_ix.clear();
+    }
     for (const NumFilter& nf : ps.num_filters) {
       size_t cur = nvs.size();
       for (size_t fi = 0; fi < cur; ++fi) {
@@ -923,13 +988,16 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
           double sw = r.sw == StrRule::BIN  ? 1.0
                       : r.sw == StrRule::TF ? double(tf)
                                             : std::log(1.0 + tf);
-          lookup_key.resize(prefix_len);
-          lookup_key.append(reinterpret_cast<const char*>(terms[di].first),
-                            terms[di].second);
-          auto it = memo.find(lookup_key);
-          if (it != memo.end()) {
-            feats.push_back({it->second, sw, uint8_t(r.idf)});
-            continue;
+          if (!combo_mode) {
+            lookup_key.resize(prefix_len);
+            lookup_key.append(
+                reinterpret_cast<const char*>(terms[di].first),
+                terms[di].second);
+            auto it = memo.find(lookup_key);
+            if (it != memo.end()) {
+              feats.push_back({it->second, sw, uint8_t(r.idf)});
+              continue;
+            }
           }
           name.assign(reinterpret_cast<const char*>(key), keyn);
           name += '$';
@@ -937,7 +1005,7 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
                       terms[di].second);
           name += r.suffix;
           emit(name, sw, r.idf);
-          if (memo.size() < (1u << 16))
+          if (!combo_mode && memo.size() < (1u << 16))
             memo.emplace(lookup_key, feats.back().idx);
         }
       }
@@ -956,7 +1024,7 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
         const uint8_t* key = kv.first.first;
         size_t keyn = kv.first.second;
         PosEntry& pe = row[ki];
-        if (pe.state >= 0 && pe.key.size() == keyn &&
+        if (!combo_mode && pe.state >= 0 && pe.key.size() == keyn &&
             0 == memcmp(pe.key.data(), key, keyn)) {
           switch (pe.state) {
             case 0:
@@ -984,7 +1052,8 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
             name += r.at_type;
             emit(name, pe.state == 1 ? kv.second
                                      : std::log(std::max(1.0, kv.second)));
-            pe.idx = feats.back().idx;  // emit() owns the name->index rule
+            if (!combo_mode)  // emit() owns the name->index rule
+              pe.idx = feats.back().idx;
             continue;
           }
         }
@@ -997,6 +1066,54 @@ static int parse_impl(void* h, const uint8_t* buf, int64_t len,
         name += r.at_type;
         emit(name, 1.0);
       }
+    }
+
+    // combinations (converter.py:412-432): cross product over the BASE
+    // named-feature snapshot, each unordered pair once per rule in
+    // canonical (bytewise == codepoint) name order, "<a>&<b>", values
+    // accumulating into the same name map; then hash everything
+    if (combo_mode) {
+      size_t base_n = named.size();
+      // frozen base values (Python's `base = list(features.items())`
+      // snapshot): a combined name colliding with a base name must not
+      // change later pairs' inputs
+      std::vector<double> base_val(base_n);
+      for (size_t i2 = 0; i2 < base_n; ++i2) base_val[i2] = named[i2].second;
+      std::string cname;
+      for (const ComboRule& cr : ps.combos) {
+        auto lm = [&](size_t i2) {
+          const std::string& s2 = named[i2].first;
+          return cr.left.match(
+              reinterpret_cast<const uint8_t*>(s2.data()), s2.size());
+        };
+        auto rm = [&](size_t i2) {
+          const std::string& s2 = named[i2].first;
+          return cr.right.match(
+              reinterpret_cast<const uint8_t*>(s2.data()), s2.size());
+        };
+        for (size_t li = 0; li < base_n; ++li) {
+          if (!lm(li)) continue;
+          for (size_t ri = 0; ri < base_n; ++ri) {
+            if (li == ri || !rm(ri)) continue;
+            // once per unordered pair per rule WITHOUT a seen-set (an
+            // allocating tree insert per candidate pair would dominate
+            // the hot path): each pair is visited at most twice; emit on
+            // the canonical visit, or on either visit when the mirror
+            // does not qualify. Values are symmetric (mul/add).
+            if (li > ri && lm(ri) && rm(li)) continue;
+            double cval = cr.op == ComboRule::MUL
+                              ? base_val[li] * base_val[ri]
+                              : base_val[li] + base_val[ri];
+            size_t a = li, b = ri;
+            if (named[b].first < named[a].first) std::swap(a, b);
+            cname = named[a].first;
+            cname += '&';
+            cname += named[b].first;
+            add_named(cname, cval);
+          }
+        }
+      }
+      for (const auto& nv : named) hash_push(nv.first, nv.second, false);
     }
 
     // idf (converter.py convert(): observe distinct indices, then scale,

@@ -188,3 +188,20 @@ def test_concurrent_train_and_mix_thread_safety():
     # both replicas converged to the same schema
     group.mix()
     assert ds[0].get_schema() == ds[1].get_schema()
+
+
+def test_tree_sum_pads_row_trimmed_diffs():
+    """Row-trimmed label diffs can differ by a row when a replica trains
+    a novel label between schema sync and get_diff; the fold zero-pads
+    to the larger row count instead of aborting the round."""
+    import numpy as np
+
+    from jubatus_tpu.parallel.mix import tree_sum
+
+    a = {"dw": np.ones((2, 4), np.float32), "count": np.float32(1.0)}
+    b = {"dw": np.full((3, 4), 2.0, np.float32), "count": np.float32(1.0)}
+    tot = tree_sum([a, b])
+    assert tot["dw"].shape == (3, 4)
+    np.testing.assert_allclose(tot["dw"][:2], 3.0)
+    np.testing.assert_allclose(tot["dw"][2], 2.0)
+    assert float(tot["count"]) == 2.0

@@ -135,11 +135,17 @@ def test_spec_rejects_unsupported_configs():
         "num_rules": [{"key": "*", "type": "num"}],
         "num_filter_rules": [{"key": "*", "type": "x", "suffix": "y"}],
     }) is None
-    # combination rules compose features
+    # combination rules ARE supported since round 4 (named cross product
+    # in C++); unknown combination methods still decline
     assert ingest.spec_from_converter_config({
         "num_rules": [{"key": "*", "type": "num"}],
         "combination_rules": [{"key_left": "*", "key_right": "*",
-                               "type": "mul"}]}) is None
+                               "type": "mul"}]}) is not None
+    assert ingest.spec_from_converter_config({
+        "num_rules": [{"key": "*", "type": "num"}],
+        "combination_types": {"odd": {"method": "concat"}},
+        "combination_rules": [{"key_left": "*", "key_right": "*",
+                               "type": "odd"}]}) is None
     # ngram IS supported since round 3 (utf-8 code-point slicing in C++);
     # regexp splitters still are not
     assert ingest.spec_from_converter_config({
@@ -664,3 +670,71 @@ def test_sigmoid_overflow_falls_back_like_python_raises():
     assert p.parse(bad) is None     # exp(750) overflows -> decline
     with pytest.raises(OverflowError):
         pyconv.convert(Datum({"k": -500.0}))
+
+
+COMBO_CONV = {
+    "string_rules": [
+        {"key": "*", "type": "str", "sample_weight": "bin",
+         "global_weight": "bin"},
+    ],
+    "num_rules": [{"key": "*", "type": "num"}],
+    "combination_rules": [
+        {"key_left": "*", "key_right": "*", "type": "mul"},
+    ],
+}
+
+
+def test_parity_combination_rules():
+    """The reference's arow_combinational_feature.json converter block
+    rides the fast path bit-identically (VERDICT r3 item 6): cross
+    product over named features, canonical pair order, mul values."""
+    spec = ingest.spec_from_converter_config(COMBO_CONV)
+    assert spec is not None and "combo\tmul" in spec
+    p = ingest.IngestParser(spec, 20)
+    pyconv = make_fv_converter(COMBO_CONV, dim_bits=20)
+    rng = random.Random(11)
+    data = [("l%d" % rng.randint(0, 2), _rand_datum(rng))
+            for _ in range(200)]
+    raw = msgpack.packb(["c", [[l, d.to_msgpack()] for l, d in data]])
+    labels, idx, val = p.parse(raw)
+    for i, (l, d) in enumerate(data):
+        assert labels[i] == l
+        assert _got(idx[i], val[i]) == _expected(pyconv, d), (i, l)
+
+
+def test_parity_combination_add_and_matchers():
+    conv = {
+        "string_rules": [
+            {"key": "s*", "type": "space", "sample_weight": "tf",
+             "global_weight": "bin"},
+        ],
+        "num_rules": [{"key": "*", "type": "num"}],
+        "combination_types": {"plus": {"method": "add"}},
+        "combination_rules": [
+            {"key_left": "*@num", "key_right": "*", "type": "plus"},
+            {"key_left": "s*", "key_right": "*#tf/bin", "type": "mul"},
+        ],
+    }
+    spec = ingest.spec_from_converter_config(conv)
+    assert spec is not None
+    p = ingest.IngestParser(spec, 18)
+    pyconv = make_fv_converter(conv, dim_bits=18)
+    rng = random.Random(13)
+    data = [("x", _rand_datum(rng)) for _ in range(200)]
+    raw = msgpack.packb(["c", [[l, d.to_msgpack()] for l, d in data]])
+    _labels, idx, val = p.parse(raw)
+    for i, (_l, d) in enumerate(data):
+        assert _got(idx[i], val[i]) == _expected(pyconv, d), i
+
+
+def test_combo_with_idf_declines():
+    conv = {
+        "string_rules": [
+            {"key": "*", "type": "space", "sample_weight": "tf",
+             "global_weight": "idf"},
+        ],
+        "combination_rules": [
+            {"key_left": "*", "key_right": "*", "type": "mul"},
+        ],
+    }
+    assert ingest.spec_from_converter_config(conv) is None
