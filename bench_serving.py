@@ -179,9 +179,20 @@ print(f"CLIENT {count} {elapsed:.4f}")
 """
 
 
+def _default_microbatch() -> int:
+    """Flush-size cap by platform: on a real chip big flushes amortize
+    the tunnel round trip (the kernel's sweet spot is 32k,
+    docs/PERF_NOTES.md); on the CPU fallback the device step runs ON the
+    single bench core, so a big flush starves the loadgen (measured:
+    cap 32k = 113k samples/s vs cap 8k = 145k, same shape otherwise)."""
+    import jax
+
+    return 32768 if jax.default_backend() != "cpu" else 8192
+
+
 def run(transport: str = "python", workload: str = "numeric",
         conf: dict = CONF, measure: float = MEASURE_SECONDS,
-        tag: str = "") -> dict:
+        tag: str = "", microbatch: int = 0) -> dict:
     from jubatus_tpu.server import EngineServer
     from jubatus_tpu.server.args import ServerArgs
 
@@ -193,7 +204,9 @@ def run(transport: str = "python", workload: str = "numeric",
         srv = EngineServer(
             "classifier", conf,
             args=ServerArgs(engine="classifier", thread=N_CLIENTS,
-                            listen_addr="127.0.0.1"))
+                            listen_addr="127.0.0.1",
+                            microbatch_max=microbatch
+                            or _default_microbatch()))
         port = srv.start(0)
     finally:
         if prev is None:
@@ -281,8 +294,14 @@ def run_proxy(transport: str = "python",
                             interval_count=1 << 30),
             coord=MemoryCoordinator(store))
         srv.start(0)
+        # interconnect timeout must cover the backend's coalescer wait
+        # (train blocks until its flush; the server grants timeout*6):
+        # the default 10 s intermittently fires under full pipelining on
+        # the one-core host, failing the whole trial with a timeout the
+        # raw relay correctly refuses to retry (double-apply risk)
         proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1",
-                                thread=N_CLIENTS),
+                                thread=N_CLIENTS,
+                                interconnect_timeout=120.0),
                       coord=MemoryCoordinator(store))
         pport = proxy.start(0)
         if prev is None:
@@ -333,9 +352,12 @@ def collect(trials: int = 2) -> dict:
     spread through the device tunnel is ~±10% (host scheduling + tunnel
     latency), so a single-shot A/B regularly inverts. Alternating A/B/A/B
     in one process and comparing per-transport bests keeps the comparison
-    honest without tripling the wall clock."""
+    honest without tripling the wall clock. The proxy RATIO is computed
+    from MEDIANS of both sides (direct's spread on the shared core is
+    ±12%; a best-vs-best ratio would be a race between two maxima)."""
     out = {"e2e_clients": N_CLIENTS, "e2e_call_batch": CALL_BATCH,
-           "e2e_features_per_datum": K}
+           "e2e_features_per_datum": K,
+           "e2e_microbatch_max": _default_microbatch()}
     transports = ["python"]
     try:
         from jubatus_tpu.rpc import native_server
@@ -345,6 +367,7 @@ def collect(trials: int = 2) -> dict:
     except Exception as e:  # noqa: BLE001
         out["e2e_native_error"] = repr(e)[:200]
     best: dict = {}
+    direct_runs: list = []
     for t in range(trials):
         for tr in transports:
             try:
@@ -353,6 +376,8 @@ def collect(trials: int = 2) -> dict:
                 out[f"e2e_{tr}_error"] = repr(e)[:200]  # a dead bench
                 continue
             key = f"e2e_rpc_train_samples_per_sec_{tr}"
+            if tr == transports[-1]:
+                direct_runs.append(r[key])
             if key not in best or r[key] > best[key]:
                 best.update(r)
     out.update(best)
@@ -376,21 +401,38 @@ def collect(trials: int = 2) -> dict:
                        measure=TEXT_MEASURE_SECONDS))
     except Exception as e:  # noqa: BLE001
         out["e2e_classify_error"] = repr(e)[:200]
-    # proxy tier: same numeric workload through the proxy hop (best of
-    # `trials`, symmetric with the direct metric's best-of selection)
+    # proxy tier: same numeric workload through the proxy hop. The
+    # REPORTED key stays best-of (symmetric with direct), but the ratio
+    # uses median-vs-median over >= 3 runs each: the direct side alone
+    # swings ~±12% run to run on the shared core, and a ratio of two
+    # bests is a race between maxima, not a comparison.
+    import numpy as _np
+
     pkey = f"e2e_rpc_train_samples_per_sec_proxy_{text_tr}"
-    for _ in range(trials):
+    proxy_runs: list = []
+    for _ in range(max(trials, 3)):
         try:
             r = run_proxy(text_tr)
         except Exception as e:  # noqa: BLE001
             out["e2e_proxy_error"] = repr(e)[:200]
             continue
+        proxy_runs.append(r.get(pkey, 0))
         if r.get(pkey, 0) > out.get(pkey, 0):
             out.update(r)
-    direct = out.get(f"e2e_rpc_train_samples_per_sec_{text_tr}")
-    via = out.get(pkey)
-    if direct and via:
-        out["e2e_proxy_vs_direct"] = round(via / direct, 3)
+    while len(direct_runs) < 3:
+        try:
+            direct_runs.append(run(text_tr)[
+                f"e2e_rpc_train_samples_per_sec_{text_tr}"])
+        except Exception as e:  # noqa: BLE001
+            out[f"e2e_{text_tr}_error"] = repr(e)[:200]
+            break
+    if proxy_runs and direct_runs:
+        med_d = float(_np.median(direct_runs))
+        med_p = float(_np.median(proxy_runs))
+        out["e2e_proxy_vs_direct"] = round(med_p / med_d, 3)
+        out["e2e_proxy_vs_direct_note"] = (
+            f"median of {len(proxy_runs)} proxy vs {len(direct_runs)} "
+            f"direct runs")
     return out
 
 

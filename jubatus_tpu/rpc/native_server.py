@@ -80,6 +80,13 @@ def _load_lib() -> Optional[ctypes.CDLL]:
         lib.jt_rpc_stop.argtypes = [ctypes.c_void_p]
         lib.jt_rpc_destroy.restype = None
         lib.jt_rpc_destroy.argtypes = [ctypes.c_void_p]
+        lib.jt_rpc_relay_config.restype = ctypes.c_int
+        lib.jt_rpc_relay_config.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_double]
+        lib.jt_rpc_relay_stats.restype = ctypes.c_int64
+        lib.jt_rpc_relay_stats.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -120,8 +127,12 @@ class NativeRpcServer:
         #: slower for ping-sized sync traffic).
         from concurrent.futures import ThreadPoolExecutor
 
+        # 64, not 32: a PROXY's bulk handler BLOCKS its worker on the
+        # backend round trip (call_raw), so the pool must cover the full
+        # in-flight depth (16 pipelined clients x depth 4) or pipelining
+        # silently halves at the relay tier; blocked threads are cheap
         self._bulk_pool = ThreadPoolExecutor(
-            max_workers=32, thread_name_prefix="native-rpc-bulk")
+            max_workers=64, thread_name_prefix="native-rpc-bulk")
         self._lib = _load_lib()
         if self._lib is None:
             raise RuntimeError("native rpc front-end unavailable (no g++?)")
@@ -196,18 +207,24 @@ class NativeRpcServer:
                 # C++ framer strips the envelope, so it reports the era
                 # pin RpcClient.call_raw relies on) OR a modern type byte
                 # in the params span. A legacy verdict stays PROVISIONAL:
-                # the connection is re-scanned until a modern byte
-                # appears (same upgrade rule as the Python transport) —
-                # only the modern verdict latches.
-                legacy = (not envelope_modern) and wire_is_legacy(raw)
+                # the connection keeps being re-scanned — every small
+                # request, power-of-2-numbered bulk ones (an every-request
+                # scan of pipelined bulk traffic measured a ~3x e2e hit;
+                # same sampling as the Python transport) — and only the
+                # modern verdict latches.
                 if conn_state is None:
-                    conn_state = {"legacy": legacy}
+                    conn_state = {"legacy": (not envelope_modern)
+                                  and wire_is_legacy(raw), "nreq": 1}
                     with self._wire_lock:
                         if len(self._conn_wire) >= 4096:
                             self._conn_wire.pop(next(iter(self._conn_wire)))
                         self._conn_wire[conn_id] = conn_state
+                elif envelope_modern:
+                    conn_state["legacy"] = False
                 else:
-                    conn_state["legacy"] = legacy
+                    nreq = conn_state["nreq"] = conn_state.get("nreq", 1) + 1
+                    if len(raw) <= 1024 or (nreq & (nreq - 1)) == 0:
+                        conn_state["legacy"] = wire_is_legacy(raw)
         # raw fast path: the C++ front-end already isolated the params
         # span; registered raw handlers consume it without Python decode
         if method in self._raw_methods and msgid != self._NOTIFY:
@@ -236,6 +253,41 @@ class NativeRpcServer:
             msgid, error, result,
             legacy=self.response_legacy(method, conn_state))
         self._lib.jt_rpc_respond(self._handle, conn_id, payload, len(payload))
+
+    # -- C++ relay plane (proxies only) ---------------------------------------
+    def relay_config(self, methods, clusters, timeout: float = 10.0) -> bool:
+        """Route ``methods`` for ``clusters`` entirely in C++: the request
+        frame forwards verbatim to a backend on a per-(client-connection,
+        cluster) pipe and the response streams back without entering
+        Python (rpc_frontend.cpp relay plane). ``clusters`` maps cluster
+        name -> [(host, port), ...]; the table is replaced wholesale.
+        Anything the C++ side declines (unknown cluster, dead pipe)
+        falls back to the registered Python handler."""
+        if self._stopped:
+            return False
+        spec = "\n".join(
+            f"{name}\t" + ",".join(f"{h}:{p}" for h, p in nodes)
+            for name, nodes in clusters.items() if nodes)
+        rc = self._lib.jt_rpc_relay_config(
+            self._handle, "\n".join(methods).encode(), spec.encode(),
+            float(timeout))
+        return rc == 0
+
+    def relay_stats(self) -> Dict[str, int]:
+        """Per-method relayed-request counts (merged into the proxy's
+        get_status counters — relayed requests never reach Python)."""
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.jt_rpc_relay_stats(self._handle, buf, cap)
+            if n >= 0:
+                out: Dict[str, int] = {}
+                for line in buf.raw[:n].decode().splitlines():
+                    m, _, c = line.partition("\t")
+                    if m:
+                        out[m] = int(c)
+                return out
+            cap = -int(n) + 16
 
     # -- lifecycle (RpcServer-compatible) -------------------------------------
     def listen(self, port: int, host: str = "0.0.0.0") -> int:

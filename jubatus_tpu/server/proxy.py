@@ -186,7 +186,18 @@ class Proxy:
         self.request_counts: Dict[str, int] = {}
         self.forward_count = 0
         self.forward_errors = 0
+        #: C++ relay plane (native transport only): random-routed raw
+        #: methods forward in rpc_frontend.cpp without entering Python;
+        #: this side only keeps the routing table fresh (clusters seen ->
+        #: current actives) and serves whatever the C++ declines
+        self._relay_methods: List[str] = []
+        self._relay_seen: Dict[str, float] = {}  # cluster -> last-live ts
+        self._relay_lock = threading.Lock()
         self._register_methods()
+        if hasattr(self.rpc, "relay_config"):
+            t = threading.Thread(target=self._relay_refresher, daemon=True,
+                                 name="proxy-relay-refresh")
+            t.start()
 
     # -- session pool (proxy.hpp:502-593) ------------------------------------
     # Borrow/return, like the reference's get/return session pool: each
@@ -305,9 +316,72 @@ class Proxy:
         with self._counters_lock:
             self.request_counts[method] = self.request_counts.get(method, 0) + 1
 
+    #: clusters with no actives for this long fall out of the relay
+    #: table and the seen-set (client-supplied names must not grow state
+    #: unboundedly — a typo'd cluster should cost one window, not forever)
+    _RELAY_SEEN_TTL = 60.0
+    _RELAY_SEEN_CAP = 1024
+
+    def _note_cluster(self, cluster: str) -> None:
+        """A cluster first seen on the Python path enters the relay table
+        at the next refresher tick — after that, its random-routed raw
+        traffic never comes back here (C++ relay plane)."""
+        with self._relay_lock:
+            if cluster not in self._relay_seen and \
+                    len(self._relay_seen) >= self._RELAY_SEEN_CAP:
+                return  # cap: a flood of bogus names relays nothing anyway
+            self._relay_seen.setdefault(cluster, time.monotonic())
+
+    def _relay_refresher(self) -> None:
+        """Keep the C++ relay's routing table fresh: every tick, push the
+        current actives of every cluster this proxy has served. Replaced
+        wholesale — a de-registered backend retires its pipes via the
+        config generation (rpc_frontend.cpp relay_try). A cluster whose
+        actives lookup FAILS transiently keeps its previous routing (a
+        coordinator hiccup must not bounce traffic to the Python path);
+        one that stays EMPTY past the TTL is dropped entirely."""
+        last_table: Dict[str, list] = {}
+        while not self._stop_event.wait(1.0):
+            with self._relay_lock:
+                seen = dict(self._relay_seen)
+            if not seen:
+                continue
+            now = time.monotonic()
+            table = {}
+            expired = []
+            for cluster, last_live in seen.items():
+                try:
+                    nodes = [(n.host, n.port)
+                             for n in self.members.actives(cluster)]
+                except Exception:  # noqa: BLE001 — carry last known
+                    log.debug("relay refresh failed for %s", cluster,
+                              exc_info=True)
+                    nodes = last_table.get(cluster, [])
+                if nodes:
+                    table[cluster] = nodes
+                    with self._relay_lock:
+                        if cluster in self._relay_seen:
+                            self._relay_seen[cluster] = now
+                elif now - last_live > self._RELAY_SEEN_TTL:
+                    expired.append(cluster)
+            if expired:
+                with self._relay_lock:
+                    for cluster in expired:
+                        self._relay_seen.pop(cluster, None)
+            last_table = table
+            try:
+                self.rpc.relay_config(self._relay_methods, table,
+                                      timeout=self.args.interconnect_timeout)
+            except Exception:  # noqa: BLE001
+                log.debug("relay config push failed", exc_info=True)
+
     def _handler(self, name: str, routing: str, cht_n: int,
                  reducer: Callable[[Any, Any], Any]) -> Callable[..., Any]:
         def handle(*params: Any) -> Any:
+            if params and isinstance(params[0], (str, bytes)):
+                c = params[0]
+                self._note_cluster(c.decode("utf-8", "surrogateescape")
+                                   if isinstance(c, bytes) else c)
             self._count(name)
             self._expire_sessions()
             actives = self.members.actives(str(params[0]))
@@ -338,6 +412,7 @@ class Proxy:
             cluster = _peek_cluster_name(raw_params)
             if cluster is None:
                 return RAW_FALLBACK  # odd wire: generic path decides
+            self._note_cluster(cluster)
             self._expire_sessions()
             actives = self.members.actives(cluster)
             if not actives:
@@ -385,6 +460,7 @@ class Proxy:
                           arity=arity)
         if routing == "random" and hasattr(self.rpc, "register_raw"):
             self.rpc.register_raw(name, self._raw_handler(name))
+            self._relay_methods.append(name)
 
     def _register_methods(self) -> None:
         for m in get_service(self.engine):
@@ -404,18 +480,30 @@ class Proxy:
     # -- own status (proxy_common::get_status) --------------------------------
     def get_proxy_status(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
         node = NodeInfo(self.args.bind_host, self.rpc.port or self.args.rpc_port)
+        # requests the C++ relay served never reach Python — fold its
+        # per-method counts into the same counters the reference reports
+        relayed: Dict[str, int] = {}
+        if hasattr(self.rpc, "relay_stats"):
+            try:
+                relayed = self.rpc.relay_stats()
+            except Exception:  # noqa: BLE001 — status must never fail
+                log.debug("relay stats fetch failed", exc_info=True)
         with self._counters_lock:
             st: Dict[str, Any] = {
                 "timestamp": int(time.time()),
                 "uptime": int(time.time() - self.start_time),
                 "type": f"{self.engine}_proxy",
                 "version": __version__,
-                "forward_count": self.forward_count,
+                "forward_count": self.forward_count + sum(relayed.values()),
                 "forward_errors": self.forward_errors,
                 "session_pool_size": sum(
                     len(v) for v in self._pool.values()),
+                "relay_count": sum(relayed.values()),
             }
-            st.update({f"request.{k}": v for k, v in self.request_counts.items()})
+            counts = dict(self.request_counts)
+            for m, c in relayed.items():
+                counts[m] = counts.get(m, 0) + c
+            st.update({f"request.{k}": v for k, v in counts.items()})
         st.update(self.args.flags_status())
         return {node.name: st}
 

@@ -19,9 +19,11 @@
 // happens in Python, so the full type zoo stays in one place.
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -31,9 +33,12 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -217,9 +222,59 @@ typedef void (*request_cb)(uint64_t conn_id, uint64_t msgid,
 // (~0ull is already taken by the notification sentinel.)
 constexpr uint64_t kCloseId = ~0ull - 1;
 
+// ------------------------------------------------------------- C++ relay
+// The proxy's RANDOM-routed hot methods never enter Python at all: the
+// client's request frame is forwarded VERBATIM to a backend over a
+// per-(client-connection, cluster) pipe, and a pump thread streams the
+// backend's response frames back to the client — the reference proxy's
+// C++ forwarding shape (proxy.hpp:64-186), with Python keeping the
+// routing table fresh (jt_rpc_relay_config) and serving every declined
+// case (unknown cluster, pipe failure, non-relay methods) through the
+// ordinary callback path. msgids pass through UNCHANGED: a pipe carries
+// exactly one client's traffic, so no correlation rewrite is needed, and
+// the backend's wire-era autodetection sees that one client's bytes.
+
+struct RelayPipe {
+  int fd = -1;
+  std::string target;             // "host:port" this pipe is stuck to
+  uint64_t generation = 0;        // config generation at creation
+  std::mutex wmu;                 // serialize request forwards
+  std::mutex omu;                 // guards outstanding
+  std::deque<uint64_t> outstanding;
+  std::atomic<bool> dead{false};
+  // the fd closes ONLY here, when the last referent (forwarder, pump,
+  // conn map) lets go — live paths use shutdown(), so a recycled fd
+  // number can never be written by a stale holder
+  ~RelayPipe() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+struct RelayCfg {
+  std::atomic<bool> enabled{false};  // lock-free gate for plain servers
+  std::mutex mu;
+  std::set<std::string> methods;
+  // cluster -> [(host, port, "host:port"), ...]
+  std::map<std::string,
+           std::vector<std::pair<std::pair<std::string, int>, std::string>>>
+      clusters;
+  double timeout_s = 10.0;
+  uint64_t generation = 0;
+  std::atomic<uint64_t> rr{0};
+  std::map<std::string, uint64_t> counts;  // relayed per method
+};
+
 struct Conn {
   int fd;
   std::mutex write_mu;
+  std::mutex pipes_mu;
+  std::map<std::string, std::shared_ptr<RelayPipe>> pipes;  // by cluster
+  // like RelayPipe: live paths (reader teardown, stop) only shutdown();
+  // the LAST referent — possibly a relay pump mid-write — closes, so a
+  // recycled fd number can never be written by a stale holder
+  ~Conn() {
+    if (fd >= 0) ::close(fd);
+  }
 };
 
 struct Server {
@@ -230,9 +285,11 @@ struct Server {
   // readers are DETACHED (connection churn must not accumulate joinable
   // threads); stop() waits for this count to reach zero instead of joining
   std::atomic<int64_t> active_readers{0};
+  std::atomic<int64_t> active_pumps{0};
   std::mutex conns_mu;
   std::map<uint64_t, std::shared_ptr<Conn>> conns;
   std::atomic<uint64_t> next_conn{1};
+  RelayCfg relay;
 };
 
 // msgid sentinel for notifications (no response expected).
@@ -263,12 +320,322 @@ bool read_array_header(const uint8_t*& p, const uint8_t* end, int64_t* n) {
   return false;
 }
 
+// ---- relay plumbing ----------------------------------------------------
+
+// pack one positive msgpack uint; returns encoded length (<= 9)
+size_t pack_uint(uint64_t v, uint8_t* b) {
+  if (v <= 0x7f) { b[0] = uint8_t(v); return 1; }
+  if (v <= 0xff) { b[0] = 0xcc; b[1] = uint8_t(v); return 2; }
+  if (v <= 0xffff) {
+    b[0] = 0xcd; b[1] = uint8_t(v >> 8); b[2] = uint8_t(v);
+    return 3;
+  }
+  if (v <= 0xffffffffull) {
+    b[0] = 0xce;
+    for (int i = 0; i < 4; ++i) b[1 + i] = uint8_t(v >> (24 - 8 * i));
+    return 5;
+  }
+  b[0] = 0xcf;
+  for (int i = 0; i < 8; ++i) b[1 + i] = uint8_t(v >> (56 - 8 * i));
+  return 9;
+}
+
+bool send_all(int fd, std::mutex& mu, const uint8_t* p, int64_t n) {
+  std::lock_guard<std::mutex> g(mu);
+  int64_t off = 0;
+  while (off < n) {
+    ssize_t m = ::send(fd, p + off, size_t(n - off), MSG_NOSIGNAL);
+    if (m <= 0) return false;
+    off += m;
+  }
+  return true;
+}
+
+// Backend -> client pump: frame-split the backend stream (responses must
+// not interleave MID-FRAME with Python-path responses on the client
+// socket) and forward each frame verbatim. On backend loss/timeout every
+// outstanding msgid gets a synthesized msgpack-rpc error so no client
+// call hangs. The pipe's fd is only shutdown() here — the RelayPipe
+// destructor closes it once every referent is gone, so a recycled fd
+// number can never be written by a stale forwarder.
+void relay_pump(Server* s, std::shared_ptr<Conn> conn,
+                std::shared_ptr<RelayPipe> pipe, double timeout_s) {
+  struct Guard {
+    std::atomic<int64_t>* n;
+    ~Guard() { n->fetch_sub(1); }
+  } guard{&s->active_pumps};
+  std::vector<uint8_t> buf;
+  uint8_t chunk[1 << 16];
+  double idle = 0.0;
+  while (s->running.load() && !pipe->dead.load()) {
+    ssize_t n = ::recv(pipe->fd, chunk, sizeof(chunk), 0);
+    if (n == 0) break;
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
+        bool waiting;
+        {
+          std::lock_guard<std::mutex> g(pipe->omu);
+          waiting = !pipe->outstanding.empty();
+        }
+        if (!waiting) {
+          idle = 0.0;
+          continue;  // idle pipe: keep listening
+        }
+        idle += 0.2;  // SO_RCVTIMEO tick
+        if (idle >= timeout_s) break;  // backend stalled mid-request
+        continue;
+      }
+      break;
+    }
+    idle = 0.0;
+    buf.insert(buf.end(), chunk, chunk + n);
+    const uint8_t* p = buf.data();
+    const uint8_t* end = p + buf.size();
+    bool broken = false;
+    while (p < end) {
+      const uint8_t* next = skip_object(p, end, 0);
+      if (next == kIncomplete) break;
+      if (next == malformed()) {
+        broken = true;
+        break;
+      }
+      const uint8_t* q = p;
+      int64_t cnt = 0;
+      uint64_t type = 0, mid = 0;
+      if (read_array_header(q, next, &cnt) && cnt == 4 &&
+          read_uint(q, next, &type) && type == 1 &&
+          read_uint(q, next, &mid)) {
+        std::lock_guard<std::mutex> g(pipe->omu);
+        for (auto it = pipe->outstanding.begin();
+             it != pipe->outstanding.end(); ++it) {
+          if (*it == mid) {
+            pipe->outstanding.erase(it);
+            break;
+          }
+        }
+      }
+      if (!send_all(conn->fd, conn->write_mu, p, next - p)) {
+        broken = true;
+        break;
+      }
+      p = next;
+    }
+    if (broken) break;
+    buf.erase(buf.begin(), buf.begin() + (p - buf.data()));
+  }
+  pipe->dead.store(true);
+  ::shutdown(pipe->fd, SHUT_RDWR);
+  // fail whatever never got its reply
+  std::deque<uint64_t> orphans;
+  {
+    std::lock_guard<std::mutex> g(pipe->omu);
+    orphans.swap(pipe->outstanding);
+  }
+  // fixraw (0xa0|len), not str8: valid in BOTH msgpack eras, so a
+  // legacy-era client being relayed still parses its error cleanly
+  static const char kErr[] = "relay: backend connection lost";
+  static_assert(sizeof(kErr) - 1 <= 31, "fixraw limit");
+  for (uint64_t id : orphans) {
+    uint8_t frame[64];
+    size_t off = 0;
+    frame[off++] = 0x94;
+    frame[off++] = 0x01;
+    off += pack_uint(id, frame + off);
+    frame[off++] = uint8_t(0xa0 | (sizeof(kErr) - 1));
+    memcpy(frame + off, kErr, sizeof(kErr) - 1);
+    off += sizeof(kErr) - 1;
+    frame[off++] = 0xc0;
+    send_all(conn->fd, conn->write_mu, frame, int64_t(off));
+  }
+}
+
+// Try to relay one request frame. Returns true when the frame was handed
+// to a backend pipe (a response WILL reach the client — from the backend
+// or synthesized); false = caller dispatches through Python as usual.
+bool relay_try(Server* s, const std::shared_ptr<Conn>& conn,
+               const uint8_t* frame, const uint8_t* frame_end,
+               uint64_t msgid, const uint8_t* mdata, int64_t mlen,
+               const uint8_t* params) {
+  std::string method(reinterpret_cast<const char*>(mdata), size_t(mlen));
+  // cluster name = first element of the params array
+  std::string cluster;
+  {
+    const uint8_t* q = params;
+    int64_t pcnt = 0;
+    const uint8_t* cd;
+    int64_t cl;
+    if (!read_array_header(q, frame_end, &pcnt) || pcnt < 1 ||
+        !read_str(q, frame_end, &cd, &cl))
+      return false;
+    cluster.assign(reinterpret_cast<const char*>(cd), size_t(cl));
+  }
+  std::pair<std::string, int> target;
+  std::string target_key;
+  double timeout_s;
+  uint64_t gen;
+  std::shared_ptr<RelayPipe> pipe;
+  {
+    std::lock_guard<std::mutex> g(s->relay.mu);
+    if (!s->relay.methods.count(method)) return false;
+    auto it = s->relay.clusters.find(cluster);
+    if (it == s->relay.clusters.end() || it->second.empty()) return false;
+    timeout_s = s->relay.timeout_s;
+    gen = s->relay.generation;
+    auto& tv = it->second;
+    auto& t = tv[s->relay.rr.fetch_add(1) % tv.size()];
+    target = t.first;
+    target_key = t.second;
+    // existing-pipe retirement check needs the target list; do it here
+    std::lock_guard<std::mutex> g2(conn->pipes_mu);
+    auto pit = conn->pipes.find(cluster);
+    if (pit != conn->pipes.end()) {
+      pipe = pit->second;
+      if (pipe->dead.load()) {
+        conn->pipes.erase(pit);
+        pipe.reset();
+      } else if (pipe->generation != gen) {
+        bool still = false;
+        for (auto& cand : tv)
+          if (cand.second == pipe->target) {
+            still = true;
+            break;
+          }
+        if (still) {
+          pipe->generation = gen;
+        } else {  // backend no longer routed: retire, re-pick below
+          pipe->dead.store(true);
+          ::shutdown(pipe->fd, SHUT_RDWR);
+          conn->pipes.erase(pit);
+          pipe.reset();
+        }
+      }
+    }
+  }
+  if (!pipe) {
+    // connect OUTSIDE the config lock (a slow backend must not stall
+    // other connections' relay decisions or config pushes), NON-BLOCKING
+    // with a bounded budget: this runs on the client's reader thread, so
+    // a blackholed backend must cost at most a couple of seconds — after
+    // which the request falls back to the Python path (whose session
+    // pool has its own timeout discipline) — never the kernel's ~2 min
+    // SYN patience, which would also wedge jt_rpc_stop behind the reader
+    int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return false;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(uint16_t(target.second));
+    if (::inet_pton(AF_INET, target.first.c_str(), &addr.sin_addr) != 1) {
+      addrinfo hints{};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      if (::getaddrinfo(target.first.c_str(), nullptr, &hints, &res) != 0 ||
+          res == nullptr) {
+        ::close(fd);
+        return false;
+      }
+      addr.sin_addr =
+          reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+      ::freeaddrinfo(res);
+    }
+    int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    if (rc < 0 && errno == EINPROGRESS) {
+      pollfd pfd{fd, POLLOUT, 0};
+      double budget = timeout_s < 2.0 ? timeout_s : 2.0;
+      rc = ::poll(&pfd, 1, int(budget * 1000));
+      int soerr = 0;
+      socklen_t slen = sizeof(soerr);
+      if (rc == 1)
+        ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen);
+      if (rc != 1 || soerr != 0) {
+        ::close(fd);
+        return false;
+      }
+    } else if (rc < 0) {
+      ::close(fd);
+      return false;
+    }
+    // back to blocking: pumps and forwards rely on blocking send/recv
+    int fl = ::fcntl(fd, F_GETFL, 0);
+    if (fl >= 0) ::fcntl(fd, F_SETFL, fl & ~O_NONBLOCK);
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    timeval tv{};
+    tv.tv_usec = 200000;  // pump tick; timeout accounting is in the pump
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    pipe = std::make_shared<RelayPipe>();
+    pipe->fd = fd;
+    pipe->target = target_key;
+    pipe->generation = gen;
+    bool raced = false;
+    {
+      std::lock_guard<std::mutex> g(conn->pipes_mu);
+      auto pit = conn->pipes.find(cluster);
+      if (pit != conn->pipes.end() && !pit->second->dead.load()) {
+        raced = true;  // another request built the pipe first
+      } else {
+        conn->pipes[cluster] = pipe;
+      }
+    }
+    if (raced) {  // drop ours (destructor closes the fd); use the winner
+      std::lock_guard<std::mutex> g(conn->pipes_mu);
+      auto pit = conn->pipes.find(cluster);
+      if (pit == conn->pipes.end()) return false;
+      pipe = pit->second;
+    } else {
+      s->active_pumps.fetch_add(1);
+      std::thread(relay_pump, s, conn, pipe, timeout_s).detach();
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(pipe->omu);
+    pipe->outstanding.push_back(msgid);
+  }
+  bool sent;
+  {
+    std::lock_guard<std::mutex> g(pipe->wmu);
+    sent = !pipe->dead.load();
+    if (sent) {
+      int64_t off = 0, n = frame_end - frame;
+      while (off < n) {
+        ssize_t m = ::send(pipe->fd, frame + off, size_t(n - off),
+                           MSG_NOSIGNAL);
+        if (m <= 0) {
+          sent = false;
+          break;
+        }
+        off += m;
+      }
+    }
+  }
+  if (!sent) {
+    {
+      std::lock_guard<std::mutex> g(pipe->omu);
+      for (auto it = pipe->outstanding.begin();
+           it != pipe->outstanding.end(); ++it)
+        if (*it == msgid) {
+          pipe->outstanding.erase(it);
+          break;
+        }
+    }
+    pipe->dead.store(true);
+    ::shutdown(pipe->fd, SHUT_RDWR);
+    return false;  // Python path serves this request
+  }
+  {
+    std::lock_guard<std::mutex> g(s->relay.mu);
+    s->relay.counts[method] += 1;
+  }
+  return true;
+}
+
 // One complete frame: request [0, msgid, method, params] (4 elements) or
 // notification [2, method, params] (3 elements); params is everything from
 // the last element to the frame end. Returns end-of-frame, kIncomplete, or
 // malformed().
-const uint8_t* parse_frame(Server* s, uint64_t conn_id, const uint8_t* p,
-                           const uint8_t* end) {
+const uint8_t* parse_frame(Server* s, uint64_t conn_id,
+                           const std::shared_ptr<Conn>& conn,
+                           const uint8_t* p, const uint8_t* end) {
   const uint8_t* frame_end = skip_object(p, end, 0);
   if (frame_end == kIncomplete || frame_end == malformed()) return frame_end;
   const uint8_t* q = p;
@@ -291,6 +658,11 @@ const uint8_t* parse_frame(Server* s, uint64_t conn_id, const uint8_t* p,
   }
   const int32_t envelope_modern = (q < frame_end && *q == 0xd9) ? 1 : 0;
   if (!read_str(q, frame_end, &mdata, &mlen)) return malformed();
+  // relay hot path: configured methods forward to a backend without ever
+  // entering Python (the frame is consumed when relay_try returns true)
+  if (count == 4 && s->relay.enabled.load(std::memory_order_relaxed) &&
+      relay_try(s, conn, p, frame_end, msgid, mdata, mlen, q))
+    return frame_end;
   s->cb(conn_id, msgid, reinterpret_cast<const char*>(mdata), mlen, q,
         frame_end - q, envelope_modern);
   return frame_end;
@@ -311,7 +683,7 @@ void reader_loop(Server* s, uint64_t conn_id, std::shared_ptr<Conn> conn) {
     const uint8_t* p = buf.data();
     const uint8_t* end = p + buf.size();
     while (p < end) {
-      const uint8_t* next = parse_frame(s, conn_id, p, end);
+      const uint8_t* next = parse_frame(s, conn_id, conn, p, end);
       if (next == kIncomplete) break;
       if (next == malformed()) {
         ::shutdown(conn->fd, SHUT_RDWR);
@@ -322,15 +694,28 @@ void reader_loop(Server* s, uint64_t conn_id, std::shared_ptr<Conn> conn) {
     buf.erase(buf.begin(), buf.begin() + (p - buf.data()));
   }
 done:
-  // erase BEFORE closing: once the fd is closed the kernel may recycle
-  // its number, and a stale map entry would let jt_rpc_stop shutdown()
-  // some unrelated socket that got the recycled fd
+  // erase BEFORE teardown: a stale map entry would let jt_rpc_stop
+  // shutdown() an unrelated socket on a recycled fd number
   {
     std::lock_guard<std::mutex> g(s->conns_mu);
     s->conns.erase(conn_id);
   }
-  ::close(conn->fd);
-  // after the fd is gone: no response can race this notification
+  // retire this connection's relay pipes so their pumps exit; the conn
+  // fd itself is shutdown() only — the Conn destructor closes it once
+  // every pump (which may be mid-write) has let go
+  {
+    std::map<std::string, std::shared_ptr<RelayPipe>> pipes;
+    {
+      std::lock_guard<std::mutex> g(conn->pipes_mu);
+      pipes.swap(conn->pipes);
+    }
+    for (auto& kv : pipes) {
+      kv.second->dead.store(true);
+      ::shutdown(kv.second->fd, SHUT_RDWR);
+    }
+  }
+  ::shutdown(conn->fd, SHUT_RDWR);
+  // the fd can no longer produce traffic: no response races this
   s->cb(conn_id, kCloseId, "", 0, nullptr, 0, 0);
 }
 
@@ -443,11 +828,21 @@ void jt_rpc_stop(void* handle) {
   ::close(s->listen_fd);
   {
     std::lock_guard<std::mutex> g(s->conns_mu);
-    for (auto& kv : s->conns) ::shutdown(kv.second->fd, SHUT_RDWR);
+    for (auto& kv : s->conns) {
+      {
+        std::lock_guard<std::mutex> g2(kv.second->pipes_mu);
+        for (auto& pk : kv.second->pipes) {
+          pk.second->dead.store(true);
+          ::shutdown(pk.second->fd, SHUT_RDWR);
+        }
+      }
+      ::shutdown(kv.second->fd, SHUT_RDWR);
+    }
   }
-  // wait for detached readers to drain: no callback may run after stop
-  // returns (the Python side may be torn down next)
-  while (s->active_readers.load() > 0) {
+  // wait for detached readers AND relay pumps to drain: no callback may
+  // run after stop returns (the Python side may be torn down next), and
+  // no pump may outlive the server it counts against
+  while (s->active_readers.load() > 0 || s->active_pumps.load() > 0) {
     std::this_thread::sleep_for(std::chrono::milliseconds(1));
   }
 }
@@ -455,6 +850,84 @@ void jt_rpc_stop(void* handle) {
 void jt_rpc_destroy(void* handle) {
   jt_rpc_stop(handle);
   delete static_cast<Server*>(handle);
+}
+
+// Configure (or reconfigure) the C++ relay plane. methods_nl: relayable
+// method names, one per line. clusters_spec: "cluster\thost:port[,...]"
+// lines — the CURRENT routing table (replaced wholesale; generation
+// bumps retire pipes stuck to de-routed backends). timeout_s: backend
+// stall budget per pipe. Passing empty methods or clusters disables the
+// fast path (every request falls back to the Python callback).
+int jt_rpc_relay_config(void* handle, const char* methods_nl,
+                        const char* clusters_spec, double timeout_s) {
+  Server* s = static_cast<Server*>(handle);
+  std::set<std::string> methods;
+  std::map<std::string,
+           std::vector<std::pair<std::pair<std::string, int>, std::string>>>
+      clusters;
+  std::string m(methods_nl ? methods_nl : "");
+  size_t pos = 0;
+  while (pos < m.size()) {
+    size_t nl = m.find('\n', pos);
+    if (nl == std::string::npos) nl = m.size();
+    if (nl > pos) methods.insert(m.substr(pos, nl - pos));
+    pos = nl + 1;
+  }
+  std::string c(clusters_spec ? clusters_spec : "");
+  pos = 0;
+  while (pos < c.size()) {
+    size_t nl = c.find('\n', pos);
+    if (nl == std::string::npos) nl = c.size();
+    std::string line = c.substr(pos, nl - pos);
+    pos = nl + 1;
+    if (line.empty()) continue;
+    size_t tab = line.find('\t');
+    if (tab == std::string::npos) return -1;
+    std::string cluster = line.substr(0, tab);
+    auto& vec = clusters[cluster];
+    size_t tpos = tab + 1;
+    while (tpos <= line.size()) {
+      size_t comma = line.find(',', tpos);
+      if (comma == std::string::npos) comma = line.size();
+      std::string hp = line.substr(tpos, comma - tpos);
+      tpos = comma + 1;
+      if (hp.empty()) continue;
+      size_t colon = hp.rfind(':');
+      if (colon == std::string::npos) return -1;
+      int port = atoi(hp.c_str() + colon + 1);
+      if (port <= 0 || port > 65535) return -1;
+      vec.push_back({{hp.substr(0, colon), port}, hp});
+    }
+  }
+  bool on = !methods.empty() && !clusters.empty();
+  {
+    std::lock_guard<std::mutex> g(s->relay.mu);
+    s->relay.methods.swap(methods);
+    s->relay.clusters.swap(clusters);
+    s->relay.timeout_s = timeout_s > 0 ? timeout_s : 10.0;
+    s->relay.generation += 1;
+  }
+  s->relay.enabled.store(on, std::memory_order_relaxed);
+  return 0;
+}
+
+// Dump per-method relayed-request counts as "method\tcount\n" lines.
+// Returns bytes written, or -(bytes needed) when cap is too small.
+int64_t jt_rpc_relay_stats(void* handle, char* buf, int64_t cap) {
+  Server* s = static_cast<Server*>(handle);
+  std::string out;
+  {
+    std::lock_guard<std::mutex> g(s->relay.mu);
+    for (auto& kv : s->relay.counts) {
+      out += kv.first;
+      out += '\t';
+      out += std::to_string(kv.second);
+      out += '\n';
+    }
+  }
+  if (int64_t(out.size()) > cap) return -int64_t(out.size());
+  memcpy(buf, out.data(), out.size());
+  return int64_t(out.size());
 }
 
 }  // extern "C"

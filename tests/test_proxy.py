@@ -190,3 +190,66 @@ def test_member_cache_invalidation():
         proxy.stop()
         for s in servers:
             s.stop()
+
+
+def test_cpp_relay_plane_serves_and_counts():
+    """Native transport: after the refresher's first table push, random-
+    routed raw traffic forwards entirely in C++ (rpc_frontend.cpp relay)
+    — results identical, counts folded into get_proxy_status, and a dead
+    backend degrades to the Python path instead of wedging."""
+    import os
+    import time
+
+    if os.environ.get("JUBATUS_TPU_NATIVE_RPC", "") in ("0", "false", "no"):
+        pytest.skip("python transport forced")
+    from jubatus_tpu.rpc import native_server
+
+    if not native_server.available():
+        pytest.skip("native rpc front-end unavailable")
+    store = _Store()
+    servers = _boot("classifier", CLASSIFIER_CONF, 2, store)
+    proxy = _proxy("classifier", store)
+    if not hasattr(proxy.rpc, "relay_config"):
+        proxy.stop()
+        for s in servers:
+            s.stop()
+        pytest.skip("proxy not on native transport")
+    cli = ClassifierClient("127.0.0.1", proxy.args.rpc_port, NAME,
+                           timeout=30)
+    try:
+        # first call goes the Python path and seeds the cluster table
+        cli.train([("a", Datum({"x": 1.0})), ("b", Datum({"x": -1.0}))])
+        deadline = time.time() + 8.0
+        relayed = {}
+        while time.time() < deadline:
+            time.sleep(0.5)
+            cli.train([("a", Datum({"x": 1.0}))])
+            relayed = proxy.rpc.relay_stats()
+            if relayed.get("train"):
+                break
+        assert relayed.get("train"), "relay never engaged"
+        # classify rides the relay too, with a correct answer
+        for _ in range(6):
+            cli.train([("a", Datum({"x": 1.0})), ("b", Datum({"x": -1.0}))])
+        res = cli.classify([Datum({"x": 1.0})])
+        assert max(res[0], key=lambda e: e[1])[0] == "a"
+        st = proxy.get_proxy_status()
+        (node,) = st.values()
+        assert node["relay_count"] >= relayed["train"]
+        assert node["request.train"] >= relayed["train"]
+        # kill both backends: relayed calls must surface an error (no
+        # hang), then the Python fallback path reports no actives
+        for s in servers:
+            s.stop()
+        with pytest.raises(Exception):
+            for _ in range(20):  # pipes + membership drain within a few
+                cli.train([("a", Datum({"x": 1.0}))])
+                time.sleep(0.3)
+    finally:
+        cli.close()
+        proxy.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — already stopped above
+                pass
