@@ -367,7 +367,6 @@ def collect(trials: int = 2) -> dict:
     except Exception as e:  # noqa: BLE001
         out["e2e_native_error"] = repr(e)[:200]
     best: dict = {}
-    direct_runs: list = []
     for t in range(trials):
         for tr in transports:
             try:
@@ -376,8 +375,6 @@ def collect(trials: int = 2) -> dict:
                 out[f"e2e_{tr}_error"] = repr(e)[:200]  # a dead bench
                 continue
             key = f"e2e_rpc_train_samples_per_sec_{tr}"
-            if tr == transports[-1]:
-                direct_runs.append(r[key])
             if key not in best or r[key] > best[key]:
                 best.update(r)
     out.update(best)
@@ -402,37 +399,40 @@ def collect(trials: int = 2) -> dict:
     except Exception as e:  # noqa: BLE001
         out["e2e_classify_error"] = repr(e)[:200]
     # proxy tier: same numeric workload through the proxy hop. The
-    # REPORTED key stays best-of (symmetric with direct), but the ratio
-    # uses median-vs-median over >= 3 runs each: the direct side alone
-    # swings ~±12% run to run on the shared core, and a ratio of two
-    # bests is a race between maxima, not a comparison.
+    # REPORTED keys stay best-of, but the ratio uses median-vs-median
+    # over ADJACENT alternating (proxy, direct) pairs: the direct side
+    # alone swings ~±12% run to run on the shared core AND trends with
+    # process age, so early-direct-vs-late-proxy systematically biased
+    # the ratio low (round 4 dry runs: adjacent protocol 0.83-0.87,
+    # early/late split 0.79 from the same code).
     import numpy as _np
 
+    dkey = f"e2e_rpc_train_samples_per_sec_{text_tr}"
     pkey = f"e2e_rpc_train_samples_per_sec_proxy_{text_tr}"
     proxy_runs: list = []
+    ratio_direct_runs: list = []
     for _ in range(max(trials, 3)):
         try:
             r = run_proxy(text_tr)
+            proxy_runs.append(r.get(pkey, 0))
+            if r.get(pkey, 0) > out.get(pkey, 0):
+                out.update(r)
         except Exception as e:  # noqa: BLE001
             out["e2e_proxy_error"] = repr(e)[:200]
-            continue
-        proxy_runs.append(r.get(pkey, 0))
-        if r.get(pkey, 0) > out.get(pkey, 0):
-            out.update(r)
-    while len(direct_runs) < 3:
         try:
-            direct_runs.append(run(text_tr)[
-                f"e2e_rpc_train_samples_per_sec_{text_tr}"])
+            d = run(text_tr)
+            ratio_direct_runs.append(d[dkey])
+            if d[dkey] > out.get(dkey, 0):
+                out[dkey] = d[dkey]
         except Exception as e:  # noqa: BLE001
             out[f"e2e_{text_tr}_error"] = repr(e)[:200]
-            break
-    if proxy_runs and direct_runs:
-        med_d = float(_np.median(direct_runs))
+    if proxy_runs and ratio_direct_runs:
+        med_d = float(_np.median(ratio_direct_runs))
         med_p = float(_np.median(proxy_runs))
         out["e2e_proxy_vs_direct"] = round(med_p / med_d, 3)
         out["e2e_proxy_vs_direct_note"] = (
-            f"median of {len(proxy_runs)} proxy vs {len(direct_runs)} "
-            f"direct runs")
+            f"median of {len(proxy_runs)} proxy vs "
+            f"{len(ratio_direct_runs)} direct runs, adjacent alternation")
     return out
 
 

@@ -83,7 +83,7 @@ def _load_lib() -> Optional[ctypes.CDLL]:
         lib.jt_rpc_relay_config.restype = ctypes.c_int
         lib.jt_rpc_relay_config.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.c_double]
+            ctypes.c_double, ctypes.c_double]
         lib.jt_rpc_relay_stats.restype = ctypes.c_int64
         lib.jt_rpc_relay_stats.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                            ctypes.c_int64]
@@ -255,7 +255,8 @@ class NativeRpcServer:
         self._lib.jt_rpc_respond(self._handle, conn_id, payload, len(payload))
 
     # -- C++ relay plane (proxies only) ---------------------------------------
-    def relay_config(self, methods, clusters, timeout: float = 10.0) -> bool:
+    def relay_config(self, methods, clusters, timeout: float = 10.0,
+                     idle_expire: float = 60.0) -> bool:
         """Route ``methods`` for ``clusters`` entirely in C++: the request
         frame forwards verbatim to a backend on a per-(client-connection,
         cluster) pipe and the response streams back without entering
@@ -270,12 +271,14 @@ class NativeRpcServer:
             for name, nodes in clusters.items() if nodes)
         rc = self._lib.jt_rpc_relay_config(
             self._handle, "\n".join(methods).encode(), spec.encode(),
-            float(timeout))
+            float(timeout), float(idle_expire))
         return rc == 0
 
     def relay_stats(self) -> Dict[str, int]:
         """Per-method relayed-request counts (merged into the proxy's
-        get_status counters — relayed requests never reach Python)."""
+        get_status counters — relayed requests never reach Python). The
+        reserved "__errors__" key counts synthesized backend-loss
+        responses (folds into forward_errors)."""
         cap = 1 << 16
         while True:
             buf = ctypes.create_string_buffer(cap)

@@ -370,8 +370,10 @@ class Proxy:
                         self._relay_seen.pop(cluster, None)
             last_table = table
             try:
-                self.rpc.relay_config(self._relay_methods, table,
-                                      timeout=self.args.interconnect_timeout)
+                self.rpc.relay_config(
+                    self._relay_methods, table,
+                    timeout=self.args.interconnect_timeout,
+                    idle_expire=self.args.session_pool_expire)
             except Exception:  # noqa: BLE001
                 log.debug("relay config push failed", exc_info=True)
 
@@ -488,6 +490,7 @@ class Proxy:
                 relayed = self.rpc.relay_stats()
             except Exception:  # noqa: BLE001 — status must never fail
                 log.debug("relay stats fetch failed", exc_info=True)
+        relay_errors = relayed.pop("__errors__", 0)
         with self._counters_lock:
             st: Dict[str, Any] = {
                 "timestamp": int(time.time()),
@@ -495,7 +498,7 @@ class Proxy:
                 "type": f"{self.engine}_proxy",
                 "version": __version__,
                 "forward_count": self.forward_count + sum(relayed.values()),
-                "forward_errors": self.forward_errors,
+                "forward_errors": self.forward_errors + relay_errors,
                 "session_pool_size": sum(
                     len(v) for v in self._pool.values()),
                 "relay_count": sum(relayed.values()),

@@ -250,18 +250,30 @@ struct RelayPipe {
   }
 };
 
-struct RelayCfg {
-  std::atomic<bool> enabled{false};  // lock-free gate for plain servers
-  std::mutex mu;
-  std::set<std::string> methods;
+// Immutable routing snapshot, swapped wholesale by jt_rpc_relay_config
+// and read lock-free (atomic shared_ptr load) on every frame — the relay
+// decision must not serialize all reader threads on one mutex. Method
+// entries carry pointers to PERSISTENT per-method counters (owned by
+// RelayCfg, never erased), so counting a relayed request is one
+// fetch_add, not a lock.
+struct RelayTable {
+  std::map<std::string, std::atomic<uint64_t>*> methods;
   // cluster -> [(host, port, "host:port"), ...]
   std::map<std::string,
            std::vector<std::pair<std::pair<std::string, int>, std::string>>>
       clusters;
   double timeout_s = 10.0;
+  double idle_expire_s = 60.0;
   uint64_t generation = 0;
+};
+
+struct RelayCfg {
+  std::atomic<bool> enabled{false};  // lock-free gate for plain servers
+  std::mutex mu;                     // guards swaps + the counter map
+  std::shared_ptr<const RelayTable> table;
+  std::map<std::string, std::unique_ptr<std::atomic<uint64_t>>> counts;
+  std::atomic<uint64_t> errors{0};   // synthesized backend-loss responses
   std::atomic<uint64_t> rr{0};
-  std::map<std::string, uint64_t> counts;  // relayed per method
 };
 
 struct Conn {
@@ -340,8 +352,7 @@ size_t pack_uint(uint64_t v, uint8_t* b) {
   return 9;
 }
 
-bool send_all(int fd, std::mutex& mu, const uint8_t* p, int64_t n) {
-  std::lock_guard<std::mutex> g(mu);
+bool send_all_fd(int fd, const uint8_t* p, int64_t n) {
   int64_t off = 0;
   while (off < n) {
     ssize_t m = ::send(fd, p + off, size_t(n - off), MSG_NOSIGNAL);
@@ -349,6 +360,11 @@ bool send_all(int fd, std::mutex& mu, const uint8_t* p, int64_t n) {
     off += m;
   }
   return true;
+}
+
+bool send_all(int fd, std::mutex& mu, const uint8_t* p, int64_t n) {
+  std::lock_guard<std::mutex> g(mu);
+  return send_all_fd(fd, p, n);
 }
 
 // Backend -> client pump: frame-split the backend stream (responses must
@@ -359,7 +375,8 @@ bool send_all(int fd, std::mutex& mu, const uint8_t* p, int64_t n) {
 // destructor closes it once every referent is gone, so a recycled fd
 // number can never be written by a stale forwarder.
 void relay_pump(Server* s, std::shared_ptr<Conn> conn,
-                std::shared_ptr<RelayPipe> pipe, double timeout_s) {
+                std::shared_ptr<RelayPipe> pipe, double timeout_s,
+                double idle_expire_s) {
   struct Guard {
     std::atomic<int64_t>* n;
     ~Guard() { n->fetch_sub(1); }
@@ -367,6 +384,7 @@ void relay_pump(Server* s, std::shared_ptr<Conn> conn,
   std::vector<uint8_t> buf;
   uint8_t chunk[1 << 16];
   double idle = 0.0;
+  double quiet = 0.0;
   while (s->running.load() && !pipe->dead.load()) {
     ssize_t n = ::recv(pipe->fd, chunk, sizeof(chunk), 0);
     if (n == 0) break;
@@ -378,9 +396,15 @@ void relay_pump(Server* s, std::shared_ptr<Conn> conn,
           waiting = !pipe->outstanding.empty();
         }
         if (!waiting) {
+          // idle-pipe expiry (≙ the session pool's --pool_expire): a
+          // connection that stopped sending relayed traffic should not
+          // hold a backend socket forever
+          quiet += 0.2;
+          if (quiet >= idle_expire_s) break;
           idle = 0.0;
-          continue;  // idle pipe: keep listening
+          continue;
         }
+        quiet = 0.0;
         idle += 0.2;  // SO_RCVTIMEO tick
         if (idle >= timeout_s) break;  // backend stalled mid-request
         continue;
@@ -446,6 +470,7 @@ void relay_pump(Server* s, std::shared_ptr<Conn> conn,
     off += sizeof(kErr) - 1;
     frame[off++] = 0xc0;
     send_all(conn->fd, conn->write_mu, frame, int64_t(off));
+    s->relay.errors.fetch_add(1, std::memory_order_relaxed);
   }
 }
 
@@ -456,7 +481,14 @@ bool relay_try(Server* s, const std::shared_ptr<Conn>& conn,
                const uint8_t* frame, const uint8_t* frame_end,
                uint64_t msgid, const uint8_t* mdata, int64_t mlen,
                const uint8_t* params) {
+  // lock-free config snapshot; method check FIRST (method names are
+  // short — SSO, no heap) so non-relayed traffic pays almost nothing
+  std::shared_ptr<const RelayTable> table =
+      std::atomic_load(&s->relay.table);
+  if (!table) return false;
   std::string method(reinterpret_cast<const char*>(mdata), size_t(mlen));
+  auto mit = table->methods.find(method);
+  if (mit == table->methods.end()) return false;
   // cluster name = first element of the params array
   std::string cluster;
   {
@@ -469,23 +501,17 @@ bool relay_try(Server* s, const std::shared_ptr<Conn>& conn,
       return false;
     cluster.assign(reinterpret_cast<const char*>(cd), size_t(cl));
   }
-  std::pair<std::string, int> target;
-  std::string target_key;
-  double timeout_s;
-  uint64_t gen;
+  auto cit = table->clusters.find(cluster);
+  if (cit == table->clusters.end() || cit->second.empty()) return false;
+  const auto& tv = cit->second;
+  const double timeout_s = table->timeout_s;
+  const double idle_expire_s = table->idle_expire_s;
+  const uint64_t gen = table->generation;
+  const auto& t = tv[s->relay.rr.fetch_add(1) % tv.size()];
+  const std::pair<std::string, int>& target = t.first;
+  const std::string& target_key = t.second;
   std::shared_ptr<RelayPipe> pipe;
   {
-    std::lock_guard<std::mutex> g(s->relay.mu);
-    if (!s->relay.methods.count(method)) return false;
-    auto it = s->relay.clusters.find(cluster);
-    if (it == s->relay.clusters.end() || it->second.empty()) return false;
-    timeout_s = s->relay.timeout_s;
-    gen = s->relay.generation;
-    auto& tv = it->second;
-    auto& t = tv[s->relay.rr.fetch_add(1) % tv.size()];
-    target = t.first;
-    target_key = t.second;
-    // existing-pipe retirement check needs the target list; do it here
     std::lock_guard<std::mutex> g2(conn->pipes_mu);
     auto pit = conn->pipes.find(cluster);
     if (pit != conn->pipes.end()) {
@@ -584,7 +610,8 @@ bool relay_try(Server* s, const std::shared_ptr<Conn>& conn,
       pipe = pit->second;
     } else {
       s->active_pumps.fetch_add(1);
-      std::thread(relay_pump, s, conn, pipe, timeout_s).detach();
+      std::thread(relay_pump, s, conn, pipe, timeout_s, idle_expire_s)
+          .detach();
     }
   }
   {
@@ -594,38 +621,32 @@ bool relay_try(Server* s, const std::shared_ptr<Conn>& conn,
   bool sent;
   {
     std::lock_guard<std::mutex> g(pipe->wmu);
-    sent = !pipe->dead.load();
-    if (sent) {
-      int64_t off = 0, n = frame_end - frame;
-      while (off < n) {
-        ssize_t m = ::send(pipe->fd, frame + off, size_t(n - off),
-                           MSG_NOSIGNAL);
-        if (m <= 0) {
-          sent = false;
-          break;
-        }
-        off += m;
-      }
-    }
+    sent = !pipe->dead.load() &&
+           send_all_fd(pipe->fd, frame, frame_end - frame);
   }
   if (!sent) {
+    // whether WE still own the msgid decides who answers: if the pump
+    // already swept it into its orphan set (backend died between our
+    // enqueue and send), a synthesized error response is on its way to
+    // the client — falling back to Python here would produce a SECOND
+    // response and a double-applied request on client retry
+    bool owned = false;
     {
       std::lock_guard<std::mutex> g(pipe->omu);
       for (auto it = pipe->outstanding.begin();
            it != pipe->outstanding.end(); ++it)
         if (*it == msgid) {
           pipe->outstanding.erase(it);
+          owned = true;
           break;
         }
     }
     pipe->dead.store(true);
     ::shutdown(pipe->fd, SHUT_RDWR);
-    return false;  // Python path serves this request
+    if (owned) return false;  // no response went out: Python serves it
+    return true;              // the pump's synthesized error answers it
   }
-  {
-    std::lock_guard<std::mutex> g(s->relay.mu);
-    s->relay.counts[method] += 1;
-  }
+  mit->second->fetch_add(1, std::memory_order_relaxed);
   return true;
 }
 
@@ -859,7 +880,8 @@ void jt_rpc_destroy(void* handle) {
 // stall budget per pipe. Passing empty methods or clusters disables the
 // fast path (every request falls back to the Python callback).
 int jt_rpc_relay_config(void* handle, const char* methods_nl,
-                        const char* clusters_spec, double timeout_s) {
+                        const char* clusters_spec, double timeout_s,
+                        double idle_expire_s) {
   Server* s = static_cast<Server*>(handle);
   std::set<std::string> methods;
   std::map<std::string,
@@ -902,16 +924,26 @@ int jt_rpc_relay_config(void* handle, const char* methods_nl,
   bool on = !methods.empty() && !clusters.empty();
   {
     std::lock_guard<std::mutex> g(s->relay.mu);
-    s->relay.methods.swap(methods);
-    s->relay.clusters.swap(clusters);
-    s->relay.timeout_s = timeout_s > 0 ? timeout_s : 10.0;
-    s->relay.generation += 1;
+    auto next = std::make_shared<RelayTable>();
+    for (const std::string& name : methods) {
+      auto& slot = s->relay.counts[name];
+      if (!slot) slot.reset(new std::atomic<uint64_t>(0));
+      next->methods[name] = slot.get();
+    }
+    next->clusters.swap(clusters);
+    next->timeout_s = timeout_s > 0 ? timeout_s : 10.0;
+    next->idle_expire_s = idle_expire_s > 0 ? idle_expire_s : 60.0;
+    next->generation =
+        (s->relay.table ? s->relay.table->generation : 0) + 1;
+    std::atomic_store(&s->relay.table,
+                      std::shared_ptr<const RelayTable>(next));
   }
   s->relay.enabled.store(on, std::memory_order_relaxed);
   return 0;
 }
 
-// Dump per-method relayed-request counts as "method\tcount\n" lines.
+// Dump per-method relayed-request counts as "method\tcount\n" lines,
+// plus a "__errors__" line counting synthesized backend-loss responses.
 // Returns bytes written, or -(bytes needed) when cap is too small.
 int64_t jt_rpc_relay_stats(void* handle, char* buf, int64_t cap) {
   Server* s = static_cast<Server*>(handle);
@@ -921,10 +953,13 @@ int64_t jt_rpc_relay_stats(void* handle, char* buf, int64_t cap) {
     for (auto& kv : s->relay.counts) {
       out += kv.first;
       out += '\t';
-      out += std::to_string(kv.second);
+      out += std::to_string(kv.second->load(std::memory_order_relaxed));
       out += '\n';
     }
   }
+  out += "__errors__\t";
+  out += std::to_string(s->relay.errors.load(std::memory_order_relaxed));
+  out += '\n';
   if (int64_t(out.size()) > cap) return -int64_t(out.size());
   memcpy(buf, out.data(), out.size());
   return int64_t(out.size());
