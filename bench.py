@@ -109,7 +109,7 @@ def cpp_arow_baseline(idx, val, labels, r=1.0, dim=None):
     return (sps, "cpp -O3") if sps > 0 else (None, "zero result")
 
 
-def _tunnel_alive(probe_timeout_s: float = 120.0) -> bool:
+def _tunnel_alive(probe_timeout_s: float = None) -> bool:  # type: ignore[assignment]
     """Ask a FRESH subprocess whether the device tunnel answers.
 
     Once backend init hangs in a process that process is lost for device
@@ -120,6 +120,12 @@ def _tunnel_alive(probe_timeout_s: float = 120.0) -> bool:
     import subprocess
     import sys
 
+    if probe_timeout_s is None:
+        # 90 s: a healthy tunnel answers a fresh process well inside this
+        # (init measured 20-40 s), while a wedged one costs each ladder
+        # attempt only this much; override for unusually slow links
+        probe_timeout_s = float(
+            os.environ.get("JUBATUS_BENCH_TUNNEL_PROBE_TIMEOUT", "90"))
     prog = (
         "import os, threading\n"
         "res = {}\n"
@@ -186,10 +192,10 @@ def _probe_device(timeout_s: float = None):  # type: ignore[assignment]
         sys.exit(1)
     # this process is lost (init hung holds the backend lock); decide the
     # NEXT process's platform by probing the tunnel with backoff
-    # 2, not more: each attempt costs up to ~3 min (probe + backoff) on a
-    # wedged tunnel, and the whole capture must stay inside the driver's
-    # window — the cron-style re-probe across the round is the real
-    # second chance, not a longer ladder here
+    # 2, not more: each attempt costs up to ~2.5 min (90 s probe + up to
+    # 60 s backoff) on a wedged tunnel, and the whole capture must stay
+    # inside the driver's window — the cron-style re-probe across the
+    # round is the real second chance, not a longer ladder here
     attempts = int(os.environ.get("JUBATUS_BENCH_PROBE_ATTEMPTS", "2"))
     reexecs = int(os.environ.get("_JUBATUS_BENCH_CHIP_REEXECS", "0"))
     revived = False

@@ -192,6 +192,18 @@ def test_member_cache_invalidation():
             s.stop()
 
 
+def _skip_unless_native():
+    """Shared gate for the C++ relay tests (one owner for the condition)."""
+    import os
+
+    if os.environ.get("JUBATUS_TPU_NATIVE_RPC", "") in ("0", "false", "no"):
+        pytest.skip("python transport forced")
+    from jubatus_tpu.rpc import native_server
+
+    if not native_server.available():
+        pytest.skip("native rpc front-end unavailable")
+
+
 def test_cpp_relay_plane_serves_and_counts():
     """Native transport: after the refresher's first table push, random-
     routed raw traffic forwards entirely in C++ (rpc_frontend.cpp relay)
@@ -200,12 +212,7 @@ def test_cpp_relay_plane_serves_and_counts():
     import os
     import time
 
-    if os.environ.get("JUBATUS_TPU_NATIVE_RPC", "") in ("0", "false", "no"):
-        pytest.skip("python transport forced")
-    from jubatus_tpu.rpc import native_server
-
-    if not native_server.available():
-        pytest.skip("native rpc front-end unavailable")
+    _skip_unless_native()
     store = _Store()
     servers = _boot("classifier", CLASSIFIER_CONF, 2, store)
     proxy = _proxy("classifier", store)
@@ -263,12 +270,7 @@ def test_cpp_relay_reroutes_on_membership_change():
     import os
     import time
 
-    if os.environ.get("JUBATUS_TPU_NATIVE_RPC", "") in ("0", "false", "no"):
-        pytest.skip("python transport forced")
-    from jubatus_tpu.rpc import native_server
-
-    if not native_server.available():
-        pytest.skip("native rpc front-end unavailable")
+    _skip_unless_native()
     store = _Store()
     servers = _boot("classifier", CLASSIFIER_CONF, 2, store)
     proxy = _proxy("classifier", store)
@@ -310,3 +312,57 @@ def test_cpp_relay_reroutes_on_membership_change():
                 s.stop()
             except Exception:  # noqa: BLE001
                 pass
+
+
+def test_cpp_relay_survives_garbage_backend():
+    """A backend that answers garbage (non-msgpack bytes) must break only
+    its pipe: outstanding calls error, the client connection survives,
+    and traffic re-establishes through the Python path / a fresh pipe."""
+    import socket
+    import threading
+    import time
+
+    _skip_unless_native()
+    from jubatus_tpu.rpc import native_server
+
+    # hand-rolled "backend": accepts, reads a bit, spews garbage, closes
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(4)
+    gport = lsock.getsockname()[1]
+
+    def evil():
+        try:
+            conn, _ = lsock.accept()
+            conn.recv(4096)
+            conn.sendall(b"\xc1\xc1\xc1garbage\xff\xff")  # 0xc1 = never valid
+            time.sleep(0.2)
+            conn.close()
+        except OSError:
+            pass
+
+    threading.Thread(target=evil, daemon=True).start()
+
+    srv = native_server.NativeRpcServer()
+    served = []
+    srv.register("probe", lambda n: served.append(n) or "py", arity=1)
+    srv.serve_background(0, host="127.0.0.1")
+    assert srv.relay_config(["probe"], {"c": [("127.0.0.1", gport)]},
+                            timeout=5.0)
+    from jubatus_tpu.rpc.client import RpcClient
+
+    try:
+        with RpcClient("127.0.0.1", srv.port, timeout=10) as cli:
+            # relayed into the garbage backend: must ERROR, not hang
+            with pytest.raises(Exception):
+                cli.call("probe", "c")
+            # the refresher's job in production: the dead backend drops
+            # out of the table; the C++ then declines and Python serves
+            assert srv.relay_config(["probe"], {}, timeout=5.0) is True
+            assert cli.call("probe", "c") == "py"
+            assert served == ["c"]
+            stats = srv.relay_stats()
+            assert stats.get("__errors__", 0) >= 1, stats
+    finally:
+        srv.stop()
+        lsock.close()
