@@ -142,7 +142,7 @@ def _tunnel_alive(probe_timeout_s: float = None) -> bool:  # type: ignore[assign
         "t.start(); t.join(%f)\n"
         "print('ALIVE' if res.get('ok') and res.get('p') != 'cpu'"
         " else 'DEAD')\n"
-        "os._exit(0)\n" % (probe_timeout_s - 10)
+        "os._exit(0)\n" % max(probe_timeout_s - 10.0, probe_timeout_s * 0.5)
     )
     env = dict(os.environ)
     env.pop("JUBATUS_TPU_PLATFORM", None)  # probe the real platform

@@ -209,7 +209,6 @@ def test_cpp_relay_plane_serves_and_counts():
     routed raw traffic forwards entirely in C++ (rpc_frontend.cpp relay)
     — results identical, counts folded into get_proxy_status, and a dead
     backend degrades to the Python path instead of wedging."""
-    import os
     import time
 
     _skip_unless_native()
@@ -267,7 +266,6 @@ def test_cpp_relay_reroutes_on_membership_change():
     config generation: traffic re-pins to the survivor without client
     reconnects, and the dead backend's last in-flight calls surface as
     errors, not hangs."""
-    import os
     import time
 
     _skip_unless_native()
@@ -366,3 +364,82 @@ def test_cpp_relay_survives_garbage_backend():
     finally:
         srv.stop()
         lsock.close()
+
+
+def test_cpp_relay_exactly_one_response_under_backend_churn():
+    """Pipelined relayed traffic while the backend dies and returns: every
+    msgid gets EXACTLY one response (backend result or synthesized/Python
+    error) — never zero (hang) and never two (the double-apply hazard the
+    relay's msgid-ownership handoff exists to prevent)."""
+    import socket
+    import threading
+    import time
+
+    import msgpack
+
+    _skip_unless_native()
+    from jubatus_tpu.rpc import native_server
+
+    # flapping backend: a real native rpc server we stop/start; a port
+    # listener vacuum between generations makes connects fail cleanly
+    backend_port = {"srv": None}
+
+    def start_backend(port=0):
+        b = native_server.NativeRpcServer()
+        b.register("probe", lambda n, i: i, arity=2)
+        p = b.serve_background(port, host="127.0.0.1")
+        backend_port["srv"] = b
+        return p
+
+    bport = start_backend()
+    front = native_server.NativeRpcServer()
+    front.register("probe", lambda n, i: -1, arity=2)  # python fallback
+    front.serve_background(0, host="127.0.0.1")
+    assert front.relay_config(["probe"], {"c": [("127.0.0.1", bport)]},
+                              timeout=3.0)
+    sock = socket.create_connection(("127.0.0.1", front.port), timeout=30)
+    unp = msgpack.Unpacker(raw=False)
+    got: dict = {}
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                data = sock.recv(65536)
+            except OSError:
+                return
+            if not data:
+                return
+            unp.feed(data)
+            for msg in unp:
+                got[msg[1]] = got.get(msg[1], 0) + 1
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    total = 400
+    mid = 0
+    try:
+        for wave in range(8):
+            for _ in range(total // 8):
+                mid += 1
+                sock.sendall(msgpack.packb([0, mid, "probe", ["c", mid]],
+                                           use_bin_type=True))
+            if wave % 3 == 1:  # churn: kill the backend mid-wave
+                backend_port["srv"].stop()
+                time.sleep(0.2)
+                newp = start_backend()
+                assert front.relay_config(
+                    ["probe"], {"c": [("127.0.0.1", newp)]}, timeout=3.0)
+            time.sleep(0.15)
+        deadline = time.time() + 20.0
+        while time.time() < deadline and len(got) < total:
+            time.sleep(0.2)
+        assert len(got) == total, f"missing responses: {total - len(got)}"
+        dupes = {k: v for k, v in got.items() if v != 1}
+        assert not dupes, f"duplicated responses: {dupes}"
+    finally:
+        stop.set()
+        sock.close()
+        front.stop()
+        if backend_port["srv"] is not None:
+            backend_port["srv"].stop()
