@@ -210,6 +210,7 @@ jax.config.update("jax_platforms", "cpu")
 pid = int(sys.argv[1]); n = int(sys.argv[2])
 jax_port, coord_dir = sys.argv[3], sys.argv[4]
 dim_bits = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+bf16 = bool(int(sys.argv[6])) if len(sys.argv) > 6 else False
 jax.distributed.initialize(f"127.0.0.1:{jax_port}", num_processes=n,
                            process_id=pid)
 from jubatus_tpu.client import ClassifierClient, Datum
@@ -228,7 +229,7 @@ else:
             "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
 args = ServerArgs(engine="classifier", coordinator=coord_dir, name="mb",
                   listen_addr="127.0.0.1", mixer="collective_mixer",
-                  interval_sec=1e9, interval_count=1 << 30,
+                  interval_sec=1e9, interval_count=1 << 30, mix_bf16=bf16,
                   # north-star payloads (256 MB diffs) need a mixer-plane
                   # timeout matched to the transfer, like the reference's
                   # --interconnect_timeout knob for big models
@@ -272,7 +273,7 @@ if pid == 0:
         leaves, _ = jax.tree_util.tree_flatten(d)
         nbytes += sum(np.asarray(x).nbytes for x in leaves)
     plat = jax.devices()[0].platform
-    tag = f"_d{dim_bits}" if dim_bits else ""
+    tag = (f"_d{dim_bits}" if dim_bits else "") + ("_bf16" if bf16 else "")
     print("COLLECTIVE=" + json.dumps(
         {f"collective_round_ms_nproc{n}{tag}": round(ms, 2),
          f"collective_round{tag}_payload_mb_per_replica":
@@ -357,14 +358,16 @@ def run_jax_world(child_src: str, n: int, timeout: float = 300.0,
 
 
 def collective_nproc(n: int = 4, dim_bits: int = 0,
-                     timeout: float = 300.0) -> dict:
+                     timeout: float = 300.0, bf16: bool = False) -> dict:
     """Timed production collective round across ``n`` OS processes.
     ``dim_bits`` > 0 runs the north-star-scale variant (AROW diffs at
     D=2^dim_bits — w + sigma, 2^dim_bits * L * 2 * 4 bytes f32 per
-    replica)."""
+    replica); ``bf16`` ships the psum compressed (--mix-bf16)."""
     out: dict = {}
-    err_key = f"collective_round{f'_d{dim_bits}' if dim_bits else ''}_error"
-    extra = (str(dim_bits),) if dim_bits else ()
+    tag = (f"_d{dim_bits}" if dim_bits else "") + ("_bf16" if bf16 else "")
+    err_key = f"collective_round{tag}_error"
+    extra = ((str(dim_bits), "1" if bf16 else "0")
+             if (dim_bits or bf16) else ())
     try:
         outs, rcs = run_jax_world(_COLLECTIVE_CHILD, n, timeout=timeout,
                                   extra_args=extra)

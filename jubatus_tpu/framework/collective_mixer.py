@@ -101,8 +101,13 @@ class CollectiveMixer(RpcLinearMixer):
     and the RPC fan-out when it can't (non-sum mixables, world mismatch,
     prepare failures)."""
 
-    def __init__(self, *args, **kwargs) -> None:
+    def __init__(self, *args, compress: bool = False, **kwargs) -> None:
         super().__init__(*args, **kwargs)
+        #: --mix-bf16: the psum ships f32 diffs as bf16 (half the
+        #: interconnect bytes; additive diffs fold into an f32 master).
+        #: Folded into the prepare signature so a mixed-flag cluster
+        #: falls back to the RPC mix instead of wedging the collective.
+        self.compress = compress
         self._staged_lock = threading.Lock()
         self._staged: Dict[str, Dict[str, Any]] = {}
         self._round_seq = 0
@@ -157,6 +162,14 @@ class CollectiveMixer(RpcLinearMixer):
                 # "unsupported" routes the whole round to the RPC mix
                 return [int(self.model_version), "unsupported"]
             diffs = {name: m.get_diff() for name, m in mixables.items()}
+        sig = _signature(diffs)
+        if sig != "unsupported":
+            # the compress flag rides the signature so a mixed-flag
+            # cluster mismatches at prepare; the "unsupported" SENTINEL
+            # must stay bare — the master's fallback check matches it
+            # exactly, and a suffixed sentinel would send a 64-bit round
+            # into the collective it cannot ride
+            sig += f"|bf16={int(self.compress)}"
         with self._staged_lock:
             # one staged round at a time: a newer prepare supersedes any
             # stale round a dead master left behind (its waiter sees the
@@ -164,7 +177,7 @@ class CollectiveMixer(RpcLinearMixer):
             self._staged = {rid: {"diffs": diffs, "union": union}}
         threading.Thread(target=self._wait_for_go, args=(rid,), daemon=True,
                          name="mix-go-wait").start()
-        return [int(self.model_version), _signature(diffs)]
+        return [int(self.model_version), sig]
 
     def local_abort(self, rid) -> bool:
         rid = rid.decode() if isinstance(rid, bytes) else rid
@@ -278,7 +291,7 @@ class CollectiveMixer(RpcLinearMixer):
             return False
         from jubatus_tpu.parallel.collective import psum_pytree
 
-        totals = psum_pytree(entry["diffs"])
+        totals = psum_pytree(entry["diffs"], compress=self.compress)
         return self.local_put_obj({
             "protocol": PROTOCOL_VERSION,
             "schema": entry["union"],

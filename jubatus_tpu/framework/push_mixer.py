@@ -265,7 +265,8 @@ class DummyMixer:
 
 def create_mixer(name: str, driver: Any, comm: LinearCommunication, *,
                  self_node: Optional[NodeInfo] = None,
-                 interval_sec: float = 16.0, interval_count: int = 512):
+                 interval_sec: float = 16.0, interval_count: int = 512,
+                 mix_bf16: bool = False):
     """Mixer factory (≙ create_mixer, mixer_factory.cpp:41-97): selects by
     the --mixer flag."""
     kwargs = dict(self_node=self_node, interval_sec=interval_sec,
@@ -275,7 +276,7 @@ def create_mixer(name: str, driver: Any, comm: LinearCommunication, *,
     if name == "collective_mixer":
         from jubatus_tpu.framework.collective_mixer import CollectiveMixer
 
-        return CollectiveMixer(driver, comm, **kwargs)
+        return CollectiveMixer(driver, comm, compress=mix_bf16, **kwargs)
     if name in STRATEGIES:
         return RpcPushMixer(driver, comm, strategy=name, **kwargs)
     if name == "dummy_mixer":

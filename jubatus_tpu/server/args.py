@@ -60,6 +60,11 @@ class ServerArgs:
     jax_coordinator: str = ""       # host:port of jax process 0
     jax_processes: int = 0          # world size; 0 = no distributed init
     jax_process_id: int = -1
+    #: --mix-bf16: the collective mixer's psum ships f32 diffs as bf16
+    #: (half the interconnect bytes per round; additive diffs fold into
+    #: an f32 master, same tradeoff as the RPC mix's bf16 option). All
+    #: members must agree — a mixed cluster falls back to the RPC mix.
+    mix_bf16: bool = False
 
     @property
     def is_standalone(self) -> bool:
@@ -150,6 +155,12 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "count); 0 disables distributed jax init")
     p.add_argument("--jax-process-id", type=int, default=-1,
                    help="this process's rank in the jax world")
+    p.add_argument("--mix-bf16", action="store_true",
+                   help="collective mixer ships f32 diffs as bf16 over "
+                        "the interconnect (half the bytes per round; "
+                        "additive diffs fold into an f32 master). All "
+                        "members must agree or the round falls back to "
+                        "the RPC mix")
     return p
 
 

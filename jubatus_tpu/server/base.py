@@ -98,6 +98,7 @@ class EngineServer:
                 self_node=NodeInfo(self.args.eth, self.args.rpc_port),
                 interval_sec=self.args.interval_sec,
                 interval_count=self.args.interval_count,
+                mix_bf16=getattr(self.args, "mix_bf16", False),
             )
             self.mixer.set_trace_registry(self.rpc.trace)
             # cluster-unique id minting for the engines that mint ids

@@ -84,9 +84,10 @@ from jubatus_tpu.server.args import ServerArgs
 
 CONF = {"method": "PA", "parameter": {"regularization_weight": 1.0},
         "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+bf16 = bool(int(sys.argv[5])) if len(sys.argv) > 5 else False
 args = ServerArgs(engine="classifier", coordinator=coord_dir, name="cm",
                   listen_addr="127.0.0.1", mixer="collective_mixer",
-                  interval_sec=1e9, interval_count=1 << 30)
+                  interval_sec=1e9, interval_count=1 << 30, mix_bf16=bf16)
 srv = EngineServer("classifier", CONF, args)
 port = srv.start(0)
 
@@ -134,13 +135,18 @@ print(f"CHILD-{pid}-OK", flush=True)
 
 
 @pytest.mark.slow
-def test_multiprocess_collective_mix():
+@pytest.mark.parametrize("bf16", [False, True])
+def test_multiprocess_collective_mix(bf16):
     # one harness owns port pick / env scrub / concurrent pipe drain /
-    # cleanup for every jax.distributed multi-process launch
+    # cleanup for every jax.distributed multi-process launch. bf16=True
+    # exercises --mix-bf16: the psum ships compressed diffs, and the
+    # cross-replica knowledge assertions prove the quantized totals
+    # still train the cluster
     import bench_mix
 
     n = 3
-    outs, rcs = bench_mix.run_jax_world(_CHILD, n, timeout=180)
+    outs, rcs = bench_mix.run_jax_world(
+        _CHILD, n, timeout=180, extra_args=("1" if bf16 else "0",))
     for i, (out, rc) in enumerate(zip(outs, rcs)):
         assert rc == 0, f"child {i} exit {rc}:\n{out[-3000:]}"
         assert f"CHILD-{i}-OK" in out, f"child {i}:\n{out[-3000:]}"
@@ -273,5 +279,49 @@ def test_go_observed_only_at_final_check_still_enters(monkeypatch):
         assert entered and entered[0] == ("late-round", 7)
         assert not srv.mixer.collective_dead
         c.close()
+    finally:
+        srv.stop()
+
+
+def test_64bit_diff_signature_stays_bare_unsupported():
+    """The '|bf16=N' signature suffix must never decorate the
+    'unsupported' SENTINEL: the master's fallback check matches the
+    sentinel exactly, and a suffixed one would route a 64-bit round into
+    a collective that raises on every member (review r4)."""
+    import numpy as np
+
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    store = _Store()
+    srv = EngineServer(
+        "classifier",
+        {"method": "PA", "parameter": {"regularization_weight": 1.0},
+         "converter": {"num_rules": [{"key": "*", "type": "num"}]}},
+        ServerArgs(engine="classifier", coordinator="(shared)", name="sb",
+                   listen_addr="127.0.0.1", mixer="collective_mixer",
+                   interval_sec=1e9, interval_count=1 << 30, mix_bf16=True),
+        coord=MemoryCoordinator(store))
+    srv.start(0)
+    try:
+        # supported diffs: signature carries the compress flag
+        _v, sig = srv.mixer.local_prepare("r1", [])
+        assert sig.endswith("|bf16=1"), sig
+        srv.mixer.local_abort("r1")
+        # force a 64-bit leaf into the diff: sentinel must stay bare
+        mixable = srv.driver.get_mixables()["classifier"]
+        orig = mixable.__class__.get_diff
+
+        def poisoned(self):
+            d = orig(self)
+            d["poison"] = np.zeros(4, np.float64)
+            return d
+
+        import unittest.mock as um
+        with um.patch.object(mixable.__class__, "get_diff", poisoned):
+            _v, sig = srv.mixer.local_prepare("r2", [])
+        assert sig == "unsupported", sig
+        srv.mixer.local_abort("r2")
     finally:
         srv.stop()

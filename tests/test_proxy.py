@@ -435,6 +435,8 @@ def test_cpp_relay_exactly_one_response_under_backend_churn():
         while time.time() < deadline and len(got) < total:
             time.sleep(0.2)
         assert len(got) == total, f"missing responses: {total - len(got)}"
+        time.sleep(1.0)  # settle: a LATE duplicate must not escape
+        assert len(got) == total
         dupes = {k: v for k, v in got.items() if v != 1}
         assert not dupes, f"duplicated responses: {dupes}"
     finally:
