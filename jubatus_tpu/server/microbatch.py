@@ -57,16 +57,23 @@ class Coalescer:
 
     def __init__(self, flush_fn: Callable[[List[Any]], Any],
                  max_batch: int = 8192,
-                 weigher: Callable[[Any], int] | None = None) -> None:
+                 weigher: Callable[[Any], int] | None = None,
+                 split_results: bool = False) -> None:
         """``weigher(item) -> examples`` lets one item represent a whole
         request's batch (the native fast path queues per-REQUEST array
         triples — far less Python object churn than per-example rows);
-        max_batch then bounds examples, not items. Default: 1 per item."""
+        max_batch then bounds examples, not items. Default: 1 per item.
+
+        ``split_results``: QUERY-plane mode — ``flush_fn`` must return a
+        sequence with one entry per submitted item, and each submitter
+        receives exactly its own slice (train flushes return one shared
+        scalar instead, the default)."""
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self._flush = flush_fn
         self._max_batch = max_batch
         self._weigher = weigher
+        self._split = split_results
         self._lock = threading.Lock()
         self._pending_items: List[Any] = []
         self._pending_tickets: List[_Ticket] = []
@@ -88,7 +95,9 @@ class Coalescer:
         with a message saying the update may still land."""
         items = list(items)
         if not items:
-            return self._flush([])
+            # split mode's contract is one result per item — for zero
+            # items that is an empty sequence, not a flush of nothing
+            return [] if self._split else self._flush([])
         if timeout is not None and timeout <= 0:
             timeout = None
         weight = (sum(self._weigher(i) for i in items)
@@ -111,11 +120,14 @@ class Coalescer:
                     self._pending_tickets.pop(i)
                     raise TimeoutError(
                         "microbatch flush did not start in time "
-                        "(items withdrawn; model NOT updated)")
+                        + ("(query withdrawn)" if self._split else
+                           "(items withdrawn; model NOT updated)"))
             if not ticket.event.wait(timeout):
                 raise TimeoutError(
-                    "microbatch flush still running after grace period — "
-                    "the update may still be applied; do not blind-retry")
+                    "microbatch flush still running after grace period"
+                    + ("" if self._split else
+                       " — the update may still be applied; "
+                       "do not blind-retry"))
         if ticket.error is not None:
             raise ticket.error
         return ticket.result
@@ -145,8 +157,18 @@ class Coalescer:
                     del self._pending_items[:t.count]
             try:
                 result = self._flush(batch)
-                for t in tickets:
-                    t.result = result
+                if self._split:
+                    if len(result) != len(batch):
+                        raise RuntimeError(
+                            f"split flush returned {len(result)} results "
+                            f"for {len(batch)} items")
+                    off = 0
+                    for t in tickets:
+                        t.result = result[off:off + t.count]
+                        off += t.count
+                else:
+                    for t in tickets:
+                        t.result = result
             except BaseException as e:  # noqa: BLE001 — deliver to callers
                 for t in tickets:
                     t.error = e

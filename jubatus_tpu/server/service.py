@@ -149,26 +149,32 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
 
     import numpy as np
 
-    def flush_requests(reqs):
-        """Each item is one request's (labels, idx [B,K], val [B,K]) —
-        arrays concatenate at numpy speed (widths are already pow2-bucketed
-        by the parser, so pads are rare and small). ``labels`` is a float32
-        target array (regression) or a (uniq_labels, label_idx) pair from
-        the C++ dedup — merging unions the uniq sets and remaps each
-        request's index array, so no per-example Python loop ever runs."""
-        if not reqs:
-            return 0
-        kmax = max(r[1].shape[1] for r in reqs)
+    def _pad_concat(pairs):
+        """Merge per-request (idx, val) pairs into one batch: pad widths
+        to the max (already pow2-bucketed by the parser, so pads are rare
+        and small) and concatenate at numpy speed. ONE owner for both the
+        train and query flush paths."""
+        kmax = max(i.shape[1] for i, _ in pairs)
         parts_i, parts_v = [], []
-        for _lb, ir, vr in reqs:
+        for ir, vr in pairs:
             if ir.shape[1] != kmax:
                 pad = kmax - ir.shape[1]
                 ir = np.pad(ir, ((0, 0), (0, pad)))
                 vr = np.pad(vr, ((0, 0), (0, pad)))
             parts_i.append(ir)
             parts_v.append(vr)
-        idx = np.concatenate(parts_i) if len(parts_i) > 1 else parts_i[0]
-        val = np.concatenate(parts_v) if len(parts_v) > 1 else parts_v[0]
+        return (np.concatenate(parts_i) if len(parts_i) > 1 else parts_i[0],
+                np.concatenate(parts_v) if len(parts_v) > 1 else parts_v[0])
+
+    def flush_requests(reqs):
+        """Each item is one request's (labels, idx [B,K], val [B,K]).
+        ``labels`` is a float32 target array (regression) or a
+        (uniq_labels, label_idx) pair from the C++ dedup — merging unions
+        the uniq sets and remaps each request's index array, so no
+        per-example Python loop ever runs."""
+        if not reqs:
+            return 0
+        idx, val = _pad_concat([(ir, vr) for _lb, ir, vr in reqs])
         if numeric:
             labels = np.concatenate([r[0] for r in reqs]) \
                 if len(reqs) > 1 else reqs[0][0]
@@ -230,22 +236,68 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
                 return parser.parse_datums(raw_params, weights=weights)
         return parser.parse_datums(raw_params)
 
+    def _query_coalescer(name: str, score_batch):
+        """Query-plane microbatching (the mirror of the train coalescer):
+        concurrent read requests join ONE device dispatch against the
+        same model snapshot — every kernel launch costs ~ms on an
+        accelerator regardless of batch size, so per-request dispatch
+        caps the query plane at launches/s, not samples/s.
+        ``score_batch(idx, val) -> per-row results``; each request gets
+        exactly its rows back (Coalescer split_results)."""
+        def query_flush(items):
+            if len(items) == 1:
+                i, v = items[0]
+                return [score_batch(i, v)]
+            rows = score_batch(*_pad_concat(items))
+            out, off = [], 0
+            for i, _ in items:
+                out.append(rows[off:off + i.shape[0]])
+                off += i.shape[0]
+            return out
+
+        qco = Coalescer(query_flush, max_batch=max_batch,
+                        weigher=lambda it: it[0].shape[0],
+                        split_results=True)
+        server.coalescers[name] = qco
+
+        def raw_handler(raw_params: bytes):
+            parsed = _parse_datums(raw_params)
+            if parsed is None:
+                return RAW_FALLBACK
+            idx, val = parsed
+            if idx.shape[0] == 0:
+                return []
+            (mine,) = qco.submit([(idx, val)], timeout=wait_s)
+            return mine
+
+        return raw_handler
+
     if numeric and hasattr(driver, "estimate_hashed"):
-        def estimate_raw(raw_params: bytes):
-            parsed = _parse_datums(raw_params)
-            if parsed is None:
-                return RAW_FALLBACK
-            return driver.estimate_hashed(*parsed)
+        if max_batch:
+            rpc.register_raw("estimate", _query_coalescer(
+                "estimate_raw", driver.estimate_hashed))
+        else:
+            def estimate_raw(raw_params: bytes):
+                parsed = _parse_datums(raw_params)
+                if parsed is None:
+                    return RAW_FALLBACK
+                return driver.estimate_hashed(*parsed)
 
-        rpc.register_raw("estimate", estimate_raw)
+            rpc.register_raw("estimate", estimate_raw)
     elif not numeric and hasattr(driver, "classify_hashed"):
-        def classify_raw(raw_params: bytes):
-            parsed = _parse_datums(raw_params)
-            if parsed is None:
-                return RAW_FALLBACK
-            return [_scored(r) for r in driver.classify_hashed(*parsed)]
+        if max_batch:
+            rpc.register_raw("classify", _query_coalescer(
+                "classify_raw",
+                lambda i, v: [_scored(r)
+                              for r in driver.classify_hashed(i, v)]))
+        else:
+            def classify_raw(raw_params: bytes):
+                parsed = _parse_datums(raw_params)
+                if parsed is None:
+                    return RAW_FALLBACK
+                return [_scored(r) for r in driver.classify_hashed(*parsed)]
 
-        rpc.register_raw("classify", classify_raw)
+            rpc.register_raw("classify", classify_raw)
 
 
 @_binder("classifier")

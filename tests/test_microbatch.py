@@ -177,3 +177,40 @@ def test_server_train_rpcs_coalesce():
             assert len(c.classify([Datum({"x": 1.0}).to_msgpack()])) == 1
     finally:
         srv.stop()
+
+
+def test_split_results_each_ticket_gets_its_slice():
+    """Query-plane mode: the flush returns per-item results and every
+    submitter receives exactly its own rows, under real concurrency."""
+    import threading
+
+    from jubatus_tpu.server.microbatch import Coalescer
+
+    def flush(items):
+        return [f"r{x}" for x in items]
+
+    co = Coalescer(flush, max_batch=64, split_results=True)
+    out = {}
+    barrier = threading.Barrier(8)
+
+    def worker(k):
+        barrier.wait()
+        out[k] = co.submit([k * 10 + j for j in range(3)])
+
+    ts = [threading.Thread(target=worker, args=(k,)) for k in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for k in range(8):
+        assert out[k] == [f"r{k * 10 + j}" for j in range(3)], out[k]
+
+
+def test_split_results_wrong_length_surfaces_error():
+    from jubatus_tpu.server.microbatch import Coalescer
+
+    co = Coalescer(lambda items: ["only-one"], max_batch=8,
+                   split_results=True)
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="split flush returned"):
+        co.submit(["a", "b"])
