@@ -109,50 +109,15 @@ def cpp_arow_baseline(idx, val, labels, r=1.0, dim=None):
     return (sps, "cpp -O3") if sps > 0 else (None, "zero result")
 
 
+# probe program, liveness verdict, round numbering and the durable/
+# compact output path live in the jax-free benchlib so the re-probe
+# daemon (tools/tunnel_reprobe.py) and the unit tests share them without
+# importing the device stack
+from benchlib import emit, probe_tunnel, tunnel_is_alive  # noqa: E402
+
+
 def _tunnel_alive(probe_timeout_s: float = None) -> bool:  # type: ignore[assignment]
-    """Ask a FRESH subprocess whether the device tunnel answers.
-
-    Once backend init hangs in a process that process is lost for device
-    work (later jax calls join the same init lock), so liveness must be
-    probed out-of-process. The child runs its own watchdog thread and
-    exits cleanly via os._exit — it is never killed mid-device-op, which
-    is what wedges the tunnel in the first place."""
-    import subprocess
-    import sys
-
-    if probe_timeout_s is None:
-        # 90 s: a healthy tunnel answers a fresh process well inside this
-        # (init measured 20-40 s), while a wedged one costs each ladder
-        # attempt only this much; override for unusually slow links
-        probe_timeout_s = float(
-            os.environ.get("JUBATUS_BENCH_TUNNEL_PROBE_TIMEOUT", "90"))
-    prog = (
-        "import os, threading\n"
-        "res = {}\n"
-        "def probe():\n"
-        "    try:\n"
-        "        import jax, jax.numpy as jnp\n"
-        "        d = jax.devices()[0]\n"
-        "        res['p'] = d.platform\n"
-        "        float(jnp.arange(4).sum())\n"
-        "        res['ok'] = True\n"
-        "    except Exception:\n"
-        "        pass\n"
-        "t = threading.Thread(target=probe, daemon=True)\n"
-        "t.start(); t.join(%f)\n"
-        "print('ALIVE' if res.get('ok') and res.get('p') != 'cpu'"
-        " else 'DEAD')\n"
-        "os._exit(0)\n" % max(probe_timeout_s - 10.0, probe_timeout_s * 0.5)
-    )
-    env = dict(os.environ)
-    env.pop("JUBATUS_TPU_PLATFORM", None)  # probe the real platform
-    try:
-        proc = subprocess.run([sys.executable, "-c", prog], env=env,
-                              capture_output=True, text=True,
-                              timeout=probe_timeout_s)
-        return "ALIVE" in proc.stdout
-    except Exception:  # noqa: BLE001
-        return False
+    return tunnel_is_alive(probe_tunnel(probe_timeout_s))
 
 
 def _probe_device(timeout_s: float = None):  # type: ignore[assignment]
@@ -392,22 +357,32 @@ def main():
 
     extra["baseline_impl"] = base_impl
     extra["baseline_samples_per_sec"] = round(base_sps, 1)
-    print(
-        json.dumps(
-            {
-                "metric": "classifier_train_samples_per_sec_arow_d2^20",
-                "value": round(tpu_sps, 1),
-                "unit": "samples/s",
-                "vs_baseline": round(tpu_sps / base_sps, 2),
-                "extra": extra,
-            }
-        )
-    )
+    payload = {
+        "metric": "classifier_train_samples_per_sec_arow_d2^20",
+        "value": round(tpu_sps, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(tpu_sps / base_sps, 2),
+        "extra": extra,
+    }
+    emit(payload)
 
 
 if __name__ == "__main__":
+    import signal
     import sys
 
+    # a Python-level handler runs only between bytecodes, so SIGTERM
+    # (e.g. tools/tunnel_reprobe.py's budget overrun) can never cut an
+    # in-flight device call — the default disposition would, and a kill
+    # mid-device-op wedges the axon tunnel for hours. os._exit, not
+    # sys.exit: a SystemExit raised while blocked in subprocess.run
+    # would be caught by its cleanup clause, which SIGKILLs the child
+    # (the d24/probe worker — possibly mid-device-op). os._exit ends
+    # only this process; children are orphaned, never killed, matching
+    # the daemon's abandon-don't-kill policy. A truly hung device op
+    # means the signal stays pending and the sender abandons us, which
+    # is the designed-for outcome.
+    signal.signal(signal.SIGTERM, lambda s, f: os._exit(143))
     if "--d24-probe" in sys.argv:
         d24_probe()
     else:

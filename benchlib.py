@@ -1,0 +1,230 @@
+"""jax-free bench plumbing shared by bench.py and tools/tunnel_reprobe.py.
+
+Lives in its own module so the long-lived re-probe daemon can import the
+probe program, the liveness verdict, and the round numbering WITHOUT
+pulling the jax/axon import stack into its own process — the daemon's
+whole design is that device init only ever happens in short-lived child
+processes (docs/PERF_NOTES.md "tunnel wedge").
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+#: fresh-subprocess tunnel probe program, the ONE definition of how the
+#: axon tunnel is probed. The child runs its own watchdog thread and
+#: exits via os._exit — it is never killed mid-device-op, which is what
+#: wedges the tunnel.
+TUNNEL_PROBE_PROG = (
+    "import os, signal, threading, time\n"
+    # ignore SIGTERM (e.g. a killpg sweep from tools/tunnel_reprobe.py):
+    # the default disposition would cut an in-flight device init — the
+    # tunnel-wedge trigger. Lifetime stays bounded by the watchdog join
+    # + os._exit below.
+    "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+    "res = {}\n"
+    "def probe():\n"
+    "    try:\n"
+    "        t0 = time.perf_counter()\n"
+    "        import jax, jax.numpy as jnp\n"
+    "        d = jax.devices()[0]\n"
+    "        res['p'] = d.platform\n"
+    "        float(jnp.arange(4).sum())\n"
+    "        res['init_s'] = round(time.perf_counter() - t0, 1)\n"
+    "        res['ok'] = True\n"
+    "    except Exception as e:\n"
+    "        res['err'] = repr(e)[:120]\n"
+    "t = threading.Thread(target=probe, daemon=True)\n"
+    "t.start(); t.join(%f)\n"
+    "import json as _j\n"
+    # flush=True: os._exit skips stdio flushing, and without it the
+    # PROBE line only survives when the ambient env happens to carry
+    # PYTHONUNBUFFERED=1
+    "print('PROBE ' + _j.dumps(res), flush=True)\n"
+    "os._exit(0)\n"
+)
+
+
+def probe_tunnel(probe_timeout_s: float = None) -> dict:  # type: ignore[assignment]
+    """One fresh-subprocess tunnel probe; returns the child's result dict.
+
+    Once backend init hangs in a process that process is lost for device
+    work (later jax calls join the same init lock), so liveness must be
+    probed out-of-process. Keys: ok, p (platform), init_s, err."""
+    if probe_timeout_s is None:
+        # 90 s: a healthy tunnel answers a fresh process well inside this
+        # (init measured 20-40 s), while a wedged one costs each ladder
+        # attempt only this much; override for unusually slow links
+        probe_timeout_s = float(
+            os.environ.get("JUBATUS_BENCH_TUNNEL_PROBE_TIMEOUT", "90"))
+    prog = TUNNEL_PROBE_PROG % max(probe_timeout_s - 10.0,
+                                   probe_timeout_s * 0.5)
+    env = dict(os.environ)
+    env.pop("JUBATUS_TPU_PLATFORM", None)  # probe the real platform
+    try:
+        # outer timeout is a DISTANT backstop (watchdog + 90 s), so the
+        # child's own watchdog thread + os._exit is what ends a hung
+        # probe; subprocess.run's SIGKILL only fires if the interpreter
+        # itself never reached the watchdog — keep that window rare,
+        # a SIGKILL mid-device-op is the tunnel-wedge trigger
+        proc = subprocess.run([sys.executable, "-c", prog], env=env,
+                              capture_output=True, text=True,
+                              timeout=probe_timeout_s + 90.0)
+        for line in proc.stdout.splitlines():
+            if line.startswith("PROBE "):
+                return json.loads(line[6:])
+        return {"err": "no probe line", "stderr": (proc.stderr or "")[-120:]}
+    except subprocess.TimeoutExpired:
+        return {"err": "probe subprocess timeout"}
+    except Exception as e:  # noqa: BLE001
+        return {"err": repr(e)[:120]}
+
+
+def tunnel_is_alive(res: dict) -> bool:
+    """The shared liveness verdict over a probe_tunnel() result."""
+    return bool(res.get("ok")) and res.get("p") not in (None, "cpu")
+
+
+#: compact-summary key budget. The driver keeps only the LAST ~2000 chars
+#: of stdout; round 4's headline keys printed first and were cut off the
+#: artifact of record (VERDICT r4 "What's weak" #1). The summary stays
+#: under this so metric+platform+headline always survive the window.
+SUMMARY_BYTES = 1800
+
+#: extra-keys priority for the compact summary, most critical first: the
+#: platform label and headline context, then the chip/d24 axis, then the
+#: serving plane, then mix. Everything else rides in BENCH_FULL only.
+SUMMARY_EXACT = (
+    "bench_platform",
+    "full_write_error",
+    "baseline_impl",
+    "baseline_samples_per_sec",
+    "tpu_d2^24_samples_per_sec",
+    "cpu_jax_d2^24_samples_per_sec",
+    "baseline_cpp_d2^24_samples_per_sec",
+    "tpu_d2^24_error",
+    "e2e_rpc_train_samples_per_sec_native",
+    "e2e_rpc_train_samples_per_sec_python",
+    "e2e_transport_ratio_native_vs_python",
+    "e2e_proxy_vs_direct",
+    "e2e_rpc_train_samples_per_sec_combo",
+    "e2e_rpc_train_samples_per_sec_combo_python",
+    "e2e_combo_native_vs_python",
+    "e2e_combo_features_per_datum",
+    "e2e_rpc_train_samples_per_sec_text_filter",
+    "e2e_fast_path_fraction_text_filter",
+    "e2e_rpc_classify_samples_per_sec_native",
+    "e2e_classify_dispatches_per_sec",
+    "e2e_classify_avg_coalesced_batch",
+    "e2e_mixed_train_classify_samples_per_sec",
+    "mix_round_worst_ms",
+    "mix_under_1s_target",
+    "collective_round_ms_nproc4_d24",
+    "collective_round_d24_platform",
+)
+#: prefix fallback order for keys not named above
+SUMMARY_PREFIX = ("e2e_", "mix_", "collective_", "chip_", "cpu_", "tpu_")
+
+
+def summarize(payload: dict, full_name: str) -> dict:
+    """The <=SUMMARY_BYTES digest of a full bench payload.
+
+    Keys enter by SUMMARY_EXACT order, then SUMMARY_PREFIX groups, then
+    the rest, until the serialized summary would exceed the budget;
+    "keys_dropped" counts what only BENCH_FULL carries."""
+    head = {k: payload[k] for k in ("metric", "value", "unit", "vs_baseline")}
+    head["full"] = full_name
+    extra = payload.get("extra", {})
+    ordered = [k for k in SUMMARY_EXACT if k in extra]
+    seen = set(ordered)
+    for pref in SUMMARY_PREFIX:
+        ordered += sorted(k for k in extra
+                          if k.startswith(pref) and k not in seen)
+        seen.update(ordered)
+    ordered += sorted(k for k in extra if k not in seen)
+    out = dict(head)
+    out["extra"] = {}
+    dropped = 0
+    for k in ordered:
+        trial = dict(out)
+        trial["extra"] = {**out["extra"], k: extra[k]}
+        # size against the WORST-CASE dropped count so the final patch
+        # below can only shrink the line, never push it past the budget
+        trial["keys_dropped"] = len(extra)
+        if len(json.dumps(trial)) > SUMMARY_BYTES:
+            dropped += 1
+            continue
+        out = trial
+    out["keys_dropped"] = dropped
+    return out
+
+
+def _is_chip(platform) -> bool:
+    return platform in ("tpu", "axon")
+
+
+def emit(payload: dict) -> None:
+    """Durable-then-compact output (VERDICT r4 next-round #1).
+
+    The FULL payload goes to BENCH_FULL_r{N}.json in the repo (the
+    durable artifact, like linear_mixer.cpp:553-558's per-round log) and
+    to stderr for interactive runs; stdout gets exactly one compact JSON
+    line, printed LAST, sized to survive a last-2000-chars window."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    full_name = f"BENCH_FULL_r{current_round():02d}.json"
+    # a chip capture is never clobbered: a later tunnel-down run diverts
+    # to a _cpu file, and a SECOND chip run (wedge + revival, capture
+    # slot 2) diverts to a numbered sibling — every capture survives
+    plat = payload.get("extra", {}).get("bench_platform")
+    path = os.path.join(here, full_name)
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                prev = json.load(f).get("extra", {}).get("bench_platform")
+        except (OSError, ValueError):
+            prev = None
+        if _is_chip(prev):
+            if not _is_chip(plat):
+                full_name = full_name[:-5] + "_cpu.json"
+            else:
+                n = 2
+                while os.path.exists(os.path.join(
+                        here, f"{full_name[:-5]}_{n}.json")):
+                    n += 1
+                full_name = f"{full_name[:-5]}_{n}.json"
+            path = os.path.join(here, full_name)
+    try:
+        with open(path, "w") as f:
+            f.write(json.dumps(payload, indent=1, sort_keys=True) + "\n")
+    except OSError as e:
+        payload.setdefault("extra", {})["full_write_error"] = repr(e)[:120]
+        full_name = None  # the pointer must not name a file that isn't there
+    # serialize AFTER any error-key mutation so stderr carries it too
+    print(json.dumps(payload, indent=1, sort_keys=True), file=sys.stderr)
+    sys.stderr.flush()
+    print(json.dumps(summarize(payload, full_name)))
+    sys.stdout.flush()
+
+
+def current_round() -> int:
+    """The round now in progress, from the driver's BENCH_r{N}.json trail.
+
+    The driver writes BENCH_r{N}.json at the END of round N, so the
+    in-progress round is max(N)+1. JUBATUS_BENCH_ROUND overrides (e.g. a
+    re-run inside an already-captured round). Non-numeric matches are
+    skipped, never fatal — bench.emit() must not crash at the end of a
+    run."""
+    import glob
+    import re
+
+    env = os.environ.get("JUBATUS_BENCH_ROUND")
+    if env and env.isdigit():
+        return int(env)
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for p in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(p))
+        if m:
+            rounds.append(int(m.group(1)))
+    return (max(rounds) + 1) if rounds else 1
