@@ -120,6 +120,22 @@ def _tunnel_alive(probe_timeout_s: float = None) -> bool:  # type: ignore[assign
     return tunnel_is_alive(probe_tunnel(probe_timeout_s))
 
 
+#: set by the SIGTERM handler (see __main__); checked between phases
+_TERM = {"req": False}
+
+
+def _term_checkpoint(where: str) -> None:
+    """Exit at a phase boundary if SIGTERM arrived mid-phase. Boundaries
+    are the only safe exits: within a phase, device ops may be in flight
+    on worker threads (serving) or in children (d24/mix)."""
+    if _TERM["req"]:
+        import sys
+
+        print(f"SIGTERM received; exiting at phase boundary: {where}",
+              file=sys.stderr)
+        os._exit(143)
+
+
 def _probe_device(timeout_s: float = None):  # type: ignore[assignment]
     """Backend init under a watchdog: the axon tunnel can hang
     indefinitely, and a bench that never prints its JSON line is worse
@@ -294,6 +310,7 @@ def main():
             extra["tpu_d2^24_error"] = (proc.stderr or "no output")[-160:]
     except Exception as e:  # noqa: BLE001
         extra["tpu_d2^24_error"] = repr(e)[:160]
+    _term_checkpoint("after d24 probe")
     # --- baseline: faithful sequential C++ AROW, numpy fallback ---
     bi, bv, bl = make_data(rng, BASELINE_EXAMPLES)
     base_sps, base_impl = cpp_arow_baseline(bi, bv, bl)
@@ -320,6 +337,7 @@ def main():
         extra.update(bench_mix.collect(dev))
     except Exception as e:  # noqa: BLE001 — headline must still print
         extra["mix_error"] = repr(e)[:200]
+    _term_checkpoint("after mix plane")
 
     # --- chip-advantage axes (VERDICT r2 item 7): L-scaling flat-vs-linear
     # --- and the CPU lock-contention row, captured by the driver itself ---
@@ -345,6 +363,7 @@ def main():
             extra.update(bench_chip_axes.chip_l_sweep())
         except Exception as e:  # noqa: BLE001
             extra["chip_l_error"] = repr(e)[:200]
+    _term_checkpoint("after chip axes")
 
     # --- end-to-end serving path (VERDICT r1 item 2: the product, not the
     # --- kernel: RPC decode -> datum -> fv convert -> device) ---
@@ -371,18 +390,20 @@ if __name__ == "__main__":
     import signal
     import sys
 
-    # a Python-level handler runs only between bytecodes, so SIGTERM
-    # (e.g. tools/tunnel_reprobe.py's budget overrun) can never cut an
-    # in-flight device call — the default disposition would, and a kill
-    # mid-device-op wedges the axon tunnel for hours. os._exit, not
-    # sys.exit: a SystemExit raised while blocked in subprocess.run
-    # would be caught by its cleanup clause, which SIGKILLs the child
-    # (the d24/probe worker — possibly mid-device-op). os._exit ends
-    # only this process; children are orphaned, never killed, matching
-    # the daemon's abandon-don't-kill policy. A truly hung device op
-    # means the signal stays pending and the sender abandons us, which
-    # is the designed-for outcome.
-    signal.signal(signal.SIGTERM, lambda s, f: os._exit(143))
+    # SIGTERM (e.g. tools/tunnel_reprobe.py's budget overrun) must never
+    # cut an in-flight device op — that wedges the axon tunnel for
+    # hours. The default disposition would; an immediate os._exit would
+    # too, because it kills WORKER THREADS (the serving phase runs an
+    # in-process EngineServer whose flushes dispatch on RPC threads).
+    # So the handler only sets a flag; _term_checkpoint() exits at phase
+    # BOUNDARIES, where no in-process device work is in flight (each
+    # phase joins its servers/children before returning). A bench hung
+    # inside one phase simply never exits — the sender abandons us,
+    # which is the designed-for outcome. Other processes in the capture
+    # group are safe by construction: the d24 child runs this same
+    # handler, bench_mix collective children and serving load
+    # generators are CPU-only (scrub_child_env strips the axon site).
+    signal.signal(signal.SIGTERM, lambda s, f: _TERM.__setitem__("req", True))
     if "--d24-probe" in sys.argv:
         d24_probe()
     else:

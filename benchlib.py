@@ -115,8 +115,10 @@ SUMMARY_EXACT = (
     "e2e_rpc_train_samples_per_sec_text_filter",
     "e2e_fast_path_fraction_text_filter",
     "e2e_rpc_classify_samples_per_sec_native",
-    "e2e_classify_dispatches_per_sec",
-    "e2e_classify_avg_coalesced_batch",
+    "e2e_classify_dispatches_per_sec_native",
+    "e2e_classify_avg_coalesced_batch_native",
+    "e2e_schema_flush_fraction_native",
+    "e2e_schema_query_flush_fraction_native",
     "e2e_mixed_train_classify_samples_per_sec",
     "mix_round_worst_ms",
     "mix_under_1s_target",

@@ -263,6 +263,66 @@ class ClassifierDriver(DriverBase):
         np.add.at(self._dcounts, slots_u, counts[:len(slots_u)])
         return self._train_slots(slots_u[label_idx], idx, val, b)
 
+    @locked
+    def train_indexed_schema(self, uniq_labels: Sequence[str],
+                             label_idx: np.ndarray, uidx: np.ndarray,
+                             val: np.ndarray) -> int:
+        """train_indexed for a UNIFORM-SCHEMA batch: every example shares
+        the hashed index vector ``uidx`` [K] (a fixed key schema — the
+        common production feed; the serving flush detects it). Runs the
+        dense [L, K]-submatrix plan (ops.train_batch_schema): K-descriptor
+        index ops + matmuls instead of B*K-element gathers/scatters —
+        the addressing-floor term (docs/PERF_NOTES.md) drops out
+        entirely. Falls back to the sparse plan under sequential train
+        mode, where exact per-datum semantics take priority."""
+        b = int(label_idx.shape[0])
+        if b == 0:
+            return 0
+        slots_u = np.array([self._ensure_label(lb) for lb in uniq_labels],
+                           dtype=np.int32)
+        counts = np.bincount(label_idx, minlength=len(uniq_labels))
+        np.add.at(self._dcounts, slots_u, counts[:len(slots_u)])
+        slots = slots_u[label_idx]
+        if self.train_mode != "parallel":
+            return self._train_slots(
+                slots, np.broadcast_to(uidx, (b, uidx.shape[0])), val, b)
+        bsz = _bucket(b, 16)
+        if bsz != b:  # zero rows are no-ops (x2 = 0 → alpha 0)
+            val = np.pad(val, ((0, bsz - b), (0, 0)))
+            slots = np.pad(slots, (0, bsz - b))
+        self.state = ops.train_batch_schema(
+            self.state,
+            jnp.asarray(uidx),
+            jnp.asarray(val),
+            jnp.asarray(slots),
+            self._mask(),
+            self.param,
+            method=self.method,
+        )
+        self.event_model_updated(b)
+        return b
+
+    def classify_hashed_schema(self, uidx: np.ndarray,
+                               val: np.ndarray) -> List[List[Tuple[str, float]]]:
+        """classify_hashed for a uniform-schema batch (ops.scores_schema:
+        K descriptors + one matmul). Same lock discipline as
+        classify_hashed: enqueue under the lock, wait unlocked."""
+        n = val.shape[0]
+        if n == 0:
+            return []
+        b = _bucket(n, 16)
+        if b != n:
+            val = np.pad(val, ((0, b - n), (0, 0)))
+        duidx, dval = jnp.asarray(uidx), jnp.asarray(val)
+        with self.lock:
+            if not self.label_slots:
+                return [[] for _ in range(n)]
+            slots = list(self.label_slots.items())
+            pending = ops.scores_schema(self.state, duidx, dval, self._mask())
+        sc = np.asarray(pending)[:n]
+        return [[(lab, float(row[slot]))
+                 for lab, slot in slots] for row in sc]
+
     def classify(self, data: Sequence[Datum]) -> List[List[Tuple[str, float]]]:
         # deliberately NOT @locked: the convert loop touches no driver
         # state and classify_hashed takes the lock for exactly the

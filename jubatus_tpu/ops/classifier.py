@@ -295,6 +295,95 @@ def train_batch_parallel(
     return ClassifierState(w, dw, prec, dprec)
 
 
+@functools.partial(jax.jit, donate_argnums=())
+def scores_schema(state: ClassifierState, uidx: jax.Array, val: jax.Array,
+                  label_mask: jax.Array) -> jax.Array:
+    """Batch classify scores for a UNIFORM-SCHEMA batch: every example
+    carries the same hashed index vector ``uidx`` [K] (a fixed key
+    schema — the common production feed shape). The [B*K]-element gather
+    of scores() collapses to K descriptors and the score math becomes a
+    dense [B,K]x[K,L] matmul — MXU work instead of element-granular
+    addressing (per-descriptor cost, docs/PERF_NOTES.md)."""
+    eff_sub = jnp.take(state.w + state.dw, uidx, axis=1)  # [L, K]
+    s = val @ eff_sub.T                                   # [B, L]
+    return jnp.where(label_mask[None, :], s, _NEG)
+
+
+@functools.partial(jax.jit, static_argnames=("method",), donate_argnums=(0,))
+def train_batch_schema(
+    state: ClassifierState,
+    uidx: jax.Array,       # [K] int32 — the shared hashed index vector
+    val: jax.Array,        # [B, K] float32
+    labels: jax.Array,     # [B] int32 — correct label row per example
+    label_mask: jax.Array, # [L] bool — live labels
+    param: float,
+    *,
+    method: str,
+) -> ClassifierState:
+    """Vectorized microbatch update for a UNIFORM-SCHEMA batch.
+
+    Semantics are identical to train_batch_parallel (every example
+    decides against the batch-start snapshot, updates land together) —
+    only the execution plan differs: with one shared index vector the
+    B*K-element packed gather collapses to K descriptors
+    (``take(.., uidx)``), scoring becomes a [B,K]x[K,L] matmul, and the
+    two B*K-element scatter-adds become label-grouped dense reductions
+    (one-hot matmuls, [L,B]x[B,K]) followed by ONE K-column scatter.
+    On v5e the sparse step is addressing-bound at ~37 ns/element
+    (docs/PERF_NOTES.md); this path removes that term entirely for
+    schema-uniform traffic and feeds the MXU instead. Float summation
+    order differs from the sparse plan (dense reductions vs scatter
+    order), so results agree to tolerance, not bitwise.
+
+    Duplicate entries in ``uidx`` (e.g. width-padding zeros) are safe:
+    the final ``.at[:, uidx].add`` accumulates per occurrence, exactly
+    like the sparse scatter over repeated (b, k) slots, and padded
+    columns carry val 0 so they contribute nothing.
+    """
+    confidence = method in CONFIDENCE_METHODS
+    w, dw, prec, dprec = state
+    num_labels = w.shape[0]
+
+    eff_sub = jnp.take(w + dw, uidx, axis=1)                       # [L, K]
+    s = val @ eff_sub.T                                            # [B, L]
+    x2_vec = val * val                                             # [B, K]
+    x2 = jnp.sum(x2_vec, axis=1)                                   # [B]
+
+    if confidence:
+        sig_sub = 1.0 / jnp.take(prec + dprec, uidx, axis=1)       # [L, K]
+        sig_c = jnp.take(sig_sub, labels, axis=0)                  # [B, K]
+        # `wrong` needs the scores only, so the provisional pass mirrors
+        # train_batch_parallel exactly (alpha from it is ignored)
+        wrong0, _, _, _ = decide_updates(
+            s, labels, label_mask, x2, jnp.zeros_like(x2), x2_vec, param,
+            method=method,
+        )
+        no_rival = jnp.sum(label_mask) < 2
+        sig_w = jnp.where(no_rival, 1.0,
+                          jnp.take(sig_sub, wrong0, axis=0))       # [B, K]
+        v = jnp.sum((sig_c + sig_w) * x2_vec, axis=1)              # [B]
+    else:
+        sig_c = jnp.ones_like(val)
+        sig_w = jnp.ones_like(val)
+        v = jnp.zeros_like(x2)
+
+    wrong, alpha, alpha_w, dp = decide_updates(
+        s, labels, label_mask, x2, v, x2_vec, param, method=method
+    )
+
+    up_c = alpha[:, None] * sig_c * val                            # [B, K]
+    up_w = alpha_w[:, None] * sig_w * val
+    onehot_c = jax.nn.one_hot(labels, num_labels, dtype=val.dtype)  # [B, L]
+    onehot_w = jax.nn.one_hot(wrong, num_labels, dtype=val.dtype)
+    delta_w = onehot_c.T @ up_c - onehot_w.T @ up_w                # [L, K]
+    dw = dw.at[:, uidx].add(delta_w)
+    if confidence:
+        dp_w = jnp.where((alpha_w > 0.0)[:, None], dp, 0.0)
+        delta_p = onehot_c.T @ dp + onehot_w.T @ dp_w              # [L, K]
+        dprec = dprec.at[:, uidx].add(delta_p)
+    return ClassifierState(w, dw, prec, dprec)
+
+
 @functools.partial(jax.jit, static_argnames=("method",), donate_argnums=(0,))
 def train_batch_sequential(
     state: ClassifierState,

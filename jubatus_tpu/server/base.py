@@ -276,6 +276,10 @@ class EngineServer:
         for nm, co in self.coalescers.items():
             st.update({f"microbatch.{nm}.{k}": v
                        for k, v in co.stats().items()})
+        # dense-submatrix (uniform key schema) plan engagement counters
+        # (service.py populates when the native fast path is registered)
+        for k, v in (getattr(self, "ingest_stats", None) or {}).items():
+            st[f"ingest.{k}"] = v
         st.update({f"driver.{k}": v for k, v in self.driver.get_status().items()})
         if self.mixer is not None:
             st.update({f"mixer.{k}": v for k, v in self.mixer.get_status().items()})

@@ -166,6 +166,29 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
         return (np.concatenate(parts_i) if len(parts_i) > 1 else parts_i[0],
                 np.concatenate(parts_v) if len(parts_v) > 1 else parts_v[0])
 
+    def _uniform_row(pairs):
+        """The shared index row if EVERY row of every (idx, val) pair
+        equals the first one (same width), else None. A fixed key schema
+        — the common production feed shape — hashes every datum to the
+        same index vector; detecting it per flush costs ~B*K int
+        compares (~0.02 µs/sample) and unlocks the dense submatrix train
+        plan (ops.train_batch_schema: no B*K-element gathers/scatters)."""
+        first = pairs[0][0]
+        row0 = first[0]
+        k = first.shape[1]
+        for ir, _vr in pairs:
+            if ir.shape[1] != k or not (ir == row0).all():
+                return None
+        return row0
+
+    schema_train = getattr(driver, "train_indexed_schema", None)
+    # schema-plan accounting, surfaced by get_status ("ingest.*" keys,
+    # server/base.py) and the e2e bench: how often flushes actually ride
+    # the dense submatrix plan
+    stats = server.ingest_stats = {"schema_flushes": 0, "sparse_flushes": 0,
+                                   "schema_query_flushes": 0,
+                                   "sparse_query_flushes": 0}
+
     def flush_requests(reqs):
         """Each item is one request's (labels, idx [B,K], val [B,K]).
         ``labels`` is a float32 target array (regression) or a
@@ -174,8 +197,8 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
         per-example Python loop ever runs."""
         if not reqs:
             return 0
-        idx, val = _pad_concat([(ir, vr) for _lb, ir, vr in reqs])
         if numeric:
+            idx, val = _pad_concat([(ir, vr) for _lb, ir, vr in reqs])
             labels = np.concatenate([r[0] for r in reqs]) \
                 if len(reqs) > 1 else reqs[0][0]
             return driver.train_hashed(labels, idx, val)
@@ -188,6 +211,15 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
                 lut[j] = label_map.setdefault(u, len(label_map))
             parts_l.append(lut[lidx])
         lidx = np.concatenate(parts_l) if len(parts_l) > 1 else parts_l[0]
+        if schema_train is not None:
+            row0 = _uniform_row([(ir, vr) for _lb, ir, vr in reqs])
+            if row0 is not None:
+                stats["schema_flushes"] += 1
+                val = np.concatenate([vr for _lb, _ir, vr in reqs]) \
+                    if len(reqs) > 1 else reqs[0][2]
+                return schema_train(list(label_map), lidx, row0, val)
+        stats["sparse_flushes"] += 1
+        idx, val = _pad_concat([(ir, vr) for _lb, ir, vr in reqs])
         return driver.train_indexed(list(label_map), lidx, idx, val)
 
     flush = _updating(server, flush_requests, count=lambda r: r)
@@ -236,15 +268,31 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
                 return parser.parse_datums(raw_params, weights=weights)
         return parser.parse_datums(raw_params)
 
-    def _query_coalescer(name: str, score_batch):
+    def _query_coalescer(name: str, score_batch, schema_score=None):
         """Query-plane microbatching (the mirror of the train coalescer):
         concurrent read requests join ONE device dispatch against the
         same model snapshot — every kernel launch costs ~ms on an
         accelerator regardless of batch size, so per-request dispatch
         caps the query plane at launches/s, not samples/s.
         ``score_batch(idx, val) -> per-row results``; each request gets
-        exactly its rows back (Coalescer split_results)."""
+        exactly its rows back (Coalescer split_results).
+        ``schema_score(uidx, val)`` is the uniform-schema dense variant,
+        taken whenever the flush's rows all share one index vector."""
         def query_flush(items):
+            if schema_score is not None:
+                row0 = _uniform_row(items)
+                if row0 is not None:
+                    stats["schema_query_flushes"] += 1
+                    if len(items) == 1:
+                        return [schema_score(row0, items[0][1])]
+                    vals = np.concatenate([v for _i, v in items])
+                    rows = schema_score(row0, vals)
+                    out, off = [], 0
+                    for i, _ in items:
+                        out.append(rows[off:off + i.shape[0]])
+                        off += i.shape[0]
+                    return out
+            stats["sparse_query_flushes"] += 1
             if len(items) == 1:
                 i, v = items[0]
                 return [score_batch(i, v)]
@@ -286,10 +334,13 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
             rpc.register_raw("estimate", estimate_raw)
     elif not numeric and hasattr(driver, "classify_hashed"):
         if max_batch:
+            schema_cls = getattr(driver, "classify_hashed_schema", None)
             rpc.register_raw("classify", _query_coalescer(
                 "classify_raw",
                 lambda i, v: [_scored(r)
-                              for r in driver.classify_hashed(i, v)]))
+                              for r in driver.classify_hashed(i, v)],
+                schema_score=None if schema_cls is None else
+                (lambda u, v: [_scored(r) for r in schema_cls(u, v)])))
         else:
             def classify_raw(raw_params: bytes):
                 parsed = _parse_datums(raw_params)

@@ -66,11 +66,15 @@ def run_abandonable(cmd, budget_s, out_path, log, name, env=None):
     """Run a capture member; on overrun SIGTERM it, then ABANDON it.
 
     Never SIGKILL: a SIGKILL mid-device-op is the exact tunnel-wedge
-    trigger this tool exists to route around. bench.py defers SIGTERM to
-    the next bytecode boundary (after any in-flight device call); if the
-    child still won't die we leave it running as an orphan, record its
-    pid so no new capture overlaps it, and move on — an orphaned bench
-    is recoverable, a wedged tunnel is not."""
+    trigger this tool exists to route around. The group TERM is safe by
+    construction: bench.py (and its d24 child) defer SIGTERM to a phase
+    boundary where no device op is in flight, and every other group
+    member (bench_mix collective children, serving load generators) is
+    CPU-only — scrub_child_env strips the axon site from their
+    PYTHONPATH, so they cannot hold a tunnel op. If the child still
+    won't die we leave it running as an orphan, record its pid so no
+    new capture overlaps it, and move on — an orphaned bench is
+    recoverable, a wedged tunnel is not."""
     t0 = time.time()
     with open(out_path, "w") as f:
         f.write(f"# cmd: {' '.join(cmd)}\n")
