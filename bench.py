@@ -403,8 +403,13 @@ if __name__ == "__main__":
     # group are safe by construction: the d24 child runs this same
     # handler, bench_mix collective children and serving load
     # generators are CPU-only (scrub_child_env strips the axon site).
-    signal.signal(signal.SIGTERM, lambda s, f: _TERM.__setitem__("req", True))
     if "--d24-probe" in sys.argv:
+        # the child runs device work on the MAIN thread only, so the
+        # between-bytecodes guarantee alone keeps SIGTERM off in-flight
+        # device ops — exit immediately at the next boundary
+        signal.signal(signal.SIGTERM, lambda s, f: os._exit(143))
         d24_probe()
     else:
+        signal.signal(signal.SIGTERM,
+                      lambda s, f: _TERM.__setitem__("req", True))
         main()

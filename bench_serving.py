@@ -192,14 +192,19 @@ def _default_microbatch() -> int:
 
 def run(transport: str = "python", workload: str = "numeric",
         conf: dict = CONF, measure: float = MEASURE_SECONDS,
-        tag: str = "", microbatch: int = 0) -> dict:
+        tag: str = "", microbatch: int = 0, native_ingest: bool = True) -> dict:
     from jubatus_tpu.server import EngineServer
     from jubatus_tpu.server.args import ServerArgs
 
     prev = os.environ.get("JUBATUS_TPU_NATIVE_RPC")
+    prev_ing = os.environ.get("JUBATUS_TPU_NATIVE_INGEST")
     # native is the DEFAULT transport now; "0" forces the Python one
     os.environ["JUBATUS_TPU_NATIVE_RPC"] = \
         "1" if transport == "native" else "0"
+    if not native_ingest:
+        # price the Python-converter fallback (the A/B the fast path's
+        # win is measured against, VERDICT r4 #3)
+        os.environ["JUBATUS_TPU_NATIVE_INGEST"] = "0"
     try:
         srv = EngineServer(
             "classifier", conf,
@@ -213,6 +218,10 @@ def run(transport: str = "python", workload: str = "numeric",
             os.environ.pop("JUBATUS_TPU_NATIVE_RPC", None)
         else:
             os.environ["JUBATUS_TPU_NATIVE_RPC"] = prev
+        if prev_ing is None:
+            os.environ.pop("JUBATUS_TPU_NATIVE_INGEST", None)
+        else:
+            os.environ["JUBATUS_TPU_NATIVE_INGEST"] = prev_ing
 
     repo = os.path.dirname(os.path.abspath(__file__))
     from bench_mix import scrub_child_env  # one owner for the env scrub
@@ -404,15 +413,28 @@ def collect(trials: int = 2) -> dict:
     # tokenized shape and the idf variant — BOTH on the native fast path
     # since round 3 (idf rides the C++ parser with the df tables)
     text_tr = "native" if "native" in transports else "python"
-    for tag, conf, wl in (("text", TEXT_CONF, "text"),
-                          ("text_idf", TEXT_IDF_CONF, "text"),
-                          ("combo", COMBO_CONF, "numeric"),
-                          ("text_filter", TEXT_FILTER_CONF, "text")):
+    for tag, conf, wl, ning in (
+            ("text", TEXT_CONF, "text", True),
+            ("text_idf", TEXT_IDF_CONF, "text", True),
+            ("combo", COMBO_CONF, "numeric", True),
+            # the Python-converter A/B for the combo fast path: same
+            # wire traffic, native parser declined (VERDICT r4 #3)
+            ("combo_python", COMBO_CONF, "numeric", False),
+            ("text_filter", TEXT_FILTER_CONF, "text", True)):
         try:
             out.update(run(text_tr, workload=wl, conf=conf,
-                           measure=TEXT_MEASURE_SECONDS, tag=tag))
+                           measure=TEXT_MEASURE_SECONDS, tag=tag,
+                           native_ingest=ning))
         except Exception as e:  # noqa: BLE001
             out[f"e2e_{tag}_error"] = repr(e)[:200]
+    ck = "e2e_rpc_train_samples_per_sec_combo"
+    if out.get(ck) and out.get(ck + "_python"):
+        out["e2e_combo_native_vs_python"] = round(
+            out[ck] / out[ck + "_python"], 2)
+    # features-per-datum for the combo shape, so throughput normalizes
+    # per EMITTED feature (K base keys -> K + K*(K-1)/2 with the
+    # wildcard x wildcard mul rule)
+    out["e2e_combo_features_per_datum"] = K + K * (K - 1) // 2
     # query plane: classify samples/s against the trained numeric model
     # (snapshot reads through the raw classify handler — no coalescer)
     try:

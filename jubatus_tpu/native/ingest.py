@@ -230,6 +230,12 @@ class IngestParser:
     @classmethod
     def from_converter_config(cls, conv: dict,
                               dim_bits: int) -> Optional["IngestParser"]:
+        # A/B switch: "0" declines every config, so the server serves the
+        # Python-converter path — how the bench prices the fast path's
+        # actual win (e2e_rpc_train_samples_per_sec_combo_python etc.)
+        if os.environ.get("JUBATUS_TPU_NATIVE_INGEST", "") in \
+                ("0", "false", "no"):
+            return None
         spec = spec_from_converter_config(conv)
         if spec is None or not available():
             return None

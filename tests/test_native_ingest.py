@@ -738,3 +738,36 @@ def test_combo_with_idf_declines():
         ],
     }
     assert ingest.spec_from_converter_config(conv) is None
+
+
+def test_parity_combo_plan_replay_fixed_schema():
+    """The combo plan (round 5): a request whose datums repeat one key
+    schema replays the recorded cross product — names/hashes computed
+    once — and must stay bit-identical to the Python converter for
+    every datum, including across a mid-request schema CHANGE (plan
+    rebuild) and a schema that collides a combined name with a base
+    name (terms accumulate into the base slot)."""
+    spec = ingest.spec_from_converter_config(COMBO_CONV)
+    p = ingest.IngestParser(spec, 20)
+    pyconv = make_fv_converter(COMBO_CONV, dim_bits=20)
+    rng = random.Random(17)
+    data = []
+    # phase 1: fixed 6-key schema, varying values (plan hit after datum 0)
+    for _ in range(60):
+        data.append(("a", Datum(num_values=[
+            (f"f{j}", rng.uniform(-5, 5)) for j in range(6)])))
+    # phase 2: schema change (extra key) -> rebuild, then hits again
+    for _ in range(60):
+        data.append(("b", Datum(num_values=[
+            (f"f{j}", rng.uniform(-5, 5)) for j in range(7)])))
+    # phase 3: collision shape — a base key named like a combined pair
+    # ("x@num&y@num" as a LITERAL key) plus x, y
+    for _ in range(30):
+        data.append(("c", Datum(num_values=[
+            ("x", rng.uniform(-2, 2)), ("y", rng.uniform(-2, 2)),
+            ("x@num&y@num", rng.uniform(-2, 2))])))
+    raw = msgpack.packb(["c", [[lab, d.to_msgpack()] for lab, d in data]])
+    labels, idx, val = p.parse(raw)
+    for i, (lab, d) in enumerate(data):
+        assert labels[i] == lab
+        assert _got(idx[i], val[i]) == _expected(pyconv, d), i

@@ -145,6 +145,23 @@ def run_capture(slot: int, rnd: int, log, remaining_s: float) -> None:
                  "err": repr(e)[:160]})
 
 
+def _foreign_bench_running() -> bool:
+    """True when a bench.py we did not spawn is running (the driver's
+    end-of-round capture, or an operator run)."""
+    me = os.getpid()
+    mine = set(_abandoned_pids)
+    try:
+        out = subprocess.run(["pgrep", "-f", r"python.*bench\.py"],
+                             capture_output=True, text=True, timeout=10)
+        for line in out.stdout.split():
+            pid = int(line)
+            if pid not in (me,) and pid not in mine:
+                return True
+    except Exception:  # noqa: BLE001 — no pgrep: assume clear
+        pass
+    return False
+
+
 def main() -> None:
     # single-instance guard: overlapping daemons would run concurrent
     # bench captures that contend for the one tunnel and clobber each
@@ -186,6 +203,11 @@ def main() -> None:
         log({"event": "probe", "alive": alive, **res})
         if not alive:
             saw_dead_since_capture = True
+        elif _foreign_bench_running():
+            # the driver's end-of-round bench (or an operator run) owns
+            # the tunnel right now; a concurrent capture would contend
+            # for the one core + tunnel and skew both
+            log({"event": "capture_deferred", "reason": "bench running"})
         elif orphans_alive():
             # an abandoned capture child is still running; launching
             # another bench against the one tunnel would corrupt both
