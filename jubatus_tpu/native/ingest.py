@@ -14,7 +14,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -205,14 +205,43 @@ def spec_from_converter_config(conv: dict) -> Optional[str]:
     return "\n".join(lines)
 
 
+#: cross-request memo cap for the hybrid filter path (entries are
+#: (rule_idx, input) -> output; a repeated key schema makes the inputs
+#: highly repetitive in real feeds)
+_FILTER_MEMO_MAX = 1 << 16
+
+
+def _build_prefilters(conv: dict):
+    """[(matcher, suffix, fn)] mirroring converter.Config's
+    string_filter_rules, built from the same factories so behavior
+    cannot drift. Raises on unknown methods (caller declines)."""
+    from jubatus_tpu.core.fv.converter import (_build_string_filter,
+                                               make_key_matcher)
+
+    types = {name: _build_string_filter(params or {})
+             for name, params in
+             (conv.get("string_filter_types") or {}).items()}
+    out = []
+    for r in conv.get("string_filter_rules") or []:
+        out.append((make_key_matcher(r["key"]), r["suffix"],
+                    types[r["type"]]))
+    return out
+
+
 class IngestParser:
     """One immutable parser handle per (converter config, dim).
 
     ``needs_weights``: the spec carries idf rules — every parse must be
     given the converter's WeightManager (and run under its lock: the C++
-    mutates the df tables in place on the train path)."""
+    mutates the df tables in place on the train path).
+
+    ``_prefilters``: hybrid string-filter mode — Python rewrites the
+    request with filter-appended string values (regex memoized per
+    distinct input) before the C++ parse; see from_converter_config."""
 
     def __init__(self, spec: str, dim_bits: int) -> None:
+        self._prefilters = None
+        self._filter_memo: Dict[tuple, str] = {}
         lib = _load()
         if lib is None:
             raise RuntimeError("native ingest unavailable")
@@ -236,13 +265,33 @@ class IngestParser:
         if os.environ.get("JUBATUS_TPU_NATIVE_INGEST", "") in \
                 ("0", "false", "no"):
             return None
+        prefilters = None
+        if conv.get("string_filter_rules"):
+            # HYBRID path (VERDICT r4 #4): the regex itself stays in
+            # Python (std::regex diverges from `re` on real patterns —
+            # the round-3 finding), memoized per distinct input string;
+            # everything else (datum walk, tokenize, tf, hash, emit)
+            # stays in C++. The C++ spec is built from the config SANS
+            # filters; parse() first rewrites the request with the
+            # filter-appended values, exactly like converter
+            # _apply_filters (converter.py:333-344).
+            try:
+                prefilters = _build_prefilters(conv)
+            except Exception:  # noqa: BLE001 — unknown method: python path
+                return None
+            conv = {k: v for k, v in conv.items()
+                    if k not in ("string_filter_rules",
+                                 "string_filter_types")}
         spec = spec_from_converter_config(conv)
         if spec is None or not available():
             return None
         try:
-            return cls(spec, dim_bits)
+            p = cls(spec, dim_bits)
         except (ValueError, RuntimeError):
             return None
+        if prefilters is not None:
+            p._prefilters = prefilters
+        return p
 
     @staticmethod
     def _idx_val(out: "_Out"):
@@ -266,6 +315,55 @@ class IngestParser:
                 float(weights._ndocs_master),
                 weights._ndocs_diff.ctypes.data_as(dp))
 
+    def _apply_prefilters(self, sv: list) -> None:
+        """Append filter outputs to one datum's string_values IN PLACE,
+        mirroring converter._apply_filters: each rule snapshots the
+        current list, so later rules see earlier rules' appends."""
+        memo = self._filter_memo
+        for ri, (match, suffix, fn) in enumerate(self._prefilters):
+            for kv in list(sv):
+                k, v = kv[0], kv[1]
+                if not match(k):
+                    continue
+                key = (ri, v)
+                fv = memo.get(key)
+                if fv is None:
+                    fv = fn(v)
+                    if len(memo) >= _FILTER_MEMO_MAX:
+                        memo.clear()
+                    memo[key] = fv
+                sv.append([k + suffix, fv])
+
+    def _prefilter_rewrite(self, raw: bytes, with_labels: bool):
+        """The hybrid filter pre-pass: decode the request, apply string
+        filters (Python regex, memoized), re-encode for the C++ parse.
+        Returns None when the wire shape is not the expected format —
+        the caller then falls back to the generic path, which fails or
+        serves it with identical semantics."""
+        import msgpack
+
+        try:
+            req = msgpack.unpackb(raw, raw=False, strict_map_key=False,
+                                  use_list=True,
+                                  unicode_errors="surrogateescape")
+            if not isinstance(req, list) or len(req) != 2 \
+                    or not isinstance(req[1], list):
+                return None
+            for item in req[1]:
+                d = item[1] if with_labels else item
+                # datums are inline arrays on this wire (Datum.to_msgpack
+                # emits the [sv, nv, bv] structure; the C++ parser reads
+                # it with array_len directly) — anything else cannot
+                # parse natively regardless, so fall back
+                if not isinstance(d, list) or not d \
+                        or not isinstance(d[0], list):
+                    return None
+                self._apply_prefilters(d[0])
+            return msgpack.packb(req, use_bin_type=True,
+                                 unicode_errors="surrogateescape")
+        except Exception:  # noqa: BLE001 — any wire oddity: generic path
+            return None
+
     def parse_indexed(self, raw: bytes, weights=None):
         """Raw train params msgpack -> (labels, idx [B,K] i32, val [B,K] f32).
 
@@ -280,6 +378,10 @@ class IngestParser:
         (train path: documents are observed and values idf-scaled exactly
         like converter.convert(update_weights=True)); caller must hold
         ``weights.lock``."""
+        if self._prefilters is not None:
+            raw = self._prefilter_rewrite(raw, with_labels=True)
+            if raw is None:
+                return None
         out = _Out()
         if self.needs_weights:
             if weights is None:
@@ -336,6 +438,10 @@ class IngestParser:
         not a datum list. For idf specs, ``weights`` is read (NOT
         observed — queries never record documents; caller holds the
         lock)."""
+        if self._prefilters is not None:
+            raw = self._prefilter_rewrite(raw, with_labels=False)
+            if raw is None:
+                return None
         out = _Out()
         if self.needs_weights:
             if weights is None:

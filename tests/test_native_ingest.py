@@ -771,3 +771,75 @@ def test_parity_combo_plan_replay_fixed_schema():
     for i, (lab, d) in enumerate(data):
         assert labels[i] == lab
         assert _got(idx[i], val[i]) == _expected(pyconv, d), i
+
+
+TEXT_FILTER_CONV = {
+    "string_filter_types": {
+        "strip_digits": {"method": "regexp", "pattern": "[0-9]+",
+                         "replace": ""}},
+    "string_filter_rules": [
+        {"key": "*", "type": "strip_digits", "suffix": "-nodigit"}],
+    "string_rules": [
+        {"key": "*", "type": "space", "sample_weight": "tf",
+         "global_weight": "bin"}],
+}
+
+
+def test_parity_string_filters_hybrid():
+    """String-filter configs ride the HYBRID fast path (round 5, VERDICT
+    r4 #4): Python applies the regex (memoized per distinct input) by
+    rewriting the request; tokenize/tf/hash stay in C++. Output must be
+    bit-identical to the Python converter, including cascaded filters
+    (a later rule matching an earlier rule's appended key)."""
+    p = ingest.IngestParser.from_converter_config(TEXT_FILTER_CONV, 20)
+    assert p is not None and p._prefilters is not None
+    pyconv = make_fv_converter(TEXT_FILTER_CONV, dim_bits=20)
+    rng = random.Random(23)
+    words = ["abc123", "x9y", "2024", "plain", "日本7語", ""]
+    data = []
+    for _ in range(120):
+        body = " ".join(rng.choice(words)
+                        for _ in range(rng.randint(0, 8)))
+        data.append((rng.choice("ab"), Datum({"body": body})))
+    raw = msgpack.packb(["c", [[lab, d.to_msgpack()] for lab, d in data]])
+    labels, idx, val = p.parse(raw)
+    for i, (lab, d) in enumerate(data):
+        assert labels[i] == lab
+        assert _got(idx[i], val[i]) == _expected(pyconv, d), i
+    # query path too
+    rawq = msgpack.packb(["c", [d.to_msgpack() for _l, d in data]])
+    qidx, qval = p.parse_datums(rawq)
+    for i, (_lab, d) in enumerate(data):
+        assert _got(qidx[i], qval[i]) == _expected(pyconv, d), i
+
+
+def test_parity_cascaded_string_filters():
+    conv = {
+        "string_filter_types": {
+            "strip_digits": {"method": "regexp", "pattern": "[0-9]+",
+                             "replace": ""},
+            "dash": {"method": "regexp", "pattern": " ",
+                     "replace": "-"}},
+        "string_filter_rules": [
+            {"key": "*", "type": "strip_digits", "suffix": "-nd"},
+            # matches the FIRST rule's appended key too (cascade)
+            {"key": "*-nd", "type": "dash", "suffix": "-dashed"}],
+        "string_rules": [
+            {"key": "*", "type": "space", "sample_weight": "bin",
+             "global_weight": "bin"}],
+    }
+    p = ingest.IngestParser.from_converter_config(conv, 18)
+    assert p is not None
+    pyconv = make_fv_converter(conv, dim_bits=18)
+    d = Datum({"body": "a1 b2 c3"})
+    raw = msgpack.packb(["c", [["x", d.to_msgpack()]]])
+    _labels, idx, val = p.parse(raw)
+    assert _got(idx[0], val[0]) == _expected(pyconv, d)
+
+
+def test_string_filter_unknown_method_declines():
+    conv = dict(TEXT_FILTER_CONV,
+                string_filter_types={"odd": {"method": "mystery"}},
+                string_filter_rules=[
+                    {"key": "*", "type": "odd", "suffix": "-x"}])
+    assert ingest.IngestParser.from_converter_config(conv, 20) is None
