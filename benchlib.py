@@ -120,9 +120,12 @@ SUMMARY_EXACT = (
     "e2e_schema_flush_fraction_native",
     "e2e_schema_query_flush_fraction_native",
     "e2e_mixed_train_classify_samples_per_sec",
+    "e2e_mixed_train_samples_per_sec",
+    "e2e_mixed_classify_samples_per_sec",
     "mix_round_worst_ms",
     "mix_under_1s_target",
     "collective_round_ms_nproc4_d24",
+    "collective_round_ms_nproc4_d24_bf16",
     "collective_round_d24_platform",
 )
 #: prefix fallback order for keys not named above

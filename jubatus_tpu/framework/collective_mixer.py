@@ -117,6 +117,10 @@ class CollectiveMixer(RpcLinearMixer):
         #: timeout with the coordinator unreadable): the collective plane
         #: is gone for this process; every later round mixes over RPC
         self.collective_dead = False
+        #: per-phase wall times of the last collective entry this member
+        #: ran (cast/ship/reduce/readback ms + payload/wire MB) — the
+        #: per-round log the reference keeps (linear_mixer.cpp:553-558)
+        self.last_phases: Dict[str, Any] = {}
 
     # -- coordinator paths ----------------------------------------------------
     def _go_path(self) -> str:
@@ -291,7 +295,12 @@ class CollectiveMixer(RpcLinearMixer):
             return False
         from jubatus_tpu.parallel.collective import psum_pytree
 
-        totals = psum_pytree(entry["diffs"], compress=self.compress)
+        # per-phase wall times for the round just run, exposed for
+        # status/bench (the reference logs time+bytes per mix round,
+        # linear_mixer.cpp:553-558; here per phase)
+        self.last_phases = {}
+        totals = psum_pytree(entry["diffs"], compress=self.compress,
+                             phases=self.last_phases)
         return self.local_put_obj({
             "protocol": PROTOCOL_VERSION,
             "schema": entry["union"],
@@ -405,4 +414,6 @@ class CollectiveMixer(RpcLinearMixer):
         st = super().get_status()
         st.update(collective_rounds=self.collective_rounds,
                   fallback_rounds=self.fallback_rounds)
+        for k, v in self.last_phases.items():
+            st[f"last_mix_{k}"] = v
         return st

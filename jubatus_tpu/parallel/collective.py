@@ -62,7 +62,8 @@ def _reduce_fn(mesh: Mesh, treedef, shapes: Tuple, dtypes: Tuple,
     )
 
 
-def psum_pytree(diff: Any, compress: bool = False) -> Any:
+def psum_pytree(diff: Any, compress: bool = False,
+                phases: dict = None) -> Any:  # type: ignore[assignment]
     """AllReduce ``diff`` (pytree of arrays/scalars) across the process
     world; returns the total as host numpy arrays. Every process must
     call this with an identically-shaped pytree (and the same
@@ -72,14 +73,29 @@ def psum_pytree(diff: Any, compress: bool = False) -> Any:
     half the wire bytes per round at ~3 decimal digits of diff
     precision; additive diffs tolerate it because put_diff folds into an
     f32 master (same contract as ``_psum_stacked(compress=True)`` and
-    the RPC mix's bf16 option)."""
+    the RPC mix's bf16 option).
+
+    ``phases`` (optional dict) is filled with this call's per-phase wall
+    times so mix rounds log like the reference's per-round time+bytes
+    (linear_mixer.cpp:553-558): ``cast_ms`` (host bf16 cast),
+    ``ship_ms`` (host->device placement), ``reduce_ms`` (the jitted
+    psum — wire and fold are ONE fused collective here, unlike the
+    reference's get_diff/fold/put_diff phases), ``readback_ms``
+    (device->host), ``payload_mb`` (post-cast bytes this replica
+    contributes) and ``wire_mb_ring_model`` (2(n-1)/n x payload — the
+    ring-allreduce bytes a replica moves per round; a model, since the
+    runtime picks the actual algorithm)."""
+    import time
+
     mesh = _world_mesh()
     n = mesh.shape["replica"]
     me = jax.local_devices()[0]
     sharding = NamedSharding(mesh, P("replica"))
 
     leaves, treedef = jax.tree_util.tree_flatten(diff)
-    arrs = []
+    t0 = time.perf_counter()
+    cast = []
+    nbytes = 0
     for leaf in leaves:
         local = np.asarray(leaf)
         if local.dtype in (np.float64, np.int64, np.uint64):
@@ -93,15 +109,34 @@ def psum_pytree(diff: Any, compress: bool = False) -> Any:
             import ml_dtypes
 
             local = local.astype(ml_dtypes.bfloat16)
+        nbytes += local.nbytes
+        cast.append(local)
+    t1 = time.perf_counter()
+    arrs = []
+    for local in cast:
         shard = jax.device_put(local[None, ...], me)
         arrs.append(jax.make_array_from_single_device_arrays(
             (n,) + local.shape, sharding, [shard]))
     stacked = jax.tree_util.tree_unflatten(treedef, arrs)
     shapes = tuple(a.shape for a in arrs)
     dtypes = tuple(str(a.dtype) for a in arrs)
+    t2 = time.perf_counter()
     total = _reduce_fn(mesh, treedef, shapes, dtypes, compress)(stacked)
-    return jax.tree_util.tree_map(
+    total = jax.block_until_ready(total)
+    t3 = time.perf_counter()
+    out = jax.tree_util.tree_map(
         lambda x: np.asarray(x.addressable_shards[0].data), total)
+    t4 = time.perf_counter()
+    if phases is not None:
+        phases.update(
+            cast_ms=round((t1 - t0) * 1e3, 2),
+            ship_ms=round((t2 - t1) * 1e3, 2),
+            reduce_ms=round((t3 - t2) * 1e3, 2),
+            readback_ms=round((t4 - t3) * 1e3, 2),
+            payload_mb=round(nbytes / 2**20, 2),
+            wire_mb_ring_model=round(nbytes * 2 * (n - 1) / n / 2**20, 2),
+        )
+    return out
 
 
 def world_size() -> int:

@@ -448,3 +448,115 @@ class _null:
 
     def __exit__(self, *a):
         return False
+
+
+def test_lock_idgen_contention_soak():
+    """VERDICT r4 item 8: multi-client zkmutex contention + id minting
+    under connection churn against the fake quorum (≙ zk_test.cpp's
+    trylock/create_id cases, here concurrent and chaotic).
+
+    Four coordinators hammer one lock path and one id path from worker
+    threads while the main thread blips random clients' connections
+    (network outage, NOT session expiry — session_grace keeps ephemerals,
+    so a blip must never silently release a held lock). Invariants:
+
+      * mutual exclusion holds through every blip (no two workers inside
+        the critical section; a surviving session keeps the lock node);
+      * every client both acquires the lock and mints ids (liveness —
+        contention and churn starve nobody out);
+      * ids minted concurrently by all clients are globally unique and
+        each client observes its own mints strictly increasing
+        (create_id's version-counter contract,
+        global_id_generator_zk.cpp:32-56)."""
+    import random
+    import threading
+
+    srv = FakeZkServer()
+    srv.session_grace = 60.0
+    port = srv.start(0)
+    n_clients = 4
+    clients = [ZkCoordinator.from_locator(f"zk://127.0.0.1:{port}")
+               for _ in range(n_clients)]
+    stop = threading.Event()
+    inside = [0]              # critical-section occupancy counter
+    violations: list = []
+    ids = [[] for _ in range(n_clients)]
+    acquired = [0] * n_clients
+    errors: list = []
+
+    def worker(i: int) -> None:
+        c = clients[i]
+        rng = random.Random(0x1D6E + i)
+        while not stop.is_set():
+            try:
+                ids[i].append(c.create_id("/soak/idgen"))
+            except Exception:  # noqa: BLE001 — mint raced a blip; retry
+                time.sleep(0.02)
+            got = False
+            try:
+                got = c.try_lock("/soak/lock")
+            except Exception:  # noqa: BLE001 — try_lock raced a blip
+                time.sleep(0.02)
+            if got:
+                inside[0] += 1
+                if inside[0] != 1:
+                    violations.append((i, inside[0]))
+                time.sleep(rng.uniform(0.0, 0.003))
+                if inside[0] != 1:
+                    violations.append((i, inside[0], "during"))
+                inside[0] -= 1
+                deadline = time.time() + 15.0
+                while time.time() < deadline:
+                    try:
+                        if c.unlock("/soak/lock"):
+                            break
+                    except Exception:  # noqa: BLE001 — mid-reconnect
+                        pass
+                    time.sleep(0.05)
+                else:
+                    errors.append(f"client {i}: unlock never succeeded")
+                    stop.set()
+                acquired[i] += 1
+            time.sleep(rng.uniform(0.0, 0.002))
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_clients)]
+    try:
+        for t in threads:
+            t.start()
+        # chaos plane: ~10 blips across random clients over ~7 s; each
+        # must resume its session (reconnect_count advances, _up returns)
+        rng = random.Random(0xC4A0)
+        for blip in range(10):
+            time.sleep(0.6)
+            c = clients[rng.randrange(n_clients)]
+            before = c._conn.reconnect_count
+            try:
+                c._conn._sock.shutdown(2)
+            except OSError:
+                pass
+            assert _wait_until(
+                lambda: c._conn.reconnect_count > before
+                and c._conn._up.is_set(), timeout=12.0), \
+                f"blip {blip}: client never resumed"
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not any(t.is_alive() for t in threads), "worker hung"
+        assert not errors, errors
+        assert not violations, f"mutual exclusion broken: {violations[:5]}"
+        assert all(a > 0 for a in acquired), \
+            f"a client was starved of the lock: {acquired}"
+        assert all(len(x) > 0 for x in ids), "a client minted no ids"
+        flat = [v for lst in ids for v in lst]
+        assert len(set(flat)) == len(flat), "duplicate ids minted"
+        for i, lst in enumerate(ids):
+            assert lst == sorted(lst), f"client {i} ids not increasing"
+        # the winning sessions never expired (suicide would close conns)
+        assert not any(c._conn._closed for c in clients)
+    finally:
+        stop.set()
+        for c in clients:
+            c.close()
+        srv.stop()

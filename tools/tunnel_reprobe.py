@@ -150,14 +150,27 @@ def _foreign_bench_running() -> bool:
     end-of-round capture, or an operator run)."""
     me = os.getpid()
     mine = set(_abandoned_pids)
+    # inspect real argv, not a command-line substring: a `pgrep -f
+    # "python.*bench\.py"` also matches any process whose cmdline merely
+    # MENTIONS both words (e.g. the round driver's shell wrapper embeds
+    # its whole instruction text), which deferred captures forever
     try:
-        out = subprocess.run(["pgrep", "-f", r"python.*bench\.py"],
-                             capture_output=True, text=True, timeout=10)
-        for line in out.stdout.split():
-            pid = int(line)
-            if pid not in (me,) and pid not in mine:
+        for pid_s in os.listdir("/proc"):
+            if not pid_s.isdigit():
+                continue
+            pid = int(pid_s)
+            if pid == me or pid in mine:
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    argv = f.read().split(b"\0")
+            except OSError:
+                continue
+            if not argv or b"python" not in os.path.basename(argv[0]):
+                continue
+            if any(os.path.basename(a) == b"bench.py" for a in argv[1:]):
                 return True
-    except Exception:  # noqa: BLE001 — no pgrep: assume clear
+    except Exception:  # noqa: BLE001 — no /proc: assume clear
         pass
     return False
 
