@@ -211,6 +211,10 @@ pid = int(sys.argv[1]); n = int(sys.argv[2])
 jax_port, coord_dir = sys.argv[3], sys.argv[4]
 dim_bits = int(sys.argv[5]) if len(sys.argv) > 5 else 0
 bf16 = bool(int(sys.argv[6])) if len(sys.argv) > 6 else False
+# CPU worlds need the gloo collectives backend or every psum raises
+# ("Multiprocess computations aren't implemented on the CPU backend")
+from jubatus_tpu.parallel.multihost import enable_cpu_collectives
+enable_cpu_collectives()
 jax.distributed.initialize(f"127.0.0.1:{jax_port}", num_processes=n,
                            process_id=pid)
 from jubatus_tpu.client import ClassifierClient, Datum
@@ -244,8 +248,11 @@ for _ in range(4):
 # budget starts AFTER training: at north-star dims the d2^24 train
 # compiles eat minutes of one time-sliced core, and a peer whose wait
 # expires calls srv.stop() — tearing its listener down right under the
-# master's mix fan-out (connection refused on every peer)
-deadline = time.time() + (120 if not dim_bits else 900)
+# master's mix fan-out (connection refused on every peer). The d24
+# budget matches the parent's 1200 s timeout: a peer deadline SHORTER
+# than the parent's lets a slow master outlive its peers and fan out
+# into torn-down listeners instead of timing out cleanly at the parent
+deadline = time.time() + (120 if not dim_bits else 1200)
 while time.time() < deadline:
     if len(membership.get_all_nodes(srv.coord, "classifier", "mb")) == n:
         break

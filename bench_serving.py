@@ -253,14 +253,23 @@ def run(transport: str = "python", workload: str = "numeric",
                 env=env, cwd=repo, stdout=subprocess.PIPE, text=True)
             for wl in wl_list
         ]
-        for p, wl in zip(procs, wl_list):
+        dead: list = []
+        for idx, (p, wl) in enumerate(zip(procs, wl_list)):
             out, _ = p.communicate(timeout=WARMUP_SECONDS + measure + 240)
+            reported = False
             for line in out.splitlines():
                 if line.startswith("CLIENT "):
                     _, cnt, el = line.split()
                     total += int(cnt)
                     per_wl[wl] += int(cnt)
                     elapsed_max = max(elapsed_max, float(el))
+                    reported = True
+            # a client that died without a CLIENT line would otherwise
+            # contribute a silent 0 and the run would report a
+            # plausible-but-low number as if every client were counted
+            if p.returncode != 0 or not reported:
+                dead.append(f"client {idx} ({wl}): rc={p.returncode}, "
+                            f"tail={out[-120:]!r}")
         for nm, co in srv.coalescers.items():
             stats[nm] = co.stats()
     finally:
@@ -270,6 +279,11 @@ def run(transport: str = "python", workload: str = "numeric",
                 p.wait()
         srv.stop()
     sps = total / elapsed_max if elapsed_max else 0.0
+    if dead:
+        err = "; ".join(dead)
+        if workload == "mixed":
+            return {"e2e_mixed_error": err}
+        return {f"e2e_rpc_{workload}_error_{tag or transport}": err}
     if workload == "mixed":
         return {
             "e2e_mixed_train_classify_samples_per_sec": round(sps, 1),

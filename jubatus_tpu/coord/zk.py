@@ -736,8 +736,19 @@ class ZkCoordinator(Coordinator):
         with self._lock:
             if path not in self._held_locks:
                 return False
+        # attempt the remove FIRST and drop membership only once the node
+        # is verifiably gone: discarding up front made a connection blip
+        # during the remove wedge the lock forever — every retry saw the
+        # path absent from _held_locks and returned False while the
+        # ephemeral node survived the reconnect (session_grace keeps the
+        # session alive). A remove that raises keeps membership, so the
+        # caller's retry loop works across reconnects.
+        removed = self.remove(path)
+        with self._lock:
             self._held_locks.discard(path)
-        return self.remove(path)
+        # ZNONODE (removed False) means the node is already gone — the
+        # lock is no longer held either way, so the release succeeded
+        return True
 
     def create_id(self, path: str) -> int:
         # setData bumps the node version atomically — the version IS the

@@ -79,7 +79,12 @@ def _signature(diffs: Dict[str, Any]) -> str:
     """Canonical shape/dtype signature; every member must match before
     anyone enters the collective (shape skew would wedge the psum).
     64-bit leaves report "unsupported": a psum in f32 would be LESS exact
-    than the RPC fold, so those rounds take the fallback."""
+    than the RPC fold, so those rounds take the fallback.
+
+    Shapes/dtypes come from array attributes, never ``np.asarray`` — on
+    a device-resident diff leaf that would be a full device→host copy of
+    the payload just to read metadata (at the d24 bench shape, hundreds
+    of MB per member per round)."""
     import jax
     import numpy as np
 
@@ -88,10 +93,15 @@ def _signature(diffs: Dict[str, Any]) -> str:
         leaves, treedef = jax.tree_util.tree_flatten(diffs[name])
         sigs = []
         for x in leaves:
-            a = np.asarray(x)
-            if a.dtype in (np.float64, np.int64, np.uint64):
+            dtype = getattr(x, "dtype", None)
+            shape = getattr(x, "shape", None)
+            if dtype is None or shape is None:
+                a = np.asarray(x)  # python scalar / list leaf
+                dtype, shape = a.dtype, a.shape
+            if np.dtype(dtype) in (np.dtype(np.float64), np.dtype(np.int64),
+                                   np.dtype(np.uint64)):
                 return "unsupported"
-            sigs.append(f"{a.shape}/{a.dtype}")
+            sigs.append(f"{tuple(shape)}/{np.dtype(dtype)}")
         parts.append(f"{name}:{treedef}:{','.join(sigs)}")
     return "|".join(parts)
 
@@ -168,12 +178,17 @@ class CollectiveMixer(RpcLinearMixer):
             diffs = {name: m.get_diff() for name, m in mixables.items()}
         sig = _signature(diffs)
         if sig != "unsupported":
-            # the compress flag rides the signature so a mixed-flag
-            # cluster mismatches at prepare; the "unsupported" SENTINEL
-            # must stay bare — the master's fallback check matches it
-            # exactly, and a suffixed sentinel would send a 64-bit round
-            # into the collective it cannot ride
-            sig += f"|bf16={int(self.compress)}"
+            # the compress flag AND the chunk plan ride the signature so
+            # a mixed-flag or mixed-chunk-size cluster mismatches at
+            # prepare (the chunked psum is a SEQUENCE of collectives — a
+            # member chunking differently would wedge the world); the
+            # "unsupported" SENTINEL must stay bare — the master's
+            # fallback check matches it exactly, and a suffixed sentinel
+            # would send a 64-bit round into the collective it cannot
+            # ride
+            from jubatus_tpu.parallel.collective import DEFAULT_CHUNK_MB
+
+            sig += f"|bf16={int(self.compress)}|chunk={DEFAULT_CHUNK_MB}"
         with self._staged_lock:
             # one staged round at a time: a newer prepare supersedes any
             # stale round a dead master left behind (its waiter sees the
@@ -297,10 +312,14 @@ class CollectiveMixer(RpcLinearMixer):
 
         # per-phase wall times for the round just run, exposed for
         # status/bench (the reference logs time+bytes per mix round,
-        # linear_mixer.cpp:553-558; here per phase)
+        # linear_mixer.cpp:553-558; here per phase + pipeline overlap).
+        # prefer_device: device-resident diff leaves (the JAX models)
+        # enter with zero staging and the totals come back as device
+        # arrays, which the jitted put_diff consumes directly — no
+        # device→host→device round trip on the apply
         self.last_phases = {}
         totals = psum_pytree(entry["diffs"], compress=self.compress,
-                             phases=self.last_phases)
+                             phases=self.last_phases, prefer_device=True)
         return self.local_put_obj({
             "protocol": PROTOCOL_VERSION,
             "schema": entry["union"],

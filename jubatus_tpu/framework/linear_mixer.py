@@ -296,7 +296,14 @@ class RpcLinearMixer:
     def local_put_obj(self, msg) -> bool:
         """Apply a reduced-diff message already in object form (the
         collective mixer lands its psum result here without a wire
-        pack/unpack round-trip)."""
+        pack/unpack round-trip).
+
+        Diff leaves may be host numpy OR device ``jax.Array``s — the
+        collective plane hands totals over device-resident
+        (``psum_pytree(prefer_device=True)``) so a jitted ``put_diff``
+        consumes them without a device→host→device bounce; mixables that
+        fold into host numpy masters convert with ``np.asarray`` exactly
+        as they would have paid at readback."""
         if msg.get("protocol") != PROTOCOL_VERSION:
             log.error("mix protocol mismatch: %s", msg.get("protocol"))
             return False

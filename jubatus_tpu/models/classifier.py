@@ -384,22 +384,33 @@ class ClassifierDriver(DriverBase):
 
         After this, slot i holds union_schema[i] on every replica, so array
         diffs are row-aligned for the psum.
+
+        Runs on EVERY mix prepare, so the already-aligned case (no new
+        labels since the last round — every steady-state round) must be
+        free: realigning unconditionally would drag all four
+        [capacity, D] tables through host numpy each round (~2 GB of
+        device→host→device traffic per member at D=2^24). When the
+        slots DO move, rows are permuted on-device with a gather instead
+        of round-tripping through the host.
         """
         new_cap = max(_INITIAL_CAPACITY, _next_pow2(len(union_schema)))
+        target_slots = {lab: i for i, lab in enumerate(union_schema)}
+        if new_cap == self.capacity and target_slots == self.label_slots:
+            return  # already canonical — the steady-state mix round
         perm = np.full(new_cap, -1, dtype=np.int64)  # new slot -> old slot
         for new_slot, label in enumerate(union_schema):
             old = self.label_slots.get(label)
             if old is not None:
                 perm[new_slot] = old
+        live_h = perm >= 0
+        gather = jnp.asarray(np.where(live_h, perm, 0).astype(np.int32))
+        live_d = jnp.asarray(live_h)[:, None]
 
         def take_rows(a, fill):
             if a.shape == (1, 1):
                 return a
-            arr = np.asarray(a)
-            out = np.full((new_cap, arr.shape[1]), fill, dtype=arr.dtype)
-            live = perm >= 0
-            out[live] = arr[perm[live]]
-            return jnp.asarray(out)
+            # device-side row permute: one gather + select, no host copy
+            return jnp.where(live_d, a[gather], jnp.asarray(fill, a.dtype))
 
         st = self.state
         self.state = self._place(ops.ClassifierState(

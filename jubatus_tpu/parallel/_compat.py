@@ -1,0 +1,60 @@
+"""jax API compatibility for the parallel plane.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to a
+top-level ``jax.shard_map`` export (renaming ``check_rep`` to
+``check_vma`` along the way), and ``jax.distributed.is_initialized``
+only exists on newer jax; this repo runs against both eras. Import from
+here so every collective/SPMD module resolves the same symbols
+regardless of the installed jax.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:  # jax >= 0.5: public top-level API
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax: the experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f=None, **kwargs):
+    """``shard_map`` accepting either era's replication-check kwarg
+    (``check_vma`` on new jax, ``check_rep`` on old) and translating to
+    whatever the installed jax understands."""
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(f, **kwargs) if f is not None else _shard_map(**kwargs)
+
+
+def axis_size(axis):
+    """``jax.lax.axis_size`` where it exists; the classic
+    ``psum(1, axis)`` idiom (constant-folded to a Python int at trace
+    time) on older jax."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis)
+    return jax.lax.psum(1, axis)
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` where it exists; the
+    runtime's client handle otherwise."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 — layout moved again: assume not init
+        return False
+
+
+__all__ = ["shard_map", "axis_size", "distributed_is_initialized"]

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Protocol, Sequence, runtime_checka
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jubatus_tpu.parallel._compat import shard_map
 
 
 @runtime_checkable
@@ -112,21 +113,31 @@ def _psum_stacked(stacked, *, mesh: Mesh, axis: str, compress: bool):
 
         return jax.tree_util.tree_map(one, local)
 
-    return jax.shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P())(stacked)
+    return shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P())(stacked)
 
 
 def allreduce_diffs(per_replica_diffs: Sequence[Any], mesh: Mesh,
-                    axis: str = "replica", compress: bool = False):
+                    axis: str = "replica", compress: bool = False,
+                    phases: Optional[dict] = None):
     """Reduce per-replica diff pytrees to one total via an XLA collective.
 
     In production each replica contributes its local shard of the stacked
     array; in tests the stack is built host-side and sharded onto the mesh.
     Returns the total diff (as held by replica 0). ``compress=True``
-    quantizes f32 leaves to bf16 for the wire (see _psum_stacked).
+    quantizes f32 leaves to bf16 for the wire (see _psum_stacked; the
+    cast happens on-device inside the collective body, same contract as
+    the cross-process engine in parallel/collective.py).
+
+    ``phases`` (optional dict) records the same per-phase wall times the
+    cross-process plane logs (ship/reduce/readback + payload MB), so the
+    in-process and jax.distributed mix paths are accounted identically.
     """
+    import time
+
     n = mesh.shape[axis]
     if len(per_replica_diffs) != n:
         raise ValueError(f"got {len(per_replica_diffs)} diffs for a {n}-replica mesh")
+    t0 = time.perf_counter()
     stacked = jax.tree_util.tree_map(
         lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *per_replica_diffs
     )
@@ -134,8 +145,25 @@ def allreduce_diffs(per_replica_diffs: Sequence[Any], mesh: Mesh,
     stacked = jax.tree_util.tree_map(
         lambda x: jax.device_put(x, sharding), stacked
     )
+    # device_put is async: block before timestamping so transfer cost
+    # does not leak into the reduce phase
+    stacked = jax.block_until_ready(stacked)
+    t1 = time.perf_counter()
     total = _psum_stacked(stacked, mesh=mesh, axis=axis, compress=compress)
-    return jax.tree_util.tree_map(lambda x: jax.device_get(x), total)
+    total = jax.block_until_ready(total)
+    t2 = time.perf_counter()
+    out = jax.tree_util.tree_map(lambda x: jax.device_get(x), total)
+    if phases is not None:
+        nbytes = sum(
+            x.nbytes // (2 if compress and x.dtype == jnp.float32 else 1)
+            for x in jax.tree_util.tree_leaves(total))
+        phases.update(
+            ship_ms=round((t1 - t0) * 1e3, 2),
+            reduce_ms=round((t2 - t1) * 1e3, 2),
+            readback_ms=round((time.perf_counter() - t2) * 1e3, 2),
+            payload_mb=round(nbytes / 2**20, 2),
+        )
+    return out
 
 
 class LocalMixGroup:
@@ -147,11 +175,18 @@ class LocalMixGroup:
     (optionally through a real device mesh), then put_diff everywhere.
     """
 
-    def __init__(self, drivers: Sequence[Any], mesh: Optional[Mesh] = None):
+    def __init__(self, drivers: Sequence[Any], mesh: Optional[Mesh] = None,
+                 compress: bool = False):
         if not drivers:
             raise ValueError("LocalMixGroup needs at least one driver")
         self.drivers = list(drivers)
         self.mesh = mesh
+        #: ship f32 diffs over the mesh as bf16 (the --mix-bf16 tradeoff
+        #: on the in-process path; cast-on-device, f32 handed back)
+        self.compress = compress
+        #: per-phase wall times of the last mesh-collective reduce this
+        #: group ran (same keys as the cross-process engine)
+        self.last_phases: Dict[str, Any] = {}
 
     def mix(self) -> Dict[str, Any]:
         # hold every participant's model lock for the round (deadlock-free:
@@ -188,7 +223,10 @@ class LocalMixGroup:
             summable = custom_mix is None or getattr(mixables[0], "MIX_IS_SUM", False)
             if (summable and self.mesh is not None
                     and self.mesh.shape.get("replica") == len(diffs)):
-                total = allreduce_diffs(diffs, self.mesh)
+                self.last_phases = {}
+                total = allreduce_diffs(diffs, self.mesh,
+                                        compress=self.compress,
+                                        phases=self.last_phases)
             elif custom_mix is not None:
                 total = functools.reduce(custom_mix, diffs)
             else:

@@ -24,10 +24,30 @@ from typing import Optional
 import jax
 
 from jubatus_tpu.coord.base import Coordinator
+from jubatus_tpu.parallel._compat import distributed_is_initialized
 
 log = logging.getLogger(__name__)
 
 JAX_COORD_PATH = "/jubatus/jax_coordinator"
+
+
+def enable_cpu_collectives() -> bool:
+    """Select the gloo cross-process collectives backend for CPU worlds.
+
+    On jax builds of this era the CPU backend refuses multiprocess
+    computations outright ("Multiprocess computations aren't implemented
+    on the CPU backend") unless ``jax_cpu_collectives_implementation``
+    is switched to gloo BEFORE the backend initializes — without it,
+    every CPU-world psum raises, members ack failure, and the collective
+    mix silently degrades to broken rounds. Must be called before
+    anything touches the XLA backend; returns True if the option was
+    set. No-op (False) on jax versions without the option (their CPU
+    collectives work out of the box)."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:  # noqa: BLE001 — option renamed/removed upstream
+        return False
 
 
 def initialize(
@@ -48,7 +68,7 @@ def initialize(
     ``jax.process_count()``/``jax.devices()`` would do that, which is why
     the already-initialized check uses ``jax.distributed.is_initialized``.
     """
-    if jax.distributed.is_initialized():
+    if distributed_is_initialized():
         return False
     if not num_processes or num_processes <= 1:
         return False  # single-host: never poll or raise
@@ -77,6 +97,7 @@ def initialize(
                 time.sleep(0.5)
     if not coordinator_address:
         return False
+    enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

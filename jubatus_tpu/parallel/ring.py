@@ -32,6 +32,7 @@ from typing import Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from jubatus_tpu.parallel._compat import axis_size, shard_map
 
 from jubatus_tpu.parallel.sharded_knn import shard_table as shard_rows  # noqa: F401
 
@@ -46,7 +47,7 @@ def ring_scan(step_fn: Callable, carry, block, axis: str):
     (XLA schedules the collective-permute async on TPU), which is the
     whole point of the ring shape: the wire hides behind the scan.
     """
-    s = jax.lax.axis_size(axis)
+    s = axis_size(axis)
     me = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % s) for i in range(s)]
 
@@ -108,7 +109,7 @@ def _ring_topk(mesh, queries, blocks, local_scores, k: int, axis: str):
     q_spec = P(axis, *([None] * (queries.ndim - 1)))
     blk_specs = jax.tree_util.tree_map(
         lambda x: P(axis, *([None] * (x.ndim - 1))), blocks)
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(q_spec, blk_specs),
         out_specs=(P(axis, None), P(axis, None)),

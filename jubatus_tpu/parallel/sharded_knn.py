@@ -30,6 +30,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jubatus_tpu.parallel._compat import shard_map
 
 
 def shard_table(mesh: Mesh, table, axis: str = "shard"):
@@ -75,7 +76,7 @@ def _sharded_topk(mesh, q, table, local_scores, k: int, axis: str,
     if valid is not None:
         in_specs.append(P(axis))
         args.append(valid)
-    fn = jax.shard_map(
+    fn = shard_map(
         scan, mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=(P(), P()),
@@ -115,7 +116,7 @@ def sharded_distances(
         parts = jax.lax.all_gather(d, axis, tiled=False)   # [S, B, c_local]
         return jnp.transpose(parts, (1, 0, 2)).reshape(q.shape[0], -1)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         scan, mesh=mesh,
         in_specs=(P(), P(axis, None)),
         out_specs=P(),

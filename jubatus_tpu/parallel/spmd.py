@@ -26,6 +26,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jubatus_tpu.parallel._compat import shard_map
 
 from jubatus_tpu.ops.classifier import (
     CONFIDENCE_METHODS,
@@ -147,7 +148,7 @@ def make_spmd_train_step(mesh: Mesh, *, method: str = "AROW", param: float = 1.0
 
     @jax.jit
     def step(state: SpmdClassifierState, idx, val, labels, label_mask):
-        out = jax.shard_map(
+        out = shard_map(
             body,
             mesh=mesh,
             in_specs=(state_spec, state_spec, state_spec, state_spec,

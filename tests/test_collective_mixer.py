@@ -73,6 +73,9 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 pid = int(sys.argv[1]); n = int(sys.argv[2])
 jax_port, coord_dir = sys.argv[3], sys.argv[4]
+# CPU worlds need the gloo collectives backend or every psum raises
+from jubatus_tpu.parallel.multihost import enable_cpu_collectives
+enable_cpu_collectives()
 jax.distributed.initialize(f"127.0.0.1:{jax_port}", num_processes=n,
                            process_id=pid)
 assert jax.process_count() == n
@@ -305,9 +308,13 @@ def test_64bit_diff_signature_stays_bare_unsupported():
         coord=MemoryCoordinator(store))
     srv.start(0)
     try:
-        # supported diffs: signature carries the compress flag
+        # supported diffs: signature carries the compress flag AND the
+        # chunk plan (a mixed-chunk-size cluster would issue mismatched
+        # collective sequences and wedge the world)
+        from jubatus_tpu.parallel.collective import DEFAULT_CHUNK_MB
+
         _v, sig = srv.mixer.local_prepare("r1", [])
-        assert sig.endswith("|bf16=1"), sig
+        assert sig.endswith(f"|bf16=1|chunk={DEFAULT_CHUNK_MB}"), sig
         srv.mixer.local_abort("r1")
         # force a 64-bit leaf into the diff: sentinel must stay bare
         mixable = srv.driver.get_mixables()["classifier"]

@@ -1,0 +1,156 @@
+"""Parity tests for the pipelined mix data plane (parallel/collective.py).
+
+The chunked double-buffered stream must be BIT-identical to the
+unchunked path for f32 (chunking only re-tiles the psum, it must never
+change the arithmetic) and must keep the established bf16 contract under
+``compress=True``. World of 1 (psum = identity) keeps the tests
+single-process while still driving the full chunk planner, the padded
+tail, the batched small-leaf collective, and the device-resident
+zero-staging path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jubatus_tpu.parallel.collective import psum_pytree
+
+RNG = np.random.default_rng(7)
+
+
+def _chunked_vs_unchunked(diff, **kw):
+    """Run the same tree through a forced-chunking plan (tiny chunk) and
+    a never-chunking plan (huge chunk); return both results."""
+    chunked = psum_pytree(diff, chunk_mb=0.25, **kw)
+    unchunked = psum_pytree(diff, chunk_mb=1 << 20, **kw)
+    return chunked, unchunked
+
+
+def test_chunked_f32_bit_identical_to_unchunked():
+    # 700_001 f32 elements per row: NOT a multiple of any chunk size —
+    # exercises the zero-padded ragged tail
+    diff = {
+        "w": RNG.normal(size=(3, 700_001)).astype(np.float32),
+        "b": RNG.normal(size=(64,)).astype(np.float32),
+    }
+    phases: dict = {}
+    chunked = psum_pytree(diff, chunk_mb=0.25, phases=phases)
+    unchunked = psum_pytree(diff, chunk_mb=1 << 20)
+    assert phases["chunks"] > 1  # the plan really split
+    # world of 1: the total IS the input, and chunking must be bit-exact
+    assert np.array_equal(chunked["w"], diff["w"])
+    assert np.array_equal(chunked["w"], unchunked["w"])
+    assert chunked["w"].dtype == np.float32
+    assert np.array_equal(chunked["b"], unchunked["b"])
+
+
+def test_chunk_exact_multiple_no_tail():
+    # leaf bytes an exact multiple of the chunk: no padded tail branch
+    elems = (1 << 16)  # 256 KiB of f32 = exactly 4 chunks of 64 KiB
+    diff = {"w": RNG.normal(size=(elems,)).astype(np.float32)}
+    phases: dict = {}
+    out = psum_pytree(diff, chunk_mb=64 / 1024, phases=phases)
+    assert phases["chunks"] == 4
+    assert np.array_equal(out["w"], diff["w"])
+
+
+def test_chunked_bf16_matches_compress_contract():
+    """compress=True must produce the same values chunked and unchunked,
+    equal to one f32→bf16→f32 round trip (world of 1), handed back f32."""
+    diff = {"w": RNG.normal(size=(2, 300_000)).astype(np.float32)}
+    chunked, unchunked = _chunked_vs_unchunked(diff, compress=True)
+    expect = np.asarray(
+        jnp.asarray(diff["w"]).astype(jnp.bfloat16).astype(jnp.float32))
+    assert chunked["w"].dtype == np.float32
+    assert np.array_equal(chunked["w"], unchunked["w"])
+    assert np.array_equal(chunked["w"], expect)
+
+
+def test_compress_halves_reported_payload():
+    diff = {"w": np.ones((256, 1024), np.float32)}
+    ph_f32: dict = {}
+    ph_bf16: dict = {}
+    psum_pytree(diff, phases=ph_f32, chunk_mb=0.25)
+    psum_pytree(diff, compress=True, phases=ph_bf16, chunk_mb=0.25)
+    assert ph_bf16["payload_mb"] == round(ph_f32["payload_mb"] / 2, 2)
+
+
+def test_non_f32_dtype_rides_chunks_exactly():
+    diff = {"idx": np.arange(200_000, dtype=np.int32)}
+    out = psum_pytree(diff, chunk_mb=0.25)
+    assert out["idx"].dtype == np.int32
+    assert np.array_equal(out["idx"], diff["idx"])
+    # compress must leave non-f32 leaves untouched
+    out_c = psum_pytree(diff, compress=True, chunk_mb=0.25)
+    assert np.array_equal(out_c["idx"], diff["idx"])
+
+
+def test_scalar_and_empty_pytrees():
+    # scalar leaves ride the batched small-leaf collective
+    out = psum_pytree({"c": np.float32(2.5), "d": jnp.float32(1.25)})
+    assert float(out["c"]) == 2.5
+    assert float(out["d"]) == 1.25
+    # empty pytree: no collective at all, phases still well-formed
+    phases: dict = {}
+    assert psum_pytree({}, phases=phases) == {}
+    assert phases["chunks"] == 0
+    assert phases["overlap_ms_saved"] == 0.0
+
+
+def test_device_resident_fast_path_world_of_1():
+    """jax.Array leaves enter with zero host staging; prefer_device hands
+    device arrays back and the values match the host path bit-for-bit."""
+    host = {
+        "w": RNG.normal(size=(2, 400_000)).astype(np.float32),
+        "b": RNG.normal(size=(16,)).astype(np.float32),
+    }
+    dev = {k: jnp.asarray(v) for k, v in host.items()}
+    out_dev = psum_pytree(dev, chunk_mb=0.25, prefer_device=True)
+    assert isinstance(out_dev["w"], jax.Array)
+    assert isinstance(out_dev["b"], jax.Array)
+    out_host = psum_pytree(host, chunk_mb=0.25)
+    assert np.array_equal(np.asarray(out_dev["w"]), out_host["w"])
+    assert np.array_equal(np.asarray(out_dev["b"]), out_host["b"])
+    # device in / host out (default) also matches
+    out_mixed = psum_pytree(dev, chunk_mb=0.25)
+    assert isinstance(out_mixed["w"], np.ndarray)
+    assert np.array_equal(out_mixed["w"], out_host["w"])
+
+
+def test_mixed_host_device_tree_parity():
+    """One tree mixing device-resident and host leaves (the real AROW
+    diff shape: jax dw/dprec + numpy df) stays bit-exact chunked."""
+    diff = {
+        "dw": jnp.asarray(RNG.normal(size=(2, 350_001)).astype(np.float32)),
+        "df": RNG.normal(size=(250_000,)).astype(np.float32),
+        "count": jnp.float32(1.0),
+    }
+    phases: dict = {}
+    out = psum_pytree(diff, chunk_mb=0.25, phases=phases)
+    assert phases["chunks"] >= 2
+    assert np.array_equal(out["dw"], np.asarray(diff["dw"]))
+    assert np.array_equal(out["df"], diff["df"])
+    assert float(out["count"]) == 1.0
+
+
+def test_64bit_leaves_still_refused():
+    with pytest.raises(ValueError, match="64-bit"):
+        psum_pytree({"x": np.zeros(4, np.float64)})
+    with pytest.raises(ValueError, match="64-bit"):
+        psum_pytree({"x": np.zeros(1 << 18, np.int64)}, chunk_mb=0.25)
+
+
+def test_phase_accounting_keys_present():
+    diff = {"w": RNG.normal(size=(1 << 18,)).astype(np.float32)}
+    phases: dict = {}
+    psum_pytree(diff, chunk_mb=0.25, phases=phases)
+    for k in ("cast_ms", "ship_ms", "reduce_ms", "readback_ms",
+              "payload_mb", "wire_mb_ring_model", "chunks", "chunk_mb",
+              "overlap_ms_saved"):
+        assert k in phases, (k, phases)
+        assert phases[k] >= 0
+    assert phases["chunk_mb"] == 0.25

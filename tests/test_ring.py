@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
+from jubatus_tpu.parallel._compat import shard_map
 
 from jubatus_tpu.ops import knn
 from jubatus_tpu.parallel.mesh import grid_mesh
@@ -45,7 +46,7 @@ def test_ring_scan_visits_every_block_once(mesh):
             step, (jnp.float32(0), jnp.int32(0)), blk, "shard")
         return total[None], origin_sum[None]
 
-    total, origin_sum = jax.shard_map(
+    total, origin_sum = shard_map(
         shard_fn, mesh=mesh, in_specs=(P("shard", None),),
         out_specs=(P("shard"), P("shard")), check_vma=False,
     )(blocks)

@@ -1,0 +1,82 @@
+"""Chunk-size sweep for the pipelined mix data plane.
+
+Boots one 4-process jax.distributed CPU world per chunk size and times
+``psum_pytree`` over a Criteo-shaped host diff (two [2, 2^23] f32 leaves
+= 128 MB payload per replica), printing a JSON dict of median round ms
+per chunk size. This is the recipe behind the DEFAULT_CHUNK_MB choice
+recorded in docs/PERF_NOTES.md ("Mix data plane") — rerun it on a real
+chip to re-pick for ICI.
+
+Usage: python tools/bench_mix_chunk_sweep.py [dim_bits] [sizes_mb...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD = r"""
+import sys, time, json
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); n = int(sys.argv[2])
+jax_port = sys.argv[3]
+dim_bits = int(sys.argv[5]); chunk_mb = float(sys.argv[6])
+from jubatus_tpu.parallel.multihost import enable_cpu_collectives
+enable_cpu_collectives()
+jax.distributed.initialize(f"127.0.0.1:{jax_port}", num_processes=n,
+                           process_id=pid)
+from jubatus_tpu.parallel.collective import psum_pytree
+
+rng = np.random.default_rng(pid)
+diff = {"dw": rng.normal(size=(2, 1 << dim_bits)).astype(np.float32),
+        "dprec": rng.normal(size=(2, 1 << dim_bits)).astype(np.float32)}
+phases = {}
+psum_pytree(diff, phases=phases, chunk_mb=chunk_mb)  # warmup (compile)
+times = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    phases = {}
+    psum_pytree(diff, phases=phases, chunk_mb=chunk_mb)
+    times.append(time.perf_counter() - t0)
+if pid == 0:
+    print("SWEEP=" + json.dumps({
+        "chunk_mb": chunk_mb,
+        "psum_ms_median": round(float(np.median(times)) * 1e3, 1),
+        "chunks": phases.get("chunks"),
+        "overlap_ms_saved": phases.get("overlap_ms_saved"),
+        "ship_ms": phases.get("ship_ms"),
+        "reduce_ms": phases.get("reduce_ms"),
+        "readback_ms": phases.get("readback_ms"),
+    }), flush=True)
+print(f"CHILD-{pid}-DONE", flush=True)
+"""
+
+
+def sweep(dim_bits: int = 23, sizes=(2.0, 4.0, 8.0, 16.0, 32.0, 4096.0)):
+    """4096 MB = never chunk: the serial single-collective reference."""
+    import bench_mix
+
+    out = {}
+    for mb in sizes:
+        outs, rcs = bench_mix.run_jax_world(
+            _CHILD, 4, timeout=600, extra_args=(str(dim_bits), str(mb)))
+        if any(rc != 0 for rc in rcs):
+            out[f"chunk_{mb}mb"] = {"error": (''.join(outs))[-200:]}
+            continue
+        for text in outs:
+            for line in text.splitlines():
+                if line.startswith("SWEEP="):
+                    out[f"chunk_{mb}mb"] = json.loads(line[len("SWEEP="):])
+    return out
+
+
+if __name__ == "__main__":
+    bits = int(sys.argv[1]) if len(sys.argv) > 1 else 23
+    sizes = tuple(float(s) for s in sys.argv[2:]) or \
+        (2.0, 4.0, 8.0, 16.0, 32.0, 4096.0)
+    print(json.dumps(sweep(bits, sizes), indent=1))
