@@ -275,6 +275,9 @@ if pid == 0:
             print(f"warmup attempt {attempt}: {out!r}", flush=True)
             time.sleep(3.0)
         assert out and out.get("collective"), out
+        # registry hygiene: drop the warmup rounds (compile-heavy) so the
+        # mix.round histogram embedded below covers steady state only
+        srv.rpc.trace.reset()
         t0 = time.perf_counter()
         out = srv.mixer.mix_now()          # measured round
         ms = (time.perf_counter() - t0) * 1e3
@@ -301,6 +304,17 @@ if pid == 0:
     # as ONE fused collective), readback, plus the ring-model wire bytes
     for k, v in getattr(srv.mixer, "last_phases", {}).items():
         rec[f"collective_phase_{k}{tag}"] = v
+    # steady-state mix.round quantiles from the span histograms (warmup
+    # rounds were reset away inside warmed_round) + the flight recorder's
+    # structured record of the measured round
+    tr = srv.rpc.trace.trace_status()
+    for q in ("p50_ms", "p99_ms", "max_ms"):
+        k = f"trace.mix.round.{q}"
+        if k in tr:
+            rec[f"collective_mix_round_{q}{tag}"] = tr[k]
+    flight = srv.mixer.flight.snapshot(last=1)
+    if flight:
+        rec[f"collective_flight_last{tag}"] = flight[-1]
     if two_variant:
         srv.mixer.compress = True
         open(coord_dir.rstrip("/") + ".flip", "w").close()
@@ -318,6 +332,11 @@ if pid == 0:
         rec[f"collective_round{tag2}_platform"] = plat
         for k, v in getattr(srv.mixer, "last_phases", {}).items():
             rec[f"collective_phase_{k}{tag2}"] = v
+        tr2 = srv.rpc.trace.trace_status()
+        for q in ("p50_ms", "p99_ms", "max_ms"):
+            k = f"trace.mix.round.{q}"
+            if k in tr2:
+                rec[f"collective_mix_round_{q}{tag2}"] = tr2[k]
     print("COLLECTIVE=" + json.dumps(rec), flush=True)
     # explicit completion marker (SIBLING of the coordinator dir — the
     # file coordinator owns everything inside): peers must NOT key off

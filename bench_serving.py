@@ -27,6 +27,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 N_CLIENTS = 16
@@ -181,6 +182,18 @@ print(f"CLIENT {count} {elapsed:.4f}")
 """
 
 
+def _latency_keys(trace_snapshot: dict, suffix: str) -> dict:
+    """Steady-state per-RPC latency quantiles from the server's span
+    histograms (utils/tracing.py), keyed for the BENCH json."""
+    out = {}
+    for m in ("train", "classify"):
+        for q in ("p50_ms", "p99_ms"):
+            k = f"trace.rpc.{m}.{q}"
+            if k in trace_snapshot:
+                out[f"e2e_rpc_{m}_{q}_{suffix}"] = trace_snapshot[k]
+    return out
+
+
 def _default_microbatch() -> int:
     """Flush-size cap by platform: on a real chip big flushes amortize
     the tunnel round trip (the kernel's sweet spot is 32k,
@@ -241,6 +254,13 @@ def run(transport: str = "python", workload: str = "numeric",
                if workload == "mixed" else [workload] * N_CLIENTS)
     per_wl = {wl: 0 for wl in wl_list}
     stats = {}
+    trace_snapshot: dict = {}
+    # quantile hygiene: reset the server's span registry once the clients'
+    # warmup window closes, so the histograms embedded in the BENCH json
+    # cover steady state only (warmup includes every bucket-shape compile)
+    reset_timer = threading.Timer(WARMUP_SECONDS + 1.0, srv.rpc.trace.reset)
+    reset_timer.daemon = True
+    reset_timer.start()
     # try/finally like run_proxy: a communicate() timeout or client crash
     # must not leak the server + up to N_CLIENTS load generators into the
     # next trial's measurement window (they'd share the single bench core)
@@ -272,7 +292,12 @@ def run(transport: str = "python", workload: str = "numeric",
                             f"tail={out[-120:]!r}")
         for nm, co in srv.coalescers.items():
             stats[nm] = co.stats()
+        # steady-state latency quantiles off the server's own registry
+        # (reset at warmup end above) — the per-request tail the
+        # throughput number hides
+        trace_snapshot = srv.rpc.trace.trace_status()
     finally:
+        reset_timer.cancel()
         for p in procs:
             if p.poll() is None:
                 p.kill()
@@ -285,7 +310,7 @@ def run(transport: str = "python", workload: str = "numeric",
             return {"e2e_mixed_error": err}
         return {f"e2e_rpc_{workload}_error_{tag or transport}": err}
     if workload == "mixed":
-        return {
+        out = {
             "e2e_mixed_train_classify_samples_per_sec": round(sps, 1),
             "e2e_mixed_train_samples_per_sec": round(
                 per_wl.get("numeric", 0) / elapsed_max, 1)
@@ -294,6 +319,8 @@ def run(transport: str = "python", workload: str = "numeric",
                 per_wl.get("classify", 0) / elapsed_max, 1)
             if elapsed_max else 0.0,
         }
+        out.update(_latency_keys(trace_snapshot, "mixed"))
+        return out
     fast_items = stats.get("train_raw", {}).get("item_count", 0)
     slow_items = stats.get("train", {}).get("item_count", 0)
     avg_batch = 0.0
@@ -303,6 +330,7 @@ def run(transport: str = "python", workload: str = "numeric",
     suffix = tag or transport
     verb = "classify" if workload == "classify" else "train"
     out = {f"e2e_rpc_{verb}_samples_per_sec_{suffix}": round(sps, 1)}
+    out.update(_latency_keys(trace_snapshot, suffix))
     ing = getattr(srv, "ingest_stats", None) or {}
     if verb == "train":  # coalescer stats are train-plane only
         out[f"e2e_avg_device_batch_{suffix}"] = round(avg_batch, 1)

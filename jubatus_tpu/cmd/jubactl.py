@@ -4,14 +4,19 @@
     jubactl -c stop   -t classifier -s jubaclassifier -n c1 -z /shared
     jubactl -c save   -t classifier -n c1 -z /shared [-i model_id]
     jubactl -c load   -t classifier -n c1 -z /shared [-i model_id]
-    jubactl -c status -t classifier -n c1 -z /shared
+    jubactl -c status -t classifier -n c1 -z /shared [--all]
+    jubactl -c metrics -t classifier -n c1 -z /shared
 
 start/stop fan out to every jubavisor under /jubatus/supervisors,
 distributing N processes round-robin (N/visors each, remainder to the
 first ones; N=0 → one per visor — jubactl.cpp:133-142,240-260). save/load
 RPC every registered server of the cluster (send2server). status prints
-the nodes/actives registries. Server flags (-C/-T/-D/-X/-S/-I/...) are
-forwarded to visor-spawned processes (jubactl.cpp:90-110).
+the nodes/actives registries; ``--all`` additionally scrapes every
+member's get_status map. ``metrics`` (beyond the reference) scrapes every
+member's raw histogram snapshot (get_metrics) and prints a MERGED cluster
+view — exact p50/p90/p99 across nodes via bucket-wise sums
+(utils/tracing.py merge_snapshots). Server flags (-C/-T/-D/-X/-S/-I/...)
+are forwarded to visor-spawned processes (jubactl.cpp:90-110).
 """
 
 from __future__ import annotations
@@ -29,7 +34,10 @@ from jubatus_tpu.rpc.client import RpcClient
 def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="jubactl")
     p.add_argument("-c", "--cmd", required=True,
-                   choices=["start", "stop", "save", "load", "status"])
+                   choices=["start", "stop", "save", "load", "status",
+                            "metrics"])
+    p.add_argument("--all", action="store_true",
+                   help="[status] also scrape every member's get_status")
     p.add_argument("-s", "--server", default="",
                    help="server name forwarded to jubavisor "
                         "(jubaclassifier or plain engine name)")
@@ -113,13 +121,77 @@ def send2server(coord: Coordinator, cmd: str, engine: str, name: str,
     return rc
 
 
-def show_status(coord: Coordinator, engine: str, name: str) -> int:
+def show_status(coord: Coordinator, engine: str, name: str,
+                show_all: bool = False) -> int:
     nodes = membership.get_all_nodes(coord, engine, name)
     actives = {n.name for n in membership.get_all_actives(coord, engine, name)}
     print(f"{engine}/{name}: {len(nodes)} node(s), {len(actives)} active")
+    rc = 0
     for node in nodes:
         mark = "active" if node.name in actives else "standby"
         print(f"  {node.name}  [{mark}]")
+        if not show_all:
+            continue
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                status = c.call("get_status", name)
+        except Exception as e:  # noqa: BLE001 — report per-host, keep going
+            print(f"    <get_status failed: {e}>")
+            rc = -1
+            continue
+        for _node_name, st in sorted(status.items()):
+            for k in sorted(st):
+                print(f"    {k}: {st[k]}")
+    return rc
+
+
+def _fmt_ms(v) -> str:
+    return f"{v:10.3f}" if isinstance(v, (int, float)) else f"{v:>10}"
+
+
+def show_metrics(coord: Coordinator, engine: str, name: str) -> int:
+    """Merged cluster quantile view: scrape every member's get_metrics
+    snapshot and fold bucket counts (exact at bucket resolution)."""
+    from jubatus_tpu.utils import tracing
+
+    nodes = membership.get_all_nodes(coord, engine, name)
+    if not nodes:
+        print(f"no server of {engine}/{name}", file=sys.stderr)
+        return -1
+    snaps: List[Dict[str, Any]] = []
+    scraped = []
+    for node in nodes:
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                per_node = c.call("get_metrics", name)
+        except Exception as e:  # noqa: BLE001 — partial view beats none
+            print(f"  <{node.name}: get_metrics failed: {e}>",
+                  file=sys.stderr)
+            continue
+        for node_name, snap in per_node.items():
+            snaps.append(snap)
+            scraped.append(node_name)
+    if not snaps:
+        print("no member answered get_metrics", file=sys.stderr)
+        return -1
+    merged = tracing.merge_snapshots(snaps)
+    print(f"{engine}/{name}: merged metrics from {len(scraped)} node(s): "
+          f"{', '.join(sorted(scraped))}")
+    hists = merged.get("hists") or {}
+    if hists:
+        print(f"  {'span':<32} {'count':>8} {'p50_ms':>10} {'p90_ms':>10} "
+              f"{'p99_ms':>10} {'max_ms':>10}")
+        for span in sorted(hists):
+            st = hists[span]
+            qs = [tracing.state_quantile(st, q) for q in (0.5, 0.9, 0.99)]
+            cells = " ".join(_fmt_ms((q or 0.0) * 1e3) for q in qs)
+            print(f"  {span:<32} {st.get('count', 0):>8} {cells} "
+                  f"{_fmt_ms(float(st.get('max_s', 0.0)) * 1e3)}")
+    counters = merged.get("counters") or {}
+    if counters:
+        print("  counters:")
+        for cname in sorted(counters):
+            print(f"    {cname}: {counters[cname]}")
     return 0
 
 
@@ -133,7 +205,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     coord = create_coordinator(spec)
     try:
         if ns.cmd == "status":
-            return show_status(coord, ns.type, ns.name)
+            return show_status(coord, ns.type, ns.name, show_all=ns.all)
+        if ns.cmd == "metrics":
+            return show_metrics(coord, ns.type, ns.name)
         if ns.cmd in ("start", "stop"):
             server = ns.server or ns.type
             name = f"{server}/{ns.name}"

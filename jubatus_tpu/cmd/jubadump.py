@@ -153,21 +153,50 @@ def dump_file(path: str, *, summary: bool = False,
     return out
 
 
+def dump_mix_history(target: str, name: str = "",
+                     timeout: float = 10.0) -> list:
+    """Pull a live server's mix-round flight records (``get_mix_history``
+    RPC — the bounded ring framework/mixer.py keeps per mixer)."""
+    from jubatus_tpu.rpc.client import RpcClient
+
+    host, _, port = target.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"--mix-history wants HOST:PORT, got {target!r}")
+    with RpcClient(host, int(port), timeout=timeout) as c:
+        return _jsonable(c.call("get_mix_history", name), False)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="jubadump",
-        description="convert saved jubatus_tpu model files to JSON")
-    p.add_argument("-i", "--input", required=True, metavar="FILE")
+        description="convert saved jubatus_tpu model files to JSON, or "
+                    "dump a live server's mix-round flight records")
+    p.add_argument("-i", "--input", metavar="FILE")
     p.add_argument("--summary", action="store_true",
                    help="digest large arrays instead of dumping them")
     p.add_argument("--no-user-data", action="store_true",
                    help="header + system container only")
+    p.add_argument("--mix-history", metavar="HOST:PORT",
+                   help="dump the mix flight recorder of a LIVE server "
+                        "(get_mix_history RPC) instead of reading a file")
+    p.add_argument("-n", "--name", default="",
+                   help="[--mix-history] cluster name to pass the RPC")
     ns = p.parse_args(argv)
+    if bool(ns.input) == bool(ns.mix_history):
+        print("exactly one of -i FILE or --mix-history HOST:PORT required",
+              file=sys.stderr)
+        return 1
     try:
-        out = dump_file(ns.input, summary=ns.summary,
-                        skip_user_data=ns.no_user_data)
+        if ns.mix_history:
+            out: Any = dump_mix_history(ns.mix_history, ns.name)
+        else:
+            out = dump_file(ns.input, summary=ns.summary,
+                            skip_user_data=ns.no_user_data)
     except (OSError, ValueError, SaveLoadError) as e:
         print(str(e), file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001 — RPC failures print, not raise
+        print(f"mix-history fetch failed: {e}", file=sys.stderr)
         return 1
     json.dump(out, sys.stdout, indent=2)
     print()

@@ -539,6 +539,9 @@ class ZkCoordinator(Coordinator):
         away — a delete-watched node that vanished fires its handler NOW
         (the event itself is gone forever), and child watchers get one
         synthetic notification so membership readers resync."""
+        from jubatus_tpu.utils import tracing
+
+        tracing.count("zk.session.reconnects")
         with self._lock:
             child_paths = list(self._child_watchers)
             del_paths = list(self._delete_watchers)
@@ -574,6 +577,9 @@ class ZkCoordinator(Coordinator):
 
     def _session_lost(self) -> None:
         log.error("zookeeper session lost; firing delete watchers")
+        from jubatus_tpu.utils import tracing
+
+        tracing.count("zk.session.lost")
         with self._lock:
             taken = self._delete_watchers
             self._delete_watchers = {}

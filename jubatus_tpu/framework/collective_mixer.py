@@ -267,17 +267,26 @@ class CollectiveMixer(RpcLinearMixer):
                               "coordinator is unreadable; tearing down the "
                               "jax distributed world to unblock any "
                               "entered peers", rid, self._go_wait())
+                    self.flight.record(
+                        "collective", ok=False, round_id=rid,
+                        reason="go_timeout_unverifiable_world_torn_down")
                     self._kill_world()
                 else:
                     log.warning("round %s: no GO within %.0fs (verified "
                                 "absent); staged diff discarded", rid,
                                 self._go_wait())
+                    self.flight.record(
+                        "collective", ok=False, round_id=rid,
+                        reason="go_timeout_verified_absent")
                 return
         ok = False
         try:
             ok = self._enter_collective(rid, base)
-        except Exception:  # noqa: BLE001 — world torn down mid-psum
+        except Exception as e:  # noqa: BLE001 — world torn down mid-psum
             log.exception("collective entry failed for round %s", rid)
+            self.flight.record("collective", ok=False, round_id=rid,
+                               reason=f"entry_failed: {type(e).__name__}: "
+                                      f"{e}")
         if self.self_node is not None:
             # ephemeral (dies with this session; never journaled) and
             # retried: a dropped ack demotes a healthy member
@@ -320,12 +329,19 @@ class CollectiveMixer(RpcLinearMixer):
         self.last_phases = {}
         totals = psum_pytree(entry["diffs"], compress=self.compress,
                              phases=self.last_phases, prefer_device=True)
-        return self.local_put_obj({
+        ok = self.local_put_obj({
             "protocol": PROTOCOL_VERSION,
             "schema": entry["union"],
             "base_version": base_version,
             "diffs": totals,
         })
+        # flight record for THIS member's collective entry: the per-phase
+        # breakdown (ship/reduce/readback + chunks) is per-member, so
+        # every participant logs one — the master additionally logs a
+        # collective_master record with the ack fold
+        self.flight.record("collective", ok=ok, round_id=rid,
+                           phases=dict(self.last_phases))
+        return ok
 
     # -- master round --------------------------------------------------------
     def _run_as_master(self, members: Sequence[NodeInfo]) -> Optional[Dict[str, Any]]:
@@ -336,6 +352,12 @@ class CollectiveMixer(RpcLinearMixer):
             # not one jax world (not all joined yet): the collective
             # cannot span them — mix over RPC
             self.fallback_rounds += 1
+            self._count("mix.fallback_rounds")
+            self.flight.record(
+                "collective", ok=False,
+                reason=("collective_dead" if self.collective_dead
+                        else f"world_mismatch: {jax.process_count()} jax "
+                             f"processes vs {len(members)} members"))
             return super()._run_as_master(members)
         t0 = time.monotonic()
         schemas = self.comm.get_schemas() if self._has_schema() else []
@@ -358,8 +380,14 @@ class CollectiveMixer(RpcLinearMixer):
                 or "unsupported" in sigs:
             self.comm.collect("mix_abort", rid)
             self.fallback_rounds += 1
+            self._count("mix.fallback_rounds")
             log.info("collective round %s not viable (%d errors, sigs %s); "
                      "falling back to rpc mix", rid, len(errors), len(sigs))
+            self.flight.record(
+                "collective", ok=False, round_id=rid,
+                reason=f"prepare_not_viable: {len(errors)} errors, "
+                       f"{len(sigs)} signatures",
+                members=len(members))
             return super()._run_as_master(members)
         base_version = max(int(r[0]) for _, r in results)
 
@@ -373,8 +401,12 @@ class CollectiveMixer(RpcLinearMixer):
         except Exception:  # noqa: BLE001
             self.comm.collect("mix_abort", rid)
             self.fallback_rounds += 1
+            self._count("mix.fallback_rounds")
             log.warning("collective round %s: GO write failed; falling "
                         "back to rpc mix", rid, exc_info=True)
+            self.flight.record("collective", ok=False, round_id=rid,
+                               reason="go_write_failed",
+                               members=len(members))
             return super()._run_as_master(members)
 
         # collect acks — the members' waiters (this process included)
@@ -417,6 +449,8 @@ class CollectiveMixer(RpcLinearMixer):
             # demoting the whole actives list would unroute the cluster,
             # so report the failed round and let the next one retry
             log.error("collective round %s: no member acked", rid)
+            self.flight.record("collective_master", ok=False, round_id=rid,
+                               reason="no_acks", members=len(members))
             return None
         for member in members:
             if not acks.get(member.name, False):
@@ -427,7 +461,8 @@ class CollectiveMixer(RpcLinearMixer):
                  self.mix_count, len(members), sum(acks.values()),
                  time.monotonic() - t0)
         return {"members": len(members), "collective": True,
-                "acked": sum(acks.values())}
+                "acked": sum(acks.values()),
+                "mode": "collective_master", "round_id": rid}
 
     def get_status(self) -> Dict[str, Any]:
         st = super().get_status()

@@ -37,7 +37,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from jubatus_tpu.coord import membership
 from jubatus_tpu.coord.base import Coordinator, NodeInfo
-from jubatus_tpu.framework.mixer import IntervalMixer
+from jubatus_tpu.framework.mixer import IntervalMixer, MixFlightRecorder
 from jubatus_tpu.parallel.mix import tree_sum
 from jubatus_tpu.rpc.client import RpcClient, RpcMClient
 from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
@@ -219,10 +219,17 @@ class RpcLinearMixer:
         self.driver = driver
         self.comm = comm
         self.self_node = self_node
+        #: per-round flight recorder (framework/mixer.py): master rounds
+        #: land via the scheduler, member-side collective entries and
+        #: failure reasons are recorded by the mixers directly
+        self.flight = MixFlightRecorder()
+        if self_node is not None:
+            self.flight.node = self_node.name
         self._scheduler = IntervalMixer(
             self._mix_round,
             interval_sec=interval_sec,
             interval_count=interval_count,
+            flight=self.flight,
         )
         self.mix_count = 0
         self.bytes_sent = 0
@@ -257,6 +264,10 @@ class RpcLinearMixer:
         )
         rpc_server.register("mix_get_model", lambda _name: self.local_get_model(),
                             binary=True)
+        # flight recorder: structured per-round history (ISSUE 2) — the
+        # same records jubadump --mix-history dumps
+        rpc_server.register(
+            "get_mix_history", lambda _name: self.flight.snapshot())
         # do_mix itself is served by the engine server (it delegates here)
 
     def local_get_schema(self) -> List[str]:
@@ -360,6 +371,10 @@ class RpcLinearMixer:
         """Route mix.round spans into the owning server's registry."""
         self._scheduler.trace = registry
 
+    def _count(self, name: str, n: int = 1) -> None:
+        """Bump a counter in the owning server's registry."""
+        self._scheduler.trace.count(name, n)
+
     # -- scheduling (≙ stabilizer_loop) --------------------------------------
     def start(self) -> None:
         self._scheduler.start()
@@ -399,6 +414,7 @@ class RpcLinearMixer:
 
     def _run_as_master(self, members: Sequence[NodeInfo]) -> Optional[Dict[str, Any]]:
         t0 = time.monotonic()
+        phases: Dict[str, Any] = {}
         # phase 1: schema alignment (classifier label vocab, stat keys) —
         # skipped entirely for engines that don't define a row schema
         schemas = self.comm.get_schemas() if self._has_schema() else []
@@ -410,16 +426,26 @@ class RpcLinearMixer:
         ]
         if schema_union:
             self.comm.sync_schema(schema_union)
+        phases["schema_ms"] = round((time.monotonic() - t0) * 1e3, 2)
         # phase 2: pull row-aligned diffs
+        t1 = time.monotonic()
         replies = self.comm.get_diff()
         if not replies:
             log.error("mix aborted: all get_diffs failed")
+            self.flight.record("rpc", ok=False,
+                               reason="all_get_diffs_failed",
+                               members=len(members))
             return None
         payloads = [unpack_mix(p) for _, p in replies]
         payloads = [p for p in payloads if p.get("protocol") == PROTOCOL_VERSION]
         if not payloads:
+            self.flight.record("rpc", ok=False,
+                               reason="no_protocol_payloads",
+                               members=len(members))
             return None
+        phases["get_diff_ms"] = round((time.monotonic() - t1) * 1e3, 2)
         # phase 3: pairwise fold per mixable (linear_mixer.cpp:481-499)
+        t2 = time.monotonic()
         mixables = self.driver.get_mixables()
         totals: Dict[str, Any] = {}
         for name, mixable in mixables.items():
@@ -440,7 +466,10 @@ class RpcLinearMixer:
             {"protocol": PROTOCOL_VERSION, "schema": schema_union,
              "base_version": base_version, "diffs": totals}
         )
+        phases["fold_ms"] = round((time.monotonic() - t2) * 1e3, 2)
+        t3 = time.monotonic()
         acks = self.comm.put_diff(packed)
+        phases["put_diff_ms"] = round((time.monotonic() - t3) * 1e3, 2)
         # active-list transitions (linear_mixer.cpp:658-681): master demotes
         # failures; successes promote themselves via on_active
         for member in members:
@@ -448,11 +477,14 @@ class RpcLinearMixer:
                 self.comm.register_active(member, False)
         self.mix_count += 1
         self.bytes_sent += len(packed)
+        self._count("mix.bytes_shipped", len(packed))
         log.info(
             "mix round %d: %d members, %d bytes, %.3fs",
             self.mix_count, len(members), len(packed), time.monotonic() - t0,
         )
-        return {"members": len(members), "bytes": len(packed)}
+        return {"members": len(members), "bytes": len(packed),
+                "mode": "rpc", "phases": phases,
+                "acked": sum(bool(v) for v in acks.values())}
 
     # -- obsolete-model recovery (linear_mixer.cpp:404-424,598-632) ----------
     def maybe_recover(self) -> bool:

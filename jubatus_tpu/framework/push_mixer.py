@@ -152,20 +152,29 @@ class RpcPushMixer(RpcLinearMixer):
         t0 = time.monotonic()
         exchanged = 0
         total_bytes = 0
+        failures: List[str] = []
         for peer in candidates:
             try:
                 total_bytes += self._exchange(peer)
                 exchanged += 1
             except Exception as e:  # noqa: BLE001 — gossip shrugs off a peer
                 log.warning("push exchange with %s failed: %s", peer.name, e)
+                failures.append(f"{peer.name}: {type(e).__name__}")
         if not exchanged:
+            # candidates existed but every exchange failed: that's a
+            # failed round, not an idle tick — record it
+            self.flight.record(self.strategy, ok=False,
+                               reason="; ".join(failures) or "no_exchange",
+                               candidates=len(candidates))
             return None
         self.mix_count += 1
         self.bytes_sent += total_bytes
         log.info("push mix round %d (%s): %d/%d peers, %d bytes, %.3fs",
                  self.mix_count, self.strategy, exchanged, len(candidates),
                  total_bytes, time.monotonic() - t0)
-        return {"members": exchanged, "bytes": total_bytes}
+        return {"members": exchanged, "bytes": total_bytes,
+                "mode": self.strategy, "candidates": len(candidates),
+                "failed_peers": failures or None}
 
     def _exchange(self, peer: NodeInfo) -> int:
         """One pairwise linear mix over a single peer connection: align
@@ -239,10 +248,15 @@ class DummyMixer:
     mixer_factory.cpp:24-31)."""
 
     def __init__(self, *_a, **_k) -> None:
+        from jubatus_tpu.framework.mixer import MixFlightRecorder
+
         self.mix_count = 0
+        self.flight = MixFlightRecorder()
 
     def register_api(self, rpc_server, name_check: str = "") -> None:
-        pass
+        # history stays queryable (empty) so tooling needn't special-case
+        rpc_server.register(
+            "get_mix_history", lambda _name: self.flight.snapshot())
 
     def set_trace_registry(self, registry) -> None:
         pass

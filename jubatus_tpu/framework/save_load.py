@@ -104,7 +104,7 @@ def save_model(
 ) -> None:
     system = {
         "version": FORMAT_VERSION,
-        "timestamp": int(time.time()),
+        "timestamp": int(time.time()),  # wall-clock
         "type": driver.TYPE,
         "id": model_id,
         "config": config,

@@ -100,7 +100,7 @@ def save_sharded(
     if jax.process_index() == 0:
         _write_system(os.path.join(dir_path, SYSTEM_FILE), {
             "version": FORMAT_VERSION,
-            "timestamp": int(time.time()),
+            "timestamp": int(time.time()),  # wall-clock
             "type": engine_type,
             "id": model_id,
             "config": config,

@@ -28,7 +28,7 @@ from jubatus_tpu.rpc.errors import (
     wire_to_error,
 )
 from jubatus_tpu.rpc.server import REQUEST, RESPONSE, _to_wire
-from jubatus_tpu.utils import faults
+from jubatus_tpu.utils import faults, tracing
 
 
 class RpcClient:
@@ -78,14 +78,23 @@ class RpcClient:
         # the is_armed() guard keeps the disarmed hot path at one flag read
         if faults.is_armed():
             faults.fire(f"rpc.call.{method}.{self.host}:{self.port}")
+        # trace context rides the envelope as an OPTIONAL 5th element
+        # ({"t": trace_id, "s": span_id}) — attached only when this thread
+        # carries one (i.e. the call happens inside a server dispatch, so
+        # the proxied/fanned-out hop joins the same trace); plain client
+        # calls stay wire-identical to msgpack-rpc
+        ctx = tracing.current_trace()
         with self._lock:
             self._msgid = (self._msgid + 1) & 0xFFFFFFFF
             msgid = self._msgid
+            env: list = [REQUEST, msgid, method, list(args)]
+            if ctx is not None:
+                env.append(tracing.to_wire(ctx))
             # surrogateescape: params a proxy forwards may hold surrogate-
             # bearing strings (legacy non-UTF8 raw decoded upstream); they
             # must re-encode to the original bytes, not raise pre-send
             payload = msgpack.packb(
-                [REQUEST, msgid, method, list(args)], default=_to_wire,
+                env, default=_to_wire,
                 unicode_errors="surrogateescape"
             )
             sock = self._connect()
@@ -112,6 +121,7 @@ class RpcClient:
         caller falls back to the generic path for retry semantics)."""
         if faults.is_armed():
             faults.fire(f"rpc.call.{method}.{self.host}:{self.port}")
+        ctx = tracing.current_trace()
         with self._lock:
             self._msgid = (self._msgid + 1) & 0xFFFFFFFF
             msgid = self._msgid
@@ -122,19 +132,28 @@ class RpcClient:
             # era span could latch the shared connection legacy and
             # degrade other clients' responses. str8 pins it modern.
             mb = method.encode()
-            head = (b"\x94\x00" + msgpack.packb(msgid)
+            # active trace context: 5-element envelope with a trailing
+            # trace span (the backend splits it off the params span)
+            env0 = b"\x95\x00" if ctx is not None else b"\x94\x00"
+            head = (env0 + msgpack.packb(msgid)
                     + b"\xd9" + bytes([len(mb)]) + mb)
+            bufs = [head, raw_params]
+            if ctx is not None:
+                bufs.append(msgpack.packb(tracing.to_wire(ctx)))
             sock = self._connect()
             try:
                 # scatter-gather: no head+params concat copy of a possibly
                 # multi-megabyte span (sendmsg may write short — finish
-                # with sendall on the remainder)
-                sent = sock.sendmsg([head, raw_params])
-                if sent < len(head):
-                    sock.sendall(head[sent:])
-                    sock.sendall(raw_params)
-                elif sent < len(head) + len(raw_params):
-                    sock.sendall(memoryview(raw_params)[sent - len(head):])
+                # with sendall on each remainder)
+                sent = sock.sendmsg(bufs)
+                if sent < sum(len(b) for b in bufs):
+                    off = sent
+                    for b in bufs:
+                        if off >= len(b):
+                            off -= len(b)
+                            continue
+                        sock.sendall(memoryview(b)[off:])
+                        off = 0
                 frame = self._read_raw_response(sock, msgid)
             except socket.timeout as e:
                 self.close()

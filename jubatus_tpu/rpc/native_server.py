@@ -42,8 +42,10 @@ log = logging.getLogger(__name__)
 
 # method is POINTER(c_char), NOT c_char_p: the span is not NUL-terminated
 # (params bytes follow immediately) and c_char_p would strlen past it.
-# Trailing c_int32: envelope_modern — the C++ framer saw a str8 method
-# name, proof of a post-2013 client (RpcClient.call_raw's era pin).
+# Trailing c_int32: envelope flags — bit 0: the C++ framer saw a str8
+# method name, proof of a post-2013 client (RpcClient.call_raw's era
+# pin); bit 1: 5-element traced envelope (the params span ends with a
+# trace element this side splits off).
 _REQUEST_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_uint64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_char),
     ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
@@ -152,7 +154,7 @@ class NativeRpcServer:
 
     # -- C++ → Python dispatch ------------------------------------------------
     def _on_request(self, conn_id, msgid, method, method_len, params_ptr,
-                    params_len, envelope_modern) -> None:
+                    params_len, envelope_flags) -> None:
         """Runs on the connection's C++ reader thread. Small requests
         dispatch INLINE (an executor hop measured ~35% slower for
         ping-sized sync traffic); bulk requests hop to the worker pool in
@@ -169,7 +171,7 @@ class NativeRpcServer:
             return
         try:
             self._dispatch(conn_id, msgid, method_name, raw,
-                           bool(envelope_modern))
+                           int(envelope_flags))
         except Exception:  # noqa: BLE001 — never raise into C++
             log.exception("native rpc dispatch failed for %s", method_name)
 
@@ -181,9 +183,15 @@ class NativeRpcServer:
     _POOL_THRESHOLD = 4096
 
     def _dispatch_fast_bulk(self, conn_id, msgid, method, raw,
-                            conn_state) -> None:
+                            conn_state, trace=None) -> None:
         try:
-            error, result = self._execute_fast(method, raw, conn_state)
+            from jubatus_tpu.utils import tracing
+
+            prev = tracing.swap_trace(tracing.from_wire(trace))
+            try:
+                error, result = self._execute_fast(method, raw, conn_state)
+            finally:
+                tracing.swap_trace(prev)
             if self._stopped:
                 return  # teardown: the C++ handle may be going away
             payload = build_response(
@@ -195,7 +203,23 @@ class NativeRpcServer:
             log.exception("native rpc bulk dispatch failed for %s", method)
 
     def _dispatch(self, conn_id: int, msgid: int, method: str,
-                  raw: bytes, envelope_modern: bool = False) -> None:
+                  raw: bytes, envelope_flags: int = 0) -> None:
+        from jubatus_tpu.utils import tracing
+
+        envelope_modern = bool(envelope_flags & 1)
+        trace = None
+        if envelope_flags & 2:
+            # traced 5-element envelope: the C++ framer hands us
+            # params + trace as one span; split at the params boundary
+            from jubatus_tpu.rpc.server import msgpack_span_end
+
+            try:
+                pend = msgpack_span_end(raw, 0)
+                if pend < len(raw):
+                    trace = msgpack.unpackb(raw[pend:], raw=False)
+                raw = raw[:pend]
+            except Exception:  # noqa: BLE001 — a bad trace element
+                trace = None  # must not kill the dispatch
         conn_state = None
         if self.wire_detect and not self.legacy_wire:
             with self._wire_lock:
@@ -230,9 +254,14 @@ class NativeRpcServer:
         if method in self._raw_methods and msgid != self._NOTIFY:
             if len(raw) >= self._POOL_THRESHOLD and not self._stopped:
                 self._bulk_pool.submit(self._dispatch_fast_bulk, conn_id,
-                                       msgid, method, raw, conn_state)
+                                       msgid, method, raw, conn_state,
+                                       trace)
                 return
-            error, result = self._execute_fast(method, raw, conn_state)
+            prev = tracing.swap_trace(tracing.from_wire(trace))
+            try:
+                error, result = self._execute_fast(method, raw, conn_state)
+            finally:
+                tracing.swap_trace(prev)
             payload = build_response(
                 msgid, error, result,
                 legacy=self.response_legacy(method, conn_state))
@@ -246,7 +275,11 @@ class NativeRpcServer:
         except Exception as e:  # noqa: BLE001 — undecodable params
             error, result = error_to_wire(e), None
         else:
-            error, result = self._execute(method, params)
+            prev = tracing.swap_trace(tracing.from_wire(trace))
+            try:
+                error, result = self._execute(method, params)
+            finally:
+                tracing.swap_trace(prev)
         if msgid == self._NOTIFY:
             return  # notification: no response on the wire
         payload = build_response(

@@ -27,6 +27,7 @@ from jubatus_tpu.rpc.errors import (
     RpcMethodNotFound,
     error_to_wire,
 )
+from jubatus_tpu.utils import tracing
 from jubatus_tpu.utils.tracing import Registry
 
 log = logging.getLogger(__name__)
@@ -143,12 +144,15 @@ def _parse_response_envelope(raw: bytes) -> int:
 
 
 def _parse_envelope(raw: bytes):
-    """Request envelope without decoding params: ``[0, msgid, method, ...]``
-    -> (msgid, method, params_offset), or None for anything else (notify,
-    malformed, exotic headers) — those take the generic decode path."""
+    """Request envelope without decoding params: ``[0, msgid, method,
+    params]`` or the traced 5-element variant ``[0, msgid, method, params,
+    trace]`` -> (msgid, method, params_offset, has_trace), or None for
+    anything else (notify, malformed, exotic headers) — those take the
+    generic decode path."""
     try:
-        if raw[0] != 0x94 or raw[1] != 0x00:  # fixarray(4), REQUEST
+        if raw[0] not in (0x94, 0x95) or raw[1] != 0x00:  # REQUEST
             return None
+        has_trace = raw[0] == 0x95
         i = 2
         t = raw[i]
         if t <= 0x7F:
@@ -171,7 +175,7 @@ def _parse_envelope(raw: bytes):
         else:
             return None
         method = raw[i:i + n].decode("utf-8", "surrogateescape")
-        return msgid, method, i + n
+        return msgid, method, i + n, has_trace
     except IndexError:
         return None
 
@@ -372,10 +376,23 @@ class RpcServer:
                     raw: bytes, conn_state: Optional[dict] = None) -> None:
         env = _parse_envelope(raw)
         if env is not None:
-            msgid, method, off = env
+            msgid, method, off, has_trace = env
+            params_span = raw[off:]
+            trace = None
+            if has_trace:
+                # traced envelope: split the params span from the trailing
+                # trace element (both follow the method; the walk is paid
+                # only on traced requests)
+                try:
+                    pend = msgpack_span_end(raw, off)
+                    if pend < len(raw):
+                        trace = msgpack.unpackb(raw[pend:], raw=False)
+                    params_span = raw[off:pend]
+                except Exception:  # noqa: BLE001 — a bad trace element
+                    params_span, trace = raw[off:], None  # must not 500
             if method in self._raw_methods and self._pool is not None:
                 self._pool.submit(self._dispatch_fast, conn, wlock, msgid,
-                                  method, raw[off:], conn_state)
+                                  method, params_span, conn_state, trace)
                 return
         msg = msgpack.unpackb(raw, raw=False, strict_map_key=False,
                               use_list=True,
@@ -384,8 +401,15 @@ class RpcServer:
 
     def _dispatch_fast(self, conn, wlock, msgid, method,
                        raw_params: bytes,
-                       conn_state: Optional[dict] = None) -> None:
-        error, result = self._execute_fast(method, raw_params, conn_state)
+                       conn_state: Optional[dict] = None,
+                       trace: Any = None) -> None:
+        # adopt the caller's trace context (or root a fresh one) for the
+        # duration of the dispatch; restore after — pool threads are reused
+        prev = tracing.swap_trace(tracing.from_wire(trace))
+        try:
+            error, result = self._execute_fast(method, raw_params, conn_state)
+        finally:
+            tracing.swap_trace(prev)
         payload = build_response(
             msgid, error, result,
             legacy=self.response_legacy(method, conn_state))
@@ -423,6 +447,7 @@ class RpcServer:
         except Exception as e:  # noqa: BLE001 — every failure must answer
             log.debug("rpc raw method %s raised", method, exc_info=True)
             self.trace.record(f"rpc.{method}", _time.perf_counter() - t0)
+            self.trace.count(f"rpc.{method}.errors")
             return error_to_wire(e), None
         params = msgpack.unpackb(raw_params, raw=False, strict_map_key=False,
                                  use_list=True,
@@ -433,19 +458,27 @@ class RpcServer:
                 conn_state: Optional[dict] = None) -> None:
         if not isinstance(msg, (list, tuple)) or not msg:
             return
-        if msg[0] == REQUEST and len(msg) == 4:
-            _, msgid, method, params = msg
+        if msg[0] == REQUEST and len(msg) in (4, 5):
+            # 5th element: optional trace context ({"t","s"}) — see
+            # rpc/client.py; plain msgpack-rpc peers send 4
+            _, msgid, method, params = msg[:4]
+            trace = msg[4] if len(msg) == 5 else None
             if self._pool is not None:
                 self._pool.submit(self._dispatch, conn, wlock, msgid, method,
-                                  params, conn_state)
+                                  params, conn_state, trace)
         elif msg[0] == NOTIFY and len(msg) == 3:
             _, method, params = msg
             if self._pool is not None:
                 self._pool.submit(self._invoke_silent, method, params)
 
     def _dispatch(self, conn, wlock, msgid, method, params,
-                  conn_state: Optional[dict] = None) -> None:
-        error, result = self._execute(method, params)
+                  conn_state: Optional[dict] = None,
+                  trace: Any = None) -> None:
+        prev = tracing.swap_trace(tracing.from_wire(trace))
+        try:
+            error, result = self._execute(method, params)
+        finally:
+            tracing.swap_trace(prev)
         payload = build_response(
             msgid, error, result,
             legacy=self.response_legacy(method, conn_state))
@@ -463,6 +496,9 @@ class RpcServer:
         except Exception as e:  # noqa: BLE001 — every failure must answer
             if not isinstance(e, RpcMethodNotFound):
                 log.debug("rpc method %s raised", method, exc_info=True)
+            # per-method failure counter: the dispatch span times success
+            # and failure identically, so error RATE needs its own series
+            self.trace.count(f"rpc.{method}.errors")
             error = error_to_wire(e)
         return error, result
 

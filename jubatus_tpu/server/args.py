@@ -65,6 +65,9 @@ class ServerArgs:
     #: an f32 master, same tradeoff as the RPC mix's bf16 option). All
     #: members must agree — a mixed cluster falls back to the RPC mix.
     mix_bf16: bool = False
+    #: Prometheus /metrics + /healthz HTTP port (utils/metrics_http.py):
+    #: -1 = off (default), 0 = ephemeral (actual port in get_status)
+    metrics_port: int = -1
 
     @property
     def is_standalone(self) -> bool:
@@ -161,6 +164,9 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "additive diffs fold into an f32 master). All "
                         "members must agree or the round falls back to "
                         "the RPC mix")
+    p.add_argument("--metrics-port", type=int, default=-1,
+                   help="serve Prometheus /metrics + /healthz on this "
+                        "HTTP port (0 = ephemeral; default off)")
     return p
 
 
@@ -177,6 +183,8 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
         raise SystemExit("--shard-devices must be >= 0")
     if args.rpc_port < 0 or args.rpc_port > 65535:
         raise SystemExit("--rpc-port out of range")
+    if args.metrics_port > 65535:
+        raise SystemExit("--metrics-port out of range")
     if not args.is_standalone and not args.name:
         raise SystemExit("distributed mode (-z) requires --name")
     return args

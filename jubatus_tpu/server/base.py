@@ -57,7 +57,7 @@ class EngineServer:
                     f"{len(devs)} local devices present")
             mesh = Mesh(devs, axis_names=("shard",))
         self.driver = create_driver(engine, json.loads(config), mesh=mesh)
-        self.start_time = time.time()
+        self.start_time = time.time()  # wall-clock
         self.last_saved = 0.0
         self.last_loaded = 0.0
         #: train-path microbatch coalescers by method name (service.py
@@ -73,6 +73,8 @@ class EngineServer:
             wire_detect=not getattr(self.args, "modern_wire", False))
         self._stop_event = threading.Event()
         self._stop_once = threading.Lock()  # first stop() wins; rest no-op
+        #: Prometheus /metrics + /healthz endpoint (--metrics-port >= 0)
+        self.metrics = None
         #: pooled peer clients for server-side replicated writes
         self._peers: Dict[str, Any] = {}
         self._peer_lock = threading.Lock()
@@ -227,7 +229,7 @@ class EngineServer:
         with self.driver.lock:
             save_model(path, self.driver, model_id=model_id,
                        config=self.config_json)
-        self.last_saved = time.time()
+        self.last_saved = time.time()  # wall-clock
         node = NodeInfo(self.args.eth, self.args.rpc_port)
         return {node.name: path}
 
@@ -238,19 +240,40 @@ class EngineServer:
     def load_file(self, path: str) -> None:
         with self.driver.lock:
             load_model(path, self.driver, expected_config=self.config_json)
-        self.last_loaded = time.time()
+        self.last_loaded = time.time()  # wall-clock
 
     def do_mix(self, _name: str = "") -> bool:
         if self.mixer is None:
             return False
         return self.mixer.mix_now() is not None
 
+    def get_metrics(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
+        """Raw mergeable metrics state keyed like get_status: one map per
+        node name, holding span histogram buckets + counters. ``jubactl
+        metrics`` folds these across the cluster for exact merged
+        quantiles (bucket-wise sums — see utils/tracing.py)."""
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        return {node.name: self.rpc.trace.snapshot()}
+
+    def _health(self) -> Dict[str, Any]:
+        """Liveness document for /healthz (utils/metrics_http.py)."""
+        doc: Dict[str, Any] = {
+            "engine": self.engine,
+            "name": self.args.name,
+            "uptime_s": int(time.time() - self.start_time),  # wall-clock
+            "rpc_port": self.rpc.port or self.args.rpc_port,
+            "update_count": self.driver.update_count,
+        }
+        if self.mixer is not None:
+            doc["mix_count"] = getattr(self.mixer, "mix_count", 0)
+        return doc
+
     def get_status(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
         """≙ server_helper::get_status (server_helper.hpp:119-219): one map
         keyed by <ip>_<port> with uptime/memory/flags/counters."""
         st: Dict[str, Any] = {
-            "timestamp": int(time.time()),
-            "uptime": int(time.time() - self.start_time),
+            "timestamp": int(time.time()),  # wall-clock
+            "uptime": int(time.time() - self.start_time),  # wall-clock
             "type": self.engine,
             "name": self.args.name,
             "version": __version__,
@@ -283,9 +306,17 @@ class EngineServer:
         st.update({f"driver.{k}": v for k, v in self.driver.get_status().items()})
         if self.mixer is not None:
             st.update({f"mixer.{k}": v for k, v in self.mixer.get_status().items()})
-        # span aggregates (SURVEY §5: tracing the reference never had) —
-        # this server's own registry, not the process default
+        # span histograms + counters (SURVEY §5: tracing the reference
+        # never had) — this server's own registry, not the process default
         st.update(self.rpc.trace.trace_status())
+        # process-wide counters (zk session events, ...) live in the
+        # default registry; surface them without clobbering our own
+        from jubatus_tpu.utils import tracing as _tracing
+
+        for k, v in _tracing.default_registry().counters().items():
+            st.setdefault(f"trace.counter.{k}", v)
+        if self.metrics is not None:
+            st["metrics_port"] = self.metrics.port
         node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
         return {node.name: st}
 
@@ -302,10 +333,25 @@ class EngineServer:
             host=self.args.bind_host,
         )
         self.args.rpc_port = actual
+        if getattr(self.args, "metrics_port", -1) >= 0:
+            from jubatus_tpu.utils.metrics_http import MetricsServer
+
+            node = NodeInfo(self.args.eth, actual)
+            self.metrics = MetricsServer(
+                self.rpc.trace,
+                labels={"engine": self.engine, "cluster": self.args.name,
+                        "node": node.name},
+                health_fn=self._health,
+                host=self.args.bind_host, port=self.args.metrics_port)
+            self.args.metrics_port = self.metrics.start()
+            log.info("metrics endpoint on %s:%d", self.args.bind_host,
+                     self.args.metrics_port)
         if self.coord is not None and self.mixer is not None:
             node = NodeInfo(self.args.eth, actual)
             # ephemeral-port binds (start(0)) resolve only now
             self.mixer.self_node = node
+            if getattr(self.mixer, "flight", None) is not None:
+                self.mixer.flight.node = node.name
             path = membership.register_actor(
                 self.coord, self.engine, self.args.name, node.host, node.port
             )
@@ -383,6 +429,7 @@ class EngineServer:
                 (self.mixer.stop if self.mixer is not None else None),
                 (self.coord.close if self.coord is not None else None),
                 self.rpc.stop,
+                (self.metrics.stop if self.metrics is not None else None),
                 self._close_peers,
             ):
                 if step is None:

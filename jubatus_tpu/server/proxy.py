@@ -41,6 +41,7 @@ from jubatus_tpu.rpc.errors import (
     RpcNoResult,
     RpcTimeoutError,
 )
+from jubatus_tpu.utils import tracing
 from jubatus_tpu.version import __version__
 
 log = logging.getLogger(__name__)
@@ -64,6 +65,8 @@ class ProxyArgs:
     daemon: bool = False
     legacy_wire: bool = False           # --legacy-wire (see rpc/legacy.py)
     modern_wire: bool = False           # --modern-wire: no autodetection
+    #: Prometheus /metrics + /healthz HTTP port: -1 = off, 0 = ephemeral
+    metrics_port: int = -1
 
     @property
     def bind_host(self) -> str:
@@ -173,7 +176,7 @@ class Proxy:
             timeout=args.timeout,
             legacy_wire=getattr(args, "legacy_wire", False),
             wire_detect=not getattr(args, "modern_wire", False))
-        self.start_time = time.time()
+        self.start_time = time.time()  # wall-clock
         self._pool: Dict[Tuple[str, int], List[_Session]] = {}
         self._pool_lock = threading.Lock()
         self._last_expiry = 0.0
@@ -193,6 +196,8 @@ class Proxy:
         self._relay_methods: List[str] = []
         self._relay_seen: Dict[str, float] = {}  # cluster -> last-live ts
         self._relay_lock = threading.Lock()
+        #: Prometheus /metrics + /healthz endpoint (--metrics-port >= 0)
+        self.metrics = None
         self._register_methods()
         if hasattr(self.rpc, "relay_config"):
             t = threading.Thread(target=self._relay_refresher, daemon=True,
@@ -275,9 +280,13 @@ class Proxy:
             self.forward_count += len(nodes)
         if len(nodes) == 1:
             return self._one(nodes[0], method, args)
+        # the fan-out hops threads: carry this request's trace context
+        # into the executor so each backend call ships the same trace_id
+        ctx = tracing.current_trace()
 
         def call(n: NodeInfo) -> Any:
-            return self._one(n, method, args)
+            with tracing.use_trace(ctx):
+                return self._one(n, method, args)
 
         futs = [(n, self._executor.submit(call, n)) for n in nodes]
         results: List[Any] = []
@@ -476,8 +485,11 @@ class Proxy:
         self._register("save", 2, "broadcast", aggregators.merge)
         self._register("load", 2, "broadcast", aggregators.all_and)
         self._register("get_status", 1, "broadcast", aggregators.merge)
+        self._register("get_metrics", 1, "broadcast", aggregators.merge)
+        self._register("get_mix_history", 1, "broadcast", aggregators.concat)
         self._register("do_mix", 1, "random", aggregators.pass_)
         self.rpc.register("get_proxy_status", self.get_proxy_status, arity=1)
+        self.rpc.register("get_proxy_metrics", self.get_metrics, arity=1)
 
     # -- own status (proxy_common::get_status) --------------------------------
     def get_proxy_status(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
@@ -493,8 +505,8 @@ class Proxy:
         relay_errors = relayed.pop("__errors__", 0)
         with self._counters_lock:
             st: Dict[str, Any] = {
-                "timestamp": int(time.time()),
-                "uptime": int(time.time() - self.start_time),
+                "timestamp": int(time.time()),  # wall-clock
+                "uptime": int(time.time() - self.start_time),  # wall-clock
                 "type": f"{self.engine}_proxy",
                 "version": __version__,
                 "forward_count": self.forward_count + sum(relayed.values()),
@@ -508,7 +520,25 @@ class Proxy:
                 counts[m] = counts.get(m, 0) + c
             st.update({f"request.{k}": v for k, v in counts.items()})
         st.update(self.args.flags_status())
+        # span histograms + counters (same registry /metrics exposes) —
+        # the proxy hop's rpc.* quantiles and trace ids sit next to the
+        # backends' in a merged get_status view
+        st.update(self.rpc.trace.trace_status())
         return {node.name: st}
+
+    def get_metrics(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
+        """This proxy's own mergeable metrics snapshot (the RPC-routed
+        ``get_metrics`` fans out to the backends instead)."""
+        node = NodeInfo(self.args.bind_host, self.rpc.port or self.args.rpc_port)
+        return {node.name: self.rpc.trace.snapshot()}
+
+    def _health(self) -> Dict[str, Any]:
+        with self._counters_lock:
+            fwd, errs = self.forward_count, self.forward_errors
+        return {"engine": f"{self.engine}_proxy",
+                "uptime_s": int(time.time() - self.start_time),  # wall-clock
+                "rpc_port": self.rpc.port or self.args.rpc_port,
+                "forward_count": fwd, "forward_errors": errs}
 
     # -- lifecycle ------------------------------------------------------------
     def start(self, port: Optional[int] = None) -> int:
@@ -518,6 +548,18 @@ class Proxy:
             host=self.args.bind_host,
         )
         self.args.rpc_port = actual
+        if getattr(self.args, "metrics_port", -1) >= 0:
+            from jubatus_tpu.utils.metrics_http import MetricsServer
+
+            self.metrics = MetricsServer(
+                self.rpc.trace,
+                labels={"engine": f"{self.engine}_proxy",
+                        "node": f"{self.args.bind_host}_{actual}"},
+                health_fn=self._health,
+                host=self.args.bind_host, port=self.args.metrics_port)
+            self.args.metrics_port = self.metrics.start()
+            log.info("proxy metrics endpoint on %s:%d", self.args.bind_host,
+                     self.args.metrics_port)
         try:
             membership.register_proxy(self.coord, self.args.bind_host, actual)
         except Exception:  # noqa: BLE001 — registry is informational for proxies
@@ -530,6 +572,11 @@ class Proxy:
 
     def stop(self) -> None:
         self.rpc.stop()
+        if self.metrics is not None:
+            try:
+                self.metrics.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.debug("metrics endpoint stop failed", exc_info=True)
         with self._pool_lock:
             for lst in self._pool.values():
                 for sess in lst:
@@ -563,6 +610,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(otherwise autodetected per connection)")
     p.add_argument("--modern-wire", action="store_true",
                    help="disable per-connection legacy-wire autodetection")
+    p.add_argument("--metrics-port", type=int, default=-1,
+                   help="serve Prometheus /metrics + /healthz on this "
+                        "HTTP port (0 = ephemeral; default off)")
     ns = p.parse_args(argv)
     args = ProxyArgs(**{f.name: getattr(ns, f.name)
                         for f in dataclasses.fields(ProxyArgs)

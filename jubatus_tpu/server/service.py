@@ -61,6 +61,7 @@ def bind_engine(rpc: RpcServer, server: Any) -> None:
     rpc.register("save", server.save, arity=2)
     rpc.register("load", server.load, arity=2)
     rpc.register("get_status", server.get_status, arity=1)
+    rpc.register("get_metrics", server.get_metrics, arity=1)
     rpc.register("do_mix", server.do_mix, arity=1)
     _BINDERS[server.engine](rpc, server)
 

@@ -1,14 +1,24 @@
-"""Tracing & profiling (SURVEY.md §5: the reference has NONE — its closest
-facility is per-round mix timing logs. This subsystem is the first-class
-improvement the survey calls for).
+"""Tracing & metrics plane (SURVEY.md §5: the reference has NONE — its
+closest facility is per-round mix timing logs).
 
-Two layers:
+Three layers:
 
-- **Span aggregates** (always on, ~100 ns/span): every RPC dispatch and
-  every mix round records into per-name aggregates (count / total / max /
-  last seconds). ``trace_status()`` flattens them into the ``get_status``
-  map, so operators see p50-ish latencies per method cluster-wide through
-  the same RPC the reference exposes counters on.
+- **Span histograms** (always on, ~O(100 ns)/record): every RPC dispatch
+  and every mix round records into a fixed-size log-bucketed histogram
+  (quarter-octave buckets, ~19% relative quantile error) per span name,
+  so ``trace_status()`` reports TRUE p50/p90/p99/max — not the
+  count/mean/max "p50-ish" aggregates this module used to serve.
+  Monotonic **counters** (rpc errors, mix failures, bytes shipped) ride
+  the same registry. Histograms expose a mergeable ``snapshot()`` so
+  ``jubactl metrics`` can fold every member's buckets into one exact
+  cluster-wide quantile view, and a Prometheus text exposition
+  (``prometheus_text``) served by utils/metrics_http.py.
+- **Trace context** (request-scoped): a thread-local (trace_id, span_id)
+  pair propagated through the RPC envelope (rpc/client.py attaches it,
+  rpc/server.py adopts it), so a proxied call shows up as ONE trace — the
+  proxy hop and the backend hop record the same trace_id into their own
+  registries (``trace.<name>.last_trace_id`` in get_status), and a small
+  ring of recent span records supports flight-recorder style debugging.
 - **XLA device traces** (opt-in): ``device_trace()`` wraps
   ``jax.profiler.trace`` when ``JUBATUS_TPU_TRACE_DIR`` is set (or a dir
   is passed), capturing TensorBoard-viewable TPU timelines of the jitted
@@ -18,19 +28,228 @@ Two layers:
 from __future__ import annotations
 
 import contextlib
+import itertools
+import math
 import os
 import threading
 import time
-from typing import Any, Dict, Iterator, Optional
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
+
+# -- histogram geometry -------------------------------------------------------
+# Quarter-octave log buckets from 2^-20 s (~1 us) to 2^7 s (128 s) plus an
+# overflow bucket: 109 fixed slots, bucket index is one log2 + one
+# multiply — cheap enough for the RPC dispatch hot path.
+_LOG2_MIN = -20
+_SUB = 4                       # buckets per octave (2^(1/4) ~ 1.19x width)
+_OCTAVES = 27
+_OVERFLOW = _OCTAVES * _SUB    # index of the overflow bucket
+_NBUCKETS = _OVERFLOW + 1
+_MIN_S = 2.0 ** _LOG2_MIN
+#: upper bound (seconds) of each finite bucket
+_BOUNDS = [2.0 ** (_LOG2_MIN + (i + 1) / _SUB) for i in range(_OVERFLOW)]
+#: geometric-midpoint factor: bucket value = upper_bound * 2^(-1/(2*SUB))
+_MID = 2.0 ** (-0.5 / _SUB)
+
+
+def bucket_index(seconds: float) -> int:
+    """Histogram slot for a duration (clamped to [0, overflow])."""
+    if seconds <= _MIN_S:
+        return 0
+    i = int((math.log2(seconds) - _LOG2_MIN) * _SUB)
+    return i if i < _OVERFLOW else _OVERFLOW
+
+
+class Histogram:
+    """One span name's fixed-size log-bucketed latency histogram.
+
+    Not internally locked — the owning Registry serializes access (one
+    registry lock per record beats per-histogram locks at our fan-in).
+    """
+
+    __slots__ = ("counts", "count", "total_s", "max_s", "last_s",
+                 "last_trace_id")
+
+    def __init__(self) -> None:
+        self.counts = [0] * _NBUCKETS
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.last_s = 0.0
+        self.last_trace_id = ""
+
+    def record(self, seconds: float) -> None:
+        self.counts[bucket_index(seconds)] += 1
+        self.count += 1
+        self.total_s += seconds
+        self.last_s = seconds
+        if seconds > self.max_s:
+            self.max_s = seconds
+
+    def quantile(self, q: float) -> Optional[float]:
+        """q-quantile in seconds (geometric bucket midpoint, clamped to
+        the observed max); None when empty."""
+        if self.count == 0:
+            return None
+        target = max(1, math.ceil(q * self.count))
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if not c:
+                continue
+            cum += c
+            if cum >= target:
+                if i >= _OVERFLOW:
+                    return self.max_s
+                return min(_BOUNDS[i] * _MID, self.max_s)
+        return self.max_s
+
+    def state(self) -> Dict[str, Any]:
+        """Wire/JSON-safe mergeable state (sparse buckets)."""
+        return {
+            "buckets": {i: c for i, c in enumerate(self.counts) if c},
+            "count": self.count,
+            "total_s": self.total_s,
+            "max_s": self.max_s,
+            "last_s": self.last_s,
+            "last_trace_id": self.last_trace_id,
+        }
+
+
+def merge_hist_states(states: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold histogram ``state()`` dicts from N nodes into one (bucket-wise
+    sum — quantiles of the merge are exact at bucket resolution). Bucket
+    keys may arrive as strings (JSON round trips)."""
+    out: Dict[str, Any] = {"buckets": {}, "count": 0, "total_s": 0.0,
+                           "max_s": 0.0, "last_s": 0.0, "last_trace_id": ""}
+    for st in states:
+        for k, c in (st.get("buckets") or {}).items():
+            i = int(k)
+            out["buckets"][i] = out["buckets"].get(i, 0) + int(c)
+        out["count"] += int(st.get("count", 0))
+        out["total_s"] += float(st.get("total_s", 0.0))
+        out["max_s"] = max(out["max_s"], float(st.get("max_s", 0.0)))
+        out["last_s"] = float(st.get("last_s", out["last_s"]))
+        out["last_trace_id"] = st.get("last_trace_id") or out["last_trace_id"]
+    return out
+
+
+def state_quantile(state: Dict[str, Any], q: float) -> Optional[float]:
+    """Quantile (seconds) from a histogram ``state()``/merged state."""
+    count = int(state.get("count", 0))
+    if count == 0:
+        return None
+    target = max(1, math.ceil(q * count))
+    cum = 0
+    max_s = float(state.get("max_s", 0.0))
+    buckets = {int(k): int(v)
+               for k, v in (state.get("buckets") or {}).items()}
+    for i in sorted(buckets):
+        cum += buckets[i]
+        if cum >= target:
+            if i >= _OVERFLOW:
+                return max_s
+            return min(_BOUNDS[i] * _MID, max_s)
+    return max_s
+
+
+def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold N registry ``snapshot()`` dicts into one cluster-wide view."""
+    hist_states: Dict[str, List[Dict[str, Any]]] = {}
+    counters: Dict[str, int] = {}
+    for snap in snaps:
+        for name, st in (snap.get("hists") or {}).items():
+            hist_states.setdefault(str(name), []).append(st)
+        for name, v in (snap.get("counters") or {}).items():
+            counters[str(name)] = counters.get(str(name), 0) + int(v)
+    return {"hists": {n: merge_hist_states(sts)
+                      for n, sts in hist_states.items()},
+            "counters": counters}
+
+
+# -- trace context ------------------------------------------------------------
+
+class TraceContext:
+    """One hop's identity inside a distributed trace."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: str = "") -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+
+
+_tls = threading.local()
+_id_seq = itertools.count(1)
+_PROC = os.urandom(4).hex()
+
+
+def _new_id() -> str:
+    # process-unique prefix + atomic counter: ~200 ns, no urandom per call
+    return f"{_PROC}{next(_id_seq) & 0xFFFFFFFF:08x}"
+
+
+def current_trace() -> Optional[TraceContext]:
+    return getattr(_tls, "ctx", None)
+
+
+def swap_trace(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Install ``ctx`` as this thread's trace context; returns the
+    previous one (restore it in a finally — dispatch pool threads are
+    reused, a leaked context would mislabel the next request)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    return prev
+
+
+@contextlib.contextmanager
+def use_trace(ctx: Optional[TraceContext]) -> Iterator[None]:
+    prev = swap_trace(ctx)
+    try:
+        yield
+    finally:
+        swap_trace(prev)
+
+
+def from_wire(wire: Any) -> TraceContext:
+    """Adopt a wire trace element ({"t": trace_id, "s": caller span}) as a
+    child context, or start a fresh root when the caller sent none."""
+    if isinstance(wire, dict):
+        tid = wire.get("t")
+        if isinstance(tid, bytes):
+            tid = tid.decode("utf-8", "replace")
+        parent = wire.get("s", "")
+        if isinstance(parent, bytes):
+            parent = parent.decode("utf-8", "replace")
+        if tid:
+            return TraceContext(str(tid), _new_id(), str(parent))
+    return TraceContext(_new_id(), _new_id(), "")
+
+
+def to_wire(ctx: TraceContext) -> Dict[str, str]:
+    return {"t": ctx.trace_id, "s": ctx.span_id}
+
+
+# -- the registry -------------------------------------------------------------
+
+#: recent span records kept per registry (flight-recorder style ring)
+_SPAN_RING = 256
+
 
 class Registry:
-    """One set of span aggregates. Each server owns its own so multi-server
-    processes (tests, embedded clusters) attribute spans per node; the
-    module-level functions use a process default."""
+    """One node's metrics: span histograms + counters + recent spans.
+
+    Each server owns its own so multi-server processes (tests, embedded
+    clusters) attribute spans per node; the module-level functions use a
+    process default.
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._aggregates: Dict[str, Dict[str, float]] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._counters: Dict[str, int] = {}
+        self._spans: deque = deque(maxlen=_SPAN_RING)
 
     @contextlib.contextmanager
     def span(self, name: str) -> Iterator[None]:
@@ -41,33 +260,116 @@ class Registry:
             self.record(name, time.perf_counter() - t0)
 
     def record(self, name: str, seconds: float) -> None:
+        ctx = getattr(_tls, "ctx", None)
         with self._lock:
-            agg = self._aggregates.get(name)
-            if agg is None:
-                agg = self._aggregates[name] = {
-                    "count": 0, "total_s": 0.0, "max_s": 0.0, "last_s": 0.0}
-            agg["count"] += 1
-            agg["total_s"] += seconds
-            agg["last_s"] = seconds
-            if seconds > agg["max_s"]:
-                agg["max_s"] = seconds
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.record(seconds)
+            if ctx is not None:
+                h.last_trace_id = ctx.trace_id
+                self._spans.append({
+                    "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+                    "parent_id": ctx.parent_id, "name": name,
+                    "duration_ms": round(seconds * 1e3, 3),
+                    "ts": time.time()})
+
+    def count(self, name: str, n: int = 1) -> None:
+        """Bump a monotonic counter (rpc errors, retries, bytes, ...)."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def recent_spans(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._spans)
 
     def trace_status(self, prefix: str = "trace") -> Dict[str, Any]:
-        """Flattened aggregates for get_status maps: trace.<name>.{count,
-        mean_ms,max_ms,last_ms}."""
+        """Flattened metrics for get_status maps: trace.<name>.{count,
+        mean_ms, p50_ms, p90_ms, p99_ms, max_ms, last_ms[, last_trace_id]}
+        plus trace.counter.<name> for the monotonic counters."""
         out: Dict[str, Any] = {}
         with self._lock:
-            for name, agg in self._aggregates.items():
-                n = int(agg["count"]) or 1
-                out[f"{prefix}.{name}.count"] = int(agg["count"])
-                out[f"{prefix}.{name}.mean_ms"] = round(agg["total_s"] / n * 1e3, 3)
-                out[f"{prefix}.{name}.max_ms"] = round(agg["max_s"] * 1e3, 3)
-                out[f"{prefix}.{name}.last_ms"] = round(agg["last_s"] * 1e3, 3)
+            for name, h in self._hists.items():
+                n = h.count or 1
+                out[f"{prefix}.{name}.count"] = h.count
+                out[f"{prefix}.{name}.mean_ms"] = round(h.total_s / n * 1e3, 3)
+                for qname, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):
+                    v = h.quantile(q)
+                    out[f"{prefix}.{name}.{qname}_ms"] = \
+                        round((v or 0.0) * 1e3, 3)
+                out[f"{prefix}.{name}.max_ms"] = round(h.max_s * 1e3, 3)
+                out[f"{prefix}.{name}.last_ms"] = round(h.last_s * 1e3, 3)
+                if h.last_trace_id:
+                    out[f"{prefix}.{name}.last_trace_id"] = h.last_trace_id
+            for name, v in self._counters.items():
+                out[f"{prefix}.counter.{name}"] = v
         return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Mergeable raw state for get_metrics / jubactl metrics."""
+        with self._lock:
+            return {"hists": {n: h.state() for n, h in self._hists.items()},
+                    "counters": dict(self._counters)}
+
+    def prometheus_text(self,
+                        labels: Optional[Dict[str, str]] = None) -> str:
+        """Prometheus text exposition (format 0.0.4) of every histogram
+        and counter. Bucket lines are emitted only at occupied bucket
+        boundaries (+Inf always) — valid cumulative histograms, compact
+        wire."""
+        base = "".join(f',{k}="{_esc(v)}"'
+                       for k, v in sorted((labels or {}).items()))
+        lines = [
+            "# TYPE jubatus_span_duration_seconds histogram",
+            "# HELP jubatus_span_duration_seconds "
+            "Span latency by name (log-bucketed).",
+        ]
+        with self._lock:
+            hists = [(n, h.counts[:], h.count, h.total_s, h.max_s)
+                     for n, h in sorted(self._hists.items())]
+            counters = sorted(self._counters.items())
+        for name, counts, count, total_s, max_s in hists:
+            sel = f'span="{_esc(name)}"{base}'
+            cum = 0
+            for i, c in enumerate(counts):
+                if not c or i >= _OVERFLOW:
+                    continue
+                cum += c
+                lines.append(
+                    f"jubatus_span_duration_seconds_bucket{{{sel},"
+                    f'le="{_BOUNDS[i]:.9g}"}} {cum}')
+            lines.append(
+                f'jubatus_span_duration_seconds_bucket{{{sel},le="+Inf"}} '
+                f"{count}")
+            lines.append(
+                f"jubatus_span_duration_seconds_sum{{{sel}}} {total_s:.9g}")
+            lines.append(
+                f"jubatus_span_duration_seconds_count{{{sel}}} {count}")
+        lines.append("# TYPE jubatus_span_max_seconds gauge")
+        for name, _counts, _count, _total, max_s in hists:
+            lines.append(
+                f'jubatus_span_max_seconds{{span="{_esc(name)}"{base}}} '
+                f"{max_s:.9g}")
+        lines.append("# TYPE jubatus_events_total counter")
+        for name, v in counters:
+            lines.append(
+                f'jubatus_events_total{{event="{_esc(name)}"{base}}} {v}')
+        return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
         with self._lock:
-            self._aggregates.clear()
+            self._hists.clear()
+            self._counters.clear()
+            self._spans.clear()
+
+
+def _esc(v: Any) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r"\"").replace(
+        "\n", r"\n")
 
 
 _default = Registry()
@@ -83,6 +385,10 @@ def span(name: str):
 
 def record(name: str, seconds: float) -> None:
     _default.record(name, seconds)
+
+
+def count(name: str, n: int = 1) -> None:
+    _default.count(name, n)
 
 
 def trace_status(prefix: str = "trace") -> Dict[str, Any]:

@@ -205,16 +205,20 @@ bool read_str(const uint8_t*& p, const uint8_t* end,
 
 // ---------------------------------------------------------------- server
 
-// envelope_modern: 1 when the envelope itself proves a post-2013 client
-// (the method name arrived as str8 — fixraw/raw16/raw32 are the only
-// encodings a vendored-msgpack client can emit). The Python layer ORs it
-// into the wire-era fingerprint; without it, clients that deliberately
-// pin the era via a str8 method name (RpcClient.call_raw) would be
-// fingerprinted from the params span alone.
+// envelope_flags bit 0 (modern): the envelope itself proves a post-2013
+// client (the method name arrived as str8 — fixraw/raw16/raw32 are the
+// only encodings a vendored-msgpack client can emit). The Python layer
+// ORs it into the wire-era fingerprint; without it, clients that
+// deliberately pin the era via a str8 method name (RpcClient.call_raw)
+// would be fingerprinted from the params span alone.
+// bit 1 (traced): the request arrived as the 5-element traced envelope
+// [0, msgid, method, params, trace] — the params span handed to the
+// callback then ends with the trace element, which the Python layer
+// splits off (rpc/server.py msgpack_span_end).
 typedef void (*request_cb)(uint64_t conn_id, uint64_t msgid,
                            const char* method, int64_t method_len,
                            const uint8_t* params, int64_t params_len,
-                           int32_t envelope_modern);
+                           int32_t envelope_flags);
 
 // msgid sentinel announcing a connection CLOSED (method/params empty):
 // lets the Python side drop per-connection state (wire-era fingerprints)
@@ -665,7 +669,7 @@ const uint8_t* parse_frame(Server* s, uint64_t conn_id,
   uint64_t type = 0, msgid = kNotifyMsgid;
   const uint8_t* mdata;
   int64_t mlen;
-  if (count == 4) {  // request
+  if (count == 4 || count == 5) {  // request (5 = traced envelope)
     if (!read_uint(q, frame_end, &type) || type != 0) return malformed();
     // both sentinels are reserved: a wire msgid equal to kCloseId would
     // spoof a connection-close notification into the Python layer
@@ -677,15 +681,19 @@ const uint8_t* parse_frame(Server* s, uint64_t conn_id,
   } else {
     return malformed();
   }
-  const int32_t envelope_modern = (q < frame_end && *q == 0xd9) ? 1 : 0;
+  int32_t envelope_flags = (q < frame_end && *q == 0xd9) ? 1 : 0;
+  if (count == 5) envelope_flags |= 2;
   if (!read_str(q, frame_end, &mdata, &mlen)) return malformed();
   // relay hot path: configured methods forward to a backend without ever
-  // entering Python (the frame is consumed when relay_try returns true)
-  if (count == 4 && s->relay.enabled.load(std::memory_order_relaxed) &&
+  // entering Python (the frame is consumed when relay_try returns true).
+  // Traced (5-element) frames forward verbatim too — the trace element
+  // rides through to the backend, which splits it off itself.
+  if ((count == 4 || count == 5) &&
+      s->relay.enabled.load(std::memory_order_relaxed) &&
       relay_try(s, conn, p, frame_end, msgid, mdata, mlen, q))
     return frame_end;
   s->cb(conn_id, msgid, reinterpret_cast<const char*>(mdata), mlen, q,
-        frame_end - q, envelope_modern);
+        frame_end - q, envelope_flags);
   return frame_end;
 }
 

@@ -131,6 +131,16 @@ other = f"x{(pid + 1) % n}"
 (res,) = c.classify([Datum({other: 1.0})])
 scores = dict(res)
 assert scores["pos"] > 0.0 > scores["neg"], (other, scores)
+
+# flight recorder: every member logged its collective entry with the
+# per-phase breakdown, and the record is queryable over the RPC
+from jubatus_tpu.rpc.client import RpcClient
+with RpcClient("127.0.0.1", port, timeout=30) as hc:
+    hist = hc.call("get_mix_history", "cm")
+col = [r for r in hist if r.get("mode") == "collective" and r.get("ok")]
+assert col, hist
+for key in ("ship_ms", "reduce_ms", "readback_ms", "chunks"):
+    assert key in (col[-1].get("phases") or {}), (key, col[-1])
 c.close()
 srv.stop()
 print(f"CHILD-{pid}-OK", flush=True)

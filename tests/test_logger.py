@@ -29,6 +29,56 @@ def test_logdir_writes_file(tmp_path):
         _cleanup()
 
 
+def test_install_sighup_reload_noop_without_config():
+    """install_sighup_reload("") must not claim the SIGHUP handler."""
+    prev = signal.getsignal(signal.SIGHUP)
+    try:
+        jlog.install_sighup_reload("")
+        assert signal.getsignal(signal.SIGHUP) is prev
+    finally:
+        signal.signal(signal.SIGHUP, prev)
+
+
+def test_sighup_reload_keeps_old_config_on_error(tmp_path):
+    """A broken config file at reload time must keep the previous logging
+    config (the reference's contract: a bad rotate never mutes a server)."""
+    conf = tmp_path / "log.json"
+    conf.write_text(json.dumps({
+        "version": 1, "root": {"level": "WARNING", "handlers": []}}))
+    try:
+        jlog.setup("jubatest", log_config=str(conf))
+        jlog.install_sighup_reload(str(conf))
+        assert logging.getLogger().level == logging.WARNING
+        conf.write_text("{not json")
+        os.kill(os.getpid(), signal.SIGHUP)  # must not raise
+        assert logging.getLogger().level == logging.WARNING
+        # and a later GOOD config applies again
+        conf.write_text(json.dumps({
+            "version": 1, "root": {"level": "ERROR", "handlers": []}}))
+        os.kill(os.getpid(), signal.SIGHUP)
+        assert logging.getLogger().level == logging.ERROR
+    finally:
+        signal.signal(signal.SIGHUP, signal.SIG_DFL)
+        logging.getLogger().setLevel(logging.WARNING)
+        _cleanup()
+
+
+def test_sighup_reload_missing_file_keeps_old_config(tmp_path):
+    conf = tmp_path / "log.json"
+    conf.write_text(json.dumps({
+        "version": 1, "root": {"level": "INFO", "handlers": []}}))
+    try:
+        jlog.setup("jubatest", log_config=str(conf))
+        jlog.install_sighup_reload(str(conf))
+        conf.unlink()
+        os.kill(os.getpid(), signal.SIGHUP)  # must not raise
+        assert logging.getLogger().level == logging.INFO
+    finally:
+        signal.signal(signal.SIGHUP, signal.SIG_DFL)
+        logging.getLogger().setLevel(logging.WARNING)
+        _cleanup()
+
+
 def test_log_config_and_sighup_reload(tmp_path):
     conf = tmp_path / "log.json"
 

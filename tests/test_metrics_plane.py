@@ -1,0 +1,420 @@
+"""Metrics-plane tests (ISSUE 2): histogram edge cases, /metrics +
+/healthz exposition, RPC error counters, trace propagation through the
+proxy, the mix flight recorder + get_mix_history, and jubactl's merged
+cluster views."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from jubatus_tpu.utils import tracing
+
+CONF = {
+    "method": "PA",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+
+
+# -- histogram edge cases -----------------------------------------------------
+
+
+def test_histogram_empty():
+    h = tracing.Histogram()
+    assert h.quantile(0.5) is None
+    assert h.count == 0 and h.max_s == 0.0
+
+
+def test_histogram_single_sample_quantiles_exact():
+    h = tracing.Histogram()
+    h.record(0.005)
+    # every quantile of a single sample is the sample (max-clamped)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert h.quantile(q) == pytest.approx(0.005)
+
+
+def test_histogram_overflow_bucket():
+    h = tracing.Histogram()
+    h.record(1e6)  # way past the 128 s top bucket
+    assert h.quantile(0.5) == pytest.approx(1e6)
+    st = h.state()
+    assert max(int(k) for k in st["buckets"]) == tracing._OVERFLOW
+
+
+def test_histogram_underflow_clamps_to_first_bucket():
+    h = tracing.Histogram()
+    h.record(0.0)
+    h.record(1e-12)
+    assert h.count == 2
+    assert h.quantile(0.5) is not None
+
+
+def test_histogram_quantile_accuracy_bounded():
+    """Bucket width is 2^(1/4) ≈ 19%: quantiles must land within one
+    bucket of the true value."""
+    h = tracing.Histogram()
+    for i in range(1, 1001):
+        h.record(i / 1000.0)  # uniform on (0, 1] s
+    p50 = h.quantile(0.5)
+    assert 0.5 / 1.2 <= p50 <= 0.5 * 1.2, p50
+    p99 = h.quantile(0.99)
+    assert 0.99 / 1.2 <= p99 <= 1.0, p99
+
+
+def test_histogram_concurrent_record():
+    reg = tracing.Registry()
+    n, threads = 2000, 8
+
+    def pump():
+        for i in range(n):
+            reg.record("conc", 1e-4 * (1 + i % 7))
+
+    ts = [threading.Thread(target=pump) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    st = reg.trace_status()
+    assert st["trace.conc.count"] == n * threads
+    snap = reg.snapshot()
+    assert sum(snap["hists"]["conc"]["buckets"].values()) == n * threads
+
+
+def test_snapshot_merge_and_state_quantile():
+    a, b = tracing.Registry(), tracing.Registry()
+    for _ in range(100):
+        a.record("x", 0.001)
+    for _ in range(100):
+        b.record("x", 0.1)
+    a.count("errs", 2)
+    b.count("errs", 3)
+    merged = tracing.merge_snapshots([a.snapshot(), b.snapshot()])
+    st = merged["hists"]["x"]
+    assert st["count"] == 200
+    assert merged["counters"]["errs"] == 5
+    p25 = tracing.state_quantile(st, 0.25)
+    p75 = tracing.state_quantile(st, 0.75)
+    assert p25 == pytest.approx(0.001, rel=0.25)
+    assert p75 == pytest.approx(0.1, rel=0.25)
+    # merged max is the max of the parts
+    assert st["max_s"] == pytest.approx(0.1, rel=0.01)
+
+
+def test_trace_context_adopt_and_fresh():
+    root = tracing.from_wire(None)
+    assert root.trace_id and root.span_id and root.parent_id == ""
+    child = tracing.from_wire(tracing.to_wire(root))
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert child.span_id != root.span_id
+    # bytes keys/values (legacy-decoded wire) are tolerated
+    b = tracing.from_wire({"t": b"abc", "s": b"def"})
+    assert b.trace_id == "abc" and b.parent_id == "def"
+
+
+def test_use_trace_restores_previous():
+    ctx = tracing.from_wire(None)
+    assert tracing.current_trace() is None
+    with tracing.use_trace(ctx):
+        assert tracing.current_trace() is ctx
+        with tracing.use_trace(None):
+            assert tracing.current_trace() is None
+        assert tracing.current_trace() is ctx
+    assert tracing.current_trace() is None
+
+
+# -- prometheus exposition ----------------------------------------------------
+
+
+def _parse_prometheus(text: str):
+    """Minimal format-0.0.4 validation: every non-comment line is
+    ``name{labels} value``; histogram buckets are cumulative; returns the
+    parsed samples."""
+    import re
+
+    samples = []
+    pat = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? ([0-9eE.+-]+|NaN|\+Inf)$')
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = pat.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        samples.append((m.group(1), m.group(2) or "", float(m.group(3))))
+    return samples
+
+
+def test_prometheus_text_parses_and_buckets_cumulative():
+    reg = tracing.Registry()
+    for i in range(50):
+        reg.record("rpc.unit", 1e-4 * (1 + i))
+    reg.count("rpc.unit.errors", 3)
+    text = reg.prometheus_text({"node": "127.0.0.1_1"})
+    samples = _parse_prometheus(text)
+    buckets = [v for n, lab, v in samples
+               if n == "jubatus_span_duration_seconds_bucket"]
+    assert buckets == sorted(buckets), "bucket counts must be cumulative"
+    assert buckets[-1] == 50
+    counts = {n: v for n, _l, v in samples}
+    assert counts["jubatus_span_duration_seconds_count"] == 50
+    assert counts["jubatus_events_total"] == 3
+    assert 'node="127.0.0.1_1"' in text
+
+
+def test_metrics_endpoint_smoke():
+    """Tier-1 smoke (ISSUE 2 satellite): boot a server with
+    --metrics_port 0 (ephemeral), scrape /metrics, and validate the
+    Prometheus text format parses; /healthz answers JSON."""
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    srv = EngineServer(
+        "classifier", CONF,
+        args=ServerArgs(engine="classifier", listen_addr="127.0.0.1",
+                        metrics_port=0))
+    port = srv.start(0)
+    try:
+        mport = srv.args.metrics_port
+        assert mport > 0
+        c = ClassifierClient("127.0.0.1", port, "")
+        c.train([["a", Datum({"x": 1.0})]])
+        c.close()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        samples = _parse_prometheus(body)
+        spans = {lab for n, lab, _v in samples
+                 if n == "jubatus_span_duration_seconds_count"}
+        assert any('span="rpc.train"' in lab for lab in spans), spans
+        assert any('engine="classifier"' in lab for lab in spans)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/healthz", timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+        assert doc["status"] == "ok" and doc["engine"] == "classifier"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/nope", timeout=10)
+    finally:
+        srv.stop()
+
+
+# -- rpc error counters -------------------------------------------------------
+
+
+def test_rpc_error_counter_per_method():
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.rpc.server import RpcServer
+
+    srv = RpcServer()
+    srv.register("boom", lambda: 1 / 0, arity=0)
+    srv.register("ok", lambda: 1, arity=0)
+    port = srv.serve_background(0, host="127.0.0.1")
+    try:
+        with RpcClient("127.0.0.1", port) as c:
+            assert c.call("ok") == 1
+            for _ in range(2):
+                with pytest.raises(Exception):
+                    c.call("boom")
+        st = srv.trace.trace_status()
+        assert st["trace.counter.rpc.boom.errors"] == 2
+        assert "trace.counter.rpc.ok.errors" not in st
+        # failures are still timed (identically to successes) AND counted
+        assert st["trace.rpc.boom.count"] == 2
+    finally:
+        srv.stop()
+
+
+# -- trace propagation --------------------------------------------------------
+
+
+@pytest.fixture()
+def one_node_cluster():
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+    store = _Store()
+    srv = EngineServer(
+        "classifier", CONF,
+        args=ServerArgs(engine="classifier", coordinator="(shared)",
+                        name="tr1", listen_addr="127.0.0.1",
+                        interval_sec=1e9, interval_count=1 << 30),
+        coord=MemoryCoordinator(store))
+    srv.start(0)
+    proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1"),
+                  coord=MemoryCoordinator(store))
+    proxy.start(0)
+    yield srv, proxy
+    proxy.stop()
+    srv.stop()
+
+
+def test_proxied_call_shares_one_trace_id(one_node_cluster):
+    """ISSUE 2 acceptance: a proxied call yields ONE trace_id across the
+    proxy's and the backend's status maps."""
+    from jubatus_tpu.client import ClassifierClient, Datum
+
+    srv, proxy = one_node_cluster
+    c = ClassifierClient("127.0.0.1", proxy.args.rpc_port, "tr1")
+    c.train([["a", Datum({"x": 1.0})], ["b", Datum({"x": -1.0})]])
+    c.classify([Datum({"x": 1.0})])
+    c.close()
+    pst = proxy.rpc.trace.trace_status()
+    bst = srv.rpc.trace.trace_status()
+    key = "trace.rpc.classify.last_trace_id"
+    assert key in pst and key in bst
+    assert pst[key] == bst[key]
+    # and the same holds for the bulk (raw fast path) train relay
+    tkey = "trace.rpc.train.last_trace_id"
+    assert pst[tkey] == bst[tkey]
+
+
+def test_proxy_fanout_broadcast_shares_trace(one_node_cluster):
+    from jubatus_tpu.client import ClassifierClient
+
+    srv, proxy = one_node_cluster
+    c = ClassifierClient("127.0.0.1", proxy.args.rpc_port, "tr1")
+    st = c.get_status()
+    assert st  # backend answered through the proxy
+    c.close()
+    key = "trace.rpc.get_status.last_trace_id"
+    assert proxy.rpc.trace.trace_status()[key] == \
+        srv.rpc.trace.trace_status()[key]
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_fields():
+    from jubatus_tpu.framework.mixer import MixFlightRecorder
+
+    fr = MixFlightRecorder(capacity=4)
+    fr.node = "me_1"
+    for i in range(6):
+        fr.record("collective", ok=(i % 2 == 0), round_id=f"r{i}",
+                  phases={"ship_ms": 1.0, "reduce_ms": 2.0,
+                          "readback_ms": 3.0, "chunks": 4},
+                  members=3)
+    snap = fr.snapshot()
+    assert len(snap) == 4, "ring must stay bounded"
+    assert [r["round_id"] for r in snap] == ["r2", "r3", "r4", "r5"]
+    last = snap[-1]
+    assert last["node"] == "me_1" and last["members"] == 3
+    for key in ("ship_ms", "reduce_ms", "readback_ms", "chunks"):
+        assert key in last["phases"]
+    stats = fr.stats()
+    assert stats["recorded"] == 6 and stats["retained"] == 4
+    assert fr.snapshot(last=2) == snap[-2:]
+
+
+def test_get_mix_history_rpc_after_round():
+    """A 2-node linear-mixer cluster: one do_mix produces >= 1 structured
+    flight record, queryable over the get_mix_history RPC."""
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    store = _Store()
+    servers = []
+    try:
+        for _ in range(2):
+            srv = EngineServer(
+                "classifier", CONF,
+                args=ServerArgs(engine="classifier", coordinator="(shared)",
+                                name="fh", listen_addr="127.0.0.1",
+                                interval_sec=1e9, interval_count=1 << 30),
+                coord=MemoryCoordinator(store))
+            srv.start(0)
+            servers.append(srv)
+        for s in servers:
+            c = ClassifierClient("127.0.0.1", s.args.rpc_port, "fh")
+            c.train([["a", Datum({"x": 1.0})]])
+            c.close()
+        assert servers[0].mixer.mix_now() is not None
+        with RpcClient("127.0.0.1", servers[0].args.rpc_port) as c:
+            hist = c.call("get_mix_history", "fh")
+        assert len(hist) >= 1
+        rec = hist[-1]
+        assert rec["mode"] == "rpc" and rec["ok"] is True
+        assert rec["members"] == 2 and rec["bytes"] > 0
+        for key in ("schema_ms", "get_diff_ms", "fold_ms", "put_diff_ms"):
+            assert key in rec["phases"], rec
+        # jubadump --mix-history against the live server
+        from jubatus_tpu.cmd import jubadump
+
+        rc = jubadump.main([
+            "--mix-history", f"127.0.0.1:{servers[0].args.rpc_port}",
+            "-n", "fh"])
+        assert rc == 0
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -- jubactl cluster views ----------------------------------------------------
+
+
+@pytest.fixture()
+def file_cluster(tmp_path):
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    coord_dir = str(tmp_path / "coord")
+    servers = []
+    for _ in range(3):
+        srv = EngineServer(
+            "classifier", CONF,
+            args=ServerArgs(engine="classifier", coordinator=coord_dir,
+                            name="jm", listen_addr="127.0.0.1",
+                            interval_sec=1e9, interval_count=1 << 30))
+        srv.start(0)
+        servers.append(srv)
+    for s in servers:
+        c = ClassifierClient("127.0.0.1", s.args.rpc_port, "jm")
+        c.train([["a", Datum({"x": 1.0})], ["b", Datum({"x": -1.0})]])
+        c.close()
+    assert servers[0].mixer.mix_now() is not None
+    yield coord_dir, servers
+    for s in servers:
+        s.stop()
+
+
+def test_jubactl_metrics_merged_view(file_cluster, capsys):
+    """ISSUE 2 acceptance: jubactl metrics against a 3-process in-memory
+    cluster prints merged p50/p99 for rpc.* and mix.round."""
+    from jubatus_tpu.cmd import jubactl
+
+    coord_dir, _servers = file_cluster
+    rc = jubactl.main(["-c", "metrics", "-t", "classifier", "-n", "jm",
+                       "-z", coord_dir])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "merged metrics from 3 node(s)" in out
+    assert "p50_ms" in out and "p99_ms" in out
+    assert "rpc.train" in out
+    assert "mix.round" in out
+
+
+def test_jubactl_status_all(file_cluster, capsys):
+    from jubatus_tpu.cmd import jubactl
+
+    coord_dir, _servers = file_cluster
+    rc = jubactl.main(["-c", "status", "--all", "-t", "classifier",
+                       "-n", "jm", "-z", coord_dir])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "3 node(s), 3 active" in out
+    assert "trace.rpc.train.p99_ms" in out
+    assert "mixer.mix_count" in out

@@ -19,6 +19,19 @@ from typing import List
 
 MAX_LINE = 110  # hard mechanical ceiling; idiomatic target is ~79
 
+#: directories whose modules sit on (or next to) request/mix hot paths:
+#: raw ``time.time()`` there is almost always a latency-measurement bug
+#: (non-monotonic under NTP slew — use time.perf_counter/monotonic or a
+#: tracing span). Genuine wall-clock timestamps (status maps, checkpoint
+#: headers) opt out per line with a ``# wall-clock`` pragma.
+HOT_TIME_DIRS = (
+    "jubatus_tpu/rpc/",
+    "jubatus_tpu/parallel/",
+    "jubatus_tpu/native/",
+    "jubatus_tpu/server/",
+    "jubatus_tpu/framework/",
+)
+
 
 def iter_files(roots: List[str]) -> List[str]:
     out = []
@@ -48,6 +61,9 @@ def check_file(path: str) -> List[str]:
     # of the tab rule with this pragma in their first 10 lines
     allow_tabs = "codestyle: allow-tabs" in "\n".join(
         text.splitlines()[:10])
+    posix = path.replace(os.sep, "/")
+    hot_time = path.endswith(".py") and any(
+        d in posix for d in HOT_TIME_DIRS)
     for i, line in enumerate(text.splitlines(), 1):
         if "\t" in line and not allow_tabs:
             problems.append(f"{path}:{i}: tab character")
@@ -56,6 +72,11 @@ def check_file(path: str) -> List[str]:
         if len(line) > MAX_LINE:
             problems.append(f"{path}:{i}: line longer than {MAX_LINE} chars"
                             f" ({len(line)})")
+        if hot_time and "time.time()" in line and "# wall-clock" not in line:
+            problems.append(
+                f"{path}:{i}: raw time.time() in a hot-path module (use "
+                "time.perf_counter/time.monotonic or a tracing span; "
+                "append '# wall-clock' for genuine timestamps)")
     if path.endswith(".py") and "/jubatus_tpu/" in path.replace(os.sep, "/"):
         try:
             tree = ast.parse(text)
