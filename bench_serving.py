@@ -438,8 +438,23 @@ def run_proxy(transport: str = "python",
         if srv is not None:
             srv.stop()
     sps = total / elapsed_max if elapsed_max else 0.0
-    return {f"e2e_rpc_train_samples_per_sec_proxy_{transport}":
-            round(sps, 1)}
+    out = {f"e2e_rpc_train_samples_per_sec_proxy_{transport}":
+           round(sps, 1)}
+    # self-healing plane quiescence proof (ISSUE 3): on the happy path
+    # the retry/failover budget must not be spent and no breaker may
+    # trip — a nonzero rate here means the plane is misfiring under
+    # normal load, not healing anything
+    counters = proxy.rpc.trace.counters() if proxy is not None else {}
+    forwards = max(1, proxy.forward_count) if proxy is not None else 1
+    out["e2e_retry_rate"] = round(
+        counters.get("rpc.retries", 0) / forwards, 6)
+    out["e2e_breaker_open_total"] = sum(
+        b.get("opened_total", 0)
+        for b in (proxy.breakers.snapshot().values()
+                  if proxy is not None else []))
+    out["e2e_fanout_timeouts_total"] = counters.get(
+        "proxy.fanout_timeouts", 0)
+    return out
 
 
 def collect(trials: int = 2) -> dict:

@@ -15,6 +15,16 @@ Engine method sets are generated from the IDL tables
 Datum-typed arguments accept `Datum` objects (packed to the wire 3-tuple
 automatically); datum-typed results come back as wire tuples — use
 `Datum.from_msgpack` when you want the typed view.
+
+Self-healing plane (docs/ROBUSTNESS.md): idempotent calls (classify /
+estimate / get_status / ...) transparently retry on transport failures
+with jittered backoff under a per-client retry budget; effectful calls
+(train / push / clear) never do. Cap an operation's total latency with
+``deadline_after`` (re-exported here) — the remaining budget propagates
+to the server, which rejects already-expired work:
+
+    with deadline_after(0.2):
+        c.classify([Datum({"subject": "hello"})])
 """
 
 from __future__ import annotations
@@ -24,6 +34,7 @@ from typing import Any, Dict, List, Tuple
 from jubatus_tpu.core.datum import Datum  # noqa: F401  (re-export)
 from jubatus_tpu.framework.idl import SERVICES
 from jubatus_tpu.rpc.client import RpcClient
+from jubatus_tpu.rpc.deadline import deadline_after  # noqa: F401  (re-export)
 
 
 class ClientBase:

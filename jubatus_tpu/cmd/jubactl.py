@@ -6,6 +6,7 @@
     jubactl -c load   -t classifier -n c1 -z /shared [-i model_id]
     jubactl -c status -t classifier -n c1 -z /shared [--all]
     jubactl -c metrics -t classifier -n c1 -z /shared
+    jubactl -c breakers -t classifier -n c1 -z /shared
 
 start/stop fan out to every jubavisor under /jubatus/supervisors,
 distributing N processes round-robin (N/visors each, remainder to the
@@ -15,8 +16,11 @@ the nodes/actives registries; ``--all`` additionally scrapes every
 member's get_status map. ``metrics`` (beyond the reference) scrapes every
 member's raw histogram snapshot (get_metrics) and prints a MERGED cluster
 view — exact p50/p90/p99 across nodes via bucket-wise sums
-(utils/tracing.py merge_snapshots). Server flags (-C/-T/-D/-X/-S/-I/...)
-are forwarded to visor-spawned processes (jubactl.cpp:90-110).
+(utils/tracing.py merge_snapshots). ``breakers`` (also beyond the
+reference) scrapes every registered proxy's per-backend circuit breaker
+and retry-budget state (rpc/breaker.py). Server flags
+(-C/-T/-D/-X/-S/-I/...) are forwarded to visor-spawned processes
+(jubactl.cpp:90-110).
 """
 
 from __future__ import annotations
@@ -35,7 +39,7 @@ def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="jubactl")
     p.add_argument("-c", "--cmd", required=True,
                    choices=["start", "stop", "save", "load", "status",
-                            "metrics"])
+                            "metrics", "breakers"])
     p.add_argument("--all", action="store_true",
                    help="[status] also scrape every member's get_status")
     p.add_argument("-s", "--server", default="",
@@ -195,6 +199,48 @@ def show_metrics(coord: Coordinator, engine: str, name: str) -> int:
     return 0
 
 
+def show_breakers(coord: Coordinator, engine: str, name: str) -> int:
+    """Per-backend circuit breaker + retry-budget state from every
+    registered proxy (the self-healing plane's ops view): which backends
+    are open/half-open, how many trips, how full the failover budget is.
+    Answers 'why is this backend getting no traffic?' without grepping
+    proxy logs."""
+    proxies = []
+    for child in coord.list(membership.PROXY_BASE):
+        try:
+            proxies.append(NodeInfo.from_name(child))
+        except (ValueError, IndexError):
+            continue
+    if not proxies:
+        print("no proxy registered", file=sys.stderr)
+        return -1
+    rc = 0
+    for proxy in proxies:
+        try:
+            with RpcClient(proxy.host, proxy.port, timeout=10.0) as c:
+                per_node = c.call("get_breakers", name)
+        except Exception as e:  # noqa: BLE001 — report per-proxy, keep going
+            print(f"  <{proxy.name}: get_breakers failed: {e}>",
+                  file=sys.stderr)
+            rc = -1
+            continue
+        for node_name, doc in sorted(per_node.items()):
+            breakers = doc.get("breakers") or {}
+            budget = doc.get("retry_budget") or {}
+            print(f"proxy {node_name}: {len(breakers)} backend(s) tracked")
+            if budget:
+                print(f"  retry budget: {budget.get('tokens')} tokens "
+                      f"(ratio {budget.get('ratio')}, "
+                      f"{budget.get('withdrawals', 0)} spent, "
+                      f"{budget.get('denials', 0)} denied)")
+            for backend in sorted(breakers):
+                b = breakers[backend]
+                print(f"  {backend:<28} {b.get('state', '?'):>9}  "
+                      f"failures_in_window={b.get('failures_in_window', 0)} "
+                      f"opened_total={b.get('opened_total', 0)}")
+    return rc
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ns = _parser().parse_args(argv)
     spec = resolve_coordinator(ns.coordinator)
@@ -208,6 +254,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return show_status(coord, ns.type, ns.name, show_all=ns.all)
         if ns.cmd == "metrics":
             return show_metrics(coord, ns.type, ns.name)
+        if ns.cmd == "breakers":
+            return show_breakers(coord, ns.type, ns.name)
         if ns.cmd in ("start", "stop"):
             server = ns.server or ns.type
             name = f"{server}/{ns.name}"

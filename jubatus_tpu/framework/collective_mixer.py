@@ -215,12 +215,12 @@ class CollectiveMixer(RpcLinearMixer):
                     return  # aborted or superseded
             try:
                 raw = self.comm.coord.read(self._go_path())
-            except Exception:  # noqa: BLE001 — transient coordinator issue
+            except Exception:  # broad-ok — transient coordinator issue
                 raw = None
             if raw:
                 try:
                     msg = unpack_obj(raw)
-                except Exception:  # noqa: BLE001
+                except Exception:  # broad-ok
                     msg = None
                 if msg:
                     got = msg.get("rid")
@@ -241,7 +241,7 @@ class CollectiveMixer(RpcLinearMixer):
                 return  # aborted or superseded meanwhile
             try:
                 raw = self.comm.coord.read(self._go_path())
-            except Exception:  # noqa: BLE001 — coordinator unreadable
+            except Exception:  # broad-ok — coordinator unreadable
                 raw = False  # sentinel: absence NOT verified
             if raw not in (None, False, b""):
                 try:
@@ -250,7 +250,7 @@ class CollectiveMixer(RpcLinearMixer):
                     got = got.decode() if isinstance(got, bytes) else got
                     if got == rid:  # GO was there all along: enter late,
                         base = int(msg.get("base", 0))  # peers are waiting
-                except Exception:  # noqa: BLE001
+                except Exception:  # broad-ok
                     pass
             if base is None:
                 with self._staged_lock:
@@ -282,7 +282,7 @@ class CollectiveMixer(RpcLinearMixer):
         ok = False
         try:
             ok = self._enter_collective(rid, base)
-        except Exception as e:  # noqa: BLE001 — world torn down mid-psum
+        except Exception as e:  # broad-ok — world torn down mid-psum
             log.exception("collective entry failed for round %s", rid)
             self.flight.record("collective", ok=False, round_id=rid,
                                reason=f"entry_failed: {type(e).__name__}: "
@@ -297,7 +297,7 @@ class CollectiveMixer(RpcLinearMixer):
                     if self.comm.coord.create(leaf, payload, ephemeral=True):
                         break
                     self.comm.coord.remove(leaf)  # stale same-name leaf
-                except Exception:  # noqa: BLE001
+                except Exception:  # broad-ok
                     if attempt == 2:
                         log.warning("ack write failed for round %s", rid,
                                     exc_info=True)
@@ -309,7 +309,7 @@ class CollectiveMixer(RpcLinearMixer):
             import jax
 
             jax.distributed.shutdown()
-        except Exception:  # noqa: BLE001 — already down is fine
+        except Exception:  # broad-ok — already down is fine
             log.debug("jax.distributed.shutdown raised", exc_info=True)
 
     def _enter_collective(self, rid: str, base_version: int) -> bool:
@@ -359,6 +359,18 @@ class CollectiveMixer(RpcLinearMixer):
                         else f"world_mismatch: {jax.process_count()} jax "
                              f"processes vs {len(members)} members"))
             return super()._run_as_master(members)
+        breakers = getattr(self.comm, "breakers", None)
+        if breakers is not None and any(
+                not breakers.available((m.host, m.port)) for m in members):
+            # a member with an OPEN breaker cannot be counted on to enter
+            # the psum — the collective is all-or-wedge, so route the
+            # round to the RPC mix, whose fan-out skips/degrades per host
+            self.fallback_rounds += 1
+            self._count("mix.fallback_rounds")
+            self.flight.record("collective", ok=False,
+                               reason="breaker_open_member",
+                               members=len(members))
+            return super()._run_as_master(members)
         t0 = time.monotonic()
         schemas = self.comm.get_schemas() if self._has_schema() else []
         union: List[str] = sorted(
@@ -398,7 +410,7 @@ class CollectiveMixer(RpcLinearMixer):
                     self._go_path(),
                     pack_obj({"rid": rid, "base": base_version})):
                 raise RuntimeError("coordinator refused the GO write")
-        except Exception:  # noqa: BLE001
+        except Exception:  # broad-ok
             self.comm.collect("mix_abort", rid)
             self.fallback_rounds += 1
             self._count("mix.fallback_rounds")
@@ -423,7 +435,7 @@ class CollectiveMixer(RpcLinearMixer):
             try:
                 leaves = [c for c in self.comm.coord.list(ack_dir)
                           if c.startswith(prefix)]
-            except Exception:  # noqa: BLE001
+            except Exception:  # broad-ok
                 leaves = []
             for leaf in leaves:
                 name = leaf[len(prefix):]
@@ -442,7 +454,7 @@ class CollectiveMixer(RpcLinearMixer):
             try:
                 self.comm.coord.remove(
                     f"{ack_dir}/{self._ack_leaf(rid, member.name)}")
-            except Exception:  # noqa: BLE001
+            except Exception:  # broad-ok
                 pass
         if not acks:
             # indistinguishable between nobody-entered and everyone-stuck:

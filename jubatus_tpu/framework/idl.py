@@ -19,7 +19,7 @@ checked-in table keeps the framework free of a build-time codegen step.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Tuple
+from typing import Dict, FrozenSet, Optional, Tuple
 
 RANDOM, BROADCAST, CHT, INTERNAL = "random", "broadcast", "cht", "internal"
 
@@ -38,10 +38,23 @@ class Method:
     lock: str = "nolock"
     #: broadcast/cht reducer (framework/aggregators.hpp)
     aggregator: str = "pass"
+    #: retry-safety class (beyond the reference's IDL): True when
+    #: re-issuing the call cannot change state (reads), False when a
+    #: duplicate would double-apply (train/push/clear/...). None derives
+    #: from the lock decorator — analysis → idempotent, update →
+    #: effectful, nolock → effectful unless tagged here explicitly.
+    idempotent: Optional[bool] = None
+
+    @property
+    def is_idempotent(self) -> bool:
+        if self.idempotent is not None:
+            return self.idempotent
+        return self.lock == "analysis"
 
 
-def _m(name, args=(), routing=RANDOM, cht_n=2, lock="nolock", agg="pass"):
-    return Method(name, tuple(args), routing, cht_n, lock, agg)
+def _m(name, args=(), routing=RANDOM, cht_n=2, lock="nolock", agg="pass",
+       idem: Optional[bool] = None):
+    return Method(name, tuple(args), routing, cht_n, lock, agg, idem)
 
 
 #: engine name → RPC surface. Source: the .idl file named per key.
@@ -75,15 +88,15 @@ SERVICES: Dict[str, Tuple[Method, ...]] = {
         _m("calc_similarity", ("lhs", "rhs"), RANDOM, lock="analysis"),
         _m("calc_l2norm", ("row",), RANDOM, lock="analysis"),
     ),
-    # nearest_neighbor.idl
+    # nearest_neighbor.idl (queries are #@nolock reads: retry-safe)
     "nearest_neighbor": (
         _m("clear", (), BROADCAST, lock="update", agg="all_and"),
         _m("set_row", ("id", "d"), CHT, 1, "update"),
-        _m("neighbor_row_from_id", ("id", "size"), RANDOM),
-        _m("neighbor_row_from_datum", ("query", "size"), RANDOM),
-        _m("similar_row_from_id", ("id", "ret_num"), RANDOM),
-        _m("similar_row_from_datum", ("query", "ret_num"), RANDOM),
-        _m("get_all_rows", (), RANDOM),
+        _m("neighbor_row_from_id", ("id", "size"), RANDOM, idem=True),
+        _m("neighbor_row_from_datum", ("query", "size"), RANDOM, idem=True),
+        _m("similar_row_from_id", ("id", "ret_num"), RANDOM, idem=True),
+        _m("similar_row_from_datum", ("query", "ret_num"), RANDOM, idem=True),
+        _m("get_all_rows", (), RANDOM, idem=True),
     ),
     # anomaly.idl
     "anomaly": (
@@ -166,10 +179,10 @@ SERVICES: Dict[str, Tuple[Method, ...]] = {
         _m("reset", ("player_id",), BROADCAST, lock="update", agg="all_or"),
         _m("clear", (), BROADCAST, lock="update", agg="all_and"),
     ),
-    # weight.idl
+    # weight.idl (calc_weight is a pure read; update mutates df tables)
     "weight": (
         _m("update", ("d",), RANDOM),
-        _m("calc_weight", ("d",), RANDOM),
+        _m("calc_weight", ("d",), RANDOM, idem=True),
         _m("clear", (), BROADCAST, agg="all_and"),
     ),
 }
@@ -189,3 +202,47 @@ def get_service(engine: str) -> Tuple[Method, ...]:
         return SERVICES[engine]
     except KeyError:
         raise KeyError(f"unknown engine {engine!r}; known: {', '.join(ENGINES)}")
+
+
+# -- idempotency classes (rpc/retry.py consumers) -----------------------------
+
+#: built-ins + mixer internals that are pure reads — safe to retry on a
+#: transport failure (the mix_* reads matter: a mixer master retrying a
+#: get_diff against a flaky member beats skipping its contribution)
+IDEMPOTENT_BUILTINS: FrozenSet[str] = frozenset({
+    "get_config", "get_status", "get_metrics", "get_mix_history",
+    "get_proxy_status", "get_proxy_metrics", "get_breakers",
+    "mix_get_schema", "mix_get_diff", "mix_get_model",
+})
+
+#: effectful built-ins, listed for the docs' idempotency matrix (anything
+#: not in either set is treated as effectful — the safe default)
+EFFECTFUL_BUILTINS: FrozenSet[str] = frozenset({
+    "save", "load", "clear", "do_mix", "mix_put_diff", "mix_sync_schema",
+    "mix_prepare", "mix_abort",
+})
+
+
+def idempotent_methods(engine: str) -> FrozenSet[str]:
+    """Wire-method names safe to retry for ``engine`` (IDL reads +
+    idempotent built-ins)."""
+    return frozenset(
+        m.name for m in get_service(engine) if m.is_idempotent
+    ) | IDEMPOTENT_BUILTINS
+
+
+def _client_safe() -> FrozenSet[str]:
+    """Method names idempotent in EVERY engine that defines them — the
+    conservative table for clients that don't know which engine they talk
+    to (a name like ``update`` that is effectful anywhere stays
+    effectful everywhere)."""
+    verdict: Dict[str, bool] = {}
+    for methods in SERVICES.values():
+        for m in methods:
+            verdict[m.name] = verdict.get(m.name, True) and m.is_idempotent
+    return frozenset(n for n, ok in verdict.items() if ok) \
+        | IDEMPOTENT_BUILTINS
+
+
+#: engine-agnostic retry-safety table (rpc/client.py's default gate)
+CLIENT_SAFE_RETRY: FrozenSet[str] = _client_safe()

@@ -39,6 +39,7 @@ from jubatus_tpu.coord import membership
 from jubatus_tpu.coord.base import Coordinator, NodeInfo
 from jubatus_tpu.framework.mixer import IntervalMixer, MixFlightRecorder
 from jubatus_tpu.parallel.mix import tree_sum
+from jubatus_tpu.rpc.breaker import BreakerBoard
 from jubatus_tpu.rpc.client import RpcClient, RpcMClient
 from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
 
@@ -127,6 +128,13 @@ class RpcLinearCommunication(LinearCommunication):
         self.name = name
         self.timeout = timeout
         self._members: List[NodeInfo] = []
+        #: per-member circuit breakers (rpc/breaker.py): a member that
+        #: has been failing its mix RPCs for a while is skipped by the
+        #: fan-out (instant BreakerOpen host error instead of a timeout
+        #: burned EVERY round) and re-admitted via half-open probes. The
+        #: registry is installed by the owning mixer's
+        #: set_trace_registry, so trips count as mix.breaker_open there.
+        self.breakers = BreakerBoard(counter_prefix="mix.breaker")
         self._mc: Optional[RpcMClient] = None  # persistent session pool
 
     def update_members(self) -> List[NodeInfo]:
@@ -134,7 +142,8 @@ class RpcLinearCommunication(LinearCommunication):
         if self._members:
             hosts = self._hosts()
             if self._mc is None:
-                self._mc = RpcMClient(hosts, self.timeout)
+                self._mc = RpcMClient(hosts, self.timeout,
+                                      breakers=self.breakers)
             else:
                 self._mc.set_hosts(hosts)
         return self._members
@@ -215,10 +224,19 @@ class RpcLinearMixer:
         self_node: Optional[NodeInfo] = None,
         interval_sec: float = 16.0,
         interval_count: int = 512,
+        quorum_fraction: float = 0.5,
     ) -> None:
         self.driver = driver
         self.comm = comm
         self.self_node = self_node
+        #: minimum fraction of members whose diffs must arrive for the
+        #: round to proceed (--mix-quorum). The reference aborts only
+        #: when ALL get_diffs fail — a round folding 1 of 50 diffs then
+        #: broadcasting it as everyone's new base is technically a mix
+        #: but practically a rollback. Rounds that proceed with missing
+        #: members are DEGRADED: counted (mix.quorum_degraded) and
+        #: stamped in the flight recorder.
+        self.quorum_fraction = float(quorum_fraction)
         #: per-round flight recorder (framework/mixer.py): master rounds
         #: land via the scheduler, member-side collective entries and
         #: failure reasons are recorded by the mixers directly
@@ -343,7 +361,7 @@ class RpcLinearMixer:
         if self.on_active is not None:
             try:
                 self.on_active(ok)
-            except Exception:  # noqa: BLE001
+            except Exception:  # broad-ok
                 log.exception("active-list transition failed")
         if not ok:
             # pull a full model from a peer once the round settles
@@ -357,7 +375,7 @@ class RpcLinearMixer:
         time.sleep(0.2)  # let the master finish broadcasting this round
         try:
             self.maybe_recover()
-        except Exception:  # noqa: BLE001 — retried on the next round
+        except Exception:  # broad-ok — retried on the next round
             log.exception("model recovery failed")
 
     def local_get_model(self) -> bytes:
@@ -368,8 +386,11 @@ class RpcLinearMixer:
             )
 
     def set_trace_registry(self, registry) -> None:
-        """Route mix.round spans into the owning server's registry."""
+        """Route mix.round spans into the owning server's registry (and
+        the comm seam's breaker transitions with them)."""
         self._scheduler.trace = registry
+        if hasattr(self.comm, "breakers"):
+            self.comm.breakers.registry = registry
 
     def _count(self, name: str, n: int = 1) -> None:
         """Bump a counter in the owning server's registry."""
@@ -443,6 +464,21 @@ class RpcLinearMixer:
                                reason="no_protocol_payloads",
                                members=len(members))
             return None
+        # quorum gate: proceeding on a sliver of the cluster would
+        # broadcast a near-empty fold as everyone's new base version
+        if len(payloads) < self.quorum_fraction * len(members):
+            log.error("mix aborted: quorum not met (%d/%d diffs, quorum "
+                      "%.0f%%)", len(payloads), len(members),
+                      self.quorum_fraction * 100)
+            self._count("mix.quorum_aborted")
+            self.flight.record(
+                "rpc", ok=False,
+                reason=f"quorum_not_met: {len(payloads)}/{len(members)}",
+                members=len(members))
+            return None
+        degraded = len(payloads) < len(members)
+        if degraded:
+            self._count("mix.quorum_degraded")
         phases["get_diff_ms"] = round((time.monotonic() - t1) * 1e3, 2)
         # phase 3: pairwise fold per mixable (linear_mixer.cpp:481-499)
         t2 = time.monotonic()
@@ -484,6 +520,8 @@ class RpcLinearMixer:
         )
         return {"members": len(members), "bytes": len(packed),
                 "mode": "rpc", "phases": phases,
+                "contributors": len(payloads),
+                "degraded": True if degraded else None,
                 "acked": sum(bool(v) for v in acks.values())}
 
     # -- obsolete-model recovery (linear_mixer.cpp:404-424,598-632) ----------
@@ -504,7 +542,7 @@ class RpcLinearMixer:
         for peer in members[:3]:
             try:
                 packed = self.comm.get_model(peer)
-            except Exception as e:  # noqa: BLE001 — dead peer, try another
+            except Exception as e:  # broad-ok — dead peer, try another
                 log.warning("recovery pull from %s failed: %s", peer.name, e)
                 continue
             msg = unpack_mix(packed)
@@ -528,5 +566,12 @@ class RpcLinearMixer:
     def get_status(self) -> Dict[str, Any]:
         st = self._scheduler.get_status()
         st.update({"bytes_sent": self.bytes_sent, "obsolete": self._obsolete,
-                   "model_version": self.model_version})
+                   "model_version": self.model_version,
+                   "quorum_fraction": self.quorum_fraction})
+        breakers = getattr(self.comm, "breakers", None)
+        if breakers is not None:
+            snap = breakers.snapshot()
+            st["breaker_backends"] = len(snap)
+            st["breaker_open"] = sum(
+                1 for b in snap.values() if b["state"] == "open")
         return st

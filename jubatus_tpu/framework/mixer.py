@@ -128,7 +128,7 @@ class IntervalMixer:
             start = time.monotonic()
             try:
                 result = self._mix_fn()
-            except Exception as e:
+            except Exception as e:  # broad-ok — mix_fn is arbitrary
                 self.trace.count("mix.round.errors")
                 self.flight.record(
                     "error", ok=False,
@@ -184,7 +184,7 @@ class IntervalMixer:
             if due:
                 try:
                     self._run_mix()  # outside the cond lock
-                except Exception:  # mix failure must not kill the loop
+                except Exception:  # broad-ok — must not kill the loop
                     import logging
 
                     logging.getLogger(__name__).exception("mix round failed")

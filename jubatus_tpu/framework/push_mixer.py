@@ -152,19 +152,36 @@ class RpcPushMixer(RpcLinearMixer):
         t0 = time.monotonic()
         exchanged = 0
         total_bytes = 0
+        skipped_open = 0
         failures: List[str] = []
+        breakers = getattr(self.comm, "breakers", None)
         for peer in candidates:
+            key = (peer.host, peer.port)
+            if breakers is not None and not breakers.allow(key):
+                # open circuit: don't burn a timeout gossiping at a peer
+                # that has been failing for a while — half-open probes
+                # re-admit it once its cooldown passes
+                skipped_open += 1
+                continue
             try:
                 total_bytes += self._exchange(peer)
                 exchanged += 1
-            except Exception as e:  # noqa: BLE001 — gossip shrugs off a peer
+                if breakers is not None:
+                    breakers.record(key, True)
+            except Exception as e:  # broad-ok — gossip shrugs off a peer
                 log.warning("push exchange with %s failed: %s", peer.name, e)
                 failures.append(f"{peer.name}: {type(e).__name__}")
+                if breakers is not None:
+                    from jubatus_tpu.rpc.errors import is_retryable
+
+                    breakers.record(key, not is_retryable(e))
         if not exchanged:
-            # candidates existed but every exchange failed: that's a
-            # failed round, not an idle tick — record it
+            # candidates existed but every exchange failed (or every
+            # circuit is open): that's a failed round, not an idle tick
             self.flight.record(self.strategy, ok=False,
-                               reason="; ".join(failures) or "no_exchange",
+                               reason="; ".join(failures) or (
+                                   "all_breakers_open" if skipped_open
+                                   else "no_exchange"),
                                candidates=len(candidates))
             return None
         self.mix_count += 1
@@ -174,6 +191,7 @@ class RpcPushMixer(RpcLinearMixer):
                  total_bytes, time.monotonic() - t0)
         return {"members": exchanged, "bytes": total_bytes,
                 "mode": self.strategy, "candidates": len(candidates),
+                "skipped_open": skipped_open or None,
                 "failed_peers": failures or None}
 
     def _exchange(self, peer: NodeInfo) -> int:
@@ -280,11 +298,12 @@ class DummyMixer:
 def create_mixer(name: str, driver: Any, comm: LinearCommunication, *,
                  self_node: Optional[NodeInfo] = None,
                  interval_sec: float = 16.0, interval_count: int = 512,
-                 mix_bf16: bool = False):
+                 mix_bf16: bool = False, quorum_fraction: float = 0.5):
     """Mixer factory (≙ create_mixer, mixer_factory.cpp:41-97): selects by
     the --mixer flag."""
     kwargs = dict(self_node=self_node, interval_sec=interval_sec,
-                  interval_count=interval_count)
+                  interval_count=interval_count,
+                  quorum_fraction=quorum_fraction)
     if name == "linear_mixer":
         return RpcLinearMixer(driver, comm, **kwargs)
     if name == "collective_mixer":

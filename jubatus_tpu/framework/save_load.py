@@ -45,7 +45,7 @@ def _semantic_config_equal(a: str, b: str) -> bool:
     not their raw text (save_load.cpp:104-109)."""
     try:
         return json.loads(a) == json.loads(b)
-    except Exception:
+    except Exception:  # broad-ok — unparseable json: compare raw
         return a == b
 
 

@@ -39,6 +39,10 @@ from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
 
 SYSTEM_FILE = "system.jubatus"
 STATE_DIR = "state"
+#: pairing-token sidecar next to the state dir, used when the installed
+#: orbax cannot carry custom_metadata in the checkpoint itself (the
+#: kwarg appeared after 0.7; see _save_state/_state_token)
+TOKEN_FILE = "state.token"
 
 
 def _write_system(path: str, system: dict) -> None:
@@ -50,6 +54,56 @@ def _read_system(path: str) -> dict:
         raw = f.read()
     system_bytes, _ = read_envelope(raw, path)
     return unpack_obj(system_bytes)
+
+
+def _save_state(ckptr, dir_path: str, state: Any, token: str) -> None:
+    """Commit the state checkpoint with its pairing token. Newer orbax
+    carries the token in the checkpoint's own custom_metadata; on
+    releases whose ``StandardCheckpointer.save`` lacks the kwarg (0.7.x,
+    the installed toolchain) the token commits to a ``state.token``
+    sidecar AFTER the state and BEFORE ``system.jubatus`` — a crash
+    between any two commits still leaves a detectable mismatch, never a
+    silent mispairing."""
+    state_path = os.path.join(dir_path, STATE_DIR)
+    try:
+        ckptr.save(state_path, state, force=True,
+                   custom_metadata={"pairing_token": token})
+        ckptr.wait_until_finished()
+        return
+    except TypeError:
+        pass  # pre-custom_metadata orbax: token sidecar below
+    ckptr.save(state_path, state, force=True)
+    ckptr.wait_until_finished()
+    if jax.process_index() == 0:
+        tmp = os.path.join(dir_path, TOKEN_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(token)
+        os.replace(tmp, os.path.join(dir_path, TOKEN_FILE))
+
+
+def _state_token(ckptr, dir_path: str) -> Optional[str]:
+    """The pairing token committed WITH the state: orbax custom_metadata
+    when the installed release returns it, else the state.token sidecar;
+    None when neither exists (a checkpoint from before pairing)."""
+    meta = ckptr.metadata(os.path.join(dir_path, STATE_DIR))
+    custom = getattr(meta, "custom_metadata", None)
+    if isinstance(custom, dict) and custom.get("pairing_token"):
+        return str(custom["pairing_token"])
+    try:
+        with open(os.path.join(dir_path, TOKEN_FILE)) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def _metadata_tree(meta: Any):
+    """Per-leaf ArrayMetadata pytree across orbax metadata shapes: newer
+    releases wrap it (``meta.item_metadata.tree``), 0.7.x returns the
+    tree directly."""
+    item = getattr(meta, "item_metadata", None)
+    if item is not None and hasattr(item, "tree"):
+        return item.tree
+    return meta
 
 
 def abstract_like(state: Any):
@@ -94,9 +148,7 @@ def save_sharded(
         ).tobytes().hex()
     os.makedirs(dir_path, exist_ok=True)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(dir_path, STATE_DIR), state, force=True,
-               custom_metadata={"pairing_token": token})
-    ckptr.wait_until_finished()
+    _save_state(ckptr, dir_path, state, token)
     if jax.process_index() == 0:
         _write_system(os.path.join(dir_path, SYSTEM_FILE), {
             "version": FORMAT_VERSION,
@@ -151,8 +203,7 @@ def load_sharded(
     state_path = os.path.join(dir_path, STATE_DIR)
     want_token = system.get("pairing_token")
     if want_token is not None:
-        have_token = (ckptr.metadata(state_path).custom_metadata
-                      or {}).get("pairing_token")
+        have_token = _state_token(ckptr, dir_path)
         if have_token != want_token:
             raise SaveLoadError(
                 f"{dir_path}: state/metadata pairing mismatch "
@@ -171,7 +222,7 @@ def checkpoint_metadata(dir_path: str) -> dict:
     out = {"system": _read_system(os.path.join(dir_path, SYSTEM_FILE))}
     ckptr = ocp.StandardCheckpointer()
     meta = ckptr.metadata(os.path.join(dir_path, STATE_DIR))
-    tree = meta.item_metadata.tree  # {leaf name: ArrayMetadata}
+    tree = _metadata_tree(meta)  # {leaf name: ArrayMetadata}
     arrays = {}
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     for path, leaf in flat:

@@ -18,6 +18,8 @@ Two planes (SURVEY.md §2.2 "TPU equivalent"):
 """
 
 from jubatus_tpu.rpc.errors import (  # noqa: F401
+    BreakerOpen,
+    DeadlineExceeded,
     RpcError,
     RpcMethodNotFound,
     RpcTypeError,
@@ -28,6 +30,8 @@ from jubatus_tpu.rpc.errors import (  # noqa: F401
     RpcNoClient,
     HostError,
     MultiRpcError,
+    is_retryable,
 )
+from jubatus_tpu.rpc.deadline import deadline_after  # noqa: F401
 from jubatus_tpu.rpc.server import RpcServer  # noqa: F401
 from jubatus_tpu.rpc.client import RpcClient, RpcMClient  # noqa: F401

@@ -1,41 +1,76 @@
 """MessagePack-RPC clients (≙ mprpc/rpc_mclient.{hpp,cpp} + client plumbing).
 
 ``RpcClient`` — one-host sync client with reconnect, msgid correlation, and
-timeout (the reference's per-call msgpack-rpc session).
+timeout (the reference's per-call msgpack-rpc session). Beyond the
+reference: IDEMPOTENT calls (framework/idl.py's tables) retry on
+transport failures (``RpcIoError``/``RpcTimeoutError``/injected faults)
+with capped exponential backoff + full jitter, governed by a per-client
+retry budget (rpc/retry.py) so a degraded cluster sees at most ~10%
+retry amplification; and an active deadline (rpc/deadline.py) rides the
+envelope as its optional 6th element, capping every attempt's socket
+timeout at the remaining budget.
 
 ``RpcMClient`` — parallel fan-out: fire the same call at N hosts, then either
 fold the results pairwise through a reducer (rpc_mclient.hpp:261-312 — this
 fold IS the allreduce combiner the mix plane replaces with psum) or collect
 per-host results+errors (rpc_result_object, rpc_mclient.hpp:314-318).
+An optional breaker board (rpc/breaker.py) lets the fan-out skip hosts
+whose circuit is open instead of burning a timeout on them every round.
 """
 
 from __future__ import annotations
 
 import socket
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, FrozenSet, List, Optional, Sequence, Tuple
 
 import msgpack
 
+from jubatus_tpu.framework.idl import CLIENT_SAFE_RETRY
+from jubatus_tpu.rpc import deadline as deadlines
+from jubatus_tpu.rpc.breaker import BreakerBoard
 from jubatus_tpu.rpc.errors import (
+    BreakerOpen,
+    DeadlineExceeded,
     HostError,
     MultiRpcError,
     RpcIoError,
     RpcNoClient,
     RpcNoResult,
     RpcTimeoutError,
+    is_retryable,
     wire_to_error,
 )
+from jubatus_tpu.rpc.retry import DEFAULT_POLICY, RetryBudget, RetryPolicy
 from jubatus_tpu.rpc.server import REQUEST, RESPONSE, _to_wire
 from jubatus_tpu.utils import faults, tracing
 
+#: transport-level failures an idempotent call may retry (FaultInjected
+#: included: injected faults stand in for the IO errors they model)
+_RETRYABLE = (RpcIoError, RpcTimeoutError, faults.FaultInjected)
+
 
 class RpcClient:
-    def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
+    def __init__(self, host: str, port: int, timeout: float = 10.0, *,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 retry_budget: Optional[RetryBudget] = None,
+                 retry_methods: Optional[FrozenSet[str]] = None,
+                 registry: Optional[tracing.Registry] = None) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
+        #: retry plane: which methods are idempotent (engine-agnostic
+        #: conservative table by default), how to back off, and the token
+        #: bucket bounding retry amplification. Pass retry_methods=
+        #: frozenset() to disable retries entirely (coord heartbeats etc.
+        #: are simply not in the table, so they never retry anyway).
+        self.retry_policy = retry_policy or DEFAULT_POLICY
+        self.retry_budget = retry_budget or RetryBudget()
+        self.retry_methods = (CLIENT_SAFE_RETRY if retry_methods is None
+                              else retry_methods)
+        self._registry = registry or tracing.default_registry()
         self._sock: Optional[socket.socket] = None
         self._msgid = 0
         # RLock: call() holds it and calls close() on failure paths
@@ -72,24 +107,87 @@ class RpcClient:
     def __exit__(self, *exc):
         self.close()
 
+    # -- retry plane ---------------------------------------------------------
+    def _with_retries(self, method: str, once: Callable[[], Any]) -> Any:
+        """Run ``once`` with the retry loop: idempotent methods retry on
+        transport failures (budget-gated, jittered backoff, bounded by
+        the remaining deadline); everything else propagates first error —
+        a duplicate of an effectful call could double-apply."""
+        retryable_method = method in self.retry_methods
+        if retryable_method:
+            self.retry_budget.deposit()
+        attempt = 0
+        while True:
+            try:
+                return once()
+            except _RETRYABLE:
+                attempt += 1
+                if not retryable_method or \
+                        attempt >= self.retry_policy.max_attempts:
+                    raise
+                rem = deadlines.remaining()
+                if rem is not None and rem <= 0:
+                    raise
+                if not self.retry_budget.try_withdraw():
+                    self._registry.count("rpc.retry_budget_exhausted")
+                    raise
+                self._registry.count("rpc.retries")
+                sleep = self.retry_policy.sleep_for(attempt, rem)
+                if sleep > 0:
+                    time.sleep(sleep)
+
+    def _effective_timeout(self, method: str) -> float:
+        """Per-attempt socket timeout: the flat client timeout, tightened
+        to the remaining deadline budget when one is active. Raises
+        DeadlineExceeded pre-flight when the budget is already gone
+        (sending work nobody can wait for wastes the backend)."""
+        rem = deadlines.remaining()
+        if rem is None:
+            return self.timeout
+        if rem <= 0:
+            self._registry.count("rpc.deadline_expired")
+            raise DeadlineExceeded(
+                f"{method} @ {self.host}:{self.port}: "
+                "deadline expired before send")
+        return min(self.timeout, rem)
+
+    def _timeout_error(self, method: str) -> Exception:
+        """socket.timeout -> taxonomy: a timeout caused by the DEADLINE
+        (not the flat client timeout) is the budget running out —
+        DeadlineExceeded, not a retryable RpcTimeoutError."""
+        if deadlines.expired():
+            self._registry.count("rpc.deadline_expired")
+            return DeadlineExceeded(
+                f"{method} @ {self.host}:{self.port}: deadline expired")
+        return RpcTimeoutError(f"{method} @ {self.host}:{self.port}")
+
     # -- calls ---------------------------------------------------------------
     def call(self, method: str, *args: Any) -> Any:
+        return self._with_retries(method, lambda: self._call_once(method, args))
+
+    def _call_once(self, method: str, args: Sequence[Any]) -> Any:
         # injection site (utils/faults.py): e.g. "rpc.call.mix_get_diff.*" —
-        # the is_armed() guard keeps the disarmed hot path at one flag read
+        # the is_armed() guard keeps the disarmed hot path at one flag read.
+        # Fired per ATTEMPT, so @N fault rules interact with retries the
+        # way real transient failures would.
         if faults.is_armed():
             faults.fire(f"rpc.call.{method}.{self.host}:{self.port}")
         # trace context rides the envelope as an OPTIONAL 5th element
-        # ({"t": trace_id, "s": span_id}) — attached only when this thread
-        # carries one (i.e. the call happens inside a server dispatch, so
-        # the proxied/fanned-out hop joins the same trace); plain client
-        # calls stay wire-identical to msgpack-rpc
+        # ({"t": trace_id, "s": span_id}), the remaining deadline budget
+        # as an OPTIONAL 6th (seconds, float). Either is attached only
+        # when this thread carries one; plain client calls stay
+        # wire-identical to msgpack-rpc.
         ctx = tracing.current_trace()
+        eff_timeout = self._effective_timeout(method)
+        dl = deadlines.to_wire()
         with self._lock:
             self._msgid = (self._msgid + 1) & 0xFFFFFFFF
             msgid = self._msgid
             env: list = [REQUEST, msgid, method, list(args)]
-            if ctx is not None:
-                env.append(tracing.to_wire(ctx))
+            if ctx is not None or dl is not None:
+                env.append(tracing.to_wire(ctx) if ctx is not None else None)
+            if dl is not None:
+                env.append(dl)
             # surrogateescape: params a proxy forwards may hold surrogate-
             # bearing strings (legacy non-UTF8 raw decoded upstream); they
             # must re-encode to the original bytes, not raise pre-send
@@ -99,11 +197,12 @@ class RpcClient:
             )
             sock = self._connect()
             try:
+                sock.settimeout(eff_timeout)
                 sock.sendall(payload)
                 msg = self._read_response(sock, msgid)
             except socket.timeout as e:
                 self.close()
-                raise RpcTimeoutError(f"{method} @ {self.host}:{self.port}") from e
+                raise self._timeout_error(method) from e
             except OSError as e:
                 self.close()
                 raise RpcIoError(f"{method} @ {self.host}:{self.port}: {e}") from e
@@ -119,9 +218,15 @@ class RpcClient:
         never materializes Python-level objects either, proxy.hpp:64-186).
         A non-nil error in the response raises the usual taxonomy (the
         caller falls back to the generic path for retry semantics)."""
+        return self._with_retries(
+            method, lambda: self._call_raw_once(method, raw_params))
+
+    def _call_raw_once(self, method: str, raw_params: bytes) -> bytes:
         if faults.is_armed():
             faults.fire(f"rpc.call.{method}.{self.host}:{self.port}")
         ctx = tracing.current_trace()
+        eff_timeout = self._effective_timeout(method)
+        dl = deadlines.to_wire()
         with self._lock:
             self._msgid = (self._msgid + 1) & 0xFFFFFFFF
             msgid = self._msgid
@@ -132,16 +237,23 @@ class RpcClient:
             # era span could latch the shared connection legacy and
             # degrade other clients' responses. str8 pins it modern.
             mb = method.encode()
-            # active trace context: 5-element envelope with a trailing
-            # trace span (the backend splits it off the params span)
-            env0 = b"\x95\x00" if ctx is not None else b"\x94\x00"
+            # trailing elements: 5-element envelope with a trace span,
+            # 6-element with trace + deadline (trace packs nil when only
+            # a deadline is active — the backend splits both off the
+            # params span)
+            n_extra = 2 if dl is not None else (1 if ctx is not None else 0)
+            env0 = bytes([0x94 + n_extra]) + b"\x00"
             head = (env0 + msgpack.packb(msgid)
                     + b"\xd9" + bytes([len(mb)]) + mb)
             bufs = [head, raw_params]
-            if ctx is not None:
-                bufs.append(msgpack.packb(tracing.to_wire(ctx)))
+            if n_extra >= 1:
+                bufs.append(msgpack.packb(tracing.to_wire(ctx))
+                            if ctx is not None else b"\xc0")
+            if n_extra == 2:
+                bufs.append(msgpack.packb(float(dl)))
             sock = self._connect()
             try:
+                sock.settimeout(eff_timeout)
                 # scatter-gather: no head+params concat copy of a possibly
                 # multi-megabyte span (sendmsg may write short — finish
                 # with sendall on each remainder)
@@ -154,10 +266,10 @@ class RpcClient:
                             continue
                         sock.sendall(memoryview(b)[off:])
                         off = 0
-                frame = self._read_raw_response(sock, msgid)
+                frame = self._read_raw_response(sock, msgid, eff_timeout)
             except socket.timeout as e:
                 self.close()
-                raise RpcTimeoutError(f"{method} @ {self.host}:{self.port}") from e
+                raise self._timeout_error(method) from e
             except OSError as e:
                 self.close()
                 raise RpcIoError(f"{method} @ {self.host}:{self.port}: {e}") from e
@@ -173,14 +285,16 @@ class RpcClient:
             raise wire_to_error(error, method)
         return frame[err_end:]
 
-    def _read_raw_response(self, sock: socket.socket, msgid: int) -> bytes:
+    def _read_raw_response(self, sock: socket.socket, msgid: int,
+                           eff_timeout: Optional[float] = None) -> bytes:
         """Read one complete response frame as BYTES (no payload decode);
         frames are delimited with the C-speed skip. Out-of-order replies
         cannot happen here — call_raw holds the lock, so exactly one
         request is in flight."""
         framer = msgpack.Unpacker()
         buf = bytearray()
-        sock.settimeout(self.timeout)
+        sock.settimeout(eff_timeout if eff_timeout is not None
+                        else self.timeout)
         while True:
             try:
                 framer.skip()
@@ -232,13 +346,19 @@ class RpcMClient:
     Keeps one persistent connection per host across calls (the reference's
     session_pool) — call ``close()`` when done, or use as a context manager.
     ``set_hosts`` reshapes the pool on membership change without dropping
-    still-valid sessions.
+    still-valid sessions. An optional ``breakers`` board short-circuits
+    hosts whose circuit is open (their slot in the fan-out becomes an
+    instant ``BreakerOpen`` host error) and re-admits them via half-open
+    probes — the mix master stops paying a full timeout per round for a
+    member that has been dead for minutes.
     """
 
     def __init__(
-        self, hosts: Sequence[Tuple[str, int]], timeout: float = 10.0
+        self, hosts: Sequence[Tuple[str, int]], timeout: float = 10.0,
+        breakers: Optional[BreakerBoard] = None,
     ) -> None:
         self.timeout = timeout
+        self.breakers = breakers
         self._pool: dict = {}
         self.hosts: List[Tuple[str, int]] = []
         self._executor = ThreadPoolExecutor(
@@ -280,12 +400,26 @@ class RpcMClient:
         def one(hp: Tuple[str, int]):
             return self._client(hp).call(method, *args)
 
-        futs = {self._executor.submit(one, hp): hp for hp in self.hosts}
+        futs = {}
+        for hp in self.hosts:
+            if self.breakers is not None and not self.breakers.allow(hp):
+                # open circuit: instant failure, no timeout burned — the
+                # caller's skip/abort semantics see it like a dead host
+                errors.append(HostError(
+                    hp[0], hp[1], BreakerOpen(f"{hp[0]}:{hp[1]}")))
+                continue
+            futs[self._executor.submit(one, hp)] = hp
         for fut, hp in futs.items():
             try:
                 results.append((hp, fut.result()))
-            except Exception as e:  # noqa: BLE001 — per-host failure is data
+                if self.breakers is not None:
+                    self.breakers.record(hp, True)
+            except Exception as e:  # broad-ok — per-host failure is data
                 errors.append(HostError(hp[0], hp[1], e))
+                if self.breakers is not None:
+                    # only transport failures count against the breaker:
+                    # an application error proves the backend is alive
+                    self.breakers.record(hp, not is_retryable(e))
         return results, errors
 
     def call_fold(

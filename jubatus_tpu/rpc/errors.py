@@ -6,6 +6,15 @@ the same taxonomy so server/proxy code can branch on failure class, and the
 same on-wire integer codes as the msgpack-rpc C++ implementation for
 method-not-found (1) and argument errors (2) so reference clients see the
 errors they expect.
+
+Beyond the reference, every class carries a **retryable** axis: transport
+failures where the request may simply be re-issued (`RpcIoError`,
+`RpcTimeoutError`) are retryable — but only for IDEMPOTENT methods (the
+idempotency tables live in framework/idl.py, the retry machinery in
+rpc/retry.py); application errors from a healthy server and expired
+deadlines are not. ``DeadlineExceeded`` gets its own on-wire code (3 — an
+extension; legacy peers see an unknown code and map it to a generic call
+error) so deadline rejections survive a proxy hop as themselves.
 """
 
 from __future__ import annotations
@@ -15,10 +24,20 @@ from typing import Any, List, Tuple
 #: on-wire error codes (msgpack-rpc convention, used by the reference servers)
 NO_METHOD_ERROR = 1
 ARGUMENT_ERROR = 2
+#: extension code: the server refused/abandoned the call because its
+#: deadline had already expired (rpc/deadline.py)
+DEADLINE_EXCEEDED_ERROR = 3
 
 
 class RpcError(RuntimeError):
-    """Base of all RPC failures (≙ mprpc/exception.hpp rpc_error)."""
+    """Base of all RPC failures (≙ mprpc/exception.hpp rpc_error).
+
+    ``retryable``: True when the failure is a transport-level loss where
+    the server may never have seen (or finished) the request — re-issuing
+    it can succeed and, for idempotent methods, is safe.
+    """
+
+    retryable = False
 
 
 class RpcMethodNotFound(RpcError):
@@ -38,9 +57,32 @@ class RpcCallError(RpcError):
 class RpcIoError(RpcError):
     """Connection failed / reset mid-call (≙ rpc_io_error)."""
 
+    retryable = True
+
 
 class RpcTimeoutError(RpcError):
     """Call exceeded the client timeout (≙ rpc_timeout_error)."""
+
+    retryable = True
+
+
+class DeadlineExceeded(RpcError):
+    """The call's propagated deadline expired (client pre-flight, server
+    dispatch rejection, or proxy fan-out budget exhaustion). NOT
+    retryable: the budget is gone — retrying would spend work the caller
+    can no longer use."""
+
+
+class BreakerOpen(RpcError):
+    """A circuit breaker refused the call without touching the backend
+    (rpc/breaker.py). Retryable against a DIFFERENT backend — the proxy's
+    failover path treats it like an instantaneous IO failure."""
+
+    retryable = True
+
+    def __init__(self, target: str = "") -> None:
+        super().__init__(f"circuit breaker open for {target}")
+        self.target = target
 
 
 class RpcNoResult(RpcError):
@@ -69,12 +111,24 @@ class MultiRpcError(RpcError):
         self.errors = errors
 
 
+def is_retryable(exc: BaseException) -> bool:
+    """Transport-level failure where a retry can succeed. Injected faults
+    (utils/faults.py) count: they stand in for the IO errors they model."""
+    if isinstance(exc, RpcError):
+        return exc.retryable
+    from jubatus_tpu.utils import faults
+
+    return isinstance(exc, (faults.FaultInjected, OSError))
+
+
 def error_to_wire(exc: BaseException) -> Any:
     """Server-side: map an exception to the response 'error' field."""
     if isinstance(exc, RpcMethodNotFound):
         return NO_METHOD_ERROR
     if isinstance(exc, (RpcTypeError, TypeError)):
         return ARGUMENT_ERROR
+    if isinstance(exc, DeadlineExceeded):
+        return DEADLINE_EXCEEDED_ERROR
     return str(exc)
 
 
@@ -84,4 +138,6 @@ def wire_to_error(err: Any, method: str = "") -> RpcError:
         return RpcMethodNotFound(method)
     if err == ARGUMENT_ERROR:
         return RpcTypeError(f"argument error calling {method}")
+    if err == DEADLINE_EXCEEDED_ERROR:
+        return DeadlineExceeded(f"{method}: deadline exceeded at server")
     return RpcCallError(f"{method}: {err!r}")

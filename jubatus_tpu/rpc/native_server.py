@@ -150,6 +150,7 @@ class NativeRpcServer:
     _invoke = RpcServer._invoke
     _execute = RpcServer._execute
     _execute_fast = RpcServer._execute_fast
+    _check_deadline = RpcServer._check_deadline
     response_legacy = RpcServer.response_legacy
 
     # -- C++ → Python dispatch ------------------------------------------------
@@ -167,12 +168,12 @@ class NativeRpcServer:
             method_name = ctypes.string_at(method, method_len).decode(
                 "utf-8", "replace")
             raw = ctypes.string_at(params_ptr, params_len)  # copy the span
-        except Exception:  # noqa: BLE001 — never raise into C++
+        except Exception:  # broad-ok — never raise into C++
             return
         try:
             self._dispatch(conn_id, msgid, method_name, raw,
                            int(envelope_flags))
-        except Exception:  # noqa: BLE001 — never raise into C++
+        except Exception:  # broad-ok — never raise into C++
             log.exception("native rpc dispatch failed for %s", method_name)
 
     #: msgid sentinel the C++ side uses for notifications
@@ -183,15 +184,18 @@ class NativeRpcServer:
     _POOL_THRESHOLD = 4096
 
     def _dispatch_fast_bulk(self, conn_id, msgid, method, raw,
-                            conn_state, trace=None) -> None:
+                            conn_state, trace=None, dl=None) -> None:
         try:
+            from jubatus_tpu.rpc import deadline as deadlines
             from jubatus_tpu.utils import tracing
 
             prev = tracing.swap_trace(tracing.from_wire(trace))
+            prev_dl = deadlines.swap(deadlines.adopt_wire(dl))
             try:
                 error, result = self._execute_fast(method, raw, conn_state)
             finally:
                 tracing.swap_trace(prev)
+                deadlines.swap(prev_dl)
             if self._stopped:
                 return  # teardown: the C++ handle may be going away
             payload = build_response(
@@ -199,27 +203,23 @@ class NativeRpcServer:
                 legacy=self.response_legacy(method, conn_state))
             self._lib.jt_rpc_respond(self._handle, conn_id, payload,
                                      len(payload))
-        except Exception:  # noqa: BLE001 — never die silently on the pool
+        except Exception:  # broad-ok — never die silently on the pool
             log.exception("native rpc bulk dispatch failed for %s", method)
 
     def _dispatch(self, conn_id: int, msgid: int, method: str,
                   raw: bytes, envelope_flags: int = 0) -> None:
+        from jubatus_tpu.rpc import deadline as deadlines
         from jubatus_tpu.utils import tracing
 
         envelope_modern = bool(envelope_flags & 1)
-        trace = None
+        trace = dl = None
         if envelope_flags & 2:
-            # traced 5-element envelope: the C++ framer hands us
-            # params + trace as one span; split at the params boundary
-            from jubatus_tpu.rpc.server import msgpack_span_end
+            # traced/deadlined (5/6-element) envelope: the C++ framer
+            # hands us params [+ trace [+ deadline]] as one span; split
+            # at the params boundary (rpc/server.py owns the walk)
+            from jubatus_tpu.rpc.server import split_extras
 
-            try:
-                pend = msgpack_span_end(raw, 0)
-                if pend < len(raw):
-                    trace = msgpack.unpackb(raw[pend:], raw=False)
-                raw = raw[:pend]
-            except Exception:  # noqa: BLE001 — a bad trace element
-                trace = None  # must not kill the dispatch
+            raw, trace, dl = split_extras(raw, 0)
         conn_state = None
         if self.wire_detect and not self.legacy_wire:
             with self._wire_lock:
@@ -255,13 +255,15 @@ class NativeRpcServer:
             if len(raw) >= self._POOL_THRESHOLD and not self._stopped:
                 self._bulk_pool.submit(self._dispatch_fast_bulk, conn_id,
                                        msgid, method, raw, conn_state,
-                                       trace)
+                                       trace, dl)
                 return
             prev = tracing.swap_trace(tracing.from_wire(trace))
+            prev_dl = deadlines.swap(deadlines.adopt_wire(dl))
             try:
                 error, result = self._execute_fast(method, raw, conn_state)
             finally:
                 tracing.swap_trace(prev)
+                deadlines.swap(prev_dl)
             payload = build_response(
                 msgid, error, result,
                 legacy=self.response_legacy(method, conn_state))
@@ -272,14 +274,16 @@ class NativeRpcServer:
             params = msgpack.unpackb(raw, raw=False, strict_map_key=False,
                                      use_list=True,
                                      unicode_errors="surrogateescape")
-        except Exception as e:  # noqa: BLE001 — undecodable params
+        except Exception as e:  # broad-ok — undecodable params must answer
             error, result = error_to_wire(e), None
         else:
             prev = tracing.swap_trace(tracing.from_wire(trace))
+            prev_dl = deadlines.swap(deadlines.adopt_wire(dl))
             try:
                 error, result = self._execute(method, params)
             finally:
                 tracing.swap_trace(prev)
+                deadlines.swap(prev_dl)
         if msgid == self._NOTIFY:
             return  # notification: no response on the wire
         payload = build_response(
@@ -361,7 +365,7 @@ class NativeRpcServer:
                 self._bulk_pool.shutdown(wait=True)
                 self._lib.jt_rpc_destroy(self._handle)
                 self._handle = None
-        except Exception:  # noqa: BLE001 — interpreter teardown
+        except Exception:  # broad-ok — interpreter teardown
             pass
 
 

@@ -23,11 +23,13 @@ from typing import Any, Callable, Dict, Optional
 
 import msgpack
 
+from jubatus_tpu.rpc import deadline as deadlines
 from jubatus_tpu.rpc.errors import (
+    DeadlineExceeded,
     RpcMethodNotFound,
     error_to_wire,
 )
-from jubatus_tpu.utils import tracing
+from jubatus_tpu.utils import faults, tracing
 from jubatus_tpu.utils.tracing import Registry
 
 log = logging.getLogger(__name__)
@@ -145,14 +147,15 @@ def _parse_response_envelope(raw: bytes) -> int:
 
 def _parse_envelope(raw: bytes):
     """Request envelope without decoding params: ``[0, msgid, method,
-    params]`` or the traced 5-element variant ``[0, msgid, method, params,
-    trace]`` -> (msgid, method, params_offset, has_trace), or None for
-    anything else (notify, malformed, exotic headers) — those take the
-    generic decode path."""
+    params]``, the traced 5-element variant ``[..., trace]``, or the
+    deadline-bearing 6-element variant ``[..., trace, deadline]`` ->
+    (msgid, method, params_offset, n_extra), or None for anything else
+    (notify, malformed, exotic headers) — those take the generic decode
+    path."""
     try:
-        if raw[0] not in (0x94, 0x95) or raw[1] != 0x00:  # REQUEST
+        if raw[0] not in (0x94, 0x95, 0x96) or raw[1] != 0x00:  # REQUEST
             return None
-        has_trace = raw[0] == 0x95
+        n_extra = raw[0] - 0x94
         i = 2
         t = raw[i]
         if t <= 0x7F:
@@ -175,9 +178,28 @@ def _parse_envelope(raw: bytes):
         else:
             return None
         method = raw[i:i + n].decode("utf-8", "surrogateescape")
-        return msgid, method, i + n, has_trace
+        return msgid, method, i + n, n_extra
     except IndexError:
         return None
+
+
+def split_extras(raw: bytes, off: int):
+    """Split a request's params span from its OPTIONAL trailing envelope
+    elements (trace, then deadline) — shared by both transports. Returns
+    (params_span, trace_wire, deadline_wire); a malformed tail degrades
+    to (everything, None, None) — a bad extra element must not 500 the
+    request."""
+    try:
+        pend = msgpack_span_end(raw, off)
+        trace_w = dl_w = None
+        if pend < len(raw):
+            tend = msgpack_span_end(raw, pend)
+            trace_w = msgpack.unpackb(raw[pend:tend], raw=False)
+            if tend < len(raw):
+                dl_w = msgpack.unpackb(raw[tend:], raw=False)
+        return raw[off:pend], trace_w, dl_w
+    except Exception:  # broad-ok — a bad trailing element must not 500
+        return raw[off:], None, None
 
 
 class RpcServer:
@@ -376,23 +398,16 @@ class RpcServer:
                     raw: bytes, conn_state: Optional[dict] = None) -> None:
         env = _parse_envelope(raw)
         if env is not None:
-            msgid, method, off, has_trace = env
-            params_span = raw[off:]
-            trace = None
-            if has_trace:
-                # traced envelope: split the params span from the trailing
-                # trace element (both follow the method; the walk is paid
-                # only on traced requests)
-                try:
-                    pend = msgpack_span_end(raw, off)
-                    if pend < len(raw):
-                        trace = msgpack.unpackb(raw[pend:], raw=False)
-                    params_span = raw[off:pend]
-                except Exception:  # noqa: BLE001 — a bad trace element
-                    params_span, trace = raw[off:], None  # must not 500
+            msgid, method, off, n_extra = env
+            params_span, trace, dl = raw[off:], None, None
+            if n_extra:
+                # traced/deadlined envelope: split the params span from
+                # the trailing elements (the walk is paid only on
+                # extended requests)
+                params_span, trace, dl = split_extras(raw, off)
             if method in self._raw_methods and self._pool is not None:
                 self._pool.submit(self._dispatch_fast, conn, wlock, msgid,
-                                  method, params_span, conn_state, trace)
+                                  method, params_span, conn_state, trace, dl)
                 return
         msg = msgpack.unpackb(raw, raw=False, strict_map_key=False,
                               use_list=True,
@@ -402,14 +417,17 @@ class RpcServer:
     def _dispatch_fast(self, conn, wlock, msgid, method,
                        raw_params: bytes,
                        conn_state: Optional[dict] = None,
-                       trace: Any = None) -> None:
-        # adopt the caller's trace context (or root a fresh one) for the
-        # duration of the dispatch; restore after — pool threads are reused
+                       trace: Any = None, dl: Any = None) -> None:
+        # adopt the caller's trace context (or root a fresh one) AND its
+        # deadline for the duration of the dispatch; restore after —
+        # pool threads are reused
         prev = tracing.swap_trace(tracing.from_wire(trace))
+        prev_dl = deadlines.swap(deadlines.adopt_wire(dl))
         try:
             error, result = self._execute_fast(method, raw_params, conn_state)
         finally:
             tracing.swap_trace(prev)
+            deadlines.swap(prev_dl)
         payload = build_response(
             msgid, error, result,
             legacy=self.response_legacy(method, conn_state))
@@ -439,12 +457,15 @@ class RpcServer:
             return self._execute(method, params)
         t0 = _time.perf_counter()
         try:
+            if faults.is_armed():
+                faults.fire(f"rpc.dispatch.{method}")
+            self._check_deadline(method)
             result = fn(raw_params)
             if result is not RAW_FALLBACK:
                 self.trace.record(f"rpc.{method}",
                                   _time.perf_counter() - t0)
                 return None, result
-        except Exception as e:  # noqa: BLE001 — every failure must answer
+        except Exception as e:  # broad-ok — every failure must answer
             log.debug("rpc raw method %s raised", method, exc_info=True)
             self.trace.record(f"rpc.{method}", _time.perf_counter() - t0)
             self.trace.count(f"rpc.{method}.errors")
@@ -458,14 +479,16 @@ class RpcServer:
                 conn_state: Optional[dict] = None) -> None:
         if not isinstance(msg, (list, tuple)) or not msg:
             return
-        if msg[0] == REQUEST and len(msg) in (4, 5):
-            # 5th element: optional trace context ({"t","s"}) — see
+        if msg[0] == REQUEST and len(msg) in (4, 5, 6):
+            # 5th element: optional trace context ({"t","s"}); 6th:
+            # optional deadline budget (remaining seconds) — see
             # rpc/client.py; plain msgpack-rpc peers send 4
             _, msgid, method, params = msg[:4]
-            trace = msg[4] if len(msg) == 5 else None
+            trace = msg[4] if len(msg) >= 5 else None
+            dl = msg[5] if len(msg) == 6 else None
             if self._pool is not None:
                 self._pool.submit(self._dispatch, conn, wlock, msgid, method,
-                                  params, conn_state, trace)
+                                  params, conn_state, trace, dl)
         elif msg[0] == NOTIFY and len(msg) == 3:
             _, method, params = msg
             if self._pool is not None:
@@ -473,12 +496,14 @@ class RpcServer:
 
     def _dispatch(self, conn, wlock, msgid, method, params,
                   conn_state: Optional[dict] = None,
-                  trace: Any = None) -> None:
+                  trace: Any = None, dl: Any = None) -> None:
         prev = tracing.swap_trace(tracing.from_wire(trace))
+        prev_dl = deadlines.swap(deadlines.adopt_wire(dl))
         try:
             error, result = self._execute(method, params)
         finally:
             tracing.swap_trace(prev)
+            deadlines.swap(prev_dl)
         payload = build_response(
             msgid, error, result,
             legacy=self.response_legacy(method, conn_state))
@@ -493,7 +518,7 @@ class RpcServer:
         error, result = None, None
         try:
             result = self._invoke(method, params)
-        except Exception as e:  # noqa: BLE001 — every failure must answer
+        except Exception as e:  # broad-ok — every failure must answer
             if not isinstance(e, RpcMethodNotFound):
                 log.debug("rpc method %s raised", method, exc_info=True)
             # per-method failure counter: the dispatch span times success
@@ -510,13 +535,27 @@ class RpcServer:
         want = self._arity.get(method)
         if want is not None and len(params) != want:
             raise TypeError(f"{method}: expected {want} params, got {len(params)}")
+        # injection site for dispatch-side chaos (queueing delay, worker
+        # stalls); fired BEFORE the deadline gate so an injected delay can
+        # deterministically expire a propagated budget
+        if faults.is_armed():
+            faults.fire(f"rpc.dispatch.{method}")
+        self._check_deadline(method)
         with self.trace.span(f"rpc.{method}"):
             return fn(*params)
+
+    def _check_deadline(self, method: str) -> None:
+        """Reject already-expired work at dispatch: computing an answer
+        nobody is waiting for only steals capacity from live requests.
+        Counted per server (``rpc.deadline_rejected``)."""
+        if deadlines.expired():
+            self.trace.count("rpc.deadline_rejected")
+            raise DeadlineExceeded(f"{method}: deadline expired at dispatch")
 
     def _invoke_silent(self, method: str, params: Any) -> None:
         try:
             self._invoke(method, params)
-        except Exception:  # noqa: BLE001
+        except Exception:  # broad-ok
             log.debug("rpc notify %s raised", method, exc_info=True)
 
     def response_legacy(self, method: str,

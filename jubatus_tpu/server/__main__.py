@@ -31,7 +31,7 @@ def main(argv=None) -> int:
         srv = None
         try:
             srv = EngineServer.from_args(args)
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # broad-ok
             print(f"config error: {e}", file=sys.stderr)
             return 1
         finally:

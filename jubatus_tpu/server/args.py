@@ -60,6 +60,11 @@ class ServerArgs:
     jax_coordinator: str = ""       # host:port of jax process 0
     jax_processes: int = 0          # world size; 0 = no distributed init
     jax_process_id: int = -1
+    #: --mix-quorum: minimum fraction of members whose diffs must arrive
+    #: for a master round to proceed (framework/linear_mixer.py); rounds
+    #: below 100% but at/above quorum run DEGRADED (counted + stamped in
+    #: the flight recorder), below it they abort
+    mix_quorum: float = 0.5
     #: --mix-bf16: the collective mixer's psum ships f32 diffs as bf16
     #: (half the interconnect bytes per round; additive diffs fold into
     #: an f32 master, same tradeoff as the RPC mix's bf16 option). All
@@ -158,6 +163,11 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "count); 0 disables distributed jax init")
     p.add_argument("--jax-process-id", type=int, default=-1,
                    help="this process's rank in the jax world")
+    p.add_argument("--mix-quorum", type=float, default=0.5,
+                   help="minimum fraction of members whose diffs must "
+                        "arrive for a mix round to proceed; rounds above "
+                        "quorum but below 100%% run degraded (counted as "
+                        "mix.quorum_degraded)")
     p.add_argument("--mix-bf16", action="store_true",
                    help="collective mixer ships f32 diffs as bf16 over "
                         "the interconnect (half the bytes per round; "

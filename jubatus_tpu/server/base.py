@@ -101,6 +101,7 @@ class EngineServer:
                 interval_sec=self.args.interval_sec,
                 interval_count=self.args.interval_count,
                 mix_bf16=getattr(self.args, "mix_bf16", False),
+                quorum_fraction=getattr(self.args, "mix_quorum", 0.5),
             )
             self.mixer.set_trace_registry(self.rpc.trace)
             # cluster-unique id minting for the engines that mint ids
@@ -167,7 +168,7 @@ class EngineServer:
         for cli in peers:
             try:
                 cli.close()
-            except Exception:  # noqa: BLE001 — teardown
+            except Exception:  # broad-ok — teardown
                 pass
 
     def drop_peer_client(self, node: NodeInfo) -> None:
@@ -176,7 +177,7 @@ class EngineServer:
         if cli is not None:
             try:
                 cli.close()
-            except Exception:  # noqa: BLE001
+            except Exception:  # broad-ok
                 pass
 
     def cluster_cht(self):
@@ -395,7 +396,7 @@ class EngineServer:
                 cht = CHT.from_coordinator(
                     self.coord, self.engine, self.args.name,
                     actives_only=False)
-            except Exception:  # noqa: BLE001 — transient coord trouble
+            except Exception:  # broad-ok — transient coord trouble
                 log.warning("assignment rebuild failed; keeping previous",
                             exc_info=True)
                 return
@@ -436,7 +437,7 @@ class EngineServer:
                     continue
                 try:
                     step()
-                except Exception:  # noqa: BLE001 — teardown must finish
+                except Exception:  # broad-ok — teardown must finish
                     log.exception("shutdown step %r failed", step)
         finally:
             # set LAST (join() must not return mid-teardown) but ALWAYS

@@ -14,26 +14,46 @@ comes from ``framework.idl.SERVICES`` (what the reference bakes into the
 generated ``*_proxy.cpp``). Wire behavior matches: same method names, same
 leading cluster-name param, same reducer semantics, per-host failures
 tolerated as long as one backend answers (proxy.hpp:325-392).
+
+Beyond the reference — the self-healing request plane:
+
+- **per-backend circuit breakers** (rpc/breaker.py): transport failures
+  land in a rolling window per member; an OPEN backend is skipped by
+  random/cht routing and re-admitted via half-open probes, replacing the
+  old blunt ``members.invalidate(cluster)`` (which nuked the whole
+  cluster's cache because ONE node failed);
+- **idempotent failover**: random-routed reads that hit a transport
+  failure fail over to the next active replica (retry-budget-gated, so a
+  degraded cluster sees bounded amplification); effectful calls keep
+  propagate-don't-double-apply semantics;
+- **deadline-aware fan-out**: the broadcast collects against ONE shared
+  deadline (``concurrent.futures.wait``) derived from the caller's
+  remaining budget, abandoning stragglers (counted as
+  ``proxy.fanout_timeouts``) instead of serially paying ``timeout+1`` per
+  hung backend; per-attempt backend timeouts derive from the remaining
+  budget because the forwarded call re-ships it on the envelope.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import logging
-import msgpack
 import random
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ThreadPoolExecutor, wait as futures_wait
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from jubatus_tpu.coord import create_coordinator, membership
 from jubatus_tpu.coord.base import Coordinator, NodeInfo
 from jubatus_tpu.coord.cht import CHT
-from jubatus_tpu.framework.idl import INTERNAL, get_service
+from jubatus_tpu.framework.idl import INTERNAL, get_service, idempotent_methods
 from jubatus_tpu.rpc import aggregators
+from jubatus_tpu.rpc import deadline as deadlines
+from jubatus_tpu.rpc.breaker import BreakerBoard
 from jubatus_tpu.rpc.client import RpcClient
 from jubatus_tpu.rpc.errors import (
+    DeadlineExceeded,
     HostError,
     MultiRpcError,
     RpcIoError,
@@ -41,10 +61,14 @@ from jubatus_tpu.rpc.errors import (
     RpcNoResult,
     RpcTimeoutError,
 )
-from jubatus_tpu.utils import tracing
+from jubatus_tpu.rpc.retry import RetryBudget
+from jubatus_tpu.utils import faults, tracing
 from jubatus_tpu.version import __version__
 
 log = logging.getLogger(__name__)
+
+#: transport-level failures (a breaker's evidence; failover triggers)
+_TRANSPORT_ERRORS = (RpcIoError, RpcTimeoutError, faults.FaultInjected)
 
 
 @dataclasses.dataclass
@@ -67,6 +91,15 @@ class ProxyArgs:
     modern_wire: bool = False           # --modern-wire: no autodetection
     #: Prometheus /metrics + /healthz HTTP port: -1 = off, 0 = ephemeral
     metrics_port: int = -1
+    #: circuit breaker tuning (rpc/breaker.py): this many transport
+    #: failures to one backend inside the window open its breaker for
+    #: the cooldown; half-open probes re-admit it
+    breaker_failures: int = 5
+    breaker_window: float = 30.0
+    breaker_cooldown: float = 5.0
+    #: retry budget: failover retries per first-attempt forward (10% =
+    #: the gRPC/Finagle convention; see rpc/retry.py)
+    retry_budget_ratio: float = 0.1
 
     @property
     def bind_host(self) -> str:
@@ -189,6 +222,16 @@ class Proxy:
         self.request_counts: Dict[str, int] = {}
         self.forward_count = 0
         self.forward_errors = 0
+        #: self-healing plane: per-backend breakers + the failover retry
+        #: budget; transitions count into the proxy's own registry
+        #: (proxy.breaker_open / proxy.breaker_close on /metrics)
+        self.breakers = BreakerBoard(
+            window_sec=args.breaker_window,
+            failure_threshold=args.breaker_failures,
+            cooldown_sec=args.breaker_cooldown,
+            registry=self.rpc.trace, counter_prefix="proxy.breaker")
+        self.retry_budget = RetryBudget(ratio=args.retry_budget_ratio)
+        self._idempotent = idempotent_methods(self.engine)
         #: C++ relay plane (native transport only): random-routed raw
         #: methods forward in rpc_frontend.cpp without entering Python;
         #: this side only keeps the routing table fresh (clusters seen ->
@@ -215,8 +258,14 @@ class Proxy:
             lst = self._pool.get(key)
             if lst:
                 return lst.pop()
+        # the proxy's backend clients do NOT retry at the client layer:
+        # the proxy owns failover ACROSS replicas (same budget, better
+        # spread) — stacked same-host retries under the fan-out would
+        # multiply tail latency
         return _Session(RpcClient(node.host, node.port,
-                                  timeout=self.args.interconnect_timeout))
+                                  timeout=self.args.interconnect_timeout,
+                                  retry_methods=frozenset(),
+                                  registry=self.rpc.trace))
 
     def _checkin(self, node: NodeInfo, sess: _Session) -> None:
         sess.last_used = time.monotonic()
@@ -273,7 +322,9 @@ class Proxy:
     ) -> Any:
         """Call all nodes in parallel; fold successes left-to-right through
         the reducer; per-host errors are tolerated unless every host fails
-        (proxy.hpp:325-392)."""
+        (proxy.hpp:325-392). The whole collection runs against ONE shared
+        deadline — a single hung backend costs the broadcast one budget,
+        not N serial budgets; stragglers are abandoned and counted."""
         if not nodes:
             raise RpcNoClient(f"no active {self.engine} servers")
         with self._counters_lock:
@@ -281,21 +332,40 @@ class Proxy:
         if len(nodes) == 1:
             return self._one(nodes[0], method, args)
         # the fan-out hops threads: carry this request's trace context
-        # into the executor so each backend call ships the same trace_id
+        # AND deadline into the executor so each backend call ships the
+        # same trace_id and derives its timeout from the remaining budget
         ctx = tracing.current_trace()
+        dl = deadlines.current()
 
         def call(n: NodeInfo) -> Any:
-            with tracing.use_trace(ctx):
+            with tracing.use_trace(ctx), deadlines.use(dl):
                 return self._one(n, method, args)
 
-        futs = [(n, self._executor.submit(call, n)) for n in nodes]
+        futs: Dict[Any, NodeInfo] = {
+            self._executor.submit(call, n): n for n in nodes}
+        budget = self.args.timeout + 1.0
+        rem = deadlines.remaining()
+        if rem is not None:
+            budget = min(budget, max(rem, 0.0))
+        done, pending = futures_wait(futs, timeout=budget)
         results: List[Any] = []
         errors: List[HostError] = []
-        for n, fut in futs:
+        # iterate in submission order (dict preserves it): the reducer
+        # fold stays deterministic even though completion order isn't
+        for fut, n in futs.items():
+            if fut in pending:
+                fut.cancel()  # abandon: result (if any) is discarded
+                errors.append(HostError(
+                    n.host, n.port,
+                    RpcTimeoutError(f"{method} @ {n.host}:{n.port}: "
+                                    "fanout deadline")))
+                continue
             try:
-                results.append(fut.result(timeout=self.args.timeout + 1.0))
-            except Exception as e:  # noqa: BLE001 — per-host failure is data
+                results.append(fut.result())
+            except Exception as e:  # broad-ok — per-host failure is data
                 errors.append(HostError(n.host, n.port, e))
+        if pending:
+            self.rpc.trace.count("proxy.fanout_timeouts", len(pending))
         if errors:
             with self._counters_lock:
                 self.forward_errors += len(errors)
@@ -307,23 +377,102 @@ class Proxy:
         return acc
 
     def _one(self, node: NodeInfo, method: str, args: Sequence[Any]) -> Any:
+        """One forwarded call, feeding the backend's breaker: transport
+        failures tear the node's sessions down and count against it;
+        application errors prove the backend alive. The old
+        ``members.invalidate(cluster)`` on any failure is gone — one sick
+        node no longer blinds the cache for the whole cluster."""
+        key = (node.host, node.port)
         sess = self._checkout(node)
         try:
             result = sess.client.call(method, *args)
-        except Exception:
-            # dead backend: close this session, drop its idle siblings,
-            # and let the caller decide
+        except _TRANSPORT_ERRORS:
+            # dead/unreachable backend: close this session, drop its idle
+            # siblings, feed the breaker, and let the caller decide
             sess.client.close()
             self._drop_sessions(node)
-            self.members.invalidate(str(args[0]) if args else "")
+            self.breakers.record(key, False)
+            raise
+        except DeadlineExceeded:
+            # the CALLER's budget ran out — no evidence about the backend
+            sess.client.close()
+            raise
+        except Exception:  # broad-ok — app error from a healthy backend
+            self._checkin(node, sess)
+            self.breakers.record(key, True)
             raise
         self._checkin(node, sess)
+        self.breakers.record(key, True)
         return result
 
     # -- routing handlers (register_async_{random,broadcast,cht}) -------------
     def _count(self, method: str) -> None:
         with self._counters_lock:
             self.request_counts[method] = self.request_counts.get(method, 0) + 1
+
+    def _route_candidates(self, nodes: Sequence[NodeInfo]) -> List[NodeInfo]:
+        """Breaker-aware filter (peek only — the probe slot is claimed by
+        ``allow`` on the node actually called): open backends drop out of
+        routing; if EVERY candidate is open, fail static (route anyway —
+        refusing all traffic would turn a breaker bug into an outage)."""
+        allowed = [n for n in nodes
+                   if self.breakers.available((n.host, n.port))]
+        if allowed:
+            return allowed
+        if nodes:
+            self.rpc.trace.count("proxy.breaker_fail_static")
+        return list(nodes)
+
+    def _call_random(self, name: str, actives: Sequence[NodeInfo],
+                     params: Sequence[Any]) -> Any:
+        """Random routing with breaker-aware selection and idempotent
+        failover: a read that hits a transport failure moves to the next
+        active replica (budget-gated); an effectful call propagates its
+        first failure — re-forwarding could double-apply."""
+        if not actives:
+            raise RpcNoClient(f"no active {self.engine} servers")
+        candidates = self._route_candidates(actives)
+        random.shuffle(candidates)
+        idem = name in self._idempotent
+        last: Optional[BaseException] = None
+        tried = 0
+        for node in candidates:
+            if not self.breakers.allow((node.host, node.port)):
+                continue  # half-open probe slot already taken
+            tried += 1
+            with self._counters_lock:
+                self.forward_count += 1
+            try:
+                return self._one(node, name, params)
+            except _TRANSPORT_ERRORS as e:
+                with self._counters_lock:
+                    self.forward_errors += 1
+                last = e
+                if not idem:
+                    raise
+                rem = deadlines.remaining()
+                if rem is not None and rem <= 0:
+                    raise
+                if not self.retry_budget.try_withdraw():
+                    self.rpc.trace.count("rpc.retry_budget_exhausted")
+                    raise
+                self.rpc.trace.count("rpc.retries")
+                continue
+        if last is not None:
+            raise last
+        if not tried:
+            # every candidate refused (all half-open with a probe in
+            # flight): force one attempt rather than failing closed
+            node = random.choice(list(candidates))
+            with self._counters_lock:
+                self.forward_count += 1
+            try:
+                return self._one(node, name, params)
+            except _TRANSPORT_ERRORS:
+                with self._counters_lock:
+                    self.forward_errors += 1
+                raise
+        raise RpcNoClient(f"no active {self.engine} servers")
 
     #: clusters with no actives for this long fall out of the relay
     #: table and the seen-set (client-supplied names must not grow state
@@ -348,7 +497,9 @@ class Proxy:
         config generation (rpc_frontend.cpp relay_try). A cluster whose
         actives lookup FAILS transiently keeps its previous routing (a
         coordinator hiccup must not bounce traffic to the Python path);
-        one that stays EMPTY past the TTL is dropped entirely."""
+        one that stays EMPTY past the TTL is dropped entirely. Backends
+        with an OPEN breaker are withheld from the relay table — the C++
+        plane routes around them exactly like the Python plane."""
         last_table: Dict[str, list] = {}
         while not self._stop_event.wait(1.0):
             with self._relay_lock:
@@ -362,12 +513,15 @@ class Proxy:
                 try:
                     nodes = [(n.host, n.port)
                              for n in self.members.actives(cluster)]
-                except Exception:  # noqa: BLE001 — carry last known
+                except Exception:  # broad-ok — carry last known
                     log.debug("relay refresh failed for %s", cluster,
                               exc_info=True)
                     nodes = last_table.get(cluster, [])
                 if nodes:
-                    table[cluster] = nodes
+                    open_set = {k for k in nodes
+                                if not self.breakers.available(k)}
+                    healthy = [k for k in nodes if k not in open_set]
+                    table[cluster] = healthy or nodes  # fail static
                     with self._relay_lock:
                         if cluster in self._relay_seen:
                             self._relay_seen[cluster] = now
@@ -383,7 +537,7 @@ class Proxy:
                     self._relay_methods, table,
                     timeout=self.args.interconnect_timeout,
                     idle_expire=self.args.session_pool_expire)
-            except Exception:  # noqa: BLE001
+            except Exception:  # broad-ok — next tick retries
                 log.debug("relay config push failed", exc_info=True)
 
     def _handler(self, name: str, routing: str, cht_n: int,
@@ -397,14 +551,19 @@ class Proxy:
             self._expire_sessions()
             actives = self.members.actives(str(params[0]))
             if routing == "broadcast":
-                nodes: Sequence[NodeInfo] = actives
-            elif routing == "cht":
+                # writes must reach every member: breakers observe but
+                # never skip a broadcast (a success even self-heals an
+                # open breaker — proof of life)
+                return self._fan(actives, name, params, reducer)
+            if routing == "cht":
                 if len(params) < 2:
                     raise TypeError(f"{name}: cht routing needs a key param")
-                nodes = CHT(actives).find(str(params[1]), cht_n)
-            else:  # random (proxy.hpp:229-247)
-                nodes = [random.choice(actives)] if actives else []
-            return self._fan(nodes, name, params, reducer)
+                ring = CHT(actives).find(str(params[1]), cht_n)
+                nodes = self._route_candidates(ring)
+                return self._fan(nodes, name, params, reducer)
+            # random (proxy.hpp:229-247) + breaker skip + idempotent
+            # failover
+            return self._call_random(name, actives, params)
 
         return handle
 
@@ -416,8 +575,12 @@ class Proxy:
         proxy, matching the reference proxy's C++ forwarding cost shape
         (proxy.hpp:64-186). Anything irregular (no actives, backend
         error/IO, undecodable name) declines to the generic path, which
-        owns retry and error taxonomy."""
+        owns retry and error taxonomy. Breaker-aware like the generic
+        path: open backends are skipped, and IDEMPOTENT methods fail over
+        to the next replica on a transport failure."""
         from jubatus_tpu.rpc.server import RAW_FALLBACK, RawResult
+
+        idem = name in self._idempotent
 
         def handle(raw_params: bytes) -> Any:
             cluster = _peek_cluster_name(raw_params)
@@ -431,32 +594,60 @@ class Proxy:
             # counted only once we own the request: every RAW_FALLBACK
             # re-enters the generic handler, which counts it there
             self._count(name)
-            node = random.choice(actives)
-            with self._counters_lock:
-                self.forward_count += 1
-            sess = self._checkout(node)
-            try:
-                span = sess.client.call_raw(name, raw_params)
-            except (RpcIoError, RpcTimeoutError):
-                # transport failure AFTER the request may have reached the
-                # backend: a silent re-forward would double-apply a train
-                # batch, so propagate — exactly what the generic path does
-                # when its single target dies (_one re-raises). Tear the
-                # node's sessions down and let the client decide.
-                sess.client.close()
-                self._drop_sessions(node)
-                self.members.invalidate(cluster)
+            candidates = self._route_candidates(actives)
+            random.shuffle(candidates)
+            last: Optional[BaseException] = None
+            tried = 0
+            for node in candidates:
+                key = (node.host, node.port)
+                if not self.breakers.allow(key):
+                    continue
+                tried += 1
                 with self._counters_lock:
-                    self.forward_errors += 1
-                raise
-            except Exception:
-                # application error from a HEALTHY backend (non-nil error
-                # span): the connection read the full response — return it
-                # to the pool and relay the error as-is
+                    self.forward_count += 1
+                sess = self._checkout(node)
+                try:
+                    span = sess.client.call_raw(name, raw_params)
+                except _TRANSPORT_ERRORS as e:
+                    # transport failure AFTER the request may have reached
+                    # the backend: for an EFFECTFUL method a silent
+                    # re-forward would double-apply a train batch, so
+                    # propagate — reads fail over to the next replica.
+                    # Tear the node's sessions down either way.
+                    sess.client.close()
+                    self._drop_sessions(node)
+                    self.breakers.record(key, False)
+                    with self._counters_lock:
+                        self.forward_errors += 1
+                    if not idem:
+                        raise
+                    rem = deadlines.remaining()
+                    if rem is not None and rem <= 0:
+                        raise
+                    if not self.retry_budget.try_withdraw():
+                        self.rpc.trace.count("rpc.retry_budget_exhausted")
+                        raise
+                    self.rpc.trace.count("rpc.retries")
+                    last = e
+                    continue
+                except DeadlineExceeded:
+                    sess.client.close()
+                    raise
+                except Exception:  # broad-ok — app error: backend alive
+                    # application error from a HEALTHY backend (non-nil
+                    # error span): the connection read the full response —
+                    # return it to the pool and relay the error as-is
+                    self._checkin(node, sess)
+                    self.breakers.record(key, True)
+                    raise
                 self._checkin(node, sess)
-                raise
-            self._checkin(node, sess)
-            return RawResult(span)
+                self.breakers.record(key, True)
+                return RawResult(span)
+            if last is not None:
+                raise last
+            if not tried:
+                return RAW_FALLBACK  # all probes busy: generic path decides
+            raise RpcNoClient(f"no active {self.engine} servers")
 
         # era-safe for every client: call_raw pins pooled backend
         # connections MODERN via its str8 method encoding, so a legacy
@@ -490,8 +681,19 @@ class Proxy:
         self._register("do_mix", 1, "random", aggregators.pass_)
         self.rpc.register("get_proxy_status", self.get_proxy_status, arity=1)
         self.rpc.register("get_proxy_metrics", self.get_metrics, arity=1)
+        self.rpc.register("get_breakers", self.get_breakers, arity=1)
 
     # -- own status (proxy_common::get_status) --------------------------------
+    def get_breakers(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
+        """Breaker + retry-budget state, keyed by proxy node name — the
+        ``jubactl -c breakers`` view and the ops answer to 'why is this
+        backend getting no traffic?'."""
+        node = NodeInfo(self.args.bind_host, self.rpc.port or self.args.rpc_port)
+        return {node.name: {
+            "breakers": self.breakers.snapshot(),
+            "retry_budget": self.retry_budget.status(),
+        }}
+
     def get_proxy_status(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
         node = NodeInfo(self.args.bind_host, self.rpc.port or self.args.rpc_port)
         # requests the C++ relay served never reach Python — fold its
@@ -500,9 +702,10 @@ class Proxy:
         if hasattr(self.rpc, "relay_stats"):
             try:
                 relayed = self.rpc.relay_stats()
-            except Exception:  # noqa: BLE001 — status must never fail
+            except Exception:  # broad-ok — status must never fail
                 log.debug("relay stats fetch failed", exc_info=True)
         relay_errors = relayed.pop("__errors__", 0)
+        breakers = self.breakers.snapshot()
         with self._counters_lock:
             st: Dict[str, Any] = {
                 "timestamp": int(time.time()),  # wall-clock
@@ -519,6 +722,13 @@ class Proxy:
             for m, c in relayed.items():
                 counts[m] = counts.get(m, 0) + c
             st.update({f"request.{k}": v for k, v in counts.items()})
+        st["breaker_backends"] = len(breakers)
+        st["breaker_open"] = sum(
+            1 for b in breakers.values() if b["state"] == "open")
+        st["breaker_opened_total"] = sum(
+            b["opened_total"] for b in breakers.values())
+        for k, v in self.retry_budget.status().items():
+            st[f"retry_budget.{k}"] = v
         st.update(self.args.flags_status())
         # span histograms + counters (same registry /metrics exposes) —
         # the proxy hop's rpc.* quantiles and trace ids sit next to the
@@ -535,10 +745,13 @@ class Proxy:
     def _health(self) -> Dict[str, Any]:
         with self._counters_lock:
             fwd, errs = self.forward_count, self.forward_errors
+        breakers = self.breakers.snapshot()
         return {"engine": f"{self.engine}_proxy",
                 "uptime_s": int(time.time() - self.start_time),  # wall-clock
                 "rpc_port": self.rpc.port or self.args.rpc_port,
-                "forward_count": fwd, "forward_errors": errs}
+                "forward_count": fwd, "forward_errors": errs,
+                "breaker_open": sum(1 for b in breakers.values()
+                                    if b["state"] == "open")}
 
     # -- lifecycle ------------------------------------------------------------
     def start(self, port: Optional[int] = None) -> int:
@@ -562,7 +775,7 @@ class Proxy:
                      self.args.metrics_port)
         try:
             membership.register_proxy(self.coord, self.args.bind_host, actual)
-        except Exception:  # noqa: BLE001 — registry is informational for proxies
+        except Exception:  # broad-ok — registry is informational for proxies
             log.debug("proxy registration failed", exc_info=True)
         log.info("%s proxy listening on %s:%d", self.engine, self.args.bind_host, actual)
         return actual
@@ -575,7 +788,7 @@ class Proxy:
         if self.metrics is not None:
             try:
                 self.metrics.stop()
-            except Exception:  # noqa: BLE001 — teardown must finish
+            except Exception:  # broad-ok — teardown must finish
                 log.debug("metrics endpoint stop failed", exc_info=True)
         with self._pool_lock:
             for lst in self._pool.values():
@@ -613,6 +826,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--metrics-port", type=int, default=-1,
                    help="serve Prometheus /metrics + /healthz on this "
                         "HTTP port (0 = ephemeral; default off)")
+    p.add_argument("--breaker-failures", type=int, default=5,
+                   help="transport failures within --breaker-window that "
+                        "open a backend's circuit breaker")
+    p.add_argument("--breaker-window", type=float, default=30.0)
+    p.add_argument("--breaker-cooldown", type=float, default=5.0,
+                   help="seconds an open breaker refuses traffic before "
+                        "admitting a half-open probe")
+    p.add_argument("--retry-budget-ratio", type=float, default=0.1,
+                   help="failover retries allowed per first-attempt "
+                        "forward (token bucket; 0 disables failover)")
     ns = p.parse_args(argv)
     args = ProxyArgs(**{f.name: getattr(ns, f.name)
                         for f in dataclasses.fields(ProxyArgs)

@@ -142,7 +142,7 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
         conv = _json.loads(server.config_json).get("converter")
         parser = IngestParser.from_converter_config(
             conv, driver.converter.hasher.dim_bits)
-    except Exception:  # noqa: BLE001 — fast path is strictly optional
+    except Exception:  # broad-ok — fast path is strictly optional
         return
     if parser is None:
         return
@@ -445,7 +445,7 @@ def _replicated_write(server: Any, key: str, apply_local, apply_remote,
                 out = apply_remote(server.peer_client(node))
             if i == 0:
                 result = out
-        except Exception:
+        except Exception:  # broad-ok — replica writes are best-effort
             if i == 0:
                 raise  # primary failure is the caller's failure
             server.drop_peer_client(node)

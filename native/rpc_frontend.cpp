@@ -211,10 +211,10 @@ bool read_str(const uint8_t*& p, const uint8_t* end,
 // ORs it into the wire-era fingerprint; without it, clients that
 // deliberately pin the era via a str8 method name (RpcClient.call_raw)
 // would be fingerprinted from the params span alone.
-// bit 1 (traced): the request arrived as the 5-element traced envelope
-// [0, msgid, method, params, trace] — the params span handed to the
-// callback then ends with the trace element, which the Python layer
-// splits off (rpc/server.py msgpack_span_end).
+// bit 1 (extended): the request arrived as the 5/6-element envelope
+// [0, msgid, method, params, trace[, deadline]] — the params span handed
+// to the callback then ends with the trailing element(s), which the
+// Python layer splits off (rpc/server.py split_extras).
 typedef void (*request_cb)(uint64_t conn_id, uint64_t msgid,
                            const char* method, int64_t method_len,
                            const uint8_t* params, int64_t params_len,
@@ -669,7 +669,10 @@ const uint8_t* parse_frame(Server* s, uint64_t conn_id,
   uint64_t type = 0, msgid = kNotifyMsgid;
   const uint8_t* mdata;
   int64_t mlen;
-  if (count == 4 || count == 5) {  // request (5 = traced envelope)
+  // request; 5 = traced envelope, 6 = traced + deadline envelope (the
+  // trailing elements are split off by the Python layer / the receiving
+  // backend — this framer only needs to not reject them)
+  if (count >= 4 && count <= 6) {
     if (!read_uint(q, frame_end, &type) || type != 0) return malformed();
     // both sentinels are reserved: a wire msgid equal to kCloseId would
     // spoof a connection-close notification into the Python layer
@@ -682,13 +685,14 @@ const uint8_t* parse_frame(Server* s, uint64_t conn_id,
     return malformed();
   }
   int32_t envelope_flags = (q < frame_end && *q == 0xd9) ? 1 : 0;
-  if (count == 5) envelope_flags |= 2;
+  if (count >= 5) envelope_flags |= 2;  // trailing trace [+ deadline]
   if (!read_str(q, frame_end, &mdata, &mlen)) return malformed();
   // relay hot path: configured methods forward to a backend without ever
   // entering Python (the frame is consumed when relay_try returns true).
-  // Traced (5-element) frames forward verbatim too — the trace element
-  // rides through to the backend, which splits it off itself.
-  if ((count == 4 || count == 5) &&
+  // Traced/deadlined (5/6-element) frames forward verbatim too — the
+  // trailing elements ride through to the backend, which splits them
+  // off itself.
+  if (count >= 4 && count <= 6 &&
       s->relay.enabled.load(std::memory_order_relaxed) &&
       relay_try(s, conn, p, frame_end, msgid, mdata, mlen, q))
     return frame_end;

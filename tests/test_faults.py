@@ -321,6 +321,288 @@ def test_heartbeat_delay_below_lease_is_harmless():
         srv.stop()
 
 
+# ----------------------------------------------- self-healing plane -------
+def test_breaker_trips_after_n_failures_and_half_open_readmits():
+    """CircuitBreaker state machine, deterministically: N failures in the
+    window open it, the cooldown admits exactly one half-open probe, a
+    probe success closes it (window cleared), a probe failure re-opens."""
+    from jubatus_tpu.rpc.breaker import CircuitBreaker
+
+    b = CircuitBreaker(failure_threshold=3, cooldown_sec=0.15,
+                       window_sec=30.0)
+    assert b.allow() and b.state == "closed"
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"          # under threshold
+    assert b.record_failure() is True   # trips
+    assert b.state == "open" and not b.allow()
+    time.sleep(0.16)
+    assert b.state == "half_open"
+    assert b.allow() is True            # the one probe
+    assert b.allow() is False           # serialized: second probe refused
+    assert b.record_failure() is True   # probe failed: re-open
+    assert not b.allow()
+    time.sleep(0.16)
+    assert b.allow() is True
+    assert b.record_success() is True   # probe succeeded: closed
+    assert b.state == "closed" and b.allow()
+    assert b.opened_total == 2
+
+
+def test_retry_budget_exhausts_under_sustained_faults():
+    """The token bucket caps retry amplification: with every call
+    failing, withdrawals stop once the budget is dry and the client
+    counts rpc.retry_budget_exhausted instead of hammering the backend."""
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.rpc.retry import RetryBudget
+    from jubatus_tpu.utils.tracing import Registry
+
+    reg = Registry()
+    budget = RetryBudget(ratio=0.01, max_tokens=2.0)
+    c = RpcClient("127.0.0.1", 1, retry_budget=budget, registry=reg)
+    with faults.armed("rpc.connect.127.0.0.1:1:error"):
+        for _ in range(10):
+            with pytest.raises(RpcError):
+                c.call("get_status", "x")
+    c.close()
+    counters = reg.counters()
+    # 2 initial tokens + 10 * 0.01 deposits < 3: at most 2-3 retries ever
+    # happen, the rest are denied
+    assert counters.get("rpc.retries", 0) <= 3
+    assert counters.get("rpc.retry_budget_exhausted", 0) >= 7
+    assert budget.status()["denials"] >= 7
+
+
+def test_expired_deadline_rejected_at_dispatch(cluster):
+    """A call whose propagated budget dies in the server's queue (here: a
+    200 ms injected dispatch delay vs a 50 ms deadline) is rejected at
+    dispatch — DeadlineExceeded to the caller in bounded time, counted by
+    the server, handler never invoked."""
+    from jubatus_tpu.rpc import deadline
+    from jubatus_tpu.rpc.errors import DeadlineExceeded
+
+    servers, clients, _ = cluster
+    before = servers[0].driver.update_count
+    with faults.armed("rpc.dispatch.train:delay:0.2"):
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            with deadline.deadline_after(0.05):
+                clients[0].train([["pos", Datum({"x": 1.0})]])
+        assert time.monotonic() - t0 < 1.0  # bounded, not the 10 s timeout
+    # the server finishes its delayed dispatch, then REJECTS (no apply)
+    deadline_counter = None
+    deadline_end = time.time() + 5
+    while time.time() < deadline_end:
+        counters = servers[0].rpc.trace.counters()
+        if counters.get("rpc.deadline_rejected"):
+            deadline_counter = counters["rpc.deadline_rejected"]
+            break
+        time.sleep(0.05)
+    assert deadline_counter == 1
+    assert servers[0].driver.update_count == before  # never applied
+
+
+def test_quorum_degraded_round_recorded(cluster):
+    """One member's diffs unreachable: the round proceeds above quorum
+    but is stamped DEGRADED in the flight recorder and counted."""
+    servers, clients, _ = cluster
+    _train_disjoint(clients)
+    port1 = servers[1].args.rpc_port
+    with faults.armed(f"rpc.call.mix_get_diff.*:{port1}:error"):
+        assert clients[2].do_mix() is True
+    recs = servers[2].mixer.flight.snapshot()
+    degraded = [r for r in recs if r.get("degraded")]
+    assert degraded and degraded[-1]["contributors"] == 2
+    assert servers[2].rpc.trace.counters().get("mix.quorum_degraded") == 1
+
+
+def test_quorum_abort_below_fraction(cluster):
+    """Two of three members unreachable: 1/3 < the 0.5 quorum — the
+    round aborts instead of broadcasting a one-node fold as everyone's
+    new base."""
+    servers, clients, _ = cluster
+    _train_disjoint(clients)
+    p0, p1 = servers[0].args.rpc_port, servers[1].args.rpc_port
+    with faults.armed(f"rpc.call.mix_get_diff.*:{p0}:error",
+                      f"rpc.call.mix_get_diff.*:{p1}:error"):
+        assert clients[2].do_mix() is False
+    recs = servers[2].mixer.flight.snapshot()
+    assert any("quorum_not_met" in r.get("reason", "") for r in recs)
+    assert clients[2].do_mix() is True  # recovers once faults clear
+
+
+@pytest.mark.slow
+def test_chaos_idempotent_failover_and_breaker_lifecycle(monkeypatch):
+    """The ISSUE 3 acceptance chaos matrix: with IO errors injected on
+    one of three backends, (a) idempotent calls through the proxy
+    succeed >= 99% via breaker skip + failover, (b) the failing backend's
+    breaker OPENS during the fault window and RE-CLOSES after faults are
+    disarmed (half-open probe), and (c) effectful train calls are never
+    silently re-forwarded — the failed call surfaces and its examples
+    are applied zero times, not two."""
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+    # python transport end to end: the C++ relay plane would bypass the
+    # (python-level) fault injection sites after its first refresh tick
+    monkeypatch.setenv("JUBATUS_TPU_NATIVE_RPC", "0")
+    store = _Store()
+    servers = _cluster(3, store)
+    clients = [ClassifierClient("127.0.0.1", s.args.rpc_port, NAME)
+               for s in servers]
+    proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1",
+                            breaker_failures=3, breaker_cooldown=1.0),
+                  coord=MemoryCoordinator(store))
+    pport = proxy.start(0)
+    pc = ClassifierClient("127.0.0.1", pport, NAME)
+    try:
+        _train_disjoint(clients)
+        bad_port = servers[0].args.rpc_port
+        bad_key = f"('127.0.0.1', {bad_port})"
+        # (a)+(b) idempotent plane under faults
+        ok = 0
+        with faults.armed(f"rpc.call.get_labels.*:{bad_port}:error"):
+            for _ in range(100):
+                try:
+                    pc.get_labels()
+                    ok += 1
+                except RpcError:
+                    pass
+            snap_during = proxy.breakers.snapshot()
+        assert ok >= 99, f"only {ok}/100 idempotent calls survived"
+        assert snap_during.get(bad_key, {}).get("state") == "open"
+        assert proxy.rpc.trace.counters().get("proxy.breaker_open", 0) >= 1
+        # (b) faults disarmed: cooldown passes, a half-open probe
+        # re-admits the backend and its breaker closes again
+        deadline_end = time.time() + 10
+        while time.time() < deadline_end:
+            pc.get_labels()
+            if proxy.breakers.snapshot()[bad_key]["state"] == "closed":
+                break
+            time.sleep(0.2)
+        assert proxy.breakers.snapshot()[bad_key]["state"] == "closed"
+        # (c) effectful plane: a train forward that dies in transport
+        # SURFACES (no silent re-forward) and applies nothing anywhere.
+        # Faults target the proxy->backend hops only (one rule per
+        # backend port) — whichever replica the proxy picks fails once.
+        labels_before = pc.get_labels()
+        rules = [f"rpc.call.train.*:{s.args.rpc_port}:error@1"
+                 for s in servers]
+        with faults.armed(*rules):
+            with pytest.raises(RpcError):
+                pc.train([["pos", Datum({"x": 1.0})],
+                          ["pos", Datum({"x": 2.0})]])
+        labels_after = pc.get_labels()
+        assert labels_after == labels_before, "train was re-forwarded"
+    finally:
+        faults.disarm_all()
+        pc.close()
+        for c in clients:
+            c.close()
+        for s in servers:
+            s.stop()
+        proxy.stop()
+
+
+def _envelope_roundtrip(port: int) -> None:
+    """Drive one server through every envelope generation a peer might
+    send: legacy 4-element, traced 5-element, deadlined 6-element (with
+    real and nil trace) — all must round-trip."""
+    import socket as _socket
+
+    import msgpack
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.rpc import deadline
+
+    sock = _socket.create_connection(("127.0.0.1", port), timeout=10.0)
+    unp = msgpack.Unpacker(raw=False)
+
+    def send_frame(env):
+        sock.sendall(msgpack.packb(env, use_bin_type=True))
+        while True:
+            try:
+                return unp.unpack()
+            except msgpack.OutOfData:
+                data = sock.recv(65536)
+                assert data, "server closed on an envelope variant"
+                unp.feed(data)
+
+    # legacy 4-element (what every deployed msgpack-rpc client sends)
+    msg = send_frame([0, 7, "get_status", ["x"]])
+    assert msg[0] == 1 and msg[1] == 7 and msg[2] is None
+    # traced 5-element
+    msg = send_frame([0, 8, "get_status", ["x"], {"t": "abc", "s": "def"}])
+    assert msg[1] == 8 and msg[2] is None
+    # deadlined 6-element, nil trace
+    msg = send_frame([0, 9, "get_status", ["x"], None, 5.0])
+    assert msg[1] == 9 and msg[2] is None
+    # deadlined 6-element, real trace
+    msg = send_frame([0, 10, "get_status", ["x"], {"t": "abc", "s": "d"},
+                      2.5])
+    assert msg[1] == 10 and msg[2] is None
+    sock.close()
+    # the typed client across generations: plain, then deadline-bearing
+    c = RpcClient("127.0.0.1", port)
+    assert c.call("get_status", "x")
+    with deadline.deadline_after(5.0):
+        assert c.call("get_status", "x")
+    c.close()
+
+
+def _deadline_bound_check(srv, port: int) -> None:
+    """ISSUE 3 acceptance: a 50 ms deadline against a dispatch delayed
+    200 ms fails with DeadlineExceeded in bounded time (not the 10 s flat
+    timeout), and the server counts the dispatch-side rejection once its
+    delayed worker reaches the gate."""
+    from jubatus_tpu.rpc import deadline
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.rpc.errors import DeadlineExceeded
+
+    c = RpcClient("127.0.0.1", port)
+    with faults.armed("rpc.dispatch.get_status:delay:0.2"):
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            with deadline.deadline_after(0.05):
+                c.call("get_status", "x")
+        assert time.monotonic() - t0 < 1.0
+    c.close()
+    deadline_end = time.time() + 5
+    while time.time() < deadline_end:
+        if srv.trace.counters().get("rpc.deadline_rejected"):
+            break
+        time.sleep(0.05)
+    assert srv.trace.counters().get("rpc.deadline_rejected", 0) >= 1
+
+
+def test_envelope_compat_python_transport():
+    from jubatus_tpu.rpc.server import RpcServer
+
+    srv = RpcServer()
+    srv.register("get_status", lambda name: {"node": {"ok": 1}}, arity=1)
+    port = srv.serve_background(0, host="127.0.0.1")
+    try:
+        _envelope_roundtrip(port)
+        assert not srv.trace.counters().get("rpc.deadline_rejected")
+        _deadline_bound_check(srv, port)
+    finally:
+        srv.stop()
+
+
+def test_envelope_compat_native_transport():
+    from jubatus_tpu.rpc import native_server
+
+    if not native_server.available():
+        pytest.skip("native transport unavailable")
+    srv = native_server.NativeRpcServer()
+    srv.register("get_status", lambda name: {"node": {"ok": 1}}, arity=1)
+    port = srv.serve_background(0, host="127.0.0.1")
+    try:
+        _envelope_roundtrip(port)
+        assert not srv.trace.counters().get("rpc.deadline_rejected")
+        _deadline_bound_check(srv, port)
+    finally:
+        srv.stop()
+
+
 def test_armed_scopes_compose():
     """Nested/outer rules survive an inner scope's exit; empty arming
     never flips the hot-path flag."""

@@ -32,6 +32,19 @@ HOT_TIME_DIRS = (
     "jubatus_tpu/framework/",
 )
 
+#: request-plane directories where a bare ``except Exception`` (or a
+#: naked ``except:``) around RPC work silently flattens the typed error
+#: taxonomy (rpc/errors.py) — retryable-vs-fatal, breaker evidence, and
+#: deadline classification all die inside it. Catch the taxonomy
+#: (RpcError subclasses / is_retryable) instead; the rare genuinely-broad
+#: catch (teardown, never-raise-into-C++ shims, handler invocation
+#: boundaries) opts out per line with a ``# broad-ok`` pragma stating why.
+BROAD_EXCEPT_DIRS = (
+    "jubatus_tpu/rpc/",
+    "jubatus_tpu/server/",
+    "jubatus_tpu/framework/",
+)
+
 
 def iter_files(roots: List[str]) -> List[str]:
     out = []
@@ -64,6 +77,8 @@ def check_file(path: str) -> List[str]:
     posix = path.replace(os.sep, "/")
     hot_time = path.endswith(".py") and any(
         d in posix for d in HOT_TIME_DIRS)
+    broad_gate = path.endswith(".py") and any(
+        d in posix for d in BROAD_EXCEPT_DIRS)
     for i, line in enumerate(text.splitlines(), 1):
         if "\t" in line and not allow_tabs:
             problems.append(f"{path}:{i}: tab character")
@@ -77,6 +92,16 @@ def check_file(path: str) -> List[str]:
                 f"{path}:{i}: raw time.time() in a hot-path module (use "
                 "time.perf_counter/time.monotonic or a tracing span; "
                 "append '# wall-clock' for genuine timestamps)")
+        stripped = line.strip()
+        if broad_gate and "# broad-ok" not in line and (
+                stripped.startswith("except Exception")
+                or stripped == "except:"):
+            problems.append(
+                f"{path}:{i}: bare 'except Exception' in a request-plane "
+                "module (catch the typed taxonomy from rpc/errors.py — "
+                "RpcError subclasses, errors.is_retryable; append "
+                "'# broad-ok — <why>' where a broad catch is genuinely "
+                "required)")
     if path.endswith(".py") and "/jubatus_tpu/" in path.replace(os.sep, "/"):
         try:
             tree = ast.parse(text)
