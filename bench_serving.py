@@ -207,7 +207,8 @@ def _default_microbatch() -> int:
 
 def run(transport: str = "python", workload: str = "numeric",
         conf: dict = CONF, measure: float = MEASURE_SECONDS,
-        tag: str = "", microbatch: int = 0, native_ingest: bool = True) -> dict:
+        tag: str = "", microbatch: int = 0, native_ingest: bool = True,
+        forensics: bool = True) -> dict:
     from jubatus_tpu.server import EngineServer
     from jubatus_tpu.server.args import ServerArgs
 
@@ -227,6 +228,11 @@ def run(transport: str = "python", workload: str = "numeric",
                             listen_addr="127.0.0.1",
                             microbatch_max=microbatch
                             or _default_microbatch()))
+        # forensics=False: histograms stay on (the p50/p99 keys below need
+        # them) but the span store + slow log are disabled — the A/B for
+        # ISSUE 4's <2% overhead budget
+        if not forensics:
+            srv.rpc.trace.set_forensics(False)
         port = srv.start(0)
     finally:
         if prev is None:
@@ -357,6 +363,44 @@ def run(transport: str = "python", workload: str = "numeric",
         if nq:
             out[f"e2e_schema_query_flush_fraction_{suffix}"] = round(
                 ing.get("schema_query_flushes", 0) / nq, 3)
+    return out
+
+
+def run_tracing_overhead(transport: str = "python",
+                         measure: float = TEXT_MEASURE_SECONDS) -> dict:
+    """ISSUE 4 satellite: the forensics layer ships with its cost
+    measured. Adjacent A/B on the classify (query) plane — span store +
+    slow log ENABLED vs DISABLED (histograms on both sides, so the
+    steady-state p50/p99 keys come from the same machinery) — and the
+    p50 ratio of record, budgeted at <2% regression. One bench core
+    swings ~±10% run to run, so the ok-flag uses the MEDIAN-free single
+    adjacent pair plus slack only in the honest direction: a ratio a
+    hair over 1.02 on a noisy host is reported as-is."""
+    out: dict = {}
+    sides = {}
+    for tag, forensics in (("forensics_on", True), ("forensics_off", False)):
+        try:
+            r = run(transport, workload="classify", measure=measure,
+                    tag=tag, forensics=forensics)
+        except Exception as e:  # noqa: BLE001 — partial results beat none
+            out[f"e2e_{tag}_error"] = repr(e)[:200]
+            continue
+        out.update(r)
+        sides[tag] = r
+    p50_on = sides.get("forensics_on", {}).get(
+        "e2e_rpc_classify_p50_ms_forensics_on")
+    p50_off = sides.get("forensics_off", {}).get(
+        "e2e_rpc_classify_p50_ms_forensics_off")
+    if p50_on and p50_off:
+        ratio = p50_on / p50_off
+        out["e2e_tracing_overhead_p50_ratio"] = round(ratio, 4)
+        out["e2e_tracing_overhead_ok"] = bool(ratio <= 1.02)
+    p99_on = sides.get("forensics_on", {}).get(
+        "e2e_rpc_classify_p99_ms_forensics_on")
+    p99_off = sides.get("forensics_off", {}).get(
+        "e2e_rpc_classify_p99_ms_forensics_off")
+    if p99_on and p99_off:
+        out["e2e_tracing_overhead_p99_ratio"] = round(p99_on / p99_off, 4)
     return out
 
 
@@ -548,6 +592,12 @@ def collect(trials: int = 2) -> dict:
                        measure=TEXT_MEASURE_SECONDS))
     except Exception as e:  # noqa: BLE001
         out["e2e_mixed_error"] = repr(e)[:200]
+    # forensics overhead A/B (ISSUE 4): span store + slow log on vs off,
+    # p50 ratio of record with a <2% budget
+    try:
+        out.update(run_tracing_overhead(text_tr))
+    except Exception as e:  # noqa: BLE001
+        out["e2e_tracing_overhead_error"] = repr(e)[:200]
     # proxy tier: same numeric workload through the proxy hop. The
     # REPORTED keys stay best-of, but the ratio uses median-vs-median
     # over ADJACENT alternating (proxy, direct) pairs: the direct side

@@ -7,6 +7,7 @@
     jubactl -c status -t classifier -n c1 -z /shared [--all]
     jubactl -c metrics -t classifier -n c1 -z /shared
     jubactl -c breakers -t classifier -n c1 -z /shared
+    jubactl -c trace TRACE_ID -t classifier -n c1 -z /shared
 
 start/stop fan out to every jubavisor under /jubatus/supervisors,
 distributing N processes round-robin (N/visors each, remainder to the
@@ -18,9 +19,13 @@ member's raw histogram snapshot (get_metrics) and prints a MERGED cluster
 view — exact p50/p90/p99 across nodes via bucket-wise sums
 (utils/tracing.py merge_snapshots). ``breakers`` (also beyond the
 reference) scrapes every registered proxy's per-backend circuit breaker
-and retry-budget state (rpc/breaker.py). Server flags
-(-C/-T/-D/-X/-S/-I/...) are forwarded to visor-spawned processes
-(jubactl.cpp:90-110).
+and retry-budget state (rpc/breaker.py). ``trace TRACE_ID`` (ISSUE 4)
+scrapes every member's span store (``get_spans``) AND every registered
+proxy's (``get_proxy_spans``), stitches the parent/child edges into ONE
+cross-node span tree, and renders it with per-hop timings — the
+distributed answer to "where did this slow request spend its time?".
+Server flags (-C/-T/-D/-X/-S/-I/...) are forwarded to visor-spawned
+processes (jubactl.cpp:90-110).
 """
 
 from __future__ import annotations
@@ -39,7 +44,11 @@ def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="jubactl")
     p.add_argument("-c", "--cmd", required=True,
                    choices=["start", "stop", "save", "load", "status",
-                            "metrics", "breakers"])
+                            "metrics", "breakers", "trace"])
+    p.add_argument("trace_id", nargs="?", default="",
+                   help="[trace] trace id to assemble (from a slow-log "
+                        "record, a /metrics exemplar, or "
+                        "trace.*.last_trace_id in get_status)")
     p.add_argument("--all", action="store_true",
                    help="[status] also scrape every member's get_status")
     p.add_argument("-s", "--server", default="",
@@ -241,6 +250,111 @@ def show_breakers(coord: Coordinator, engine: str, name: str) -> int:
     return rc
 
 
+def _proxies(coord: Coordinator) -> List[NodeInfo]:
+    out = []
+    for child in coord.list(membership.PROXY_BASE):
+        try:
+            out.append(NodeInfo.from_name(child))
+        except (ValueError, IndexError):
+            continue
+    return out
+
+
+def collect_trace_spans(coord: Coordinator, engine: str, name: str,
+                        trace_id: str) -> List[Dict[str, Any]]:
+    """Scrape every member's span store (``get_spans``) and every
+    registered proxy's own (``get_proxy_spans``) for one trace; each
+    span record is annotated with the node it came from."""
+    spans: List[Dict[str, Any]] = []
+    for node, method in (
+            [(n, "get_spans")
+             for n in membership.get_all_nodes(coord, engine, name)]
+            + [(pxy, "get_proxy_spans") for pxy in _proxies(coord)]):
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                per_node = c.call(method, name, trace_id)
+        except Exception as e:  # noqa: BLE001 — partial trace beats none
+            print(f"  <{node.name}: {method} failed: {e}>", file=sys.stderr)
+            continue
+        for node_name, recs in (per_node or {}).items():
+            for rec in recs or []:
+                rec = dict(rec)
+                rec.setdefault("node", node_name)
+                spans.append(rec)
+    return spans
+
+
+def assemble_trace(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Stitch span records (possibly from many nodes) into a forest:
+    each returned root carries nested ``children`` lists. A span whose
+    parent was not captured anywhere (the client's side of the story, or
+    a ring-evicted hop) becomes a root — partial traces still render."""
+    by_id: Dict[str, Dict[str, Any]] = {}
+    for rec in spans:
+        node = dict(rec)
+        node["children"] = []
+        by_id[str(node.get("span_id", ""))] = node
+    roots: List[Dict[str, Any]] = []
+    for node in by_id.values():
+        parent = by_id.get(str(node.get("parent_id", "")))
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    def _start(n: Dict[str, Any]) -> float:
+        return float(n.get("ts", 0.0))
+    for node in by_id.values():
+        node["children"].sort(key=_start)
+    roots.sort(key=_start)
+    return roots
+
+
+def render_trace(trace_id: str, roots: List[Dict[str, Any]],
+                 out=None) -> None:
+    """Print one assembled span tree with per-hop timings: duration,
+    owning node, and offset from the trace's first captured span."""
+    out = out or sys.stdout
+    t0 = min((float(r.get("ts", 0.0)) for r in roots), default=0.0)
+    count = [0]
+
+    def _walk(node: Dict[str, Any], indent: str, last: bool) -> None:
+        count[0] += 1
+        branch = "└─ " if last else "├─ "
+        off = (float(node.get("ts", 0.0)) - t0) * 1e3
+        print(f"{indent}{branch}{node.get('name', '?'):<24} "
+              f"{float(node.get('duration_ms', 0.0)):>9.3f} ms  "
+              f"@{node.get('node', '?')}  [t+{off:.1f}ms]", file=out)
+        child_indent = indent + ("   " if last else "│  ")
+        kids = node.get("children", [])
+        for i, child in enumerate(kids):
+            _walk(child, child_indent, i == len(kids) - 1)
+
+    for i, root in enumerate(roots):
+        _walk(root, "", i == len(roots) - 1)
+    print(f"trace {trace_id}: {count[0]} span(s), "
+          f"{len(roots)} root(s)", file=out)
+
+
+def show_trace(coord: Coordinator, engine: str, name: str,
+               trace_id: str) -> int:
+    """ISSUE 4 acceptance: assemble + render ONE cross-node span tree
+    for a trace id, proxy and backend hops included."""
+    if not trace_id:
+        print("trace needs a TRACE_ID (jubactl -c trace TRACE_ID ...)",
+              file=sys.stderr)
+        return 1
+    spans = collect_trace_spans(coord, engine, name, trace_id)
+    if not spans:
+        print(f"no spans retained for trace {trace_id} "
+              "(ring-evicted, or the id never existed)", file=sys.stderr)
+        return -1
+    nodes = {s.get("node", "?") for s in spans}
+    print(f"{engine}/{name}: trace {trace_id} across "
+          f"{len(nodes)} node(s): {', '.join(sorted(nodes))}")
+    render_trace(trace_id, assemble_trace(spans))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ns = _parser().parse_args(argv)
     spec = resolve_coordinator(ns.coordinator)
@@ -256,6 +370,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return show_metrics(coord, ns.type, ns.name)
         if ns.cmd == "breakers":
             return show_breakers(coord, ns.type, ns.name)
+        if ns.cmd == "trace":
+            return show_trace(coord, ns.type, ns.name, ns.trace_id)
         if ns.cmd in ("start", "stop"):
             server = ns.server or ns.type
             name = f"{server}/{ns.name}"

@@ -153,17 +153,34 @@ def dump_file(path: str, *, summary: bool = False,
     return out
 
 
-def dump_mix_history(target: str, name: str = "",
-                     timeout: float = 10.0) -> list:
-    """Pull a live server's mix-round flight records (``get_mix_history``
-    RPC — the bounded ring framework/mixer.py keeps per mixer)."""
+def _live_call(target: str, method: str, flag: str, name: str,
+               *extra: Any, timeout: float = 10.0) -> Any:
+    """One RPC against a live HOST:PORT target (the --mix-history /
+    --slow-log live-dump paths share the parse + call shape)."""
     from jubatus_tpu.rpc.client import RpcClient
 
     host, _, port = target.rpartition(":")
     if not host or not port.isdigit():
-        raise ValueError(f"--mix-history wants HOST:PORT, got {target!r}")
+        raise ValueError(f"{flag} wants HOST:PORT, got {target!r}")
     with RpcClient(host, int(port), timeout=timeout) as c:
-        return _jsonable(c.call("get_mix_history", name), False)
+        return _jsonable(c.call(method, name, *extra), False)
+
+
+def dump_mix_history(target: str, name: str = "",
+                     timeout: float = 10.0) -> list:
+    """Pull a live server's mix-round flight records (``get_mix_history``
+    RPC — the bounded ring framework/mixer.py keeps per mixer)."""
+    return _live_call(target, "get_mix_history", "--mix-history", name,
+                      timeout=timeout)
+
+
+def dump_slow_log(target: str, name: str = "",
+                  timeout: float = 10.0) -> dict:
+    """Pull a live server's (or proxy's) slow-request ring — the
+    tail-based capture of utils/slowlog.py, keyed by node name. Against
+    a proxy the reply also folds in every backend's ring."""
+    return _live_call(target, "get_slow_log", "--slow-log", name,
+                      timeout=timeout)
 
 
 def main(argv=None) -> int:
@@ -179,16 +196,24 @@ def main(argv=None) -> int:
     p.add_argument("--mix-history", metavar="HOST:PORT",
                    help="dump the mix flight recorder of a LIVE server "
                         "(get_mix_history RPC) instead of reading a file")
+    p.add_argument("--slow-log", metavar="HOST:PORT", dest="slow_log",
+                   help="dump the slow-request ring of a LIVE server or "
+                        "proxy (get_slow_log RPC): tail-based capture of "
+                        "requests at/above the --slowlog-quantile of "
+                        "their own latency histogram")
     p.add_argument("-n", "--name", default="",
-                   help="[--mix-history] cluster name to pass the RPC")
+                   help="[--mix-history/--slow-log] cluster name to pass "
+                        "the RPC")
     ns = p.parse_args(argv)
-    if bool(ns.input) == bool(ns.mix_history):
-        print("exactly one of -i FILE or --mix-history HOST:PORT required",
-              file=sys.stderr)
+    if sum(map(bool, (ns.input, ns.mix_history, ns.slow_log))) != 1:
+        print("exactly one of -i FILE, --mix-history HOST:PORT, or "
+              "--slow-log HOST:PORT required", file=sys.stderr)
         return 1
     try:
         if ns.mix_history:
             out: Any = dump_mix_history(ns.mix_history, ns.name)
+        elif ns.slow_log:
+            out = dump_slow_log(ns.slow_log, ns.name)
         else:
             out = dump_file(ns.input, summary=ns.summary,
                             skip_user_data=ns.no_user_data)
@@ -196,7 +221,7 @@ def main(argv=None) -> int:
         print(str(e), file=sys.stderr)
         return 1
     except Exception as e:  # noqa: BLE001 — RPC failures print, not raise
-        print(f"mix-history fetch failed: {e}", file=sys.stderr)
+        print(f"live dump failed: {e}", file=sys.stderr)
         return 1
     json.dump(out, sys.stdout, indent=2)
     print()

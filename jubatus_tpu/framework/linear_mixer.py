@@ -392,6 +392,11 @@ class RpcLinearMixer:
         if hasattr(self.comm, "breakers"):
             self.comm.breakers.registry = registry
 
+    @property
+    def trace(self):
+        """The owning server's tracing registry (mix.phase.* spans)."""
+        return self._scheduler.trace
+
     def _count(self, name: str, n: int = 1) -> None:
         """Bump a counter in the owning server's registry."""
         self._scheduler.trace.count(name, n)
@@ -436,76 +441,85 @@ class RpcLinearMixer:
     def _run_as_master(self, members: Sequence[NodeInfo]) -> Optional[Dict[str, Any]]:
         t0 = time.monotonic()
         phases: Dict[str, Any] = {}
+        # Each phase is a registry span (mix.phase.*): the flight record
+        # keeps its per-round ms, the histograms accumulate the quantile
+        # view, and — because the scheduler roots a trace per round — the
+        # spans assemble under the round's trace_id in jubactl -c trace.
         # phase 1: schema alignment (classifier label vocab, stat keys) —
         # skipped entirely for engines that don't define a row schema
-        schemas = self.comm.get_schemas() if self._has_schema() else []
-        schema_union: List[str] = sorted(
-            set().union(*(set(s) for s in schemas))
-        ) if schemas else []
-        schema_union = [
-            s.decode() if isinstance(s, bytes) else s for s in schema_union
-        ]
-        if schema_union:
-            self.comm.sync_schema(schema_union)
-        phases["schema_ms"] = round((time.monotonic() - t0) * 1e3, 2)
+        with self.trace.span("mix.phase.schema") as sp:
+            schemas = self.comm.get_schemas() if self._has_schema() else []
+            schema_union: List[str] = sorted(
+                set().union(*(set(s) for s in schemas))
+            ) if schemas else []
+            schema_union = [
+                s.decode() if isinstance(s, bytes) else s
+                for s in schema_union
+            ]
+            if schema_union:
+                self.comm.sync_schema(schema_union)
+        phases["schema_ms"] = round(sp.seconds * 1e3, 2)
         # phase 2: pull row-aligned diffs
-        t1 = time.monotonic()
-        replies = self.comm.get_diff()
-        if not replies:
-            log.error("mix aborted: all get_diffs failed")
-            self.flight.record("rpc", ok=False,
-                               reason="all_get_diffs_failed",
-                               members=len(members))
-            return None
-        payloads = [unpack_mix(p) for _, p in replies]
-        payloads = [p for p in payloads if p.get("protocol") == PROTOCOL_VERSION]
-        if not payloads:
-            self.flight.record("rpc", ok=False,
-                               reason="no_protocol_payloads",
-                               members=len(members))
-            return None
-        # quorum gate: proceeding on a sliver of the cluster would
-        # broadcast a near-empty fold as everyone's new base version
-        if len(payloads) < self.quorum_fraction * len(members):
-            log.error("mix aborted: quorum not met (%d/%d diffs, quorum "
-                      "%.0f%%)", len(payloads), len(members),
-                      self.quorum_fraction * 100)
-            self._count("mix.quorum_aborted")
-            self.flight.record(
-                "rpc", ok=False,
-                reason=f"quorum_not_met: {len(payloads)}/{len(members)}",
-                members=len(members))
-            return None
-        degraded = len(payloads) < len(members)
-        if degraded:
-            self._count("mix.quorum_degraded")
-        phases["get_diff_ms"] = round((time.monotonic() - t1) * 1e3, 2)
+        with self.trace.span("mix.phase.get_diff") as sp:
+            replies = self.comm.get_diff()
+            if not replies:
+                log.error("mix aborted: all get_diffs failed")
+                self.flight.record("rpc", ok=False,
+                                   reason="all_get_diffs_failed",
+                                   members=len(members))
+                return None
+            payloads = [unpack_mix(p) for _, p in replies]
+            payloads = [p for p in payloads
+                        if p.get("protocol") == PROTOCOL_VERSION]
+            if not payloads:
+                self.flight.record("rpc", ok=False,
+                                   reason="no_protocol_payloads",
+                                   members=len(members))
+                return None
+            # quorum gate: proceeding on a sliver of the cluster would
+            # broadcast a near-empty fold as everyone's new base version
+            if len(payloads) < self.quorum_fraction * len(members):
+                log.error("mix aborted: quorum not met (%d/%d diffs, quorum "
+                          "%.0f%%)", len(payloads), len(members),
+                          self.quorum_fraction * 100)
+                self._count("mix.quorum_aborted")
+                self.flight.record(
+                    "rpc", ok=False,
+                    reason=f"quorum_not_met: {len(payloads)}/{len(members)}",
+                    members=len(members))
+                return None
+            degraded = len(payloads) < len(members)
+            if degraded:
+                self._count("mix.quorum_degraded")
+        phases["get_diff_ms"] = round(sp.seconds * 1e3, 2)
         # phase 3: pairwise fold per mixable (linear_mixer.cpp:481-499)
-        t2 = time.monotonic()
-        mixables = self.driver.get_mixables()
-        totals: Dict[str, Any] = {}
-        for name, mixable in mixables.items():
-            diffs = [p["diffs"][name] for p in payloads if name in p["diffs"]]
-            if not diffs:
-                continue
-            custom_mix = getattr(mixable, "mix", None)
-            if custom_mix is not None:
-                totals[name] = functools.reduce(custom_mix, diffs)
-            else:
-                totals[name] = tree_sum(diffs)
-        # the round's base = the most advanced contributor; anyone behind it
-        # cannot be caught up by deltas and must recover a full model
-        base_version = max(
-            (int(p.get("version", 0)) for p in payloads), default=0
-        )
-        packed = pack_mix(
-            {"protocol": PROTOCOL_VERSION, "schema": schema_union,
-             "base_version": base_version, "diffs": totals}
-        )
-        phases["fold_ms"] = round((time.monotonic() - t2) * 1e3, 2)
-        t3 = time.monotonic()
-        acks = self.comm.put_diff(packed)
-        phases["put_diff_ms"] = round((time.monotonic() - t3) * 1e3, 2)
+        with self.trace.span("mix.phase.fold") as sp:
+            mixables = self.driver.get_mixables()
+            totals: Dict[str, Any] = {}
+            for name, mixable in mixables.items():
+                diffs = [p["diffs"][name] for p in payloads
+                         if name in p["diffs"]]
+                if not diffs:
+                    continue
+                custom_mix = getattr(mixable, "mix", None)
+                if custom_mix is not None:
+                    totals[name] = functools.reduce(custom_mix, diffs)
+                else:
+                    totals[name] = tree_sum(diffs)
+            # the round's base = the most advanced contributor; anyone
+            # behind it cannot be caught up by deltas and must recover a
+            # full model
+            base_version = max(
+                (int(p.get("version", 0)) for p in payloads), default=0
+            )
+            packed = pack_mix(
+                {"protocol": PROTOCOL_VERSION, "schema": schema_union,
+                 "base_version": base_version, "diffs": totals}
+            )
+        phases["fold_ms"] = round(sp.seconds * 1e3, 2)
+        with self.trace.span("mix.phase.put_diff") as sp:
+            acks = self.comm.put_diff(packed)
+        phases["put_diff_ms"] = round(sp.seconds * 1e3, 2)
         # active-list transitions (linear_mixer.cpp:658-681): master demotes
         # failures; successes promote themselves via on_active
         for member in members:

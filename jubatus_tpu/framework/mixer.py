@@ -15,6 +15,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from jubatus_tpu.utils import tracing
 from jubatus_tpu.utils.tracing import Registry, default_registry
 
 
@@ -121,22 +122,31 @@ class IntervalMixer:
     def _run_mix(self) -> Any:
         """Execute one mix round WITHOUT holding the condition lock: updated()
         callers (the train hot path) must never block behind a collective.
-        _mix_serialize keeps concurrent mix_now/loop rounds from overlapping."""
-        with self._mix_serialize, self.trace.span("mix.round"):
+        _mix_serialize keeps concurrent mix_now/loop rounds from overlapping.
+
+        Every round roots a FRESH trace context: the ``mix.round`` span
+        (and the master's phase spans + the members' mix_* dispatch spans,
+        which inherit the context through the RPC fan-out) land in the
+        span store under one trace_id, stamped into the flight record —
+        ``jubactl -c trace <id>`` then shows a mix round's cross-node
+        anatomy next to the RPC traffic it contended with."""
+        ctx = tracing.new_root()
+        with self._mix_serialize, tracing.use_trace(ctx):
             with self._cond:
                 self._counter = 0
-            start = time.monotonic()
             try:
-                result = self._mix_fn()
+                with self.trace.span("mix.round") as sp:
+                    result = self._mix_fn()
             except Exception as e:  # broad-ok — mix_fn is arbitrary
                 self.trace.count("mix.round.errors")
                 self.flight.record(
                     "error", ok=False,
                     reason=f"{type(e).__name__}: {e}",
-                    duration_ms=(time.monotonic() - start) * 1e3)
+                    duration_ms=sp.seconds * 1e3,
+                    trace_id=ctx.trace_id)
                 raise
             with self._cond:
-                self.last_mix_duration = time.monotonic() - start
+                self.last_mix_duration = sp.seconds
                 self.mix_count += 1
                 self._last_mix_time = time.monotonic()
             if isinstance(result, dict):
@@ -146,11 +156,13 @@ class IntervalMixer:
                 mode = extra.pop("mode", "mix")
                 phases = extra.pop("phases", None)
                 rid = extra.pop("round_id", "")
-                for k in ("ok", "reason", "duration_ms", "ts", "node", "seq"):
+                for k in ("ok", "reason", "duration_ms", "ts", "node",
+                          "seq", "trace_id"):
                     extra.pop(k, None)  # reserved record fields
                 self.flight.record(
                     mode, ok=True, round_id=rid, phases=phases,
-                    duration_ms=self.last_mix_duration * 1e3, **extra)
+                    duration_ms=self.last_mix_duration * 1e3,
+                    trace_id=ctx.trace_id, **extra)
             return result
 
     # -- background loop ------------------------------------------------------

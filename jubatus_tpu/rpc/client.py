@@ -20,6 +20,7 @@ whose circuit is open instead of burning a timeout on them every round.
 
 from __future__ import annotations
 
+import contextlib
 import socket
 import threading
 import time
@@ -176,16 +177,21 @@ class RpcClient:
         # ({"t": trace_id, "s": span_id}), the remaining deadline budget
         # as an OPTIONAL 6th (seconds, float). Either is attached only
         # when this thread carries one; plain client calls stay
-        # wire-identical to msgpack-rpc.
+        # wire-identical to msgpack-rpc. The wire element carries a fresh
+        # CHILD span id — the call itself is a span (rpc.client.<method>
+        # in this registry, so the forensics tree shows the hop's wire+
+        # queue time between the caller's dispatch and the callee's)
         ctx = tracing.current_trace()
+        child = tracing.child_of(ctx) if ctx is not None else None
         eff_timeout = self._effective_timeout(method)
         dl = deadlines.to_wire()
         with self._lock:
             self._msgid = (self._msgid + 1) & 0xFFFFFFFF
             msgid = self._msgid
             env: list = [REQUEST, msgid, method, list(args)]
-            if ctx is not None or dl is not None:
-                env.append(tracing.to_wire(ctx) if ctx is not None else None)
+            if child is not None or dl is not None:
+                env.append(tracing.to_wire(child)
+                           if child is not None else None)
             if dl is not None:
                 env.append(dl)
             # surrogateescape: params a proxy forwards may hold surrogate-
@@ -197,9 +203,14 @@ class RpcClient:
             )
             sock = self._connect()
             try:
-                sock.settimeout(eff_timeout)
-                sock.sendall(payload)
-                msg = self._read_response(sock, msgid)
+                with contextlib.ExitStack() as stk:
+                    if child is not None:
+                        stk.enter_context(tracing.use_trace(child))
+                        stk.enter_context(
+                            self._registry.span(f"rpc.client.{method}"))
+                    sock.settimeout(eff_timeout)
+                    sock.sendall(payload)
+                    msg = self._read_response(sock, msgid)
             except socket.timeout as e:
                 self.close()
                 raise self._timeout_error(method) from e
@@ -225,6 +236,7 @@ class RpcClient:
         if faults.is_armed():
             faults.fire(f"rpc.call.{method}.{self.host}:{self.port}")
         ctx = tracing.current_trace()
+        child = tracing.child_of(ctx) if ctx is not None else None
         eff_timeout = self._effective_timeout(method)
         dl = deadlines.to_wire()
         with self._lock:
@@ -241,32 +253,37 @@ class RpcClient:
             # 6-element with trace + deadline (trace packs nil when only
             # a deadline is active — the backend splits both off the
             # params span)
-            n_extra = 2 if dl is not None else (1 if ctx is not None else 0)
+            n_extra = 2 if dl is not None else (1 if child is not None else 0)
             env0 = bytes([0x94 + n_extra]) + b"\x00"
             head = (env0 + msgpack.packb(msgid)
                     + b"\xd9" + bytes([len(mb)]) + mb)
             bufs = [head, raw_params]
             if n_extra >= 1:
-                bufs.append(msgpack.packb(tracing.to_wire(ctx))
-                            if ctx is not None else b"\xc0")
+                bufs.append(msgpack.packb(tracing.to_wire(child))
+                            if child is not None else b"\xc0")
             if n_extra == 2:
                 bufs.append(msgpack.packb(float(dl)))
             sock = self._connect()
             try:
-                sock.settimeout(eff_timeout)
-                # scatter-gather: no head+params concat copy of a possibly
-                # multi-megabyte span (sendmsg may write short — finish
-                # with sendall on each remainder)
-                sent = sock.sendmsg(bufs)
-                if sent < sum(len(b) for b in bufs):
-                    off = sent
-                    for b in bufs:
-                        if off >= len(b):
-                            off -= len(b)
-                            continue
-                        sock.sendall(memoryview(b)[off:])
-                        off = 0
-                frame = self._read_raw_response(sock, msgid, eff_timeout)
+                with contextlib.ExitStack() as stk:
+                    if child is not None:
+                        stk.enter_context(tracing.use_trace(child))
+                        stk.enter_context(
+                            self._registry.span(f"rpc.client.{method}"))
+                    sock.settimeout(eff_timeout)
+                    # scatter-gather: no head+params concat copy of a
+                    # possibly multi-megabyte span (sendmsg may write
+                    # short — finish with sendall on each remainder)
+                    sent = sock.sendmsg(bufs)
+                    if sent < sum(len(b) for b in bufs):
+                        off = sent
+                        for b in bufs:
+                            if off >= len(b):
+                                off -= len(b)
+                                continue
+                            sock.sendall(memoryview(b)[off:])
+                            off = 0
+                    frame = self._read_raw_response(sock, msgid, eff_timeout)
             except socket.timeout as e:
                 self.close()
                 raise self._timeout_error(method) from e
@@ -396,9 +413,16 @@ class RpcMClient:
     def _fan_out(self, method: str, args: Sequence[Any]):
         results: List[Tuple[Tuple[str, int], Any]] = []
         errors: List[HostError] = []
+        # the fan-out hops threads: carry the caller's trace context AND
+        # deadline into the executor so every per-host call ships the
+        # same trace_id (a mix round's get_diff spans assemble under the
+        # round's trace) and derives its timeout from the shared budget
+        ctx = tracing.current_trace()
+        dl = deadlines.current()
 
         def one(hp: Tuple[str, int]):
-            return self._client(hp).call(method, *args)
+            with tracing.use_trace(ctx), deadlines.use(dl):
+                return self._client(hp).call(method, *args)
 
         futs = {}
         for hp in self.hosts:

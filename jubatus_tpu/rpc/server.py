@@ -360,7 +360,11 @@ class RpcServer:
         #: power-of-2-numbered bulk ones; an every-request scan measured a
         #: ~3x e2e train throughput hit for genuinely-legacy-looking
         #: pipelined bulk traffic.
-        conn_state = {"legacy": False}
+        try:
+            peer = "%s:%s" % conn.getpeername()[:2]
+        except (OSError, TypeError):
+            peer = ""
+        conn_state = {"legacy": False, "peer": peer}
         scanning = self.wire_detect and not self.legacy_wire
         nreq = 0
         try:
@@ -421,7 +425,10 @@ class RpcServer:
         # adopt the caller's trace context (or root a fresh one) AND its
         # deadline for the duration of the dispatch; restore after —
         # pool threads are reused
-        prev = tracing.swap_trace(tracing.from_wire(trace))
+        ctx = tracing.from_wire(trace)
+        if conn_state is not None:
+            ctx.peer = conn_state.get("peer", "")
+        prev = tracing.swap_trace(ctx)
         prev_dl = deadlines.swap(deadlines.adopt_wire(dl))
         try:
             error, result = self._execute_fast(method, raw_params, conn_state)
@@ -444,10 +451,9 @@ class RpcServer:
         ``modern_only`` (the proxy's verbatim relays) are skipped for
         legacy-era connections — their spans must be decoded and
         re-encoded modern, not forwarded as-is. The trace span is recorded
-        here only when the fast path served the request — fallbacks are
-        counted once, by _invoke's span."""
-        import time as _time
-
+        here only when the fast path served the request — a fallback
+        cancels the span handle so the request is counted once, by
+        _invoke's span."""
         fn = self._raw_methods[method]
         if conn_state is not None and conn_state.get("legacy") and \
                 getattr(fn, "modern_only", False):
@@ -455,21 +461,19 @@ class RpcServer:
                                      strict_map_key=False, use_list=True,
                                      unicode_errors="surrogateescape")
             return self._execute(method, params)
-        t0 = _time.perf_counter()
-        try:
-            if faults.is_armed():
-                faults.fire(f"rpc.dispatch.{method}")
-            self._check_deadline(method)
-            result = fn(raw_params)
+        with self.trace.span(f"rpc.{method}") as sp:
+            try:
+                if faults.is_armed():
+                    faults.fire(f"rpc.dispatch.{method}")
+                self._check_deadline(method)
+                result = fn(raw_params)
+            except Exception as e:  # broad-ok — every failure must answer
+                log.debug("rpc raw method %s raised", method, exc_info=True)
+                self.trace.count(f"rpc.{method}.errors")
+                return error_to_wire(e), None
             if result is not RAW_FALLBACK:
-                self.trace.record(f"rpc.{method}",
-                                  _time.perf_counter() - t0)
                 return None, result
-        except Exception as e:  # broad-ok — every failure must answer
-            log.debug("rpc raw method %s raised", method, exc_info=True)
-            self.trace.record(f"rpc.{method}", _time.perf_counter() - t0)
-            self.trace.count(f"rpc.{method}.errors")
-            return error_to_wire(e), None
+            sp.cancel()
         params = msgpack.unpackb(raw_params, raw=False, strict_map_key=False,
                                  use_list=True,
                                  unicode_errors="surrogateescape")
@@ -497,7 +501,10 @@ class RpcServer:
     def _dispatch(self, conn, wlock, msgid, method, params,
                   conn_state: Optional[dict] = None,
                   trace: Any = None, dl: Any = None) -> None:
-        prev = tracing.swap_trace(tracing.from_wire(trace))
+        ctx = tracing.from_wire(trace)
+        if conn_state is not None:
+            ctx.peer = conn_state.get("peer", "")
+        prev = tracing.swap_trace(ctx)
         prev_dl = deadlines.swap(deadlines.adopt_wire(dl))
         try:
             error, result = self._execute(method, params)

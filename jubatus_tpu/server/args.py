@@ -73,6 +73,18 @@ class ServerArgs:
     #: Prometheus /metrics + /healthz HTTP port (utils/metrics_http.py):
     #: -1 = off (default), 0 = ephemeral (actual port in get_status)
     metrics_port: int = -1
+    #: --slowlog-*: tail-based slow-request capture (utils/slowlog.py) —
+    #: an RPC at/above this quantile of its OWN span histogram lands in
+    #: a bounded ring (queryable: get_slow_log RPC, jubadump --slow-log)
+    #: and stamps a Prometheus exemplar on its histogram bucket.
+    #: capacity 0 disables capture; no capture below min_count samples.
+    slowlog_capacity: int = 256
+    slowlog_quantile: float = 0.99
+    slowlog_min_count: int = 64
+    #: runtime telemetry sampler period (utils/runtime_telemetry.py):
+    #: RSS/FDs/threads/GC + JAX compile+cache+device-memory signals into
+    #: get_status (runtime.*), /metrics, /healthz; 0 disables the thread
+    telemetry_interval: float = 10.0
 
     @property
     def is_standalone(self) -> bool:
@@ -177,6 +189,23 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", type=int, default=-1,
                    help="serve Prometheus /metrics + /healthz on this "
                         "HTTP port (0 = ephemeral; default off)")
+    p.add_argument("--slowlog-capacity", type=int, default=256,
+                   help="slow-request ring size (tail-based capture of "
+                        "RPCs at/above --slowlog-quantile of their own "
+                        "latency histogram; 0 disables)")
+    p.add_argument("--slowlog-quantile", type=float, default=0.99,
+                   help="per-span histogram quantile at/above which a "
+                        "request is captured in the slow log (and "
+                        "exemplar-stamped on /metrics)")
+    p.add_argument("--slowlog-min-count", type=int, default=64,
+                   help="samples a span needs before slow-log "
+                        "thresholding starts (early on, everything "
+                        "is 'p99')")
+    p.add_argument("--telemetry-interval", type=float, default=10.0,
+                   help="runtime telemetry sampling period in seconds "
+                        "(RSS/FDs/threads/GC + JAX compile/cache/device-"
+                        "memory into get_status, /metrics, /healthz; "
+                        "0 disables the sampler thread)")
     return p
 
 
@@ -195,6 +224,12 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
         raise SystemExit("--rpc-port out of range")
     if args.metrics_port > 65535:
         raise SystemExit("--metrics-port out of range")
+    if args.slowlog_capacity < 0:
+        raise SystemExit("--slowlog-capacity must be >= 0")
+    if not 0.0 < args.slowlog_quantile <= 1.0:
+        raise SystemExit("--slowlog-quantile must be in (0, 1]")
+    if args.telemetry_interval < 0:
+        raise SystemExit("--telemetry-interval must be >= 0")
     if not args.is_standalone and not args.name:
         raise SystemExit("distributed mode (-z) requires --name")
     return args

@@ -71,6 +71,17 @@ class EngineServer:
             timeout=self.args.timeout,
             legacy_wire=getattr(self.args, "legacy_wire", False),
             wire_detect=not getattr(self.args, "modern_wire", False))
+        # forensics plane (ISSUE 4): slow-request ring tuning off the
+        # --slowlog-* flags, and the runtime telemetry sampler thread
+        self.rpc.trace.slowlog.configure(
+            capacity=getattr(self.args, "slowlog_capacity", 256),
+            quantile=getattr(self.args, "slowlog_quantile", 0.99),
+            min_count=getattr(self.args, "slowlog_min_count", 64))
+        from jubatus_tpu.utils.runtime_telemetry import RuntimeTelemetry
+
+        self.telemetry = RuntimeTelemetry(
+            self.rpc.trace,
+            interval_sec=getattr(self.args, "telemetry_interval", 10.0))
         self._stop_event = threading.Event()
         self._stop_once = threading.Lock()  # first stop() wins; rest no-op
         #: Prometheus /metrics + /healthz endpoint (--metrics-port >= 0)
@@ -256,6 +267,19 @@ class EngineServer:
         node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
         return {node.name: self.rpc.trace.snapshot()}
 
+    def get_spans(self, _name: str, trace_id: str) -> Dict[str, Any]:
+        """Span records of one trace from THIS node's span store, keyed
+        like get_status — the per-node half of ``jubactl -c trace``
+        (the proxy broadcasts this and merges the maps)."""
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        return {node.name: self.rpc.trace.get_spans(str(trace_id))}
+
+    def get_slow_log(self, _name: str = "") -> Dict[str, Any]:
+        """This node's slow-request ring (tail-based capture; see
+        utils/slowlog.py), keyed like get_status."""
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        return {node.name: self.rpc.trace.slowlog.snapshot()}
+
     def _health(self) -> Dict[str, Any]:
         """Liveness document for /healthz (utils/metrics_http.py)."""
         doc: Dict[str, Any] = {
@@ -267,6 +291,12 @@ class EngineServer:
         }
         if self.mixer is not None:
             doc["mix_count"] = getattr(self.mixer, "mix_count", 0)
+        # runtime telemetry summary (full key set lives in get_status)
+        rt = self.telemetry.status()
+        for k in ("rss_bytes", "open_fds", "threads",
+                  "jax_compile_count", "jax_compile_ms", "slowlog_depth"):
+            if k in rt:
+                doc[k] = rt[k]
         return doc
 
     def get_status(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
@@ -310,6 +340,12 @@ class EngineServer:
         # span histograms + counters (SURVEY §5: tracing the reference
         # never had) — this server's own registry, not the process default
         st.update(self.rpc.trace.trace_status())
+        # runtime telemetry sample (RSS, FDs, GC, JAX compile/cache/device
+        # memory) + slow-log ring health (utils/runtime_telemetry.py)
+        st.update({f"runtime.{k}": v
+                   for k, v in self.telemetry.status().items()})
+        st.update({f"slowlog.{k}": v
+                   for k, v in self.rpc.trace.slowlog.stats().items()})
         # process-wide counters (zk session events, ...) live in the
         # default registry; surface them without clobbering our own
         from jubatus_tpu.utils import tracing as _tracing
@@ -334,6 +370,7 @@ class EngineServer:
             host=self.args.bind_host,
         )
         self.args.rpc_port = actual
+        self.telemetry.start()
         if getattr(self.args, "metrics_port", -1) >= 0:
             from jubatus_tpu.utils.metrics_http import MetricsServer
 
@@ -431,6 +468,7 @@ class EngineServer:
                 (self.coord.close if self.coord is not None else None),
                 self.rpc.stop,
                 (self.metrics.stop if self.metrics is not None else None),
+                self.telemetry.stop,
                 self._close_peers,
             ):
                 if step is None:

@@ -100,6 +100,13 @@ class ProxyArgs:
     #: retry budget: failover retries per first-attempt forward (10% =
     #: the gRPC/Finagle convention; see rpc/retry.py)
     retry_budget_ratio: float = 0.1
+    #: --slowlog-*: tail-based slow-request capture at the PROXY hop
+    #: (utils/slowlog.py) — same semantics as the engine servers
+    slowlog_capacity: int = 256
+    slowlog_quantile: float = 0.99
+    slowlog_min_count: int = 64
+    #: runtime telemetry sampler period (0 disables the thread)
+    telemetry_interval: float = 10.0
 
     @property
     def bind_host(self) -> str:
@@ -241,6 +248,17 @@ class Proxy:
         self._relay_lock = threading.Lock()
         #: Prometheus /metrics + /healthz endpoint (--metrics-port >= 0)
         self.metrics = None
+        # forensics plane (ISSUE 4): slow-request ring at the proxy hop +
+        # the runtime telemetry sampler (started with the listener)
+        self.rpc.trace.slowlog.configure(
+            capacity=getattr(args, "slowlog_capacity", 256),
+            quantile=getattr(args, "slowlog_quantile", 0.99),
+            min_count=getattr(args, "slowlog_min_count", 64))
+        from jubatus_tpu.utils.runtime_telemetry import RuntimeTelemetry
+
+        self.telemetry = RuntimeTelemetry(
+            self.rpc.trace,
+            interval_sec=getattr(args, "telemetry_interval", 10.0))
         self._register_methods()
         if hasattr(self.rpc, "relay_config"):
             t = threading.Thread(target=self._relay_refresher, daemon=True,
@@ -678,12 +696,64 @@ class Proxy:
         self._register("get_status", 1, "broadcast", aggregators.merge)
         self._register("get_metrics", 1, "broadcast", aggregators.merge)
         self._register("get_mix_history", 1, "broadcast", aggregators.concat)
+        # trace forensics (ISSUE 4): broadcast + fold the proxy's OWN
+        # records into the reply, so one call against the proxy returns
+        # the full cross-node view (the proxy hop is part of the trace)
+        self.rpc.register("get_spans",
+                          self._forensics_handler(
+                              "get_spans", self.get_proxy_spans),
+                          arity=2)
+        self.rpc.register("get_slow_log",
+                          self._forensics_handler(
+                              "get_slow_log", self.get_proxy_slow_log),
+                          arity=1)
         self._register("do_mix", 1, "random", aggregators.pass_)
         self.rpc.register("get_proxy_status", self.get_proxy_status, arity=1)
         self.rpc.register("get_proxy_metrics", self.get_metrics, arity=1)
+        self.rpc.register("get_proxy_spans", self.get_proxy_spans, arity=2)
+        self.rpc.register("get_proxy_slow_log", self.get_proxy_slow_log,
+                          arity=1)
         self.rpc.register("get_breakers", self.get_breakers, arity=1)
 
+    def _forensics_handler(self, name: str,
+                           own_fn: Callable[..., Dict[str, Any]]
+                           ) -> Callable[..., Dict[str, Any]]:
+        """Broadcast ``name`` to the backends and fold the proxy's OWN
+        records in — a proxied trace/slow-log query returns every hop of
+        the story in one call. Backend failures (no actives, partial
+        cluster) degrade to whatever answered plus the proxy's view: a
+        forensics query against a sick cluster is exactly when partial
+        data matters most."""
+        fan = self._handler(name, "broadcast", 2, aggregators.merge)
+
+        def handle(*params: Any) -> Dict[str, Any]:
+            out: Dict[str, Any] = {}
+            try:
+                folded = fan(*params)
+                if isinstance(folded, dict):
+                    out.update(folded)
+            except Exception:  # broad-ok — partial forensics beat none
+                log.debug("%s backend broadcast failed", name,
+                          exc_info=True)
+            out.update(own_fn(*params))
+            return out
+
+        return handle
+
     # -- own status (proxy_common::get_status) --------------------------------
+    def get_proxy_spans(self, _name: str = "",
+                        trace_id: str = "") -> Dict[str, Any]:
+        """This proxy's OWN span records for one trace (its dispatch and
+        per-backend client-call spans), keyed by proxy node name."""
+        node = NodeInfo(self.args.bind_host, self.rpc.port or self.args.rpc_port)
+        return {node.name: self.rpc.trace.get_spans(str(trace_id))}
+
+    def get_proxy_slow_log(self, _name: str = "") -> Dict[str, Any]:
+        """This proxy's slow-request ring (tail-based capture of the
+        proxy hop itself)."""
+        node = NodeInfo(self.args.bind_host, self.rpc.port or self.args.rpc_port)
+        return {node.name: self.rpc.trace.slowlog.snapshot()}
+
     def get_breakers(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
         """Breaker + retry-budget state, keyed by proxy node name — the
         ``jubactl -c breakers`` view and the ops answer to 'why is this
@@ -734,6 +804,10 @@ class Proxy:
         # the proxy hop's rpc.* quantiles and trace ids sit next to the
         # backends' in a merged get_status view
         st.update(self.rpc.trace.trace_status())
+        st.update({f"runtime.{k}": v
+                   for k, v in self.telemetry.status().items()})
+        st.update({f"slowlog.{k}": v
+                   for k, v in self.rpc.trace.slowlog.stats().items()})
         return {node.name: st}
 
     def get_metrics(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
@@ -746,12 +820,17 @@ class Proxy:
         with self._counters_lock:
             fwd, errs = self.forward_count, self.forward_errors
         breakers = self.breakers.snapshot()
-        return {"engine": f"{self.engine}_proxy",
-                "uptime_s": int(time.time() - self.start_time),  # wall-clock
-                "rpc_port": self.rpc.port or self.args.rpc_port,
-                "forward_count": fwd, "forward_errors": errs,
-                "breaker_open": sum(1 for b in breakers.values()
-                                    if b["state"] == "open")}
+        doc = {"engine": f"{self.engine}_proxy",
+               "uptime_s": int(time.time() - self.start_time),  # wall-clock
+               "rpc_port": self.rpc.port or self.args.rpc_port,
+               "forward_count": fwd, "forward_errors": errs,
+               "breaker_open": sum(1 for b in breakers.values()
+                                   if b["state"] == "open")}
+        rt = self.telemetry.status()
+        for k in ("rss_bytes", "open_fds", "threads", "slowlog_depth"):
+            if k in rt:
+                doc[k] = rt[k]
+        return doc
 
     # -- lifecycle ------------------------------------------------------------
     def start(self, port: Optional[int] = None) -> int:
@@ -761,6 +840,7 @@ class Proxy:
             host=self.args.bind_host,
         )
         self.args.rpc_port = actual
+        self.telemetry.start()
         if getattr(self.args, "metrics_port", -1) >= 0:
             from jubatus_tpu.utils.metrics_http import MetricsServer
 
@@ -785,6 +865,7 @@ class Proxy:
 
     def stop(self) -> None:
         self.rpc.stop()
+        self.telemetry.stop()
         if self.metrics is not None:
             try:
                 self.metrics.stop()
@@ -836,6 +917,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--retry-budget-ratio", type=float, default=0.1,
                    help="failover retries allowed per first-attempt "
                         "forward (token bucket; 0 disables failover)")
+    p.add_argument("--slowlog-capacity", type=int, default=256,
+                   help="slow-request ring size at the proxy hop "
+                        "(0 disables tail-based capture)")
+    p.add_argument("--slowlog-quantile", type=float, default=0.99,
+                   help="per-span histogram quantile at/above which a "
+                        "forwarded request is captured in the slow log")
+    p.add_argument("--slowlog-min-count", type=int, default=64,
+                   help="samples a span needs before slow-log "
+                        "thresholding starts")
+    p.add_argument("--telemetry-interval", type=float, default=10.0,
+                   help="runtime telemetry sampling period in seconds "
+                        "(0 disables the sampler thread)")
     ns = p.parse_args(argv)
     args = ProxyArgs(**{f.name: getattr(ns, f.name)
                         for f in dataclasses.fields(ProxyArgs)

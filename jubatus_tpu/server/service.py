@@ -62,6 +62,9 @@ def bind_engine(rpc: RpcServer, server: Any) -> None:
     rpc.register("load", server.load, arity=2)
     rpc.register("get_status", server.get_status, arity=1)
     rpc.register("get_metrics", server.get_metrics, arity=1)
+    # trace forensics (ISSUE 4): per-trace span store + slow-request ring
+    rpc.register("get_spans", server.get_spans, arity=2)
+    rpc.register("get_slow_log", server.get_slow_log, arity=1)
     rpc.register("do_mix", server.do_mix, arity=1)
     _BINDERS[server.engine](rpc, server)
 

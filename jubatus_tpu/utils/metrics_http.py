@@ -5,11 +5,17 @@ when ``--metrics-port`` is given (off by default; ``0`` binds an
 ephemeral port — the actual port lands in get_status). Serves:
 
 - ``GET /metrics``  — Prometheus text exposition (0.0.4) of the node's
-  tracing Registry (span latency histograms + event counters), with
-  static identity labels (engine, cluster, node).
+  tracing Registry (span latency histograms + event counters + runtime
+  gauges), with static identity labels (engine, cluster, node). Buckets
+  holding a slow-request capture carry an OpenMetrics-style exemplar
+  (``# {trace_id="..."} value ts``) linking the spike to a trace.
 - ``GET /healthz``  — JSON liveness document from a caller-supplied
-  callable (uptime, rpc port, mixer counters, ...). Always 200 while the
-  process serves; orchestration probes hit this, scrapers hit /metrics.
+  callable (uptime, rpc port, mixer counters, runtime telemetry
+  summary, ...). Always 200 while the process serves; orchestration
+  probes hit this, scrapers hit /metrics.
+- ``GET /slowlog``  — JSON dump of the registry's slow-request ring
+  (tail-based capture, utils/slowlog.py): the curl-able twin of the
+  ``get_slow_log`` RPC / ``jubadump --slow-log``.
 
 Deliberately read-only and unauthenticated, like every Prometheus
 exporter: bind it to an internal interface. The RPC plane stays the
@@ -62,6 +68,12 @@ class MetricsServer:
                         if outer.health_fn is not None:
                             doc.update(outer.health_fn())
                         body = (json.dumps(doc) + "\n").encode()
+                        ctype = "application/json"
+                    elif self.path.split("?", 1)[0] == "/slowlog":
+                        body = (json.dumps({
+                            "stats": outer.registry.slowlog.stats(),
+                            "records": outer.registry.slowlog.snapshot(),
+                        }) + "\n").encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)

@@ -1,0 +1,93 @@
+"""Tail-based slow-request capture (ISSUE 4: slow-request forensics).
+
+A bounded ring of structured records for requests that landed at or
+above a configurable quantile of their OWN span histogram (default
+p99): method, duration, trace_id, peer, deadline-remaining, and the
+threshold that tripped. Tail-based means the log captures exactly the
+requests an operator would go hunting for after a latency page — the
+ones past the knee of the distribution — with no sampling decision made
+before the duration is known (head-based sampling throws the tail away
+by construction).
+
+The quantile threshold is computed against the span's log-bucketed
+histogram (utils/tracing.py) and CACHED on the histogram, refreshed
+every 64 records, so the record hot path pays one float compare, not a
+109-bucket walk. No capture happens until a span has ``min_count``
+samples — early in a process's life every request is "p99".
+
+Owned by each tracing ``Registry`` (one per server process); configured
+by ``--slowlog-capacity`` / ``--slowlog-quantile`` / ``--slowlog-min-count``;
+queried by the ``get_slow_log`` RPC, ``jubadump --slow-log``, and the
+``/slowlog`` endpoint of utils/metrics_http.py. Each captured record also
+stamps a Prometheus exemplar (trace_id) onto the histogram bucket it
+landed in, so a scrape dashboard links a p99 spike straight to a trace.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+#: ring size; 0 disables capture entirely (record() never consults us)
+DEFAULT_CAPACITY = 256
+#: a request at/above this quantile of its own span histogram is slow
+DEFAULT_QUANTILE = 0.99
+#: no thresholding until a span has this many samples
+DEFAULT_MIN_COUNT = 64
+
+
+class SlowLog:
+    """Bounded ring of slow-request records for one Registry."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 quantile: float = DEFAULT_QUANTILE,
+                 min_count: int = DEFAULT_MIN_COUNT) -> None:
+        self._lock = threading.Lock()
+        self.capacity = int(capacity)
+        self.quantile = float(quantile)
+        self.min_count = int(min_count)
+        self._ring: deque = deque(maxlen=max(self.capacity, 1))
+        self._captured = 0
+
+    def configure(self, capacity: Optional[int] = None,
+                  quantile: Optional[float] = None,
+                  min_count: Optional[int] = None) -> None:
+        """Re-tune at server start (flags); keeps already-captured
+        records up to the new capacity."""
+        with self._lock:
+            if capacity is not None:
+                self.capacity = int(capacity)
+                self._ring = deque(self._ring,
+                                   maxlen=max(self.capacity, 1))
+            if quantile is not None:
+                if not 0.0 < quantile <= 1.0:
+                    raise ValueError(f"slowlog quantile {quantile} not in "
+                                     "(0, 1]")
+                self.quantile = float(quantile)
+            if min_count is not None:
+                self.min_count = max(1, int(min_count))
+
+    def add(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            self._captured += 1
+            self._ring.append(rec)
+
+    def snapshot(self, last: int = 0) -> List[Dict[str, Any]]:
+        """Oldest-first copy (the newest ``last`` when > 0)."""
+        with self._lock:
+            out = list(self._ring)
+        return out[-last:] if last > 0 else out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"captured": self._captured,
+                    "retained": len(self._ring),
+                    "capacity": self.capacity,
+                    "quantile": self.quantile,
+                    "min_count": self.min_count}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._captured = 0

@@ -17,8 +17,14 @@ Three layers:
   pair propagated through the RPC envelope (rpc/client.py attaches it,
   rpc/server.py adopts it), so a proxied call shows up as ONE trace — the
   proxy hop and the backend hop record the same trace_id into their own
-  registries (``trace.<name>.last_trace_id`` in get_status), and a small
-  ring of recent span records supports flight-recorder style debugging.
+  registries (``trace.<name>.last_trace_id`` in get_status).
+- **Span store** (ISSUE 4): every registry keeps a bounded ring of span
+  records INDEXED BY trace_id (parent/child edges from the envelope's
+  ``{"t","s"}`` element), served over the ``get_spans`` RPC so ``jubactl
+  -c trace TRACE_ID`` can assemble one cross-node span tree. Tail-based
+  slow-request capture rides the same record path: a span at/above a
+  configurable quantile of its own histogram lands in the slow-log ring
+  (utils/slowlog.py) and stamps a Prometheus exemplar on its bucket.
 - **XLA device traces** (opt-in): ``device_trace()`` wraps
   ``jax.profiler.trace`` when ``JUBATUS_TPU_TRACE_DIR`` is set (or a dir
   is passed), capturing TensorBoard-viewable TPU timelines of the jitted
@@ -34,7 +40,9 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from jubatus_tpu.utils.slowlog import SlowLog
 
 # -- histogram geometry -------------------------------------------------------
 # Quarter-octave log buckets from 2^-20 s (~1 us) to 2^7 s (128 s) plus an
@@ -68,7 +76,7 @@ class Histogram:
     """
 
     __slots__ = ("counts", "count", "total_s", "max_s", "last_s",
-                 "last_trace_id")
+                 "last_trace_id", "exemplars", "slow_threshold_s")
 
     def __init__(self) -> None:
         self.counts = [0] * _NBUCKETS
@@ -77,6 +85,13 @@ class Histogram:
         self.max_s = 0.0
         self.last_s = 0.0
         self.last_trace_id = ""
+        #: bucket index -> (trace_id, seconds, unix_ts) of the most recent
+        #: SLOW request that landed there (Prometheus exemplars: the
+        #: p99-spike bucket links straight to a trace)
+        self.exemplars: Dict[int, Tuple[str, float, float]] = {}
+        #: cached slow-log quantile threshold (refreshed every 64 records
+        #: so the hot path pays one compare, not a bucket walk)
+        self.slow_threshold_s: Optional[float] = None
 
     def record(self, seconds: float) -> None:
         self.counts[bucket_index(seconds)] += 1
@@ -169,15 +184,19 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
 # -- trace context ------------------------------------------------------------
 
 class TraceContext:
-    """One hop's identity inside a distributed trace."""
+    """One hop's identity inside a distributed trace. ``peer`` is the
+    remote address the request arrived from (best-effort: the Python
+    transport stamps it per connection; the C++ transport does not
+    surface it) — it rides into slow-log records, not the wire."""
 
-    __slots__ = ("trace_id", "span_id", "parent_id")
+    __slots__ = ("trace_id", "span_id", "parent_id", "peer")
 
     def __init__(self, trace_id: str, span_id: str,
-                 parent_id: str = "") -> None:
+                 parent_id: str = "", peer: str = "") -> None:
         self.trace_id = trace_id
         self.span_id = span_id
         self.parent_id = parent_id
+        self.peer = peer
 
 
 _tls = threading.local()
@@ -231,48 +250,147 @@ def to_wire(ctx: TraceContext) -> Dict[str, str]:
     return {"t": ctx.trace_id, "s": ctx.span_id}
 
 
+def child_of(ctx: TraceContext) -> TraceContext:
+    """A fresh child span of ``ctx`` (same trace, new span id): the
+    identity an outbound client call records under, so the receiving
+    hop's parent edge points at the CALL, not the whole dispatch."""
+    return TraceContext(ctx.trace_id, _new_id(), ctx.span_id)
+
+
+def new_root() -> TraceContext:
+    """A fresh root context (e.g. a mix round starting its own trace)."""
+    return TraceContext(_new_id(), _new_id(), "")
+
+
 # -- the registry -------------------------------------------------------------
 
-#: recent span records kept per registry (flight-recorder style ring)
-_SPAN_RING = 256
+#: span records kept per registry, ring-evicted oldest-first and INDEXED
+#: by trace_id so get_spans(trace_id) is an O(spans-in-trace) lookup
+_SPAN_RING = 512
+
+
+class _SpanHandle:
+    """Yielded by ``Registry.span``: ``seconds`` is the measured duration
+    (set at scope exit), ``cancel()`` suppresses the record — the raw
+    fast path's RAW_FALLBACK must not double-count with the generic
+    handler's own span."""
+
+    __slots__ = ("cancelled", "seconds")
+
+    def __init__(self) -> None:
+        self.cancelled = False
+        self.seconds = 0.0
+
+    def cancel(self) -> None:
+        self.cancelled = True
 
 
 class Registry:
-    """One node's metrics: span histograms + counters + recent spans.
+    """One node's metrics: span histograms + counters + gauges + the
+    trace-indexed span store + the slow-request log.
 
     Each server owns its own so multi-server processes (tests, embedded
     clusters) attribute spans per node; the module-level functions use a
     process default.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, span_capacity: int = _SPAN_RING) -> None:
         self._lock = threading.Lock()
         self._hists: Dict[str, Histogram] = {}
         self._counters: Dict[str, int] = {}
-        self._spans: deque = deque(maxlen=_SPAN_RING)
+        self._gauges: Dict[str, float] = {}
+        self._span_cap = span_capacity
+        self._spans: deque = deque()
+        self._by_trace: Dict[str, List[Dict[str, Any]]] = {}
+        #: tail-based slow-request ring (utils/slowlog.py); servers tune
+        #: it from --slowlog-* flags via slowlog.configure()
+        self.slowlog = SlowLog()
+        #: span store + slow log master switch (histograms stay on):
+        #: bench_serving.py's overhead A/B flips it
+        self._forensics = True
 
     @contextlib.contextmanager
-    def span(self, name: str) -> Iterator[None]:
+    def span(self, name: str) -> Iterator[_SpanHandle]:
         t0 = time.perf_counter()
+        h = _SpanHandle()
         try:
-            yield
+            yield h
         finally:
-            self.record(name, time.perf_counter() - t0)
+            h.seconds = time.perf_counter() - t0
+            if not h.cancelled:
+                self.record(name, h.seconds)
+
+    def set_forensics(self, enabled: bool) -> None:
+        """Toggle the span store + slow log (histograms/counters stay on)."""
+        self._forensics = bool(enabled)
 
     def record(self, name: str, seconds: float) -> None:
         ctx = getattr(_tls, "ctx", None)
+        slow_thr: Optional[float] = None
         with self._lock:
             h = self._hists.get(name)
             if h is None:
                 h = self._hists[name] = Histogram()
             h.record(seconds)
+            forensics = self._forensics
+            if forensics:
+                sl = self.slowlog
+                if sl.capacity > 0 and h.count >= sl.min_count:
+                    # cached threshold: a 109-bucket quantile walk per
+                    # record would tax the dispatch hot path; refresh
+                    # every 64 samples tracks the distribution closely
+                    # enough for tail capture
+                    thr = h.slow_threshold_s
+                    if thr is None or (h.count & 63) == 0:
+                        thr = h.slow_threshold_s = h.quantile(sl.quantile)
+                    if thr is not None and seconds >= thr:
+                        slow_thr = thr
+                        h.exemplars[bucket_index(seconds)] = (
+                            ctx.trace_id if ctx is not None else "",
+                            seconds, time.time())
             if ctx is not None:
                 h.last_trace_id = ctx.trace_id
-                self._spans.append({
-                    "trace_id": ctx.trace_id, "span_id": ctx.span_id,
-                    "parent_id": ctx.parent_id, "name": name,
-                    "duration_ms": round(seconds * 1e3, 3),
-                    "ts": time.time()})
+                if forensics:
+                    if len(self._spans) >= self._span_cap:
+                        old = self._spans.popleft()
+                        lst = self._by_trace.get(old["trace_id"])
+                        if lst:
+                            if lst[0] is old:
+                                lst.pop(0)
+                            else:  # defensive; eviction is FIFO per trace
+                                try:
+                                    lst.remove(old)
+                                except ValueError:
+                                    pass
+                            if not lst:
+                                del self._by_trace[old["trace_id"]]
+                    rec = {
+                        "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+                        "parent_id": ctx.parent_id, "name": name,
+                        "duration_ms": round(seconds * 1e3, 3),
+                        "ts": time.time() - seconds}
+                    self._spans.append(rec)
+                    self._by_trace.setdefault(ctx.trace_id, []).append(rec)
+        if slow_thr is not None:
+            self._capture_slow(name, seconds, slow_thr, ctx)
+
+    def _capture_slow(self, name: str, seconds: float, threshold: float,
+                      ctx: Optional[TraceContext]) -> None:
+        """Build + ring one slow-request record (outside the registry
+        lock — the slow path may consult the deadline plane)."""
+        rec: Dict[str, Any] = {
+            "method": name,
+            "duration_ms": round(seconds * 1e3, 3),
+            "threshold_ms": round(threshold * 1e3, 3),
+            "trace_id": ctx.trace_id if ctx is not None else "",
+            "span_id": ctx.span_id if ctx is not None else "",
+            "peer": ctx.peer if ctx is not None else "",
+            "ts": round(time.time() - seconds, 3),
+        }
+        rem = _deadline_remaining()
+        if rem is not None:
+            rec["deadline_remaining_ms"] = round(rem * 1e3, 3)
+        self.slowlog.add(rec)
 
     def count(self, name: str, n: int = 1) -> None:
         """Bump a monotonic counter (rpc errors, retries, bytes, ...)."""
@@ -283,9 +401,26 @@ class Registry:
         with self._lock:
             return dict(self._counters)
 
+    def gauge(self, name: str, value: float) -> None:
+        """Set a point-in-time gauge (runtime telemetry: RSS, FDs,
+        compile counts, ...) — exported on /metrics, not merged."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
     def recent_spans(self) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._spans)
+
+    def get_spans(self, trace_id: str) -> List[Dict[str, Any]]:
+        """All retained span records of one trace, oldest-first — the
+        per-node half of the cross-node trace assembly (``get_spans``
+        RPC -> jubactl -c trace)."""
+        with self._lock:
+            return [dict(r) for r in self._by_trace.get(str(trace_id), [])]
 
     def trace_status(self, prefix: str = "trace") -> Dict[str, Any]:
         """Flattened metrics for get_status maps: trace.<name>.{count,
@@ -310,17 +445,23 @@ class Registry:
         return out
 
     def snapshot(self) -> Dict[str, Any]:
-        """Mergeable raw state for get_metrics / jubactl metrics."""
+        """Mergeable raw state for get_metrics / jubactl metrics.
+        ``gauges`` ride along for single-node views; merge_snapshots
+        ignores them (point-in-time per-process values don't sum)."""
         with self._lock:
             return {"hists": {n: h.state() for n, h in self._hists.items()},
-                    "counters": dict(self._counters)}
+                    "counters": dict(self._counters),
+                    "gauges": dict(self._gauges)}
 
     def prometheus_text(self,
                         labels: Optional[Dict[str, str]] = None) -> str:
-        """Prometheus text exposition (format 0.0.4) of every histogram
-        and counter. Bucket lines are emitted only at occupied bucket
-        boundaries (+Inf always) — valid cumulative histograms, compact
-        wire."""
+        """Prometheus text exposition (format 0.0.4) of every histogram,
+        counter, and gauge. Bucket lines are emitted only at occupied
+        bucket boundaries (+Inf always) — valid cumulative histograms,
+        compact wire. Buckets holding a slow-request capture carry an
+        OpenMetrics-style exemplar (``# {trace_id="..."} value ts``) so
+        a p99 spike on a dashboard links straight to a trace; scrapers
+        that only speak 0.0.4 ignore text after ``#``."""
         base = "".join(f',{k}="{_esc(v)}"'
                        for k, v in sorted((labels or {}).items()))
         lines = [
@@ -329,19 +470,25 @@ class Registry:
             "Span latency by name (log-bucketed).",
         ]
         with self._lock:
-            hists = [(n, h.counts[:], h.count, h.total_s, h.max_s)
+            hists = [(n, h.counts[:], h.count, h.total_s, h.max_s,
+                      dict(h.exemplars))
                      for n, h in sorted(self._hists.items())]
             counters = sorted(self._counters.items())
-        for name, counts, count, total_s, max_s in hists:
+            gauges = sorted(self._gauges.items())
+        for name, counts, count, total_s, max_s, exemplars in hists:
             sel = f'span="{_esc(name)}"{base}'
             cum = 0
             for i, c in enumerate(counts):
                 if not c or i >= _OVERFLOW:
                     continue
                 cum += c
-                lines.append(
-                    f"jubatus_span_duration_seconds_bucket{{{sel},"
-                    f'le="{_BOUNDS[i]:.9g}"}} {cum}')
+                line = (f"jubatus_span_duration_seconds_bucket{{{sel},"
+                        f'le="{_BOUNDS[i]:.9g}"}} {cum}')
+                ex = exemplars.get(i)
+                if ex is not None and ex[0]:
+                    line += (f' # {{trace_id="{_esc(ex[0])}"}} '
+                             f"{ex[1]:.9g} {ex[2]:.3f}")
+                lines.append(line)
             lines.append(
                 f'jubatus_span_duration_seconds_bucket{{{sel},le="+Inf"}} '
                 f"{count}")
@@ -350,7 +497,7 @@ class Registry:
             lines.append(
                 f"jubatus_span_duration_seconds_count{{{sel}}} {count}")
         lines.append("# TYPE jubatus_span_max_seconds gauge")
-        for name, _counts, _count, _total, max_s in hists:
+        for name, _counts, _count, _total, max_s, _ex in hists:
             lines.append(
                 f'jubatus_span_max_seconds{{span="{_esc(name)}"{base}}} '
                 f"{max_s:.9g}")
@@ -358,18 +505,44 @@ class Registry:
         for name, v in counters:
             lines.append(
                 f'jubatus_events_total{{event="{_esc(name)}"{base}}} {v}')
+        if gauges:
+            lines.append("# TYPE jubatus_runtime_gauge gauge")
+            lines.append("# HELP jubatus_runtime_gauge "
+                         "Process runtime telemetry (sampler).")
+            for name, v in gauges:
+                lines.append(
+                    f'jubatus_runtime_gauge{{key="{_esc(name)}"{base}}} '
+                    f"{v:.9g}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
         with self._lock:
             self._hists.clear()
             self._counters.clear()
+            self._gauges.clear()
             self._spans.clear()
+            self._by_trace.clear()
+        self.slowlog.clear()
 
 
 def _esc(v: Any) -> str:
     return str(v).replace("\\", r"\\").replace('"', r"\"").replace(
         "\n", r"\n")
+
+
+_deadline_mod = None
+
+
+def _deadline_remaining() -> Optional[float]:
+    """Remaining deadline budget for slow-log records. Lazy module cache:
+    utils must not import the rpc package at import time (rpc imports
+    tracing), and the lookup only runs on the slow-capture cold path."""
+    global _deadline_mod
+    if _deadline_mod is None:
+        from jubatus_tpu.rpc import deadline as _d
+
+        _deadline_mod = _d
+    return _deadline_mod.remaining()
 
 
 _default = Registry()

@@ -179,11 +179,18 @@ def test_invalid_configs_raise():
 
 
 def test_reference_config_files_parse():
-    """Every converter block shipped in the reference's config/ must parse."""
+    """Every converter block shipped in config/ (this repo's copy of the
+    reference's per-engine example configs) must parse. The old absolute
+    /root/reference path only existed on the original capture host — the
+    repo's own config/ tree is the durable copy of the same files."""
     import glob
     import json
+    import os
 
-    paths = glob.glob("/root/reference/config/*/*.json")
+    base = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "config")
+    paths = glob.glob(os.path.join(base, "*", "*.json"))
     assert paths, "reference configs not found"
     for path in paths:
         with open(path) as f:

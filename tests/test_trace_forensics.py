@@ -1,0 +1,548 @@
+"""Trace-forensics tests (ISSUE 4): trace-indexed span store, cross-node
+span assembly through a real proxy + 2-backend topology, tail-based
+slow-log capture, Prometheus exemplars, the runtime telemetry sampler,
+and get_spans/get_slow_log envelope compat on both transports."""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+import pytest
+
+from jubatus_tpu.utils import tracing
+
+CONF = {
+    "method": "PA",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+
+
+# -- span store ---------------------------------------------------------------
+
+
+def test_span_store_indexed_by_trace():
+    reg = tracing.Registry()
+    ctx_a = tracing.new_root()
+    ctx_b = tracing.new_root()
+    with tracing.use_trace(ctx_a):
+        reg.record("rpc.x", 0.001)
+        reg.record("rpc.y", 0.002)
+    with tracing.use_trace(ctx_b):
+        reg.record("rpc.x", 0.003)
+    spans_a = reg.get_spans(ctx_a.trace_id)
+    assert [s["name"] for s in spans_a] == ["rpc.x", "rpc.y"]
+    assert all(s["trace_id"] == ctx_a.trace_id for s in spans_a)
+    assert len(reg.get_spans(ctx_b.trace_id)) == 1
+    assert reg.get_spans("nope") == []
+
+
+def test_span_store_ring_evicts_oldest_and_prunes_index():
+    reg = tracing.Registry(span_capacity=8)
+    first = tracing.new_root()
+    with tracing.use_trace(first):
+        reg.record("rpc.old", 0.001)
+    for _ in range(20):
+        with tracing.use_trace(tracing.new_root()):
+            reg.record("rpc.new", 0.001)
+    assert len(reg.recent_spans()) == 8
+    # the evicted trace's index entry is gone, not leaked
+    assert reg.get_spans(first.trace_id) == []
+    assert len(reg._by_trace) == 8
+
+
+def test_span_parent_edges_from_child_context():
+    root = tracing.new_root()
+    child = tracing.child_of(root)
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert child.span_id != root.span_id
+
+
+def test_span_handle_cancel_suppresses_record():
+    reg = tracing.Registry()
+    with reg.span("kept"):
+        pass
+    with reg.span("dropped") as sp:
+        sp.cancel()
+    st = reg.trace_status()
+    assert st["trace.kept.count"] == 1
+    assert "trace.dropped.count" not in st
+    assert sp.seconds >= 0.0  # duration still measured for the caller
+
+
+def test_forensics_toggle_keeps_histograms():
+    reg = tracing.Registry()
+    reg.slowlog.configure(min_count=1, quantile=0.5)
+    reg.set_forensics(False)
+    ctx = tracing.new_root()
+    with tracing.use_trace(ctx):
+        for _ in range(10):
+            reg.record("rpc.z", 0.001)
+    assert reg.trace_status()["trace.rpc.z.count"] == 10
+    assert reg.get_spans(ctx.trace_id) == []
+    assert reg.slowlog.snapshot() == []
+
+
+# -- slow log -----------------------------------------------------------------
+
+
+def test_slowlog_threshold_behavior():
+    """No capture below min_count; past it, only requests at/above the
+    configured quantile of their OWN histogram land in the ring."""
+    reg = tracing.Registry()
+    reg.slowlog.configure(capacity=16, quantile=0.99, min_count=64)
+    ctx = tracing.new_root()
+    with tracing.use_trace(ctx):
+        # spread 1..32 ms: p99 lands near the top of the spread, so a
+        # clearly-median request afterwards must NOT be captured
+        for i in range(64):
+            reg.record("rpc.t", 0.001 * (1 + i % 32))
+        base = len(reg.slowlog.snapshot())
+        reg.record("rpc.t", 0.002)  # ~median: under the p99 threshold
+        assert len(reg.slowlog.snapshot()) == base
+        reg.record("rpc.t", 1.0)  # unambiguous tail event
+    recs = reg.slowlog.snapshot()
+    assert len(recs) == base + 1
+    slow = recs[-1]
+    assert slow["method"] == "rpc.t"
+    assert slow["duration_ms"] == pytest.approx(1000.0, rel=0.01)
+    assert slow["trace_id"] == ctx.trace_id
+    assert slow["threshold_ms"] > 2.0
+    assert "peer" in slow and "ts" in slow
+
+
+def test_slowlog_no_capture_below_min_count():
+    reg = tracing.Registry()
+    reg.slowlog.configure(capacity=16, quantile=0.99, min_count=64)
+    for _ in range(63):
+        reg.record("rpc.m", 0.001)
+    assert reg.slowlog.snapshot() == []
+
+
+def test_slowlog_ring_bounded():
+    reg = tracing.Registry()
+    reg.slowlog.configure(capacity=4, quantile=0.5, min_count=1)
+    for _ in range(50):
+        reg.record("rpc.b", 0.001)
+    stats = reg.slowlog.stats()
+    assert stats["retained"] <= 4
+    assert stats["captured"] >= stats["retained"]
+
+
+def test_slowlog_records_deadline_remaining():
+    from jubatus_tpu.rpc import deadline as deadlines
+
+    reg = tracing.Registry()
+    reg.slowlog.configure(capacity=8, quantile=0.5, min_count=1)
+    with deadlines.deadline_after(30.0):
+        for _ in range(3):
+            reg.record("rpc.d", 0.001)
+    recs = reg.slowlog.snapshot()
+    assert recs, "quantile 0.5 with min_count 1 must capture"
+    assert 0 < recs[-1]["deadline_remaining_ms"] <= 30_000
+
+
+# -- prometheus exemplars -----------------------------------------------------
+
+#: exposition line with an OpenMetrics-style exemplar:
+#:   name{labels} value # {trace_id="..."} exemplar_value timestamp
+_EXEMPLAR_RE = re.compile(
+    r'^jubatus_span_duration_seconds_bucket\{[^}]*\} \d+ '
+    r'# \{trace_id="([0-9a-f]+)"\} [0-9eE.+-]+ [0-9.]+$')
+
+
+def test_prometheus_exemplar_line_parses():
+    reg = tracing.Registry()
+    reg.slowlog.configure(min_count=1, quantile=0.5)
+    ctx = tracing.new_root()
+    with tracing.use_trace(ctx):
+        for _ in range(5):
+            reg.record("rpc.e", 0.002)
+        reg.record("rpc.e", 0.5)  # forced-slow
+    text = reg.prometheus_text({"node": "n1"})
+    ex_lines = [ln for ln in text.splitlines() if "# {trace_id=" in ln]
+    assert ex_lines, text
+    m = _EXEMPLAR_RE.match(ex_lines[-1])
+    assert m, ex_lines[-1]
+    assert m.group(1) == ctx.trace_id
+    # non-exemplar lines still parse as plain format 0.0.4
+    for ln in text.splitlines():
+        if ln.startswith("#") or not ln or "# {" in ln:
+            continue
+        assert re.match(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? '
+                        r'[0-9eE.+-]+$', ln), ln
+
+
+# -- runtime telemetry --------------------------------------------------------
+
+
+def test_runtime_telemetry_sampler_keys():
+    from jubatus_tpu.utils.runtime_telemetry import RuntimeTelemetry
+
+    reg = tracing.Registry()
+    rt = RuntimeTelemetry(reg, interval_sec=0.05)
+    s = rt.sample()
+    for key in ("rss_bytes", "open_fds", "threads", "gc_gen0",
+                "slowlog_depth", "samples"):
+        assert key in s, s
+    # jax is imported by the test session -> the jax keys must be present
+    assert "jax_compile_count" in s and "jax_compile_ms" in s
+    # gauges reach the registry -> /metrics exposition
+    text = reg.prometheus_text()
+    assert 'jubatus_runtime_gauge{key="rss_bytes"}' in text
+    # the sampler thread keeps sampling
+    rt.start()
+    try:
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if rt.status().get("samples", 0) >= 2:
+                break
+            time.sleep(0.02)
+        assert rt.status()["samples"] >= 2
+    finally:
+        rt.stop()
+
+
+def test_jax_compile_hook_counts_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    from jubatus_tpu.utils import runtime_telemetry as rtm
+
+    assert rtm.install_jax_hooks()
+    before = rtm.jax_compile_stats()["compile_count"]
+    # a fresh closure defeats the jit cache -> at least one real compile
+    k = time.monotonic()  # unique constant baked into the jaxpr
+    jax.jit(lambda x: x * k + 1.0)(jnp.ones(3)).block_until_ready()
+    after = rtm.jax_compile_stats()
+    assert after["compile_count"] > before
+    assert after["compile_ms"] > 0
+
+
+# -- cross-node assembly ------------------------------------------------------
+
+
+@pytest.fixture()
+def proxy_two_backends():
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+    store = _Store()
+    servers = []
+    for _ in range(2):
+        srv = EngineServer(
+            "classifier", CONF,
+            args=ServerArgs(engine="classifier", coordinator="(shared)",
+                            name="fx", listen_addr="127.0.0.1",
+                            interval_sec=1e9, interval_count=1 << 30),
+            coord=MemoryCoordinator(store))
+        srv.start(0)
+        servers.append(srv)
+    proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1"),
+                  coord=MemoryCoordinator(store))
+    proxy.start(0)
+    yield servers, proxy
+    proxy.stop()
+    for s in servers:
+        s.stop()
+
+
+def test_cross_node_span_assembly(proxy_two_backends):
+    """ISSUE 4 acceptance: ONE trace_id through proxy + 2 backends
+    assembles into a single tree with >= 3 hops (proxy dispatch ->
+    per-backend client calls -> backend dispatches)."""
+    from jubatus_tpu.cmd.jubactl import assemble_trace
+    from jubatus_tpu.rpc.client import RpcClient
+
+    servers, proxy = proxy_two_backends
+    ctx = tracing.new_root()
+    with tracing.use_trace(ctx):
+        with RpcClient("127.0.0.1", proxy.args.rpc_port) as c:
+            assert c.call("get_status", "fx")
+    # one get_spans against the PROXY returns proxy + backend records
+    with RpcClient("127.0.0.1", proxy.args.rpc_port) as c:
+        spans_map = c.call("get_spans", "fx", ctx.trace_id)
+    assert len(spans_map) == 3, sorted(spans_map)  # proxy + 2 backends
+    spans = []
+    for node, recs in spans_map.items():
+        assert recs, f"{node} returned no spans"
+        for rec in recs:
+            rec = dict(rec)
+            rec.setdefault("node", node)
+            spans.append(rec)
+    assert {s["trace_id"] for s in spans} == {ctx.trace_id}
+    roots = assemble_trace(spans)
+    assert len(roots) == 1, [r["name"] for r in roots]
+    root = roots[0]
+    assert root["name"] == "rpc.get_status"
+
+    def depth(node, d=1):
+        return max([depth(c, d + 1) for c in node["children"]] or [d])
+
+    assert depth(root) >= 3, "proxy -> client-call -> backend hops"
+    names = set()
+
+    def walk(node):
+        names.add(node["name"])
+        for c in node["children"]:
+            walk(c)
+
+    walk(root)
+    assert "rpc.client.get_status" in names
+    # both BACKEND dispatch spans hang off the tree (the third
+    # rpc.get_status span is the proxy's own dispatch — the root)
+    proxy_node = f"127.0.0.1_{proxy.args.rpc_port}"
+    backend_nodes = {s["node"] for s in spans
+                     if s["name"] == "rpc.get_status"
+                     and s["node"] != proxy_node}
+    assert len(backend_nodes) == 2
+    assert root["node"] == proxy_node
+
+
+def test_get_slow_log_rpc_through_proxy(proxy_two_backends):
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.rpc.client import RpcClient
+
+    servers, proxy = proxy_two_backends
+    for s in servers:
+        s.rpc.trace.slowlog.configure(min_count=1, quantile=0.5)
+    c = ClassifierClient("127.0.0.1", proxy.args.rpc_port, "fx")
+    for _ in range(20):
+        c.train([["a", Datum({"x": 1.0})]])
+    c.close()
+    with RpcClient("127.0.0.1", proxy.args.rpc_port) as rc:
+        out = rc.call("get_slow_log", "fx")
+    # the proxy's own node key is present even if its ring is empty;
+    # at least one backend captured something
+    assert len(out) == 3, sorted(out)
+    captured = [r for recs in out.values() for r in recs]
+    assert captured
+    assert all("method" in r and "duration_ms" in r and "trace_id" in r
+               for r in captured)
+
+
+def test_jubactl_trace_renders_tree(tmp_path, capsys):
+    """jubactl -c trace TRACE_ID against a live 1-proxy/2-backend file-
+    coordinator cluster prints ONE assembled tree containing proxy and
+    backend spans for the same trace id."""
+    from jubatus_tpu.cmd import jubactl
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+    coord_dir = str(tmp_path / "coord")
+    servers = []
+    proxy = None
+    try:
+        for _ in range(2):
+            srv = EngineServer(
+                "classifier", CONF,
+                args=ServerArgs(engine="classifier", coordinator=coord_dir,
+                                name="jt", listen_addr="127.0.0.1",
+                                interval_sec=1e9, interval_count=1 << 30))
+            srv.start(0)
+            servers.append(srv)
+        proxy = Proxy(ProxyArgs(engine="classifier",
+                                listen_addr="127.0.0.1",
+                                coordinator=coord_dir))
+        proxy.start(0)
+        ctx = tracing.new_root()
+        with tracing.use_trace(ctx):
+            with RpcClient("127.0.0.1", proxy.args.rpc_port) as c:
+                assert c.call("get_status", "jt")
+        rc = jubactl.main(["-c", "trace", "-t", "classifier", "-n", "jt",
+                           "-z", coord_dir, ctx.trace_id])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert f"trace {ctx.trace_id}" in out
+        assert "1 root(s)" in out, out
+        assert "rpc.get_status" in out and "rpc.client.get_status" in out
+        # per-hop timings + node attribution are rendered
+        assert "ms  @127.0.0.1_" in out and "[t+" in out
+        # every node of the topology appears in the tree
+        for srv in servers:
+            assert f"127.0.0.1_{srv.args.rpc_port}" in out
+        assert f"127.0.0.1_{proxy.args.rpc_port}" in out
+        # unknown trace id: graceful nonzero exit
+        assert jubactl.main(["-c", "trace", "-t", "classifier", "-n", "jt",
+                             "-z", coord_dir, "feedfacefeedface"]) == -1
+    finally:
+        if proxy is not None:
+            proxy.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_mix_round_spans_share_one_trace():
+    """Mix rounds are traces too: the master's mix.round + phase spans
+    and the members' mix_* dispatch spans assemble under the trace_id
+    stamped into the flight record."""
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    store = _Store()
+    servers = []
+    try:
+        for _ in range(2):
+            srv = EngineServer(
+                "classifier", CONF,
+                args=ServerArgs(engine="classifier", coordinator="(shared)",
+                                name="mt", listen_addr="127.0.0.1",
+                                interval_sec=1e9, interval_count=1 << 30),
+                coord=MemoryCoordinator(store))
+            srv.start(0)
+            servers.append(srv)
+        for s in servers:
+            c = ClassifierClient("127.0.0.1", s.args.rpc_port, "mt")
+            c.train([["a", Datum({"x": 1.0})]])
+            c.close()
+        assert servers[0].mixer.mix_now() is not None
+        rec = servers[0].mixer.flight.snapshot()[-1]
+        assert rec["mode"] == "rpc"
+        tid = rec["trace_id"]
+        assert tid
+        master_spans = servers[0].rpc.trace.get_spans(tid)
+        names = {s["name"] for s in master_spans}
+        assert "mix.round" in names
+        assert "mix.phase.get_diff" in names and "mix.phase.put_diff" in names
+        # the member's mix_* dispatches carry the SAME trace id (the
+        # fan-out propagates the context across the executor + wire)
+        member_spans = servers[1].rpc.trace.get_spans(tid)
+        member_names = {s["name"] for s in member_spans}
+        assert "rpc.mix_get_diff" in member_names, member_names
+        assert "rpc.mix_put_diff" in member_names
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -- envelope compat on both transports ---------------------------------------
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_forensics_rpcs_envelope_compat(monkeypatch, native):
+    """get_spans / get_slow_log answer 4-element (plain msgpack-rpc) AND
+    5/6-element (traced/deadlined) envelopes on both transports."""
+    from jubatus_tpu.rpc import native_server
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+
+    if native and not native_server.available():
+        pytest.skip("native transport unavailable")
+    monkeypatch.setenv("JUBATUS_TPU_NATIVE_RPC", "1" if native else "0")
+    srv = EngineServer("classifier", CONF)
+    srv.rpc.trace.slowlog.configure(min_count=1, quantile=0.5)
+    port = srv.start(0)
+    try:
+        from jubatus_tpu.client import ClassifierClient, Datum
+        from jubatus_tpu.rpc import deadline as deadlines
+
+        c = ClassifierClient("127.0.0.1", port, "")
+        for _ in range(5):
+            c.train([["a", Datum({"x": 1.0})]])
+        c.close()
+        ctx = tracing.new_root()
+        with tracing.use_trace(ctx):
+            with RpcClient("127.0.0.1", port) as rc:
+                rc.call("get_status", "")
+        with RpcClient("127.0.0.1", port) as rc:
+            # plain 4-element envelope
+            plain = rc.call("get_spans", "", ctx.trace_id)
+            (recs,) = plain.values()
+            assert any(r["name"] == "rpc.get_status" for r in recs)
+            slow = rc.call("get_slow_log", "")
+            (slow_recs,) = slow.values()
+            assert slow_recs and all("trace_id" in r for r in slow_recs)
+        # traced + deadlined (5/6-element) envelope
+        probe = tracing.new_root()
+        with tracing.use_trace(probe), deadlines.deadline_after(30.0):
+            with RpcClient("127.0.0.1", port) as rc:
+                traced = rc.call("get_spans", "", ctx.trace_id)
+        (traced_recs,) = traced.values()
+        assert {r["span_id"] for r in traced_recs} >= \
+            {r["span_id"] for r in recs}
+    finally:
+        srv.stop()
+
+
+# -- status / health surfacing ------------------------------------------------
+
+
+def test_runtime_keys_in_get_status_and_healthz():
+    import json
+    import urllib.request
+
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    srv = EngineServer(
+        "classifier", CONF,
+        args=ServerArgs(engine="classifier", listen_addr="127.0.0.1",
+                        metrics_port=0))
+    port = srv.start(0)
+    try:
+        c = ClassifierClient("127.0.0.1", port, "")
+        c.train([["a", Datum({"x": 1.0})]])
+        (st,) = c.get_status().values()
+        c.close()
+        assert st["runtime.rss_bytes"] > 0
+        assert "runtime.jax_compile_count" in st
+        assert st["slowlog.capacity"] == 256
+        assert st["argv.slowlog_quantile"] == 0.99
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.args.metrics_port}/healthz",
+                timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+        assert doc["rss_bytes"] > 0 and "slowlog_depth" in doc
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.args.metrics_port}/slowlog",
+                timeout=10) as resp:
+            sl = json.loads(resp.read().decode())
+        assert sl["stats"]["capacity"] == 256
+        assert isinstance(sl["records"], list)
+    finally:
+        srv.stop()
+
+
+def test_concurrent_span_store_safe():
+    """The trace-indexed store stays consistent under concurrent record
+    + get_spans + eviction."""
+    reg = tracing.Registry(span_capacity=64)
+    stop = threading.Event()
+    errors = []
+
+    def pump():
+        try:
+            while not stop.is_set():
+                with tracing.use_trace(tracing.new_root()):
+                    reg.record("conc", 1e-4)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def read():
+        try:
+            while not stop.is_set():
+                for rec in reg.recent_spans()[:8]:
+                    reg.get_spans(rec["trace_id"])
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=pump) for _ in range(4)] + \
+        [threading.Thread(target=read) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors
+    assert len(reg.recent_spans()) <= 64

@@ -46,6 +46,24 @@ BROAD_EXCEPT_DIRS = (
 )
 
 
+def _is_span_timed(posix_path: str) -> bool:
+    """Files whose hot-path timing must go through the tracing registry's
+    ``span()`` helper (ISSUE 4): RPC dispatch and the mixer round paths.
+    A hand-rolled ``time.perf_counter()`` pair there produces a duration
+    the forensics plane never sees — no histogram, no span store entry,
+    no slow-log eligibility — so the measurement silently falls out of
+    every operator view. The registry helper is the same two
+    perf_counter calls PLUS the record. Genuinely raw timers (the span
+    helper's own implementation, code that must not touch the registry
+    lock) opt out per line with a ``# raw-timer`` pragma stating why."""
+    if posix_path.endswith(("jubatus_tpu/rpc/server.py",
+                            "jubatus_tpu/rpc/client.py",
+                            "jubatus_tpu/rpc/native_server.py")):
+        return True
+    return ("jubatus_tpu/framework/" in posix_path
+            and "mixer" in os.path.basename(posix_path))
+
+
 def iter_files(roots: List[str]) -> List[str]:
     out = []
     for root in roots:
@@ -79,6 +97,7 @@ def check_file(path: str) -> List[str]:
         d in posix for d in HOT_TIME_DIRS)
     broad_gate = path.endswith(".py") and any(
         d in posix for d in BROAD_EXCEPT_DIRS)
+    span_timed = path.endswith(".py") and _is_span_timed(posix)
     for i, line in enumerate(text.splitlines(), 1):
         if "\t" in line and not allow_tabs:
             problems.append(f"{path}:{i}: tab character")
@@ -92,6 +111,14 @@ def check_file(path: str) -> List[str]:
                 f"{path}:{i}: raw time.time() in a hot-path module (use "
                 "time.perf_counter/time.monotonic or a tracing span; "
                 "append '# wall-clock' for genuine timestamps)")
+        if span_timed and "time.perf_counter(" in line and \
+                "# raw-timer" not in line:
+            problems.append(
+                f"{path}:{i}: hand-rolled perf_counter in an RPC-dispatch/"
+                "mixer hot path (time it with the tracing registry's "
+                "span() helper so the duration reaches the histograms, "
+                "span store, and slow log; append '# raw-timer — <why>' "
+                "where a raw timer is genuinely required)")
         stripped = line.strip()
         if broad_gate and "# broad-ok" not in line and (
                 stripped.startswith("except Exception")
