@@ -24,12 +24,14 @@ from __future__ import annotations
 
 import math
 import re
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.core.fv.hashing import FeatureHasher
 from jubatus_tpu.core.fv.weight_manager import WeightManager
-from jubatus_tpu.core.sparse import SparseVector
+from jubatus_tpu.core.sparse import CSRBatch, SparseVector
 
 
 class ConverterError(ValueError):
@@ -312,22 +314,93 @@ class ConverterConfig:
 # ---------------------------------------------------------------------------
 # the converter
 # ---------------------------------------------------------------------------
+#: global-weight kind codes carried through the batch pipeline's flat arrays
+_GW_BIN, _GW_IDF, _GW_USER = 0, 1, 2
+_GW_CODE = {"bin": _GW_BIN, "idf": _GW_IDF, "weight": _GW_USER}
+
+#: default bound for the tokenization/name memo caches (entries, not bytes);
+#: overridable per converter via set_cache_size (--fv-cache-size)
+DEFAULT_CACHE_SIZE = 1 << 16
+
+
+class _ComboPlan:
+    """The combination cross product as a pure function of the BASE
+    feature-name schema (which repeats across a feed's datums): slot
+    names, hashed indices, gw kinds, and the bilinear terms feeding each
+    slot. On a schema hit the whole string/pair stage of _apply_combos is
+    replayed as numpy gathers + multiplies over the batch — the Python
+    mirror of the native parser's combo plan (native/fast_ingest.cpp)."""
+
+    __slots__ = ("slot_idx", "slot_kind", "a_idx", "b_idx", "mul_mask",
+                 "t_starts", "slot_names")
+
+    def __init__(self, slot_names, slot_idx, slot_kind,
+                 a_idx, b_idx, mul_mask, t_starts):
+        self.slot_names = slot_names
+        self.slot_idx = slot_idx      # int32 [S]
+        self.slot_kind = slot_kind    # uint8 [S]
+        self.a_idx = a_idx            # int32 [T] base column of left term
+        self.b_idx = b_idx            # int32 [T]
+        self.mul_mask = mul_mask      # bool  [T] mul (True) vs add
+        self.t_starts = t_starts      # int64 [S] first term per slot
+
+    def slot_values(self, base_vals: np.ndarray) -> np.ndarray:
+        """[G, nbase] float64 base values → [G, S] slot values."""
+        va = base_vals[:, self.a_idx]
+        vb = base_vals[:, self.b_idx]
+        tv = np.where(self.mul_mask, va * vb, va + vb)
+        if self.t_starts.shape[0] == tv.shape[1]:
+            return tv  # one term per slot — the common case
+        return np.add.reduceat(tv, self.t_starts, axis=1)
+
+
 class DatumToFVConverter:
-    """datum → hashed weighted sparse feature vector."""
+    """datum → hashed weighted sparse feature vector.
+
+    Two entry points: ``convert`` (per-datum, reference semantics) and
+    ``convert_batch`` (batch-native: memoized tokenization, one hash
+    sweep, vectorized global weights, CSR output — the serving hot
+    path). Both run the same extraction code, so they cannot drift."""
 
     def __init__(
         self,
         config: ConverterConfig,
         hasher: Optional[FeatureHasher] = None,
         weights: Optional[WeightManager] = None,
+        cache_size: int = DEFAULT_CACHE_SIZE,
     ):
         self.config = config
         self.hasher = hasher or FeatureHasher()
         self.weights = weights or WeightManager(self.hasher.dim)
+        # bounded memo caches (clear-on-full — the native parser's
+        # discipline; hot keys repopulate in one batch). Caches hold only
+        # weight-INDEPENDENT facts (tokenizations, filter outputs, hashed
+        # indices, gw kinds) so they can never serve a stale idf/user
+        # weighted value.
+        self._cache_max = max(int(cache_size), 0)
+        self._filter_memo: Dict[tuple, str] = {}
+        self._token_memo: Dict[tuple, tuple] = {}
+        self._name_memo: Dict[str, Tuple[int, int]] = {}
+        self._combo_plans: Dict[tuple, _ComboPlan] = {}
 
     @property
     def dim(self) -> int:
         return self.hasher.dim
+
+    def set_cache_size(self, n: int) -> None:
+        """Rebound the tokenization/name memo caches (--fv-cache-size);
+        0 disables memoization."""
+        self._cache_max = max(int(n), 0)
+        for memo in (self._filter_memo, self._token_memo, self._name_memo):
+            if len(memo) > self._cache_max:
+                memo.clear()
+
+    def _memo_put(self, memo: dict, key, value):
+        if self._cache_max:
+            if len(memo) >= self._cache_max:
+                memo.clear()
+            memo[key] = value
+        return value
 
     # -- filters ------------------------------------------------------------
     def _apply_filters(self, datum: Datum) -> Datum:
@@ -337,11 +410,16 @@ class DatumToFVConverter:
             num_values=datum.num_values,
             binary_values=datum.binary_values,
         )
-        for rule in cfg.string_filter_rules:
+        memo = self._filter_memo
+        for fi, rule in enumerate(cfg.string_filter_rules):
             fn = cfg.string_filters[rule.type_name]
             for key, value in list(out.string_values):
                 if rule.matcher(key):
-                    out.string_values.append((key + rule.suffix, fn(value)))
+                    fkey = (fi, value)
+                    fv = memo.get(fkey)
+                    if fv is None:
+                        fv = self._memo_put(memo, fkey, fn(value))
+                    out.string_values.append((key + rule.suffix, fv))
         for rule in cfg.num_filter_rules:
             fn = cfg.num_filters[rule.type_name]
             for key, value in list(out.num_values):
@@ -349,9 +427,24 @@ class DatumToFVConverter:
                     out.num_values.append((key + rule.suffix, fn(value)))
         return out
 
+    def _term_counts(self, type_name: str, splitter: Splitter,
+                     text: str) -> tuple:
+        """Distinct (term, tf) pairs in first-seen order, memoized per
+        (splitter type, input string) — repeated hot strings (headers,
+        categorical values) skip re-splitting entirely."""
+        tkey = (type_name, text)
+        cached = self._token_memo.get(tkey)
+        if cached is not None:
+            return cached
+        counts: Dict[str, int] = {}
+        for term in splitter(text):
+            counts[term] = counts.get(term, 0) + 1
+        return self._memo_put(self._token_memo, tkey, tuple(counts.items()))
+
     # -- extraction ---------------------------------------------------------
-    def _named_features(self, datum: Datum) -> Dict[str, float]:
-        """Produce the weighted feature dict keyed by full feature name."""
+    def _base_named_features(self, datum: Datum) -> Dict[str, float]:
+        """The weighted feature dict BEFORE combination rules — the
+        snapshot the combo cross product feeds on."""
         cfg = self.config
         datum = self._apply_filters(datum)
         features: Dict[str, float] = {}
@@ -359,23 +452,20 @@ class DatumToFVConverter:
         # string rules
         for rule in cfg.string_rules:
             splitter = cfg.string_types[rule.type_name]
+            suffix = (f"@{rule.type_name}"
+                      f"#{rule.sample_weight}/{rule.global_weight}")
             for key, text in datum.string_values:
                 if not rule.matcher(key):
                     continue
-                counts: Dict[str, int] = {}
-                for term in splitter(text):
-                    counts[term] = counts.get(term, 0) + 1
-                for term, tf in counts.items():
+                for term, tf in self._term_counts(
+                        rule.type_name, splitter, text):
                     if rule.sample_weight == "bin":
                         sw = 1.0
                     elif rule.sample_weight == "tf":
                         sw = float(tf)
                     else:  # log_tf
                         sw = math.log(1.0 + tf)
-                    name = (
-                        f"{key}${term}@{rule.type_name}"
-                        f"#{rule.sample_weight}/{rule.global_weight}"
-                    )
+                    name = f"{key}${term}{suffix}"
                     features[name] = features.get(name, 0.0) + sw
 
         # num rules
@@ -409,28 +499,37 @@ class DatumToFVConverter:
                 for name, v in fn(key, value):
                     features[name] = features.get(name, 0.0) + v
 
-        # combination features over the features produced so far. Each rule
-        # emits each unordered pair once (canonical name order), regardless of
-        # which side matched which matcher; values accumulate across rules.
-        if cfg.combination_rules:
-            base = list(features.items())
-            for rule in cfg.combination_rules:
-                op = cfg.combination_types[rule.type_name]
-                seen = set()
-                for lname, lval in base:
-                    if not rule.match_left(lname):
-                        continue
-                    for rname, rval in base:
-                        if lname == rname or not rule.match_right(rname):
-                            continue
-                        a, b = (lname, rname) if lname < rname else (rname, lname)
-                        if (a, b) in seen:
-                            continue
-                        seen.add((a, b))
-                        cval = lval * rval if op == "mul" else lval + rval
-                        name = f"{a}&{b}"
-                        features[name] = features.get(name, 0.0) + cval
+        return features
 
+    def _apply_combos(self, features: Dict[str, float]) -> None:
+        """Combination features over the features produced so far, added
+        in place. Each rule emits each unordered pair once (canonical
+        name order), regardless of which side matched which matcher;
+        values accumulate across rules."""
+        cfg = self.config
+        base = list(features.items())
+        for rule in cfg.combination_rules:
+            op = cfg.combination_types[rule.type_name]
+            seen = set()
+            for lname, lval in base:
+                if not rule.match_left(lname):
+                    continue
+                for rname, rval in base:
+                    if lname == rname or not rule.match_right(rname):
+                        continue
+                    a, b = (lname, rname) if lname < rname else (rname, lname)
+                    if (a, b) in seen:
+                        continue
+                    seen.add((a, b))
+                    cval = lval * rval if op == "mul" else lval + rval
+                    name = f"{a}&{b}"
+                    features[name] = features.get(name, 0.0) + cval
+
+    def _named_features(self, datum: Datum) -> Dict[str, float]:
+        """Produce the weighted feature dict keyed by full feature name."""
+        features = self._base_named_features(datum)
+        if self.config.combination_rules:
+            self._apply_combos(features)
         return features
 
     # -- hashing + global weights -------------------------------------------
@@ -462,6 +561,194 @@ class DatumToFVConverter:
                 value *= self.weights.user_weight(idx)
             hashed[idx] = hashed.get(idx, 0.0) + value
         return sorted(hashed.items())
+
+    # -- batch pipeline ------------------------------------------------------
+    def _resolve_names(self, names: List[str]):
+        """names → (int32 indices, uint8 gw kinds): memo lookups plus ONE
+        ``index_array`` sweep for the misses. The memo holds only pure
+        facts (hash, kind parsed from the name) — never weighted values."""
+        n = len(names)
+        idx = np.empty(n, dtype=np.int32)
+        kind = np.empty(n, dtype=np.uint8)
+        memo = self._name_memo
+        miss_pos: List[int] = []
+        miss_names: List[str] = []
+        for i, nm in enumerate(names):
+            e = memo.get(nm)
+            if e is None:
+                miss_pos.append(i)
+                miss_names.append(nm)
+            else:
+                idx[i] = e[0]
+                kind[i] = e[1]
+        if miss_names:
+            new_idx = self.hasher.index_array(miss_names)
+            for p, nm, ix in zip(miss_pos, miss_names, new_idx.tolist()):
+                k = _GW_CODE[_global_weight_kind(nm)]
+                self._memo_put(memo, nm, (ix, k))
+                idx[p] = ix
+                kind[p] = k
+        return idx, kind
+
+    def _combo_plan_for(self, base_names: tuple) -> _ComboPlan:
+        """Build (or fetch) the combo plan for one base-name schema —
+        a symbolic replay of _apply_combos with values left abstract."""
+        plan = self._combo_plans.get(base_names)
+        if plan is not None:
+            return plan
+        cfg = self.config
+        slot_names: List[str] = []
+        slot_map: Dict[str, int] = {}
+        slot_terms: List[List[Tuple[int, int, bool]]] = []
+        for rule in cfg.combination_rules:
+            mul = cfg.combination_types[rule.type_name] == "mul"
+            seen = set()
+            left = [i for i, nm in enumerate(base_names)
+                    if rule.match_left(nm)]
+            right = [i for i, nm in enumerate(base_names)
+                     if rule.match_right(nm)]
+            for li in left:
+                ln = base_names[li]
+                for ri in right:
+                    if li == ri:
+                        continue
+                    rn = base_names[ri]
+                    a, b = (ln, rn) if ln < rn else (rn, ln)
+                    if (a, b) in seen:
+                        continue
+                    seen.add((a, b))
+                    name = f"{a}&{b}"
+                    s = slot_map.get(name)
+                    if s is None:
+                        s = len(slot_names)
+                        slot_map[name] = s
+                        slot_names.append(name)
+                        slot_terms.append([])
+                    slot_terms[s].append((li, ri, mul))
+        a_idx, b_idx, mul_mask, t_starts = [], [], [], []
+        for terms in slot_terms:
+            t_starts.append(len(a_idx))
+            for li, ri, mul in terms:
+                a_idx.append(li)
+                b_idx.append(ri)
+                mul_mask.append(mul)
+        sidx, skind = self._resolve_names(slot_names)
+        plan = _ComboPlan(
+            slot_names, sidx, skind,
+            np.asarray(a_idx, dtype=np.int32),
+            np.asarray(b_idx, dtype=np.int32),
+            np.asarray(mul_mask, dtype=bool),
+            np.asarray(t_starts, dtype=np.int64),
+        )
+        if len(self._combo_plans) >= 64:
+            self._combo_plans.clear()
+        self._combo_plans[base_names] = plan
+        return plan
+
+    def convert_batch(self, data: Sequence[Datum],
+                      update_weights: bool = False) -> CSRBatch:
+        """Batch-native conversion: tokenize/filter with the memo caches,
+        hash every feature name in one sweep, apply global weights as
+        numpy gathers, and emit an arena-style CSR triple — no per-datum
+        SparseVector objects on the hot path.
+
+        Semantics match per-datum ``convert`` exactly, with ONE
+        documented difference under ``update_weights=True``: document
+        frequencies for the WHOLE batch are observed first (one
+        ``observe_batch`` call — the idf batch-collapse fix), then every
+        row's idf reflects the full batch's counts. Per-datum convert
+        interleaves observe/lookup per document, so a document sees only
+        its predecessors; intra-batch arrival order was never a contract
+        (the microbatch coalescer already merges concurrent requests in
+        arbitrary order), and the two agree for batch size 1 and
+        converge as counts grow."""
+        b = len(data)
+        if b == 0:
+            return CSRBatch(np.zeros(0, np.int32), np.zeros(0, np.float32),
+                            np.zeros(1, np.int64))
+        base = [self._base_named_features(d) for d in data]
+        combo = bool(self.config.combination_rules)
+
+        row_idx: List[np.ndarray] = [None] * b  # type: ignore[list-item]
+        row_val: List[np.ndarray] = [None] * b  # type: ignore[list-item]
+        row_kind: List[np.ndarray] = [None] * b  # type: ignore[list-item]
+        if not combo:
+            flat_names: List[str] = []
+            counts = np.empty(b, dtype=np.int64)
+            for i, nd in enumerate(base):
+                flat_names.extend(nd.keys())
+                counts[i] = len(nd)
+            idx, kind = self._resolve_names(flat_names)
+            val = np.empty(len(flat_names), dtype=np.float64)
+            pos = 0
+            for nd in base:
+                for v in nd.values():
+                    val[pos] = v
+                    pos += 1
+            flat_idx, flat_val, flat_kind = idx, val, kind
+            entry_rows = np.repeat(np.arange(b, dtype=np.int64), counts)
+        else:
+            # group rows by base-name schema; the cross product becomes
+            # one vectorized bilinear evaluation per group (fixed key
+            # schemas — the production shape — form a single group)
+            groups: Dict[tuple, List[int]] = {}
+            for i, nd in enumerate(base):
+                groups.setdefault(tuple(nd.keys()), []).append(i)
+            for names_t, members in groups.items():
+                bidx, bkind = self._resolve_names(list(names_t))
+                plan = self._combo_plan_for(names_t)
+                bvals = np.array(
+                    [list(base[r].values()) for r in members],
+                    dtype=np.float64).reshape(len(members), len(names_t))
+                svals = plan.slot_values(bvals) if len(plan.slot_names) \
+                    else np.zeros((len(members), 0))
+                gidx = np.concatenate([bidx, plan.slot_idx])
+                gkind = np.concatenate([bkind, plan.slot_kind])
+                for gi, r in enumerate(members):
+                    row_idx[r] = gidx
+                    row_kind[r] = gkind
+                    row_val[r] = np.concatenate([bvals[gi], svals[gi]])
+            counts = np.fromiter((a.shape[0] for a in row_idx),
+                                 dtype=np.int64, count=b)
+            flat_idx = np.concatenate(row_idx) if b else np.zeros(0, np.int32)
+            flat_val = np.concatenate(row_val)
+            flat_kind = np.concatenate(row_kind)
+            entry_rows = np.repeat(np.arange(b, dtype=np.int64), counts)
+
+        # global weights — vectorized gathers instead of per-index calls.
+        # observe() runs ONCE for the whole batch (before any lookup), so
+        # every row sees the post-batch document counts.
+        idf_mask = flat_kind == _GW_IDF
+        if idf_mask.any():
+            if update_weights:
+                self.weights.observe_batch(flat_idx[idf_mask],
+                                           entry_rows[idf_mask])
+            flat_val[idf_mask] *= self.weights.idf_many(flat_idx[idf_mask])
+        user_mask = flat_kind == _GW_USER
+        if user_mask.any():
+            flat_val[user_mask] *= self.weights.user_weight_many(
+                flat_idx[user_mask])
+
+        # per-row merge by hashed index (convert()'s sorted-dict
+        # semantics): stable lexsort keeps insertion order for colliding
+        # entries, so float accumulation order matches the per-datum dict
+        if flat_idx.shape[0] == 0:
+            return CSRBatch(np.zeros(0, np.int32), np.zeros(0, np.float32),
+                            np.zeros(b + 1, np.int64))
+        order = np.lexsort((flat_idx, entry_rows))
+        srow = entry_rows[order]
+        sidx = flat_idx[order]
+        sval = flat_val[order]
+        boundary = np.ones(sidx.shape[0], dtype=bool)
+        boundary[1:] = (srow[1:] != srow[:-1]) | (sidx[1:] != sidx[:-1])
+        starts = np.flatnonzero(boundary)
+        midx = sidx[starts].astype(np.int32)
+        mval = np.add.reduceat(sval, starts)
+        mrows = srow[starts]
+        mcounts = np.bincount(mrows, minlength=b)
+        off = np.zeros(b + 1, dtype=np.int64)
+        np.cumsum(mcounts, out=off[1:])
+        return CSRBatch(midx, mval.astype(np.float32), off)
 
     def convert_named(self, datum: Datum, update_weights: bool = False) -> Dict[str, float]:
         """Named (unhashed) features with global weights applied — for the
@@ -509,15 +796,19 @@ def make_fv_converter(
     converter_block: Optional[dict],
     dim_bits: int = 20,
     weights: Optional[WeightManager] = None,
+    cache_size: int = DEFAULT_CACHE_SIZE,
 ) -> DatumToFVConverter:
     """Factory mirroring core::fv_converter::make_fv_converter
     (reference usage: jubatus/server/server/classifier_serv.cpp:110).
 
     A "hash_max_size" in the converter block overrides ``dim_bits`` — the
     config is the deployment's statement of model scale, same as the
-    reference core's converter_config member."""
+    reference core's converter_config member. ``cache_size`` bounds the
+    tokenization/name memo caches (--fv-cache-size)."""
     config = ConverterConfig(converter_block)
     if config.dim_bits is not None:
         dim_bits = config.dim_bits
     hasher = FeatureHasher(dim_bits=dim_bits)
-    return DatumToFVConverter(config, hasher, weights or WeightManager(hasher.dim))
+    return DatumToFVConverter(config, hasher,
+                              weights or WeightManager(hasher.dim),
+                              cache_size=cache_size)

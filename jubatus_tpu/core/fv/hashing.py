@@ -60,6 +60,20 @@ class FeatureHasher:
             self._reverse.setdefault(h, name)
         return h
 
+    def _remember_many(self, idxs, names) -> None:
+        """Grow the reverse map from a batch, honoring reverse_capacity —
+        ONE owner for every batch path (index_many, index_array). The cap
+        is re-checked per entry, not per batch: a single oversized batch
+        must not blow past the bound."""
+        rev = self._reverse
+        cap = self._reverse_capacity
+        if len(rev) >= cap:
+            return
+        for h, name in zip(idxs, names):
+            if len(rev) >= cap:
+                break
+            rev.setdefault(int(h), name)
+
     def index_many(self, names, remember: bool = True):
         """Batch hashing. The C batch path (jubatus_tpu.native.hash_names)
         is bit-identical but measured SLOWER than this loop at realistic
@@ -73,11 +87,26 @@ class FeatureHasher:
 
         idxs = native.hash_names(list(names), self._mask)
         if remember:
-            for h, name in zip(idxs.tolist(), names):
-                if len(self._reverse) >= self._reverse_capacity:
-                    break
-                self._reverse.setdefault(int(h), name)
+            self._remember_many(idxs.tolist(), names)
         return [int(i) for i in idxs]
+
+    def index_array(self, names, remember: bool = True):
+        """Batch hashing to an int32 numpy array — the batch converter's
+        sweep (core/fv/converter.py convert_batch). Bit-identical to
+        index()/index_many; the reverse map grows through the same
+        capacity-bounded path."""
+        import numpy as np
+
+        crc = zlib.crc32
+        out = np.fromiter(
+            (crc(n.encode("utf-8", "surrogateescape")) for n in names),
+            dtype=np.uint32, count=len(names))
+        out &= np.uint32(self._mask)
+        idxs = out.astype(np.int32)
+        idxs[idxs == 0] = 1  # index 0 is the padding slot
+        if remember:
+            self._remember_many(idxs.tolist(), names)
+        return idxs
 
     def name_of(self, index: int) -> Optional[str]:
         """Reverse lookup (best effort; None if evicted or never seen)."""

@@ -46,6 +46,41 @@ class WeightManager:
             self._df_diff[np.asarray(list(indices), dtype=np.int64)] += 1.0
             self._ndocs_diff[0] += 1.0
 
+    def observe_batch(self, indices: np.ndarray, rows: np.ndarray) -> None:
+        """Record a whole batch of documents in ONE lock acquisition —
+        the batch converter's train path (convert_batch) and the flush-
+        time deferred-idf path (server/service.py).
+
+        ``indices``/``rows`` are parallel flat arrays: entry j says
+        document ``rows[j]`` contained feature ``indices[j]``. Duplicate
+        (row, index) pairs are deduplicated here (df counts one per
+        document, like per-datum observe's set()); the number of
+        documents is taken from the distinct row ids. The per-datum
+        ``observe()`` loop this replaces serialized conversion under this
+        lock once per datum — the idf batch-collapse."""
+        if indices.size == 0:
+            return
+        rows = np.asarray(rows, dtype=np.int64)
+        pair = rows * np.int64(self.dim) + np.asarray(indices, np.int64)
+        uniq = np.unique(pair)
+        ndocs = int(np.unique(rows).size)
+        uidx = uniq % np.int64(self.dim)
+        with self.lock:
+            np.add.at(self._df_diff, uidx, 1.0)
+            self._ndocs_diff[0] += float(ndocs)
+
+    def observe_rows(self, idx: np.ndarray) -> None:
+        """observe_batch for a padded [B, K] index matrix (the native
+        ingest interchange shape): each row is one document; index 0 is
+        the padding slot and is never counted."""
+        b = idx.shape[0]
+        if b == 0:
+            return
+        rows = np.repeat(np.arange(b, dtype=np.int64), idx.shape[1])
+        flat = idx.reshape(-1)
+        live = flat != 0
+        self.observe_batch(flat[live], rows[live])
+
     def set_user_weight(self, index: int, weight: float) -> None:
         self._user_weights[index] = float(weight)
 
@@ -61,8 +96,32 @@ class WeightManager:
             return 1.0
         return math.log(n / df)
 
+    def idf_many(self, indices: np.ndarray) -> np.ndarray:
+        """Vectorized idf lookup: one float64 gather over the df tables
+        instead of per-index idf() calls. Bit-parity with idf(): the
+        master+diff sum stays in float32 BEFORE widening (idf() does
+        float(f32 + f32)), and log runs on float64."""
+        ix = np.asarray(indices, dtype=np.int64)
+        n = self.ndocs
+        df = (self._df_master[ix] + self._df_diff[ix]).astype(np.float64)
+        if n <= 0:
+            return np.ones(ix.shape, dtype=np.float64)
+        out = np.ones(ix.shape, dtype=np.float64)
+        live = df > 0
+        out[live] = np.log(n / df[live])
+        return out
+
     def user_weight(self, index: int) -> float:
         return self._user_weights.get(index, 1.0)
+
+    def user_weight_many(self, indices: np.ndarray) -> np.ndarray:
+        """Vectorized user-weight lookup (global_weight "weight")."""
+        ix = np.asarray(indices, dtype=np.int64)
+        if not self._user_weights:
+            return np.ones(ix.shape, dtype=np.float64)
+        uw = self._user_weights
+        return np.fromiter((uw.get(int(i), 1.0) for i in ix),
+                           dtype=np.float64, count=ix.shape[0])
 
     # -- mixable protocol (parallel/mix.py) ---------------------------------
     #: mix() below is elementwise addition, so the mesh psum path applies

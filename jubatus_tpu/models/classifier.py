@@ -25,7 +25,7 @@ import numpy as np
 
 from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.core.fv import make_fv_converter
-from jubatus_tpu.core.sparse import SparseBatch, _bucket
+from jubatus_tpu.core.sparse import _bucket
 from jubatus_tpu.framework.driver import DriverBase, locked
 from jubatus_tpu.models.classifier_nn import NN_METHODS as _NN_METHODS
 from jubatus_tpu.ops import classifier as ops
@@ -172,35 +172,29 @@ class ClassifierDriver(DriverBase):
         }
 
     # -- train / classify ----------------------------------------------------
-    @locked
+    def featurize_train(self, data: Sequence[Tuple[str, Datum]]):
+        """Stage-1 host featurization for the pipelined microbatch
+        (server/microbatch.py PipelinedCoalescer): batch-convert WITHOUT
+        the driver lock — the WeightManager has its own lock for the
+        batch idf observe — so the next batch featurizes while the
+        device consumes the previous one. Returns the (labels, idx, val)
+        triple ``train_hashed`` consumes."""
+        labels = [label for label, _ in data]
+        csr = self.converter.convert_batch(
+            [datum for _, datum in data], update_weights=True)
+        sb = csr.to_padded()
+        return labels, sb.idx, sb.val
+
     def train(self, data: Sequence[Tuple[str, Datum]]) -> int:
+        """Batch-native train: one convert_batch sweep (memoized
+        tokenization, single hash pass, batch idf observe) into the
+        pre-hashed device path — no per-datum SparseVector objects.
+        Featurization runs unlocked; train_hashed takes the driver lock
+        for the device step (batch_bucket row padding lives there)."""
         if not data:
             return 0
-        vectors, slots = [], []
-        for label, datum in data:
-            slot = self._ensure_label(label)
-            vectors.append(self.converter.convert(datum, update_weights=True))
-            slots.append(slot)
-            self._dcounts[slot] += 1.0
-        # batch_bucket: round B up to a power of two so coalesced batches
-        # (whose sizes vary per flush) reuse compiled kernels instead of
-        # recompiling per shape — measured 59x server ingest throughput on v5e
-        # (8 clients x 64/rpc: 0.4k -> 26k samples/s).
-        # Padding rows are no-ops by construction (val 0 → alpha 0).
-        sb = SparseBatch.from_vectors(vectors, batch_bucket=16)
-        slots_arr = sb.pad_aux(slots, dtype=np.int32)
-        self.state = ops.train_batch(
-            self.state,
-            jnp.asarray(sb.idx),
-            jnp.asarray(sb.val),
-            jnp.asarray(slots_arr),
-            self._mask(),
-            self.param,
-            method=self.method,
-            mode=self.train_mode,
-        )
-        self.event_model_updated(len(data))
-        return len(data)
+        labels, idx, val = self.featurize_train(data)
+        return self.train_hashed(labels, idx, val)
 
     def _train_slots(self, slots: np.ndarray, idx: np.ndarray,
                      val: np.ndarray, b: int) -> int:
@@ -302,6 +296,77 @@ class ClassifierDriver(DriverBase):
         self.event_model_updated(b)
         return b
 
+    @locked
+    def train_indexed_combo(self, uniq_labels: Sequence[str],
+                            label_idx: np.ndarray, uidx: np.ndarray,
+                            base_val: np.ndarray, a_idx: np.ndarray,
+                            b_idx: np.ndarray, mul_mask: np.ndarray) -> int:
+        """train_indexed_schema with DEVICE-SIDE combination expansion:
+        ``uidx`` is the full base+slot index vector ([K0+S], no duplicate
+        indices — the plan builder guarantees it), ``base_val`` only the
+        [B, K0] base columns. The cross product's slot values are
+        computed on device (ops._expand_combo), so neither the host
+        parse nor the wire ever carries the (K0+S)-wide row — the combo
+        serving cliff was upload-bound, not compute-bound."""
+        b = int(label_idx.shape[0])
+        if b == 0:
+            return 0
+        slots_u = np.array([self._ensure_label(lb) for lb in uniq_labels],
+                           dtype=np.int32)
+        counts = np.bincount(label_idx, minlength=len(uniq_labels))
+        np.add.at(self._dcounts, slots_u, counts[:len(slots_u)])
+        slots = slots_u[label_idx]
+        k0 = base_val.shape[1]
+        if self.train_mode != "parallel":
+            # sequential mode: exact per-datum semantics take priority —
+            # expand on host and ride the sparse scan path
+            full = _expand_combo_host(base_val, a_idx, b_idx, mul_mask)
+            return self._train_slots(
+                slots, np.broadcast_to(uidx, (b, uidx.shape[0])), full, b)
+        bsz = _bucket(b, 16)
+        if bsz != b:  # zero base rows expand to zero slots — still no-ops
+            base_val = np.pad(base_val, ((0, bsz - b), (0, 0)))
+            slots = np.pad(slots, (0, bsz - b))
+        self.state = ops.train_batch_schema_combo(
+            self.state,
+            jnp.asarray(uidx),
+            jnp.asarray(base_val),
+            jnp.asarray(a_idx),
+            jnp.asarray(b_idx),
+            jnp.asarray(mul_mask),
+            jnp.asarray(slots),
+            self._mask(),
+            self.param,
+            method=self.method,
+        )
+        self.event_model_updated(b)
+        return b
+
+    def classify_hashed_combo(self, uidx: np.ndarray, base_val: np.ndarray,
+                              a_idx: np.ndarray, b_idx: np.ndarray,
+                              mul_mask: np.ndarray
+                              ) -> List[List[Tuple[str, float]]]:
+        """classify_hashed_schema with device-side combo expansion —
+        same lock discipline (enqueue under the lock, wait unlocked)."""
+        n = base_val.shape[0]
+        if n == 0:
+            return []
+        b = _bucket(n, 16)
+        if b != n:
+            base_val = np.pad(base_val, ((0, b - n), (0, 0)))
+        duidx, dval = jnp.asarray(uidx), jnp.asarray(base_val)
+        da, db = jnp.asarray(a_idx), jnp.asarray(b_idx)
+        dm = jnp.asarray(mul_mask)
+        with self.lock:
+            if not self.label_slots:
+                return [[] for _ in range(n)]
+            slots = list(self.label_slots.items())
+            pending = ops.scores_schema_combo(
+                self.state, duidx, dval, da, db, dm, self._mask())
+        sc = np.asarray(pending)[:n]
+        return [[(lab, float(row[slot]))
+                 for lab, slot in slots] for row in sc]
+
     def classify_hashed_schema(self, uidx: np.ndarray,
                                val: np.ndarray) -> List[List[Tuple[str, float]]]:
         """classify_hashed for a uniform-schema batch (ops.scores_schema:
@@ -324,17 +389,16 @@ class ClassifierDriver(DriverBase):
                  for lab, slot in slots] for row in sc]
 
     def classify(self, data: Sequence[Datum]) -> List[List[Tuple[str, float]]]:
-        # deliberately NOT @locked: the convert loop touches no driver
+        # deliberately NOT @locked: batch conversion touches no driver
         # state and classify_hashed takes the lock for exactly the
         # dispatch window — concurrent Datum-path queries overlap too
         if not data:
             return []
-        vectors = [self.converter.convert(d) for d in data]
-        sb = SparseBatch.from_vectors(vectors, batch_bucket=16)
+        sb = self.converter.convert_batch(data).to_padded(batch_bucket=16)
         out = self.classify_hashed(sb.idx, sb.val)
         if not out:
             return [[] for _ in data]
-        # from_vectors already row-bucketed; slice its pad rows back off
+        # to_padded already row-bucketed; slice its pad rows back off
         return out[: len(data)]
 
     def classify_hashed(self, idx: np.ndarray,
@@ -529,3 +593,12 @@ def _next_pow2(n: int) -> int:
     while p < n:
         p *= 2
     return p
+
+
+def _expand_combo_host(base_val: np.ndarray, a_idx: np.ndarray,
+                       b_idx: np.ndarray, mul_mask: np.ndarray) -> np.ndarray:
+    """Host-side mirror of ops._expand_combo (sequential train mode)."""
+    va = base_val[:, a_idx]
+    vb = base_val[:, b_idx]
+    slots = np.where(mul_mask[None, :], va * vb, va + vb)
+    return np.concatenate([base_val, slots], axis=1).astype(np.float32)

@@ -171,9 +171,13 @@ class ClassifierNNDriver(DriverBase):
     # -- training -------------------------------------------------------------
     @locked
     def train(self, data: List[Tuple[str, Datum]]) -> int:
-        for label, datum in data:
-            vec = self.converter.convert(datum, update_weights=True)
-            self.backend.set_row(uuid.uuid4().hex, vec, datum=str(label))
+        # batch featurization (one hash sweep + batch idf observe); the
+        # backend row inserts stay per-row — that is the row store's API
+        csr = self.converter.convert_batch(
+            [datum for _, datum in data], update_weights=True)
+        for i, (label, _datum) in enumerate(data):
+            self.backend.set_row(uuid.uuid4().hex, csr.row(i),
+                                 datum=str(label))
             if str(label) not in self.registered:
                 self._mark_label(str(label), True)
         self._invalidate_counts()
@@ -193,10 +197,11 @@ class ClassifierNNDriver(DriverBase):
     @locked
     def classify(self, data: List[Datum]) -> List[List[Tuple[str, float]]]:
         labels = sorted(self._label_counts())
+        csr = self.converter.convert_batch(list(data))
         out: List[List[Tuple[str, float]]] = []
-        for datum in data:
+        for i, _datum in enumerate(data):
             scores = {label: 0.0 for label in labels}
-            vec = self.converter.convert(datum)
+            vec = csr.row(i)
             for rid, dist in self.backend.neighbors(vec, self.k):
                 label = self.backend.store.datums.get(rid)
                 if label is None:

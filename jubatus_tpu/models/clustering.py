@@ -96,8 +96,12 @@ class ClusteringDriver(DriverBase):
     # -- update ----------------------------------------------------------------
     @locked
     def push(self, points: Sequence[Tuple[str, Datum]]) -> bool:
-        for row_id, datum in points:
-            vec = self.converter.convert(datum, update_weights=True)
+        # batch featurization: one hash sweep + one batch idf observe for
+        # the whole push (core/fv convert_batch)
+        csr = self.converter.convert_batch(
+            [datum for _, datum in points], update_weights=True)
+        for pos, (row_id, datum) in enumerate(points):
+            vec = csr.row(pos)
             i = self._id_pos.get(row_id)
             if i is not None:
                 self._datums[i], self._vecs[i] = datum, vec
@@ -306,7 +310,7 @@ class ClusteringDriver(DriverBase):
         # weights reproduce the original vectors
         if "fv_weights" in obj:
             self.converter.weights.unpack(obj["fv_weights"])
-        self._vecs = [self.converter.convert(d) for d in datums]
+        self._vecs = self.converter.convert_batch(datums).rows()
         self._weights = [float(w) for w in obj["weights"]]
         self._pending = int(obj.get("pending", 0))
         if self._vecs:

@@ -180,7 +180,8 @@ class RecommenderDriver(DriverBase):
 
     @locked
     def calc_l2norm(self, row: Datum) -> float:
-        return math.sqrt(sum(v * v for _, v in self.converter.convert(row)))
+        vec = self.converter.convert(row)  # one datum by contract
+        return math.sqrt(sum(v * v for _, v in vec))
 
     # -- mix plane -------------------------------------------------------------
     def get_mixables(self):

@@ -16,7 +16,7 @@ import numpy as np
 
 from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.core.fv import make_fv_converter
-from jubatus_tpu.core.sparse import SparseBatch, _bucket
+from jubatus_tpu.core.sparse import _bucket
 from jubatus_tpu.framework.driver import DriverBase, locked
 from jubatus_tpu.ops import regression as ops
 
@@ -62,27 +62,25 @@ class RegressionDriver(DriverBase):
         return ops.RegressionState(
             *(jax.device_put(a, self._sharding) for a in state))
 
-    @locked
+    def featurize_train(self, data: Sequence[Tuple[float, Datum]]):
+        """Stage-1 host featurization for the pipelined microbatch:
+        batch-convert WITHOUT the driver lock (the WeightManager has its
+        own lock for the batch idf observe). Returns the (targets, idx,
+        val) triple ``train_hashed`` consumes."""
+        targets = np.asarray([float(y) for y, _ in data], dtype=np.float32)
+        csr = self.converter.convert_batch(
+            [d for _, d in data], update_weights=True)
+        sb = csr.to_padded()
+        return targets, sb.idx, sb.val
+
     def train(self, data: Sequence[Tuple[float, Datum]]) -> int:
+        """Batch-native train: one convert_batch sweep into the
+        pre-hashed device path (train_hashed buckets rows to pow2 —
+        padded rows predict 0 for target 0 → loss 0 → no update)."""
         if not data:
             return 0
-        vectors = [self.converter.convert(d, update_weights=True) for _, d in data]
-        # batch_bucket bounds distinct compiled shapes (coalesced sizes
-        # vary per flush); padded rows predict 0 for target 0 → loss 0 →
-        # no update
-        sb = SparseBatch.from_vectors(vectors, batch_bucket=16)
-        targets = sb.pad_aux([float(y) for y, _ in data], dtype=np.float32)
-        self.state = ops.train_batch(
-            self.state,
-            jnp.asarray(sb.idx),
-            jnp.asarray(sb.val),
-            jnp.asarray(targets),
-            self.sensitivity,
-            self.c,
-            method=self.method,
-        )
-        self.event_model_updated(len(data))
-        return len(data)
+        targets, idx, val = self.featurize_train(data)
+        return self.train_hashed(targets, idx, val)
 
     @locked
     def train_hashed(self, targets: np.ndarray, idx: np.ndarray,
@@ -115,8 +113,7 @@ class RegressionDriver(DriverBase):
         # NOT @locked: estimate_hashed locks only its dispatch window
         if not data:
             return []
-        vectors = [self.converter.convert(d) for d in data]
-        sb = SparseBatch.from_vectors(vectors, batch_bucket=16)
+        sb = self.converter.convert_batch(data).to_padded(batch_bucket=16)
         return self.estimate_hashed(sb.idx, sb.val)[: len(data)]
 
     def estimate_hashed(self, idx: np.ndarray,

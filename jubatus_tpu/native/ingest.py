@@ -211,6 +211,20 @@ def spec_from_converter_config(conv: dict) -> Optional[str]:
 _FILTER_MEMO_MAX = 1 << 16
 
 
+def deferred_idf_scale(idx: np.ndarray, val: np.ndarray, weights,
+                       observe: bool) -> np.ndarray:
+    """Flush-time batch idf for a deferred-idf parser's output: observe
+    every document of the coalesced flush ONCE (train path), then scale
+    the raw sample-weighted values by log(ndocs/df) gathered over the
+    index matrix. ONE weights-lock acquisition per flush instead of one
+    serialized parse per request — the idf batch-collapse fix. Padding
+    entries (index 0, value 0) stay 0 (df 0 → factor 1.0 → 0.0)."""
+    if observe:
+        weights.observe_rows(idx)
+    w = weights.idf_many(idx.reshape(-1)).reshape(idx.shape)
+    return (val.astype(np.float64) * w).astype(np.float32)
+
+
 def _build_prefilters(conv: dict):
     """[(matcher, suffix, fn)] mirroring converter.Config's
     string_filter_rules, built from the same factories so behavior
@@ -252,6 +266,16 @@ class IngestParser:
         self.needs_weights = any(
             ln.split("\t")[3] == "idf"
             for ln in spec.split("\n") if ln.startswith("str\t"))
+        #: deferred-idf mode (from_converter_config sets it for pure-idf
+        #: configs): the parse emits RAW sample-weighted values — names
+        #: and hashes unchanged — against zeroed df tables (idf factor
+        #: 1.0, nothing observed, NO WeightManager lock), and the caller
+        #: applies observe + scaling once per coalesced FLUSH
+        #: (deferred_idf_scale). Fixes the idf batch-collapse: per-request
+        #: parses no longer serialize on the weights lock.
+        self.deferred_idf = False
+        self._zero_df: Optional[np.ndarray] = None
+        self._zero_nd: Optional[np.ndarray] = None
         self._handle = lib.jt_ingest_create(spec.encode())
         if not self._handle:
             raise ValueError(f"native ingest rejected spec: {spec!r}")
@@ -291,6 +315,17 @@ class IngestParser:
             return None
         if prefilters is not None:
             p._prefilters = prefilters
+        # pure-idf configs defer weighting to the flush: every feature
+        # the spec can emit is idf-weighted (all string rules idf, no
+        # num/combination rules), so post-merge scaling at flush time is
+        # exact — see deferred_idf_scale. Mixed specs keep the in-parse
+        # protocol (a post-merge scale would mis-weight hash collisions
+        # between idf and non-idf features).
+        if p.needs_weights and not conv.get("num_rules") \
+                and not conv.get("combination_rules") \
+                and all(r.get("global_weight") == "idf"
+                        for r in (conv.get("string_rules") or [])):
+            p.deferred_idf = True
         return p
 
     @staticmethod
@@ -314,6 +349,22 @@ class IngestParser:
                 weights._df_diff.ctypes.data_as(fp),
                 float(weights._ndocs_master),
                 weights._ndocs_diff.ctypes.data_as(dp))
+
+    def _zero_weight_args(self):
+        """Zeroed df tables for deferred-idf parses: df 0 → idf factor
+        1.0 (raw values out), observe 0 → nothing written — the parse
+        touches no shared state and needs no lock."""
+        import ctypes as ct
+
+        if self._zero_df is None:
+            self._zero_df = np.zeros(self._mask + 1, np.float32)
+            self._zero_nd = np.zeros(1, np.float64)
+        fp = ct.POINTER(ct.c_float)
+        dp = ct.POINTER(ct.c_double)
+        return (self._zero_df.ctypes.data_as(fp),
+                self._zero_df.ctypes.data_as(fp),
+                0.0,
+                self._zero_nd.ctypes.data_as(dp))
 
     def _apply_prefilters(self, sv: list) -> None:
         """Append filter outputs to one datum's string_values IN PLACE,
@@ -384,12 +435,18 @@ class IngestParser:
                 return None
         out = _Out()
         if self.needs_weights:
-            if weights is None:
-                return None
-            dfm, dfd, nm, nd = self._weight_args(weights)
-            rc = self._lib.jt_ingest_parse_w(
-                self._handle, raw, len(raw), self._mask, dfm, dfd, nm, nd,
-                1, ctypes.byref(out))
+            if self.deferred_idf:
+                dfm, dfd, nm, nd = self._zero_weight_args()
+                rc = self._lib.jt_ingest_parse_w(
+                    self._handle, raw, len(raw), self._mask, dfm, dfd, nm,
+                    nd, 0, ctypes.byref(out))
+            else:
+                if weights is None:
+                    return None
+                dfm, dfd, nm, nd = self._weight_args(weights)
+                rc = self._lib.jt_ingest_parse_w(
+                    self._handle, raw, len(raw), self._mask, dfm, dfd, nm,
+                    nd, 1, ctypes.byref(out))
         else:
             rc = self._lib.jt_ingest_parse(self._handle, raw, len(raw),
                                            self._mask, ctypes.byref(out))
@@ -444,9 +501,12 @@ class IngestParser:
                 return None
         out = _Out()
         if self.needs_weights:
-            if weights is None:
+            if self.deferred_idf:
+                dfm, dfd, nm, nd = self._zero_weight_args()
+            elif weights is None:
                 return None
-            dfm, dfd, nm, nd = self._weight_args(weights)
+            else:
+                dfm, dfd, nm, nd = self._weight_args(weights)
             rc = self._lib.jt_ingest_parse_datums_w(
                 self._handle, raw, len(raw), self._mask, dfm, dfd, nm, nd,
                 ctypes.byref(out))

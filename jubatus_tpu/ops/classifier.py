@@ -309,6 +309,38 @@ def scores_schema(state: ClassifierState, uidx: jax.Array, val: jax.Array,
     return jnp.where(label_mask[None, :], s, _NEG)
 
 
+def _expand_combo(base_val: jax.Array, a_idx: jax.Array, b_idx: jax.Array,
+                  mul_mask: jax.Array) -> jax.Array:
+    """Device-side combination-feature expansion for a uniform-schema
+    batch: the cross product's pair values are a bilinear function of the
+    [B, K0] BASE feature matrix, so the host ships K0-wide rows and the
+    device materializes the S combo slots itself — slot s =
+    base[:, a]*base[:, b] (mul) or base[:, a]+base[:, b] (add). The wire
+    and host-emit cost of the (K0 + S)-wide row (528 slots at the bench
+    shape) drops to K0. Padding rows are all-zero base rows, so every
+    slot value is 0 there (0*0 = 0+0 = 0) and the no-op guarantee holds.
+    Returns the full [B, K0 + S] value matrix aligned with the caller's
+    uidx = concat(base_idx_row, slot_idx)."""
+    va = jnp.take(base_val, a_idx, axis=1)
+    vb = jnp.take(base_val, b_idx, axis=1)
+    slots = jnp.where(mul_mask[None, :], va * vb, va + vb)
+    return jnp.concatenate([base_val, slots], axis=1)
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def scores_schema_combo(state: ClassifierState, uidx: jax.Array,
+                        base_val: jax.Array, a_idx: jax.Array,
+                        b_idx: jax.Array, mul_mask: jax.Array,
+                        label_mask: jax.Array) -> jax.Array:
+    """scores_schema with on-device combination expansion (see
+    _expand_combo): ``uidx`` is the full base+slot index vector, the host
+    ships only the base columns."""
+    val = _expand_combo(base_val, a_idx, b_idx, mul_mask)
+    eff_sub = jnp.take(state.w + state.dw, uidx, axis=1)
+    s = val @ eff_sub.T
+    return jnp.where(label_mask[None, :], s, _NEG)
+
+
 @functools.partial(jax.jit, static_argnames=("method",), donate_argnums=(0,))
 def train_batch_schema(
     state: ClassifierState,
@@ -340,6 +372,36 @@ def train_batch_schema(
     like the sparse scatter over repeated (b, k) slots, and padded
     columns carry val 0 so they contribute nothing.
     """
+    return _train_schema_impl(state, uidx, val, labels, label_mask, param,
+                              method)
+
+
+@functools.partial(jax.jit, static_argnames=("method",), donate_argnums=(0,))
+def train_batch_schema_combo(
+    state: ClassifierState,
+    uidx: jax.Array,       # [K0+S] int32 — base row + combo slot indices
+    base_val: jax.Array,   # [B, K0] float32 — base feature values only
+    a_idx: jax.Array,      # [S] int32 — left base column per slot
+    b_idx: jax.Array,      # [S] int32 — right base column per slot
+    mul_mask: jax.Array,   # [S] bool — mul (True) vs add per slot
+    labels: jax.Array,
+    label_mask: jax.Array,
+    param: float,
+    *,
+    method: str,
+) -> ClassifierState:
+    """train_batch_schema with on-device combination expansion: the host
+    ships the K0 base columns, the device materializes the S combo slots
+    (_expand_combo) and runs the identical dense schema update. The
+    caller guarantees ``uidx`` has no duplicate indices across base and
+    slots (the plan builder declines colliding schemas), so expansion +
+    schema update is exactly the merged per-datum feature vector."""
+    val = _expand_combo(base_val, a_idx, b_idx, mul_mask)
+    return _train_schema_impl(state, uidx, val, labels, label_mask, param,
+                              method)
+
+
+def _train_schema_impl(state, uidx, val, labels, label_mask, param, method):
     confidence = method in CONFIDENCE_METHODS
     w, dw, prec, dprec = state
     num_labels = w.shape[0]

@@ -85,6 +85,11 @@ class ServerArgs:
     #: RSS/FDs/threads/GC + JAX compile+cache+device-memory signals into
     #: get_status (runtime.*), /metrics, /healthz; 0 disables the thread
     telemetry_interval: float = 10.0
+    #: --fv-cache-size: bound (entries) for the feature pipeline's
+    #: tokenization/filter/name memo caches (core/fv/converter.py) — hot
+    #: repeated strings skip re-splitting/re-hashing; 0 disables
+    #: memoization
+    fv_cache_size: int = 65536
 
     @property
     def is_standalone(self) -> bool:
@@ -206,6 +211,11 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "(RSS/FDs/threads/GC + JAX compile/cache/device-"
                         "memory into get_status, /metrics, /healthz; "
                         "0 disables the sampler thread)")
+    p.add_argument("--fv-cache-size", type=int, default=65536,
+                   help="entry bound for the feature pipeline's "
+                        "tokenization/filter/name memo caches (repeated "
+                        "hot strings skip re-splitting and re-hashing); "
+                        "0 disables memoization")
     return p
 
 
@@ -230,6 +240,8 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
         raise SystemExit("--slowlog-quantile must be in (0, 1]")
     if args.telemetry_interval < 0:
         raise SystemExit("--telemetry-interval must be >= 0")
+    if args.fv_cache_size < 0:
+        raise SystemExit("--fv-cache-size must be >= 0")
     if not args.is_standalone and not args.name:
         raise SystemExit("distributed mode (-z) requires --name")
     return args

@@ -57,6 +57,11 @@ class EngineServer:
                     f"{len(devs)} local devices present")
             mesh = Mesh(devs, axis_names=("shard",))
         self.driver = create_driver(engine, json.loads(config), mesh=mesh)
+        # --fv-cache-size: rebound the converter's tokenization/name memo
+        # caches (core/fv/converter.py; default matches the flag default)
+        conv = getattr(self.driver, "converter", None)
+        if conv is not None and hasattr(conv, "set_cache_size"):
+            conv.set_cache_size(getattr(self.args, "fv_cache_size", 65536))
         self.start_time = time.time()  # wall-clock
         self.last_saved = 0.0
         self.last_loaded = 0.0

@@ -28,9 +28,10 @@ loopback: 10 clients × ``-c 8`` turned 100 train RPCs into 37 flushes.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, List, Sequence
 
-__all__ = ["Coalescer"]
+__all__ = ["Coalescer", "PipelinedCoalescer"]
 
 
 class _Ticket:
@@ -132,29 +133,39 @@ class Coalescer:
             raise ticket.error
         return ticket.result
 
+    def _claim(self):
+        """Pop the next batch (items + tickets + weight) under the lock;
+        None when the queue is empty (caller releases flusher duty).
+        Shared by the single-stage and pipelined drain loops."""
+        if not self._pending_tickets:
+            self._active = False
+            return None
+        batch: List[Any] = []
+        tickets: List[_Ticket] = []
+        batch_weight = 0
+        while self._pending_tickets and \
+                batch_weight + self._pending_tickets[0].weight \
+                <= self._max_batch:
+            t = self._pending_tickets.pop(0)
+            tickets.append(t)
+            batch_weight += t.weight
+            batch.extend(self._pending_items[:t.count])
+            del self._pending_items[:t.count]
+        if not tickets:  # one oversized submit: flush it alone
+            t = self._pending_tickets.pop(0)
+            tickets.append(t)
+            batch_weight += t.weight
+            batch.extend(self._pending_items[:t.count])
+            del self._pending_items[:t.count]
+        return batch, tickets, batch_weight
+
     def _drain(self) -> None:
         while True:
             with self._lock:
-                if not self._pending_tickets:
-                    self._active = False
+                claimed = self._claim()
+                if claimed is None:
                     return
-                batch: List[Any] = []
-                tickets: List[_Ticket] = []
-                batch_weight = 0
-                while self._pending_tickets and \
-                        batch_weight + self._pending_tickets[0].weight \
-                        <= self._max_batch:
-                    t = self._pending_tickets.pop(0)
-                    tickets.append(t)
-                    batch_weight += t.weight
-                    batch.extend(self._pending_items[:t.count])
-                    del self._pending_items[:t.count]
-                if not tickets:  # one oversized submit: flush it alone
-                    t = self._pending_tickets.pop(0)
-                    tickets.append(t)
-                    batch_weight += t.weight
-                    batch.extend(self._pending_items[:t.count])
-                    del self._pending_items[:t.count]
+                batch, tickets, batch_weight = claimed
             try:
                 result = self._flush(batch)
                 if self._split:
@@ -187,3 +198,149 @@ class Coalescer:
             "item_count": items,
             "avg_batch": (items / flushes if flushes else 0.0),
         }
+
+
+class PipelinedCoalescer(Coalescer):
+    """Two-stage coalescer: host featurization overlapped with the device
+    step (the feature pipeline's host/device overlap).
+
+    ``prep_fn(items) -> prepared`` is stage 1 (host: decode + batch
+    featurize); ``flush_fn(prepared)`` is stage 2 (device: upload +
+    kernel). The flusher thread preps batch N+1 while a dedicated device
+    worker consumes batch N — double-buffered (at most ONE prepared
+    batch waits, so prep can never run unboundedly ahead of the model
+    it trains against), with Coalescer's ticket/error semantics: a
+    stage-1 error fails exactly that batch's tickets immediately, a
+    stage-2 error fails them when the device stage completes.
+
+    Span stamping: when ``trace`` (a tracing Registry) is given, stage 1
+    records ``fv.convert`` and stage 2 ``fv.upload`` — the featurize vs
+    device split in ``jubactl -c trace``/get_status.
+
+    Overlap accounting: ``stats()`` adds prep/device seconds and
+    ``overlap_fraction`` — the share of host featurize time that ran
+    while the device stage was busy (time the pipeline hid)."""
+
+    def __init__(self, prep_fn: Callable[[List[Any]], Any],
+                 flush_fn: Callable[[Any], Any],
+                 max_batch: int = 8192,
+                 weigher: Callable[[Any], int] | None = None,
+                 trace: Any = None) -> None:
+        super().__init__(flush_fn, max_batch=max_batch, weigher=weigher)
+        self._prep = prep_fn
+        self._trace = trace
+        self._dev_lock = threading.Lock()
+        self._dev_ready = threading.Condition(self._dev_lock)
+        self._dev_queue: List[tuple] = []      # at most 1 prepared batch
+        self._dev_slot = threading.Semaphore(1)
+        self._dev_thread: threading.Thread | None = None
+        self._busy_lock = threading.Lock()
+        self._dev_busy_total = 0.0
+        self._dev_busy_since: float | None = None
+        self.prep_seconds = 0.0
+        self.device_seconds = 0.0
+        self.overlap_seconds = 0.0
+
+    # -- overlap accounting --------------------------------------------------
+    def _device_busy_seconds(self) -> float:
+        with self._busy_lock:
+            t = self._dev_busy_total
+            if self._dev_busy_since is not None:
+                t += time.perf_counter() - self._dev_busy_since
+            return t
+
+    def _finish(self, tickets: List[_Ticket], batch_weight: int) -> None:
+        with self._lock:
+            self.flush_count += 1
+            self.item_count += batch_weight
+        for t in tickets:
+            t.event.set()
+
+    def _ensure_worker(self) -> None:
+        if self._dev_thread is None or not self._dev_thread.is_alive():
+            self._dev_thread = threading.Thread(
+                target=self._device_loop, daemon=True,
+                name="microbatch-device")
+            self._dev_thread.start()
+
+    def _device_loop(self) -> None:
+        while True:
+            with self._dev_lock:
+                while not self._dev_queue:
+                    self._dev_ready.wait()
+                prepared, tickets, batch_weight = self._dev_queue.pop(0)
+            with self._busy_lock:
+                self._dev_busy_since = time.perf_counter()
+            try:
+                if self._trace is not None:
+                    with self._trace.span("fv.upload"):
+                        result = self._flush(prepared)
+                else:
+                    result = self._flush(prepared)
+                for t in tickets:
+                    t.result = result
+            except BaseException as e:  # noqa: BLE001 — deliver to callers
+                for t in tickets:
+                    t.error = e
+            finally:
+                with self._busy_lock:
+                    now = time.perf_counter()
+                    dt = now - self._dev_busy_since
+                    self._dev_busy_total += dt
+                    self.device_seconds += dt
+                    self._dev_busy_since = None
+                self._finish(tickets, batch_weight)
+                self._dev_slot.release()
+
+    def _drain(self) -> None:
+        while True:
+            with self._lock:
+                claimed = self._claim()
+                if claimed is None:
+                    return
+                batch, tickets, batch_weight = claimed
+            # stage 1 in THIS thread: overlaps whatever batch the device
+            # worker is currently consuming
+            t0 = time.perf_counter()
+            d0 = self._device_busy_seconds()
+            err: BaseException | None = None
+            prepared = None
+            try:
+                if self._trace is not None:
+                    with self._trace.span("fv.convert"):
+                        prepared = self._prep(batch)
+                else:
+                    prepared = self._prep(batch)
+            except BaseException as e:  # noqa: BLE001 — deliver to callers
+                err = e
+            d1 = self._device_busy_seconds()
+            dt = time.perf_counter() - t0
+            with self._busy_lock:
+                self.prep_seconds += dt
+                self.overlap_seconds += min(dt, max(d1 - d0, 0.0))
+            if err is not None:
+                for t in tickets:
+                    t.error = err
+                self._finish(tickets, batch_weight)
+                continue
+            # stage 2 handoff: block only when BOTH buffers are full
+            # (one in flight on the device + one prepared)
+            self._dev_slot.acquire()
+            self._ensure_worker()
+            with self._dev_lock:
+                self._dev_queue.append((prepared, tickets, batch_weight))
+                self._dev_ready.notify()
+
+    def stats(self) -> dict:
+        out = super().stats()
+        with self._busy_lock:
+            prep = self.prep_seconds
+            dev = self.device_seconds
+            ov = self.overlap_seconds
+        out.update(
+            prep_seconds=round(prep, 6),
+            device_seconds=round(dev, 6),
+            overlap_seconds=round(ov, 6),
+            overlap_fraction=round(ov / prep, 4) if prep > 0 else 0.0,
+        )
+        return out

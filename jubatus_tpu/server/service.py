@@ -17,6 +17,8 @@ from __future__ import annotations
 import logging
 from typing import Any, Callable, Dict, List
 
+import numpy as np
+
 from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.rpc.server import RpcServer
 
@@ -91,13 +93,150 @@ def _updating(server: Any, fn: Callable, count: Callable[[Any], int] = lambda r:
 # -- per-engine binders -------------------------------------------------------
 
 
+class _ComboPlanCache:
+    """Device-expansion plans for combination-rule configs, keyed by the
+    base index row (the feature schema). The C++ base parser ships only
+    the [B, K0] base columns; the plan carries the full base+slot index
+    vector and the (a, b, op) bilinear terms the device expands
+    (ops._expand_combo). Slot hashes and pair structure come from the
+    Python converter's own combo plan (core/fv/converter.py) — the
+    single owner of combination semantics — validated against the C++
+    row by hashing a sample datum's base names. Schemas the plan cannot
+    serve exactly (hash collisions, idf/user weights, multi-term slots)
+    are declined and the request falls back to the generic
+    batch-converter path with identical semantics."""
+
+    _MISS = object()
+
+    class Plan:
+        __slots__ = ("uidx", "a_idx", "b_idx", "mul_mask")
+
+        def __init__(self, uidx, a_idx, b_idx, mul_mask):
+            self.uidx = uidx
+            self.a_idx = a_idx
+            self.b_idx = b_idx
+            self.mul_mask = mul_mask
+
+    def __init__(self, conv: dict, converter) -> None:
+        self._conv = conv or {}
+        self._converter = converter  # the driver's full converter
+        self._plans: Dict[bytes, Any] = {}
+
+    def make_base_parser(self, dim_bits: int):
+        """The C++ parser for the config SANS combination rules (base
+        features only); None when that subset is not native-expressible."""
+        from jubatus_tpu.native.ingest import IngestParser
+
+        base_conv = {k: v for k, v in self._conv.items()
+                     if k != "combination_rules"}
+        try:
+            return IngestParser.from_converter_config(base_conv, dim_bits)
+        except Exception:  # broad-ok — plan mode is strictly optional
+            return None
+
+    def _plan_for(self, row0, raw_params: bytes, with_labels: bool):
+        key = row0.tobytes()
+        plan = self._plans.get(key, self._MISS)
+        if plan is not self._MISS:
+            return plan
+        plan = self._build(row0, raw_params, with_labels)
+        if len(self._plans) >= 64:
+            self._plans.clear()
+        self._plans[key] = plan
+        return plan
+
+    def _build(self, row0, raw_params: bytes, with_labels: bool):
+        import msgpack
+
+        from jubatus_tpu.core.datum import Datum
+
+        try:
+            req = msgpack.unpackb(raw_params, raw=False,
+                                  strict_map_key=False, use_list=True,
+                                  unicode_errors="surrogateescape")
+            wire = req[1][0][1] if with_labels else req[1][0]
+            datum = Datum.from_msgpack(wire)
+        except Exception:  # broad-ok — undecodable sample: decline plan
+            return None
+        conv = self._converter
+        named = conv._base_named_features(datum)
+        names = list(named)
+        live = row0[row0 != 0]
+        if len(names) != live.shape[0]:
+            return None  # hash collision merged base columns
+        idxs, kinds = conv._resolve_names(names)
+        order = np.argsort(idxs, kind="stable")
+        if not np.array_equal(idxs[order], live.astype(np.int32)):
+            return None  # sample's schema does not explain the row
+        if kinds.any():
+            return None  # base features must be bin-weighted
+        sorted_names = tuple(names[i] for i in order)
+        cplan = conv._combo_plan_for(sorted_names)
+        if cplan.slot_kind.any():
+            return None  # combo slots must be bin-weighted
+        if cplan.t_starts.shape[0] != cplan.a_idx.shape[0]:
+            return None  # multi-term slots: host semantics required
+        nz = np.concatenate([live.astype(np.int32), cplan.slot_idx])
+        if np.unique(nz).shape[0] != nz.shape[0]:
+            return None  # index collision: expansion would double-count
+        uidx = np.concatenate([row0.astype(np.int32), cplan.slot_idx])
+        return self.Plan(uidx, cplan.a_idx, cplan.b_idx,
+                         cplan.mul_mask.astype(bool))
+
+    def parse_train(self, base_parser, raw_params: bytes):
+        """Raw train params -> a coalescer item riding the device-
+        expansion plan, or RAW_FALLBACK (generic path, same semantics)."""
+        from jubatus_tpu.rpc.server import RAW_FALLBACK
+
+        parsed = base_parser.parse_indexed(raw_params)
+        if parsed is None:
+            return RAW_FALLBACK
+        labels, idx, val = parsed
+        if isinstance(labels, np.ndarray):
+            return RAW_FALLBACK  # numeric labels on a classifier wire
+        b = idx.shape[0]
+        if b == 0:
+            return RAW_FALLBACK
+        row0 = idx[0]
+        if b > 1 and not (idx == row0).all():
+            return RAW_FALLBACK  # mixed schemas in one request
+        plan = self._plan_for(row0, raw_params, with_labels=True)
+        if plan is None:
+            return RAW_FALLBACK
+        return (("combo", plan), labels, idx, val)
+
+    def parse_query(self, base_parser, raw_params: bytes):
+        """Raw datum-list params -> (plan, base_val) or RAW_FALLBACK."""
+        from jubatus_tpu.rpc.server import RAW_FALLBACK
+
+        parsed = base_parser.parse_datums(raw_params)
+        if parsed is None:
+            return RAW_FALLBACK
+        idx, val = parsed
+        if idx.shape[0] == 0:
+            return (None, val)
+        row0 = idx[0]
+        if idx.shape[0] > 1 and not (idx == row0).all():
+            return RAW_FALLBACK
+        plan = self._plan_for(row0, raw_params, with_labels=False)
+        if plan is None:
+            return RAW_FALLBACK
+        return (plan, val)
+
+
 def _register_train(rpc: RpcServer, server: Any, decode_pair,
                     train_fn) -> None:
     """Register "train" with microbatch coalescing (server/microbatch.py):
     concurrent train RPCs merge into one driver/device batch — SURVEY.md
     §7 step 4's ingest queue. ``--microbatch-max 0`` restores the direct
     per-RPC path. Either way each caller's reply is its own item count
-    (the reference's per-call return, classifier_impl.cpp:56-59)."""
+    (the reference's per-call return, classifier_impl.cpp:56-59).
+
+    Drivers exposing the featurize/apply split (``featurize_train`` +
+    ``train_hashed``) ride the two-stage PipelinedCoalescer: batch N+1
+    featurizes on the flusher's host thread (span ``fv.convert``) while
+    the device consumes batch N (span ``fv.upload``) — the feature
+    pipeline's host/device overlap."""
     max_batch = getattr(server.args, "microbatch_max", 8192)
     flush = _updating(server, train_fn, count=lambda r: r)
     if not max_batch:
@@ -107,9 +246,21 @@ def _register_train(rpc: RpcServer, server: Any, decode_pair,
             arity=2,
         )
         return
-    from jubatus_tpu.server.microbatch import Coalescer
+    driver = server.driver
+    featurize = getattr(driver, "featurize_train", None)
+    apply_fn = getattr(driver, "train_hashed", None)
+    if featurize is not None and apply_fn is not None:
+        from jubatus_tpu.server.microbatch import PipelinedCoalescer
 
-    co = Coalescer(flush, max_batch=max_batch)
+        device_step = _updating(
+            server, lambda prepared: apply_fn(*prepared),
+            count=lambda r: r)
+        co = PipelinedCoalescer(featurize, device_step,
+                                max_batch=max_batch, trace=rpc.trace)
+    else:
+        from jubatus_tpu.server.microbatch import Coalescer
+
+        co = Coalescer(flush, max_batch=max_batch)
     server.coalescers["train"] = co
 
     # -t 0 conventionally means "no timeout" — map to an unbounded wait
@@ -151,8 +302,6 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
         return
     from jubatus_tpu.rpc.server import RAW_FALLBACK
 
-    import numpy as np
-
     def _pad_concat(pairs):
         """Merge per-request (idx, val) pairs into one batch: pad widths
         to the max (already pow2-bucketed by the parser, so pads are rare
@@ -186,80 +335,156 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
         return row0
 
     schema_train = getattr(driver, "train_indexed_schema", None)
+    combo_train = getattr(driver, "train_indexed_combo", None)
     # schema-plan accounting, surfaced by get_status ("ingest.*" keys,
     # server/base.py) and the e2e bench: how often flushes actually ride
     # the dense submatrix plan
     stats = server.ingest_stats = {"schema_flushes": 0, "sparse_flushes": 0,
+                                   "combo_flushes": 0,
                                    "schema_query_flushes": 0,
                                    "sparse_query_flushes": 0}
 
-    def flush_requests(reqs):
-        """Each item is one request's (labels, idx [B,K], val [B,K]).
-        ``labels`` is a float32 target array (regression) or a
-        (uniq_labels, label_idx) pair from the C++ dedup — merging unions
-        the uniq sets and remaps each request's index array, so no
-        per-example Python loop ever runs."""
-        if not reqs:
-            return 0
-        if numeric:
-            idx, val = _pad_concat([(ir, vr) for _lb, ir, vr in reqs])
-            labels = np.concatenate([r[0] for r in reqs]) \
-                if len(reqs) > 1 else reqs[0][0]
-            return driver.train_hashed(labels, idx, val)
+    # deferred-idf (pure-idf specs): parses run lock-free against zero df
+    # tables; ONE observe+scale per coalesced flush (the idf
+    # batch-collapse fix — see native/ingest.py deferred_idf_scale)
+    deferred = parser.deferred_idf
+    weights = driver.converter.weights \
+        if (parser.needs_weights or deferred) else None
+
+    # combo device plan (classifier combo configs): parse only the BASE
+    # features in C++, expand the cross product ON DEVICE
+    # (ops.train_batch_schema_combo) — the (K0+S)-wide row never crosses
+    # the host/device wire. None when ineligible; requests the plan
+    # cannot serve fall back to the generic batch-converter path.
+    combo_ctx = None
+    if combo_train is not None and not numeric \
+            and (conv or {}).get("combination_rules"):
+        combo_ctx = _ComboPlanCache(conv, driver.converter)
+        base_parser = combo_ctx.make_base_parser(
+            driver.converter.hasher.dim_bits)
+        if base_parser is None:
+            combo_ctx = None
+
+    def _merge_labels(label_pairs):
+        """Union per-request (uniq_labels, label_idx) pairs into one
+        distinct-label list + remapped int32 row index — no per-example
+        Python loop (the C++ dedup did the heavy lifting)."""
         label_map: dict = {}
         parts_l = []
-        for lb, _ir, _vr in reqs:
-            uniq, lidx = lb
+        for uniq, lidx in label_pairs:
             lut = np.empty(len(uniq), np.int32)
             for j, u in enumerate(uniq):
                 lut[j] = label_map.setdefault(u, len(label_map))
             parts_l.append(lut[lidx])
         lidx = np.concatenate(parts_l) if len(parts_l) > 1 else parts_l[0]
-        if schema_train is not None:
-            row0 = _uniform_row([(ir, vr) for _lb, ir, vr in reqs])
+        return list(label_map), lidx
+
+    def prep_requests(reqs):
+        """Stage 1 (host) of the pipelined flush: merge per-request
+        arrays into ONE device-ready batch — label-map union, width
+        pad+concat, deferred-idf observe+scale, execution-plan selection.
+        Runs on the flusher thread while the device consumes the
+        previous batch."""
+        if not reqs:
+            return None
+        if reqs[0][0][0] == "combo":
+            # (("combo", plan), labels, base_idx, base_val): group by
+            # plan (one group for a fixed-schema feed) for the
+            # device-expansion path
+            groups: dict = {}
+            for tag, lb, _ir, vr in reqs:
+                entry = groups.setdefault(id(tag[1]), (tag[1], [], []))
+                entry[1].append(lb)
+                entry[2].append(vr)
+            out = []
+            for plan, lbs, vals in groups.values():
+                uniq, lidx = _merge_labels(lbs)
+                val = np.concatenate(vals) if len(vals) > 1 else vals[0]
+                out.append((uniq, lidx, plan, val))
+            stats["combo_flushes"] += 1
+            return ("combo", out)
+        if numeric:
+            idx, val = _pad_concat([(ir, vr) for _t, _lb, ir, vr in reqs])
+            labels = np.concatenate([r[1] for r in reqs]) \
+                if len(reqs) > 1 else reqs[0][1]
+            return ("numeric", labels, idx, val)
+        uniq, lidx = _merge_labels([lb for _t, lb, _i, _v in reqs])
+        if schema_train is not None and not deferred:
+            row0 = _uniform_row([(ir, vr) for _t, _lb, ir, vr in reqs])
             if row0 is not None:
                 stats["schema_flushes"] += 1
-                val = np.concatenate([vr for _lb, _ir, vr in reqs]) \
-                    if len(reqs) > 1 else reqs[0][2]
-                return schema_train(list(label_map), lidx, row0, val)
+                val = np.concatenate([vr for _t, _lb, _ir, vr in reqs]) \
+                    if len(reqs) > 1 else reqs[0][3]
+                return ("schema", uniq, lidx, row0, val)
         stats["sparse_flushes"] += 1
-        idx, val = _pad_concat([(ir, vr) for _lb, ir, vr in reqs])
-        return driver.train_indexed(list(label_map), lidx, idx, val)
+        idx, val = _pad_concat([(ir, vr) for _t, _lb, ir, vr in reqs])
+        if deferred:
+            from jubatus_tpu.native.ingest import deferred_idf_scale
 
-    flush = _updating(server, flush_requests, count=lambda r: r)
+            val = deferred_idf_scale(idx, val, weights, observe=True)
+        return ("sparse", uniq, lidx, idx, val)
+
+    def apply_prepared(prepared):
+        """Stage 2 (device): dispatch the prepared batch onto the
+        matching driver plan."""
+        if prepared is None:
+            return 0
+        kind = prepared[0]
+        if kind == "combo":
+            n = 0
+            for uniq, lidx, plan, val in prepared[1]:
+                n += combo_train(uniq, lidx, plan.uidx, val,
+                                 plan.a_idx, plan.b_idx, plan.mul_mask)
+            return n
+        if kind == "numeric":
+            return driver.train_hashed(prepared[1], prepared[2], prepared[3])
+        if kind == "schema":
+            return schema_train(prepared[1], prepared[2], prepared[3],
+                                prepared[4])
+        return driver.train_indexed(prepared[1], prepared[2], prepared[3],
+                                    prepared[4])
+
     max_batch = getattr(server.args, "microbatch_max", 8192)
     wait_s = server.args.timeout * 6 if server.args.timeout > 0 else None
+    device_step = _updating(server, apply_prepared, count=lambda r: r)
     if max_batch:
-        from jubatus_tpu.server.microbatch import Coalescer
+        from jubatus_tpu.server.microbatch import (Coalescer,
+                                                   PipelinedCoalescer)
 
-        co = Coalescer(flush, max_batch=max_batch,
-                       weigher=lambda item: item[1].shape[0])
+        co = PipelinedCoalescer(
+            prep_requests, device_step, max_batch=max_batch,
+            weigher=lambda item: item[2].shape[0], trace=rpc.trace)
         server.coalescers["train_raw"] = co
-
-    # idf specs observe documents + scale against the converter's df
-    # tables at parse time (in C++); the WeightManager lock serializes
-    # that in-place mutation against mixes/unpacks swapping the buffers
-    weights = driver.converter.weights if parser.needs_weights else None
+    trace = rpc.trace
 
     def train_raw(raw_params: bytes):
-        if weights is not None:
-            with weights.lock:
-                parsed = parser.parse_indexed(raw_params, weights=weights)
-        else:
-            parsed = parser.parse_indexed(raw_params)
-        if parsed is None:
-            return RAW_FALLBACK
-        labels, idx, val = parsed
-        if numeric != isinstance(labels, np.ndarray):
-            return RAW_FALLBACK  # label kind mismatch: let the generic
-            # path produce the proper type error
-        n = idx.shape[0]
+        with trace.span("fv.convert"):
+            if combo_ctx is not None:
+                item = combo_ctx.parse_train(base_parser, raw_params)
+                if item is RAW_FALLBACK:
+                    return RAW_FALLBACK  # generic batch-converter path
+            elif weights is not None and not deferred:
+                with weights.lock:
+                    parsed = parser.parse_indexed(raw_params,
+                                                  weights=weights)
+            else:
+                # deferred idf / unweighted: lock-free parallel parse
+                parsed = parser.parse_indexed(raw_params)
+        if combo_ctx is None:
+            if parsed is None:
+                return RAW_FALLBACK
+            labels, idx, val = parsed
+            if numeric != isinstance(labels, np.ndarray):
+                return RAW_FALLBACK  # label kind mismatch: let the
+                # generic path produce the proper type error
+            item = (("plain",), labels, idx, val)
+        n = item[2].shape[0]
         if n == 0:
             return 0
         if max_batch:
-            co.submit([(labels, idx, val)], timeout=wait_s)
+            co.submit([item], timeout=wait_s)
         else:
-            flush([(labels, idx, val)])
+            device_step(prep_requests([item]))
         return n
 
     rpc.register_raw("train", train_raw)
@@ -267,6 +492,17 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
     # the query path rides the same parser: [name, [datum, ...]] -> hashed
     # batch -> snapshot-read scores, no Datum objects
     def _parse_datums(raw_params: bytes):
+        if deferred:
+            # lock-free parse, then one vectorized idf gather (queries
+            # read idf, never observe)
+            parsed = parser.parse_datums(raw_params)
+            if parsed is None:
+                return None
+            from jubatus_tpu.native.ingest import deferred_idf_scale
+
+            idx, val = parsed
+            return idx, deferred_idf_scale(idx, val, weights,
+                                           observe=False)
         if weights is not None:
             with weights.lock:  # queries read idf, never observe
                 return parser.parse_datums(raw_params, weights=weights)
@@ -313,7 +549,8 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
         server.coalescers[name] = qco
 
         def raw_handler(raw_params: bytes):
-            parsed = _parse_datums(raw_params)
+            with trace.span("fv.convert"):
+                parsed = _parse_datums(raw_params)
             if parsed is None:
                 return RAW_FALLBACK
             idx, val = parsed
@@ -336,6 +573,20 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
                 return driver.estimate_hashed(*parsed)
 
             rpc.register_raw("estimate", estimate_raw)
+    elif combo_ctx is not None and hasattr(driver, "classify_hashed_combo"):
+        def classify_combo_raw(raw_params: bytes):
+            with trace.span("fv.convert"):
+                out = combo_ctx.parse_query(base_parser, raw_params)
+            if out is RAW_FALLBACK:
+                return RAW_FALLBACK
+            plan, val = out
+            if plan is None:
+                return []
+            rows = driver.classify_hashed_combo(
+                plan.uidx, val, plan.a_idx, plan.b_idx, plan.mul_mask)
+            return [_scored(r) for r in rows]
+
+        rpc.register_raw("classify", classify_combo_raw)
     elif not numeric and hasattr(driver, "classify_hashed"):
         if max_batch:
             schema_cls = getattr(driver, "classify_hashed_schema", None)

@@ -42,7 +42,10 @@
 #include <charconv>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
+#include <locale>
+#include <sstream>
 #include <cstring>
 #include <deque>
 #include <unordered_map>
@@ -64,6 +67,23 @@ struct CrcTable {
   }
 };
 const CrcTable kCrc;
+
+// Locale-independent f64 parse for spec literals (create-time only).
+// from_chars where the toolchain has it (GCC 11+); classic-locale
+// istringstream otherwise — never plain strtod, which honors LC_NUMERIC.
+inline double parse_spec_f64(const std::string& s) {
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+  double v = 0.0;
+  std::from_chars(s.data(), s.data() + s.size(), v);
+  return v;
+#else
+  std::istringstream is(s);
+  is.imbue(std::locale::classic());
+  double v = 0.0;
+  is >> v;
+  return v;
+#endif
+}
 
 inline uint32_t crc32_update(uint32_t c, const uint8_t* p, size_t n) {
   for (size_t i = 0; i < n; ++i) c = kCrc.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
@@ -467,7 +487,25 @@ size_t format_num(double v, char* buf) {
   if (v == std::floor(v) && std::isfinite(v)) return 0;  // huge integral
   if (!std::isfinite(v)) return 0;  // nan/inf: Python renders differently
   char sci[48];
-  auto r = std::to_chars(sci, sci + 48, v, std::chars_format::scientific);
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+  auto tcr = std::to_chars(sci, sci + 48, v, std::chars_format::scientific);
+  char* sci_end = tcr.ptr;
+#else
+  // libstdc++ < 11 has no floating-point to_chars: produce the same
+  // shortest-round-trip scientific digits by minimal-precision printf +
+  // strtod round-trip check (both are correctly rounded, so the digit
+  // string is identical for the shortest precision that round-trips)
+  char* sci_end = sci;
+  for (int prec = 0; prec <= 17; ++prec) {
+    int n = snprintf(sci, sizeof sci, "%.*e", prec, v);
+    if (n <= 0) return 0;
+    if (std::strtod(sci, nullptr) == v) {
+      sci_end = sci + n;
+      break;
+    }
+  }
+  if (sci_end == sci) return 0;
+#endif
   // parse "[-]d[.ddd]e±EE"
   char* p = sci;
   char* out = buf;
@@ -480,7 +518,7 @@ size_t format_num(double v, char* buf) {
   digits[nd++] = *p++;
   if (*p == '.') {
     ++p;
-    while (p < r.ptr && *p != 'e') digits[nd++] = *p++;
+    while (p < sci_end && *p != 'e') digits[nd++] = *p++;
   }
   int exp10 = 0;
   {
@@ -492,7 +530,7 @@ size_t format_num(double v, char* buf) {
     } else if (*p == '+') {
       ++p;
     }
-    while (p < r.ptr) exp10 = exp10 * 10 + (*p++ - '0');
+    while (p < sci_end) exp10 = exp10 * 10 + (*p++ - '0');
     if (neg) exp10 = -exp10;
   }
   if (-4 <= exp10 && exp10 < 16) {  // fixed
@@ -587,8 +625,8 @@ void* jt_ingest_create(const char* spec) {
       // from_chars: locale-INDEPENDENT ("5.5" must not parse as 5.0
       // under an LC_NUMERIC with a comma separator smuggled in by some
       // other module in the host process)
-      std::from_chars(f[2].data(), f[2].data() + f[2].size(), nf.a);
-      std::from_chars(f[3].data(), f[3].data() + f[3].size(), nf.b);
+      nf.a = parse_spec_f64(f[2]);
+      nf.b = parse_spec_f64(f[3]);
       nf.m = Matcher::make(f[4]);
       nf.suffix = f[5];
       ps->num_filters.push_back(std::move(nf));

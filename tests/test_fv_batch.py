@@ -1,0 +1,365 @@
+"""Batch feature-extraction pipeline tests (ISSUE 5).
+
+Parity suite: ``convert_batch`` must reproduce per-datum ``convert``
+(indices, values, idf weights, combination rules, num filters) across
+every converter block shipped in config/, plus CSR packing, memo-cache
+correctness under weight updates, the reverse-map capacity bound on the
+batch hash paths, and the vectorized WeightManager lookups.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from jubatus_tpu.core import Datum
+from jubatus_tpu.core.fv import make_fv_converter
+from jubatus_tpu.core.fv.hashing import FeatureHasher
+from jubatus_tpu.core.fv.weight_manager import WeightManager
+from jubatus_tpu.core.sparse import CSRBatch, SparseBatch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: converter blocks exercising every weighting/combination axis directly
+SYNTH_CONFIGS = {
+    "num": {"num_rules": [{"key": "*", "type": "num"}]},
+    "text_tf": {"string_rules": [
+        {"key": "*", "type": "space", "sample_weight": "tf",
+         "global_weight": "bin"}]},
+    "text_log_tf_idf": {"string_rules": [
+        {"key": "*", "type": "space", "sample_weight": "log_tf",
+         "global_weight": "idf"}]},
+    "ngram_idf": {
+        "string_types": {"bigram": {"method": "ngram", "char_num": "2"}},
+        "string_rules": [
+            {"key": "*", "type": "bigram", "sample_weight": "bin",
+             "global_weight": "idf"}]},
+    "combo_mul": {
+        "num_rules": [{"key": "*", "type": "num"}],
+        "combination_rules": [
+            {"key_left": "*", "key_right": "*", "type": "mul"}]},
+    "combo_add_matchers": {
+        "num_rules": [{"key": "*", "type": "num"}],
+        "string_rules": [
+            {"key": "*", "type": "str", "sample_weight": "bin",
+             "global_weight": "bin"}],
+        "combination_types": {"plus": {"method": "add"}},
+        "combination_rules": [
+            {"key_left": "f*", "key_right": "*", "type": "plus"},
+            {"key_left": "*", "key_right": "*str*", "type": "mul"}]},
+    "filters": {
+        "string_filter_types": {
+            "detag": {"method": "regexp", "pattern": "<[^>]*>",
+                      "replace": ""}},
+        "string_filter_rules": [
+            {"key": "t*", "type": "detag", "suffix": "-detag"}],
+        "num_filter_types": {
+            "add5": {"method": "add", "value": "5"},
+            "lin": {"method": "linear_normalization", "min": "0",
+                    "max": "10"}},
+        "num_filter_rules": [
+            {"key": "f*", "type": "add5", "suffix": "+5"},
+            {"key": "f*", "type": "lin", "suffix": "_lin"}],
+        "string_rules": [
+            {"key": "*", "type": "space", "sample_weight": "tf",
+             "global_weight": "bin"}],
+        "num_rules": [{"key": "*", "type": "num"}]},
+    "user_weight": {"string_rules": [
+        {"key": "*", "type": "space", "sample_weight": "bin",
+         "global_weight": "weight"}]},
+}
+
+WORDS = ["alpha", "beta", "gamma", "delta", "eps", "zeta", "eta", "theta"]
+
+
+def _mk_datum(rng, i):
+    sv = [("t", " ".join(rng.choice(WORDS)
+                         for _ in range(rng.randint(0, 9)))),
+          ("title", "<p>%s</p>" % rng.choice(WORDS))]
+    nv = [("f%d" % j, rng.uniform(-3, 3)) for j in range(rng.randint(0, 4))]
+    if rng.random() < 0.3:
+        nv.append(("count", float(rng.randint(0, 50))))
+    return Datum(string_values=sv, num_values=nv)
+
+
+def _assert_csr_equals_vectors(csr, vectors, tag=""):
+    ref = CSRBatch.from_vectors(vectors)
+    np.testing.assert_array_equal(csr.row_offsets, ref.row_offsets, err_msg=tag)
+    np.testing.assert_array_equal(csr.indices, ref.indices, err_msg=tag)
+    np.testing.assert_array_equal(csr.values, ref.values, err_msg=tag)
+
+
+@pytest.mark.parametrize("name", sorted(SYNTH_CONFIGS))
+def test_convert_batch_parity_synthetic(name):
+    import random
+
+    rng = random.Random(hash(name) & 0xFFFF)
+    conf = SYNTH_CONFIGS[name]
+    per = make_fv_converter(conf, dim_bits=16)
+    bat = make_fv_converter(conf, dim_bits=16)
+    if name == "user_weight":
+        for w, c in ((per, 2.5), (bat, 2.5)):
+            idx = w.hasher.index("t$alpha@space#bin/weight")
+            w.weights.set_user_weight(idx, c)
+    data = [_mk_datum(rng, i) for i in range(30)]
+    vectors = [per.convert(d) for d in data]
+    csr = bat.convert_batch(data)
+    _assert_csr_equals_vectors(csr, vectors, name)
+    # repeat: memo caches must not change anything
+    csr2 = bat.convert_batch(data)
+    _assert_csr_equals_vectors(csr2, vectors, name + "/memo")
+
+
+def test_convert_batch_parity_every_shipped_config():
+    """Every converter block in config/ (the shipped reference configs,
+    incl. idf global weights and combination rules) must produce
+    identical output through both pipelines."""
+    import random
+
+    rng = random.Random(5)
+    paths = sorted(glob.glob(os.path.join(REPO, "config", "*", "*.json")))
+    assert paths
+    data = [_mk_datum(rng, i) for i in range(12)]
+    checked = 0
+    for path in paths:
+        with open(path) as f:
+            cfg = json.load(f)
+        if "converter" not in cfg:
+            continue
+        per = make_fv_converter(cfg["converter"], dim_bits=12)
+        bat = make_fv_converter(cfg["converter"], dim_bits=12)
+        vectors = [per.convert(d) for d in data]
+        csr = bat.convert_batch(data)
+        _assert_csr_equals_vectors(csr, vectors, path)
+        checked += 1
+    assert checked >= 10
+
+
+def test_convert_batch_idf_update_semantics():
+    """update_weights=True observes the WHOLE batch first (the idf
+    batch-collapse fix), so every row's idf reflects the full batch —
+    equal to per-datum 'observe all, then convert' and to per-datum
+    sequential convert for batch size 1."""
+    import random
+
+    rng = random.Random(9)
+    conf = SYNTH_CONFIGS["text_log_tf_idf"]
+    data = [_mk_datum(rng, i) for i in range(14)]
+
+    bat = make_fv_converter(conf, dim_bits=16)
+    csr = bat.convert_batch(data, update_weights=True)
+
+    ref = make_fv_converter(conf, dim_bits=16)
+    for d in data:  # observe phase (per-datum convert's df bookkeeping)
+        named = ref.convert_named(d)
+        idf_idx = {ref.hasher.index(n) for n in named if n.endswith("/idf")}
+        if idf_idx:
+            ref.weights.observe(idf_idx)
+    vectors = [ref.convert(d) for d in data]
+    _assert_csr_equals_vectors(csr, vectors, "idf-batch")
+    # ndocs counts only documents that carried idf features
+    assert bat.weights.ndocs == ref.weights.ndocs
+    np.testing.assert_array_equal(bat.weights._df_diff, ref.weights._df_diff)
+
+    # batch size 1 == per-datum sequential, document by document
+    seq = make_fv_converter(conf, dim_bits=16)
+    one = make_fv_converter(conf, dim_bits=16)
+    for d in data:
+        v = seq.convert(d, update_weights=True)
+        c = one.convert_batch([d], update_weights=True)
+        _assert_csr_equals_vectors(c, [v], "b1")
+
+
+def test_memo_cache_never_serves_stale_idf():
+    """The memo caches hold tokenizations/hashes only — after the df
+    state moves (more documents observed), the SAME input string must
+    come out with the NEW idf weighting."""
+    conf = {"string_rules": [
+        {"key": "*", "type": "space", "sample_weight": "bin",
+         "global_weight": "idf"}]}
+    conv = make_fv_converter(conf, dim_bits=16)
+    d = Datum({"t": "common rare"})
+    first = conv.convert_batch([d], update_weights=True)
+    # shift the weights: 'common' appears in 3 more docs
+    for _ in range(3):
+        conv.convert_batch([Datum({"t": "common"})], update_weights=True)
+    again = conv.convert_batch([d])  # same string, memoized tokenization
+    name_c = "t$common@space#bin/idf"
+    name_r = "t$rare@space#bin/idf"
+    ic, ir = conv.hasher.index(name_c), conv.hasher.index(name_r)
+    vals = dict(zip(again.indices.tolist(), again.values.tolist()))
+    assert vals[ic] == pytest.approx(math.log(4 / 4))
+    assert vals[ir] == pytest.approx(math.log(4 / 1))
+    # and the first conversion saw the then-current (1-doc) state
+    vals0 = dict(zip(first.indices.tolist(), first.values.tolist()))
+    assert vals0[ic] == pytest.approx(math.log(1 / 1))
+    # user weights too: set after first conversion, must apply at once
+    conf_w = {"string_rules": [
+        {"key": "*", "type": "space", "sample_weight": "bin",
+         "global_weight": "weight"}]}
+    cw = make_fv_converter(conf_w, dim_bits=16)
+    dw = Datum({"t": "x"})
+    before = cw.convert_batch([dw])
+    iw = cw.hasher.index("t$x@space#bin/weight")
+    cw.weights.set_user_weight(iw, 7.0)
+    after = cw.convert_batch([dw])
+    assert dict(zip(before.indices.tolist(),
+                    before.values.tolist()))[iw] == 1.0
+    assert dict(zip(after.indices.tolist(),
+                    after.values.tolist()))[iw] == 7.0
+
+
+def test_cache_disabled_still_correct():
+    conf = SYNTH_CONFIGS["text_tf"]
+    a = make_fv_converter(conf, dim_bits=16, cache_size=0)
+    b = make_fv_converter(conf, dim_bits=16)
+    d = [Datum({"t": "a b a c"}), Datum({"t": "a b a c"})]
+    ca, cb = a.convert_batch(d), b.convert_batch(d)
+    np.testing.assert_array_equal(ca.indices, cb.indices)
+    np.testing.assert_array_equal(ca.values, cb.values)
+    assert not a._token_memo and not a._name_memo
+
+
+def test_cache_bound_holds():
+    conv = make_fv_converter(SYNTH_CONFIGS["text_tf"], dim_bits=16)
+    conv.set_cache_size(8)
+    for i in range(100):
+        conv.convert_batch([Datum({"t": "tok%d" % i})])
+    assert len(conv._token_memo) <= 8
+    assert len(conv._name_memo) <= 8
+
+
+# -- hasher batch paths ------------------------------------------------------
+
+def test_index_array_matches_index():
+    h = FeatureHasher(dim_bits=14)
+    names = ["feat%d" % i for i in range(200)] + ["éא", ""]
+    arr = h.index_array(names)
+    assert arr.dtype == np.int32
+    assert [h.index(n) for n in names] == arr.tolist()
+    assert (arr != 0).all()
+
+
+def test_reverse_capacity_bound_on_batch_paths():
+    """Regression (ISSUE 5 satellite): every batch hash path must honor
+    reverse_capacity — one oversized batch must not blow past the
+    bound."""
+    for method in ("index_many", "index_array"):
+        h = FeatureHasher(dim_bits=16, reverse_capacity=10)
+        getattr(h, method)(["n%d" % i for i in range(500)])
+        assert len(h._reverse) <= 10
+        # and remember=False records nothing
+        h2 = FeatureHasher(dim_bits=16, reverse_capacity=10)
+        getattr(h2, method)(["n%d" % i for i in range(50)],
+                            remember=False)
+        assert not h2._reverse
+
+
+# -- vectorized weight manager ----------------------------------------------
+
+def test_weight_manager_vectorized_lookups():
+    wm = WeightManager(1 << 10)
+    wm.observe([3, 5])
+    wm.observe([5])
+    wm.observe([7])
+    idx = np.array([3, 5, 7, 9])
+    np.testing.assert_allclose(
+        wm.idf_many(idx), [wm.idf(3), wm.idf(5), wm.idf(7), wm.idf(9)])
+    wm.set_user_weight(9, 4.0)
+    np.testing.assert_allclose(
+        wm.user_weight_many(idx), [1.0, 1.0, 1.0, 4.0])
+
+
+def test_observe_batch_dedups_per_document():
+    a = WeightManager(1 << 10)
+    b = WeightManager(1 << 10)
+    docs = [[3, 5, 3], [5, 5], [7]]
+    for d in docs:
+        a.observe(set(d))
+    flat = np.concatenate([np.asarray(d) for d in docs])
+    rows = np.concatenate([np.full(len(d), i) for i, d in enumerate(docs)])
+    b.observe_batch(flat, rows)
+    np.testing.assert_array_equal(a._df_diff, b._df_diff)
+    assert a.ndocs == b.ndocs == 3
+
+
+def test_observe_rows_skips_padding():
+    wm = WeightManager(1 << 10)
+    idx = np.array([[3, 5, 0, 0], [5, 0, 0, 0]], dtype=np.int32)
+    wm.observe_rows(idx)
+    assert wm._df_diff[0] == 0.0
+    assert wm._df_diff[3] == 1.0 and wm._df_diff[5] == 2.0
+    assert wm.ndocs == 2
+
+
+# -- CSR packing -------------------------------------------------------------
+
+def test_csr_to_padded_matches_from_vectors():
+    import random
+
+    rng = random.Random(3)
+    vecs = []
+    for _ in range(23):
+        k = rng.randint(0, 9)
+        vecs.append(sorted((rng.randint(1, 1000), rng.uniform(-1, 1))
+                           for _ in range(k)))
+    csr = CSRBatch.from_vectors(vecs)
+    for bucket in (1, 16):
+        a = csr.to_padded(batch_bucket=bucket)
+        b = SparseBatch.from_vectors(vecs, batch_bucket=bucket)
+        np.testing.assert_array_equal(a.idx, b.idx)
+        np.testing.assert_array_equal(a.val, b.val)
+    assert csr.rows() == [[(i, pytest.approx(v, abs=1e-6)) for i, v in vec]
+                          for vec in vecs]
+
+
+def test_csr_uniform_row_detection():
+    uni = CSRBatch.from_vectors([[(3, 1.0), (9, 2.0)]] * 4)
+    np.testing.assert_array_equal(uni.uniform_row(), [3, 9])
+    ragged = CSRBatch.from_vectors([[(3, 1.0)], [(3, 1.0), (9, 2.0)]])
+    assert ragged.uniform_row() is None
+    mixed = CSRBatch.from_vectors([[(3, 1.0)], [(4, 1.0)]])
+    assert mixed.uniform_row() is None
+    assert CSRBatch.from_vectors([]).uniform_row() is None
+
+
+# -- drivers on the batch API ------------------------------------------------
+
+def test_classifier_train_classify_batch_native():
+    from jubatus_tpu.models.classifier import ClassifierDriver
+
+    conf = {"method": "AROW", "parameter": {"regularization_weight": 1.0},
+            "converter": {"string_rules": [
+                {"key": "*", "type": "space", "sample_weight": "tf",
+                 "global_weight": "idf"}]}}
+    d = ClassifierDriver(conf, dim_bits=12)
+    data = [("spam", Datum({"t": "win money now"})),
+            ("ham", Datum({"t": "meet at noon"}))] * 3
+    assert d.train(data) == 6
+    out = d.classify([Datum({"t": "money money"}),
+                      Datum({"t": "noon meet"})])
+    assert len(out) == 2
+    assert max(out[0], key=lambda p: p[1])[0] == "spam"
+    assert max(out[1], key=lambda p: p[1])[0] == "ham"
+    # featurize/apply split (the pipelined coalescer's two stages)
+    labels, idx, val = d.featurize_train(data)
+    assert len(labels) == 6 and idx.shape == val.shape
+    assert d.train_hashed(labels, idx, val) == 6
+
+
+def test_regression_batch_native():
+    from jubatus_tpu.models.regression import RegressionDriver
+
+    conf = {"method": "PA1",
+            "parameter": {"sensitivity": 0.1, "regularization_weight": 1.0},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    d = RegressionDriver(conf, dim_bits=12)
+    data = [(float(x), Datum({"x": float(x)})) for x in range(1, 9)]
+    assert d.train(data) == 8
+    est = d.estimate([Datum({"x": 4.0})])
+    assert len(est) == 1 and est[0] != 0.0

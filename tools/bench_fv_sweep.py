@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Feature-extraction microbench: per-datum convert vs convert_batch.
+
+Sweeps batch size x converter config and reports samples/s for both
+pipelines plus the speedup, JSON to stdout — the host-side half of the
+ISSUE 5 trajectory (bench_serving measures the e2e serving plane; this
+isolates featurization so a regression is attributable).
+
+    python tools/bench_fv_sweep.py [--batches 256,2048,16384] [--seconds 1.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CONFIGS = {
+    "numeric": {"num_rules": [{"key": "*", "type": "num"}]},
+    "text_tf": {"string_rules": [
+        {"key": "*", "type": "space", "sample_weight": "tf",
+         "global_weight": "bin"}]},
+    "text_idf": {"string_rules": [
+        {"key": "*", "type": "space", "sample_weight": "tf",
+         "global_weight": "idf"}]},
+    "combo": {
+        "num_rules": [{"key": "*", "type": "num"}],
+        "combination_rules": [
+            {"key_left": "*", "key_right": "*", "type": "mul"}]},
+}
+
+K = 32  # features per datum (bench_serving's shape)
+
+
+def _make_data(workload: str, n: int, rng):
+    from jubatus_tpu.core import Datum
+
+    vocab = [f"w{i:03d}" for i in range(400)]
+    out = []
+    for _ in range(n):
+        if workload.startswith("text"):
+            words = rng.choice(len(vocab), size=K)
+            out.append(Datum({"body": " ".join(vocab[w] for w in words)}))
+        else:
+            out.append(Datum({f"f{j}": float(v)
+                              for j, v in enumerate(rng.normal(size=K))}))
+    return out
+
+
+def _time_loop(fn, seconds: float) -> float:
+    """Calls/s of ``fn`` over a ~``seconds`` window (>= 1 call)."""
+    fn()  # warm (memo caches, combo plans)
+    n = 0
+    t0 = time.perf_counter()
+    deadline = t0 + seconds
+    while True:
+        fn()
+        n += 1
+        now = time.perf_counter()
+        if now >= deadline:
+            return n / (now - t0)
+
+
+def run(batches, seconds: float, update_weights: bool = True) -> dict:
+    from jubatus_tpu.core.fv import make_fv_converter
+
+    rng = np.random.default_rng(0)
+    out = {"k_features": K, "update_weights": update_weights}
+    for name, conf in CONFIGS.items():
+        wl = "text" if name.startswith("text") else "numeric"
+        for b in batches:
+            data = _make_data(wl, b, rng)
+            per = make_fv_converter(conf, dim_bits=18)
+            bat = make_fv_converter(conf, dim_bits=18)
+
+            def run_per(per=per, data=data):
+                for d in data:
+                    per.convert(d, update_weights=update_weights)
+
+            def run_bat(bat=bat, data=data):
+                bat.convert_batch(data, update_weights=update_weights)
+
+            sp = _time_loop(run_per, seconds) * b
+            sb = _time_loop(run_bat, seconds) * b
+            out[f"fv_per_datum_samples_per_sec_{name}_b{b}"] = round(sp, 1)
+            out[f"fv_batch_samples_per_sec_{name}_b{b}"] = round(sb, 1)
+            out[f"fv_batch_speedup_{name}_b{b}"] = round(sb / sp, 2) \
+                if sp else 0.0
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batches", default="256,2048,16384",
+                    help="comma-separated batch sizes")
+    ap.add_argument("--seconds", type=float, default=1.0,
+                    help="measure window per cell")
+    ap.add_argument("--no-update-weights", action="store_true",
+                    help="bench the query-plane conversion (no observe)")
+    args = ap.parse_args()
+    batches = [int(x) for x in args.batches.split(",") if x]
+    print(json.dumps(run(batches, args.seconds,
+                         update_weights=not args.no_update_weights),
+                     indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,54 @@ BROAD_EXCEPT_DIRS = (
 )
 
 
+#: serving hot-path directories where a per-datum ``converter.convert()``
+#: call INSIDE a loop/comprehension is the featurization cliff the batch
+#: pipeline exists to remove (ISSUE 5: ~29x between per-datum convert and
+#: batch-native featurization at the bench shape) — use
+#: ``converter.convert_batch`` and slice rows instead. Genuine per-datum
+#: sites (single-datum APIs re-converting one row) opt out per line with
+#: a ``# per-datum-ok`` pragma stating why.
+CONVERT_LOOP_DIRS = (
+    "jubatus_tpu/server/",
+    "jubatus_tpu/models/",
+)
+
+
+def _check_convert_loops(path: str, tree: "ast.AST",
+                         lines: List[str]) -> List[str]:
+    """Flag ``<...>.converter.convert(...)`` (or ``converter.convert``)
+    calls nested inside a for/while loop or comprehension."""
+    problems = []
+    loop_nodes = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
+                  ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+    def is_convert_call(node) -> bool:
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "convert"):
+            return False
+        obj = node.func.value
+        return (isinstance(obj, ast.Name) and obj.id == "converter") or \
+            (isinstance(obj, ast.Attribute) and obj.attr == "converter")
+
+    for outer in ast.walk(tree):
+        if not isinstance(outer, loop_nodes):
+            continue
+        for node in ast.walk(outer):
+            if node is outer or not is_convert_call(node):
+                continue
+            line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "# per-datum-ok" in line:
+                continue
+            problems.append(
+                f"{path}:{node.lineno}: per-datum converter.convert() in a "
+                "loop on a serving hot path (use converter.convert_batch "
+                "and CSRBatch rows — the batch pipeline; append "
+                "'# per-datum-ok — <why>' where a single-datum call is "
+                "genuinely required)")
+    return problems
+
+
 def _is_span_timed(posix_path: str) -> bool:
     """Files whose hot-path timing must go through the tracing registry's
     ``span()`` helper (ISSUE 4): RPC dispatch and the mixer round paths.
@@ -137,6 +185,9 @@ def check_file(path: str) -> List[str]:
         if not os.path.basename(path) == "__main__.py" and \
                 ast.get_docstring(tree) is None and text.strip():
             problems.append(f"{path}: missing module docstring")
+        if any(d in posix for d in CONVERT_LOOP_DIRS):
+            problems.extend(_check_convert_loops(path, tree,
+                                                 text.splitlines()))
     return problems
 
 
