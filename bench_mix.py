@@ -22,8 +22,11 @@ does the same for the TPU mix plane on three paths:
   timed on the master. Virtual CPU world: the number bounds protocol +
   host cost, not interconnect bandwidth (labeled as such).
 
-Every path reports f32 and, where applicable, bf16-compressed variants
-(half the wire bytes).
+Every path reports f32 and, where applicable, the compressed wire
+variants — bf16 (half the bytes) and block-quantized int8 (~4x fewer
+bytes, --mix-compress int8) — plus a multi-round drift probe proving the
+int8 error-feedback residual keeps averaged weights unbiased
+(``collective_round_drift_vs_f32`` vs the stateless ``_noef`` control).
 
 Usage: python bench_mix.py        — prints one JSON dict of mix metrics.
 Also importable: bench.py folds `collect(...)` into its "extra" field.
@@ -210,7 +213,7 @@ jax.config.update("jax_platforms", "cpu")
 pid = int(sys.argv[1]); n = int(sys.argv[2])
 jax_port, coord_dir = sys.argv[3], sys.argv[4]
 dim_bits = int(sys.argv[5]) if len(sys.argv) > 5 else 0
-bf16 = bool(int(sys.argv[6])) if len(sys.argv) > 6 else False
+mode = sys.argv[6] if len(sys.argv) > 6 else "off"  # off|bf16|int8
 # CPU worlds need the gloo collectives backend or every psum raises
 # ("Multiprocess computations aren't implemented on the CPU backend")
 from jubatus_tpu.parallel.multihost import enable_cpu_collectives
@@ -233,7 +236,8 @@ else:
             "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
 args = ServerArgs(engine="classifier", coordinator=coord_dir, name="mb",
                   listen_addr="127.0.0.1", mixer="collective_mixer",
-                  interval_sec=1e9, interval_count=1 << 30, mix_bf16=bf16,
+                  interval_sec=1e9, interval_count=1 << 30,
+                  mix_compress=mode,
                   # north-star payloads (256 MB diffs) need a mixer-plane
                   # timeout matched to the transfer, like the reference's
                   # --interconnect_timeout knob for big models
@@ -252,16 +256,16 @@ for _ in range(4):
 # budget matches the parent's 1200 s timeout: a peer deadline SHORTER
 # than the parent's lets a slow master outlive its peers and fan out
 # into torn-down listeners instead of timing out cleanly at the parent
-deadline = time.time() + (120 if not dim_bits else 1200)
+deadline = time.time() + (120 if not dim_bits else 1800)
 while time.time() < deadline:
     if len(membership.get_all_nodes(srv.coord, "classifier", "mb")) == n:
         break
     time.sleep(0.2)
-# the d24 world measures f32 AND bf16 back to back in ONE world (flip
-# compress in place between rounds — the prepare signature re-reads it,
-# so all members flipping keeps the cluster matched); a second world
-# boot would pay membership + d24 train compiles twice
-two_variant = bool(dim_bits) and not bf16
+# the d24 world measures f32, bf16 AND int8 back to back in ONE world
+# (flip compress in place between rounds — the prepare signature
+# re-reads it, so all members flipping keeps the cluster matched); a
+# second world boot would pay membership + d24 train compiles twice
+variants = ["bf16", "int8"] if (dim_bits and mode == "off") else []
 if pid == 0:
     time.sleep(1.5 if not dim_bits else 5.0)  # peers finish training
     def warmed_round():
@@ -278,65 +282,71 @@ if pid == 0:
         # registry hygiene: drop the warmup rounds (compile-heavy) so the
         # mix.round histogram embedded below covers steady state only
         srv.rpc.trace.reset()
-        t0 = time.perf_counter()
-        out = srv.mixer.mix_now()          # measured round
-        ms = (time.perf_counter() - t0) * 1e3
-        assert out and out.get("collective"), out
-        return ms
-    ms = warmed_round()
+        # median of 3 measured rounds: the round is dominated by the
+        # device-queue drain at the chunk-0 barrier on a time-sliced
+        # host, which is noisy run to run — one sample flips mode
+        # comparisons, three stabilize them
+        times = []
+        for _ in range(3 if dim_bits else 1):
+            t0 = time.perf_counter()
+            out = srv.mixer.mix_now()      # measured round
+            times.append((time.perf_counter() - t0) * 1e3)
+            assert out and out.get("collective"), out
+        times.sort()
+        return times[len(times) // 2]
+    rec = {}
+    plat = jax.devices()[0].platform
+    def measure(tag):
+        # per-phase breakdown of the measured round (VERDICT r4 #5):
+        # makes the wire-bandwidth claim arithmetic from measured terms
+        # instead of an assertion — cast (~0, on-device by design), ship
+        # (host->device + wire prep), reduce (wire+fold as ONE fused
+        # collective), readback, plus the wire bytes and quant mode the
+        # flight recorder stamps per round
+        ms = warmed_round()
+        rec[f"collective_round_ms_nproc{n}{tag}"] = round(ms, 2)
+        rec[f"collective_round{tag}_platform"] = plat
+        phases = dict(getattr(srv.mixer, "last_phases", {}))
+        for k, v in phases.items():
+            rec[f"collective_phase_{k}{tag}"] = v
+        if "wire_mb" in phases:
+            rec[f"collective_wire_mb_per_round{tag}"] = phases["wire_mb"]
+        # steady-state mix.round quantiles from the span histograms
+        # (warmup rounds were reset away inside warmed_round)
+        tr = srv.rpc.trace.trace_status()
+        for q in ("p50_ms", "p99_ms", "max_ms"):
+            k = f"trace.mix.round.{q}"
+            if k in tr:
+                rec[f"collective_mix_round_{q}{tag}"] = tr[k]
+    tag = (f"_d{dim_bits}" if dim_bits else "") + \
+        (f"_{mode}" if mode != "off" else "")
+    measure(tag)
     diffs = {k: m.get_diff() for k, m in srv.driver.get_mixables().items()}
     import numpy as np
     nbytes = 0
     for d in diffs.values():
         leaves, _ = jax.tree_util.tree_flatten(d)
         nbytes += sum(np.asarray(x).nbytes for x in leaves)
-    plat = jax.devices()[0].platform
-    tag = (f"_d{dim_bits}" if dim_bits else "") + ("_bf16" if bf16 else "")
-    rec = {f"collective_round_ms_nproc{n}{tag}": round(ms, 2),
-           f"collective_round{tag}_payload_mb_per_replica":
-               round(nbytes / 2**20, 2),
-           f"collective_round{tag}_platform": plat,
-           f"collective_round{tag}_note": f"{n} jax.distributed {plat} "
-           "processes; orchestration+psum cost, not interconnect bandwidth"}
-    # per-phase breakdown of the measured round (VERDICT r4 #5): makes
-    # the ICI bandwidth claim arithmetic from measured terms instead of
-    # an assertion — cast (bf16), ship (host->device), reduce (wire+fold
-    # as ONE fused collective), readback, plus the ring-model wire bytes
-    for k, v in getattr(srv.mixer, "last_phases", {}).items():
-        rec[f"collective_phase_{k}{tag}"] = v
-    # steady-state mix.round quantiles from the span histograms (warmup
-    # rounds were reset away inside warmed_round) + the flight recorder's
-    # structured record of the measured round
-    tr = srv.rpc.trace.trace_status()
-    for q in ("p50_ms", "p99_ms", "max_ms"):
-        k = f"trace.mix.round.{q}"
-        if k in tr:
-            rec[f"collective_mix_round_{q}{tag}"] = tr[k]
+    rec[f"collective_round{tag}_payload_mb_per_replica"] = \
+        round(nbytes / 2**20, 2)
+    rec[f"collective_round{tag}_note"] = (
+        f"{n} jax.distributed {plat} processes; orchestration+psum "
+        "cost, not interconnect bandwidth")
     flight = srv.mixer.flight.snapshot(last=1)
     if flight:
         rec[f"collective_flight_last{tag}"] = flight[-1]
-    if two_variant:
-        srv.mixer.compress = True
-        open(coord_dir.rstrip("/") + ".flip", "w").close()
+    for v in variants:
+        srv.mixer.compress = v
+        open(coord_dir.rstrip("/") + f".flip_{v}", "w").close()
         fdeadline = time.time() + 120
         while time.time() < fdeadline:
-            if all(os.path.exists(f"{coord_dir.rstrip('/')}.flipped{p}")
+            if all(os.path.exists(f"{coord_dir.rstrip('/')}.flipped_{v}_{p}")
                    for p in range(1, n)):
                 break
             time.sleep(0.2)
         else:
-            raise AssertionError("peers never acked the bf16 flip")
-        ms2 = warmed_round()
-        tag2 = f"_d{dim_bits}_bf16"
-        rec[f"collective_round_ms_nproc{n}{tag2}"] = round(ms2, 2)
-        rec[f"collective_round{tag2}_platform"] = plat
-        for k, v in getattr(srv.mixer, "last_phases", {}).items():
-            rec[f"collective_phase_{k}{tag2}"] = v
-        tr2 = srv.rpc.trace.trace_status()
-        for q in ("p50_ms", "p99_ms", "max_ms"):
-            k = f"trace.mix.round.{q}"
-            if k in tr2:
-                rec[f"collective_mix_round_{q}{tag2}"] = tr2[k]
+            raise AssertionError(f"peers never acked the {v} flip")
+        measure(f"_d{dim_bits}_{v}")
     print("COLLECTIVE=" + json.dumps(rec), flush=True)
     # explicit completion marker (SIBLING of the coordinator dir — the
     # file coordinator owns everything inside): peers must NOT key off
@@ -346,15 +356,15 @@ if pid == 0:
     open(coord_dir.rstrip("/") + ".done", "w").close()
 else:
     done = coord_dir.rstrip("/") + ".done"
-    flip = coord_dir.rstrip("/") + ".flip"
-    flipped = False
+    pending = list(variants)
     while time.time() < deadline:
         if os.path.exists(done):
             break
-        if two_variant and not flipped and os.path.exists(flip):
-            srv.mixer.compress = True
-            open(f"{coord_dir.rstrip('/')}.flipped{pid}", "w").close()
-            flipped = True
+        if pending and os.path.exists(
+                f"{coord_dir.rstrip('/')}.flip_{pending[0]}"):
+            v = pending.pop(0)
+            srv.mixer.compress = v
+            open(f"{coord_dir.rstrip('/')}.flipped_{v}_{pid}", "w").close()
         time.sleep(0.2)
 c.close()
 srv.stop()
@@ -413,25 +423,29 @@ def run_jax_world(child_src: str, n: int, timeout: float = 300.0,
                 p.kill()
                 p.wait()
         shutil.rmtree(coord_dir, ignore_errors=True)
-        for suffix in [".done", ".flip"] + [
-                f".flipped{i}" for i in range(1, n)]:
-            try:  # the children's sibling marker files
-                os.unlink(coord_dir.rstrip("/") + suffix)
+        import glob as _glob
+
+        for marker in _glob.glob(coord_dir.rstrip("/") + ".*"):
+            try:  # the children's sibling marker files (.done, .flip_*)
+                os.unlink(marker)
             except OSError:
                 pass
 
 
 def collective_nproc(n: int = 4, dim_bits: int = 0,
-                     timeout: float = 300.0, bf16: bool = False) -> dict:
+                     timeout: float = 300.0, mode: str = "off") -> dict:
     """Timed production collective round across ``n`` OS processes.
     ``dim_bits`` > 0 runs the north-star-scale variant (AROW diffs at
     D=2^dim_bits — w + sigma, 2^dim_bits * L * 2 * 4 bytes f32 per
-    replica); ``bf16`` ships the psum compressed (--mix-bf16)."""
+    replica) and measures ALL THREE wire modes back to back in one
+    world when ``mode`` starts at "off" (f32 → flip bf16 → flip int8);
+    ``mode`` pins a single --mix-compress variant otherwise."""
     out: dict = {}
-    tag = (f"_d{dim_bits}" if dim_bits else "") + ("_bf16" if bf16 else "")
+    tag = (f"_d{dim_bits}" if dim_bits else "") + \
+        (f"_{mode}" if mode != "off" else "")
     err_key = f"collective_round{tag}_error"
-    extra = ((str(dim_bits), "1" if bf16 else "0")
-             if (dim_bits or bf16) else ())
+    extra = ((str(dim_bits), mode)
+             if (dim_bits or mode != "off") else ())
     try:
         outs, rcs = run_jax_world(_COLLECTIVE_CHILD, n, timeout=timeout,
                                   extra_args=extra)
@@ -448,6 +462,87 @@ def collective_nproc(n: int = 4, dim_bits: int = 0,
     return out
 
 
+_DRIFT_CHILD = r"""
+import sys, json
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); n = int(sys.argv[2])
+jax_port = sys.argv[3]
+dim_bits = int(sys.argv[5]); rounds = int(sys.argv[6])
+from jubatus_tpu.parallel.multihost import enable_cpu_collectives
+enable_cpu_collectives()
+jax.distributed.initialize(f"127.0.0.1:{jax_port}", num_processes=n,
+                           process_id=pid)
+from jubatus_tpu.parallel.collective import ErrorFeedback, psum_pytree
+
+# every process contributes fresh per-round diffs; all processes run the
+# SAME sequence of collectives (f32, int8+EF, int8 stateless) so the
+# streams stay in lockstep — no mixer protocol needed for a raw probe
+rng = np.random.default_rng(100 + pid)
+shape = (2, 1 << dim_bits)
+# force the chunked (= quantized) path even at probe dims below the
+# default 8 MiB chunk: ~4 chunks per leaf at any dim_bits
+chunk_mb = min(8.0, max(0.25, shape[0] * shape[1] * 4 / 2**20 / 4))
+ef = ErrorFeedback()
+S32 = np.zeros(shape, np.float32)
+S8 = np.zeros(shape, np.float32)
+S8n = np.zeros(shape, np.float32)
+ph = {}
+d1 = None
+for r in range(rounds):
+    x = {"w": rng.normal(size=shape).astype(np.float32)}
+    S32 += psum_pytree(x, compress="off", chunk_mb=chunk_mb)["w"]
+    S8 += psum_pytree(x, compress="int8", chunk_mb=chunk_mb, phases=ph,
+                      feedback=ef)["w"]
+    S8n += psum_pytree(x, compress="int8", chunk_mb=chunk_mb)["w"]
+    if d1 is None:
+        d1 = float(np.linalg.norm(S8 - S32))
+if pid == 0:
+    ref = float(np.linalg.norm(S32))
+    print("DRIFT=" + json.dumps({
+        "collective_round_drift_vs_f32":
+            float(np.linalg.norm(S8 - S32)) / ref,
+        "collective_round_drift_vs_f32_noef":
+            float(np.linalg.norm(S8n - S32)) / ref,
+        "collective_round_drift_rounds": rounds,
+        "collective_round_drift_first_round_l2": d1,
+        "collective_round_drift_ef_rounds": ef.rounds,
+        "collective_wire_mb_per_round": ph.get("wire_mb"),
+        "collective_round_drift_note": (
+            f"cumulative {rounds}-round averaged-weight drift of the "
+            "int8 transport at D=2^%d across %d processes; error "
+            "feedback telescopes it to ONE round's quantization error, "
+            "stateless int8 random-walks" % (dim_bits, n)),
+    }), flush=True)
+print(f"CHILD-{pid}-DONE", flush=True)
+"""
+
+
+def drift_probe(n: int = 4, dim_bits: int = 22, rounds: int = 6,
+                timeout: float = 600.0) -> dict:
+    """Multi-round averaged-weight drift of the int8 quantized transport
+    vs the exact f32 collective, measured on a REAL n-process world:
+    ``collective_round_drift_vs_f32`` (error feedback carried between
+    rounds — bounded, non-compounding) against the ``_noef`` control
+    (stateless quantization — sqrt(rounds) random walk). The test
+    suite's world-of-1 gate proves the telescoping algebra; this probe
+    proves it survives the scatter/gather ring."""
+    try:
+        outs, rcs = run_jax_world(_DRIFT_CHILD, n, timeout=timeout,
+                                  extra_args=(str(dim_bits), str(rounds)))
+    except subprocess.TimeoutExpired:
+        return {"collective_round_drift_error": "timeout"}
+    if any(rc != 0 for rc in rcs):
+        return {"collective_round_drift_error":
+                f"child exits {rcs}: {(''.join(outs))[-300:]}"}
+    for text in outs:
+        for line in text.splitlines():
+            if line.startswith("DRIFT="):
+                return json.loads(line[len("DRIFT="):])
+    return {"collective_round_drift_error": "no master output"}
+
+
 def collect(dev=None) -> dict:
     import jax
 
@@ -459,12 +554,30 @@ def collect(dev=None) -> dict:
                            else jax.devices()[0].platform)
     out.update(_allreduce8_subprocess())
     out.update(collective_nproc(4))
-    # the d24 world measures f32 AND bf16 rounds back to back (one boot,
-    # one membership, flip-in-place): per-phase keys for both variants
-    # let the --mix-bf16 tradeoff be audited per term (cast cost vs
-    # halved ship/wire bytes) instead of as one opaque total (VERDICT
-    # r4 #5)
-    out.update(collective_nproc(4, dim_bits=NORTH_STAR_BITS, timeout=1200))
+    # multi-round drift of the quantized transport vs f32 on a real
+    # 4-process world: error feedback bounded vs stateless random walk
+    out.update(drift_probe())
+    # the d24 world measures f32, bf16 AND int8 rounds back to back (one
+    # boot, one membership, flip-in-place): per-phase keys for all three
+    # variants let the --mix-compress tradeoff be audited per term
+    # (on-device cast/quant cost vs 2x/4x fewer wire bytes) instead of
+    # as one opaque total (VERDICT r4 #5)
+    out.update(collective_nproc(4, dim_bits=NORTH_STAR_BITS, timeout=1800))
+    # wire-reduction ratio the int8 mode actually achieved at d24, and
+    # the round-time comparison against the bf16 baseline (on CPU
+    # loopback the quantization compute competes with the saved memcpy
+    # on the SAME starved core — the wire win is the ICI story, see
+    # docs/PERF_NOTES.md "Quantized mix")
+    w_f32 = out.get(f"collective_wire_mb_per_round_d{NORTH_STAR_BITS}")
+    w_int8 = out.get(f"collective_wire_mb_per_round_d{NORTH_STAR_BITS}_int8")
+    if w_f32 and w_int8:
+        out["collective_wire_reduction_int8_vs_f32"] = round(
+            w_f32 / w_int8, 2)
+    ms_bf16 = out.get(f"collective_round_ms_nproc4_d{NORTH_STAR_BITS}_bf16")
+    ms_int8 = out.get(f"collective_round_ms_nproc4_d{NORTH_STAR_BITS}_int8")
+    if ms_bf16 and ms_int8:
+        out["collective_round_int8_vs_bf16_ratio"] = round(
+            ms_int8 / ms_bf16, 3)
     gates = [v for k, v in out.items() if k.startswith("mix_round_ms_d24_")]
     if gates:
         out["mix_round_worst_ms"] = max(gates)

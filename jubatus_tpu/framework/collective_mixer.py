@@ -111,13 +111,28 @@ class CollectiveMixer(RpcLinearMixer):
     and the RPC fan-out when it can't (non-sum mixables, world mismatch,
     prepare failures)."""
 
-    def __init__(self, *args, compress: bool = False, **kwargs) -> None:
+    def __init__(self, *args, compress: Any = False, **kwargs) -> None:
         super().__init__(*args, **kwargs)
-        #: --mix-bf16: the psum ships f32 diffs as bf16 (half the
-        #: interconnect bytes; additive diffs fold into an f32 master).
-        #: Folded into the prepare signature so a mixed-flag cluster
-        #: falls back to the RPC mix instead of wedging the collective.
+        #: --mix-compress: wire mode for the psum — ``off`` ships native
+        #: dtypes, ``bf16`` casts f32 diffs on device (half the
+        #: interconnect bytes; additive diffs fold into an f32 master),
+        #: ``int8`` rides the block-quantized collective (~4x fewer wire
+        #: bytes) with this mixer's error-feedback residual keeping the
+        #: averaged weights unbiased. The historical bool (True = bf16)
+        #: still resolves. Folded into the prepare signature so a
+        #: mixed-mode cluster falls back to the RPC mix instead of
+        #: wedging the collective.
         self.compress = compress
+        #: per-replica error-feedback residual pytree for int8 rounds
+        #: (parallel/collective.ErrorFeedback): the quantization error of
+        #: this member's shipped diff, added back into the NEXT round's
+        #: diff so multi-round weight averages do not walk. Residuals are
+        #: committed inside psum_pytree only when the whole entry
+        #: succeeds — aborted/degraded/failed rounds leave the state of
+        #: the last successful round intact. Device-resident: ~1.25x the
+        #: chunked-diff payload in device memory while int8 is on.
+        #: Created lazily so importing the mixer never drags jax in.
+        self.ef: Optional[Any] = None
         self._staged_lock = threading.Lock()
         self._staged: Dict[str, Dict[str, Any]] = {}
         self._round_seq = 0
@@ -178,17 +193,26 @@ class CollectiveMixer(RpcLinearMixer):
             diffs = {name: m.get_diff() for name, m in mixables.items()}
         sig = _signature(diffs)
         if sig != "unsupported":
-            # the compress flag AND the chunk plan ride the signature so
-            # a mixed-flag or mixed-chunk-size cluster mismatches at
+            # the compress mode AND the chunk plan ride the signature so
+            # a mixed-mode or mixed-chunk-size cluster mismatches at
             # prepare (the chunked psum is a SEQUENCE of collectives — a
             # member chunking differently would wedge the world); the
             # "unsupported" SENTINEL must stay bare — the master's
             # fallback check matches it exactly, and a suffixed sentinel
             # would send a 64-bit round into the collective it cannot
-            # ride
-            from jubatus_tpu.parallel.collective import DEFAULT_CHUNK_MB
+            # ride. Old peers emit exactly "|bf16=N|chunk=M": off/bf16
+            # keep that format verbatim, and int8 inserts a "|quant="
+            # component an old peer never produces — so a mixed-era
+            # cluster mismatches into the RPC fallback instead of
+            # wedging half the world inside a quantized collective.
+            from jubatus_tpu.parallel.collective import (
+                DEFAULT_CHUNK_MB, QUANT_BLOCK, _norm_compress)
 
-            sig += f"|bf16={int(self.compress)}|chunk={DEFAULT_CHUNK_MB}"
+            mode = _norm_compress(self.compress)
+            sig += f"|bf16={int(mode == 'bf16')}"
+            if mode == "int8":
+                sig += f"|quant=int8:{QUANT_BLOCK}"
+            sig += f"|chunk={DEFAULT_CHUNK_MB}"
         with self._staged_lock:
             # one staged round at a time: a newer prepare supersedes any
             # stale round a dead master left behind (its waiter sees the
@@ -317,18 +341,23 @@ class CollectiveMixer(RpcLinearMixer):
             entry = self._staged.pop(rid, None)
         if entry is None:
             return False
-        from jubatus_tpu.parallel.collective import psum_pytree
+        from jubatus_tpu.parallel.collective import (
+            ErrorFeedback, psum_pytree)
 
+        if self.ef is None:
+            self.ef = ErrorFeedback()
         # per-phase wall times for the round just run, exposed for
         # status/bench (the reference logs time+bytes per mix round,
-        # linear_mixer.cpp:553-558; here per phase + pipeline overlap).
-        # prefer_device: device-resident diff leaves (the JAX models)
-        # enter with zero staging and the totals come back as device
-        # arrays, which the jitted put_diff consumes directly — no
-        # device→host→device round trip on the apply
+        # linear_mixer.cpp:553-558; here per phase + pipeline overlap +
+        # the resolved quant mode and wire bytes the flight recorder
+        # stamps per round). prefer_device: device-resident diff leaves
+        # (the JAX models) enter with zero staging and the totals come
+        # back as device arrays, which the jitted put_diff consumes
+        # directly — no device→host→device round trip on the apply
         self.last_phases = {}
         totals = psum_pytree(entry["diffs"], compress=self.compress,
-                             phases=self.last_phases, prefer_device=True)
+                             phases=self.last_phases, prefer_device=True,
+                             feedback=self.ef)
         ok = self.local_put_obj({
             "protocol": PROTOCOL_VERSION,
             "schema": entry["union"],
@@ -478,8 +507,17 @@ class CollectiveMixer(RpcLinearMixer):
 
     def get_status(self) -> Dict[str, Any]:
         st = super().get_status()
+        from jubatus_tpu.parallel.collective import _norm_compress
+        from jubatus_tpu.parallel.multihost import collective_capabilities
+
         st.update(collective_rounds=self.collective_rounds,
-                  fallback_rounds=self.fallback_rounds)
+                  fallback_rounds=self.fallback_rounds,
+                  mix_compress=_norm_compress(self.compress))
+        for k, v in collective_capabilities().items():
+            st[f"mix_caps_{k}"] = v
+        if self.ef is not None:
+            for k, v in self.ef.stats().items():
+                st[f"mix_ef_{k}"] = v
         for k, v in self.last_phases.items():
             st[f"last_mix_{k}"] = v
         return st

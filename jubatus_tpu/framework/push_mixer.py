@@ -298,9 +298,12 @@ class DummyMixer:
 def create_mixer(name: str, driver: Any, comm: LinearCommunication, *,
                  self_node: Optional[NodeInfo] = None,
                  interval_sec: float = 16.0, interval_count: int = 512,
-                 mix_bf16: bool = False, quorum_fraction: float = 0.5):
+                 mix_bf16: bool = False, quorum_fraction: float = 0.5,
+                 mix_compress: str = "off"):
     """Mixer factory (≙ create_mixer, mixer_factory.cpp:41-97): selects by
-    the --mixer flag."""
+    the --mixer flag. ``mix_compress`` is the collective wire mode
+    (off|bf16|int8); the deprecated ``mix_bf16`` bool still resolves to
+    bf16 when no explicit mode is given."""
     kwargs = dict(self_node=self_node, interval_sec=interval_sec,
                   interval_count=interval_count,
                   quorum_fraction=quorum_fraction)
@@ -309,7 +312,9 @@ def create_mixer(name: str, driver: Any, comm: LinearCommunication, *,
     if name == "collective_mixer":
         from jubatus_tpu.framework.collective_mixer import CollectiveMixer
 
-        return CollectiveMixer(driver, comm, compress=mix_bf16, **kwargs)
+        mode = mix_compress if mix_compress != "off" else \
+            ("bf16" if mix_bf16 else "off")
+        return CollectiveMixer(driver, comm, compress=mode, **kwargs)
     if name in STRATEGIES:
         return RpcPushMixer(driver, comm, strategy=name, **kwargs)
     if name == "dummy_mixer":

@@ -22,11 +22,27 @@ The data plane is PIPELINED (docs/PERF_NOTES.md "Mix data plane"):
   function of (shapes, dtypes, chunk_bytes, compress) — which the
   collective mixer folds into its prepare signature — never of where a
   leaf happens to live.
-- ``compress=True`` casts f32 leaves to bf16 INSIDE the jitted
-  collective body (cast-on-device, input buffer donated off-CPU), so the
-  wire sees half the bytes without the old full host-side astype copy
-  (EQuARX, arxiv 2506.17615: a compressed AllReduce only wins when the
-  cast is fused into the collective).
+- ``compress`` is a three-state wire mode, ``off | bf16 | int8`` (the
+  historical bool still resolves: True == "bf16").
+
+  * ``bf16`` casts f32 chunks to bf16 ON DEVICE in the ship stage (a
+    tiny jitted cast right after placement), so the psum's wire sees
+    half the bytes, the collective body stays a pure reduce, and the
+    host never stages an astype copy (EQuARX, arxiv 2506.17615: a
+    compressed AllReduce only wins when the cast is fused off the host).
+  * ``int8`` is the EQuARX shape proper: per-block scales computed on
+    device, quantize-on-device BEFORE the ship (the collective stages
+    int8 + one f32 scale per QUANT_BLOCK elements — ~3.94x fewer bytes
+    per chunk), scatter-reduce where receivers DEQUANTIZE and
+    accumulate in f32, the segment owner REQUANTIZES the reduced
+    total, the int8 representation all-gathers around the ring, and
+    readback dequantizes.
+    Quantization is biased, and an online learner's weight averages
+    feed the next round — so a per-replica ``ErrorFeedback`` residual
+    (quantization error added back into the next round's diff) keeps
+    the averaged weights unbiased: the shipped sums telescope to the
+    true sums minus ONE bounded residual, for any number of rounds.
+    Small leaves and non-f32 dtypes stay exact (counts must not drift).
 - Leaves that are already device-resident ``jax.Array``s (the models in
   models/ are JAX — their diffs need not round-trip through numpy) take
   a zero-staging path: no host cast, no ``device_put`` from numpy, and
@@ -38,7 +54,8 @@ the same order and the same ``compress``/``chunk_bytes`` (the collective
 mixer's prepare phase verifies this before anyone enters), and the jax
 runtime must be initialized across the world (jax.distributed.initialize
 — parallel/multihost.py). Works single-process too (world of 1: psum
-degenerates to identity), which is what the driver dry run exercises.
+degenerates to identity and the int8 path to one quantize round trip —
+which is exactly what the error-feedback drift gates exercise).
 """
 
 from __future__ import annotations
@@ -69,7 +86,66 @@ DEFAULT_CHUNK_MB = float(os.environ.get("JUBATUS_TPU_MIX_CHUNK_MB", "8"))
 #: buffer (ship k+1 while chunk k reduces and chunk k−1 reads back)
 _PIPELINE_DEPTH = 2
 
+#: wire-compression modes psum_pytree understands; the collective
+#: mixer's --mix-compress flag and prepare signature speak the same enum
+COMPRESS_MODES = ("off", "bf16", "int8")
+
+#: elements per quantization block in int8 mode: one f32 scale (absmax /
+#: 127) per QUANT_BLOCK elements, so the wire overhead is 4/QUANT_BLOCK
+#: bytes per element (~1.6% at 256 — 3.94x total reduction vs f32).
+#: Every process in a cluster must agree (rides the prepare signature).
+QUANT_BLOCK = int(os.environ.get("JUBATUS_TPU_MIX_QUANT_BLOCK", "256"))
+
 _64BIT = (np.dtype(np.float64), np.dtype(np.int64), np.dtype(np.uint64))
+
+
+def _norm_compress(compress: Any) -> str:
+    """Resolve the wire mode: the ``off|bf16|int8`` enum, or the
+    historical bool (True meant "ship f32 as bf16") every pre-enum
+    caller still passes."""
+    if isinstance(compress, str):
+        mode = compress.lower() or "off"
+        if mode not in COMPRESS_MODES:
+            raise ValueError(f"unknown mix compress mode {compress!r}; "
+                             f"expected one of {COMPRESS_MODES}")
+        return mode
+    return "bf16" if compress else "off"
+
+
+class ErrorFeedback:
+    """Per-replica error-feedback residual state for the int8 transport.
+
+    Block quantization is biased, and the mix averages weights round
+    over round — without correction the per-round bias compounds into a
+    random walk on the averaged model. Carrying the residual
+    ``e_r = (x_r + e_{r-1}) - dequant(quant(x_r + e_{r-1}))`` between
+    rounds telescopes it away: the sum of shipped contributions equals
+    the sum of true diffs minus ONE bounded residual, for any number of
+    rounds (the drift gate in tests/test_collective_pipeline.py proves
+    both directions).
+
+    Two chains per replica, matching the two quantization events in the
+    chunk collective: ``contrib`` (this replica's own diff segments,
+    quantized once for the scatter) and ``total`` (the requant of the
+    reduced segments this replica owns and broadcasts). Residuals stay
+    device-resident between rounds, keyed by (leaf index, chunk start),
+    and are committed only after the WHOLE collective entry succeeds —
+    an aborted, degraded, or mid-psum-failed round leaves the state of
+    the last successful round intact."""
+
+    def __init__(self) -> None:
+        self.key: Optional[Tuple] = None
+        self.contrib: Dict[Tuple[int, int], Any] = {}
+        self.total: Dict[Tuple[int, int], Any] = {}
+        self.rounds = 0
+
+    def reset(self) -> None:
+        self.key = None
+        self.contrib.clear()
+        self.total.clear()
+
+    def stats(self) -> Dict[str, int]:
+        return {"rounds": self.rounds, "chunks": len(self.contrib)}
 
 
 def _world_mesh() -> Mesh:
@@ -141,6 +217,119 @@ def _reduce_chunk_fn(mesh: Mesh, elems: int, dtype_str: str, compress: bool):
     )
 
 
+@functools.lru_cache(maxsize=8)
+def _cast_fn(dtype_str: str):
+    """On-device dtype cast for the ship stage (bf16 mode). The wire
+    prep must never be a host astype — at the d24 bench shape that copy
+    alone cost ~740 ms per round (the codestyle host-cast gate keeps it
+    from coming back)."""
+    return jax.jit(lambda x: x.astype(jnp.dtype(dtype_str)))
+
+
+def _block_quant(y, block: int):
+    """[m] f32 -> ([m] int8, [m/block] f32 scales); m % block == 0.
+    Symmetric per-block absmax scaling (EQuARX's block-wise design: one
+    outlier only poisons its own 256 elements, not the tensor)."""
+    b = y.reshape(-1, block)
+    amax = jnp.max(jnp.abs(b), axis=1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, jnp.float32(1.0))
+    q = jnp.clip(jnp.round(b / scale), -127.0, 127.0).astype(jnp.int8)
+    return q.reshape(-1), scale[:, 0]
+
+
+def _block_dequant(q, scale, block: int):
+    return (q.reshape(-1, block).astype(jnp.float32)
+            * scale[:, None]).reshape(-1)
+
+
+@functools.lru_cache(maxsize=32)
+def _quant_ship_fn(celems: int, block: int):
+    """LOCAL (non-collective) per-chunk quantizer for the ship stage:
+    ``(x [1, celems] f32, res [1, celems] f32) -> (q int8, scales f32,
+    new_res f32)``. Quantize-on-device BEFORE the ship — the collective's
+    input arrays are int8 + per-block scales (4x smaller staging than an
+    f32 chunk), the error-feedback residual of this replica's own
+    contribution is computed here (and never enters the collective), and
+    the host never stages a cast."""
+
+    def body(x, res):
+        y = jnp.squeeze(x, 0) + jnp.squeeze(res, 0)
+        q, scales = _block_quant(y, block)
+        new_res = y - _block_dequant(q, scales, block)
+        return q[None], scales[None], new_res[None]
+
+    return jax.jit(body)
+
+
+@functools.lru_cache(maxsize=32)
+def _quant_reduce_fn(mesh: Mesh, celems: int, block: int):
+    """Quantized all-reduce of one pre-quantized [world, celems] chunk —
+    dequant → sum → requant, one jitted program per chunk size:
+
+    - scatter-reduce: for each ring shift k, every replica forwards its
+      (already ship-quantized) int8 copy of the RECEIVER's segment; the
+      receiver dequantizes and accumulates in f32 on device — the wire
+      never sees anything wider than int8 + per-block f32 scales.
+    - the segment owner requantizes the reduced total (the second
+      error-feedback chain, carried via ``res_t``) and the int8 bits
+      all-gather around the ring; EVERY replica — owner included —
+      dequantizes the same int8+scale representation on readback, so
+      the output is bit-identical everywhere (shard_map cannot prove
+      that: check_rep=False).
+
+    World of 1 degenerates to the pure quantize round trip (ship quant
+    → dequant → total requant) with both residual chains active — the
+    single-process drift gates ride that."""
+    n = mesh.shape["replica"]
+    seg = celems // n  # planner pads celems to a multiple of n*block
+    sb = seg // block  # scale blocks per segment
+
+    def body(q, scales, res_t):
+        q = jnp.squeeze(q, 0)
+        scales = jnp.squeeze(scales, 0)
+        r = jax.lax.axis_index("replica")
+        qsegs = q.reshape(n, seg)
+        ssegs = scales.reshape(n, sb)
+        acc = _block_dequant(
+            jax.lax.dynamic_index_in_dim(qsegs, r, 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(ssegs, r, 0, keepdims=False),
+            block)
+        for k in range(1, n):
+            perm = [(i, (i + k) % n) for i in range(n)]
+            sq = jax.lax.dynamic_index_in_dim(
+                qsegs, (r + k) % n, 0, keepdims=False)
+            ss = jax.lax.dynamic_index_in_dim(
+                ssegs, (r + k) % n, 0, keepdims=False)
+            acc = acc + _block_dequant(
+                jax.lax.ppermute(sq, "replica", perm),
+                jax.lax.ppermute(ss, "replica", perm), block)
+        tot = acc + jnp.squeeze(res_t, 0)
+        tq, ts = _block_quant(tot, block)
+        new_res_t = tot - _block_dequant(tq, ts, block)
+        out = jnp.zeros((n, seg), jnp.float32)
+        out = out.at[r].set(_block_dequant(tq, ts, block))
+        cq, cs, idx = tq, ts, r
+        fwd = [(i, (i + 1) % n) for i in range(n)]
+        for _ in range(n - 1):
+            cq = jax.lax.ppermute(cq, "replica", fwd)
+            cs = jax.lax.ppermute(cs, "replica", fwd)
+            idx = (idx - 1) % n
+            out = out.at[idx].set(_block_dequant(cq, cs, block))
+        return out.reshape(celems), new_res_t[None]
+
+    return jax.jit(
+        shard_map(body, mesh=mesh,
+                  in_specs=(P("replica"), P("replica"), P("replica")),
+                  out_specs=(P(), P("replica")),
+                  check_rep=False),
+        out_shardings=(NamedSharding(mesh, P()),
+                       NamedSharding(mesh, P("replica"))),
+        # only the quantized buffer is donated: the residual input must
+        # survive a failed round (feedback commits on success)
+        donate_argnums=_donate(),
+    )
+
+
 def _leaf_meta(leaf) -> Tuple[Any, np.dtype, Tuple[int, ...]]:
     """(leaf, dtype, shape) WITHOUT materializing device arrays on the
     host (np.asarray on a jax.Array is a full device→host copy)."""
@@ -152,21 +341,28 @@ def _leaf_meta(leaf) -> Tuple[Any, np.dtype, Tuple[int, ...]]:
     return leaf, np.dtype(dtype), tuple(shape)
 
 
-def psum_pytree(diff: Any, compress: bool = False,
+def psum_pytree(diff: Any, compress: Any = False,
                 phases: dict = None,  # type: ignore[assignment]
                 chunk_mb: Optional[float] = None,
-                prefer_device: bool = False) -> Any:
+                prefer_device: bool = False,
+                feedback: Optional[ErrorFeedback] = None) -> Any:
     """AllReduce ``diff`` (pytree of arrays/scalars) across the process
     world. Every process must call this with an identically-shaped
     pytree and the same ``compress`` and ``chunk_mb`` (both ride the
     collective mixer's prepare signature).
 
-    ``compress=True`` ships f32 leaves over the interconnect as bf16 —
-    half the wire bytes per round at ~3 decimal digits of diff
-    precision; additive diffs tolerate it because put_diff folds into an
-    f32 master (same contract as ``_psum_stacked(compress=True)`` and
-    the RPC mix's bf16 option). The cast runs on-device inside the
-    collective body.
+    ``compress`` picks the wire mode (``off | bf16 | int8``; the
+    historical bool still works, True == "bf16"). ``bf16`` ships f32
+    leaves as bf16 — half the wire bytes per round at ~3 decimal digits
+    of diff precision; additive diffs tolerate it because put_diff folds
+    into an f32 master. The cast runs ON DEVICE in the ship stage (a
+    host astype here once cost ~740 ms per d24 round). ``int8`` runs
+    chunked f32 leaves through the block-quantized all-reduce
+    (``_quant_chunk_fn``): ~3.94x fewer wire bytes; pass a persistent
+    ``feedback`` (ErrorFeedback) so the quantization error is carried
+    into the next round's diff and the averaged model stays unbiased —
+    without it every round's bias walks the weights. Small leaves and
+    non-f32 dtypes stay exact under int8.
 
     ``prefer_device=True`` returns totals as device ``jax.Array``s
     (no readback) — callers whose put_diff is jitted consume them
@@ -174,22 +370,27 @@ def psum_pytree(diff: Any, compress: bool = False,
 
     ``phases`` (optional dict) is filled with this call's per-phase wall
     times so mix rounds log like the reference's per-round time+bytes
-    (linear_mixer.cpp:553-558): ``cast_ms`` (host cast — ~0 now that the
-    compress cast is on-device), ``ship_ms`` (host→device placement;
-    the first chunk is measured with an explicit completion barrier so
-    async dispatch cannot leak transfer time into ``reduce_ms``),
-    ``reduce_ms`` (the jitted psums — wire and fold are ONE fused
-    collective, unlike the reference's get_diff/fold/put_diff),
-    ``readback_ms`` (device→host; in the pipelined stream this is the
-    time BLOCKED on arrival, i.e. whatever the overlap didn't hide),
-    ``payload_mb`` (post-cast wire bytes this replica contributes),
+    (linear_mixer.cpp:553-558): ``cast_ms`` (host cast — held at ~0 by
+    design: compress casts/quantization run on device), ``ship_ms``
+    (host→device placement + the on-device wire prep; the first chunk is
+    measured with an explicit completion barrier so async dispatch
+    cannot leak transfer time into ``reduce_ms``), ``reduce_ms`` (the
+    jitted collectives — wire and fold are ONE fused program, unlike the
+    reference's get_diff/fold/put_diff), ``readback_ms`` (device→host;
+    in the pipelined stream this is the time BLOCKED on arrival, i.e.
+    whatever the overlap didn't hide), ``payload_mb`` (post-compress
+    wire bytes this replica contributes, including quantization scales
+    and block padding under int8), ``wire_mb`` ==
     ``wire_mb_ring_model`` (2(n-1)/n × payload — ring-allreduce bytes
-    per replica; a model, the runtime picks the algorithm), plus the
-    pipeline accounting: ``chunks``, ``chunk_mb``, and
+    per replica; exact for the int8 scatter+gather this module
+    implements, a model for the runtime-picked psum), ``quant`` (the
+    resolved wire mode, stamped into flight-recorder round records),
+    plus the pipeline accounting: ``chunks``, ``chunk_mb``, and
     ``overlap_ms_saved`` — a DIRECT measurement of the overlap win:
     the reader thread's readback blocking that elapsed while the main
     thread was still shipping/reducing later chunks (minus the tail it
     did wait for) — wait the serial path would have eaten inline."""
+    mode = _norm_compress(compress)
     mesh = _world_mesh()
     n = mesh.shape["replica"]
     me = jax.local_devices()[0]
@@ -197,19 +398,19 @@ def psum_pytree(diff: Any, compress: bool = False,
     if chunk_mb is None:
         chunk_mb = DEFAULT_CHUNK_MB
     chunk_bytes = max(1, int(chunk_mb * 2**20))
+    block = QUANT_BLOCK
 
     leaves, treedef = jax.tree_util.tree_flatten(diff)
     if phases is not None:
         phases.update(cast_ms=0.0, ship_ms=0.0, reduce_ms=0.0,
                       readback_ms=0.0, payload_mb=0.0,
-                      wire_mb_ring_model=0.0, chunks=0,
+                      wire_mb=0.0, wire_mb_ring_model=0.0, chunks=0,
                       chunk_mb=round(chunk_bytes / 2**20, 2),
-                      overlap_ms_saved=0.0)
+                      overlap_ms_saved=0.0, quant=mode)
     if not leaves:
         return diff
 
     metas = []
-    nbytes = 0
     for leaf in leaves:
         leaf, dtype, shape = _leaf_meta(leaf)
         if dtype in _64BIT:
@@ -220,10 +421,6 @@ def psum_pytree(diff: Any, compress: bool = False,
                 f"64-bit leaf dtype {dtype} cannot ride the "
                 "collective exactly; use the RPC mix path")
         size = int(np.prod(shape, dtype=np.int64)) if shape else 1
-        wire = size * dtype.itemsize
-        if compress and dtype == np.float32:
-            wire //= 2
-        nbytes += wire
         metas.append((leaf, dtype, shape, size))
 
     # the collective sequence must be identical on every process, so the
@@ -233,6 +430,33 @@ def psum_pytree(diff: Any, compress: bool = False,
                  if s * dt.itemsize < chunk_bytes]
     big_idx = [i for i, (_, dt, _, s) in enumerate(metas)
                if s * dt.itemsize >= chunk_bytes]
+    big_set = set(big_idx)
+
+    def _chunk_elems(dtype: np.dtype) -> int:
+        ce = max(1, chunk_bytes // dtype.itemsize)
+        if mode == "int8" and dtype == np.float32:
+            # every replica-owned segment must block-quantize: pad the
+            # chunk up to a multiple of world * QUANT_BLOCK (zeros
+            # quantize to zeros; sliced off at collection)
+            quantum = n * block
+            ce = ((ce + quantum - 1) // quantum) * quantum
+        return ce
+
+    # wire accounting per leaf: bf16 halves every f32 leaf; int8
+    # quantizes only the CHUNKED f32 leaves (small leaves and non-f32
+    # dtypes ship exact) at 1 byte/elem + one f32 scale per block,
+    # counting the block padding the stream actually ships
+    nbytes = 0
+    for i, (_, dtype, _, size) in enumerate(metas):
+        wire = size * dtype.itemsize
+        if dtype == np.float32:
+            if mode == "bf16":
+                wire //= 2
+            elif mode == "int8" and i in big_set:
+                ce = _chunk_elems(dtype)
+                shipped = ((size + ce - 1) // ce) * ce
+                wire = shipped + (shipped // block) * 4
+        nbytes += wire
 
     out: List[Any] = [None] * len(metas)
     t_ship = t_reduce = t_readback = t_cast = 0.0
@@ -258,7 +482,7 @@ def psum_pytree(diff: Any, compress: bool = False,
         dtypes = tuple(str(a.dtype) for a in arrs)
         s_treedef = jax.tree_util.tree_structure(stacked)
         total = _reduce_tree_fn(mesh, s_treedef, shapes, dtypes,
-                                compress)(stacked)
+                                mode == "bf16")(stacked)
         total = jax.block_until_ready(total)
         t2 = time.perf_counter()
         for i, tot in zip(small_idx, total):
@@ -272,13 +496,14 @@ def psum_pytree(diff: Any, compress: bool = False,
     # -- big leaves: chunked double-buffered stream ---------------------
     n_chunks = 0
     overlap_saved = 0.0
+    quant_rounds = 0
     if big_idx:
         stream: List[Tuple[int, int, int]] = []  # (leaf idx, start, stop)
         flats: Dict[int, Any] = {}
         chunks_out: Dict[int, List[Any]] = {}
         for i in big_idx:
             leaf, dtype, shape, size = metas[i]
-            celems = max(1, chunk_bytes // dtype.itemsize)
+            celems = _chunk_elems(dtype)
             if isinstance(leaf, jax.Array):
                 flats[i] = leaf.reshape(-1)  # device op, zero staging
             else:
@@ -289,10 +514,24 @@ def psum_pytree(diff: Any, compress: bool = False,
                 stream.append((i, start, min(start + celems, size)))
         n_chunks = len(stream)
 
+        # error-feedback state: reset on any plan change (shape, chunk,
+        # world, or block skew would misalign the carried residuals);
+        # fresh residuals commit only after the whole stream succeeds
+        plan_key = (str(treedef),
+                    tuple((str(m[1]), m[2]) for m in metas),
+                    chunk_bytes, n, block)
+        if feedback is not None and feedback.key != plan_key:
+            feedback.reset()
+        pending_c: Dict[Tuple[int, int], Any] = {}
+        pending_t: Dict[Tuple[int, int], Any] = {}
+
+        def _quantized(i: int) -> bool:
+            return mode == "int8" and metas[i][1] == np.float32
+
         def ship(entry):
             i, start, stop = entry
             dtype = metas[i][1]
-            celems = max(1, chunk_bytes // dtype.itemsize)
+            celems = _chunk_elems(dtype)
             flat = flats[i]
             chunk = flat[start:stop]
             pad = celems - (stop - start)
@@ -306,12 +545,61 @@ def psum_pytree(diff: Any, compress: bool = False,
                     chunk = np.concatenate(
                         [chunk, np.zeros(pad, chunk.dtype)])
                 shard = jax.device_put(chunk[None, :], me)
+            if mode == "bf16" and dtype == np.float32:
+                # the wire prep IS the ship path: cast on device right
+                # after placement, so the collective body reduces
+                # pre-cast bf16 and the host never stages an astype
+                shard = _cast_fn("bfloat16")(shard)
+            elif _quantized(i):
+                # quantize-on-device before the ship: the collective's
+                # staged inputs are int8 + per-block scales (4x less),
+                # and this replica's contribution residual (error
+                # feedback chain 1) is computed here, locally — it
+                # never enters the collective
+                key = (i, start)
+                rc = feedback.contrib.get(key) \
+                    if feedback is not None else None
+                if rc is None:
+                    rc = jax.device_put(
+                        np.zeros((1, celems), np.float32), me)
+                q, scales, new_rc = _quant_ship_fn(celems, block)(shard, rc)
+                pending_c[key] = new_rc
+                gq = jax.make_array_from_single_device_arrays(
+                    (n, celems), sharding, [q])
+                gs = jax.make_array_from_single_device_arrays(
+                    (n, celems // block), sharding, [scales])
+                return (gq, gs), celems
             return jax.make_array_from_single_device_arrays(
                 (n, celems), sharding, [shard]), celems
 
-        def reduce_chunk(stacked, celems, dtype):
-            return _reduce_chunk_fn(mesh, celems, str(dtype),
-                                    compress)(stacked)
+        def _total_residual(entry, celems):
+            """The owned-segment requant residual (error feedback chain
+            2) as a [world, seg] array — zeros on the first round /
+            after a plan change. Stored globals are reused as-is: their
+            sharding matches the freshly built (equal) mesh."""
+            rt = feedback.total.get((entry[0], entry[1])) \
+                if feedback is not None else None
+            if rt is None:
+                seg = celems // n
+                rt = jax.make_array_from_single_device_arrays(
+                    (n, seg), sharding,
+                    [jax.device_put(np.zeros((1, seg), np.float32), me)])
+            return rt
+
+        def reduce_chunk(entry, stacked, celems):
+            i = entry[0]
+            dtype = metas[i][1]
+            if _quantized(i):
+                gq, gs = stacked
+                rt = _total_residual(entry, celems)
+                reduced, new_rt = _quant_reduce_fn(
+                    mesh, celems, block)(gq, gs, rt)
+                pending_t[(i, entry[1])] = new_rt
+                return reduced
+            dt = ("bfloat16" if mode == "bf16" and dtype == np.float32
+                  else str(dtype))
+            return _reduce_chunk_fn(mesh, celems, dt,
+                                    mode == "bf16")(stacked)
 
         def collect(entry, reduced):
             i, start, stop = entry
@@ -336,7 +624,7 @@ def psum_pytree(diff: Any, compress: bool = False,
         stacked, celems = ship(stream[0])
         jax.block_until_ready(stacked)
         tp1 = time.perf_counter()
-        reduced = reduce_chunk(stacked, celems, metas[stream[0][0]][1])
+        reduced = reduce_chunk(stream[0], stacked, celems)
         reduced = jax.block_until_ready(reduced)
         tp2 = time.perf_counter()
         collect(stream[0], reduced)
@@ -388,7 +676,7 @@ def psum_pytree(diff: Any, compress: bool = False,
                 t0 = time.perf_counter()
                 stacked, celems = ship(entry)
                 t1 = time.perf_counter()
-                reduced = reduce_chunk(stacked, celems, metas[entry[0]][1])
+                reduced = reduce_chunk(entry, stacked, celems)
                 if not prefer_device:
                     try:
                         reduced.copy_to_host_async()
@@ -414,6 +702,15 @@ def psum_pytree(diff: Any, compress: bool = False,
         # the degenerate no-pipelined-chunks case)
         overlap_saved = max(0.0, state["blocked"] - t_join)
 
+        # the whole stream completed: NOW the carried residuals advance
+        # (an exception above leaves the last successful round's state)
+        if feedback is not None and (pending_c or pending_t):
+            feedback.contrib.update(pending_c)
+            feedback.total.update(pending_t)
+            feedback.key = plan_key
+            feedback.rounds += 1
+            quant_rounds = 1
+
         for i in big_idx:
             _, dtype, shape, size = metas[i]
             t3 = time.perf_counter()
@@ -428,6 +725,7 @@ def psum_pytree(diff: Any, compress: bool = False,
                 out[i] = total.reshape(shape)
             t_readback += time.perf_counter() - t3
 
+    wire_mb = nbytes * 2 * (n - 1) / n / 2**20
     if phases is not None:
         phases.update(
             cast_ms=round(t_cast * 1e3, 2),
@@ -435,11 +733,15 @@ def psum_pytree(diff: Any, compress: bool = False,
             reduce_ms=round(t_reduce * 1e3, 2),
             readback_ms=round(t_readback * 1e3, 2),
             payload_mb=round(nbytes / 2**20, 2),
-            wire_mb_ring_model=round(nbytes * 2 * (n - 1) / n / 2**20, 2),
+            wire_mb=round(wire_mb, 2),
+            wire_mb_ring_model=round(wire_mb, 2),
             chunks=n_chunks,
             chunk_mb=round(chunk_bytes / 2**20, 2),
             overlap_ms_saved=round(overlap_saved * 1e3, 2),
+            quant=mode,
         )
+        if quant_rounds:
+            phases["ef_rounds"] = feedback.rounds
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

@@ -39,15 +39,51 @@ def enable_cpu_collectives() -> bool:
     on the CPU backend") unless ``jax_cpu_collectives_implementation``
     is switched to gloo BEFORE the backend initializes — without it,
     every CPU-world psum raises, members ack failure, and the collective
-    mix silently degrades to broken rounds. Must be called before
-    anything touches the XLA backend; returns True if the option was
-    set. No-op (False) on jax versions without the option (their CPU
-    collectives work out of the box)."""
+    mix silently degrades to broken rounds. gloo also carries the
+    collective_permute the int8 quantized transport's scatter/gather
+    ring rides (parallel/collective._quant_chunk_fn), so one switch
+    covers every wire mode. Must be called before anything touches the
+    XLA backend; returns True if the option was set. No-op (False) on
+    jax versions without the option (their CPU collectives work out of
+    the box)."""
     try:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
         return True
     except Exception:  # noqa: BLE001 — option renamed/removed upstream
         return False
+
+
+def collective_capabilities() -> dict:
+    """What the initialized runtime can carry for the mix plane — the
+    ops-facing answer to "can this member ride --mix-compress int8?".
+    Keys: ``backend`` (cpu/tpu/...), ``distributed`` (one jax world
+    spans the fleet), ``world`` (process count), ``quantized_transport``
+    (the int8 ring's requirements are met: every backend this repo
+    targets carries psum + collective_permute once the world is up —
+    CPU via gloo, TPU natively — so this tracks ``distributed`` or a
+    world of one). Surfaced in the collective mixer's get_status so a
+    mixed fleet is diagnosable before a round falls back."""
+    init = distributed_is_initialized()
+    world = jax.process_count() if init else 1
+    backend = jax.default_backend()
+    quantized = True
+    if backend == "cpu" and world > 1:
+        # a CPU world that skipped enable_cpu_collectives() has no
+        # cross-process collectives AT ALL — psum and the int8 ring's
+        # collective_permute both raise at dispatch. config.read is the
+        # only access path this option supports on this jax (attribute
+        # access returns nothing for it).
+        try:
+            impl = jax.config.read("jax_cpu_collectives_implementation")
+        except Exception:  # noqa: BLE001 — option renamed/removed upstream
+            impl = None
+        quantized = impl == "gloo"
+    return {
+        "backend": backend,
+        "distributed": init,
+        "world": world,
+        "quantized_transport": quantized,
+    }
 
 
 def initialize(

@@ -65,10 +65,18 @@ class ServerArgs:
     #: below 100% but at/above quorum run DEGRADED (counted + stamped in
     #: the flight recorder), below it they abort
     mix_quorum: float = 0.5
-    #: --mix-bf16: the collective mixer's psum ships f32 diffs as bf16
-    #: (half the interconnect bytes per round; additive diffs fold into
-    #: an f32 master, same tradeoff as the RPC mix's bf16 option). All
-    #: members must agree — a mixed cluster falls back to the RPC mix.
+    #: --mix-compress: the collective mixer's wire mode. ``off`` ships
+    #: diffs at their native dtype; ``bf16`` casts f32 diffs to bf16 ON
+    #: DEVICE in the ship path (half the interconnect bytes; additive
+    #: diffs fold into an f32 master); ``int8`` runs the block-quantized
+    #: collective (~4x fewer wire bytes, one f32 scale per 256 elements)
+    #: with a per-replica error-feedback residual carried between rounds
+    #: so the averaged weights stay unbiased. All members must agree —
+    #: a mixed cluster falls back to the RPC mix.
+    mix_compress: str = "off"
+    #: --mix-bf16: deprecated alias for ``--mix-compress bf16`` (kept so
+    #: existing deployments' argv keeps working); an explicit
+    #: --mix-compress wins when both are given.
     mix_bf16: bool = False
     #: Prometheus /metrics + /healthz HTTP port (utils/metrics_http.py):
     #: -1 = off (default), 0 = ephemeral (actual port in get_status)
@@ -185,12 +193,21 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "arrive for a mix round to proceed; rounds above "
                         "quorum but below 100%% run degraded (counted as "
                         "mix.quorum_degraded)")
+    p.add_argument("--mix-compress", default="off",
+                   choices=["off", "bf16", "int8"],
+                   help="collective mixer wire mode: off = native "
+                        "dtypes; bf16 = cast f32 diffs to bf16 on "
+                        "device (half the bytes per round); int8 = "
+                        "block-quantized collective (~4x fewer wire "
+                        "bytes, one f32 scale per 256 elements) with an "
+                        "error-feedback residual carried between rounds "
+                        "so averaged weights stay unbiased. All members "
+                        "must agree or the round falls back to the RPC "
+                        "mix")
     p.add_argument("--mix-bf16", action="store_true",
-                   help="collective mixer ships f32 diffs as bf16 over "
-                        "the interconnect (half the bytes per round; "
-                        "additive diffs fold into an f32 master). All "
-                        "members must agree or the round falls back to "
-                        "the RPC mix")
+                   help="deprecated alias for --mix-compress bf16 (an "
+                        "explicit --mix-compress wins when both are "
+                        "given)")
     p.add_argument("--metrics-port", type=int, default=-1,
                    help="serve Prometheus /metrics + /healthz on this "
                         "HTTP port (0 = ephemeral; default off)")
@@ -242,6 +259,8 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
         raise SystemExit("--telemetry-interval must be >= 0")
     if args.fv_cache_size < 0:
         raise SystemExit("--fv-cache-size must be >= 0")
+    if args.mix_bf16 and args.mix_compress == "off":
+        args.mix_compress = "bf16"  # deprecated alias resolves here
     if not args.is_standalone and not args.name:
         raise SystemExit("distributed mode (-z) requires --name")
     return args

@@ -116,6 +116,7 @@ class EngineServer:
                 self_node=NodeInfo(self.args.eth, self.args.rpc_port),
                 interval_sec=self.args.interval_sec,
                 interval_count=self.args.interval_count,
+                mix_compress=getattr(self.args, "mix_compress", "off"),
                 mix_bf16=getattr(self.args, "mix_bf16", False),
                 quorum_fraction=getattr(self.args, "mix_quorum", 0.5),
             )

@@ -15,3 +15,30 @@ def test_codestyle_clean():
         capture_output=True, text=True, cwd=str(repo))
     assert r.returncode == 0, \
         f"style problems:\n{r.stdout[-4000:]}\n{r.stderr[-2000:]}"
+
+
+def test_host_cast_gate_fires_and_pragma_opts_out(tmp_path):
+    """The parallel/ host-cast rule (ISSUE 6): a host-side numpy dtype
+    cast in a collective hot path is flagged; the # host-cast-ok pragma
+    and jnp (device) casts are not."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo / "tools" / "codestyle"))
+    try:
+        import check as codestyle
+    finally:
+        sys.path.pop(0)
+    d = tmp_path / "jubatus_tpu" / "parallel"
+    d.mkdir(parents=True)
+    bad = d / "victim.py"
+    bad.write_text(
+        '"""doc."""\n'
+        "import numpy as np\n"
+        "x = a.astype(np.float16)\n"                       # flagged
+        "y = a.astype(ml_dtypes.bfloat16)\n"               # flagged
+        "z = a.astype(np.float16)  # host-cast-ok - tiny\n"  # pragma
+        "w = a.astype(jnp.bfloat16)\n",                    # device cast
+        encoding="utf-8")
+    problems = codestyle.check_file(str(bad))
+    cast_hits = [p for p in problems if "host-side numpy dtype cast" in p]
+    assert len(cast_hits) == 2, problems
+    assert ":3:" in cast_hits[0] and ":4:" in cast_hits[1]

@@ -87,10 +87,11 @@ from jubatus_tpu.server.args import ServerArgs
 
 CONF = {"method": "PA", "parameter": {"regularization_weight": 1.0},
         "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
-bf16 = bool(int(sys.argv[5])) if len(sys.argv) > 5 else False
+mode = sys.argv[5] if len(sys.argv) > 5 else "off"
 args = ServerArgs(engine="classifier", coordinator=coord_dir, name="cm",
                   listen_addr="127.0.0.1", mixer="collective_mixer",
-                  interval_sec=1e9, interval_count=1 << 30, mix_bf16=bf16)
+                  interval_sec=1e9, interval_count=1 << 30,
+                  mix_compress=mode)
 srv = EngineServer("classifier", CONF, args)
 port = srv.start(0)
 
@@ -139,8 +140,10 @@ with RpcClient("127.0.0.1", port, timeout=30) as hc:
     hist = hc.call("get_mix_history", "cm")
 col = [r for r in hist if r.get("mode") == "collective" and r.get("ok")]
 assert col, hist
-for key in ("ship_ms", "reduce_ms", "readback_ms", "chunks"):
+for key in ("ship_ms", "reduce_ms", "readback_ms", "chunks", "quant",
+            "wire_mb"):
     assert key in (col[-1].get("phases") or {}), (key, col[-1])
+assert col[-1]["phases"]["quant"] == mode, col[-1]
 c.close()
 srv.stop()
 print(f"CHILD-{pid}-OK", flush=True)
@@ -148,22 +151,40 @@ print(f"CHILD-{pid}-OK", flush=True)
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("bf16", [False, True])
-def test_multiprocess_collective_mix(bf16):
+@pytest.mark.parametrize("mode", ["off", "bf16", "int8"])
+def test_multiprocess_collective_mix(mode):
     # one harness owns port pick / env scrub / concurrent pipe drain /
-    # cleanup for every jax.distributed multi-process launch. bf16=True
-    # exercises --mix-bf16: the psum ships compressed diffs, and the
-    # cross-replica knowledge assertions prove the quantized totals
-    # still train the cluster
+    # cleanup for every jax.distributed multi-process launch. bf16/int8
+    # exercise --mix-compress: the psum ships compressed diffs, and the
+    # cross-replica knowledge assertions prove the compressed totals
+    # still train the cluster; the flight record stamps the quant mode
     import bench_mix
 
     n = 3
     outs, rcs = bench_mix.run_jax_world(
-        _CHILD, n, timeout=180, extra_args=("1" if bf16 else "0",))
+        _CHILD, n, timeout=180, extra_args=(mode,))
     for i, (out, rc) in enumerate(zip(outs, rcs)):
         assert rc == 0, f"child {i} exit {rc}:\n{out[-3000:]}"
         assert f"CHILD-{i}-OK" in out, f"child {i}:\n{out[-3000:]}"
     assert any("MASTER-ROUND" in o for o in outs)
+
+
+@pytest.mark.slow
+def test_multiprocess_int8_drift_probe():
+    """The quantized transport across a REAL 4-process world: every
+    replica reads back the identical dequantized totals, multi-round
+    drift vs f32 stays small with error feedback, and the no-feedback
+    drift is measurably worse (the EF telescoping survives the
+    scatter/gather ring, not just the world-of-1 round trip)."""
+    import bench_mix
+
+    out = bench_mix.drift_probe(n=4, dim_bits=18, rounds=4)
+    assert "collective_round_drift_vs_f32" in out, out
+    drift = out["collective_round_drift_vs_f32"]
+    noef = out["collective_round_drift_vs_f32_noef"]
+    assert 0 < drift < 0.02, out
+    assert noef > drift, out
+    assert out["collective_wire_mb_per_round"] > 0
 
 
 def test_prepared_member_discards_stage_without_go(monkeypatch):
@@ -371,3 +392,90 @@ def test_psum_pytree_phase_instrumentation():
     assert total_c["w"].dtype == np.float32  # handed back f32
     np.testing.assert_allclose(total_c["w"], diff["w"], rtol=1e-2)
     assert bf16_phases["payload_mb"] == round(f32_payload / 2, 2)
+
+
+def test_prepare_signature_per_compress_mode():
+    """The three wire modes produce three distinct prepare signatures —
+    so a mixed-mode cluster mismatches at prepare and falls back to the
+    RPC mix instead of wedging half the world inside a collective it
+    built differently. off/bf16 keep the exact legacy "|bf16=N|chunk=M"
+    format (old peers interoperate); int8 inserts a "|quant=" component
+    no old peer ever produces."""
+    from jubatus_tpu.parallel.collective import DEFAULT_CHUNK_MB, QUANT_BLOCK
+
+    store = _Store()
+    args = ServerArgs(engine="classifier", coordinator="(shared)",
+                      name=NAME, listen_addr="127.0.0.1",
+                      mixer="collective_mixer",
+                      interval_sec=1e9, interval_count=1 << 30)
+    srv = EngineServer("classifier", CONF, args,
+                       coord=MemoryCoordinator(store))
+    srv.start(0)
+    try:
+        from jubatus_tpu.client import ClassifierClient, Datum
+
+        c = ClassifierClient("127.0.0.1", srv.args.rpc_port, NAME)
+        c.train([["pos", Datum({"a": 1.0})]])
+        sigs = {}
+        for mode in ("off", "bf16", "int8"):
+            srv.mixer.compress = mode
+            _v, sigs[mode] = srv.mixer.local_prepare(f"r-{mode}", [])
+            srv.mixer.local_abort(f"r-{mode}")
+        assert sigs["off"].endswith(f"|bf16=0|chunk={DEFAULT_CHUNK_MB}")
+        assert sigs["bf16"].endswith(f"|bf16=1|chunk={DEFAULT_CHUNK_MB}")
+        assert sigs["int8"].endswith(
+            f"|bf16=0|quant=int8:{QUANT_BLOCK}|chunk={DEFAULT_CHUNK_MB}")
+        assert len(set(sigs.values())) == 3
+        # bool compat: True still signs exactly like the bf16 enum
+        srv.mixer.compress = True
+        _v, sig_bool = srv.mixer.local_prepare("r-bool", [])
+        srv.mixer.local_abort("r-bool")
+        assert sig_bool == sigs["bf16"]
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_ef_residual_survives_failed_collective_entry(monkeypatch):
+    """The error-feedback residual advances only on a SUCCESSFUL
+    collective entry: a psum that dies (world torn down mid-stream, a
+    degraded round, an abort) must leave the residual of the last good
+    round intact — otherwise the next round feeds back a corrupted
+    error and the unbiasedness guarantee is gone."""
+    import jubatus_tpu.parallel.collective as collective
+
+    store = _Store()
+    args = ServerArgs(engine="classifier", coordinator="(shared)",
+                      name=NAME, listen_addr="127.0.0.1",
+                      mixer="collective_mixer",
+                      interval_sec=1e9, interval_count=1 << 30,
+                      mix_compress="int8")
+    srv = EngineServer("classifier", CONF, args,
+                       coord=MemoryCoordinator(store))
+    srv.start(0)
+    try:
+        from jubatus_tpu.client import ClassifierClient, Datum
+
+        c = ClassifierClient("127.0.0.1", srv.args.rpc_port, NAME)
+        c.train([["pos", Datum({"a": 1.0})]])
+        assert srv.mixer.get_status()["mix_compress"] == "int8"
+        ef = collective.ErrorFeedback()
+        ef.rounds = 3
+        ef.key = ("sentinel",)
+        srv.mixer.ef = ef
+        # an abort discards the stage without touching the residual
+        srv.mixer.local_prepare("r-abort", [])
+        assert srv.mixer.local_abort("r-abort") is True
+        assert ef.rounds == 3 and ef.key == ("sentinel",)
+        # a psum that raises mid-entry leaves it intact too
+        def boom(*a, **k):
+            raise RuntimeError("world torn down")
+
+        monkeypatch.setattr(collective, "psum_pytree", boom)
+        srv.mixer.local_prepare("r-fail", [])
+        with pytest.raises(RuntimeError, match="world torn down"):
+            srv.mixer._enter_collective("r-fail", 0)
+        assert ef.rounds == 3 and ef.key == ("sentinel",)
+        c.close()
+    finally:
+        srv.stop()

@@ -6,7 +6,11 @@ change the arithmetic) and must keep the established bf16 contract under
 ``compress=True``. World of 1 (psum = identity) keeps the tests
 single-process while still driving the full chunk planner, the padded
 tail, the batched small-leaf collective, and the device-resident
-zero-staging path.
+zero-staging path — and, for the int8 transport, exactly one
+block-quantize round trip per chunk, which is what the error-feedback
+drift gates measure: with the residual carried the multi-round
+averaged-weight drift is BOUNDED (telescoping), without it the per-round
+bias random-walks as sqrt(rounds).
 """
 
 from __future__ import annotations
@@ -17,7 +21,12 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from jubatus_tpu.parallel.collective import psum_pytree
+from jubatus_tpu.parallel.collective import (
+    QUANT_BLOCK,
+    ErrorFeedback,
+    _norm_compress,
+    psum_pytree,
+)
 
 RNG = np.random.default_rng(7)
 
@@ -149,8 +158,156 @@ def test_phase_accounting_keys_present():
     phases: dict = {}
     psum_pytree(diff, chunk_mb=0.25, phases=phases)
     for k in ("cast_ms", "ship_ms", "reduce_ms", "readback_ms",
-              "payload_mb", "wire_mb_ring_model", "chunks", "chunk_mb",
-              "overlap_ms_saved"):
+              "payload_mb", "wire_mb", "wire_mb_ring_model", "chunks",
+              "chunk_mb", "overlap_ms_saved"):
         assert k in phases, (k, phases)
         assert phases[k] >= 0
     assert phases["chunk_mb"] == 0.25
+    assert phases["quant"] == "off"
+
+
+# -- int8 quantized transport + error feedback ------------------------------
+
+def test_compress_mode_enum_and_bool_compat():
+    """The historical bool and the off|bf16|int8 enum resolve to the
+    same modes; junk is rejected loudly (a typo'd flag must never
+    silently ship f32)."""
+    assert _norm_compress(False) == "off"
+    assert _norm_compress(True) == "bf16"
+    assert _norm_compress("off") == "off"
+    assert _norm_compress("bf16") == "bf16"
+    assert _norm_compress("int8") == "int8"
+    with pytest.raises(ValueError, match="compress mode"):
+        _norm_compress("int4")
+    diff = {"w": RNG.normal(size=(2, 100_000)).astype(np.float32)}
+    a = psum_pytree(diff, compress=True, chunk_mb=0.25)
+    b = psum_pytree(diff, compress="bf16", chunk_mb=0.25)
+    assert np.array_equal(a["w"], b["w"])
+
+
+def test_int8_block_quant_error_bounded():
+    """Per-element int8 error is bounded by its 256-block's scale/2
+    (symmetric absmax scaling): one outlier only poisons its own
+    block, never the tensor — the EQuARX block-wise property."""
+    w = RNG.normal(size=(2, 350_001)).astype(np.float32)
+    w[0, 123] = 80.0  # an outlier: its block coarsens, others must not
+    out = psum_pytree({"w": w}, compress="int8", chunk_mb=0.25)
+    err = np.abs(out["w"] - w).reshape(-1)
+    flat = w.reshape(-1)
+    pad = (-flat.size) % QUANT_BLOCK
+    blocks = np.pad(flat, (0, pad)).reshape(-1, QUANT_BLOCK)
+    bound = np.abs(blocks).max(axis=1) / 127.0 * 0.5 + 1e-6
+    errp = np.pad(err, (0, pad)).reshape(-1, QUANT_BLOCK)
+    assert (errp <= bound[:, None]).all()
+    # blocks away from the outlier keep fine resolution
+    assert err[-QUANT_BLOCK:].max() <= 4.0 / 127.0
+
+
+def test_int8_exact_for_small_and_non_f32_leaves():
+    """int8 quantizes only the CHUNKED f32 leaves: scalars/counters and
+    integer tables must never drift."""
+    diff = {
+        "w": RNG.normal(size=(2, 200_000)).astype(np.float32),
+        "idx": np.arange(200_000, dtype=np.int32),
+        "count": np.float32(17.0),
+    }
+    out = psum_pytree(diff, compress="int8", chunk_mb=0.25)
+    assert np.array_equal(out["idx"], diff["idx"])
+    assert float(out["count"]) == 17.0
+    assert not np.array_equal(out["w"], diff["w"])  # quantized
+
+
+def test_int8_payload_accounting_near_4x():
+    diff = {"w": np.ones((1 << 22,), np.float32)}  # 16 MB, no padding
+    ph32: dict = {}
+    ph8: dict = {}
+    psum_pytree(diff, phases=ph32, chunk_mb=1.0)
+    psum_pytree(diff, compress="int8", phases=ph8, chunk_mb=1.0)
+    assert ph8["quant"] == "int8"
+    ratio = ph32["payload_mb"] / ph8["payload_mb"]
+    # 1 byte/elem + 4/QUANT_BLOCK scale bytes = 3.94x at block 256
+    assert 3.5 <= ratio <= 4.0, (ratio, ph32, ph8)
+
+
+def test_int8_error_feedback_drift_gate():
+    """THE parity gate: accumulate R rounds of mixed totals. With the
+    error-feedback residual the drift vs f32 telescopes — round R's
+    cumulative drift equals ONE round's quantization error, it does not
+    compound. Without the residual the same transport fails this gate
+    (sqrt(R) random walk) — proving the gate has teeth and the residual
+    is load-bearing, not decorative."""
+    rng = np.random.default_rng(3)
+    shape = (2, 200_000)
+    rounds = 16
+    ef = ErrorFeedback()
+    s32 = np.zeros(shape, np.float32)
+    s8 = np.zeros(shape, np.float32)
+    s8n = np.zeros(shape, np.float32)
+    drift_ef = []
+    drift_noef = []
+    for _ in range(rounds):
+        x = {"w": rng.normal(size=shape).astype(np.float32)}
+        s32 += psum_pytree(x, chunk_mb=0.25)["w"]
+        s8 += psum_pytree(x, compress="int8", chunk_mb=0.25,
+                          feedback=ef)["w"]
+        s8n += psum_pytree(x, compress="int8", chunk_mb=0.25)["w"]
+        drift_ef.append(float(np.linalg.norm(s8 - s32)))
+        drift_noef.append(float(np.linalg.norm(s8n - s32)))
+    assert ef.rounds == rounds
+    # the GATE: bounded and non-compounding (empirically the ratio is
+    # ~1.00; 1.5 allows residual-magnitude noise)
+    assert drift_ef[-1] <= 1.5 * drift_ef[0], drift_ef
+    # ...which the no-feedback transport demonstrably FAILS
+    # (empirically ~sqrt(16) = 4.0x round 1's drift)
+    assert drift_noef[-1] > 1.5 * drift_noef[0], drift_noef
+    assert drift_noef[-1] > 2.0 * drift_ef[-1]
+
+
+def test_int8_residual_commits_only_on_success():
+    """A round that dies mid-stream must leave the residual state of the
+    last successful round intact — a degraded/aborted round would
+    otherwise corrupt the error the next round feeds back."""
+    rng = np.random.default_rng(11)
+    x = {"w": rng.normal(size=(2, 200_000)).astype(np.float32)}
+    ef = ErrorFeedback()
+    psum_pytree(x, compress="int8", chunk_mb=0.25, feedback=ef)
+    assert ef.rounds == 1
+    key_before = ef.key
+    res_before = dict(ef.total)
+    # 64-bit leaves are refused at the planner — before any chunk runs
+    with pytest.raises(ValueError, match="64-bit"):
+        psum_pytree({"w": np.zeros((1 << 18,), np.float64)},
+                    compress="int8", chunk_mb=0.25, feedback=ef)
+    assert ef.rounds == 1 and ef.key == key_before
+    assert all(ef.total[k] is res_before[k] for k in res_before)
+
+
+def test_int8_residual_resets_on_plan_change():
+    """Shape/chunk churn invalidates carried residuals (they are
+    positional); the transport must reset rather than misapply them."""
+    rng = np.random.default_rng(12)
+    ef = ErrorFeedback()
+    psum_pytree({"w": rng.normal(size=(2, 200_000)).astype(np.float32)},
+                compress="int8", chunk_mb=0.25, feedback=ef)
+    n_keys = len(ef.contrib)
+    assert n_keys > 0
+    psum_pytree({"w": rng.normal(size=(2, 300_000)).astype(np.float32)},
+                compress="int8", chunk_mb=0.25, feedback=ef)
+    # old keys are gone, new plan's keys are in
+    assert ef.rounds == 2
+    assert len(ef.contrib) != n_keys or ef.key is not None
+
+
+def test_int8_device_resident_leaves_and_prefer_device():
+    """The zero-staging jax.Array path rides the quantized transport
+    too, and prefer_device hands device totals back."""
+    host = RNG.normal(size=(2, 300_000)).astype(np.float32)
+    dev = {"w": jnp.asarray(host)}
+    ef = ErrorFeedback()
+    out = psum_pytree(dev, compress="int8", chunk_mb=0.25,
+                      prefer_device=True, feedback=ef)
+    assert isinstance(out["w"], jax.Array)
+    err = np.abs(np.asarray(out["w"]) - host)
+    assert err.max() > 0  # quantized
+    assert err.max() < np.abs(host).max() / 64  # but sane
+    assert ef.rounds == 1

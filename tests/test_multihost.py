@@ -69,3 +69,14 @@ def test_endpoint_is_ephemeral():
     multihost.publish_endpoint(p0, "10.0.0.1:8476")
     p0.close()  # fleet incarnation dies
     assert MemoryCoordinator(store).read(multihost.JAX_COORD_PATH) is None
+
+
+def test_collective_capabilities_single_host():
+    """The ops-facing capability probe (can this member ride
+    --mix-compress int8?): a single-host world always can — one
+    process, no cross-process collectives needed."""
+    caps = multihost.collective_capabilities()
+    assert caps["world"] == 1
+    assert caps["distributed"] is False
+    assert caps["quantized_transport"] is True
+    assert isinstance(caps["backend"], str) and caps["backend"]

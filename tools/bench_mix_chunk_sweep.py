@@ -2,10 +2,11 @@
 
 Boots one 4-process jax.distributed CPU world per chunk size and times
 ``psum_pytree`` over a Criteo-shaped host diff (two [2, 2^23] f32 leaves
-= 128 MB payload per replica), printing a JSON dict of median round ms
-per chunk size. This is the recipe behind the DEFAULT_CHUNK_MB choice
-recorded in docs/PERF_NOTES.md ("Mix data plane") — rerun it on a real
-chip to re-pick for ICI.
+= 128 MB payload per replica) in EVERY wire mode — f32, bf16, and the
+block-quantized int8 transport — printing a JSON dict of median round ms
+per chunk size per mode. This is the recipe behind the DEFAULT_CHUNK_MB
+choice recorded in docs/PERF_NOTES.md ("Mix data plane" / "Quantized
+mix") — rerun it on a real chip to re-pick for ICI.
 
 Usage: python tools/bench_mix_chunk_sweep.py [dim_bits] [sizes_mb...]
 """
@@ -30,29 +31,39 @@ from jubatus_tpu.parallel.multihost import enable_cpu_collectives
 enable_cpu_collectives()
 jax.distributed.initialize(f"127.0.0.1:{jax_port}", num_processes=n,
                            process_id=pid)
-from jubatus_tpu.parallel.collective import psum_pytree
+from jubatus_tpu.parallel.collective import ErrorFeedback, psum_pytree
 
 rng = np.random.default_rng(pid)
 diff = {"dw": rng.normal(size=(2, 1 << dim_bits)).astype(np.float32),
         "dprec": rng.normal(size=(2, 1 << dim_bits)).astype(np.float32)}
-phases = {}
-psum_pytree(diff, phases=phases, chunk_mb=chunk_mb)  # warmup (compile)
-times = []
-for _ in range(3):
-    t0 = time.perf_counter()
+rec = {"chunk_mb": chunk_mb}
+ef = ErrorFeedback()
+# every process runs the modes in the same order: the collective
+# sequences stay in lockstep without any coordination protocol
+for mode in ("off", "bf16", "int8"):
+    kw = {"feedback": ef} if mode == "int8" else {}
     phases = {}
-    psum_pytree(diff, phases=phases, chunk_mb=chunk_mb)
-    times.append(time.perf_counter() - t0)
-if pid == 0:
-    print("SWEEP=" + json.dumps({
-        "chunk_mb": chunk_mb,
+    psum_pytree(diff, compress=mode, phases=phases, chunk_mb=chunk_mb,
+                **kw)  # warmup (compile)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        phases = {}
+        psum_pytree(diff, compress=mode, phases=phases,
+                    chunk_mb=chunk_mb, **kw)
+        times.append(time.perf_counter() - t0)
+    tag = {"off": "f32", "bf16": "bf16", "int8": "int8"}[mode]
+    rec[tag] = {
         "psum_ms_median": round(float(np.median(times)) * 1e3, 1),
         "chunks": phases.get("chunks"),
+        "wire_mb": phases.get("wire_mb"),
         "overlap_ms_saved": phases.get("overlap_ms_saved"),
         "ship_ms": phases.get("ship_ms"),
         "reduce_ms": phases.get("reduce_ms"),
         "readback_ms": phases.get("readback_ms"),
-    }), flush=True)
+    }
+if pid == 0:
+    print("SWEEP=" + json.dumps(rec), flush=True)
 print(f"CHILD-{pid}-DONE", flush=True)
 """
 
@@ -64,7 +75,7 @@ def sweep(dim_bits: int = 23, sizes=(2.0, 4.0, 8.0, 16.0, 32.0, 4096.0)):
     out = {}
     for mb in sizes:
         outs, rcs = bench_mix.run_jax_world(
-            _CHILD, 4, timeout=600, extra_args=(str(dim_bits), str(mb)))
+            _CHILD, 4, timeout=900, extra_args=(str(dim_bits), str(mb)))
         if any(rc != 0 for rc in rcs):
             out[f"chunk_{mb}mb"] = {"error": (''.join(outs))[-200:]}
             continue

@@ -14,6 +14,7 @@ from __future__ import annotations
 import ast
 import glob
 import os
+import re
 import sys
 from typing import List
 
@@ -44,6 +45,23 @@ BROAD_EXCEPT_DIRS = (
     "jubatus_tpu/server/",
     "jubatus_tpu/framework/",
 )
+
+
+#: collective hot-path directories where a HOST-side numpy dtype cast
+#: (``.astype(np.*)`` / ``.astype(ml_dtypes.*)``) stages a full copy of
+#: the payload on the host before the wire ever sees it — the exact bug
+#: the quantized transport killed (ISSUE 6: the bf16 path's host astype
+#: cost ~740 ms per d24 round; ``collective_phase_cast_ms_d24_bf16``).
+#: Cast/quantize ON DEVICE instead: a jnp dtype inside the jitted
+#: ship/reduce path (collective._cast_fn / _quant_chunk_fn). The rare
+#: genuine host cast (tiny metadata arrays, pre-staging for a host-only
+#: code path) opts out per line with a ``# host-cast-ok`` pragma
+#: stating why.
+HOST_CAST_DIRS = (
+    "jubatus_tpu/parallel/",
+)
+
+_HOST_CAST_RE = re.compile(r"\.astype\(\s*(np|numpy|ml_dtypes)\.")
 
 
 #: serving hot-path directories where a per-datum ``converter.convert()``
@@ -145,6 +163,8 @@ def check_file(path: str) -> List[str]:
         d in posix for d in HOT_TIME_DIRS)
     broad_gate = path.endswith(".py") and any(
         d in posix for d in BROAD_EXCEPT_DIRS)
+    host_cast = path.endswith(".py") and any(
+        d in posix for d in HOST_CAST_DIRS)
     span_timed = path.endswith(".py") and _is_span_timed(posix)
     for i, line in enumerate(text.splitlines(), 1):
         if "\t" in line and not allow_tabs:
@@ -154,6 +174,15 @@ def check_file(path: str) -> List[str]:
         if len(line) > MAX_LINE:
             problems.append(f"{path}:{i}: line longer than {MAX_LINE} chars"
                             f" ({len(line)})")
+        if host_cast and "# host-cast-ok" not in line and \
+                _HOST_CAST_RE.search(line):
+            problems.append(
+                f"{path}:{i}: host-side numpy dtype cast in a collective "
+                "hot path (a full host copy of the payload before the "
+                "wire — cast/quantize on device inside the jitted "
+                "ship/reduce path with a jnp dtype instead; append "
+                "'# host-cast-ok — <why>' where a host cast is genuinely "
+                "required)")
         if hot_time and "time.time()" in line and "# wall-clock" not in line:
             problems.append(
                 f"{path}:{i}: raw time.time() in a hot-path module (use "
