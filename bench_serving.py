@@ -208,7 +208,7 @@ def _default_microbatch() -> int:
 def run(transport: str = "python", workload: str = "numeric",
         conf: dict = CONF, measure: float = MEASURE_SECONDS,
         tag: str = "", microbatch: int = 0, native_ingest: bool = True,
-        forensics: bool = True) -> dict:
+        forensics: bool = True, model_health=None) -> dict:
     from jubatus_tpu.server import EngineServer
     from jubatus_tpu.server.args import ServerArgs
 
@@ -221,13 +221,27 @@ def run(transport: str = "python", workload: str = "numeric",
     # operator shell must not silently turn the native rows into
     # Python-ingest runs and flatten the A/B to ~1.0
     os.environ["JUBATUS_TPU_NATIVE_INGEST"] = "1" if native_ingest else "0"
+    # model_health (ISSUE 7): None keeps the stock server (the other
+    # benches' behavior); True arms the FULL observability load —
+    # 1 s telemetry ticks driving time-series ring sampling + SLO
+    # burn-rate evaluation against live SLOs; False strips the plane
+    # entirely (no ring, no SLO engine, no sampler thread) — the
+    # honest "off" side of the overhead A/B
+    health_args: dict = {}
+    if model_health is True:
+        health_args = dict(
+            telemetry_interval=1.0,
+            slo=["latency:rpc.classify:p99:50", "error_rate:*:0.01"],
+            slo_fast_window=5.0, slo_slow_window=30.0)
+    elif model_health is False:
+        health_args = dict(telemetry_interval=0.0, timeseries_capacity=0)
     try:
         srv = EngineServer(
             "classifier", conf,
             args=ServerArgs(engine="classifier", thread=N_CLIENTS,
                             listen_addr="127.0.0.1",
                             microbatch_max=microbatch
-                            or _default_microbatch()))
+                            or _default_microbatch(), **health_args))
         # forensics=False: histograms stay on (the p50/p99 keys below need
         # them) but the span store + slow log are disabled — the A/B for
         # ISSUE 4's <2% overhead budget
@@ -456,6 +470,42 @@ def run_tracing_overhead(transport: str = "python",
     return out
 
 
+def run_observability_overhead(transport: str = "python",
+                               measure: float = TEXT_MEASURE_SECONDS
+                               ) -> dict:
+    """ISSUE 7 satellite: the FULL observability plane's cost, measured
+    the same adjacent-A/B way as the ISSUE 4 tracing overhead — but the
+    "on" side now also carries time-series ring sampling + live SLO
+    burn-rate evaluation on a 1 s telemetry tick, and the "off" side
+    strips forensics AND the model-health plane entirely. Same classify
+    workload, same <2% p50 budget
+    (``e2e_observability_overhead_p50_ratio``)."""
+    out: dict = {}
+    sides = {}
+    for tag, forensics, health in (("obs_on", True, True),
+                                   ("obs_off", False, False)):
+        try:
+            r = run(transport, workload="classify", measure=measure,
+                    tag=tag, forensics=forensics, model_health=health)
+        except Exception as e:  # noqa: BLE001 — partial results beat none
+            out[f"e2e_{tag}_error"] = repr(e)[:200]
+            continue
+        out.update(r)
+        sides[tag] = r
+    p50_on = sides.get("obs_on", {}).get("e2e_rpc_classify_p50_ms_obs_on")
+    p50_off = sides.get("obs_off", {}).get("e2e_rpc_classify_p50_ms_obs_off")
+    if p50_on and p50_off:
+        ratio = p50_on / p50_off
+        out["e2e_observability_overhead_p50_ratio"] = round(ratio, 4)
+        out["e2e_observability_overhead_ok"] = bool(ratio <= 1.02)
+    p99_on = sides.get("obs_on", {}).get("e2e_rpc_classify_p99_ms_obs_on")
+    p99_off = sides.get("obs_off", {}).get("e2e_rpc_classify_p99_ms_obs_off")
+    if p99_on and p99_off:
+        out["e2e_observability_overhead_p99_ratio"] = round(
+            p99_on / p99_off, 4)
+    return out
+
+
 def run_proxy(transport: str = "python",
               measure: float = MEASURE_SECONDS) -> dict:
     """Proxy-tier path (VERDICT r2 item 8): clients -> Proxy (random
@@ -661,6 +711,13 @@ def collect(trials: int = 2) -> dict:
         out.update(run_tracing_overhead(text_tr))
     except Exception as e:  # noqa: BLE001
         out["e2e_tracing_overhead_error"] = repr(e)[:200]
+    # full observability-plane overhead A/B (ISSUE 7): forensics +
+    # time-series sampling + SLO evaluation on vs everything off,
+    # same <2% p50 budget
+    try:
+        out.update(run_observability_overhead(text_tr))
+    except Exception as e:  # noqa: BLE001
+        out["e2e_observability_overhead_error"] = repr(e)[:200]
     # proxy tier: same numeric workload through the proxy hop. The
     # REPORTED keys stay best-of, but the ratio uses median-vs-median
     # over ADJACENT alternating (proxy, direct) pairs: the direct side

@@ -44,13 +44,21 @@ def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="jubactl")
     p.add_argument("-c", "--cmd", required=True,
                    choices=["start", "stop", "save", "load", "status",
-                            "metrics", "breakers", "trace"])
+                            "metrics", "breakers", "trace", "alerts",
+                            "watch"])
     p.add_argument("trace_id", nargs="?", default="",
                    help="[trace] trace id to assemble (from a slow-log "
                         "record, a /metrics exemplar, or "
                         "trace.*.last_trace_id in get_status)")
     p.add_argument("--all", action="store_true",
                    help="[status] also scrape every member's get_status")
+    p.add_argument("--once", action="store_true",
+                   help="[watch] render one frame and exit (scripts/CI)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="[watch] refresh period in seconds")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="[watch] rate/quantile window in seconds "
+                        "(computed from each node's get_timeseries ring)")
     p.add_argument("-s", "--server", default="",
                    help="server name forwarded to jubavisor "
                         "(jubaclassifier or plain engine name)")
@@ -153,6 +161,16 @@ def show_status(coord: Coordinator, engine: str, name: str,
             rc = -1
             continue
         for _node_name, st in sorted(status.items()):
+            # model-health verdict first (ISSUE 7): the structured
+            # degraded reasons /healthz carries, rendered as one line
+            hs = st.get("health.status")
+            if hs:
+                reasons = st.get("health.reasons") or []
+                kinds = ", ".join(
+                    str(r.get("kind", "?")) +
+                    (f":{r['name']}" if r.get("name") else "")
+                    for r in reasons) if isinstance(reasons, list) else ""
+                print(f"    health: {hs}" + (f" [{kinds}]" if kinds else ""))
             for k in sorted(st):
                 print(f"    {k}: {st[k]}")
     return rc
@@ -248,6 +266,198 @@ def show_breakers(coord: Coordinator, engine: str, name: str) -> int:
                       f"failures_in_window={b.get('failures_in_window', 0)} "
                       f"opened_total={b.get('opened_total', 0)}")
     return rc
+
+
+def show_alerts(coord: Coordinator, engine: str, name: str) -> int:
+    """Model-health plane (ISSUE 7): every member's + proxy's SLO state
+    (``get_alerts`` / ``get_proxy_alerts``) — which alerts are FIRING,
+    and every configured SLO's current fast/slow burn rates."""
+    rows: List[Dict[str, Any]] = []
+    scraped = 0
+    for node, method in (
+            [(n, "get_alerts")
+             for n in membership.get_all_nodes(coord, engine, name)]
+            + [(pxy, "get_proxy_alerts") for pxy in _proxies(coord)]):
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                per_node = c.call(method, name)
+        except Exception as e:  # noqa: BLE001 — partial view beats none
+            print(f"  <{node.name}: {method} failed: {e}>", file=sys.stderr)
+            continue
+        scraped += 1
+        for node_name, doc in sorted((per_node or {}).items()):
+            for st in (doc or {}).get("slos") or []:
+                st = dict(st)
+                st["node"] = node_name
+                rows.append(st)
+    if not scraped:
+        print(f"no member of {engine}/{name} answered get_alerts",
+              file=sys.stderr)
+        return -1
+    firing = [r for r in rows if r.get("firing")]
+    print(f"{engine}/{name}: {len(firing)} alert(s) firing, "
+          f"{len(rows)} SLO state(s) across the cluster")
+    if rows:
+        print(f"  {'node':<22} {'slo':<28} {'state':<8} "
+              f"{'burn_fast':>9} {'burn_slow':>9}")
+        for r in sorted(rows, key=lambda r: (not r.get("firing"),
+                                             r.get("node", ""),
+                                             r.get("name", ""))):
+            state = "FIRING" if r.get("firing") else "ok"
+            print(f"  {r.get('node', '?'):<22} {r.get('name', '?'):<28} "
+                  f"{state:<8} {r.get('burn_fast', 0.0):>9.2f} "
+                  f"{r.get('burn_slow', 0.0):>9.2f}")
+            if r.get("firing"):
+                print(f"      {r.get('describe', '')}")
+    else:
+        print("  (no SLOs configured — pass --slo to the servers)")
+    return 0
+
+
+def collect_watch(coord: Coordinator, engine: str, name: str,
+                  window_s: float = 60.0) -> Dict[str, Any]:
+    """One scrape of the whole cluster for the watch view: per-member
+    get_status + get_timeseries + get_alerts, per-proxy
+    get_proxy_status. Failures degrade per node (a sick node is exactly
+    what the watch exists to show)."""
+    from jubatus_tpu.utils.timeseries import window_from_points
+
+    nodes = membership.get_all_nodes(coord, engine, name)
+    actives = {n.name for n in membership.get_all_actives(
+        coord, engine, name)}
+    data: Dict[str, Any] = {"engine": engine, "name": name,
+                            "window_s": window_s, "nodes": {},
+                            "proxies": {}, "actives": actives}
+    for node in nodes:
+        entry: Dict[str, Any] = {"error": ""}
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                status = c.call("get_status", name)
+                ts = c.call("get_timeseries", name)
+                alerts = c.call("get_alerts", name)
+        except Exception as e:  # noqa: BLE001 — render the sick node
+            entry["error"] = str(e)
+            data["nodes"][node.name] = entry
+            continue
+        st = (status or {}).get(node.name) or \
+            next(iter((status or {}).values()), {})
+        entry["status"] = st
+        points = ((ts or {}).get(node.name) or {}).get("points") or []
+        entry["window"] = window_from_points(points, window_s)
+        doc = (alerts or {}).get(node.name) or {}
+        entry["alerts"] = [a.get("name") for a in doc.get("alerts") or []]
+        data["nodes"][node.name] = entry
+    for pxy in _proxies(coord):
+        try:
+            with RpcClient(pxy.host, pxy.port, timeout=10.0) as c:
+                pst = c.call("get_proxy_status", name)
+        except Exception as e:  # noqa: BLE001
+            data["proxies"][pxy.name] = {"error": str(e)}
+            continue
+        for node_name, st in (pst or {}).items():
+            data["proxies"][node_name] = {"status": st, "error": ""}
+    return data
+
+
+def _watch_node_row(node_name: str, entry: Dict[str, Any],
+                    active: bool) -> str:
+    if entry.get("error"):
+        return (f"  {node_name:<22} {'DOWN':<9} "
+                f"<{entry['error'][:60]}>")
+    st = entry.get("status") or {}
+    win = entry.get("window")
+    req_s = err_s = 0.0
+    p99 = None
+    p99_span = ""
+    if win is not None:
+        for span in win.spans("rpc."):
+            r = win.span_rate(span)
+            req_s += r
+            if r > 0:
+                q = win.quantile_ms(span, 0.99)
+                if q is not None and (p99 is None or q > p99):
+                    p99, p99_span = q, span
+        for cname in win.counter_names("rpc."):
+            if cname.endswith(".errors"):
+                err_s += win.counter_rate(cname)
+    health = st.get("health.status", "?")
+    state = health if active else f"{health}/standby"
+    div = st.get("mixer.health_premix_divergence_mean",
+                 st.get("mixer.health_premix_divergence"))
+    stale = st.get("mixer.health_staleness_max",
+                   st.get("mixer.self_staleness"))
+    drift = st.get("mixer.mix_ef_contrib_residual_norm")
+    mix_bits = []
+    if div is not None:
+        mix_bits.append(f"div {float(div):.3f}")
+    if stale is not None:
+        mix_bits.append(f"stale {int(stale)}")
+    if st.get("mixer.model_version") is not None:
+        mix_bits.append(f"v{st['mixer.model_version']}")
+    if drift is not None:
+        mix_bits.append(f"ef {float(drift):.3g}")
+    alerts = ",".join(entry.get("alerts") or []) or "-"
+    p99_cell = f"{p99:.1f} {p99_span[4:]}" if p99 is not None else "-"
+    return (f"  {node_name:<22} {state:<9} {req_s:>8.1f} {err_s:>7.2f}  "
+            f"{p99_cell:<22} {' '.join(mix_bits) or '-':<28} {alerts}")
+
+
+def render_watch_frame(data: Dict[str, Any], ts: str = "") -> str:
+    """One watch frame as text (pure; asserted by tests, printed by the
+    refresh loop): per-node request/error rates + windowed p99 from the
+    time-series, mix health (divergence/staleness/quant drift), proxy
+    breaker states, and the firing alerts."""
+    lines: List[str] = []
+    nodes = data.get("nodes") or {}
+    proxies = data.get("proxies") or {}
+    actives = data.get("actives") or set()
+    lines.append(f"{data.get('engine')}/{data.get('name')}"
+                 f"{'  ' + ts if ts else ''}  "
+                 f"window {data.get('window_s', 0):g}s  "
+                 f"({len(nodes)} server(s), {len(proxies)} proxy(ies))")
+    lines.append(f"  {'node':<22} {'state':<9} {'req/s':>8} {'err/s':>7}  "
+                 f"{'p99 ms (span)':<22} {'mix health':<28} alerts")
+    for node_name in sorted(nodes):
+        lines.append(_watch_node_row(node_name, nodes[node_name],
+                                     node_name in actives))
+    for pname in sorted(proxies):
+        p = proxies[pname]
+        if p.get("error"):
+            lines.append(f"  proxy {pname:<16} DOWN <{p['error'][:60]}>")
+            continue
+        st = p.get("status") or {}
+        lines.append(
+            f"  proxy {pname:<16} {st.get('breaker_open', 0)} breaker(s) "
+            f"open / {st.get('breaker_backends', 0)} tracked, "
+            f"forwards {st.get('forward_count', 0)} "
+            f"(errors {st.get('forward_errors', 0)})")
+    firing = sorted({a for e in nodes.values()
+                     for a in (e.get("alerts") or [])})
+    lines.append("  alerts firing: " + (", ".join(firing) or "none"))
+    return "\n".join(lines)
+
+
+def show_watch(coord: Coordinator, engine: str, name: str, *,
+               once: bool = False, interval: float = 2.0,
+               window_s: float = 60.0) -> int:
+    """Live cluster watch (ISSUE 7): poll + render until interrupted
+    (``--once`` renders a single frame — the scriptable/CI form)."""
+    import time as _time
+
+    while True:
+        data = collect_watch(coord, engine, name, window_s)
+        ts = _time.strftime("%H:%M:%S")
+        frame = render_watch_frame(data, ts=ts)
+        if once:
+            print(frame)
+            return 0 if data.get("nodes") else -1
+        # full-frame refresh: clear + home, like watch(1)
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        try:
+            _time.sleep(max(interval, 0.2))
+        except KeyboardInterrupt:
+            return 0
 
 
 def _proxies(coord: Coordinator) -> List[NodeInfo]:
@@ -372,6 +582,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             return show_breakers(coord, ns.type, ns.name)
         if ns.cmd == "trace":
             return show_trace(coord, ns.type, ns.name, ns.trace_id)
+        if ns.cmd == "alerts":
+            return show_alerts(coord, ns.type, ns.name)
+        if ns.cmd == "watch":
+            return show_watch(coord, ns.type, ns.name, once=ns.once,
+                              interval=ns.interval, window_s=ns.window)
         if ns.cmd in ("start", "stop"):
             server = ns.server or ns.type
             name = f"{server}/{ns.name}"

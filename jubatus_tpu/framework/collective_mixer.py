@@ -146,6 +146,10 @@ class CollectiveMixer(RpcLinearMixer):
         #: ran (cast/ship/reduce/readback ms + payload/wire MB) — the
         #: per-round log the reference keeps (linear_mixer.cpp:553-558)
         self.last_phases: Dict[str, Any] = {}
+        #: error-feedback residual norms cached at round end (ISSUE 7):
+        #: get_status and the drift-rate gauge read these instead of
+        #: paying device reductions per scrape
+        self._ef_norms: Dict[str, float] = {}
 
     # -- coordinator paths ----------------------------------------------------
     def _go_path(self) -> str:
@@ -233,6 +237,7 @@ class CollectiveMixer(RpcLinearMixer):
         is what makes partial entry impossible for live members."""
         deadline = time.monotonic() + self._go_wait()
         base: Optional[int] = None
+        world_n = 0
         while time.monotonic() < deadline:
             with self._staged_lock:
                 if rid not in self._staged:
@@ -251,6 +256,7 @@ class CollectiveMixer(RpcLinearMixer):
                     got = got.decode() if isinstance(got, bytes) else got
                     if got == rid:
                         base = int(msg.get("base", 0))
+                        world_n = int(msg.get("n", 0))
                         break
             time.sleep(_GO_POLL_SEC)
         if base is None:
@@ -274,6 +280,7 @@ class CollectiveMixer(RpcLinearMixer):
                     got = got.decode() if isinstance(got, bytes) else got
                     if got == rid:  # GO was there all along: enter late,
                         base = int(msg.get("base", 0))  # peers are waiting
+                        world_n = int(msg.get("n", 0))
                 except Exception:  # broad-ok
                     pass
             if base is None:
@@ -305,7 +312,7 @@ class CollectiveMixer(RpcLinearMixer):
                 return
         ok = False
         try:
-            ok = self._enter_collective(rid, base)
+            ok = self._enter_collective(rid, base, world_n)
         except Exception as e:  # broad-ok — world torn down mid-psum
             log.exception("collective entry failed for round %s", rid)
             self.flight.record("collective", ok=False, round_id=rid,
@@ -336,7 +343,8 @@ class CollectiveMixer(RpcLinearMixer):
         except Exception:  # broad-ok — already down is fine
             log.debug("jax.distributed.shutdown raised", exc_info=True)
 
-    def _enter_collective(self, rid: str, base_version: int) -> bool:
+    def _enter_collective(self, rid: str, base_version: int,
+                          world_n: int = 0) -> bool:
         with self._staged_lock:
             entry = self._staged.pop(rid, None)
         if entry is None:
@@ -358,19 +366,83 @@ class CollectiveMixer(RpcLinearMixer):
         totals = psum_pytree(entry["diffs"], compress=self.compress,
                              phases=self.last_phases, prefer_device=True,
                              feedback=self.ef)
+        # mix-convergence telemetry (ISSUE 7): every member measures the
+        # distance of its OWN contribution from the folded average — the
+        # per-member half of the divergence signal the RPC master
+        # computes centrally. Device leaves reduce on device; only the
+        # scalar norms come back to the host.
+        health = self._entry_health(entry["diffs"], totals, world_n)
         ok = self.local_put_obj({
             "protocol": PROTOCOL_VERSION,
             "schema": entry["union"],
             "base_version": base_version,
             "diffs": totals,
+            "health": health,
         })
+        if ok:
+            self._note_round_telemetry()
         # flight record for THIS member's collective entry: the per-phase
         # breakdown (ship/reduce/readback + chunks) is per-member, so
         # every participant logs one — the master additionally logs a
         # collective_master record with the ack fold
         self.flight.record("collective", ok=ok, round_id=rid,
-                           phases=dict(self.last_phases))
+                           phases=dict(self.last_phases),
+                           health=health or None)
         return ok
+
+    def _entry_health(self, own: Dict[str, Any], totals: Dict[str, Any],
+                      world_n: int) -> Dict[str, Any]:
+        """Convergence stats for one collective entry: relative L2 of
+        (own contribution - totals/n). Empty when the GO marker came
+        from a pre-ISSUE-7 master (no world size on the wire)."""
+        if world_n <= 0:
+            return {}
+        from jubatus_tpu.framework.linear_mixer import (
+            _leaf_sq, _flatten, _sum_names, divergence_sq)
+
+        try:
+            with self.driver.lock:
+                names = _sum_names(self.driver.get_mixables())
+            if not names:
+                return {}
+            avg_sq = sum(
+                _leaf_sq(t) / (world_n * world_n)
+                for name in names if name in totals
+                for t in _flatten(totals[name]))
+            denom = (avg_sq ** 0.5) + 1e-12
+            rel = (divergence_sq(own, totals, world_n, names) ** 0.5) / denom
+            return {"premix_divergence": round(rel, 6),
+                    "update_norm": round((avg_sq ** 0.5) * world_n, 6),
+                    "contributors": world_n}
+        except Exception:  # broad-ok — telemetry must never fail a round
+            log.debug("entry health computation failed", exc_info=True)
+            return {}
+
+    def _note_round_telemetry(self) -> None:
+        """Round-end gauges for the wire and the error-feedback chains:
+        wire MB shipped, residual norms, and the residual DRIFT RATE
+        (norm change per round) the SLO engine can watch — a positive
+        drift rate sustained over rounds means quantization error is
+        accumulating faster than the telescoping cancels it."""
+        wire_mb = self.last_phases.get("wire_mb")
+        if isinstance(wire_mb, (int, float)):
+            self.trace.gauge("mix.wire_mb", float(wire_mb))
+        if self.ef is None or self.ef.rounds == 0:
+            return
+        try:
+            norms = self.ef.norms()
+        except Exception:  # broad-ok — telemetry must never fail a round
+            log.debug("ef norm computation failed", exc_info=True)
+            return
+        prev = self._ef_norms.get("contrib_residual_norm")
+        self._ef_norms = norms
+        self.trace.gauge("mix.ef_contrib_residual_norm",
+                         norms["contrib_residual_norm"])
+        self.trace.gauge("mix.ef_total_residual_norm",
+                         norms["total_residual_norm"])
+        if prev is not None:
+            self.trace.gauge("mix.ef_residual_drift_rate",
+                             round(norms["contrib_residual_norm"] - prev, 9))
 
     # -- master round --------------------------------------------------------
     def _run_as_master(self, members: Sequence[NodeInfo]) -> Optional[Dict[str, Any]]:
@@ -437,7 +509,8 @@ class CollectiveMixer(RpcLinearMixer):
         try:
             if not self.comm.coord.set(
                     self._go_path(),
-                    pack_obj({"rid": rid, "base": base_version})):
+                    pack_obj({"rid": rid, "base": base_version,
+                              "n": len(members)})):
                 raise RuntimeError("coordinator refused the GO write")
         except Exception:  # broad-ok
             self.comm.collect("mix_abort", rid)
@@ -518,6 +591,8 @@ class CollectiveMixer(RpcLinearMixer):
         if self.ef is not None:
             for k, v in self.ef.stats().items():
                 st[f"mix_ef_{k}"] = v
+        for k, v in self._ef_norms.items():
+            st[f"mix_ef_{k}"] = v
         for k, v in self.last_phases.items():
             st[f"last_mix_{k}"] = v
         return st

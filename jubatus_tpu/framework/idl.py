@@ -212,8 +212,10 @@ def get_service(engine: str) -> Tuple[Method, ...]:
 IDEMPOTENT_BUILTINS: FrozenSet[str] = frozenset({
     "get_config", "get_status", "get_metrics", "get_mix_history",
     "get_spans", "get_slow_log",
+    "get_timeseries", "get_alerts",
     "get_proxy_status", "get_proxy_metrics", "get_proxy_spans",
-    "get_proxy_slow_log", "get_breakers",
+    "get_proxy_slow_log", "get_proxy_timeseries", "get_proxy_alerts",
+    "get_breakers",
     "mix_get_schema", "mix_get_diff", "mix_get_model",
 })
 

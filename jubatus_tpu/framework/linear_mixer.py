@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import math
 import random
 import threading
 import time
@@ -79,6 +80,95 @@ def unpack_mix(data: bytes):
     if tag == b"R":
         return unpack_obj(data[1:])
     return unpack_obj(data)
+
+
+# -- mix-convergence telemetry (ISSUE 7) --------------------------------------
+# The health plane answers "is the LEARNING healthy?": how far apart the
+# replicas' contributions are before the fold (divergence), how big the
+# applied step is (update norm), and which members keep missing rounds
+# (staleness). Computed once per round from data the round already holds
+# — no extra RPCs, one vector pass over the payloads.
+
+def _leaf_sq(x: Any) -> float:
+    """Sum of squares of one diff leaf. Multiplying by 1.0 promotes int
+    leaves without forcing a host copy of device arrays (jnp and numpy
+    both dispatch through the operators); scalar leaves fall through."""
+    d = x * 1.0
+    s = getattr(d, "sum", None)
+    if s is None:
+        return float(d * d)
+    return float((d * d).sum())
+
+
+def _pair_sq(a: Any, b: Any, b_scale: float) -> float:
+    """Sum of squares of ``a - b * b_scale`` (0.0 on a leaf-shape
+    mismatch: row-trimmed label diffs may differ by a row — tree_sum
+    pads them for the fold, the health stats just skip them)."""
+    if getattr(a, "shape", None) != getattr(b, "shape", None):
+        return 0.0
+    d = a * 1.0 - b * b_scale
+    s = getattr(d, "sum", None)
+    if s is None:
+        return float(d * d)
+    return float((d * d).sum())
+
+
+def _sum_names(mixables: Dict[str, Any]) -> List[str]:
+    """Mixables whose fold is elementwise addition — the only ones for
+    which "contribution vs folded average" is meaningful."""
+    return [name for name, m in mixables.items()
+            if getattr(m, "mix", None) is None
+            or getattr(m, "MIX_IS_SUM", False)]
+
+
+def _flatten(tree: Any) -> List[Any]:
+    import jax
+
+    return jax.tree_util.tree_flatten(tree)[0]
+
+
+def divergence_sq(diffs: Dict[str, Any], totals: Dict[str, Any],
+                  n: int, names: List[str]) -> float:
+    """Squared L2 distance of one member's contribution from the folded
+    average (``totals / n``), summed over the summable mixables."""
+    s = 0.0
+    for name in names:
+        if name not in diffs or name not in totals:
+            continue
+        own = _flatten(diffs[name])
+        tot = _flatten(totals[name])
+        if len(own) != len(tot):
+            continue
+        for a, t in zip(own, tot):
+            s += _pair_sq(a, t, 1.0 / n)
+    return s
+
+
+def mix_health(contribs: List[Dict[str, Any]], totals: Dict[str, Any],
+               names: List[str]) -> Dict[str, Any]:
+    """Per-round convergence stats: relative pre-mix divergence of each
+    contribution vs the folded average, and the applied step's norm.
+    Divergences are normalized by the average's own norm so the signal
+    is scale-free — 0.0 means the replicas agree, ~1.0 means they are
+    as far apart as the update is big (learning divergence or a sick
+    replica)."""
+    n = len(contribs)
+    if n == 0 or not names:
+        return {}
+    avg_sq = sum(
+        _leaf_sq(t) / (n * n)
+        for name in names if name in totals
+        for t in _flatten(totals[name]))
+    denom = math.sqrt(avg_sq) + 1e-12
+    rel = [math.sqrt(divergence_sq(d, totals, n, names)) / denom
+           for d in contribs]
+    update_norm = math.sqrt(avg_sq) * n
+    return {
+        "premix_divergence_mean": round(sum(rel) / n, 6),
+        "premix_divergence_max": round(max(rel), 6),
+        "update_norm": round(update_norm, 6),
+        "contributors": n,
+    }
 
 
 class LinearCommunication:
@@ -265,6 +355,23 @@ class RpcLinearMixer:
         #: locally-applied put_diff so the member (re)registers ITSELF in the
         #: actives list through its own coordinator session
         self.on_active: Optional[Any] = None
+        # -- model-health plane (ISSUE 7) --------------------------------
+        #: master-side staleness bookkeeping: rounds THIS node led, and
+        #: per-member (round index of last contribution, round index
+        #: first seen) — staleness = rounds since a member's diff last
+        #: made it into a fold this master ran
+        self._rounds_led = 0
+        self._member_last_contrib: Dict[str, int] = {}
+        self._member_first_seen: Dict[str, int] = {}
+        #: did the last master round this node led proceed without every
+        #: member's diff? (/healthz degraded-reason "mix_quorum_degraded")
+        self.last_round_degraded = False
+        #: member-side staleness: consecutive put_diffs this member
+        #: failed to apply (0 = healthy; grows while obsolete/recovering)
+        self.self_staleness = 0
+        #: last round's convergence stats, as received in the put_diff
+        #: payload (every member holds the master's computed view)
+        self.last_health: Dict[str, Any] = {}
 
     # -- RPC surface served by the owning server (linear_mixer.cpp:270-290) --
     def register_api(self, rpc_server, name_check: str = "") -> None:
@@ -336,6 +443,9 @@ class RpcLinearMixer:
         if msg.get("protocol") != PROTOCOL_VERSION:
             log.error("mix protocol mismatch: %s", msg.get("protocol"))
             return False
+        health = msg.get("health")
+        if isinstance(health, dict):
+            self._note_health(health)
         base_version = int(msg.get("base_version", 0))
         if self.model_version < base_version:
             # I missed rounds (fresh boot / restart): the fold is deltas
@@ -358,6 +468,10 @@ class RpcLinearMixer:
             if ok:
                 self.model_version = base_version + 1
         self._obsolete = not ok
+        # member-side staleness: every member gauges its OWN distance
+        # from the cluster's round cadence (applied rounds reset it)
+        self.self_staleness = 0 if ok else self.self_staleness + 1
+        self.trace.gauge("mix.self_staleness", self.self_staleness)
         if self.on_active is not None:
             try:
                 self.on_active(ok)
@@ -370,6 +484,21 @@ class RpcLinearMixer:
                 target=self._recover_soon, daemon=True, name="mix-recover"
             ).start()
         return ok
+
+    def _note_health(self, health: Dict[str, Any]) -> None:
+        """Adopt one round's convergence stats (master-computed for the
+        RPC mix, self-computed for the collective): remember the dict
+        for get_status and publish the scalar gauges every member's
+        /metrics must carry (ISSUE 7 acceptance)."""
+        norm = {k.decode() if isinstance(k, bytes) else str(k): v
+                for k, v in health.items()}
+        self.last_health = norm
+        for key in ("premix_divergence_mean", "premix_divergence_max",
+                    "premix_divergence", "update_norm", "staleness_max",
+                    "contributors"):
+            v = norm.get(key)
+            if isinstance(v, (int, float)):
+                self.trace.gauge(f"mix.{key}", float(v))
 
     def _recover_soon(self) -> None:
         time.sleep(0.2)  # let the master finish broadcasting this round
@@ -468,9 +597,10 @@ class RpcLinearMixer:
                                    reason="all_get_diffs_failed",
                                    members=len(members))
                 return None
-            payloads = [unpack_mix(p) for _, p in replies]
-            payloads = [p for p in payloads
-                        if p.get("protocol") == PROTOCOL_VERSION]
+            entries = [(node, unpack_mix(p)) for node, p in replies]
+            entries = [(node, p) for node, p in entries
+                       if p.get("protocol") == PROTOCOL_VERSION]
+            payloads = [p for _, p in entries]
             if not payloads:
                 self.flight.record("rpc", ok=False,
                                    reason="no_protocol_payloads",
@@ -512,9 +642,19 @@ class RpcLinearMixer:
             base_version = max(
                 (int(p.get("version", 0)) for p in payloads), default=0
             )
+            # mix-convergence telemetry (ISSUE 7): divergence of each
+            # contribution vs the folded average + per-member staleness,
+            # shipped INSIDE the put_diff payload so every member (not
+            # just the master) gauges the round's health. Old peers
+            # ignore the extra key — the protocol version is unchanged.
+            health = mix_health([p["diffs"] for p in payloads], totals,
+                                _sum_names(mixables))
+            health.update(self._staleness_update(
+                members, {node.name for node, _ in entries}))
             packed = pack_mix(
                 {"protocol": PROTOCOL_VERSION, "schema": schema_union,
-                 "base_version": base_version, "diffs": totals}
+                 "base_version": base_version, "diffs": totals,
+                 "health": health}
             )
         phases["fold_ms"] = round(sp.seconds * 1e3, 2)
         with self.trace.span("mix.phase.put_diff") as sp:
@@ -532,11 +672,33 @@ class RpcLinearMixer:
             "mix round %d: %d members, %d bytes, %.3fs",
             self.mix_count, len(members), len(packed), time.monotonic() - t0,
         )
+        self.last_round_degraded = bool(degraded)
         return {"members": len(members), "bytes": len(packed),
                 "mode": "rpc", "phases": phases,
                 "contributors": len(payloads),
                 "degraded": True if degraded else None,
+                "health": health or None,
                 "acked": sum(bool(v) for v in acks.values())}
+
+    def _staleness_update(self, members: Sequence[NodeInfo],
+                          contributed: set) -> Dict[str, Any]:
+        """Advance the master-side staleness ledger for one led round
+        and return the health fields: per-member rounds since last
+        contribution (0 = contributed this round) and the max."""
+        self._rounds_led += 1
+        idx = self._rounds_led
+        staleness: Dict[str, int] = {}
+        for m in members:
+            self._member_first_seen.setdefault(m.name, idx - 1)
+            if m.name in contributed:
+                self._member_last_contrib[m.name] = idx
+            base = self._member_last_contrib.get(
+                m.name, self._member_first_seen[m.name])
+            staleness[m.name] = idx - base
+        if not staleness:
+            return {}
+        return {"staleness": staleness,
+                "staleness_max": max(staleness.values())}
 
     # -- obsolete-model recovery (linear_mixer.cpp:404-424,598-632) ----------
     def maybe_recover(self) -> bool:
@@ -581,7 +743,12 @@ class RpcLinearMixer:
         st = self._scheduler.get_status()
         st.update({"bytes_sent": self.bytes_sent, "obsolete": self._obsolete,
                    "model_version": self.model_version,
-                   "quorum_fraction": self.quorum_fraction})
+                   "quorum_fraction": self.quorum_fraction,
+                   "self_staleness": self.self_staleness,
+                   "last_round_degraded": self.last_round_degraded})
+        for k, v in self.last_health.items():
+            if isinstance(v, (int, float, dict)):
+                st[f"health_{k}"] = v
         breakers = getattr(self.comm, "breakers", None)
         if breakers is not None:
             snap = breakers.snapshot()

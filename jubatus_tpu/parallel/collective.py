@@ -61,6 +61,7 @@ which is exactly what the error-feedback drift gates exercise).
 from __future__ import annotations
 
 import functools
+import math
 import os
 import time
 from collections import deque
@@ -146,6 +147,23 @@ class ErrorFeedback:
 
     def stats(self) -> Dict[str, int]:
         return {"rounds": self.rounds, "chunks": len(self.contrib)}
+
+    def norms(self) -> Dict[str, float]:
+        """L2 norm of each residual chain — the model-health plane's
+        drift signal (ISSUE 7): a residual norm that GROWS round over
+        round means quantization error is being deferred faster than
+        the telescoping cancels it. One device reduction per residual
+        chunk, so call this once per round (the mixer caches it for
+        get_status), not per scrape."""
+        out: Dict[str, float] = {}
+        for name, chain in (("contrib", self.contrib),
+                            ("total", self.total)):
+            s = 0.0
+            for v in chain.values():
+                d = v * 1.0  # promote without a host copy; jnp or numpy
+                s += float((d * d).sum())
+            out[f"{name}_residual_norm"] = float(math.sqrt(s))
+        return out
 
 
 def _world_mesh() -> Mesh:

@@ -98,6 +98,27 @@ class ServerArgs:
     #: repeated strings skip re-splitting/re-hashing; 0 disables
     #: memoization
     fv_cache_size: int = 65536
+    #: --slo (repeatable): declarative SLOs evaluated as multi-window
+    #: burn rates over the metric time-series ring (utils/slo.py).
+    #: Grammar: ``latency:<span>:p<QQ>:<threshold_ms>[:<objective>]``,
+    #: ``error_rate:<span|*>:<objective>``, ``gauge:<key>:<ceiling>``;
+    #: optional ``name=`` prefix. Firing alerts surface as ``slo.*``
+    #: gauges on /metrics, degrade /healthz, and list under
+    #: ``jubactl -c alerts``.
+    slo: List[str] = dataclasses.field(default_factory=list)
+    #: --slo-fast/slow-window: the multi-window burn-rate pair (s) —
+    #: the fast window proves the burn is CURRENT (and clears alerts
+    #: quickly after recovery), the slow one that it is significant
+    slo_fast_window: float = 300.0
+    slo_slow_window: float = 3600.0
+    #: --slo-burn-threshold: fire when BOTH windows burn error budget
+    #: at/above this multiple of the sustainable rate
+    slo_burn_threshold: float = 2.0
+    #: --timeseries-capacity: points retained in the per-process metric
+    #: time-series ring (one point per telemetry tick; the default is
+    #: 1 h of history at the 10 s interval). 0 disables the ring (and
+    #: with it SLO evaluation and get_timeseries).
+    timeseries_capacity: int = 360
 
     @property
     def is_standalone(self) -> bool:
@@ -233,12 +254,39 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "tokenization/filter/name memo caches (repeated "
                         "hot strings skip re-splitting and re-hashing); "
                         "0 disables memoization")
+    p.add_argument("--slo", action="append", default=None, metavar="SPEC",
+                   help="declarative SLO evaluated as a multi-window "
+                        "burn rate (repeatable). SPEC is "
+                        "latency:<span>:p<QQ>:<threshold_ms>[:<objective>]"
+                        " (e.g. latency:rpc.classify:p99:50), "
+                        "error_rate:<span|*>:<objective> "
+                        "(e.g. error_rate:*:0.01), or "
+                        "gauge:<key>:<ceiling>; an optional name= prefix "
+                        "names the alert. Firing alerts surface as "
+                        "slo.* gauges on /metrics, degrade /healthz, and "
+                        "list under jubactl -c alerts")
+    p.add_argument("--slo-fast-window", type=float, default=300.0,
+                   help="fast burn-rate window in seconds (proves the "
+                        "burn is current; clears alerts after recovery)")
+    p.add_argument("--slo-slow-window", type=float, default=3600.0,
+                   help="slow burn-rate window in seconds (proves the "
+                        "burn is significant, not one blip)")
+    p.add_argument("--slo-burn-threshold", type=float, default=2.0,
+                   help="fire an alert when BOTH windows burn error "
+                        "budget at/above this multiple of the "
+                        "sustainable rate")
+    p.add_argument("--timeseries-capacity", type=int, default=360,
+                   help="points retained in the metric time-series ring "
+                        "(one per telemetry tick; default = 1 h at the "
+                        "10 s interval). 0 disables the ring, SLO "
+                        "evaluation, and get_timeseries")
     return p
 
 
 def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
     ns = build_parser().parse_args(argv)
-    args = ServerArgs(**{
+    ns.slo = ns.slo or []  # argparse append default stays None (shared
+    args = ServerArgs(**{  # mutable [] would leak across parses)
         f.name: getattr(ns, f.name) for f in dataclasses.fields(ServerArgs)
     })
     if args.thread < 1:
@@ -259,6 +307,19 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
         raise SystemExit("--telemetry-interval must be >= 0")
     if args.fv_cache_size < 0:
         raise SystemExit("--fv-cache-size must be >= 0")
+    if args.timeseries_capacity < 0:
+        raise SystemExit("--timeseries-capacity must be >= 0")
+    if args.slo_fast_window <= 0 or args.slo_slow_window <= 0:
+        raise SystemExit("--slo-*-window must be > 0")
+    if args.slo_burn_threshold <= 0:
+        raise SystemExit("--slo-burn-threshold must be > 0")
+    for spec in args.slo:
+        from jubatus_tpu.utils.slo import parse_slo
+
+        try:  # reject bad grammar at argv time, not at first tick
+            parse_slo(spec)
+        except ValueError as e:
+            raise SystemExit(str(e))
     if args.mix_bf16 and args.mix_compress == "off":
         args.mix_compress = "bf16"  # deprecated alias resolves here
     if not args.is_standalone and not args.name:

@@ -87,6 +87,29 @@ class EngineServer:
         self.telemetry = RuntimeTelemetry(
             self.rpc.trace,
             interval_sec=getattr(self.args, "telemetry_interval", 10.0))
+        # model-health plane (ISSUE 7): the metric time-series ring +
+        # the SLO burn-rate engine, both ticked by the telemetry
+        # sampler (one thread owns all periodic observability work)
+        from jubatus_tpu.utils.slo import SloEngine, parse_slo
+        from jubatus_tpu.utils.timeseries import TimeSeriesRing
+
+        ts_cap = getattr(self.args, "timeseries_capacity", 360)
+        interval = self.telemetry.interval_sec
+        self.timeseries: Optional[TimeSeriesRing] = None
+        self.slo: Optional[SloEngine] = None
+        if ts_cap > 0:
+            self.timeseries = TimeSeriesRing(
+                capacity=ts_cap,
+                min_spacing_s=min(1.0, interval / 2) if interval > 0
+                else 0.0)
+            self.slo = SloEngine(
+                [parse_slo(s) for s in getattr(self.args, "slo", []) or []],
+                self.timeseries, self.rpc.trace,
+                fast_window_s=getattr(self.args, "slo_fast_window", 300.0),
+                slow_window_s=getattr(self.args, "slo_slow_window", 3600.0),
+                burn_threshold=getattr(
+                    self.args, "slo_burn_threshold", 2.0))
+            self.telemetry.hooks.append(self._model_health_tick)
         self._stop_event = threading.Event()
         self._stop_once = threading.Lock()  # first stop() wins; rest no-op
         #: Prometheus /metrics + /healthz endpoint (--metrics-port >= 0)
@@ -286,15 +309,85 @@ class EngineServer:
         node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
         return {node.name: self.rpc.trace.slowlog.snapshot()}
 
+    # -- model-health plane (ISSUE 7) ----------------------------------------
+    def _model_health_tick(self) -> None:
+        """One telemetry tick: snapshot the registry into the
+        time-series ring, then re-evaluate every SLO's burn rates
+        against the updated ring."""
+        if self.timeseries is None:
+            return
+        self.timeseries.sample(self.rpc.trace.snapshot())
+        if self.slo is not None:
+            self.slo.evaluate()
+
+    def get_timeseries(self, _name: str = "") -> Dict[str, Any]:
+        """This node's metric time-series ring (utils/timeseries.py),
+        keyed like get_status: ring stats + the raw points, so callers
+        (jubactl -c watch) compute windowed rates/quantiles per node
+        and fold across the cluster."""
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        if self.timeseries is None:
+            return {node.name: {"stats": {}, "points": []}}
+        return {node.name: {"stats": self.timeseries.stats(),
+                            "points": self.timeseries.points()}}
+
+    def get_alerts(self, _name: str = "") -> Dict[str, Any]:
+        """This node's SLO state (utils/slo.py): currently-firing
+        alerts plus every configured SLO's last-evaluated burn rates —
+        the per-node half of ``jubactl -c alerts``."""
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        if self.slo is None:
+            return {node.name: {"alerts": [], "slos": []}}
+        return {node.name: {"alerts": self.slo.alerts(),
+                            "slos": self.slo.status()}}
+
+    def _degraded_reasons(self) -> list:
+        """Structured degraded-reason list for /healthz and get_status:
+        firing SLOs, open mix breakers, a quorum-degraded last round,
+        an obsolete (recovering) model, a torn-down collective plane."""
+        reasons: list = []
+        if self.slo is not None:
+            for a in self.slo.alerts():
+                reasons.append({"kind": "slo_firing", "name": a["name"],
+                                "burn_fast": a.get("burn_fast"),
+                                "burn_slow": a.get("burn_slow")})
+        m = self.mixer
+        if m is not None:
+            breakers = getattr(getattr(m, "comm", None), "breakers", None)
+            if breakers is not None:
+                open_backends = [k for k, b in breakers.snapshot().items()
+                                 if b["state"] == "open"]
+                if open_backends:
+                    reasons.append({"kind": "mix_breaker_open",
+                                    "count": len(open_backends),
+                                    "backends": sorted(open_backends)})
+            if getattr(m, "last_round_degraded", False):
+                reasons.append({"kind": "mix_quorum_degraded"})
+            if getattr(m, "_obsolete", False):
+                reasons.append({"kind": "model_obsolete",
+                                "staleness": getattr(m, "self_staleness", 0)})
+            if getattr(m, "collective_dead", False):
+                reasons.append({"kind": "collective_dead"})
+        return reasons
+
     def _health(self) -> Dict[str, Any]:
-        """Liveness document for /healthz (utils/metrics_http.py)."""
+        """Liveness document for /healthz (utils/metrics_http.py).
+        ``status`` degrades to "degraded" with a STRUCTURED reason list
+        (ISSUE 7) — orchestration keeps getting its 200 (the process
+        serves), operators and the watch view get the why."""
+        reasons = self._degraded_reasons()
         doc: Dict[str, Any] = {
+            "status": "degraded" if reasons else "ok",
+            "degraded_reasons": reasons,
             "engine": self.engine,
             "name": self.args.name,
             "uptime_s": int(time.time() - self.start_time),  # wall-clock
             "rpc_port": self.rpc.port or self.args.rpc_port,
             "update_count": self.driver.update_count,
         }
+        if self.slo is not None:
+            doc["slo_count"] = len(self.slo.specs)
+            doc["slo_firing"] = len(self.slo.alerts())
         if self.mixer is not None:
             doc["mix_count"] = getattr(self.mixer, "mix_count", 0)
         # runtime telemetry summary (full key set lives in get_status)
@@ -352,6 +445,18 @@ class EngineServer:
                    for k, v in self.telemetry.status().items()})
         st.update({f"slowlog.{k}": v
                    for k, v in self.rpc.trace.slowlog.stats().items()})
+        # model-health plane (ISSUE 7): health verdict + time-series
+        # ring depth + SLO burn states, so `jubactl -c status --all`
+        # and the watch view read one map
+        reasons = self._degraded_reasons()
+        st["health.status"] = "degraded" if reasons else "ok"
+        st["health.reasons"] = reasons
+        if self.timeseries is not None:
+            st.update({f"timeseries.{k}": v
+                       for k, v in self.timeseries.stats().items()})
+        if self.slo is not None:
+            st["slo.configured"] = len(self.slo.specs)
+            st["slo.firing"] = len(self.slo.alerts())
         # process-wide counters (zk session events, ...) live in the
         # default registry; surface them without clobbering our own
         from jubatus_tpu.utils import tracing as _tracing

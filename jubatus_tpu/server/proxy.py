@@ -107,6 +107,15 @@ class ProxyArgs:
     slowlog_min_count: int = 64
     #: runtime telemetry sampler period (0 disables the thread)
     telemetry_interval: float = 10.0
+    #: --slo et al.: the model-health plane at the PROXY hop (ISSUE 7) —
+    #: same grammar/semantics as the engine servers (utils/slo.py);
+    #: proxy-side SLOs watch the forwarded-request spans
+    slo: List[str] = dataclasses.field(default_factory=list)
+    slo_fast_window: float = 300.0
+    slo_slow_window: float = 3600.0
+    slo_burn_threshold: float = 2.0
+    #: metric time-series ring depth (0 disables ring + SLO evaluation)
+    timeseries_capacity: int = 360
 
     @property
     def bind_host(self) -> str:
@@ -259,6 +268,27 @@ class Proxy:
         self.telemetry = RuntimeTelemetry(
             self.rpc.trace,
             interval_sec=getattr(args, "telemetry_interval", 10.0))
+        # model-health plane (ISSUE 7) at the proxy hop: time-series
+        # ring + SLO burn-rate engine, ticked by the telemetry sampler
+        from jubatus_tpu.utils.slo import SloEngine, parse_slo
+        from jubatus_tpu.utils.timeseries import TimeSeriesRing
+
+        ts_cap = getattr(args, "timeseries_capacity", 360)
+        interval = self.telemetry.interval_sec
+        self.timeseries: Optional[TimeSeriesRing] = None
+        self.slo: Optional[SloEngine] = None
+        if ts_cap > 0:
+            self.timeseries = TimeSeriesRing(
+                capacity=ts_cap,
+                min_spacing_s=min(1.0, interval / 2) if interval > 0
+                else 0.0)
+            self.slo = SloEngine(
+                [parse_slo(s) for s in getattr(args, "slo", []) or []],
+                self.timeseries, self.rpc.trace,
+                fast_window_s=getattr(args, "slo_fast_window", 300.0),
+                slow_window_s=getattr(args, "slo_slow_window", 3600.0),
+                burn_threshold=getattr(args, "slo_burn_threshold", 2.0))
+            self.telemetry.hooks.append(self._model_health_tick)
         self._register_methods()
         if hasattr(self.rpc, "relay_config"):
             t = threading.Thread(target=self._relay_refresher, daemon=True,
@@ -707,11 +737,26 @@ class Proxy:
                           self._forensics_handler(
                               "get_slow_log", self.get_proxy_slow_log),
                           arity=1)
+        # model-health plane (ISSUE 7): one call against the proxy
+        # returns the whole cluster's time-series/alert state (backends
+        # broadcast + the proxy's own hop folded in)
+        self.rpc.register("get_timeseries",
+                          self._forensics_handler(
+                              "get_timeseries", self.get_proxy_timeseries),
+                          arity=1)
+        self.rpc.register("get_alerts",
+                          self._forensics_handler(
+                              "get_alerts", self.get_proxy_alerts),
+                          arity=1)
         self._register("do_mix", 1, "random", aggregators.pass_)
         self.rpc.register("get_proxy_status", self.get_proxy_status, arity=1)
         self.rpc.register("get_proxy_metrics", self.get_metrics, arity=1)
         self.rpc.register("get_proxy_spans", self.get_proxy_spans, arity=2)
         self.rpc.register("get_proxy_slow_log", self.get_proxy_slow_log,
+                          arity=1)
+        self.rpc.register("get_proxy_timeseries", self.get_proxy_timeseries,
+                          arity=1)
+        self.rpc.register("get_proxy_alerts", self.get_proxy_alerts,
                           arity=1)
         self.rpc.register("get_breakers", self.get_breakers, arity=1)
 
@@ -753,6 +798,31 @@ class Proxy:
         proxy hop itself)."""
         node = NodeInfo(self.args.bind_host, self.rpc.port or self.args.rpc_port)
         return {node.name: self.rpc.trace.slowlog.snapshot()}
+
+    def _model_health_tick(self) -> None:
+        """Telemetry tick: ring sample + SLO evaluation (ISSUE 7)."""
+        if self.timeseries is None:
+            return
+        self.timeseries.sample(self.rpc.trace.snapshot())
+        if self.slo is not None:
+            self.slo.evaluate()
+
+    def get_proxy_timeseries(self, _name: str = "") -> Dict[str, Any]:
+        """This proxy's OWN metric time-series ring (the RPC-routed
+        ``get_timeseries`` additionally broadcasts to the backends)."""
+        node = NodeInfo(self.args.bind_host, self.rpc.port or self.args.rpc_port)
+        if self.timeseries is None:
+            return {node.name: {"stats": {}, "points": []}}
+        return {node.name: {"stats": self.timeseries.stats(),
+                            "points": self.timeseries.points()}}
+
+    def get_proxy_alerts(self, _name: str = "") -> Dict[str, Any]:
+        """This proxy's OWN SLO state (firing alerts + burn rates)."""
+        node = NodeInfo(self.args.bind_host, self.rpc.port or self.args.rpc_port)
+        if self.slo is None:
+            return {node.name: {"alerts": [], "slos": []}}
+        return {node.name: {"alerts": self.slo.alerts(),
+                            "slos": self.slo.status()}}
 
     def get_breakers(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
         """Breaker + retry-budget state, keyed by proxy node name — the
@@ -820,12 +890,27 @@ class Proxy:
         with self._counters_lock:
             fwd, errs = self.forward_count, self.forward_errors
         breakers = self.breakers.snapshot()
+        # structured degraded reasons (ISSUE 7): open backend breakers
+        # + firing proxy-side SLOs, same shape as the servers' /healthz
+        reasons: List[Dict[str, Any]] = []
+        open_backends = sorted(
+            str(k) for k, b in breakers.items() if b["state"] == "open")
+        if open_backends:
+            reasons.append({"kind": "breaker_open",
+                            "count": len(open_backends),
+                            "backends": open_backends})
+        if self.slo is not None:
+            for a in self.slo.alerts():
+                reasons.append({"kind": "slo_firing", "name": a["name"],
+                                "burn_fast": a.get("burn_fast"),
+                                "burn_slow": a.get("burn_slow")})
         doc = {"engine": f"{self.engine}_proxy",
+               "status": "degraded" if reasons else "ok",
+               "degraded_reasons": reasons,
                "uptime_s": int(time.time() - self.start_time),  # wall-clock
                "rpc_port": self.rpc.port or self.args.rpc_port,
                "forward_count": fwd, "forward_errors": errs,
-               "breaker_open": sum(1 for b in breakers.values()
-                                   if b["state"] == "open")}
+               "breaker_open": len(open_backends)}
         rt = self.telemetry.status()
         for k in ("rss_bytes", "open_fds", "threads", "slowlog_depth"):
             if k in rt:
@@ -929,10 +1014,34 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--telemetry-interval", type=float, default=10.0,
                    help="runtime telemetry sampling period in seconds "
                         "(0 disables the sampler thread)")
+    p.add_argument("--slo", action="append", default=None, metavar="SPEC",
+                   help="declarative SLO at the proxy hop, evaluated as "
+                        "a multi-window burn rate (repeatable; same "
+                        "grammar as the servers: latency:<span>:p<QQ>:"
+                        "<threshold_ms>[:<objective>], error_rate:"
+                        "<span|*>:<objective>, gauge:<key>:<ceiling>)")
+    p.add_argument("--slo-fast-window", type=float, default=300.0,
+                   help="fast burn-rate window in seconds")
+    p.add_argument("--slo-slow-window", type=float, default=3600.0,
+                   help="slow burn-rate window in seconds")
+    p.add_argument("--slo-burn-threshold", type=float, default=2.0,
+                   help="fire when BOTH windows burn at/above this "
+                        "multiple of the sustainable budget spend")
+    p.add_argument("--timeseries-capacity", type=int, default=360,
+                   help="metric time-series ring depth (points; 0 "
+                        "disables the ring and SLO evaluation)")
     ns = p.parse_args(argv)
+    ns.slo = ns.slo or []
     args = ProxyArgs(**{f.name: getattr(ns, f.name)
                         for f in dataclasses.fields(ProxyArgs)
                         if hasattr(ns, f.name)})
+    for spec in args.slo:
+        from jubatus_tpu.utils.slo import parse_slo
+
+        try:  # reject bad grammar at argv time
+            parse_slo(spec)
+        except ValueError as e:
+            raise SystemExit(str(e))
     logging.basicConfig(
         level=logging.INFO,
         format=f"%(asctime)s %(levelname)s [{args.engine}_proxy:{args.rpc_port}] %(message)s",

@@ -67,6 +67,9 @@ def bind_engine(rpc: RpcServer, server: Any) -> None:
     # trace forensics (ISSUE 4): per-trace span store + slow-request ring
     rpc.register("get_spans", server.get_spans, arity=2)
     rpc.register("get_slow_log", server.get_slow_log, arity=1)
+    # model-health plane (ISSUE 7): metric time-series + SLO alerts
+    rpc.register("get_timeseries", server.get_timeseries, arity=1)
+    rpc.register("get_alerts", server.get_alerts, arity=1)
     rpc.register("do_mix", server.do_mix, arity=1)
     _BINDERS[server.engine](rpc, server)
 

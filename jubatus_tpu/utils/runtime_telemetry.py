@@ -173,6 +173,13 @@ class RuntimeTelemetry:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._samples = 0
+        #: per-tick callbacks (ISSUE 7): the owning server appends its
+        #: model-health tick here — time-series ring sampling + SLO
+        #: evaluation ride the existing sampler thread instead of
+        #: spawning their own. Hooks run AFTER the runtime gauges are
+        #: published (so the tick's ring point sees them) and must never
+        #: raise (guarded anyway).
+        self.hooks: list = []
         install_jax_hooks()
 
     def sample(self) -> Dict[str, Any]:
@@ -192,6 +199,11 @@ class RuntimeTelemetry:
         for k, v in s.items():
             if isinstance(v, (int, float)):
                 self.registry.gauge(k, v)
+        for hook in list(self.hooks):
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — a tick must never raise
+                log.debug("telemetry tick hook failed", exc_info=True)
         return s
 
     def status(self) -> Dict[str, Any]:

@@ -42,3 +42,15 @@ def test_host_cast_gate_fires_and_pragma_opts_out(tmp_path):
     cast_hits = [p for p in problems if "host-side numpy dtype cast" in p]
     assert len(cast_hits) == 2, problems
     assert ":3:" in cast_hits[0] and ":4:" in cast_hits[1]
+
+
+def test_metrics_docs_catalog_clean():
+    """The metric-catalog gate (ISSUE 7): every literal counter/gauge
+    key exported through the tracing registry must appear in the
+    OBSERVABILITY.md catalog — codestyle fails on undocumented keys."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    r = subprocess.run(
+        [sys.executable, str(repo / "tools" / "check_metrics_docs.py")],
+        capture_output=True, text=True, cwd=str(repo))
+    assert r.returncode == 0, \
+        f"undocumented metric keys:\n{r.stdout[-4000:]}\n{r.stderr[-2000:]}"

@@ -300,7 +300,7 @@ def test_go_observed_only_at_final_check_still_enters(monkeypatch):
         c.train([["pos", Datum({"a": 1.0})]])
         entered = []
         srv.mixer._enter_collective = \
-            lambda rid, base: entered.append((rid, base)) or True
+            lambda rid, base, *a: entered.append((rid, base)) or True
         go = pack_obj({"rid": "late-round", "base": 7})
         # zero window: the waiter skips straight to the final verification
         # read, which is exactly the path under test

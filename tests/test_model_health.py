@@ -1,0 +1,615 @@
+"""Model-health plane tests (ISSUE 7): time-series ring bounds and
+window math, SLO grammar + burn-rate fire/clear, mix-convergence
+gauges on every member of a live cluster, concurrent /metrics scrape
+under sampler + mix load, degraded /healthz, jubactl alerts/watch,
+and the metrics-docs catalog gate."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from jubatus_tpu.utils import timeseries, tracing
+from jubatus_tpu.utils.slo import SloEngine, parse_slo
+from jubatus_tpu.utils.timeseries import TimeSeriesRing, window_from_points
+
+CONF = {
+    "method": "PA",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+
+
+# -- time-series ring ---------------------------------------------------------
+
+
+def test_ring_bounds_and_eviction():
+    ring = TimeSeriesRing(capacity=5)
+    reg = tracing.Registry()
+    for i in range(12):
+        reg.count("evt")
+        assert ring.sample(reg.snapshot(), ts=1000.0 + i)
+    assert len(ring) == 5
+    pts = ring.points()
+    assert [p["ts"] for p in pts] == [1007.0, 1008.0, 1009.0, 1010.0, 1011.0]
+    st = ring.stats()
+    assert st["sampled"] == 12 and st["retained"] == 5
+    assert st["oldest_ts"] == 1007.0 and st["newest_ts"] == 1011.0
+    assert ring.points(last=2) == pts[-2:]
+
+
+def test_ring_spacing_guard_and_force():
+    ring = TimeSeriesRing(capacity=8, min_spacing_s=5.0)
+    reg = tracing.Registry()
+    assert ring.sample(reg.snapshot(), ts=100.0)
+    assert not ring.sample(reg.snapshot(), ts=101.0)  # too close
+    assert ring.sample(reg.snapshot(), ts=101.0, force=True)
+    assert ring.sample(reg.snapshot(), ts=107.0)
+    assert len(ring) == 3
+
+
+def test_window_counter_rates_and_quantiles():
+    reg = tracing.Registry()
+    ring = TimeSeriesRing(capacity=16)
+    for _ in range(100):
+        reg.record("rpc.classify", 0.001)
+    reg.count("rpc.classify.errors", 2)
+    ring.sample(reg.snapshot(), ts=0.0)
+    for _ in range(50):
+        reg.record("rpc.classify", 0.2)
+    reg.count("rpc.classify.errors", 8)
+    ring.sample(reg.snapshot(), ts=10.0)
+    win = ring.window(60.0)
+    assert win is not None
+    # only the BETWEEN-points traffic is in the window
+    assert win.span_count("rpc.classify") == 50
+    assert win.span_rate("rpc.classify") == pytest.approx(5.0)
+    assert win.counter_delta("rpc.classify.errors") == 8
+    assert win.counter_rate("rpc.classify.errors") == pytest.approx(0.8)
+    # windowed p50 reflects the slow burst, not the lifetime histogram
+    assert win.quantile_ms("rpc.classify", 0.5) == pytest.approx(200, rel=0.3)
+    assert win.bad_fraction("rpc.classify", 0.05) == pytest.approx(1.0)
+    assert win.counter_names("rpc.") == ["rpc.classify.errors"]
+    assert win.spans("rpc.") == ["rpc.classify"]
+
+
+def test_window_clamps_after_registry_reset():
+    reg = tracing.Registry()
+    ring = TimeSeriesRing(capacity=8)
+    reg.count("c", 100)
+    reg.record("s", 0.01)
+    ring.sample(reg.snapshot(), ts=0.0)
+    reg.reset()
+    reg.count("c", 5)
+    ring.sample(reg.snapshot(), ts=10.0)
+    win = ring.window(60.0)
+    assert win.counter_delta("c") == 0  # clamped, not negative
+    assert win.span_count("s") == 0
+
+
+def test_window_from_points_baseline_selection():
+    pts = [{"ts": float(t), "hists": {}, "counters": {"c": t}, "gauges": {}}
+           for t in (0, 10, 20, 30)]
+    win = window_from_points(pts, 15.0)  # start at 30-15=15 -> baseline 10
+    assert win.baseline["ts"] == 10.0
+    assert win.counter_delta("c") == 20
+    # window longer than the ring: falls back to the oldest point
+    win = window_from_points(pts, 1000.0)
+    assert win.baseline["ts"] == 0.0
+    assert window_from_points(pts[:1], 10.0) is None
+
+
+def test_hist_state_delta_is_window_histogram():
+    a, b = tracing.Histogram(), None
+    for _ in range(10):
+        a.record(0.001)
+    before = a.state()
+    for _ in range(10):
+        a.record(1.0)
+    d = timeseries.hist_state_delta(a.state(), before)
+    assert d["count"] == 10
+    assert tracing.state_quantile(d, 0.5) == pytest.approx(1.0, rel=0.3)
+    d0 = timeseries.hist_state_delta(a.state(), b)  # no baseline
+    assert d0["count"] == 20
+
+
+# -- slo grammar + burn math --------------------------------------------------
+
+
+def test_parse_slo_grammar():
+    s = parse_slo("latency:rpc.classify:p99:50")
+    assert s.kind == "latency" and s.span == "rpc.classify"
+    assert s.threshold_s == pytest.approx(0.05)
+    assert s.objective == pytest.approx(0.01)
+    assert s.name == "rpc.classify.p99"
+    s = parse_slo("hot=latency:rpc.train:p90:20:0.2")
+    assert s.name == "hot" and s.objective == pytest.approx(0.2)
+    s = parse_slo("error_rate:*:0.01")
+    assert s.kind == "error_rate" and s.span == "*"
+    s = parse_slo("gauge:mix.ef_residual_drift_rate:0.05")
+    assert s.kind == "gauge" and s.ceiling == pytest.approx(0.05)
+    for bad in ("latency:rpc.x:q99:50", "latency:rpc.x:p99:0",
+                "error_rate:*:1.5", "gauge:k:0", "nope:x:y",
+                "latency:rpc.x:p99", "error_rate:*"):
+        with pytest.raises(ValueError):
+            parse_slo(bad)
+
+
+def _ticked(reg, ring, ts):
+    ring.sample(reg.snapshot(), ts=ts)
+
+
+def test_burn_rate_fires_and_clears():
+    """Multi-window burn math on a synthetic timeline: an error/latency
+    burst fires (both windows above threshold), recovery clears (the
+    fast window moves past the burst while the slow one still sees
+    it)."""
+    reg = tracing.Registry()
+    ring = TimeSeriesRing(capacity=32)
+    eng = SloEngine(
+        [parse_slo("latency:rpc.classify:p99:50"),
+         parse_slo("error_rate:*:0.01")],
+        ring, reg, fast_window_s=30.0, slow_window_s=120.0,
+        burn_threshold=2.0)
+    t0 = 10_000.0
+    for _ in range(200):
+        reg.record("rpc.classify", 0.001)
+    _ticked(reg, ring, t0)
+    # quiet period: no burn
+    for _ in range(100):
+        reg.record("rpc.classify", 0.001)
+    _ticked(reg, ring, t0 + 20)
+    st = {s["name"]: s for s in eng.evaluate(now=t0 + 20)}
+    assert not st["rpc.classify.p99"]["firing"]
+    assert not st["errors.*"]["firing"]
+    # burst: slow requests + errors
+    for _ in range(50):
+        reg.record("rpc.classify", 0.4)
+    reg.count("rpc.classify.errors", 10)
+    _ticked(reg, ring, t0 + 40)
+    st = {s["name"]: s for s in eng.evaluate(now=t0 + 40)}
+    assert st["rpc.classify.p99"]["firing"]
+    assert st["errors.*"]["firing"]
+    assert st["errors.*"]["burn_fast"] > 2.0
+    assert reg.gauges()["slo.rpc.classify.p99.firing"] == 1.0
+    assert reg.counters()["slo.transitions"] == 2
+    assert len(eng.alerts()) == 2
+    # recovery: healthy traffic, fast window moves past the burst
+    for _ in range(300):
+        reg.record("rpc.classify", 0.001)
+    _ticked(reg, ring, t0 + 80)
+    for _ in range(300):
+        reg.record("rpc.classify", 0.001)
+    _ticked(reg, ring, t0 + 110)
+    st = {s["name"]: s for s in eng.evaluate(now=t0 + 110)}
+    assert not st["rpc.classify.p99"]["firing"]
+    assert not st["errors.*"]["firing"]
+    assert eng.alerts() == []
+    assert reg.gauges()["slo.rpc.classify.p99.firing"] == 0.0
+
+
+def test_gauge_slo_burns_on_windowed_mean():
+    reg = tracing.Registry()
+    ring = TimeSeriesRing(capacity=8)
+    eng = SloEngine([parse_slo("gauge:mix.drift:0.1")], ring, reg,
+                    fast_window_s=30, slow_window_s=60, burn_threshold=2.0)
+    reg.gauge("mix.drift", 0.05)
+    _ticked(reg, ring, 0.0)
+    reg.gauge("mix.drift", 0.05)
+    _ticked(reg, ring, 10.0)
+    st = eng.evaluate(now=10.0)[0]
+    assert st["burn_fast"] == pytest.approx(0.5) and not st["firing"]
+    reg.gauge("mix.drift", 0.4)
+    _ticked(reg, ring, 20.0)
+    st = eng.evaluate(now=20.0)[0]
+    assert st["burn_fast"] > 2.0 and st["firing"]
+
+
+def test_error_feedback_norms():
+    from jubatus_tpu.parallel.collective import ErrorFeedback
+
+    ef = ErrorFeedback()
+    assert ef.norms() == {"contrib_residual_norm": 0.0,
+                          "total_residual_norm": 0.0}
+    ef.contrib[(0, 0)] = np.array([3.0, 4.0], dtype=np.float32)
+    ef.total[(0, 0)] = np.array([6.0, 8.0], dtype=np.float32)
+    n = ef.norms()
+    assert n["contrib_residual_norm"] == pytest.approx(5.0)
+    assert n["total_residual_norm"] == pytest.approx(10.0)
+
+
+# -- cluster: convergence gauges on every member ------------------------------
+
+
+@pytest.fixture()
+def health_cluster(tmp_path):
+    """3-member linear-mixer cluster with SLOs configured and manual
+    telemetry ticks (telemetry_interval=0: no sampler thread races the
+    assertions)."""
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    coord_dir = str(tmp_path / "coord")
+    servers = []
+    for _ in range(3):
+        srv = EngineServer(
+            "classifier", CONF,
+            args=ServerArgs(engine="classifier", coordinator=coord_dir,
+                            name="mh", listen_addr="127.0.0.1",
+                            interval_sec=1e9, interval_count=1 << 30,
+                            telemetry_interval=0,
+                            slo=["latency:rpc.classify:p99:50",
+                                 "error_rate:*:0.01"],
+                            slo_fast_window=1.0, slo_slow_window=2.5,
+                            metrics_port=0))
+        srv.start(0)
+        servers.append(srv)
+    # train DIFFERENT data per node so contributions genuinely diverge
+    for i, s in enumerate(servers):
+        c = ClassifierClient("127.0.0.1", s.args.rpc_port, "mh")
+        c.train([[f"l{i}", Datum({"x": float(i + 1)})],
+                 [f"l{(i + 1) % 3}", Datum({"x": -2.0 * i - 1})]])
+        for _ in range(20):
+            c.classify([Datum({"x": 1.0})])
+        c.close()
+    yield coord_dir, servers
+    for s in servers:
+        s.stop()
+
+
+def test_mix_round_gauges_on_every_member(health_cluster):
+    """ISSUE 7 acceptance: one mix round -> divergence / staleness /
+    update-norm gauges on EVERY member, health stamped in the flight
+    record and get_status."""
+    _coord, servers = health_cluster
+    res = servers[0].mixer.mix_now()
+    assert res is not None and res["health"]["contributors"] == 3
+    assert res["health"]["premix_divergence_max"] > 0  # distinct data
+    assert res["health"]["staleness_max"] == 0
+    for s in servers:
+        g = s.rpc.trace.gauges()
+        assert g["mix.premix_divergence_mean"] > 0
+        assert g["mix.premix_divergence_max"] >= g["mix.premix_divergence_mean"]
+        assert g["mix.update_norm"] > 0
+        assert g["mix.self_staleness"] == 0.0
+        assert g["mix.staleness_max"] == 0.0
+        assert g["mix.contributors"] == 3.0
+    # flight record carries the same health dict
+    rec = servers[0].mixer.flight.snapshot()[-1]
+    assert rec["health"]["contributors"] == 3
+    assert set(rec["health"]["staleness"]) == \
+        {s.self_nodeinfo().name for s in servers}
+    # get_status flattens it under mixer.health_*
+    st = next(iter(servers[1].get_status().values()))
+    assert st["mixer.health_update_norm"] > 0
+    assert st["mixer.self_staleness"] == 0
+    assert st["health.status"] == "ok" and st["health.reasons"] == []
+
+
+def test_staleness_tracks_missing_member(health_cluster):
+    """A member whose get_diff keeps failing goes stale in the master's
+    ledger; the health dict every member receives says so."""
+    _coord, servers = health_cluster
+    assert servers[0].mixer.mix_now() is not None
+    # wedge member 2's mix_get_diff by stopping its RPC plane
+    servers[2].rpc.stop()
+    res = None
+    for _ in range(2):
+        res = servers[0].mixer.mix_now()
+    assert res is not None
+    stale = res["health"]["staleness"]
+    victim = servers[2].self_nodeinfo().name
+    assert stale[victim] >= 2
+    assert res["health"]["staleness_max"] >= 2
+    assert res["degraded"] is True
+    # survivors gauge the degraded round's staleness
+    g = servers[0].rpc.trace.gauges()
+    assert g["mix.staleness_max"] >= 2
+
+
+def test_get_timeseries_rpc_and_windowed_rates(health_cluster):
+    from jubatus_tpu.rpc.client import RpcClient
+
+    _coord, servers = health_cluster
+    srv = servers[0]
+    srv._model_health_tick()
+    time.sleep(0.05)
+    for _ in range(10):
+        srv.rpc.trace.record("rpc.classify", 0.002)
+    srv._model_health_tick()
+    with RpcClient("127.0.0.1", srv.args.rpc_port) as c:
+        ts = c.call("get_timeseries", "mh")
+    node = srv.self_nodeinfo().name
+    assert node in ts
+    points = ts[node]["points"]
+    assert len(points) >= 2
+    assert ts[node]["stats"]["retained"] == len(points)
+    win = window_from_points(points, 60.0)
+    assert win.span_count("rpc.classify") >= 10
+    st = next(iter(srv.get_status().values()))
+    assert st["timeseries.retained"] >= 2
+    assert st["slo.configured"] == 2
+
+
+def test_slo_burst_fires_degrades_healthz_then_clears(health_cluster,
+                                                      capsys):
+    """ISSUE 7 acceptance: an injected latency/error burst fires a
+    burn-rate alert that shows in jubactl -c alerts and degrades
+    /healthz, and clears after recovery."""
+    from jubatus_tpu.cmd import jubactl
+
+    coord_dir, servers = health_cluster
+    srv = servers[0]
+    reg = srv.rpc.trace
+    for _ in range(100):
+        reg.record("rpc.classify", 0.001)
+    srv._model_health_tick()
+    time.sleep(0.3)
+    # burst: slow requests + errors
+    for _ in range(40):
+        reg.record("rpc.classify", 0.5)
+    reg.count("rpc.classify.errors", 10)
+    srv._model_health_tick()
+    assert len(srv.slo.alerts()) >= 1
+    # /healthz degrades with a structured slo_firing reason
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.args.metrics_port}/healthz",
+            timeout=10) as resp:
+        doc = json.loads(resp.read().decode())
+    assert doc["status"] == "degraded"
+    kinds = {r["kind"] for r in doc["degraded_reasons"]}
+    assert "slo_firing" in kinds
+    assert doc["slo_firing"] >= 1
+    # jubactl -c alerts renders the firing row
+    rc = jubactl.main(["-c", "alerts", "-t", "classifier", "-n", "mh",
+                       "-z", coord_dir])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "FIRING" in out and "rpc.classify.p99" in out
+    # recovery: healthy traffic pushes the fast window past the burst
+    time.sleep(1.2)
+    for _ in range(400):
+        reg.record("rpc.classify", 0.001)
+    srv._model_health_tick()
+    time.sleep(0.4)
+    for _ in range(400):
+        reg.record("rpc.classify", 0.001)
+    srv._model_health_tick()
+    assert srv.slo.alerts() == []
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.args.metrics_port}/healthz",
+            timeout=10) as resp:
+        doc = json.loads(resp.read().decode())
+    assert doc["status"] == "ok"
+
+
+def test_jubactl_watch_once_renders_cluster_frame(health_cluster, capsys):
+    """ISSUE 7 acceptance: jubactl -c watch --once renders ONE coherent
+    frame: every node's row with rates/p99/mix health, plus the alerts
+    line."""
+    from jubatus_tpu.cmd import jubactl
+
+    coord_dir, servers = health_cluster
+    servers[0].mixer.mix_now()
+    for s in servers:
+        s._model_health_tick()
+    time.sleep(0.05)
+    for s in servers:
+        for _ in range(5):
+            s.rpc.trace.record("rpc.classify", 0.002)
+        s._model_health_tick()
+    rc = jubactl.main(["-c", "watch", "--once", "--window", "120",
+                       "-t", "classifier", "-n", "mh", "-z", coord_dir])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = out.splitlines()
+    assert "3 server(s)" in lines[0] and "window 120s" in lines[0]
+    for s in servers:
+        node = s.self_nodeinfo().name
+        row = next(ln for ln in lines if ln.strip().startswith(node))
+        assert "div " in row and "stale " in row  # mix health cell
+    assert any("alerts firing:" in ln for ln in lines)
+    assert "req/s" in out and "p99 ms" in out
+
+
+def test_jubactl_status_all_renders_health_line(health_cluster, capsys):
+    from jubatus_tpu.cmd import jubactl
+
+    coord_dir, servers = health_cluster
+    rc = jubactl.main(["-c", "status", "--all", "-t", "classifier",
+                       "-n", "mh", "-z", coord_dir])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.count("health: ok") == 3
+
+
+def test_degraded_reasons_cover_mix_states(health_cluster):
+    """Structured /healthz reasons: quorum-degraded round + obsolete
+    model show up with their kinds (no SLO involvement)."""
+    _coord, servers = health_cluster
+    srv = servers[1]
+    srv.mixer.last_round_degraded = True
+    srv.mixer._obsolete = True
+    kinds = {r["kind"] for r in srv._degraded_reasons()}
+    assert {"mix_quorum_degraded", "model_obsolete"} <= kinds
+    doc = srv._health()
+    assert doc["status"] == "degraded"
+    srv.mixer.last_round_degraded = False
+    srv.mixer._obsolete = False
+    assert srv._health()["status"] == "ok"
+
+
+def test_proxy_folds_timeseries_and_alerts(tmp_path):
+    """get_timeseries / get_alerts against a proxy return backend AND
+    proxy entries in one call (broadcast + fold-own)."""
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+    store = _Store()
+    srv = EngineServer(
+        "classifier", CONF,
+        args=ServerArgs(engine="classifier", coordinator="(shared)",
+                        name="pf", listen_addr="127.0.0.1",
+                        interval_sec=1e9, interval_count=1 << 30,
+                        telemetry_interval=0,
+                        slo=["error_rate:*:0.01"]),
+        coord=MemoryCoordinator(store))
+    srv.start(0)
+    proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1",
+                            telemetry_interval=0,
+                            slo=["latency:rpc.classify:p99:100"]),
+                  coord=MemoryCoordinator(store))
+    proxy.start(0)
+    try:
+        srv._model_health_tick()
+        proxy._model_health_tick()
+        time.sleep(0.02)
+        srv._model_health_tick()
+        proxy._model_health_tick()
+        srv.slo.evaluate()
+        proxy.slo.evaluate()
+        with RpcClient("127.0.0.1", proxy.args.rpc_port) as c:
+            ts = c.call("get_timeseries", "pf")
+            al = c.call("get_alerts", "pf")
+        assert len(ts) == 2 and len(al) == 2  # backend + proxy entries
+        assert all("points" in v for v in ts.values())
+        slo_names = {s["name"] for doc in al.values()
+                     for s in doc.get("slos", [])}
+        assert {"errors.*", "rpc.classify.p99"} <= slo_names
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+# -- concurrency: scrape vs sampler vs mix ------------------------------------
+
+
+def _parse_prometheus_strict(text: str) -> int:
+    """Every non-comment line must parse; bucket series must be
+    cumulative per selector. Returns the sample count."""
+    import re
+
+    pat = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? ([0-9eE.+-]+|NaN|\+Inf)'
+        r'( # \{.*\} [0-9eE.+-]+ [0-9.]+)?$')
+    assert text.endswith("\n")
+    buckets: dict = {}
+    n = 0
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = pat.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        n += 1
+        if m.group(1) == "jubatus_span_duration_seconds_bucket":
+            sel = m.group(2).split('le="')[0]
+            prev = buckets.get(sel, 0.0)
+            assert float(m.group(3)) >= prev, f"non-cumulative at {line!r}"
+            buckets[sel] = float(m.group(3))
+    return n
+
+
+@pytest.mark.slow
+def test_concurrent_scrape_sampler_and_mix(health_cluster):
+    """ISSUE 7 satellite: /metrics scraped concurrently with the
+    telemetry sampler ticking and mix rounds running — every scrape
+    parses as valid cumulative Prometheus text (no torn snapshots),
+    nothing deadlocks."""
+    _coord, servers = health_cluster
+    srv = servers[0]
+    stop = threading.Event()
+    errors: list = []
+
+    def pump_ticks():
+        while not stop.is_set():
+            try:
+                srv._model_health_tick()
+                srv.telemetry.sample()
+            except Exception as e:  # noqa: BLE001 — fail the test below
+                errors.append(repr(e))
+                return
+
+    def pump_mix():
+        while not stop.is_set():
+            try:
+                servers[0].mixer.mix_now()
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+                return
+
+    def pump_traffic():
+        i = 0
+        while not stop.is_set():
+            srv.rpc.trace.record("rpc.classify", 0.001 * (1 + i % 5))
+            i += 1
+
+    threads = [threading.Thread(target=f, daemon=True)
+               for f in (pump_ticks, pump_mix, pump_traffic)]
+    for t in threads:
+        t.start()
+    try:
+        total = 0
+        for _ in range(25):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.args.metrics_port}/metrics",
+                    timeout=10) as resp:
+                assert resp.status == 200
+                total += _parse_prometheus_strict(resp.read().decode())
+        assert total > 0
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    assert errors == []
+
+
+# -- metrics-docs catalog gate ------------------------------------------------
+
+
+def test_check_metrics_docs_clean():
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    r = subprocess.run(
+        [sys.executable, str(repo / "tools" / "check_metrics_docs.py")],
+        capture_output=True, text=True, cwd=str(repo))
+    assert r.returncode == 0, \
+        f"undocumented metric keys:\n{r.stdout}\n{r.stderr}"
+
+
+def test_check_metrics_docs_detects_missing(tmp_path):
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo / "tools"))
+    try:
+        import check_metrics_docs as cmd
+    finally:
+        sys.path.pop(0)
+    d = tmp_path / "jubatus_tpu" / "sub"
+    d.mkdir(parents=True)
+    (d / "victim.py").write_text(
+        '"""doc."""\n'
+        'self.rpc.trace.count("made.up_counter")\n'
+        'registry.gauge(f"made.{k}.gauge", 1.0)\n'
+        'ln.count("\\t")\n'  # string-method false positive: ignored
+        'reg.count("rpc.retries")\n',  # documented: passes
+        encoding="utf-8")
+    found = cmd.scan_source_keys(str(tmp_path / "jubatus_tpu"))
+    assert "made.up_counter" in found
+    assert "made.*.gauge" in found
+    assert "\t" not in found and not any("\t" in k for k in found)
+    missing = cmd.missing_keys(found, cmd.doc_keys())
+    names = {k for k, _ in missing}
+    assert names == {"made.up_counter", "made.*.gauge"}
+    # wildcard matching: <placeholders> in the doc cover f-string keys
+    assert cmd._segments_match("rpc.*.errors", "rpc.*.errors")
+    assert not cmd._segments_match("rpc.x.errors", "rpc.x")

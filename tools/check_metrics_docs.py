@@ -1,0 +1,139 @@
+#!/usr/bin/env python3
+"""Metric-catalog gate (ISSUE 7): every exported metric key must be
+documented in docs/OBSERVABILITY.md.
+
+The observability plane is only as good as its catalog — an undocumented
+counter is a dashboard nobody builds and an alert nobody writes. This
+checker extracts every LITERAL counter/gauge key registered through the
+tracing registry (``<...>.count("...")`` / ``<...>.gauge("...")`` /
+``self._count("...")`` call sites across ``jubatus_tpu/``), normalizes
+f-string placeholders (``{method}`` → ``*``), and requires each key to
+match a catalog token in OBSERVABILITY.md (backtick-quoted, with
+``<placeholder>`` segments as wildcards and ``{a,b}`` brace sets
+expanded).
+
+Keys built from variables (e.g. the breaker board's configurable
+counter prefix) are invisible to a static scan and are documented by
+hand; the gate covers the literal majority and every new ``slo.*`` /
+``mix.*`` key.
+
+Run directly or via the codestyle suite:
+
+    python tools/check_metrics_docs.py          # rc 1 + listing if missing
+"""
+
+from __future__ import annotations
+
+import glob
+import itertools
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+#: registry call sites whose first argument is a literal metric key.
+#: Receivers are constrained (trace/tracing/registry/…) so string
+#: methods like ``line.count("x")`` never match.
+_CALL_RE = re.compile(
+    r"(?:\btrace|\btracing|\bregistry|\b_registry|\breg)\s*\.\s*"
+    r"(?:count|gauge)\(\s*(f?)\"([^\"]+)\"")
+_COUNT_HELPER_RE = re.compile(r"self\._count\(\s*(f?)\"([^\"]+)\"")
+
+#: a plausible metric key after normalization: dotted lowercase segments
+_KEY_RE = re.compile(r"^[a-z][a-z0-9_*]*(\.[a-z0-9_*]+)+$")
+
+#: doc catalog tokens: anything backtick-quoted
+_DOC_TOKEN_RE = re.compile(r"`([^`]+)`")
+
+
+def _normalize_source_key(raw: str, is_fstring: bool) -> str:
+    key = raw
+    if is_fstring:
+        key = re.sub(r"\{[^}]*\}", "*", key)
+    return key
+
+
+def _expand_doc_token(token: str) -> List[str]:
+    """``zk.session.{lost,reconnects}`` → both keys; ``rpc.<method>.errors``
+    → ``rpc.*.errors``."""
+    token = re.sub(r"<[^>]+>", "*", token.strip())
+    sets = re.findall(r"\{([^}]*)\}", token)
+    if not sets:
+        return [token]
+    template = re.sub(r"\{[^}]*\}", "\x00", token)
+    combos = itertools.product(*[s.split(",") for s in sets])
+    out = []
+    for combo in combos:
+        t = template
+        for part in combo:
+            t = t.replace("\x00", part.strip(), 1)
+        out.append(t)
+    return out
+
+
+def scan_source_keys(root: str = "") -> Dict[str, List[str]]:
+    """Literal metric keys -> list of 'file:line' sites."""
+    root = root or os.path.join(REPO, "jubatus_tpu")
+    found: Dict[str, List[str]] = {}
+    for path in sorted(glob.glob(os.path.join(root, "**", "*.py"),
+                                 recursive=True)):
+        rel = os.path.relpath(path, REPO)
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                for pat in (_CALL_RE, _COUNT_HELPER_RE):
+                    for m in pat.finditer(line):
+                        key = _normalize_source_key(m.group(2),
+                                                    m.group(1) == "f")
+                        if _KEY_RE.match(key):
+                            found.setdefault(key, []).append(
+                                f"{rel}:{lineno}")
+    return found
+
+
+def doc_keys(doc_path: str = DOC) -> Set[str]:
+    with open(doc_path, encoding="utf-8") as f:
+        text = f.read()
+    keys: Set[str] = set()
+    for token in _DOC_TOKEN_RE.findall(text):
+        for expanded in _expand_doc_token(token):
+            if _KEY_RE.match(expanded):
+                keys.add(expanded)
+    return keys
+
+
+def _segments_match(found: str, doc: str) -> bool:
+    fs, ds = found.split("."), doc.split(".")
+    if len(fs) != len(ds):
+        return False
+    return all(f == d or f == "*" or d == "*" for f, d in zip(fs, ds))
+
+
+def missing_keys(found: Dict[str, List[str]],
+                 documented: Set[str]) -> List[Tuple[str, List[str]]]:
+    out = []
+    for key in sorted(found):
+        if not any(_segments_match(key, d) for d in documented):
+            out.append((key, found[key]))
+    return out
+
+
+def main(argv: List[str] | None = None) -> int:
+    args = sys.argv[1:] if argv is None else argv
+    root = args[0] if args else ""
+    found = scan_source_keys(root)
+    documented = doc_keys()
+    missing = missing_keys(found, documented)
+    for key, sites in missing:
+        print(f"UNDOCUMENTED metric key {key!r} "
+              f"(exported at {', '.join(sites[:3])}) — add it to the "
+              "metric catalog in docs/OBSERVABILITY.md")
+    print(f"{len(missing)} undocumented of {len(found)} exported "
+          f"metric key(s); {len(documented)} catalog token(s)")
+    return 1 if missing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
