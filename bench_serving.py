@@ -184,10 +184,13 @@ print(f"CLIENT {count} {elapsed:.4f}")
 
 def _latency_keys(trace_snapshot: dict, suffix: str) -> dict:
     """Steady-state per-RPC latency quantiles from the server's span
-    histograms (utils/tracing.py), keyed for the BENCH json."""
+    histograms (utils/tracing.py), keyed for the BENCH json. mean_ms
+    rides along because it is CONTINUOUS (total/count) where the
+    quantiles are bucket-quantized (~19% steps) — the overhead A/Bs'
+    <2% budgets are only resolvable against the mean."""
     out = {}
     for m in ("train", "classify"):
-        for q in ("p50_ms", "p99_ms"):
+        for q in ("p50_ms", "p99_ms", "mean_ms"):
             k = f"trace.rpc.{m}.{q}"
             if k in trace_snapshot:
                 out[f"e2e_rpc_{m}_{q}_{suffix}"] = trace_snapshot[k]
@@ -208,7 +211,8 @@ def _default_microbatch() -> int:
 def run(transport: str = "python", workload: str = "numeric",
         conf: dict = CONF, measure: float = MEASURE_SECONDS,
         tag: str = "", microbatch: int = 0, native_ingest: bool = True,
-        forensics: bool = True, model_health=None) -> dict:
+        forensics: bool = True, model_health=None,
+        profile_hz=None) -> dict:
     from jubatus_tpu.server import EngineServer
     from jubatus_tpu.server.args import ServerArgs
 
@@ -235,6 +239,11 @@ def run(transport: str = "python", workload: str = "numeric",
             slo_fast_window=5.0, slo_slow_window=30.0)
     elif model_health is False:
         health_args = dict(telemetry_interval=0.0, timeseries_capacity=0)
+    # profile_hz (ISSUE 8): None keeps the stock server (the always-on
+    # sampler at its default rate); a number pins the sampling rate for
+    # the profiling-overhead A/B (0 = sampler thread fully off)
+    if profile_hz is not None:
+        health_args["profile_hz"] = float(profile_hz)
     try:
         srv = EngineServer(
             "classifier", conf,
@@ -506,6 +515,61 @@ def run_observability_overhead(transport: str = "python",
     return out
 
 
+def run_profiling_overhead(transport: str = "python",
+                           measure: float = TEXT_MEASURE_SECONDS,
+                           pairs: int = 3) -> dict:
+    """ISSUE 8 satellite: the always-on stack sampler ships with its
+    cost measured. Adjacent A/B PAIRS on the classify plane — sampler
+    ON at the default ~67 Hz vs fully OFF (no thread) — with
+    median-of-pairs ratios: the histogram quantiles move in ~19%
+    bucket steps, so a single pair's p50 ratio reads either 1.0 or a
+    full bucket (dry runs: 1.0, 1.0, 1.1892 from identical code). The
+    <2% budget (``e2e_profiling_overhead_ok``) therefore gates on the
+    CONTINUOUS mean-latency ratio, with the median p50 ratio required
+    to stay within one bucket step."""
+    out: dict = {}
+    r_p50, r_p99, r_mean = [], [], []
+    for i in range(max(1, pairs)):
+        sides = {}
+        for tag, hz in (("prof_on", 67.0), ("prof_off", 0.0)):
+            try:
+                r = run(transport, workload="classify", measure=measure,
+                        tag=tag, profile_hz=hz)
+            except Exception as e:  # noqa: BLE001 — partial beats none
+                out[f"e2e_{tag}_error"] = repr(e)[:200]
+                continue
+            if i == 0:
+                out.update(r)  # per-side keys of record: first pair
+            sides[tag] = r
+        for key, acc in (("p50_ms", r_p50), ("p99_ms", r_p99),
+                         ("mean_ms", r_mean)):
+            on = sides.get("prof_on", {}).get(
+                f"e2e_rpc_classify_{key}_prof_on")
+            off = sides.get("prof_off", {}).get(
+                f"e2e_rpc_classify_{key}_prof_off")
+            if on and off:
+                acc.append(on / off)
+    import numpy as _np
+
+    if r_p50:
+        med_p50 = float(_np.median(r_p50))
+        out["e2e_profiling_overhead_p50_ratio"] = round(med_p50, 4)
+        if r_mean:
+            med_mean = float(_np.median(r_mean))
+            out["e2e_profiling_overhead_mean_ratio"] = round(med_mean, 4)
+            # mean resolves the 2%; p50 can only prove "same bucket"
+            out["e2e_profiling_overhead_ok"] = bool(
+                med_mean <= 1.02 and med_p50 <= 1.19)
+        out["e2e_profiling_overhead_note"] = (
+            f"median of {len(r_p50)} adjacent on/off pairs; p50/p99 are "
+            "bucket-quantized (~19% steps), the mean ratio carries the "
+            "<2% verdict")
+    if r_p99:
+        out["e2e_profiling_overhead_p99_ratio"] = round(
+            float(_np.median(r_p99)), 4)
+    return out
+
+
 def run_proxy(transport: str = "python",
               measure: float = MEASURE_SECONDS) -> dict:
     """Proxy-tier path (VERDICT r2 item 8): clients -> Proxy (random
@@ -718,6 +782,12 @@ def collect(trials: int = 2) -> dict:
         out.update(run_observability_overhead(text_tr))
     except Exception as e:  # noqa: BLE001
         out["e2e_observability_overhead_error"] = repr(e)[:200]
+    # continuous-profiling overhead A/B (ISSUE 8): the ~67 Hz stack
+    # sampler on vs fully off, same <2% p50 budget
+    try:
+        out.update(run_profiling_overhead(text_tr))
+    except Exception as e:  # noqa: BLE001
+        out["e2e_profiling_overhead_error"] = repr(e)[:200]
     # proxy tier: same numeric workload through the proxy hop. The
     # REPORTED keys stay best-of, but the ratio uses median-vs-median
     # over ADJACENT alternating (proxy, direct) pairs: the direct side

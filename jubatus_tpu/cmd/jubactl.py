@@ -8,6 +8,7 @@
     jubactl -c metrics -t classifier -n c1 -z /shared
     jubactl -c breakers -t classifier -n c1 -z /shared
     jubactl -c trace TRACE_ID -t classifier -n c1 -z /shared
+    jubactl -c profile -t classifier -n c1 -z /shared [--folded] [--device]
 
 start/stop fan out to every jubavisor under /jubatus/supervisors,
 distributing N processes round-robin (N/visors each, remainder to the
@@ -24,6 +25,12 @@ scrapes every member's span store (``get_spans``) AND every registered
 proxy's (``get_proxy_spans``), stitches the parent/child edges into ONE
 cross-node span tree, and renders it with per-hop timings — the
 distributed answer to "where did this slow request spend its time?".
+``profile`` (ISSUE 8) scrapes every member's folded stack samples
+(``get_profile``) and every proxy's own (``get_proxy_profile``), folds
+them into ONE cluster profile, and renders a top-N self/cumulative
+table — or ``--folded`` collapsed-stack lines for flamegraph.pl /
+speedscope; ``--device`` lists or triggers on-demand XLA captures
+(``profile_device``) instead.
 Server flags (-C/-T/-D/-X/-S/-I/...) are forwarded to visor-spawned
 processes (jubactl.cpp:90-110).
 """
@@ -45,7 +52,7 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("-c", "--cmd", required=True,
                    choices=["start", "stop", "save", "load", "status",
                             "metrics", "breakers", "trace", "alerts",
-                            "watch"])
+                            "watch", "profile"])
     p.add_argument("trace_id", nargs="?", default="",
                    help="[trace] trace id to assemble (from a slow-log "
                         "record, a /metrics exemplar, or "
@@ -59,6 +66,22 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--window", type=float, default=60.0,
                    help="[watch] rate/quantile window in seconds "
                         "(computed from each node's get_timeseries ring)")
+    p.add_argument("--seconds", type=float, default=60.0,
+                   help="[profile] sampling window to fold (seconds; "
+                        "0 = every retained bucket)")
+    p.add_argument("--folded", action="store_true",
+                   help="[profile] emit collapsed-stack 'stack count' "
+                        "lines (flamegraph.pl / speedscope input) "
+                        "instead of the top-N table")
+    p.add_argument("--top", type=int, default=30,
+                   help="[profile] rows in the self/cumulative table")
+    p.add_argument("--device", action="store_true",
+                   help="[profile] on-demand XLA device capture instead "
+                        "of stack sampling: list existing artifacts, or "
+                        "capture for --device-seconds on every backend")
+    p.add_argument("--device-seconds", type=float, default=0.0,
+                   help="[profile --device] capture duration in seconds "
+                        "(0 = just list existing artifacts)")
     p.add_argument("-s", "--server", default="",
                    help="server name forwarded to jubavisor "
                         "(jubaclassifier or plain engine name)")
@@ -470,6 +493,110 @@ def _proxies(coord: Coordinator) -> List[NodeInfo]:
     return out
 
 
+def collect_profiles(coord: Coordinator, engine: str, name: str,
+                     seconds: float = 60.0) -> Dict[str, Dict[str, Any]]:
+    """Scrape every member's folded stack profile (``get_profile``) and
+    every registered proxy's own (``get_proxy_profile``) — one doc per
+    node name. Per-node failures degrade (partial profile beats none,
+    same stance as the trace/alert collectors)."""
+    docs: Dict[str, Dict[str, Any]] = {}
+    for node, method in (
+            [(n, "get_profile")
+             for n in membership.get_all_nodes(coord, engine, name)]
+            + [(pxy, "get_proxy_profile") for pxy in _proxies(coord)]):
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                per_node = c.call(method, name, float(seconds))
+        except Exception as e:  # noqa: BLE001 — partial profile beats none
+            print(f"  <{node.name}: {method} failed: {e}>", file=sys.stderr)
+            continue
+        for node_name, doc in (per_node or {}).items():
+            if isinstance(doc, dict):
+                docs[str(node_name)] = doc
+    return docs
+
+
+def show_profile(coord: Coordinator, engine: str, name: str, *,
+                 seconds: float = 60.0, folded: bool = False,
+                 top: int = 30, device: bool = False,
+                 device_seconds: float = 0.0) -> int:
+    """ISSUE 8: the cluster profile view. Default mode folds every
+    member's (and proxy's) collapsed stacks over the last ``seconds``
+    and prints a top-N self/cumulative table; ``--folded`` emits raw
+    ``stack count`` lines on stdout (header on stderr) so the output
+    pipes straight into flamegraph.pl or speedscope. ``--device``
+    switches to the on-demand XLA capture plane: list artifacts, or
+    capture ``--device-seconds`` on every backend."""
+    from jubatus_tpu.utils import profiler as prof
+
+    if device:
+        nodes = membership.get_all_nodes(coord, engine, name)
+        if not nodes:
+            print(f"no server of {engine}/{name}", file=sys.stderr)
+            return -1
+        rc = 0
+        for node in nodes:
+            try:
+                # capture blocks for its duration: size the timeout to it
+                with RpcClient(node.host, node.port,
+                               timeout=max(10.0, device_seconds + 10.0)) as c:
+                    per_node = c.call("profile_device", name,
+                                      float(device_seconds))
+            except Exception as e:  # noqa: BLE001 — report per-host
+                print(f"  <{node.name}: profile_device failed: {e}>",
+                      file=sys.stderr)
+                rc = -1
+                continue
+            for node_name, doc in sorted((per_node or {}).items()):
+                if "error" in doc:
+                    print(f"{node_name}: capture error: {doc['error']}")
+                    rc = -1
+                elif "artifact" in doc:
+                    print(f"{node_name}: captured {doc.get('seconds')}s "
+                          f"-> {doc['artifact']} ({doc.get('bytes', 0)} "
+                          "bytes)")
+                else:
+                    arts = doc.get("artifacts") or []
+                    print(f"{node_name}: {len(arts)} capture(s) in "
+                          f"{doc.get('dir', '?')}")
+                    for a in arts:
+                        print(f"  {a.get('name')}  {a.get('bytes', 0)} bytes")
+        return rc
+    docs = collect_profiles(coord, engine, name, seconds)
+    if not docs:
+        print(f"no member of {engine}/{name} answered get_profile",
+              file=sys.stderr)
+        return -1
+    merged = prof.fold_profiles(docs.values())
+    per_node = ", ".join(
+        f"{n} ({sum((d.get('folded') or {}).values())} samples)"
+        for n, d in sorted(docs.items()))
+    header = (f"{engine}/{name}: profile window {seconds:g}s, folded "
+              f"from {len(docs)} node(s): {per_node}")
+    if not merged:
+        print(header, file=sys.stderr)
+        print("no samples retained (is --profile-hz 0 everywhere?)",
+              file=sys.stderr)
+        return -1
+    if folded:
+        # stdout stays pure collapsed-stack lines for flamegraph.pl
+        print(header, file=sys.stderr)
+        for line in prof.folded_lines(merged):
+            print(line)
+        return 0
+    print(header)
+    print(prof.render_top(merged, top=top))
+    snaps = [(n, s) for n, d in sorted(docs.items())
+             for s in d.get("snapshots") or []]
+    if snaps:
+        print(f"  tail-triggered snapshots ({len(snaps)}):")
+        for n, s in snaps[-8:]:
+            ids = ",".join(s.get("trace_ids") or []) or "-"
+            print(f"    {n}  span={s.get('span')}  "
+                  f"samples={s.get('samples')}  traces={ids}")
+    return 0
+
+
 def collect_trace_spans(coord: Coordinator, engine: str, name: str,
                         trace_id: str) -> List[Dict[str, Any]]:
     """Scrape every member's span store (``get_spans``) and every
@@ -587,6 +714,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         if ns.cmd == "watch":
             return show_watch(coord, ns.type, ns.name, once=ns.once,
                               interval=ns.interval, window_s=ns.window)
+        if ns.cmd == "profile":
+            return show_profile(coord, ns.type, ns.name,
+                                seconds=ns.seconds, folded=ns.folded,
+                                top=ns.top, device=ns.device,
+                                device_seconds=ns.device_seconds)
         if ns.cmd in ("start", "stop"):
             server = ns.server or ns.type
             name = f"{server}/{ns.name}"

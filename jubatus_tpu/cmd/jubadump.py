@@ -183,6 +183,17 @@ def dump_slow_log(target: str, name: str = "",
                       timeout=timeout)
 
 
+def dump_profile(target: str, name: str = "", seconds: float = 0.0,
+                 timeout: float = 10.0) -> dict:
+    """Pull a live server's (or proxy's) folded stack profile — the
+    always-on sampler of utils/profiler.py (collapsed stacks + sampler
+    stats + tail-triggered snapshots), keyed by node name. Against a
+    proxy the reply also folds in every backend's samples. ``seconds``
+    bounds the window (0 = every retained bucket)."""
+    return _live_call(target, "get_profile", "--profile", name,
+                      float(seconds), timeout=timeout)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="jubadump",
@@ -201,19 +212,31 @@ def main(argv=None) -> int:
                         "proxy (get_slow_log RPC): tail-based capture of "
                         "requests at/above the --slowlog-quantile of "
                         "their own latency histogram")
+    p.add_argument("--profile", metavar="HOST:PORT", dest="profile",
+                   help="dump the folded stack profile of a LIVE server "
+                        "or proxy (get_profile RPC): collapsed stacks "
+                        "from the always-on sampler, sampler stats, and "
+                        "tail-triggered snapshots")
+    p.add_argument("--seconds", type=float, default=0.0,
+                   help="[--profile] window to fold (seconds; 0 = every "
+                        "retained bucket)")
     p.add_argument("-n", "--name", default="",
-                   help="[--mix-history/--slow-log] cluster name to pass "
-                        "the RPC")
+                   help="[--mix-history/--slow-log/--profile] cluster "
+                        "name to pass the RPC")
     ns = p.parse_args(argv)
-    if sum(map(bool, (ns.input, ns.mix_history, ns.slow_log))) != 1:
-        print("exactly one of -i FILE, --mix-history HOST:PORT, or "
-              "--slow-log HOST:PORT required", file=sys.stderr)
+    if sum(map(bool, (ns.input, ns.mix_history, ns.slow_log,
+                      ns.profile))) != 1:
+        print("exactly one of -i FILE, --mix-history HOST:PORT, "
+              "--slow-log HOST:PORT, or --profile HOST:PORT required",
+              file=sys.stderr)
         return 1
     try:
         if ns.mix_history:
             out: Any = dump_mix_history(ns.mix_history, ns.name)
         elif ns.slow_log:
             out = dump_slow_log(ns.slow_log, ns.name)
+        elif ns.profile:
+            out = dump_profile(ns.profile, ns.name, ns.seconds)
         else:
             out = dump_file(ns.input, summary=ns.summary,
                             skip_user_data=ns.no_user_data)

@@ -213,6 +213,10 @@ IDEMPOTENT_BUILTINS: FrozenSet[str] = frozenset({
     "get_config", "get_status", "get_metrics", "get_mix_history",
     "get_spans", "get_slow_log",
     "get_timeseries", "get_alerts",
+    # continuous profiling plane (ISSUE 8): profile reads are pure;
+    # profile_device only re-captures into the same capped artifacts
+    # dir on a retry — safe to re-issue after a transport failure
+    "get_profile", "profile_device", "get_proxy_profile",
     "get_proxy_status", "get_proxy_metrics", "get_proxy_spans",
     "get_proxy_slow_log", "get_proxy_timeseries", "get_proxy_alerts",
     "get_breakers",

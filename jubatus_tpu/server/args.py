@@ -119,6 +119,24 @@ class ServerArgs:
     #: 1 h of history at the 10 s interval). 0 disables the ring (and
     #: with it SLO evaluation and get_timeseries).
     timeseries_capacity: int = 360
+    #: --profile-hz: always-on stack sampling rate (utils/profiler.py) —
+    #: a daemon thread samples every thread's stack at this rate into a
+    #: bounded windowed store served by ``get_profile`` / ``jubactl -c
+    #: profile`` / ``jubadump --profile``. 0 disables the sampler (no
+    #: thread); the default ~67 Hz stays inside the <2% overhead budget
+    #: (bench_serving run_profiling_overhead).
+    profile_hz: float = 67.0
+    #: --profile-dir: artifacts directory for on-demand device captures
+    #: (``profile_device`` RPC wrapping jax.profiler.trace); empty =
+    #: <datadir>/jubatus_profile_<engine>_<port>. Capped — old captures
+    #: are pruned.
+    profile_dir: str = ""
+    #: --profile-trigger-breaches: this many slow-log captures of the
+    #: SAME span inside --profile-trigger-window auto-capture a short
+    #: sampling-profile snapshot stamped with the breaching trace_ids
+    #: (once per window; 0 disables the tail trigger)
+    profile_trigger_breaches: int = 3
+    profile_trigger_window: float = 10.0
 
     @property
     def is_standalone(self) -> bool:
@@ -280,6 +298,27 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "(one per telemetry tick; default = 1 h at the "
                         "10 s interval). 0 disables the ring, SLO "
                         "evaluation, and get_timeseries")
+    p.add_argument("--profile-hz", type=float, default=67.0,
+                   help="always-on stack sampling rate (Hz): a daemon "
+                        "thread folds every thread's stack into a "
+                        "bounded windowed store served by get_profile, "
+                        "jubactl -c profile, and jubadump --profile; "
+                        "0 disables the sampler thread entirely")
+    p.add_argument("--profile-dir", default="",
+                   help="artifacts directory for on-demand device "
+                        "captures (profile_device RPC wrapping "
+                        "jax.profiler.trace; jubactl -c profile "
+                        "--device); empty = under --datadir. Old "
+                        "captures are pruned past a fixed cap")
+    p.add_argument("--profile-trigger-breaches", type=int, default=3,
+                   help="slow-log captures of the SAME span inside "
+                        "--profile-trigger-window that auto-capture a "
+                        "short sampling-profile snapshot stamped with "
+                        "the breaching trace_ids (once per window; "
+                        "0 disables the tail trigger)")
+    p.add_argument("--profile-trigger-window", type=float, default=10.0,
+                   help="breach-counting window (seconds) for the "
+                        "tail-triggered profile snapshot")
     return p
 
 
@@ -313,6 +352,12 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
         raise SystemExit("--slo-*-window must be > 0")
     if args.slo_burn_threshold <= 0:
         raise SystemExit("--slo-burn-threshold must be > 0")
+    if args.profile_hz < 0 or args.profile_hz > 1000:
+        raise SystemExit("--profile-hz must be in [0, 1000]")
+    if args.profile_trigger_breaches < 0:
+        raise SystemExit("--profile-trigger-breaches must be >= 0")
+    if args.profile_trigger_window <= 0:
+        raise SystemExit("--profile-trigger-window must be > 0")
     for spec in args.slo:
         from jubatus_tpu.utils.slo import parse_slo
 

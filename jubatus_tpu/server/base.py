@@ -87,6 +87,22 @@ class EngineServer:
         self.telemetry = RuntimeTelemetry(
             self.rpc.trace,
             interval_sec=getattr(self.args, "telemetry_interval", 10.0))
+        # continuous profiling plane (ISSUE 8): always-on stack sampler
+        # + capped device-capture dir + the slowlog tail trigger that
+        # snapshots the sampler when one span breaches repeatedly
+        from jubatus_tpu.utils.profiler import SamplingProfiler
+
+        self.profiler = SamplingProfiler(
+            self.rpc.trace, hz=getattr(self.args, "profile_hz", 67.0))
+        #: created lazily (_device_capture()): the default artifacts dir
+        #: carries the BOUND rpc port, which an ephemeral-port start
+        #: only resolves at serve time
+        self.device_capture = None
+        trig = getattr(self.args, "profile_trigger_breaches", 3)
+        if trig > 0 and self.profiler.enabled:
+            self.rpc.trace.slowlog.set_trigger(
+                self.profiler.tail_snapshot, breaches=trig,
+                window_s=getattr(self.args, "profile_trigger_window", 10.0))
         # model-health plane (ISSUE 7): the metric time-series ring +
         # the SLO burn-rate engine, both ticked by the telemetry
         # sampler (one thread owns all periodic observability work)
@@ -309,6 +325,47 @@ class EngineServer:
         node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
         return {node.name: self.rpc.trace.slowlog.snapshot()}
 
+    # -- continuous profiling plane (ISSUE 8) --------------------------------
+    def get_profile(self, _name: str = "", seconds: float = 0.0
+                    ) -> Dict[str, Any]:
+        """This node's folded stack profile over the last ``seconds``
+        (0 = every retained bucket), keyed like get_status: collapsed
+        stacks + sampler stats + the tail-triggered snapshot ring. The
+        proxy broadcasts this and folds its own samples in (``jubactl
+        -c profile``)."""
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        return {node.name: self.profiler.profile(float(seconds or 0.0))}
+
+    def _device_capture(self):
+        """The capped device-capture dir, created on first use so the
+        default path carries the ACTUAL bound rpc port (multiple
+        ephemeral-port servers on one host must not share a dir)."""
+        if self.device_capture is None:
+            from jubatus_tpu.utils.profiler import DeviceCapture
+
+            prof_dir = getattr(self.args, "profile_dir", "") or os.path.join(
+                self.args.datadir,
+                f"jubatus_profile_{self.engine}_"
+                f"{self.rpc.port or self.args.rpc_port}")
+            self.device_capture = DeviceCapture(prof_dir)
+        return self.device_capture
+
+    def profile_device(self, _name: str = "", seconds: float = 0.0
+                       ) -> Dict[str, Any]:
+        """On-demand device capture: ``seconds > 0`` runs one bounded
+        ``jax.profiler.trace()`` into the capped ``--profile-dir``
+        (blocking this RPC worker for the duration); ``seconds == 0``
+        lists existing artifacts. Failures return a structured
+        ``error`` — a CPU-only box degrades, it doesn't 500."""
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        s = float(seconds or 0.0)
+        if s <= 0:
+            return {node.name: self._device_capture().list()}
+        doc = self._device_capture().capture(s)
+        if "artifact" in doc:
+            self.rpc.trace.count("profiler.device_captures")
+        return {node.name: doc}
+
     # -- model-health plane (ISSUE 7) ----------------------------------------
     def _model_health_tick(self) -> None:
         """One telemetry tick: snapshot the registry into the
@@ -390,6 +447,12 @@ class EngineServer:
             doc["slo_firing"] = len(self.slo.alerts())
         if self.mixer is not None:
             doc["mix_count"] = getattr(self.mixer, "mix_count", 0)
+        # profiler state (ISSUE 8): one glance says whether the sampler
+        # is on and collecting (full stats live in get_status)
+        pstats = self.profiler.stats()
+        doc["profiler_hz"] = pstats["hz"]
+        doc["profiler_samples"] = pstats["samples"]
+        doc["profiler_snapshots"] = pstats["snapshots_taken"]
         # runtime telemetry summary (full key set lives in get_status)
         rt = self.telemetry.status()
         for k in ("rss_bytes", "open_fds", "threads",
@@ -445,6 +508,10 @@ class EngineServer:
                    for k, v in self.telemetry.status().items()})
         st.update({f"slowlog.{k}": v
                    for k, v in self.rpc.trace.slowlog.stats().items()})
+        # continuous profiling plane (ISSUE 8): sampler health — is it
+        # on, how many samples/stacks, how often the tail trigger fired
+        st.update({f"profiler.{k}": v
+                   for k, v in self.profiler.stats().items()})
         # model-health plane (ISSUE 7): health verdict + time-series
         # ring depth + SLO burn states, so `jubactl -c status --all`
         # and the watch view read one map
@@ -482,6 +549,7 @@ class EngineServer:
         )
         self.args.rpc_port = actual
         self.telemetry.start()
+        self.profiler.start()
         if getattr(self.args, "metrics_port", -1) >= 0:
             from jubatus_tpu.utils.metrics_http import MetricsServer
 
@@ -580,6 +648,7 @@ class EngineServer:
                 self.rpc.stop,
                 (self.metrics.stop if self.metrics is not None else None),
                 self.telemetry.stop,
+                self.profiler.stop,
                 self._close_peers,
             ):
                 if step is None:

@@ -116,6 +116,14 @@ class ProxyArgs:
     slo_burn_threshold: float = 2.0
     #: metric time-series ring depth (0 disables ring + SLO evaluation)
     timeseries_capacity: int = 360
+    #: --profile-hz: always-on stack sampler at the PROXY hop
+    #: (utils/profiler.py) — the proxy's own routing/fan-out stacks fold
+    #: into the cluster profile next to the backends'; 0 = off
+    profile_hz: float = 67.0
+    #: --profile-trigger-*: slow-log breach trigger for the proxy's own
+    #: spans (same semantics as the engine servers)
+    profile_trigger_breaches: int = 3
+    profile_trigger_window: float = 10.0
 
     @property
     def bind_host(self) -> str:
@@ -268,6 +276,18 @@ class Proxy:
         self.telemetry = RuntimeTelemetry(
             self.rpc.trace,
             interval_sec=getattr(args, "telemetry_interval", 10.0))
+        # continuous profiling plane (ISSUE 8) at the proxy hop: the
+        # same always-on sampler + slowlog tail trigger as the servers
+        # (no device capture — proxies have no accelerator work)
+        from jubatus_tpu.utils.profiler import SamplingProfiler
+
+        self.profiler = SamplingProfiler(
+            self.rpc.trace, hz=getattr(args, "profile_hz", 67.0))
+        trig = getattr(args, "profile_trigger_breaches", 3)
+        if trig > 0 and self.profiler.enabled:
+            self.rpc.trace.slowlog.set_trigger(
+                self.profiler.tail_snapshot, breaches=trig,
+                window_s=getattr(args, "profile_trigger_window", 10.0))
         # model-health plane (ISSUE 7) at the proxy hop: time-series
         # ring + SLO burn-rate engine, ticked by the telemetry sampler
         from jubatus_tpu.utils.slo import SloEngine, parse_slo
@@ -748,6 +768,15 @@ class Proxy:
                           self._forensics_handler(
                               "get_alerts", self.get_proxy_alerts),
                           arity=1)
+        # continuous profiling plane (ISSUE 8): one get_profile against
+        # the proxy returns the whole cluster's folded stacks (backends
+        # broadcast + the proxy's own samples); device captures
+        # broadcast so `jubactl -c profile --device` hits every backend
+        self.rpc.register("get_profile",
+                          self._forensics_handler(
+                              "get_profile", self.get_proxy_profile),
+                          arity=2)
+        self._register("profile_device", 2, "broadcast", aggregators.merge)
         self._register("do_mix", 1, "random", aggregators.pass_)
         self.rpc.register("get_proxy_status", self.get_proxy_status, arity=1)
         self.rpc.register("get_proxy_metrics", self.get_metrics, arity=1)
@@ -758,6 +787,8 @@ class Proxy:
                           arity=1)
         self.rpc.register("get_proxy_alerts", self.get_proxy_alerts,
                           arity=1)
+        self.rpc.register("get_proxy_profile", self.get_proxy_profile,
+                          arity=2)
         self.rpc.register("get_breakers", self.get_breakers, arity=1)
 
     def _forensics_handler(self, name: str,
@@ -824,6 +855,13 @@ class Proxy:
         return {node.name: {"alerts": self.slo.alerts(),
                             "slos": self.slo.status()}}
 
+    def get_proxy_profile(self, _name: str = "",
+                          seconds: float = 0.0) -> Dict[str, Any]:
+        """This proxy's OWN folded stack profile (the RPC-routed
+        ``get_profile`` additionally broadcasts to the backends)."""
+        node = NodeInfo(self.args.bind_host, self.rpc.port or self.args.rpc_port)
+        return {node.name: self.profiler.profile(float(seconds or 0.0))}
+
     def get_breakers(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
         """Breaker + retry-budget state, keyed by proxy node name — the
         ``jubactl -c breakers`` view and the ops answer to 'why is this
@@ -878,6 +916,8 @@ class Proxy:
                    for k, v in self.telemetry.status().items()})
         st.update({f"slowlog.{k}": v
                    for k, v in self.rpc.trace.slowlog.stats().items()})
+        st.update({f"profiler.{k}": v
+                   for k, v in self.profiler.stats().items()})
         return {node.name: st}
 
     def get_metrics(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
@@ -911,6 +951,9 @@ class Proxy:
                "rpc_port": self.rpc.port or self.args.rpc_port,
                "forward_count": fwd, "forward_errors": errs,
                "breaker_open": len(open_backends)}
+        pstats = self.profiler.stats()
+        doc["profiler_hz"] = pstats["hz"]
+        doc["profiler_samples"] = pstats["samples"]
         rt = self.telemetry.status()
         for k in ("rss_bytes", "open_fds", "threads", "slowlog_depth"):
             if k in rt:
@@ -926,6 +969,7 @@ class Proxy:
         )
         self.args.rpc_port = actual
         self.telemetry.start()
+        self.profiler.start()
         if getattr(self.args, "metrics_port", -1) >= 0:
             from jubatus_tpu.utils.metrics_http import MetricsServer
 
@@ -951,6 +995,7 @@ class Proxy:
     def stop(self) -> None:
         self.rpc.stop()
         self.telemetry.stop()
+        self.profiler.stop()
         if self.metrics is not None:
             try:
                 self.metrics.stop()
@@ -1030,6 +1075,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--timeseries-capacity", type=int, default=360,
                    help="metric time-series ring depth (points; 0 "
                         "disables the ring and SLO evaluation)")
+    p.add_argument("--profile-hz", type=float, default=67.0,
+                   help="always-on stack sampling rate at the proxy hop "
+                        "(Hz); the proxy's samples fold into jubactl -c "
+                        "profile next to the backends'; 0 disables")
+    p.add_argument("--profile-trigger-breaches", type=int, default=3,
+                   help="slow-log captures of the SAME span inside "
+                        "--profile-trigger-window that auto-capture a "
+                        "profile snapshot (once per window; 0 disables)")
+    p.add_argument("--profile-trigger-window", type=float, default=10.0,
+                   help="breach-counting window (seconds) for the "
+                        "tail-triggered profile snapshot")
     ns = p.parse_args(argv)
     ns.slo = ns.slo or []
     args = ProxyArgs(**{f.name: getattr(ns, f.name)

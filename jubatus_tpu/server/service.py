@@ -70,6 +70,10 @@ def bind_engine(rpc: RpcServer, server: Any) -> None:
     # model-health plane (ISSUE 7): metric time-series + SLO alerts
     rpc.register("get_timeseries", server.get_timeseries, arity=1)
     rpc.register("get_alerts", server.get_alerts, arity=1)
+    # continuous profiling plane (ISSUE 8): folded stack profile +
+    # on-demand XLA device capture
+    rpc.register("get_profile", server.get_profile, arity=2)
+    rpc.register("profile_device", server.profile_device, arity=2)
     rpc.register("do_mix", server.do_mix, arity=1)
     _BINDERS[server.engine](rpc, server)
 

@@ -25,9 +25,13 @@ landed in, so a scrape dashboard links a p99 spike straight to a trace.
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
 
 #: ring size; 0 disables capture entirely (record() never consults us)
 DEFAULT_CAPACITY = 256
@@ -35,6 +39,13 @@ DEFAULT_CAPACITY = 256
 DEFAULT_QUANTILE = 0.99
 #: no thresholding until a span has this many samples
 DEFAULT_MIN_COUNT = 64
+#: tail-trigger defaults (ISSUE 8): K breaches of the SAME span inside
+#: the window fire the breach callback (a profiler snapshot) ONCE
+DEFAULT_TRIGGER_BREACHES = 3
+DEFAULT_TRIGGER_WINDOW_S = 10.0
+#: trace ids carried per breach window (enough to pivot into jubactl
+#: -c trace; unbounded capture would let a storm grow the window rec)
+_TRIGGER_MAX_IDS = 8
 
 
 class SlowLog:
@@ -49,6 +60,15 @@ class SlowLog:
         self.min_count = int(min_count)
         self._ring: deque = deque(maxlen=max(self.capacity, 1))
         self._captured = 0
+        #: tail-triggered profiling (ISSUE 8): ``on_breach(span,
+        #: trace_ids)`` fires when ``trigger_breaches`` captures of the
+        #: SAME span land inside ``trigger_window_s`` — exactly once per
+        #: window (the flag clears when the window expires)
+        self.on_breach: Optional[Callable[[str, List[str]], Any]] = None
+        self.trigger_breaches = 0          # 0 = trigger disabled
+        self.trigger_window_s = DEFAULT_TRIGGER_WINDOW_S
+        self._windows: Dict[str, Dict[str, Any]] = {}
+        self._trigger_fired = 0
 
     def configure(self, capacity: Optional[int] = None,
                   quantile: Optional[float] = None,
@@ -68,10 +88,58 @@ class SlowLog:
             if min_count is not None:
                 self.min_count = max(1, int(min_count))
 
+    def set_trigger(self, fn: Optional[Callable[[str, List[str]], Any]],
+                    breaches: int = DEFAULT_TRIGGER_BREACHES,
+                    window_s: float = DEFAULT_TRIGGER_WINDOW_S) -> None:
+        """Arm (or disarm with fn=None / breaches=0) the tail trigger:
+        K same-span captures inside the window call ``fn(span,
+        trace_ids)`` once. The callback runs on the capturing request's
+        thread OUTSIDE the ring lock and must be cheap (the profiler's
+        snapshot fold is)."""
+        with self._lock:
+            self.on_breach = fn
+            self.trigger_breaches = max(0, int(breaches))
+            self.trigger_window_s = float(window_s)
+            self._windows.clear()
+
     def add(self, rec: Dict[str, Any]) -> None:
         with self._lock:
             self._captured += 1
             self._ring.append(rec)
+        self._note_breach(str(rec.get("method", "")),
+                          str(rec.get("trace_id", "")))
+
+    def _note_breach(self, span: str, trace_id: str,
+                     now: Optional[float] = None) -> bool:
+        """Advance one span's breach window; fires the trigger exactly
+        once per window when it reaches ``trigger_breaches`` captures.
+        ``now`` is injectable for tests (monotonic domain). Returns
+        True when the callback fired."""
+        fire: Optional[Callable[[str, List[str]], Any]] = None
+        ids: List[str] = []
+        with self._lock:
+            if self.trigger_breaches <= 0 or self.on_breach is None \
+                    or not span:
+                return False
+            t = time.monotonic() if now is None else float(now)
+            w = self._windows.get(span)
+            if w is None or t - w["start"] > self.trigger_window_s:
+                w = self._windows[span] = {"start": t, "count": 0,
+                                           "ids": [], "fired": False}
+            w["count"] += 1
+            if trace_id and len(w["ids"]) < _TRIGGER_MAX_IDS:
+                w["ids"].append(trace_id)
+            if not w["fired"] and w["count"] >= self.trigger_breaches:
+                w["fired"] = True
+                self._trigger_fired += 1
+                fire, ids = self.on_breach, list(w["ids"])
+        if fire is None:
+            return False
+        try:
+            fire(span, ids)
+        except Exception:  # noqa: BLE001 — a trigger must never break capture
+            log.debug("slowlog breach trigger failed", exc_info=True)
+        return True
 
     def snapshot(self, last: int = 0) -> List[Dict[str, Any]]:
         """Oldest-first copy (the newest ``last`` when > 0)."""
@@ -85,9 +153,14 @@ class SlowLog:
                     "retained": len(self._ring),
                     "capacity": self.capacity,
                     "quantile": self.quantile,
-                    "min_count": self.min_count}
+                    "min_count": self.min_count,
+                    "trigger_breaches": self.trigger_breaches,
+                    "trigger_window_s": self.trigger_window_s,
+                    "trigger_fired": self._trigger_fired}
 
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
             self._captured = 0
+            self._windows.clear()
+            self._trigger_fired = 0

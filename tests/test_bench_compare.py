@@ -1,0 +1,155 @@
+"""tools/bench_compare.py tests (ISSUE 8): the perf trajectory's
+mechanical regression gate — direction inference, tolerance (global +
+per-key), boolean gates, bench-shape flattening, latest-two glob
+selection, and exit codes over canned fixtures."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_compare",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tools", "bench_compare.py"))
+bc = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bc)
+
+
+OLD = {
+    "e2e_rpc_train_samples_per_sec_native": 100000.0,
+    "e2e_rpc_classify_p99_ms_native": 10.0,
+    "e2e_tracing_overhead_p50_ratio": 1.01,
+    "e2e_profiling_overhead_ok": True,
+    "collective_wire_mb_per_round": 480.0,
+    "e2e_fv_overlap_fraction": 0.8,
+    "bench_platform_note": "cpu",   # non-numeric: ignored by flatten
+    "e2e_clients": 16,              # no direction: info only
+}
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_direction_inference():
+    assert bc.direction("e2e_rpc_train_samples_per_sec_native") == "higher"
+    assert bc.direction("e2e_fv_overlap_fraction") == "higher"
+    assert bc.direction("collective_round_int8_vs_bf16_speedup") == "higher"
+    assert bc.direction("e2e_rpc_classify_p99_ms_native") == "lower"
+    assert bc.direction("e2e_tracing_overhead_p50_ratio") == "lower"
+    assert bc.direction("collective_wire_mb_per_round") == "lower"
+    assert bc.direction("collective_round_drift_vs_f32") == "lower"
+    assert bc.direction("e2e_profiling_overhead_ok") == "bool"
+    assert bc.direction("mix_under_1s_target") == "bool"
+    assert bc.direction("e2e_clients") is None
+
+
+def test_flatten_collapses_round_envelopes():
+    envelope = {"n": 5, "rc": 0, "tail": "…",
+                "parsed": {"metric": "x", "value": 2.0,
+                           "extra": {"e2e_a_samples_per_sec": 10.0,
+                                     "nested": {"k_ms": 1.0}}}}
+    flat = bc.flatten(envelope)
+    # parsed/extra collapse WITHOUT a prefix; other dicts keep one
+    assert flat["e2e_a_samples_per_sec"] == 10.0
+    assert flat["value"] == 2.0
+    assert flat["nested.k_ms"] == 1.0
+    assert "tail" not in flat
+    # flat maps (bench_serving / profile_flush output) pass through
+    assert bc.flatten({"a_ms": 1.5})["a_ms"] == 1.5
+
+
+def test_regressions_flagged_beyond_tolerance():
+    new = dict(OLD)
+    new["e2e_rpc_train_samples_per_sec_native"] = 80000.0   # -20%: bad
+    new["e2e_rpc_classify_p99_ms_native"] = 13.0            # +30%: bad
+    new["e2e_profiling_overhead_ok"] = False                # flip: bad
+    new["collective_wire_mb_per_round"] = 120.0             # -75%: good
+    rows, regs = bc.compare(OLD, new, tolerance=0.05)
+    bad = {r["key"] for r in regs}
+    assert bad == {"e2e_rpc_train_samples_per_sec_native",
+                   "e2e_rpc_classify_p99_ms_native",
+                   "e2e_profiling_overhead_ok"}
+    verdicts = {r["key"]: r["verdict"] for r in rows}
+    assert verdicts["collective_wire_mb_per_round"] == "improved"
+    assert verdicts["e2e_clients"] == "info"
+
+
+def test_within_tolerance_is_clean():
+    new = dict(OLD)
+    new["e2e_rpc_train_samples_per_sec_native"] = 96500.0   # -3.5% < 5%
+    new["e2e_rpc_classify_p99_ms_native"] = 10.4            # +4%  < 5%
+    _rows, regs = bc.compare(OLD, new, tolerance=0.05)
+    assert regs == []
+
+
+def test_per_key_tolerance_override():
+    new = dict(OLD)
+    new["e2e_rpc_classify_p99_ms_native"] = 14.0            # +40%
+    _r, regs = bc.compare(OLD, new, tolerance=0.05)
+    assert len(regs) == 1
+    _r, regs = bc.compare(
+        OLD, new, tolerance=0.05,
+        key_tolerance={"e2e_rpc_classify_p99_ms_native": 0.5})
+    assert regs == []
+
+
+def test_added_removed_keys_never_gate():
+    new = dict(OLD)
+    del new["collective_wire_mb_per_round"]
+    new["brand_new_ms"] = 5.0
+    rows, regs = bc.compare(OLD, new)
+    assert regs == []
+    verdicts = {r["key"]: r["verdict"] for r in rows}
+    assert verdicts["collective_wire_mb_per_round"] == "removed"
+    assert verdicts["brand_new_ms"] == "added"
+
+
+def test_main_exit_codes_over_fixtures(tmp_path, capsys):
+    old_p = _write(tmp_path, "BENCH_r01.json", OLD)
+    good = dict(OLD)
+    good["e2e_rpc_train_samples_per_sec_native"] = 120000.0
+    good_p = _write(tmp_path, "BENCH_r02.json", good)
+    bad = dict(OLD)
+    bad["e2e_rpc_train_samples_per_sec_native"] = 50000.0
+    bad_p = _write(tmp_path, "BENCH_r03.json", bad)
+
+    assert bc.main([old_p, good_p]) == 0
+    out = capsys.readouterr().out
+    assert "improved" in out and "0 regressed" in out
+    assert bc.main([old_p, bad_p]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out
+    # round envelopes flatten the same way end to end
+    env_old = _write(tmp_path, "env_old.json",
+                     {"parsed": {"extra": OLD}, "rc": 0})
+    assert bc.main([env_old, bad_p]) == 1
+    capsys.readouterr()
+    # usage errors
+    assert bc.main([]) == 2
+    assert bc.main([old_p, "/nonexistent.json"]) == 2
+    assert bc.main([old_p, good_p, "--key-tolerance", "nonsense"]) == 2
+
+
+def test_glob_picks_latest_two(tmp_path, capsys):
+    _write(tmp_path, "BENCH_r01.json", OLD)
+    mid = dict(OLD)
+    mid["e2e_rpc_train_samples_per_sec_native"] = 50000.0
+    _write(tmp_path, "BENCH_r02.json", mid)
+    new = dict(mid)
+    new["e2e_rpc_train_samples_per_sec_native"] = 51000.0
+    _write(tmp_path, "BENCH_r03.json", new)
+    # latest two = r02 -> r03 (within tolerance); the r01 drop is not
+    # in the window
+    assert bc.main(["--glob", str(tmp_path / "BENCH_r*.json")]) == 0
+    out = capsys.readouterr().out
+    assert "BENCH_r02.json" in out and "BENCH_r03.json" in out
+    with pytest.raises(ValueError):
+        bc.pick_latest_two(str(tmp_path / "nope*.json"))

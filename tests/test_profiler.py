@@ -1,0 +1,506 @@
+"""Continuous-profiling plane tests (ISSUE 8): the always-on stack
+sampler (bounded store, hz=0 off, folding determinism), slowlog
+tail-triggered snapshots (once per breach window, trace_id stamping),
+device capture bounds, get_profile/profile_device envelope compat on
+both transports, and the cluster acceptance: ``jubactl -c profile
+--folded`` against a live proxy + 2-backend topology emits a non-empty
+cluster-folded collapsed-stack profile containing frames from both
+backends."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from jubatus_tpu.utils import tracing
+from jubatus_tpu.utils.profiler import (
+    OTHER_KEY,
+    DeviceCapture,
+    SamplingProfiler,
+    collapse_frame,
+    fold_profiles,
+    folded_lines,
+    render_top,
+    top_table,
+)
+from jubatus_tpu.utils.slowlog import SlowLog
+
+CONF = {
+    "method": "PA",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+
+
+# -- the sampler itself -------------------------------------------------------
+
+
+def test_sampler_collects_stacks_with_thread_roots():
+    reg = tracing.Registry()
+    prof = SamplingProfiler(reg, hz=250)
+    stop = threading.Event()
+
+    def busy_beaver():
+        while not stop.is_set():
+            sum(i * i for i in range(500))
+
+    t = threading.Thread(target=busy_beaver, name="prof-busy", daemon=True)
+    t.start()
+    prof.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            doc = prof.profile(60)
+            if any("busy_beaver" in k for k in doc["folded"]):
+                break
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        prof.stop()
+        t.join(timeout=2)
+    doc = prof.profile(60)
+    assert doc["folded"], "sampler collected nothing"
+    assert doc["stats"]["enabled"] and doc["stats"]["samples"] > 0
+    busy = [k for k in doc["folded"] if "busy_beaver" in k]
+    assert busy, sorted(doc["folded"])[:5]
+    # thread name roots the stack; the sampler's own thread is excluded
+    assert any(k.startswith("thread:prof-busy;") for k in busy)
+    assert not any("stack-profiler" in k.split(";", 1)[0]
+                   for k in doc["folded"])
+
+
+def test_bounded_store_under_churn():
+    prof = SamplingProfiler(None, hz=0, max_stacks=8)
+    with prof._lock:
+        for i in range(100):
+            prof._ingest_locked(f"thread:t;mod.py:f{i}")
+    doc = prof.profile(0)
+    # bound holds: max_stacks distinct keys + the overflow bucket
+    assert len(doc["folded"]) <= 8 + 1
+    assert doc["folded"][OTHER_KEY] == 100 - 8
+    assert doc["stats"]["truncated"] == 100 - 8
+    # counts stay honest: every ingested sample is accounted somewhere
+    assert sum(doc["folded"].values()) == 100
+
+
+def test_window_rotation_bounds_history():
+    prof = SamplingProfiler(None, hz=0, bucket_s=0.5, ring_capacity=4)
+    now = time.time()
+    with prof._lock:
+        prof._ingest_locked("thread:t;a.py:f")
+        for i in range(10):  # force rotations far past ring capacity
+            prof._rotate_locked(now + i)
+            prof._ingest_locked("thread:t;a.py:f")
+    assert prof.stats()["ring_buckets"] <= 4
+    # a short window excludes evicted/out-of-window buckets but always
+    # includes the live bucket
+    doc = prof.profile(0.001)
+    assert doc["folded"].get("thread:t;a.py:f", 0) >= 1
+
+
+def test_hz_zero_fully_off():
+    prof = SamplingProfiler(None, hz=0)
+    prof.start()
+    assert prof._thread is None  # no thread at all
+    assert not prof.enabled
+    doc = prof.profile(60)
+    assert doc["folded"] == {}
+    assert doc["stats"]["enabled"] is False
+    # the tail trigger degrades to a no-op, not a crash
+    assert prof.tail_snapshot("rpc.x", ["t1"]) is None
+    assert prof.snapshots() == []
+    prof.stop()
+
+
+def test_hz_zero_server_has_no_sampler_thread():
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    srv = EngineServer(
+        "classifier", CONF,
+        args=ServerArgs(engine="classifier", listen_addr="127.0.0.1",
+                        profile_hz=0.0))
+    port = srv.start(0)
+    try:
+        assert srv.profiler._thread is None
+        assert not any(t.name == "stack-profiler"
+                       for t in threading.enumerate())
+        (doc,) = srv.get_profile("", 60).values()
+        assert doc["folded"] == {} and doc["stats"]["enabled"] is False
+        assert port
+    finally:
+        srv.stop()
+
+
+def test_collapse_frame_shape():
+    import sys
+
+    frame = sys._getframe()
+    key = collapse_frame(frame, "tname")
+    parts = key.split(";")
+    assert parts[0] == "thread:tname"
+    assert parts[-1].endswith(":test_collapse_frame_shape")
+    # file.py:function tokens, no line numbers
+    assert all(":" in p for p in parts)
+
+
+def test_folding_determinism_and_order_invariance():
+    d1 = {"folded": {"t;a": 3, "t;b": 1}}
+    d2 = {"folded": {"t;a": 2, "t;c": 5}}
+    once = fold_profiles([d1, d2])
+    assert once == {"t;a": 5, "t;b": 1, "t;c": 5}
+    assert fold_profiles([d2, d1]) == once          # order-invariant
+    assert fold_profiles([d1, d2]) == once          # repeatable
+    # bare folded dicts fold too (jubactl folds mixed shapes)
+    assert fold_profiles([{"t;a": 1}, d1])["t;a"] == 4
+    lines = folded_lines(once)
+    assert lines == sorted(lines)
+    assert "t;a 5" in lines
+
+
+def test_top_table_self_cum_math():
+    folded = {"t;a;b": 6, "t;a;c": 4, "t;a": 2,
+              "t;r;r": 3}  # recursion: r counted once per stack
+    rows = {r["frame"]: r for r in top_table(folded)}
+    assert rows["b"]["self"] == 6 and rows["b"]["cum"] == 6
+    assert rows["a"]["self"] == 2 and rows["a"]["cum"] == 12
+    assert rows["t"]["cum"] == 15
+    assert rows["r"]["self"] == 3 and rows["r"]["cum"] == 3
+    text = render_top(folded, top=3)
+    assert "frame" in text and "total: 15 sample(s)" in text
+
+
+def test_concurrent_get_profile_during_sampling():
+    reg = tracing.Registry()
+    prof = SamplingProfiler(reg, hz=500, bucket_s=0.5)
+    prof.start()
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        try:
+            while not stop.is_set():
+                doc = prof.profile(1.0)
+                assert isinstance(doc["folded"], dict)
+                prof.tail_snapshot("rpc.x", ["tid"])
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=reader, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.6)
+    stop.set()
+    for t in threads:
+        t.join(timeout=2)
+    prof.stop()
+    assert not errors, errors
+    assert prof.stats()["samples"] > 0
+    # the snapshot ring stayed bounded under the hammering
+    assert len(prof.snapshots()) <= SamplingProfiler(None).\
+        _snapshots.maxlen
+
+
+# -- tail trigger (slowlog -> snapshot) ---------------------------------------
+
+
+def test_slowlog_trigger_fires_once_per_window():
+    sl = SlowLog()
+    fired = []
+    sl.set_trigger(lambda span, ids: fired.append((span, ids)),
+                   breaches=3, window_s=10.0)
+    t0 = 1000.0
+    for i in range(5):  # 5 breaches in one window -> ONE fire at the 3rd
+        sl._note_breach("rpc.classify", f"tid{i}", now=t0 + i)
+    assert len(fired) == 1
+    span, ids = fired[0]
+    assert span == "rpc.classify"
+    assert ids == ["tid0", "tid1", "tid2"]
+    # window expires -> breaches count fresh, can fire again
+    for i in range(3):
+        sl._note_breach("rpc.classify", f"late{i}", now=t0 + 20 + i)
+    assert len(fired) == 2
+    assert fired[1][1] == ["late0", "late1", "late2"]
+    # distinct spans keep independent windows
+    sl._note_breach("rpc.train", "x", now=t0 + 21)
+    assert len(fired) == 2
+    assert sl.stats()["trigger_fired"] == 2
+
+
+def test_slowlog_trigger_disabled_and_error_isolated():
+    sl = SlowLog()
+    # disabled by default: no callback, nothing fires
+    assert sl._note_breach("rpc.x", "t", now=1.0) is False
+    # a raising callback must not break capture
+    sl.set_trigger(lambda *_: 1 / 0, breaches=1, window_s=10.0)
+    sl.add({"method": "rpc.x", "trace_id": "t1", "duration_ms": 1.0})
+    assert sl.stats()["captured"] == 1
+    assert sl.stats()["trigger_fired"] == 1
+
+
+def test_breach_snapshot_carries_trace_id_through_registry():
+    """Acceptance: a slowlog breach auto-captures a profiler snapshot
+    stamped with the breaching trace_id, through the REAL wiring
+    (Registry.record -> slow capture -> slowlog.add -> trigger)."""
+    reg = tracing.Registry()
+    prof = SamplingProfiler(reg, hz=100)
+    reg.slowlog.configure(min_count=1, quantile=0.5)
+    reg.slowlog.set_trigger(prof.tail_snapshot, breaches=3, window_s=30.0)
+    ctx = tracing.new_root()
+    with tracing.use_trace(ctx):
+        for _ in range(4):  # equal durations: every record >= threshold
+            reg.record("rpc.classify", 0.25)
+    snaps = prof.snapshots()
+    assert len(snaps) == 1, snaps  # once per window despite 4 breaches
+    assert snaps[0]["span"] == "rpc.classify"
+    assert ctx.trace_id in snaps[0]["trace_ids"]
+    # the snapshot rides the get_profile doc
+    doc = prof.profile(60)
+    assert doc["snapshots"] and \
+        ctx.trace_id in doc["snapshots"][0]["trace_ids"]
+
+
+def test_server_breach_snapshot_end_to_end():
+    """Server-level: slow spans breach -> snapshot appears in the
+    get_profile RPC reply with the breaching trace_id."""
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    srv = EngineServer(
+        "classifier", CONF,
+        args=ServerArgs(engine="classifier", listen_addr="127.0.0.1"))
+    srv.rpc.trace.slowlog.configure(min_count=1, quantile=0.5)
+    port = srv.start(0)
+    try:
+        ctx = tracing.new_root()
+        with tracing.use_trace(ctx):
+            for _ in range(4):
+                srv.rpc.trace.record("rpc.classify", 0.25)
+        with RpcClient("127.0.0.1", port) as rc:
+            (doc,) = rc.call("get_profile", "", 60.0).values()
+        assert doc["snapshots"], doc["stats"]
+        snap = doc["snapshots"][0]
+        assert snap["span"] == "rpc.classify"
+        assert ctx.trace_id in snap["trace_ids"]
+        # slowlog stats surface the trigger state in get_status
+        (st,) = srv.get_status().values()
+        assert st["slowlog.trigger_fired"] >= 1
+        assert st["profiler.snapshots_taken"] >= 1
+        assert st["profiler.enabled"] is True
+    finally:
+        srv.stop()
+
+
+# -- device capture -----------------------------------------------------------
+
+
+def test_device_capture_capped_and_listed(tmp_path):
+    cap = DeviceCapture(str(tmp_path / "prof"), max_captures=2)
+    results = [cap.capture(0.05) for _ in range(3)]
+    oks = [r for r in results if "artifact" in r]
+    errs = [r for r in results if "error" in r]
+    # jax's CPU profiler works in this container; if a backend quirk
+    # breaks it the API must degrade to a structured error, not raise
+    assert not errs or all("dir" in r for r in errs)
+    listing = cap.list()
+    assert len(listing["artifacts"]) <= 2  # pruned past the cap
+    if oks:
+        assert listing["artifacts"], listing
+        # the newest artifact survives the prune
+        assert any(a["path"] == oks[-1]["artifact"]
+                   for a in listing["artifacts"])
+
+
+def test_profile_device_rpc_list_and_capture(tmp_path):
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    srv = EngineServer(
+        "classifier", CONF,
+        args=ServerArgs(engine="classifier", listen_addr="127.0.0.1",
+                        profile_dir=str(tmp_path / "artifacts")))
+    port = srv.start(0)
+    try:
+        with RpcClient("127.0.0.1", port) as rc:
+            (empty,) = rc.call("profile_device", "", 0.0).values()
+            assert empty["artifacts"] == []
+            (cap,) = rc.call("profile_device", "", 0.1).values()
+            assert "artifact" in cap or "error" in cap
+            (after,) = rc.call("profile_device", "", 0.0).values()
+            if "artifact" in cap:
+                assert len(after["artifacts"]) == 1
+    finally:
+        srv.stop()
+
+
+# -- envelope compat on both transports ---------------------------------------
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_profile_rpcs_envelope_compat(monkeypatch, tmp_path, native):
+    """get_profile / profile_device answer 4-element (plain msgpack-rpc)
+    AND 5/6-element (traced/deadlined) envelopes on both transports —
+    mirroring the get_spans/get_timeseries coverage."""
+    from jubatus_tpu.rpc import deadline as deadlines
+    from jubatus_tpu.rpc import native_server
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    if native and not native_server.available():
+        pytest.skip("native transport unavailable")
+    monkeypatch.setenv("JUBATUS_TPU_NATIVE_RPC", "1" if native else "0")
+    srv = EngineServer(
+        "classifier", CONF,
+        args=ServerArgs(engine="classifier", listen_addr="127.0.0.1",
+                        profile_dir=str(tmp_path / "artifacts")))
+    port = srv.start(0)
+    try:
+        deadline = time.monotonic() + 5.0
+        while srv.profiler.stats()["samples"] == 0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        with RpcClient("127.0.0.1", port) as rc:
+            # plain 4-element envelope
+            (doc,) = rc.call("get_profile", "", 60.0).values()
+            assert doc["folded"], doc["stats"]
+            assert doc["stats"]["hz"] == 67.0
+            (dev,) = rc.call("profile_device", "", 0.0).values()
+            assert dev["artifacts"] == []
+        # traced + deadlined (5/6-element) envelope
+        probe = tracing.new_root()
+        with tracing.use_trace(probe), deadlines.deadline_after(30.0):
+            with RpcClient("127.0.0.1", port) as rc:
+                (traced,) = rc.call("get_profile", "", 60.0).values()
+                (tdev,) = rc.call("profile_device", "", 0.0).values()
+        assert traced["folded"] and tdev["artifacts"] == []
+    finally:
+        srv.stop()
+
+
+def test_profile_methods_registered_idempotent():
+    from jubatus_tpu.framework.idl import (
+        CLIENT_SAFE_RETRY,
+        IDEMPOTENT_BUILTINS,
+        idempotent_methods,
+    )
+
+    for m in ("get_profile", "profile_device", "get_proxy_profile"):
+        assert m in IDEMPOTENT_BUILTINS
+        assert m in idempotent_methods("classifier")
+        assert m in CLIENT_SAFE_RETRY
+
+
+# -- cluster acceptance -------------------------------------------------------
+
+
+@pytest.fixture()
+def profile_cluster(tmp_path):
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+    coord_dir = str(tmp_path / "coord")
+    servers = []
+    proxy = None
+    try:
+        for _ in range(2):
+            srv = EngineServer(
+                "classifier", CONF,
+                args=ServerArgs(engine="classifier", coordinator=coord_dir,
+                                name="pf", listen_addr="127.0.0.1",
+                                interval_sec=1e9, interval_count=1 << 30))
+            srv.start(0)
+            servers.append(srv)
+        proxy = Proxy(ProxyArgs(engine="classifier",
+                                listen_addr="127.0.0.1",
+                                coordinator=coord_dir))
+        proxy.start(0)
+        # let every node's sampler land at least one sample
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not all(
+                n.profiler.stats()["samples"] > 0
+                for n in servers + [proxy]):
+            time.sleep(0.05)
+        yield coord_dir, servers, proxy
+    finally:
+        if proxy is not None:
+            proxy.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_cluster_folded_profile_acceptance(profile_cluster, capsys):
+    """ISSUE 8 acceptance: ``jubactl -c profile --folded`` against a
+    live proxy + 2-backend cluster emits a non-empty, cluster-folded
+    collapsed-stack profile containing frames from BOTH backends."""
+    from jubatus_tpu.cmd import jubactl
+    from jubatus_tpu.rpc.client import RpcClient
+
+    coord_dir, servers, proxy = profile_cluster
+    # one get_profile against the PROXY returns proxy + both backends,
+    # each contributing frames
+    with RpcClient("127.0.0.1", proxy.args.rpc_port) as c:
+        prof = c.call("get_profile", "pf", 60.0)
+    assert len(prof) == 3, sorted(prof)
+    for node, doc in prof.items():
+        assert doc["folded"], f"{node} contributed no frames"
+        assert sum(doc["folded"].values()) > 0
+    backend_nodes = {f"127.0.0.1_{s.args.rpc_port}" for s in servers}
+    assert backend_nodes <= set(prof)
+    rc = jubactl.main(["-c", "profile", "-t", "classifier", "-n", "pf",
+                       "-z", coord_dir, "--folded"])
+    cap = capsys.readouterr()
+    assert rc == 0
+    # stdout is pure collapsed-stack lines, each "stack count"
+    lines = [ln for ln in cap.out.splitlines() if ln.strip()]
+    assert lines, cap.err
+    for ln in lines:
+        stack, _, count = ln.rpartition(" ")
+        assert stack and int(count) > 0
+    # cluster-wide fold: totals cover every node's samples
+    total = sum(int(ln.rpartition(" ")[2]) for ln in lines)
+    assert total >= sum(
+        sum(d["folded"].values()) for d in prof.values()) * 0.5
+    # the header (stderr) attributes every node, both backends included
+    for node in backend_nodes:
+        assert node in cap.err
+
+
+def test_jubactl_profile_table_and_device(profile_cluster, capsys):
+    from jubatus_tpu.cmd import jubactl
+
+    coord_dir, servers, proxy = profile_cluster
+    rc = jubactl.main(["-c", "profile", "-t", "classifier", "-n", "pf",
+                       "-z", coord_dir, "--top", "5"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "frame" in out and "self%" in out
+    assert "folded from 3 node(s)" in out
+    rc = jubactl.main(["-c", "profile", "-t", "classifier", "-n", "pf",
+                       "-z", coord_dir, "--device"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "capture(s)" in out
+
+
+def test_jubadump_profile_live(profile_cluster, capsys):
+    import json
+
+    from jubatus_tpu.cmd import jubadump
+
+    _coord, servers, _proxy = profile_cluster
+    rc = jubadump.main(["--profile",
+                        f"127.0.0.1:{servers[0].args.rpc_port}",
+                        "-n", "pf", "--seconds", "60"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    (node_doc,) = doc.values()
+    assert node_doc["folded"]
+    assert node_doc["stats"]["enabled"] is True

@@ -1,0 +1,243 @@
+#!/usr/bin/env python3
+"""Diff two BENCH_*.json rounds and gate key-metric regressions (ISSUE 8).
+
+Every perf PR so far proved its win by hand-reading two JSON files; this
+is the mechanical version — the perf trajectory's regression gate:
+
+    python tools/bench_compare.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_compare.py --glob 'BENCH_r*.json'   # latest two
+    python tools/bench_compare.py old.json new.json \
+        --tolerance 0.05 --key-tolerance collective_round_ms_nproc4_d24=0.15
+
+Inputs may be any of the repo's bench shapes: the round envelope
+(``{"parsed": {"extra": {...}}}``), the full capture
+(``{"extra": {...}, "value": ...}``), or a flat ``{key: number}`` dict
+(bench_serving/profile_flush output) — numeric keys are flattened out of
+all of them.
+
+Regression direction is inferred per key:
+
+- **higher is better** — throughput (``*_per_sec``, ``*samples_per_sec``),
+  ``*_speedup``, engagement ``*_fraction``s;
+- **lower is better** — latencies (``*_ms``/``*_ms_*``), overhead/cost
+  ``*_ratio``s, ``*_wire_mb*``, ``*drift*``, error/timeout counts;
+- **boolean gates** — ``*_ok`` / ``*_target`` flipping true→false is a
+  regression regardless of tolerance;
+- keys matching neither pattern are reported informationally and never
+  gate (a new key or a removed key is also information, not a failure).
+
+A key regresses when it moves beyond its tolerance (default
+``--tolerance 0.05`` = 5%, overridable per key) in the bad direction.
+Exit status: 0 clean, 1 regressions found, 2 usage/input error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_TOLERANCE = 0.05
+
+#: key patterns whose larger values are better
+_HIGHER = re.compile(
+    r"(_per_sec($|_)|samples_per_sec|_speedup($|_)|_fraction($|_))")
+#: key patterns whose smaller values are better
+_LOWER = re.compile(
+    r"(_ms($|_)|_ratio($|_)|wire_mb|drift|_error(s)?($|_)|_timeouts"
+    r"|_errors_total|_denials)")
+#: boolean gates: True -> False is a regression
+_BOOL_GATE = re.compile(r"(_ok($|_)|_target($|_))")
+
+
+def flatten(doc: Any, prefix: str = "") -> Dict[str, Any]:
+    """Numeric/bool leaves of a bench JSON, flattened. The round
+    envelope's ``parsed``/``extra`` nesting collapses WITHOUT a prefix —
+    ``extra.e2e_x`` and a flat ``e2e_x`` must compare as the same key
+    across bench shapes."""
+    out: Dict[str, Any] = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            k = str(k)
+            if k in ("parsed", "extra"):
+                out.update(flatten(v, prefix))
+            elif isinstance(v, dict):
+                out.update(flatten(v, f"{prefix}{k}."))
+            elif isinstance(v, bool) or isinstance(v, (int, float)):
+                out[f"{prefix}{k}"] = v
+    return out
+
+
+def direction(key: str) -> Optional[str]:
+    """'higher' | 'lower' | 'bool' | None (ungated)."""
+    if _BOOL_GATE.search(key):
+        return "bool"
+    if _HIGHER.search(key):
+        return "higher"
+    if _LOWER.search(key):
+        return "lower"
+    return None
+
+
+def compare(old: Dict[str, Any], new: Dict[str, Any],
+            tolerance: float = DEFAULT_TOLERANCE,
+            key_tolerance: Optional[Dict[str, float]] = None
+            ) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+    """Diff two flat metric maps; returns (rows, regressions). Each row:
+    {key, old, new, change, direction, verdict} — verdict in
+    {"ok", "improved", "REGRESSED", "info", "added", "removed"}."""
+    key_tolerance = key_tolerance or {}
+    rows: List[Dict[str, Any]] = []
+    regressions: List[Dict[str, Any]] = []
+    for key in sorted(set(old) | set(new)):
+        o, n = old.get(key), new.get(key)
+        if o is None or n is None:
+            rows.append({"key": key, "old": o, "new": n, "change": None,
+                         "direction": direction(key),
+                         "verdict": "added" if o is None else "removed"})
+            continue
+        d = direction(key)
+        tol = key_tolerance.get(key, tolerance)
+        if not isinstance(o, (bool, int, float)) \
+                or not isinstance(n, (bool, int, float)):
+            # defensive: callers may pass unflattened maps with string
+            # leaves — those are information, never a gate
+            rows.append({"key": key, "old": o, "new": n, "change": None,
+                         "direction": None, "verdict": "info"})
+            continue
+        if d == "bool" or isinstance(o, bool) or isinstance(n, bool):
+            verdict = "ok"
+            if bool(o) and not bool(n):
+                verdict = "REGRESSED"
+            elif not bool(o) and bool(n):
+                verdict = "improved"
+            row = {"key": key, "old": bool(o), "new": bool(n),
+                   "change": None, "direction": "bool", "verdict": verdict}
+        else:
+            o, n = float(o), float(n)
+            change = (n - o) / abs(o) if o else (0.0 if n == o else None)
+            verdict = "info"
+            if d == "higher":
+                verdict = "REGRESSED" if (change is not None
+                                          and change < -tol) else \
+                    ("improved" if change is not None and change > tol
+                     else "ok")
+            elif d == "lower":
+                verdict = "REGRESSED" if (change is not None
+                                          and change > tol) else \
+                    ("improved" if change is not None and change < -tol
+                     else "ok")
+            row = {"key": key, "old": o, "new": n, "change": change,
+                   "direction": d, "verdict": verdict}
+        rows.append(row)
+        if row["verdict"] == "REGRESSED":
+            regressions.append(row)
+    return rows, regressions
+
+
+def load_metrics(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return flatten(json.load(f))
+
+
+def pick_latest_two(pattern: str) -> Tuple[str, str]:
+    """(older, newer) by name sort — the repo's rounds are numbered
+    (BENCH_r01..), so lexical order IS chronological order; ties or
+    exotic names fall back to mtime."""
+    paths = sorted(globlib.glob(pattern))
+    if len(paths) < 2:
+        raise ValueError(
+            f"--glob {pattern!r} matched {len(paths)} file(s); need >= 2")
+    paths.sort(key=lambda p: (os.path.basename(p), os.path.getmtime(p)))
+    return paths[-2], paths[-1]
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, bool):
+        return str(v)
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render(rows: List[Dict[str, Any]], old_path: str, new_path: str,
+           show_all: bool = False) -> str:
+    lines = [f"bench_compare: {old_path} -> {new_path}"]
+    shown = 0
+    for r in rows:
+        if not show_all and r["verdict"] in ("ok", "added", "removed",
+                                             "info"):
+            continue
+        shown += 1
+        chg = (f"{r['change'] * 100:+.1f}%" if isinstance(r["change"], float)
+               else "-")
+        lines.append(f"  {r['verdict']:<10} {r['key']:<52} "
+                     f"{_fmt(r['old']):>12} -> {_fmt(r['new']):>12}  {chg}")
+    gated = sum(1 for r in rows if r["direction"] is not None
+                and r["verdict"] not in ("added", "removed"))
+    regressed = sum(1 for r in rows if r["verdict"] == "REGRESSED")
+    improved = sum(1 for r in rows if r["verdict"] == "improved")
+    lines.append(f"  {gated} gated key(s): {regressed} regressed, "
+                 f"{improved} improved, "
+                 f"{gated - regressed - improved} within tolerance")
+    if not shown and not show_all:
+        lines.insert(1, "  (no keys moved beyond tolerance; --all to "
+                     "list everything)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_compare",
+        description="diff two BENCH_*.json rounds; exit 1 on key-metric "
+                    "regressions beyond tolerance")
+    p.add_argument("old", nargs="?", help="older round JSON")
+    p.add_argument("new", nargs="?", help="newer round JSON")
+    p.add_argument("--glob", dest="glob_pat", default="",
+                   help="pick the latest two files matching this glob "
+                        "instead of naming them (lexical order = round "
+                        "order for BENCH_rNN names)")
+    p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                   help="relative change beyond which a gated key "
+                        "regresses (default 0.05 = 5%%)")
+    p.add_argument("--key-tolerance", action="append", default=[],
+                   metavar="KEY=FRAC",
+                   help="per-key tolerance override (repeatable), e.g. "
+                        "collective_round_ms_nproc4_d24=0.15 for a "
+                        "noisy key")
+    p.add_argument("--all", action="store_true",
+                   help="print every compared key, not just movers")
+    ns = p.parse_args(argv)
+    try:
+        if ns.glob_pat:
+            old_path, new_path = pick_latest_two(ns.glob_pat)
+        elif ns.old and ns.new:
+            old_path, new_path = ns.old, ns.new
+        else:
+            print("need OLD NEW paths or --glob", file=sys.stderr)
+            return 2
+        key_tol: Dict[str, float] = {}
+        for spec in ns.key_tolerance:
+            key, _, frac = spec.partition("=")
+            if not key or not frac:
+                print(f"bad --key-tolerance {spec!r} (want KEY=FRAC)",
+                      file=sys.stderr)
+                return 2
+            key_tol[key] = float(frac)
+        old = load_metrics(old_path)
+        new = load_metrics(new_path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+    rows, regressions = compare(old, new, tolerance=ns.tolerance,
+                                key_tolerance=key_tol)
+    print(render(rows, old_path, new_path, show_all=ns.all))
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

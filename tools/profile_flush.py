@@ -1,3 +1,4 @@
+#!/usr/bin/env python3
 """Profile the serving flush path: where does the per-flush time go?
 
 Mimics service.py's train_raw flush at several batch sizes, separating:
@@ -6,13 +7,30 @@ Mimics service.py's train_raw flush at several batch sizes, separating:
   step   — device step time (dispatch..block_until_ready)
   pipe   — effective per-step time when N steps are dispatched back-to-back
            before one block (does the runtime pipeline them?)
+
+ISSUE 8 rework: timing rides the tracing span plane (utils/tracing.py
+Registry.span handles — the same histograms the servers export) instead
+of hand-rolled wall-clock deltas, the optional ``--device-dir`` wraps
+the measured loops in an XLA capture (utils/profiler.DeviceCapture's
+machinery via tracing.device_trace), and ``--json`` emits a flat
+``{key: number}`` map tools/bench_compare.py diffs against any other
+round:
+
+    python tools/profile_flush.py --json /tmp/flush_a.json
+    ... change something ...
+    python tools/profile_flush.py --json /tmp/flush_b.json
+    python tools/bench_compare.py /tmp/flush_a.json /tmp/flush_b.json
 """
-import time
+import argparse
+import json
+import sys
+
 import numpy as np
 
 import jax
 
 from jubatus_tpu.models.classifier import ClassifierDriver
+from jubatus_tpu.utils import tracing
 
 CONF = {
     "method": "AROW",
@@ -20,6 +38,8 @@ CONF = {
     "converter": {"num_rules": [{"key": "*", "type": "num"}]},
 }
 K = 32
+REPS = 5
+PIPE_DEPTH = 10
 rng = np.random.default_rng(0)
 
 
@@ -30,50 +50,104 @@ def make_batch(b):
     return labels, idx, val
 
 
-def main():
-    d = ClassifierDriver(CONF, dim_bits=18)
-    print("platform:", jax.devices()[0].platform)
-    for b in (512, 2048, 8192, 32768):
-        labels, idx, val = make_batch(b)
-        # warm the compile
-        d.train_hashed(labels, idx, val)
-        jax.block_until_ready(d.state.w)
+def profile_batch(d, reg, b):
+    """One batch size's phase breakdown, measured as spans in ``reg``
+    (span names carry the batch size so the registry's histograms — and
+    the JSON — keep every shape separate)."""
+    labels, idx, val = make_batch(b)
+    # warm the compile
+    d.train_hashed(labels, idx, val)
+    jax.block_until_ready(d.state.w)
 
-        # host-only portion: run everything except the device call
-        t0 = time.perf_counter()
-        for _ in range(5):
+    # host-only portion: run everything except the device call
+    with reg.span(f"flush.host.b{b}") as sp_host:
+        for _ in range(REPS):
             slots = [d._ensure_label(lb) for lb in labels]
             for s in slots:
                 d._dcounts[s] += 1.0
             sa = np.zeros(b, dtype=np.int32)
             sa[: len(slots)] = slots
             _ = d._mask()
-        host_ms = (time.perf_counter() - t0) / 5 * 1e3
+    host_ms = sp_host.seconds / REPS * 1e3
 
-        # dispatch (async) vs blocked step
-        t0 = time.perf_counter()
-        for _ in range(5):
+    # dispatch (async) vs blocked step
+    with reg.span(f"flush.dispatch.b{b}") as sp_disp:
+        for _ in range(REPS):
             d.train_hashed(labels, idx, val)
-        disp_ms = (time.perf_counter() - t0) / 5 * 1e3
-        jax.block_until_ready(d.state.w)
+    disp_ms = sp_disp.seconds / REPS * 1e3
+    jax.block_until_ready(d.state.w)
 
-        t0 = time.perf_counter()
-        for _ in range(5):
+    with reg.span(f"flush.step.b{b}") as sp_step:
+        for _ in range(REPS):
             d.train_hashed(labels, idx, val)
             jax.block_until_ready(d.state.w)
-        step_ms = (time.perf_counter() - t0) / 5 * 1e3
+    step_ms = sp_step.seconds / REPS * 1e3
 
-        # pipelined: 10 dispatches then one block
-        t0 = time.perf_counter()
-        for _ in range(10):
+    # pipelined: N dispatches then one block
+    with reg.span(f"flush.pipe.b{b}") as sp_pipe:
+        for _ in range(PIPE_DEPTH):
             d.train_hashed(labels, idx, val)
         jax.block_until_ready(d.state.w)
-        pipe_ms = (time.perf_counter() - t0) / 10 * 1e3
+    pipe_ms = sp_pipe.seconds / PIPE_DEPTH * 1e3
 
-        print(f"B={b:6d}  host={host_ms:7.2f}ms  disp={disp_ms:7.2f}ms  "
-              f"step={step_ms:7.2f}ms  pipe={pipe_ms:7.2f}ms  "
-              f"-> blocked {b/step_ms*1e3:9.0f}/s  piped {b/pipe_ms*1e3:9.0f}/s")
+    return {
+        f"profile_flush_host_ms_b{b}": round(host_ms, 3),
+        f"profile_flush_dispatch_ms_b{b}": round(disp_ms, 3),
+        f"profile_flush_step_ms_b{b}": round(step_ms, 3),
+        f"profile_flush_pipe_ms_b{b}": round(pipe_ms, 3),
+        f"profile_flush_blocked_samples_per_sec_b{b}": round(
+            b / step_ms * 1e3, 1),
+        f"profile_flush_piped_samples_per_sec_b{b}": round(
+            b / pipe_ms * 1e3, 1),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="profile_flush",
+        description="phase breakdown of the train flush path, on the "
+                    "tracing span plane; JSON output diffs with "
+                    "tools/bench_compare.py")
+    p.add_argument("--batches", default="512,2048,8192,32768",
+                   help="comma-separated batch sizes")
+    p.add_argument("--json", dest="json_path", default="",
+                   help="write the flat metric map here "
+                        "(bench_compare.py input)")
+    p.add_argument("--device-dir", default="",
+                   help="also capture an XLA device trace of the "
+                        "measured loops into this directory "
+                        "(TensorBoard-viewable)")
+    ns = p.parse_args(argv)
+    batches = [int(b) for b in ns.batches.split(",") if b.strip()]
+
+    d = ClassifierDriver(CONF, dim_bits=18)
+    reg = tracing.Registry()
+    out = {"profile_flush_platform": jax.devices()[0].platform}
+    print("platform:", out["profile_flush_platform"])
+    with tracing.device_trace(ns.device_dir or None):
+        for b in batches:
+            keys = profile_batch(d, reg, b)
+            out.update(keys)
+            host = keys[f"profile_flush_host_ms_b{b}"]
+            disp = keys[f"profile_flush_dispatch_ms_b{b}"]
+            step = keys[f"profile_flush_step_ms_b{b}"]
+            pipe = keys[f"profile_flush_pipe_ms_b{b}"]
+            print(f"B={b:6d}  host={host:7.2f}ms  disp={disp:7.2f}ms  "
+                  f"step={step:7.2f}ms  pipe={pipe:7.2f}ms  "
+                  f"-> blocked "
+                  f"{keys[f'profile_flush_blocked_samples_per_sec_b{b}']:9.0f}"
+                  f"/s  piped "
+                  f"{keys[f'profile_flush_piped_samples_per_sec_b{b}']:9.0f}"
+                  f"/s")
+    if ns.json_path:
+        numeric = {k: v for k, v in out.items()
+                   if isinstance(v, (int, float))}
+        with open(ns.json_path, "w") as f:
+            json.dump(numeric, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {len(numeric)} key(s) to {ns.json_path}")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
