@@ -75,6 +75,27 @@ def _summable(mixable: Any) -> bool:
         getattr(mixable, "MIX_IS_SUM", False)
 
 
+def elect_representatives(member_names, topo) -> Dict[int, str]:
+    """host index -> the member fronting that host on the inter-host
+    wire. Deterministic and derived ONLY from the full registered
+    member list + topology (members sorted by name, grouped host-major
+    — the same member↔process-order convention the world-size check
+    already assumes; the group's first name represents it), NEVER from
+    a round's contributor set — so a degraded / below-quorum round
+    cannot reshuffle representatives, only a real membership or
+    topology change can. Empty when the member count fits neither one
+    process per (host, local) slot nor one per host (M local devices
+    each) — the same fleets whose prepare signatures mismatch."""
+    if topo is None:
+        return {}
+    names = sorted(member_names)
+    if len(names) == topo.hosts * topo.locals:
+        return {h: names[h * topo.locals] for h in range(topo.hosts)}
+    if len(names) == topo.hosts:
+        return {h: names[h] for h in range(topo.hosts)}
+    return {}
+
+
 def _signature(diffs: Dict[str, Any]) -> str:
     """Canonical shape/dtype signature; every member must match before
     anyone enters the collective (shape skew would wedge the psum).
@@ -111,7 +132,8 @@ class CollectiveMixer(RpcLinearMixer):
     and the RPC fan-out when it can't (non-sum mixables, world mismatch,
     prepare failures)."""
 
-    def __init__(self, *args, compress: Any = False, **kwargs) -> None:
+    def __init__(self, *args, compress: Any = False,
+                 topology: str = "", **kwargs) -> None:
         super().__init__(*args, **kwargs)
         #: --mix-compress: wire mode for the psum — ``off`` ships native
         #: dtypes, ``bf16`` casts f32 diffs on device (half the
@@ -123,6 +145,24 @@ class CollectiveMixer(RpcLinearMixer):
         #: mixed-mode cluster falls back to the RPC mix instead of
         #: wedging the collective.
         self.compress = compress
+        #: --mix-topology: the hierarchical-mix tier shape. ``""`` keeps
+        #: the flat single-tier psum (and the legacy prepare-signature
+        #: format — old peers interoperate); ``auto`` derives N hosts ×
+        #: M local devices from the runtime and goes hierarchical when
+        #: M > 1; an explicit ``HxM`` groups the process world (the
+        #: co-located-processes deployment and the bench/test lever).
+        #: The resolved ``NxM`` rides the prepare signature, so a fleet
+        #: with heterogeneous topologies mismatches into the RPC
+        #: fallback instead of wedging a skewed collective.
+        self.topology = topology or ""
+        #: resolved HostTopology for this process (lazy — resolution
+        #: touches jax); None = flat
+        self._topo: Optional[Any] = None
+        self._topo_resolved = False
+        #: last deterministic per-host representative election this
+        #: member computed (master rounds refresh it; surfaced in
+        #: get_status and stamped into master flight records)
+        self._reps: Dict[int, str] = {}
         #: per-replica error-feedback residual pytree for int8 rounds
         #: (parallel/collective.ErrorFeedback): the quantization error of
         #: this member's shipped diff, added back into the NEXT round's
@@ -150,6 +190,35 @@ class CollectiveMixer(RpcLinearMixer):
         #: get_status and the drift-rate gauge read these instead of
         #: paying device reductions per scrape
         self._ef_norms: Dict[str, float] = {}
+
+    def _resolve_topology(self) -> Optional[Any]:
+        """The hierarchical tier shape this member will sign and enter
+        with, resolved once per process (membership does not change a
+        process's device layout; a failed resolution logs and degrades
+        to flat — the signature mismatch against correctly-resolved
+        peers then routes the round to the RPC mix)."""
+        if self._topo_resolved:
+            return self._topo
+        topo = None
+        if self.topology:
+            try:
+                from jubatus_tpu.parallel.mesh import host_topology
+
+                if self.topology == "auto":
+                    t = host_topology()
+                    # auto only goes hierarchical when there is an
+                    # intra-host tier to exploit; Nx1 stays flat (and
+                    # keeps the legacy signature format)
+                    topo = t if t.locals > 1 else None
+                else:
+                    topo = host_topology(override=self.topology)
+            except Exception:  # broad-ok — degrade to flat, peers mismatch
+                log.warning("cannot resolve mix topology %r; staying flat",
+                            self.topology, exc_info=True)
+                topo = None
+        self._topo = topo
+        self._topo_resolved = True
+        return topo
 
     # -- coordinator paths ----------------------------------------------------
     def _go_path(self) -> str:
@@ -217,6 +286,15 @@ class CollectiveMixer(RpcLinearMixer):
             if mode == "int8":
                 sig += f"|quant=int8:{QUANT_BLOCK}"
             sig += f"|chunk={DEFAULT_CHUNK_MB}"
+            topo = self._resolve_topology()
+            if topo is not None:
+                # hierarchical rounds sign their tier shape: a member
+                # resolving a DIFFERENT NxM (heterogeneous fleet, stale
+                # flag, failed resolution) mismatches here and the
+                # round falls back to the RPC mix — a skewed two-tier
+                # collective would wedge the world. Flat members append
+                # nothing, so pre-topology peers interoperate verbatim.
+                sig += f"|topo={topo.signature}"
         with self._staged_lock:
             # one staged round at a time: a newer prepare supersedes any
             # stale round a dead master left behind (its waiter sees the
@@ -365,7 +443,8 @@ class CollectiveMixer(RpcLinearMixer):
         self.last_phases = {}
         totals = psum_pytree(entry["diffs"], compress=self.compress,
                              phases=self.last_phases, prefer_device=True,
-                             feedback=self.ef)
+                             feedback=self.ef,
+                             topology=self._resolve_topology())
         # mix-convergence telemetry (ISSUE 7): every member measures the
         # distance of its OWN contribution from the folded average — the
         # per-member half of the divergence signal the RPC master
@@ -427,6 +506,17 @@ class CollectiveMixer(RpcLinearMixer):
         wire_mb = self.last_phases.get("wire_mb")
         if isinstance(wire_mb, (int, float)):
             self.trace.gauge("mix.wire_mb", float(wire_mb))
+        # per-tier round timings + the scaling plane's wire gauge: the
+        # intra tier must stay cheap and flat as hosts grow, the inter
+        # tier is the wire, and wire bytes per HOST is the quantity the
+        # hierarchical reduce holds proportional to hosts (flat mode
+        # reports intra 0 / inter == reduce: every byte is inter-host)
+        for src, key in (("intra_ms", "mix.intra_ms"),
+                         ("inter_ms", "mix.inter_ms"),
+                         ("wire_bytes_per_host", "mix.wire_bytes_per_host")):
+            v = self.last_phases.get(src)
+            if isinstance(v, (int, float)):
+                self.trace.gauge(key, float(v))
         if self.ef is None or self.ef.rounds == 0:
             return
         try:
@@ -472,6 +562,13 @@ class CollectiveMixer(RpcLinearMixer):
                                reason="breaker_open_member",
                                members=len(members))
             return super()._run_as_master(members)
+        topo = self._resolve_topology()
+        if topo is not None:
+            # refresh the deterministic per-host representative election
+            # from the FULL member list (degraded rounds keep it stable;
+            # only membership/topology changes move it)
+            self._reps = elect_representatives(
+                [m.name for m in members], topo)
         t0 = time.monotonic()
         schemas = self.comm.get_schemas() if self._has_schema() else []
         union: List[str] = sorted(
@@ -574,18 +671,27 @@ class CollectiveMixer(RpcLinearMixer):
         log.info("collective mix round %d: %d members (%d acked), %.3fs",
                  self.mix_count, len(members), sum(acks.values()),
                  time.monotonic() - t0)
-        return {"members": len(members), "collective": True,
-                "acked": sum(acks.values()),
-                "mode": "collective_master", "round_id": rid}
+        out = {"members": len(members), "collective": True,
+               "acked": sum(acks.values()),
+               "mode": "collective_master", "round_id": rid}
+        if topo is not None:
+            out["topology"] = topo.signature
+            out["representatives"] = sorted(self._reps.values())
+        return out
 
     def get_status(self) -> Dict[str, Any]:
         st = super().get_status()
         from jubatus_tpu.parallel.collective import _norm_compress
         from jubatus_tpu.parallel.multihost import collective_capabilities
 
+        topo = self._resolve_topology()
         st.update(collective_rounds=self.collective_rounds,
                   fallback_rounds=self.fallback_rounds,
-                  mix_compress=_norm_compress(self.compress))
+                  mix_compress=_norm_compress(self.compress),
+                  mix_topology=topo.signature if topo is not None
+                  else "flat")
+        if self._reps:
+            st["mix_representatives"] = sorted(self._reps.values())
         for k, v in collective_capabilities().items():
             st[f"mix_caps_{k}"] = v
         if self.ef is not None:

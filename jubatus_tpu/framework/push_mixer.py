@@ -299,11 +299,13 @@ def create_mixer(name: str, driver: Any, comm: LinearCommunication, *,
                  self_node: Optional[NodeInfo] = None,
                  interval_sec: float = 16.0, interval_count: int = 512,
                  mix_bf16: bool = False, quorum_fraction: float = 0.5,
-                 mix_compress: str = "off"):
+                 mix_compress: str = "off", mix_topology: str = ""):
     """Mixer factory (≙ create_mixer, mixer_factory.cpp:41-97): selects by
     the --mixer flag. ``mix_compress`` is the collective wire mode
     (off|bf16|int8); the deprecated ``mix_bf16`` bool still resolves to
-    bf16 when no explicit mode is given."""
+    bf16 when no explicit mode is given. ``mix_topology`` is the
+    collective mixer's hierarchical tier shape (``""``/``auto``/``HxM``,
+    see --mix-topology)."""
     kwargs = dict(self_node=self_node, interval_sec=interval_sec,
                   interval_count=interval_count,
                   quorum_fraction=quorum_fraction)
@@ -314,7 +316,8 @@ def create_mixer(name: str, driver: Any, comm: LinearCommunication, *,
 
         mode = mix_compress if mix_compress != "off" else \
             ("bf16" if mix_bf16 else "off")
-        return CollectiveMixer(driver, comm, compress=mode, **kwargs)
+        return CollectiveMixer(driver, comm, compress=mode,
+                               topology=mix_topology, **kwargs)
     if name in STRATEGIES:
         return RpcPushMixer(driver, comm, strategy=name, **kwargs)
     if name == "dummy_mixer":

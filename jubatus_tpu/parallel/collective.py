@@ -48,6 +48,21 @@ The data plane is PIPELINED (docs/PERF_NOTES.md "Mix data plane"):
   a zero-staging path: no host cast, no ``device_put`` from numpy, and
   with ``prefer_device=True`` no readback either — the totals are handed
   back as device arrays for the jitted put_diff to consume directly.
+- ``topology`` switches the chunked pipeline into HIERARCHICAL mode
+  over the two-tier ``(host, local)`` mesh (parallel/mesh.py
+  ``host_topology``): each chunk is first psum'd over the ``local``
+  axis (intra-host — ICI/loopback, not the wire), each local lane then
+  carries only its 1/M segment of the host total into the inter-host
+  reduce over the ``host`` axis, and an intra-host all-gather (a psum
+  of lane-placed segments) rebuilds the full chunk. The inter-host
+  wire therefore ships ONE copy of the chunk per host — wire bytes per
+  host stay proportional to hosts, not total devices (the MLPerf-on-
+  TPU-pods / "limits of Concurrency" hierarchical-reduction shape;
+  flat all-reduce ships the chunk once per *device*). Wire modes
+  compose: bf16 casts and int8 quantizes AFTER the intra-host reduce
+  (the intra tier stays exact f32 — its bandwidth is free by
+  assumption), so int8 error-feedback residuals correct the HOST sum
+  and live one per host, not one per device.
 
 Requirements: every process calls with the SAME treedef/shapes/dtypes in
 the same order and the same ``compress``/``chunk_bytes`` (the collective
@@ -72,6 +87,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jubatus_tpu.parallel._compat import shard_map
+from jubatus_tpu.parallel.mesh import HostTopology, host_mesh, host_topology
 
 #: pipeline chunk size in MiB (uncompressed leaf bytes). Leaves at or
 #: above this split into chunks and double-buffer; smaller leaves batch
@@ -111,6 +127,39 @@ def _norm_compress(compress: Any) -> str:
                              f"expected one of {COMPRESS_MODES}")
         return mode
     return "bf16" if compress else "off"
+
+
+def _norm_topology(topology: Any) -> Optional[HostTopology]:
+    """Resolve the hierarchical-mode switch: None/"" / "flat" keep the
+    flat single-tier pipeline; a HostTopology rides as-is; an "HxM"
+    string (the --mix-topology override) resolves against the runtime's
+    devices. Every process in a cluster must resolve the SAME topology —
+    the collective mixer signs its prepare with it."""
+    if topology is None or topology == "" or topology == "flat":
+        return None
+    if isinstance(topology, HostTopology):
+        return topology
+    if topology == "auto":
+        return host_topology()
+    return host_topology(override=topology)
+
+
+#: per-(device, shape, dtype) zero staging buffers for the hierarchical
+#: path's non-representative lanes and fresh residual chains. Bounded;
+#: safe to reuse because device arrays are immutable and the hier
+#: programs never donate them.
+_ZEROS_CACHE: Dict[Tuple, Any] = {}
+
+
+def _dev_zeros(dev, shape: Tuple[int, ...], dtype_str: str):
+    key = (dev, shape, dtype_str)
+    z = _ZEROS_CACHE.get(key)
+    if z is None:
+        if len(_ZEROS_CACHE) > 64:
+            _ZEROS_CACHE.clear()
+        z = jax.device_put(np.zeros(shape, np.dtype(dtype_str)), dev)
+        _ZEROS_CACHE[key] = z
+    return z
 
 
 class ErrorFeedback:
@@ -260,6 +309,52 @@ def _block_dequant(q, scale, block: int):
             * scale[:, None]).reshape(-1)
 
 
+def _quant_ring_reduce(q, scales, res_t, axis: str, n: int, block: int):
+    """The quantized scatter-reduce + all-gather ring over ``axis``
+    (n members), shared by the flat transport (axis="replica", the
+    whole world) and the hierarchical inter-host tier (axis="host",
+    one lane-segment per host group). ``q`` [m] int8 + ``scales``
+    [m/block] f32 are the caller's pre-quantized copy of the full ring
+    payload (m divisible by n*block); ``res_t`` [m/n] is the carried
+    requant residual of the segment this member owns. Returns the
+    dequantized total [m] f32 — bit-identical on every member, because
+    everyone dequantizes the same all-gathered int8+scale bits — and
+    the new owned-segment residual. n == 1 degenerates to the pure
+    dequant → +res → requant round trip the world-1 drift gates ride."""
+    m = q.shape[0]
+    seg = m // n
+    sb = (m // block) // n  # scale blocks per segment
+    r = jax.lax.axis_index(axis)
+    qsegs = q.reshape(n, seg)
+    ssegs = scales.reshape(n, sb)
+    acc = _block_dequant(
+        jax.lax.dynamic_index_in_dim(qsegs, r, 0, keepdims=False),
+        jax.lax.dynamic_index_in_dim(ssegs, r, 0, keepdims=False),
+        block)
+    for k in range(1, n):
+        perm = [(i, (i + k) % n) for i in range(n)]
+        sq = jax.lax.dynamic_index_in_dim(
+            qsegs, (r + k) % n, 0, keepdims=False)
+        ss = jax.lax.dynamic_index_in_dim(
+            ssegs, (r + k) % n, 0, keepdims=False)
+        acc = acc + _block_dequant(
+            jax.lax.ppermute(sq, axis, perm),
+            jax.lax.ppermute(ss, axis, perm), block)
+    tot = acc + res_t
+    tq, ts = _block_quant(tot, block)
+    new_res_t = tot - _block_dequant(tq, ts, block)
+    out = jnp.zeros((n, seg), jnp.float32)
+    out = out.at[r].set(_block_dequant(tq, ts, block))
+    cq, cs, idx = tq, ts, r
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    for _ in range(n - 1):
+        cq = jax.lax.ppermute(cq, axis, fwd)
+        cs = jax.lax.ppermute(cs, axis, fwd)
+        idx = (idx - 1) % n
+        out = out.at[idx].set(_block_dequant(cq, cs, block))
+    return out.reshape(m), new_res_t
+
+
 @functools.lru_cache(maxsize=32)
 def _quant_ship_fn(celems: int, block: int):
     """LOCAL (non-collective) per-chunk quantizer for the ship stage:
@@ -299,41 +394,12 @@ def _quant_reduce_fn(mesh: Mesh, celems: int, block: int):
     → dequant → total requant) with both residual chains active — the
     single-process drift gates ride that."""
     n = mesh.shape["replica"]
-    seg = celems // n  # planner pads celems to a multiple of n*block
-    sb = seg // block  # scale blocks per segment
 
     def body(q, scales, res_t):
-        q = jnp.squeeze(q, 0)
-        scales = jnp.squeeze(scales, 0)
-        r = jax.lax.axis_index("replica")
-        qsegs = q.reshape(n, seg)
-        ssegs = scales.reshape(n, sb)
-        acc = _block_dequant(
-            jax.lax.dynamic_index_in_dim(qsegs, r, 0, keepdims=False),
-            jax.lax.dynamic_index_in_dim(ssegs, r, 0, keepdims=False),
-            block)
-        for k in range(1, n):
-            perm = [(i, (i + k) % n) for i in range(n)]
-            sq = jax.lax.dynamic_index_in_dim(
-                qsegs, (r + k) % n, 0, keepdims=False)
-            ss = jax.lax.dynamic_index_in_dim(
-                ssegs, (r + k) % n, 0, keepdims=False)
-            acc = acc + _block_dequant(
-                jax.lax.ppermute(sq, "replica", perm),
-                jax.lax.ppermute(ss, "replica", perm), block)
-        tot = acc + jnp.squeeze(res_t, 0)
-        tq, ts = _block_quant(tot, block)
-        new_res_t = tot - _block_dequant(tq, ts, block)
-        out = jnp.zeros((n, seg), jnp.float32)
-        out = out.at[r].set(_block_dequant(tq, ts, block))
-        cq, cs, idx = tq, ts, r
-        fwd = [(i, (i + 1) % n) for i in range(n)]
-        for _ in range(n - 1):
-            cq = jax.lax.ppermute(cq, "replica", fwd)
-            cs = jax.lax.ppermute(cs, "replica", fwd)
-            idx = (idx - 1) % n
-            out = out.at[idx].set(_block_dequant(cq, cs, block))
-        return out.reshape(celems), new_res_t[None]
+        out, new_res_t = _quant_ring_reduce(
+            jnp.squeeze(q, 0), jnp.squeeze(scales, 0),
+            jnp.squeeze(res_t, 0), "replica", n, block)
+        return out, new_res_t[None]
 
     return jax.jit(
         shard_map(body, mesh=mesh,
@@ -346,6 +412,103 @@ def _quant_reduce_fn(mesh: Mesh, celems: int, block: int):
         # survive a failed round (feedback commits on success)
         donate_argnums=_donate(),
     )
+
+
+_SPEC2 = P("host", "local")
+
+
+@functools.lru_cache(maxsize=32)
+def _hier_fns(mesh: Mesh, celems: int, dtype_str: str, mode: str):
+    """The two-tier reduce of one [hosts, locals, celems] chunk as TWO
+    jitted programs (separately dispatched so the mix can time the
+    tiers apart — ``intra_ms`` vs ``inter_ms``):
+
+    - intra: reduce-scatter over ``local`` — each lane receives ONLY
+      its 1/M segment of the host sum, (M-1)/M of the chunk on the
+      intra wire (a full psum would ship 2(M-1)/M and broadcast a sum
+      we immediately discard M-1 of). The bf16 cast happens here, after
+      the exact intra fold, when the wire mode asks — the inter tier's
+      input is one chunk copy per host, spread over the lanes.
+    - inter: psum over ``host`` reduces each lane's segment across
+      hosts (M parallel rings, each carrying a DISTINCT segment — the
+      per-host wire is the chunk once, not once per device), then an
+      intra-host all-gather of the lane segments (lane-order concat)
+      rebuilds the full chunk on every device.
+
+    A 1x1 topology degenerates to the identity pipeline — bit-identical
+    to the flat path, which the world-1 parity gates assert."""
+    compress = mode == "bf16" and dtype_str == "float32"
+
+    def intra(x):
+        y = jnp.squeeze(x, (0, 1))
+        s = jax.lax.psum_scatter(y, "local", scatter_dimension=0,
+                                 tiled=True)
+        if compress:
+            s = s.astype(jnp.bfloat16)
+        return s[None, None]
+
+    def inter(s):
+        y = jnp.squeeze(s, (0, 1))
+        tot = jax.lax.psum(y, "host")
+        if compress:
+            tot = tot.astype(jnp.float32)
+        return jax.lax.all_gather(tot, "local", tiled=True)
+
+    # no donation on the intra input: its zero lanes come from the
+    # shared _dev_zeros cache and must survive the call
+    intra_j = jax.jit(
+        shard_map(intra, mesh=mesh, in_specs=_SPEC2, out_specs=_SPEC2,
+                  check_rep=False),
+        out_shardings=NamedSharding(mesh, _SPEC2))
+    inter_j = jax.jit(
+        shard_map(inter, mesh=mesh, in_specs=_SPEC2, out_specs=P(),
+                  check_rep=False),
+        out_shardings=NamedSharding(mesh, P()),
+        donate_argnums=_donate())
+    return intra_j, inter_j
+
+
+@functools.lru_cache(maxsize=32)
+def _hier_quant_fns(mesh: Mesh, celems: int, block: int):
+    """int8 over the two-tier mesh. Quantization happens AFTER the
+    intra-host reduce (the intra tier is exact f32 — quantizing the
+    wire you are not constrained by would only add error), so the
+    error-feedback residuals correct the HOST sum: one ``contrib``
+    chain entry per (host, lane) segment — per host, not per
+    contributing device — and the ring's requant chain per owned
+    sub-segment, exactly like the flat transport one tier down."""
+    n_host = mesh.shape["host"]
+
+    def intra(x, res_c):
+        y = jnp.squeeze(x, (0, 1))
+        s = jax.lax.psum_scatter(y, "local", scatter_dimension=0,
+                                 tiled=True)
+        s = s + jnp.squeeze(res_c, (0, 1))
+        q, scales = _block_quant(s, block)
+        new_res = s - _block_dequant(q, scales, block)
+        return q[None, None], scales[None, None], new_res[None, None]
+
+    def inter(q, scales, res_t):
+        out_seg, new_rt = _quant_ring_reduce(
+            jnp.squeeze(q, (0, 1)), jnp.squeeze(scales, (0, 1)),
+            jnp.squeeze(res_t, (0, 1)), "host", n_host, block)
+        return (jax.lax.all_gather(out_seg, "local", tiled=True),
+                new_rt[None, None])
+
+    # no donation on intra (zero lanes + residual come from shared /
+    # carried buffers); inter donates only the fresh quantized buffer —
+    # the residual input must survive a failed round
+    intra_j = jax.jit(
+        shard_map(intra, mesh=mesh, in_specs=(_SPEC2, _SPEC2),
+                  out_specs=(_SPEC2, _SPEC2, _SPEC2), check_rep=False),
+        out_shardings=(NamedSharding(mesh, _SPEC2),) * 3)
+    inter_j = jax.jit(
+        shard_map(inter, mesh=mesh, in_specs=(_SPEC2, _SPEC2, _SPEC2),
+                  out_specs=(P(), _SPEC2), check_rep=False),
+        out_shardings=(NamedSharding(mesh, P()),
+                       NamedSharding(mesh, _SPEC2)),
+        donate_argnums=_donate())
+    return intra_j, inter_j
 
 
 def _leaf_meta(leaf) -> Tuple[Any, np.dtype, Tuple[int, ...]]:
@@ -363,7 +526,8 @@ def psum_pytree(diff: Any, compress: Any = False,
                 phases: dict = None,  # type: ignore[assignment]
                 chunk_mb: Optional[float] = None,
                 prefer_device: bool = False,
-                feedback: Optional[ErrorFeedback] = None) -> Any:
+                feedback: Optional[ErrorFeedback] = None,
+                topology: Any = None) -> Any:
     """AllReduce ``diff`` (pytree of arrays/scalars) across the process
     world. Every process must call this with an identically-shaped
     pytree and the same ``compress`` and ``chunk_mb`` (both ride the
@@ -407,12 +571,39 @@ def psum_pytree(diff: Any, compress: Any = False,
     ``overlap_ms_saved`` — a DIRECT measurement of the overlap win:
     the reader thread's readback blocking that elapsed while the main
     thread was still shipping/reducing later chunks (minus the tail it
-    did wait for) — wait the serial path would have eaten inline."""
+    did wait for) — wait the serial path would have eaten inline.
+
+    ``topology`` (None | HostTopology | "auto" | "HxM") switches the
+    CHUNKED stream into the two-tier hierarchical reduce over the
+    (host, local) mesh: intra-host psum first, one chunk copy per host
+    on the inter-host wire (see the module docstring). Small leaves
+    keep the flat batched collective — their wire share is noise and
+    the stream shape must stay a pure function of the plan inputs.
+    Hierarchical phases additionally report ``intra_ms``/``inter_ms``
+    (per-tier; barriered exactly for chunk 0, dispatch-side for the
+    pipelined remainder, like ``reduce_ms``), ``topo`` (the NxM
+    signature, "flat" otherwise) and ``wire_bytes_per_host`` (ring-
+    model inter-host bytes one HOST ships per round — the scaling
+    gate's key: flat grows it with devices, hierarchical holds it at
+    the host count)."""
     mode = _norm_compress(compress)
+    # a 1x1 (trivial) topology still rides the hier code path — the
+    # world-1 parity gates prove that path bit-identical to flat
+    topo = _norm_topology(topology)
     mesh = _world_mesh()
     n = mesh.shape["replica"]
     me = jax.local_devices()[0]
     sharding = NamedSharding(mesh, P("replica"))
+    hier = topo is not None
+    if hier:
+        mesh2 = host_mesh(topo)
+        sharding2 = NamedSharding(mesh2, _SPEC2)
+        my_devs = [d for row in topo.grid for d in row
+                   if d.process_index == me.process_index]
+        if not my_devs:
+            raise ValueError(
+                f"topology {topo.signature} includes no device of "
+                f"process {me.process_index}")
     if chunk_mb is None:
         chunk_mb = DEFAULT_CHUNK_MB
     chunk_bytes = max(1, int(chunk_mb * 2**20))
@@ -421,10 +612,13 @@ def psum_pytree(diff: Any, compress: Any = False,
     leaves, treedef = jax.tree_util.tree_flatten(diff)
     if phases is not None:
         phases.update(cast_ms=0.0, ship_ms=0.0, reduce_ms=0.0,
-                      readback_ms=0.0, payload_mb=0.0,
-                      wire_mb=0.0, wire_mb_ring_model=0.0, chunks=0,
+                      readback_ms=0.0, intra_ms=0.0, inter_ms=0.0,
+                      payload_mb=0.0,
+                      wire_mb=0.0, wire_mb_ring_model=0.0,
+                      wire_bytes_per_host=0, chunks=0,
                       chunk_mb=round(chunk_bytes / 2**20, 2),
-                      overlap_ms_saved=0.0, quant=mode)
+                      overlap_ms_saved=0.0, quant=mode,
+                      topo=topo.signature if hier else "flat")
     if not leaves:
         return diff
 
@@ -452,19 +646,29 @@ def psum_pytree(diff: Any, compress: Any = False,
 
     def _chunk_elems(dtype: np.dtype) -> int:
         ce = max(1, chunk_bytes // dtype.itemsize)
-        if mode == "int8" and dtype == np.float32:
+        if hier:
+            # every lane owns a 1/M segment of the chunk; int8
+            # additionally block-quantizes per host-ring sub-segment
+            quantum = topo.locals
+            if mode == "int8" and dtype == np.float32:
+                quantum = topo.locals * topo.hosts * block
+        elif mode == "int8" and dtype == np.float32:
             # every replica-owned segment must block-quantize: pad the
             # chunk up to a multiple of world * QUANT_BLOCK (zeros
             # quantize to zeros; sliced off at collection)
             quantum = n * block
-            ce = ((ce + quantum - 1) // quantum) * quantum
-        return ce
+        else:
+            quantum = 1
+        return ((ce + quantum - 1) // quantum) * quantum
 
     # wire accounting per leaf: bf16 halves every f32 leaf; int8
     # quantizes only the CHUNKED f32 leaves (small leaves and non-f32
     # dtypes ship exact) at 1 byte/elem + one f32 scale per block,
-    # counting the block padding the stream actually ships
-    nbytes = 0
+    # counting the block padding the stream actually ships. Chunked
+    # and small bytes are tracked apart: in hierarchical mode only the
+    # chunked stream rides the two-tier reduce (small leaves stay on
+    # the flat world ring), so their ring models differ.
+    nbytes = big_bytes = small_bytes = 0
     for i, (_, dtype, _, size) in enumerate(metas):
         wire = size * dtype.itemsize
         if dtype == np.float32:
@@ -475,6 +679,10 @@ def psum_pytree(diff: Any, compress: Any = False,
                 shipped = ((size + ce - 1) // ce) * ce
                 wire = shipped + (shipped // block) * 4
         nbytes += wire
+        if i in big_set:
+            big_bytes += wire
+        else:
+            small_bytes += wire
 
     out: List[Any] = [None] * len(metas)
     t_ship = t_reduce = t_readback = t_cast = 0.0
@@ -533,18 +741,38 @@ def psum_pytree(diff: Any, compress: Any = False,
         n_chunks = len(stream)
 
         # error-feedback state: reset on any plan change (shape, chunk,
-        # world, or block skew would misalign the carried residuals);
-        # fresh residuals commit only after the whole stream succeeds
+        # world, topology, or block skew would misalign the carried
+        # residuals); fresh residuals commit only after the whole
+        # stream succeeds
         plan_key = (str(treedef),
                     tuple((str(m[1]), m[2]) for m in metas),
-                    chunk_bytes, n, block)
+                    chunk_bytes, n, block,
+                    topo.signature if hier else "flat")
         if feedback is not None and feedback.key != plan_key:
             feedback.reset()
         pending_c: Dict[Tuple[int, int], Any] = {}
         pending_t: Dict[Tuple[int, int], Any] = {}
+        tiers = {"intra": 0.0, "inter": 0.0}
 
         def _quantized(i: int) -> bool:
             return mode == "int8" and metas[i][1] == np.float32
+
+        def _hier_global(per_dev_shape, dtype_str, data=None):
+            """A (hosts, locals, *per_dev_shape) global array from this
+            process's addressable lanes: ``data`` on its FIRST grid
+            device (a process contributes its chunk exactly once),
+            cached zeros on the rest — the intra psum folds every
+            host's real lanes and ignores the zero ones."""
+            shards = []
+            for j, d in enumerate(my_devs):
+                if j == 0 and data is not None:
+                    shards.append(jax.device_put(data[None, None], d))
+                else:
+                    shards.append(
+                        _dev_zeros(d, (1, 1) + per_dev_shape, dtype_str))
+            return jax.make_array_from_single_device_arrays(
+                (topo.hosts, topo.locals) + per_dev_shape, sharding2,
+                shards)
 
         def ship(entry):
             i, start, stop = entry
@@ -557,12 +785,18 @@ def psum_pytree(diff: Any, compress: Any = False,
                 if pad:
                     chunk = jnp.concatenate(
                         [chunk, jnp.zeros(pad, chunk.dtype)])
-                shard = jax.device_put(chunk[None, :], me)
             else:
                 if pad:
                     chunk = np.concatenate(
                         [chunk, np.zeros(pad, chunk.dtype)])
-                shard = jax.device_put(chunk[None, :], me)
+            if hier:
+                # the wire prep (bf16 cast / int8 quantization) happens
+                # INSIDE the collective, after the exact intra-host
+                # fold — the ship stage only places this process's
+                # contribution on its representative lane
+                return _hier_global((celems,), str(chunk.dtype),
+                                    data=chunk), celems
+            shard = jax.device_put(chunk[None, :], me)
             if mode == "bf16" and dtype == np.float32:
                 # the wire prep IS the ship path: cast on device right
                 # after placement, so the collective body reduces
@@ -604,9 +838,11 @@ def psum_pytree(diff: Any, compress: Any = False,
                     [jax.device_put(np.zeros((1, seg), np.float32), me)])
             return rt
 
-        def reduce_chunk(entry, stacked, celems):
+        def reduce_chunk(entry, stacked, celems, barrier=False):
             i = entry[0]
             dtype = metas[i][1]
+            if hier:
+                return _reduce_chunk_hier(entry, stacked, celems, barrier)
             if _quantized(i):
                 gq, gs = stacked
                 rt = _total_residual(entry, celems)
@@ -618,6 +854,53 @@ def psum_pytree(diff: Any, compress: Any = False,
                   else str(dtype))
             return _reduce_chunk_fn(mesh, celems, dt,
                                     mode == "bf16")(stacked)
+
+        def _reduce_chunk_hier(entry, stacked, celems, barrier):
+            """Two dispatches per chunk — intra-host fold, then the
+            inter-host ring + rebuild — so the tiers are timed apart.
+            Chunk 0 (``barrier``) blocks between them: its ``intra_ms``
+            / ``inter_ms`` are real wall splits; the pipelined
+            remainder adds dispatch-side time only (same honesty
+            contract as ``reduce_ms``)."""
+            i, start, _stop = entry
+            key = (i, start)
+            t0 = time.perf_counter()
+            if _quantized(i):
+                seg = celems // topo.locals
+                rc = feedback.contrib.get(key) \
+                    if feedback is not None else None
+                if rc is None:
+                    rc = _hier_global((seg,), "float32")
+                intra_fn, inter_fn = _hier_quant_fns(mesh2, celems, block)
+                q, scales, new_rc = intra_fn(stacked, rc)
+                if barrier:
+                    jax.block_until_ready((q, scales))
+                t1 = time.perf_counter()
+                pending_c[key] = new_rc
+                rt = feedback.total.get(key) \
+                    if feedback is not None else None
+                if rt is None:
+                    rt = _hier_global((seg // topo.hosts,), "float32")
+                reduced, new_rt = inter_fn(q, scales, rt)
+                if barrier:
+                    jax.block_until_ready(reduced)
+                t2 = time.perf_counter()
+                pending_t[key] = new_rt
+            else:
+                dtype = metas[i][1]
+                intra_fn, inter_fn = _hier_fns(mesh2, celems,
+                                               str(dtype), mode)
+                segs = intra_fn(stacked)
+                if barrier:
+                    jax.block_until_ready(segs)
+                t1 = time.perf_counter()
+                reduced = inter_fn(segs)
+                if barrier:
+                    jax.block_until_ready(reduced)
+                t2 = time.perf_counter()
+            tiers["intra"] += t1 - t0
+            tiers["inter"] += t2 - t1
+            return reduced
 
         def collect(entry, reduced):
             i, start, stop = entry
@@ -642,7 +925,7 @@ def psum_pytree(diff: Any, compress: Any = False,
         stacked, celems = ship(stream[0])
         jax.block_until_ready(stacked)
         tp1 = time.perf_counter()
-        reduced = reduce_chunk(stream[0], stacked, celems)
+        reduced = reduce_chunk(stream[0], stacked, celems, barrier=True)
         reduced = jax.block_until_ready(reduced)
         tp2 = time.perf_counter()
         collect(stream[0], reduced)
@@ -743,20 +1026,43 @@ def psum_pytree(diff: Any, compress: Any = False,
                 out[i] = total.reshape(shape)
             t_readback += time.perf_counter() - t3
 
-    wire_mb = nbytes * 2 * (n - 1) / n / 2**20
+    # ring-model wire accounting. Flat: every process ships the full
+    # post-compress payload around the world ring — bytes per host grow
+    # with the device count. Hierarchical: the chunked stream crosses
+    # the inter-host wire ONCE per host (2(H-1)/H of the chunked
+    # payload, spread over the M lanes), small leaves stay on the world
+    # ring — bytes per host stay proportional to hosts.
+    if hier:
+        h_ring = 2 * (topo.hosts - 1) / topo.hosts
+        w_ring = 2 * (n - 1) / n
+        wire_per_host = big_bytes * h_ring + \
+            topo.locals * small_bytes * w_ring
+        wire_mb = (big_bytes * h_ring / topo.locals +
+                   small_bytes * w_ring) / 2**20
+    else:
+        wire_mb = nbytes * 2 * (n - 1) / n / 2**20
+        wire_per_host = nbytes * 2 * (n - 1) / n
     if phases is not None:
+        # per-tier split: in flat mode EVERY reduced byte crosses the
+        # process boundary, so the whole reduce is the inter tier
+        intra_s = tiers["intra"] if big_idx and hier else 0.0
+        inter_s = tiers["inter"] if big_idx and hier else t_reduce
         phases.update(
             cast_ms=round(t_cast * 1e3, 2),
             ship_ms=round(t_ship * 1e3, 2),
             reduce_ms=round(t_reduce * 1e3, 2),
             readback_ms=round(t_readback * 1e3, 2),
+            intra_ms=round(intra_s * 1e3, 2),
+            inter_ms=round(inter_s * 1e3, 2),
             payload_mb=round(nbytes / 2**20, 2),
             wire_mb=round(wire_mb, 2),
             wire_mb_ring_model=round(wire_mb, 2),
+            wire_bytes_per_host=int(wire_per_host),
             chunks=n_chunks,
             chunk_mb=round(chunk_bytes / 2**20, 2),
             overlap_ms_saved=round(overlap_saved * 1e3, 2),
             quant=mode,
+            topo=topo.signature if hier else "flat",
         )
         if quant_rounds:
             phases["ef_rounds"] = feedback.rounds

@@ -7,6 +7,12 @@ A jubatus_tpu cluster is a static `jax.sharding.Mesh`. The axes in use:
 - ``shard``: row/feature sharding for instance-based engines (the reference's
   consistent-hash-table row placement, cht.cpp:107-143 — replaced by static
   mesh placement, SURVEY.md §5 "long-context").
+- ``host`` / ``local``: the two-tier topology of the hierarchical mix
+  (``host_topology()`` / ``host_mesh()``): N hosts × M local devices,
+  host-major. Intra-host collectives ride ``local`` (ICI/loopback),
+  inter-host ones ``host`` (DCN — the wire whose bytes the hierarchical
+  reduce in parallel/collective.py keeps proportional to hosts, not
+  total devices).
 
 Multi-host: call jax.distributed.initialize() before building the mesh; the
 same code then spans hosts with collectives riding ICI (and DCN across
@@ -15,16 +21,134 @@ slices). Single chip degenerates to a 1-device mesh.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import dataclasses
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
 
-def replica_mesh(n_replicas: Optional[int] = None, devices=None) -> Mesh:
-    """A 1-D mesh of model replicas over the first n devices."""
+def host_major(devices=None) -> list:
+    """Devices ordered host-major: grouped by ``process_index``, by id
+    within a process. ``jax.devices()`` order is backend-defined and can
+    interleave hosts — a mesh axis built over the flat order would then
+    span the network where the code expects locality (a "local" slice of
+    consecutive devices must be consecutive *on one host*)."""
     devices = list(devices if devices is not None else jax.devices())
+    return sorted(devices, key=lambda d: (d.process_index, d.id))
+
+
+@dataclasses.dataclass(frozen=True)
+class HostTopology:
+    """Two-tier device topology for the hierarchical mix: ``hosts``
+    groups of ``locals`` devices each. ``grid`` is the host-major
+    (hosts, locals) device grid as nested tuples (hashable — jitted
+    collective programs cache on it via the Mesh they build). ``source``
+    records how it was derived (``derived`` from the runtime,
+    ``override`` from an explicit ``HxM`` spec)."""
+
+    hosts: int
+    locals: int
+    grid: Tuple[tuple, ...]
+    source: str = "derived"
+
+    @property
+    def signature(self) -> str:
+        """The ``NxM`` string the collective mixer folds into its
+        prepare signature — heterogeneous fleets mismatch here and fall
+        back to the RPC mix instead of wedging a skewed collective."""
+        return f"{self.hosts}x{self.locals}"
+
+    @property
+    def trivial(self) -> bool:
+        return self.hosts * self.locals <= 1
+
+
+def _parse_topology(spec) -> Tuple[int, int]:
+    if isinstance(spec, (tuple, list)) and len(spec) == 2:
+        h, m = int(spec[0]), int(spec[1])
+    else:
+        try:
+            h_s, _, m_s = str(spec).lower().partition("x")
+            h, m = int(h_s), int(m_s)
+        except ValueError:
+            raise ValueError(
+                f"bad topology {spec!r}: expected 'HxM' (hosts x local "
+                "devices), e.g. '4x2'") from None
+    if h < 1 or m < 1:
+        raise ValueError(f"bad topology {spec!r}: both tiers must be >= 1")
+    return h, m
+
+
+def host_topology(devices=None, override=None) -> HostTopology:
+    """The runtime's two-tier (host, local) topology.
+
+    Derived (no ``override``): one grid row per process, the process's
+    local devices (host-major order) as its row — the pod shape, one
+    jax process per host with M chips each. Processes with non-uniform
+    device counts degrade to one device per process (``Nx1``), because a
+    ragged grid cannot mesh.
+
+    ``override`` (``"HxM"`` / ``(H, M)`` — the test/bench lever, and the
+    knob for fleets that co-locate M single-device processes per host):
+    regrids the participant list host-major. With multiple processes the
+    participants are one device per process (first local each) and
+    H*M must equal the process count; single-process worlds regrid the
+    local devices themselves (H*M of them), which is how the virtual
+    8-device CPU test world exercises real two-tier collectives without
+    a cluster."""
+    devices = host_major(devices)
+    if not devices:
+        raise ValueError("no devices")
+    by_proc: dict = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    rows = [tuple(by_proc[p]) for p in sorted(by_proc)]
+    if override is not None and override != "":
+        h, m = _parse_topology(override)
+        if len(rows) > 1:
+            if h * m != len(rows):
+                raise ValueError(
+                    f"topology {h}x{m} needs {h * m} processes, "
+                    f"world has {len(rows)}")
+            flat = [row[0] for row in rows]
+        else:
+            if h * m > len(devices):
+                raise ValueError(
+                    f"topology {h}x{m} needs {h * m} devices, "
+                    f"have {len(devices)}")
+            flat = devices[: h * m]
+        grid = tuple(tuple(flat[i * m:(i + 1) * m]) for i in range(h))
+        return HostTopology(h, m, grid, source="override")
+    counts = {len(r) for r in rows}
+    if len(counts) != 1:
+        return HostTopology(len(rows), 1,
+                            tuple((row[0],) for row in rows),
+                            source="nonuniform")
+    return HostTopology(len(rows), counts.pop(), tuple(rows),
+                        source="derived")
+
+
+def host_mesh(topo: Optional[HostTopology] = None, devices=None,
+              override=None) -> Mesh:
+    """The 2-D ``(host, local)`` mesh of ``host_topology`` — intra-host
+    collectives ride the ``local`` axis (ICI / loopback), inter-host
+    ones the ``host`` axis (DCN / the real wire)."""
+    if topo is None:
+        topo = host_topology(devices, override)
+    arr = np.empty((topo.hosts, topo.locals), dtype=object)
+    for h, row in enumerate(topo.grid):
+        for l, d in enumerate(row):
+            arr[h, l] = d
+    return Mesh(arr, axis_names=("host", "local"))
+
+
+def replica_mesh(n_replicas: Optional[int] = None, devices=None) -> Mesh:
+    """A 1-D mesh of model replicas over the first n devices
+    (host-major, so "first n" is the first hosts' devices — never an
+    interleaved sample that spans every host)."""
+    devices = host_major(devices)
     if n_replicas is not None:
         if n_replicas > len(devices):
             raise ValueError(
@@ -51,8 +175,12 @@ def make_feature_sharding(mesh: Mesh, mesh_axis: str, dim_bits: int,
 
 def grid_mesh(replica: int, shard: int, devices=None) -> Mesh:
     """A 2-D (replica, shard) mesh: data-parallel groups of row-sharded
-    stores — the TPU equivalent of N CHT-sharded servers with replication."""
-    devices = list(devices if devices is not None else jax.devices())
+    stores — the TPU equivalent of N CHT-sharded servers with
+    replication. Devices are taken host-major (grouped by process) so
+    the trailing ``shard`` axis — the one the row stores all-gather
+    over — stays within a host wherever the shape allows, instead of
+    striding the network because ``jax.devices()`` interleaved hosts."""
+    devices = host_major(devices)
     need = replica * shard
     if need > len(devices):
         raise ValueError(f"mesh {replica}x{shard} needs {need} devices, have {len(devices)}")

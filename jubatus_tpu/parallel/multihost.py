@@ -57,14 +57,20 @@ def collective_capabilities() -> dict:
     """What the initialized runtime can carry for the mix plane — the
     ops-facing answer to "can this member ride --mix-compress int8?".
     Keys: ``backend`` (cpu/tpu/...), ``distributed`` (one jax world
-    spans the fleet), ``world`` (process count), ``quantized_transport``
-    (the int8 ring's requirements are met: every backend this repo
-    targets carries psum + collective_permute once the world is up —
-    CPU via gloo, TPU natively — so this tracks ``distributed`` or a
-    world of one). Surfaced in the collective mixer's get_status so a
-    mixed fleet is diagnosable before a round falls back."""
+    spans the fleet), ``world`` (process count), ``local_devices``
+    (devices THIS process contributes — the intra-host tier the
+    hierarchical mix folds before the wire), ``topology`` (the derived
+    ``NxM`` two-tier shape, processes x local devices — `jubactl -c
+    status`/`watch` show it per member, so a fleet whose tier shapes
+    disagree is diagnosable BEFORE its rounds mismatch into the RPC
+    fallback), ``quantized_transport`` (the int8 ring's requirements
+    are met: every backend this repo targets carries psum +
+    collective_permute once the world is up — CPU via gloo, TPU
+    natively — so this tracks ``distributed`` or a world of one).
+    Surfaced in the collective mixer's get_status."""
     init = distributed_is_initialized()
     world = jax.process_count() if init else 1
+    local = len(jax.local_devices())
     backend = jax.default_backend()
     quantized = True
     if backend == "cpu" and world > 1:
@@ -82,6 +88,8 @@ def collective_capabilities() -> dict:
         "backend": backend,
         "distributed": init,
         "world": world,
+        "local_devices": local,
+        "topology": f"{world}x{local}",
         "quantized_transport": quantized,
     }
 

@@ -78,6 +78,15 @@ class ServerArgs:
     #: existing deployments' argv keeps working); an explicit
     #: --mix-compress wins when both are given.
     mix_bf16: bool = False
+    #: --mix-topology: hierarchical mix tier shape (collective mixer
+    #: only). ``""`` = flat single-tier psum; ``auto`` derives N hosts
+    #: x M local devices from the runtime (hierarchical when M > 1);
+    #: explicit ``HxM`` groups the process world. The two-tier reduce
+    #: psums intra-host first and ships ONE chunk copy per host on the
+    #: inter-host wire — wire bytes per host stay proportional to
+    #: hosts, not total devices. The resolved NxM rides the prepare
+    #: signature: heterogeneous fleets fall back to the RPC mix.
+    mix_topology: str = ""
     #: Prometheus /metrics + /healthz HTTP port (utils/metrics_http.py):
     #: -1 = off (default), 0 = ephemeral (actual port in get_status)
     metrics_port: int = -1
@@ -247,6 +256,16 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                    help="deprecated alias for --mix-compress bf16 (an "
                         "explicit --mix-compress wins when both are "
                         "given)")
+    p.add_argument("--mix-topology", default="",
+                   help="hierarchical mix tier shape (collective mixer): "
+                        "'' = flat single-tier psum; 'auto' = derive N "
+                        "hosts x M local devices from the runtime and go "
+                        "hierarchical when M > 1; explicit 'HxM' groups "
+                        "the process world (co-located processes per "
+                        "host). Intra-host reduce first, one chunk copy "
+                        "per host on the inter-host wire; the resolved "
+                        "NxM rides the prepare signature so mismatched "
+                        "fleets fall back to the RPC mix")
     p.add_argument("--metrics-port", type=int, default=-1,
                    help="serve Prometheus /metrics + /healthz on this "
                         "HTTP port (0 = ephemeral; default off)")

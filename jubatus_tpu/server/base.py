@@ -157,6 +157,7 @@ class EngineServer:
                 interval_count=self.args.interval_count,
                 mix_compress=getattr(self.args, "mix_compress", "off"),
                 mix_bf16=getattr(self.args, "mix_bf16", False),
+                mix_topology=getattr(self.args, "mix_topology", ""),
                 quorum_fraction=getattr(self.args, "mix_quorum", 0.5),
             )
             self.mixer.set_trace_registry(self.rpc.trace)

@@ -51,6 +51,59 @@ def test_direction_inference():
     assert bc.direction("e2e_clients") is None
 
 
+def test_direction_inference_scaling_keys():
+    """ISSUE 9 scaling plane: wire bytes per HOST gate down-good (the
+    hierarchical reduce's whole claim), the reduction factor up-good —
+    and the factor must win over the _per_host substring it contains."""
+    assert bc.direction("collective_wire_bytes_per_host_nproc8_d24") \
+        == "lower"
+    assert bc.direction(
+        "collective_wire_bytes_per_host_nproc8_d24_hier") == "lower"
+    assert bc.direction("collective_phase_wire_bytes_per_host_d24") \
+        == "lower"
+    assert bc.direction("collective_wire_per_host_reduction_nproc8") \
+        == "higher"
+    assert bc.direction("collective_round_ms_nproc16_d24_hier") == "lower"
+
+
+def test_nproc16_default_tolerance():
+    """The nproc16 wall times swing on scheduler noise (16 gloo
+    processes, however few cores): their built-in tolerance is loose,
+    the deterministic wire-byte keys keep the tight default, and an
+    explicit --key-tolerance still wins."""
+    assert bc.default_tolerance_for(
+        "collective_round_ms_nproc16_d24", 0.05) == 0.30
+    assert bc.default_tolerance_for(
+        "collective_round_ms_nproc16_d24_hier", 0.05) == 0.30
+    assert bc.default_tolerance_for(
+        "collective_round_ms_nproc8_d24", 0.05) == 0.05
+    assert bc.default_tolerance_for(
+        "collective_wire_bytes_per_host_nproc16_d24", 0.05) == 0.05
+    old = {"collective_round_ms_nproc16_d24": 4000.0,
+           "collective_wire_bytes_per_host_nproc16_d24": 100663296}
+    new = {"collective_round_ms_nproc16_d24": 4800.0,  # +20% < 30%
+           "collective_wire_bytes_per_host_nproc16_d24": 100663296}
+    _rows, regs = bc.compare(old, new, tolerance=0.05)
+    assert regs == []
+    new["collective_round_ms_nproc16_d24"] = 5600.0   # +40% > 30%
+    _rows, regs = bc.compare(old, new, tolerance=0.05)
+    assert [r["key"] for r in regs] == ["collective_round_ms_nproc16_d24"]
+    # wire bytes growing is a regression at the tight default: the
+    # hierarchical claim IS that this number stays put
+    new["collective_round_ms_nproc16_d24"] = 4000.0
+    new["collective_wire_bytes_per_host_nproc16_d24"] = 201326592
+    _rows, regs = bc.compare(old, new, tolerance=0.05)
+    assert [r["key"] for r in regs] == \
+        ["collective_wire_bytes_per_host_nproc16_d24"]
+    # explicit per-key override still beats the built-in default
+    old2 = {"collective_round_ms_nproc16_d24": 4000.0}
+    new2 = {"collective_round_ms_nproc16_d24": 4800.0}
+    _rows, regs = bc.compare(
+        old2, new2, tolerance=0.05,
+        key_tolerance={"collective_round_ms_nproc16_d24": 0.10})
+    assert len(regs) == 1
+
+
 def test_flatten_collapses_round_envelopes():
     envelope = {"n": 5, "rc": 0, "tail": "…",
                 "parsed": {"metric": "x", "value": 2.0,

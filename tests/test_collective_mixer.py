@@ -88,10 +88,11 @@ from jubatus_tpu.server.args import ServerArgs
 CONF = {"method": "PA", "parameter": {"regularization_weight": 1.0},
         "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
 mode = sys.argv[5] if len(sys.argv) > 5 else "off"
+topo = sys.argv[6] if len(sys.argv) > 6 else ""
 args = ServerArgs(engine="classifier", coordinator=coord_dir, name="cm",
                   listen_addr="127.0.0.1", mixer="collective_mixer",
                   interval_sec=1e9, interval_count=1 << 30,
-                  mix_compress=mode)
+                  mix_compress=mode, mix_topology=topo)
 srv = EngineServer("classifier", CONF, args)
 port = srv.start(0)
 
@@ -115,6 +116,12 @@ if pid == 0:
     time.sleep(1.0)  # let every replica finish its training calls
     out = srv.mixer.mix_now()
     assert out and out.get("collective") is True, out
+    if topo:
+        # hierarchical round: the master reports the tier shape and the
+        # deterministic per-host representative election
+        assert out.get("topology") == topo, out
+        hosts = int(topo.split("x")[0])
+        assert len(out.get("representatives", [])) == hosts, out
     print("MASTER-ROUND", out, flush=True)
 else:
     # wait until the master's commit raised our model version
@@ -141,9 +148,12 @@ with RpcClient("127.0.0.1", port, timeout=30) as hc:
 col = [r for r in hist if r.get("mode") == "collective" and r.get("ok")]
 assert col, hist
 for key in ("ship_ms", "reduce_ms", "readback_ms", "chunks", "quant",
-            "wire_mb"):
+            "wire_mb", "topo"):
     assert key in (col[-1].get("phases") or {}), (key, col[-1])
 assert col[-1]["phases"]["quant"] == mode, col[-1]
+assert col[-1]["phases"]["topo"] == (topo or "flat"), col[-1]
+if topo:
+    assert srv.mixer.get_status()["mix_topology"] == topo
 c.close()
 srv.stop()
 print(f"CHILD-{pid}-OK", flush=True)
@@ -163,6 +173,27 @@ def test_multiprocess_collective_mix(mode):
     n = 3
     outs, rcs = bench_mix.run_jax_world(
         _CHILD, n, timeout=180, extra_args=(mode,))
+    for i, (out, rc) in enumerate(zip(outs, rcs)):
+        assert rc == 0, f"child {i} exit {rc}:\n{out[-3000:]}"
+        assert f"CHILD-{i}-OK" in out, f"child {i}:\n{out[-3000:]}"
+    assert any("MASTER-ROUND" in o for o in outs)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["off", "int8"])
+def test_multiprocess_hierarchical_collective_mix(mode):
+    """The full production stack over a REAL 4-process world with
+    --mix-topology 2x2: every member signs |topo=2x2, the round runs
+    the two-tier reduce (topo stamped in the flight record's phases),
+    the master reports the per-host representative election, and the
+    cross-replica knowledge assertions prove the hierarchical totals
+    still train the cluster — in the exact f32 mode and through the
+    int8 transport whose residuals live per host."""
+    import bench_mix
+
+    n = 4
+    outs, rcs = bench_mix.run_jax_world(
+        _CHILD, n, timeout=240, extra_args=(mode, "2x2"))
     for i, (out, rc) in enumerate(zip(outs, rcs)):
         assert rc == 0, f"child {i} exit {rc}:\n{out[-3000:]}"
         assert f"CHILD-{i}-OK" in out, f"child {i}:\n{out[-3000:]}"
@@ -431,6 +462,172 @@ def test_prepare_signature_per_compress_mode():
         _v, sig_bool = srv.mixer.local_prepare("r-bool", [])
         srv.mixer.local_abort("r-bool")
         assert sig_bool == sigs["bf16"]
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_topology_rides_prepare_signature():
+    """Hierarchical rounds sign their tier shape: a flat member's
+    signature is byte-identical to the legacy format (old peers
+    interoperate), a topology member appends '|topo=NxM', and distinct
+    topologies produce distinct signatures — the master's sig-set check
+    then routes a heterogeneous fleet to the RPC mix instead of wedging
+    a skewed two-tier collective."""
+    store = _Store()
+    sigs = {}
+    for topo in ("", "2x4", "4x2", "auto"):
+        args = ServerArgs(engine="classifier", coordinator="(shared)",
+                          name=NAME, listen_addr="127.0.0.1",
+                          mixer="collective_mixer",
+                          interval_sec=1e9, interval_count=1 << 30,
+                          mix_topology=topo)
+        srv = EngineServer("classifier", CONF, args,
+                           coord=MemoryCoordinator(store))
+        srv.start(0)
+        try:
+            from jubatus_tpu.client import ClassifierClient, Datum
+
+            c = ClassifierClient("127.0.0.1", srv.args.rpc_port, NAME)
+            c.train([["pos", Datum({"a": 1.0})]])
+            _v, sigs[topo] = srv.mixer.local_prepare(f"r-{topo or 'flat'}",
+                                                     [])
+            srv.mixer.local_abort(f"r-{topo or 'flat'}")
+            c.close()
+        finally:
+            srv.stop()
+    from jubatus_tpu.parallel.collective import DEFAULT_CHUNK_MB
+
+    assert sigs[""].endswith(f"|bf16=0|chunk={DEFAULT_CHUNK_MB}")
+    assert "|topo=" not in sigs[""]
+    assert sigs["2x4"] == sigs[""] + "|topo=2x4"
+    assert sigs["4x2"] == sigs[""] + "|topo=4x2"
+    # auto on the 8-virtual-device single-process world derives 1x8
+    assert sigs["auto"] == sigs[""] + "|topo=1x8"
+    assert len(set(sigs.values())) == 4
+
+
+def test_topology_mismatch_falls_back_to_rpc_mix(monkeypatch):
+    """Two members resolving DIFFERENT tier shapes (heterogeneous
+    fleet / stale flag) must mismatch at prepare and complete the round
+    over the RPC mix. The world-size gate is forced open so the
+    signature check is provably what routes the fallback."""
+    import jax
+
+    store = _Store()
+    servers = []
+    for topo in ("2x4", ""):
+        args = ServerArgs(engine="classifier", coordinator="(shared)",
+                          name=NAME, listen_addr="127.0.0.1",
+                          mixer="collective_mixer",
+                          interval_sec=1e9, interval_count=1 << 30,
+                          mix_topology=topo)
+        s = EngineServer("classifier", CONF, args,
+                         coord=MemoryCoordinator(store))
+        s.start(0)
+        servers.append(s)
+    try:
+        from jubatus_tpu.client import ClassifierClient, Datum
+
+        c0 = ClassifierClient("127.0.0.1", servers[0].args.rpc_port, NAME)
+        c1 = ClassifierClient("127.0.0.1", servers[1].args.rpc_port, NAME)
+        for _ in range(4):
+            c0.train([["pos", Datum({"a": 1.0})]])
+            c1.train([["neg", Datum({"b": 1.0})]])
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        assert c0.do_mix() is True
+        st = next(iter(servers[0].get_status().values()))
+        assert st["mixer.fallback_rounds"] >= 1
+        assert st["mixer.collective_rounds"] == 0
+        rec = [r for r in servers[0].mixer.flight.snapshot()
+               if r.get("mode") == "collective" and not r.get("ok")]
+        assert rec and "prepare_not_viable" in rec[-1]["reason"], rec
+        # the fallback still produced a correct converged model
+        (r1,) = c1.classify([Datum({"a": 1.0})])
+        scores = dict(r1)
+        assert scores["pos"] > scores["neg"]
+        c0.close()
+        c1.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_representative_election_deterministic_and_degraded_stable():
+    """elect_representatives derives one front per host from the FULL
+    registered member list + topology alone — same inputs, same fronts,
+    regardless of round order or which members a degraded round lost —
+    and refuses fleets whose member count fits no tier layout."""
+    from jubatus_tpu.framework.collective_mixer import elect_representatives
+    from jubatus_tpu.parallel.mesh import host_topology
+
+    topo = host_topology(override="2x4")
+    names = [f"m{i}:920{i}" for i in range(8)]
+    # one process per (host, local) slot: group's first name fronts it
+    reps = elect_representatives(names, topo)
+    assert reps == {0: "m0:9200", 1: "m4:9204"}
+    # list order must not matter (a round that discovers members in a
+    # different order cannot reshuffle the wire)
+    assert elect_representatives(list(reversed(names)), topo) == reps
+    # a degraded round passes the SAME registered list (participation
+    # is not an input): election is identical
+    assert elect_representatives(names, topo) == reps
+    # one process per host (M local devices each)
+    assert elect_representatives(names[:2], topo) == \
+        {0: "m0:9200", 1: "m1:9201"}
+    # no viable layout -> empty (the same fleets that mismatch at
+    # prepare); flat -> empty
+    assert elect_representatives(names[:5], topo) == {}
+    assert elect_representatives(names, None) == {}
+
+
+def test_status_reports_topology_and_local_devices():
+    """jubactl-facing plumbing: get_status carries the resolved tier
+    shape and the runtime capabilities (local_devices + derived
+    topology) so a fleet is diagnosable BEFORE rounds fall back."""
+    store = _Store()
+    args = ServerArgs(engine="classifier", coordinator="(shared)",
+                      name=NAME, listen_addr="127.0.0.1",
+                      mixer="collective_mixer",
+                      interval_sec=1e9, interval_count=1 << 30,
+                      mix_topology="2x4")
+    srv = EngineServer("classifier", CONF, args,
+                       coord=MemoryCoordinator(store))
+    srv.start(0)
+    try:
+        st = srv.mixer.get_status()
+        assert st["mix_topology"] == "2x4"
+        assert st["mix_caps_local_devices"] == 8
+        assert st["mix_caps_topology"] == "1x8"
+        assert st["mix_caps_world"] == 1
+    finally:
+        srv.stop()
+
+
+def test_unresolvable_topology_degrades_to_flat():
+    """A member whose topology cannot resolve (flag asks for more
+    devices than the runtime has) must log, stay flat, and sign the
+    legacy format — its signature then mismatches correctly-resolved
+    hierarchical peers and the round routes to RPC, instead of the
+    member crashing at prepare."""
+    store = _Store()
+    args = ServerArgs(engine="classifier", coordinator="(shared)",
+                      name=NAME, listen_addr="127.0.0.1",
+                      mixer="collective_mixer",
+                      interval_sec=1e9, interval_count=1 << 30,
+                      mix_topology="64x64")
+    srv = EngineServer("classifier", CONF, args,
+                       coord=MemoryCoordinator(store))
+    srv.start(0)
+    try:
+        from jubatus_tpu.client import ClassifierClient, Datum
+
+        c = ClassifierClient("127.0.0.1", srv.args.rpc_port, NAME)
+        c.train([["pos", Datum({"a": 1.0})]])
+        _v, sig = srv.mixer.local_prepare("r-big", [])
+        srv.mixer.local_abort("r-big")
+        assert "|topo=" not in sig
+        assert srv.mixer.get_status()["mix_topology"] == "flat"
         c.close()
     finally:
         srv.stop()

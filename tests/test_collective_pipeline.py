@@ -298,6 +298,166 @@ def test_int8_residual_resets_on_plan_change():
     assert len(ef.contrib) != n_keys or ef.key is not None
 
 
+# -- hierarchical two-tier reduce (topology=) --------------------------------
+#
+# World of 1 with the conftest's 8 virtual CPU devices: an "HxM" override
+# regrids the local devices, so the two-tier reduce (intra-host psum,
+# lane-segmented inter-host ring, intra-host rebuild) runs for REAL over
+# the (host, local) mesh while the process total stays the identity —
+# every grouping must therefore be bit-identical to the flat path.
+
+@pytest.mark.parametrize("topo", ["1x1", "8x1", "1x8", "2x4", "4x2"])
+def test_hier_chunked_bit_identical_to_flat(topo):
+    diff = {
+        "w": RNG.normal(size=(3, 700_001)).astype(np.float32),
+        "b": RNG.normal(size=(64,)).astype(np.float32),
+    }
+    phases: dict = {}
+    flat = psum_pytree({k: v.copy() for k, v in diff.items()},
+                       chunk_mb=0.25)
+    hier = psum_pytree({k: v.copy() for k, v in diff.items()},
+                       chunk_mb=0.25, topology=topo, phases=phases)
+    assert phases["chunks"] > 1
+    assert phases["topo"] == topo
+    assert np.array_equal(hier["w"], flat["w"])
+    assert np.array_equal(hier["b"], flat["b"])
+    assert hier["w"].dtype == np.float32
+
+
+def test_hier_bf16_matches_flat_bf16():
+    """bf16 composes with the two-tier reduce: the cast happens after
+    the exact intra fold, and at world 1 (host sum == the input) the
+    values must equal the flat bf16 round trip bit-for-bit."""
+    diff = {"w": RNG.normal(size=(2, 300_000)).astype(np.float32)}
+    flat = psum_pytree({"w": diff["w"].copy()}, compress="bf16",
+                       chunk_mb=0.25)
+    hier = psum_pytree({"w": diff["w"].copy()}, compress="bf16",
+                       chunk_mb=0.25, topology="2x4")
+    assert np.array_equal(hier["w"], flat["w"])
+
+
+def test_hier_phase_keys_and_wire_per_host_model():
+    """Hierarchical phases stamp the tier split and the scaling gate's
+    key: ``wire_bytes_per_host`` follows the ring model — the chunked
+    payload crosses the inter-host wire 2(H-1)/H times per HOST (not per
+    device), so for one fleet size, fewer hosts on the wire = fewer
+    bytes per round in flight between hosts."""
+    elems = 1 << 18  # 1 MiB f32, exact multiple of every plan below
+    diff = {"w": np.ones((elems,), np.float32)}
+    per_host = {}
+    for topo in ("8x1", "4x2", "2x4"):
+        ph: dict = {}
+        psum_pytree(diff, chunk_mb=0.25, topology=topo, phases=ph)
+        for k in ("intra_ms", "inter_ms", "wire_bytes_per_host"):
+            assert k in ph and ph[k] >= 0, (k, ph)
+        h = int(topo.split("x")[0])
+        assert ph["wire_bytes_per_host"] == \
+            int(elems * 4 * 2 * (h - 1) / h), (topo, ph)
+        per_host[topo] = ph["wire_bytes_per_host"]
+    # grouping 8 lanes as 2 hosts x 4 devices vs 8 flat "hosts" cuts
+    # inter-host bytes per host by 1.75x; the TOTAL inter-host traffic
+    # (sum over hosts) falls 8*1.75 / 2*1.0 = 7x — >= the local factor 4
+    assert per_host["2x4"] < per_host["4x2"] < per_host["8x1"]
+    assert 8 * per_host["8x1"] >= 4 * (2 * per_host["2x4"])
+    # flat mode on a world of 1 ships nothing (no peer); the key exists
+    ph_flat: dict = {}
+    psum_pytree(diff, chunk_mb=0.25, phases=ph_flat)
+    assert ph_flat["topo"] == "flat"
+    assert ph_flat["wire_bytes_per_host"] == 0
+    assert ph_flat["intra_ms"] == 0.0
+
+
+def test_hier_small_leaves_stay_flat_and_exact():
+    """Leaves below the chunk threshold keep the flat batched
+    collective even in hierarchical mode (their wire share is noise);
+    values stay exact and the tier timings stay zero."""
+    diff = {"b": RNG.normal(size=(64,)).astype(np.float32),
+            "c": np.float32(3.0)}
+    ph: dict = {}
+    out = psum_pytree(diff, topology="2x4", phases=ph)
+    assert np.array_equal(out["b"], diff["b"])
+    assert float(out["c"]) == 3.0
+    assert ph["topo"] == "2x4"
+    assert ph["intra_ms"] == 0.0 and ph["chunks"] == 0
+
+
+def test_hier_int8_error_feedback_drift_gate():
+    """The EF telescoping survives the two-tier transport: residuals
+    correct the HOST sum (one chain per (host, lane) segment), and the
+    multi-round drift vs f32 stays bounded exactly like the flat gate —
+    while the no-feedback transport demonstrably random-walks."""
+    rng = np.random.default_rng(5)
+    shape = (2, 200_000)
+    rounds = 12
+    ef = ErrorFeedback()
+    s32 = np.zeros(shape, np.float32)
+    s8 = np.zeros(shape, np.float32)
+    s8n = np.zeros(shape, np.float32)
+    drift_ef = []
+    drift_noef = []
+    for _ in range(rounds):
+        x = {"w": rng.normal(size=shape).astype(np.float32)}
+        s32 += psum_pytree(x, chunk_mb=0.25)["w"]
+        s8 += psum_pytree(x, compress="int8", chunk_mb=0.25,
+                          feedback=ef, topology="2x4")["w"]
+        s8n += psum_pytree(x, compress="int8", chunk_mb=0.25,
+                           topology="2x4")["w"]
+        drift_ef.append(float(np.linalg.norm(s8 - s32)))
+        drift_noef.append(float(np.linalg.norm(s8n - s32)))
+    assert ef.rounds == rounds
+    assert drift_ef[-1] <= 1.5 * drift_ef[0], drift_ef
+    assert drift_noef[-1] > 1.5 * drift_noef[0], drift_noef
+    assert drift_noef[-1] > 2.0 * drift_ef[-1]
+
+
+def test_hier_int8_matches_flat_int8_on_first_round():
+    """Round 1 (no carried residual yet) of the hierarchical int8
+    transport quantizes the identical host totals the flat transport
+    does at world 1 — same blocks, same scales, bit-equal output."""
+    diff = {"w": RNG.normal(size=(2, 350_001)).astype(np.float32)}
+    flat = psum_pytree({"w": diff["w"].copy()}, compress="int8",
+                       chunk_mb=0.25)
+    hier = psum_pytree({"w": diff["w"].copy()}, compress="int8",
+                       chunk_mb=0.25, topology="1x8")
+    assert np.array_equal(hier["w"], flat["w"])
+
+
+def test_hier_int8_residual_resets_on_topology_change():
+    """The topology signature rides the EF plan key: regrouping the
+    fleet (or toggling flat<->hier) repositions every carried residual,
+    so the transport must reset instead of misapplying them."""
+    rng = np.random.default_rng(13)
+    x = {"w": rng.normal(size=(2, 200_000)).astype(np.float32)}
+    ef = ErrorFeedback()
+    psum_pytree(x, compress="int8", chunk_mb=0.25, feedback=ef,
+                topology="2x4")
+    key_24 = ef.key
+    assert ef.rounds == 1 and key_24 is not None
+    psum_pytree(x, compress="int8", chunk_mb=0.25, feedback=ef,
+                topology="4x2")
+    assert ef.key != key_24
+    assert ef.rounds == 2  # reset then committed under the new plan
+    psum_pytree(x, compress="int8", chunk_mb=0.25, feedback=ef)
+    assert ef.key != key_24  # flat keys differ from every topology
+
+
+def test_hier_prefer_device_and_device_resident_leaves():
+    host = RNG.normal(size=(2, 400_000)).astype(np.float32)
+    dev = {"w": jnp.asarray(host)}
+    out = psum_pytree(dev, chunk_mb=0.25, topology="2x4",
+                      prefer_device=True)
+    assert isinstance(out["w"], jax.Array)
+    assert np.array_equal(np.asarray(out["w"]), host)
+
+
+def test_hier_rejects_bad_topology():
+    diff = {"w": np.zeros((1 << 18,), np.float32)}
+    with pytest.raises(ValueError, match="topology"):
+        psum_pytree(diff, topology="junk")
+    with pytest.raises(ValueError, match="devices"):
+        psum_pytree(diff, topology="4x4")  # needs 16 of the 8
+
+
 def test_int8_device_resident_leaves_and_prefer_device():
     """The zero-staging jax.Array path rides the quantized transport
     too, and prefer_device hands device totals back."""

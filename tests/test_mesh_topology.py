@@ -1,0 +1,153 @@
+"""Two-tier topology model + host-major device ordering (parallel/mesh.py).
+
+The hierarchical mix (ISSUE 9) needs a topology the whole fleet agrees
+on: ``host_topology()`` groups devices host-major into N hosts x M local
+devices, ``host_mesh()`` is its 2-D (host, local) mesh, and the
+pre-existing 1-D/2-D mesh builders must order devices host-major too —
+``jax.devices()`` order is backend-defined and can interleave hosts, and
+a mesh axis built over the interleaved order would put a "local" slice
+across the network.
+"""
+
+from __future__ import annotations
+
+import jax
+import pytest
+
+from jubatus_tpu.parallel.mesh import (
+    HostTopology,
+    grid_mesh,
+    host_major,
+    host_mesh,
+    host_topology,
+    replica_mesh,
+)
+
+
+class _FakeDevice:
+    """Hashable stand-in (jax.sharding.Mesh keys on the device tuple)."""
+
+    def __init__(self, proc: int, dev_id: int):
+        self.process_index = proc
+        self.id = dev_id
+
+    def __repr__(self):
+        return f"fake(p{self.process_index}/d{self.id})"
+
+
+def _fake(proc: int, dev_id: int):
+    return _FakeDevice(proc, dev_id)
+
+
+def _interleaved(hosts: int, per_host: int):
+    """The pathological jax.devices() order: round-robin across hosts
+    (device 0 of every host first) — a flat 'first M' slice spans every
+    host instead of one."""
+    return [_fake(p, p * per_host + i)
+            for i in range(per_host) for p in range(hosts)]
+
+
+# -- host_major ordering (the satellite regression) ---------------------------
+
+def test_host_major_groups_interleaved_hosts():
+    devs = _interleaved(2, 4)
+    ordered = host_major(devs)
+    assert [(d.process_index, d.id) for d in ordered] == \
+        [(0, 0), (0, 1), (0, 2), (0, 3), (1, 4), (1, 5), (1, 6), (1, 7)]
+
+
+def test_replica_mesh_is_host_major():
+    """replica_mesh over scrambled real devices must come out id-sorted
+    (all test devices share process 0): 'the first n devices' means the
+    first hosts' devices, never an interleaved sample."""
+    devs = list(reversed(jax.devices()))
+    mesh = replica_mesh(devices=devs)
+    ids = [d.id for d in mesh.devices.reshape(-1)]
+    assert ids == sorted(ids)
+
+
+def test_grid_mesh_is_host_major():
+    devs = list(reversed(jax.devices()))
+    mesh = grid_mesh(2, 4, devices=devs)
+    ids = [d.id for d in mesh.devices.reshape(-1)]
+    assert ids == sorted(ids)
+    assert mesh.shape == {"replica": 2, "shard": 4}
+
+
+def test_grid_mesh_shard_axis_stays_on_host():
+    """With 2 fake hosts x 4 devices handed over INTERLEAVED, each
+    replica row (whose trailing shard axis all-gathers constantly) must
+    land on ONE host — the regression that motivated host-major order."""
+    devs = _interleaved(2, 4)
+    mesh = grid_mesh(2, 4, devices=devs)
+    for row in mesh.devices:
+        assert len({d.process_index for d in row}) == 1
+
+
+# -- host_topology derivation -------------------------------------------------
+
+def test_host_topology_derived_groups_by_process():
+    topo = host_topology(devices=_interleaved(3, 2))
+    assert (topo.hosts, topo.locals) == (3, 2)
+    assert topo.signature == "3x2"
+    assert topo.source == "derived"
+    for h, row in enumerate(topo.grid):
+        assert [d.process_index for d in row] == [h, h]
+
+
+def test_host_topology_nonuniform_degrades_to_one_per_host():
+    devs = [_fake(0, 0), _fake(0, 1), _fake(1, 2)]  # ragged: 2 + 1
+    topo = host_topology(devices=devs)
+    assert (topo.hosts, topo.locals) == (2, 1)
+    assert topo.source == "nonuniform"
+
+
+def test_host_topology_override_single_process_regrid():
+    """Single-process worlds regrid their local devices — the virtual
+    8-device CPU test world exercising real two-tier collectives."""
+    topo = host_topology(override="2x4")
+    assert (topo.hosts, topo.locals) == (2, 4)
+    assert topo.source == "override"
+    assert topo.signature == "2x4"
+    assert not topo.trivial
+    flat = [d for row in topo.grid for d in row]
+    assert len(flat) == 8 and len(set(flat)) == 8
+    # tuple form resolves identically
+    assert host_topology(override=(2, 4)).signature == "2x4"
+
+
+def test_host_topology_override_multi_process_groups_processes():
+    """With >1 process the participants are one device per process and
+    HxM must tile the process count (co-located processes per host)."""
+    devs = [_fake(p, 10 + p) for p in range(4)]
+    topo = host_topology(devices=devs, override="2x2")
+    assert (topo.hosts, topo.locals) == (2, 2)
+    assert [[d.process_index for d in row] for row in topo.grid] == \
+        [[0, 1], [2, 3]]
+    with pytest.raises(ValueError, match="processes"):
+        host_topology(devices=devs, override="3x2")
+
+
+def test_host_topology_rejects_bad_specs():
+    # NOTE: "" is not an error — it is the flat sentinel (_norm_topology)
+    for bad in ("3x", "x3", "junk", "0x2", "2x0"):
+        with pytest.raises(ValueError):
+            host_topology(override=bad)
+    with pytest.raises(ValueError, match="devices"):
+        host_topology(override="4x4")  # needs 16, world has 8
+
+
+def test_trivial_topology():
+    assert HostTopology(1, 1, ((None,),)).trivial
+    assert not HostTopology(2, 1, ((None,), (None,))).trivial
+
+
+# -- host_mesh ----------------------------------------------------------------
+
+def test_host_mesh_axes_and_shape():
+    mesh = host_mesh(override="2x4")
+    assert mesh.axis_names == ("host", "local")
+    assert mesh.shape == {"host": 2, "local": 4}
+    # rows are the topology's rows, host-major
+    ids = [d.id for d in mesh.devices.reshape(-1)]
+    assert ids == sorted(ids)

@@ -43,13 +43,34 @@ from typing import Any, Dict, List, Optional, Tuple
 
 DEFAULT_TOLERANCE = 0.05
 
-#: key patterns whose larger values are better
+#: key patterns whose larger values are better (checked before _LOWER:
+#: a wire REDUCTION factor beats the _per_host substring it contains)
 _HIGHER = re.compile(
-    r"(_per_sec($|_)|samples_per_sec|_speedup($|_)|_fraction($|_))")
-#: key patterns whose smaller values are better
+    r"(_per_sec($|_)|samples_per_sec|_speedup($|_)|_fraction($|_)"
+    r"|_reduction($|_))")
+#: key patterns whose smaller values are better. ``_per_host`` covers
+#: the hierarchical-mix scaling plane (ISSUE 9): wire bytes each host
+#: ships per round — the quantity the two-tier reduce holds down, so
+#: growth is a regression exactly like a latency
 _LOWER = re.compile(
-    r"(_ms($|_)|_ratio($|_)|wire_mb|drift|_error(s)?($|_)|_timeouts"
-    r"|_errors_total|_denials)")
+    r"(_ms($|_)|_ratio($|_)|wire_mb|_per_host($|_)|drift"
+    r"|_error(s)?($|_)|_timeouts|_errors_total|_denials)")
+
+#: built-in per-key tolerance defaults (explicit --key-tolerance wins):
+#: the nproc16 sweep time-slices 16 gloo processes over however few
+#: cores the box has, so its WALL times swing far beyond the 5% default
+#: on pure scheduler noise — its wire-byte keys are arithmetic and keep
+#: the tight gate
+_DEFAULT_KEY_TOL: List[Tuple[re.Pattern, float]] = [
+    (re.compile(r"_ms_nproc16($|_)"), 0.30),
+]
+
+
+def default_tolerance_for(key: str, fallback: float) -> float:
+    for pat, tol in _DEFAULT_KEY_TOL:
+        if pat.search(key):
+            return tol
+    return fallback
 #: boolean gates: True -> False is a regression
 _BOOL_GATE = re.compile(r"(_ok($|_)|_target($|_))")
 
@@ -101,7 +122,9 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
                          "verdict": "added" if o is None else "removed"})
             continue
         d = direction(key)
-        tol = key_tolerance.get(key, tolerance)
+        tol = key_tolerance.get(key)
+        if tol is None:
+            tol = default_tolerance_for(key, tolerance)
         if not isinstance(o, (bool, int, float)) \
                 or not isinstance(n, (bool, int, float)):
             # defensive: callers may pass unflattened maps with string

@@ -1,6 +1,6 @@
 """Chunk-size sweep for the pipelined mix data plane.
 
-Boots one 4-process jax.distributed CPU world per chunk size and times
+Boots one jax.distributed CPU world per chunk size and times
 ``psum_pytree`` over a Criteo-shaped host diff (two [2, 2^23] f32 leaves
 = 128 MB payload per replica) in EVERY wire mode — f32, bf16, and the
 block-quantized int8 transport — printing a JSON dict of median round ms
@@ -8,7 +8,15 @@ per chunk size per mode. This is the recipe behind the DEFAULT_CHUNK_MB
 choice recorded in docs/PERF_NOTES.md ("Mix data plane" / "Quantized
 mix") — rerun it on a real chip to re-pick for ICI.
 
+``--topology NxM`` runs the sweep through the HIERARCHICAL two-tier
+reduce (ISSUE 9) instead of the flat ring: the world grows to N*M
+processes grouped N hosts x M co-located processes each, and every mode
+reports the per-tier split (``intra_ms``/``inter_ms``) plus
+``wire_bytes_per_host`` — re-picking the chunk size for the tiered
+pipeline, whose inter-host ring ships 1/M of each chunk per lane.
+
 Usage: python tools/bench_mix_chunk_sweep.py [dim_bits] [sizes_mb...]
+                                             [--topology NxM]
 """
 
 from __future__ import annotations
@@ -27,6 +35,7 @@ jax.config.update("jax_platforms", "cpu")
 pid = int(sys.argv[1]); n = int(sys.argv[2])
 jax_port = sys.argv[3]
 dim_bits = int(sys.argv[5]); chunk_mb = float(sys.argv[6])
+topo = sys.argv[7] if len(sys.argv) > 7 else "flat"
 from jubatus_tpu.parallel.multihost import enable_cpu_collectives
 enable_cpu_collectives()
 jax.distributed.initialize(f"127.0.0.1:{jax_port}", num_processes=n,
@@ -36,12 +45,13 @@ from jubatus_tpu.parallel.collective import ErrorFeedback, psum_pytree
 rng = np.random.default_rng(pid)
 diff = {"dw": rng.normal(size=(2, 1 << dim_bits)).astype(np.float32),
         "dprec": rng.normal(size=(2, 1 << dim_bits)).astype(np.float32)}
-rec = {"chunk_mb": chunk_mb}
+rec = {"chunk_mb": chunk_mb, "topo": topo}
+hier = {} if topo == "flat" else {"topology": topo}
 ef = ErrorFeedback()
 # every process runs the modes in the same order: the collective
 # sequences stay in lockstep without any coordination protocol
 for mode in ("off", "bf16", "int8"):
-    kw = {"feedback": ef} if mode == "int8" else {}
+    kw = dict(hier, **({"feedback": ef} if mode == "int8" else {}))
     phases = {}
     psum_pytree(diff, compress=mode, phases=phases, chunk_mb=chunk_mb,
                 **kw)  # warmup (compile)
@@ -62,20 +72,33 @@ for mode in ("off", "bf16", "int8"):
         "reduce_ms": phases.get("reduce_ms"),
         "readback_ms": phases.get("readback_ms"),
     }
+    if topo != "flat":
+        rec[tag]["intra_ms"] = phases.get("intra_ms")
+        rec[tag]["inter_ms"] = phases.get("inter_ms")
+        rec[tag]["wire_bytes_per_host"] = phases.get("wire_bytes_per_host")
 if pid == 0:
     print("SWEEP=" + json.dumps(rec), flush=True)
 print(f"CHILD-{pid}-DONE", flush=True)
 """
 
 
-def sweep(dim_bits: int = 23, sizes=(2.0, 4.0, 8.0, 16.0, 32.0, 4096.0)):
-    """4096 MB = never chunk: the serial single-collective reference."""
+def sweep(dim_bits: int = 23, sizes=(2.0, 4.0, 8.0, 16.0, 32.0, 4096.0),
+          topology: str = "flat"):
+    """4096 MB = never chunk: the serial single-collective reference.
+    ``topology`` != "flat" sizes the world to H*M processes and routes
+    every round through the two-tier reduce."""
     import bench_mix
 
+    if topology == "flat":
+        n = 4
+    else:
+        h, _, m = topology.partition("x")
+        n = int(h) * int(m)
     out = {}
     for mb in sizes:
         outs, rcs = bench_mix.run_jax_world(
-            _CHILD, 4, timeout=900, extra_args=(str(dim_bits), str(mb)))
+            _CHILD, n, timeout=900,
+            extra_args=(str(dim_bits), str(mb), topology))
         if any(rc != 0 for rc in rcs):
             out[f"chunk_{mb}mb"] = {"error": (''.join(outs))[-200:]}
             continue
@@ -86,8 +109,28 @@ def sweep(dim_bits: int = 23, sizes=(2.0, 4.0, 8.0, 16.0, 32.0, 4096.0)):
     return out
 
 
-if __name__ == "__main__":
-    bits = int(sys.argv[1]) if len(sys.argv) > 1 else 23
-    sizes = tuple(float(s) for s in sys.argv[2:]) or \
+def _parse_argv(argv):
+    topology = "flat"
+    rest = []
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--topology":
+            if i + 1 >= len(argv):
+                raise SystemExit("--topology needs an NxM value")
+            topology = argv[i + 1]
+            i += 2
+        elif argv[i].startswith("--topology="):
+            topology = argv[i].split("=", 1)[1]
+            i += 1
+        else:
+            rest.append(argv[i])
+            i += 1
+    bits = int(rest[0]) if rest else 23
+    sizes = tuple(float(s) for s in rest[1:]) or \
         (2.0, 4.0, 8.0, 16.0, 32.0, 4096.0)
-    print(json.dumps(sweep(bits, sizes), indent=1))
+    return bits, sizes, topology
+
+
+if __name__ == "__main__":
+    bits, sizes, topology = _parse_argv(sys.argv[1:])
+    print(json.dumps(sweep(bits, sizes, topology), indent=1))
