@@ -667,6 +667,351 @@ def run_proxy(transport: str = "python",
     return out
 
 
+#: churn-tolerant load generator (elastic membership, ISSUE 10): counts
+#: per-call errors instead of dying on the first one, and reconnects
+#: when the proxy drops the connection — the churn bench measures the
+#: CLUSTER's error behavior, so the client must survive to report it
+_CHURN_CLIENT_PROG = r"""
+import os, socket, sys, time
+import numpy as np
+import msgpack
+port, call_batch, k, warmup, measure, workload = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
+    float(sys.argv[4]), float(sys.argv[5]), sys.argv[6])
+from jubatus_tpu.client import Datum
+rng = np.random.default_rng(os.getpid())
+
+def mk_datum():
+    return Datum({f"f{j}": float(v)
+                  for j, v in enumerate(rng.normal(size=k))})
+
+frames = []
+for _ in range(8):
+    batch = []
+    for _ in range(call_batch):
+        label = "a" if rng.random() < 0.5 else "b"
+        batch.append([label, mk_datum().to_msgpack()])
+    if workload == "classify":
+        frames.append(msgpack.packb(
+            [0, 1, "classify", ["bench", [d for _l, d in batch]]],
+            use_bin_type=True))
+    else:
+        frames.append(msgpack.packb([0, 1, "train", ["bench", batch]],
+                                    use_bin_type=True))
+
+sock = None
+unp = msgpack.Unpacker()
+def connect():
+    global sock, unp
+    if sock is not None:
+        try: sock.close()
+        except OSError: pass
+    sock = socket.create_connection(("127.0.0.1", port), timeout=30.0)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    unp = msgpack.Unpacker()
+connect()
+
+errors = 0
+def call(frame):
+    # one call in flight (no pipelining: per-call error accounting)
+    global errors
+    try:
+        sock.sendall(frame)
+        while True:
+            try:
+                msg = unp.unpack()
+                break
+            except msgpack.OutOfData:
+                pass
+            data = sock.recv(65536)
+            if not data:
+                raise ConnectionError("closed")
+            unp.feed(data)
+        if msg[2] is not None:
+            errors += 1
+        return True
+    except (OSError, ConnectionError):
+        errors += 1
+        for _ in range(20):
+            try:
+                connect()
+                return False
+            except OSError:
+                time.sleep(0.25)
+        raise
+
+deadline_warm = time.perf_counter() + warmup
+i = 0
+while time.perf_counter() < deadline_warm:
+    call(frames[i % len(frames)]); i += 1
+count = 0
+errors = 0  # steady-state accounting only
+t0 = time.perf_counter()
+deadline = t0 + measure
+while time.perf_counter() < deadline:
+    if call(frames[i % len(frames)]):
+        count += call_batch
+    i += 1
+elapsed = time.perf_counter() - t0
+print(f"CHURNCLIENT {workload} {count} {errors} {elapsed:.4f}")
+"""
+
+
+def run_churn(transport: str = "python", measure: float = 60.0,
+              churn_period: float = 30.0, backends: int = 3) -> dict:
+    """Churn chaos bench (elastic membership, ISSUE 10): 16 mixed
+    clients (8 train / 8 classify) against a proxy over ``backends``
+    classifier servers while a churn thread KILLS one backend and boots
+    a replacement every ``churn_period`` seconds.
+
+    Keys of record:
+
+    - ``e2e_churn_mixed_error``  — error fraction of IDEMPOTENT
+      (classify) traffic during churn; the breaker/failover/ring-refresh
+      planes must hold it ~0.
+    - ``e2e_churn_train_error``  — error fraction of effectful traffic
+      (bounded, not zero: a train in flight on the killed socket cannot
+      be blindly re-forwarded).
+    - ``e2e_churn_p99_inflation_ratio`` — churn-window p99 over the
+      quiescent p99 measured first on the same topology (max over
+      train/classify at the proxy hop).
+    - ``e2e_churn_epoch`` — final membership epoch (join/leave count).
+    """
+    import numpy as _np
+
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+
+    prev = os.environ.get("JUBATUS_TPU_NATIVE_RPC")
+    os.environ["JUBATUS_TPU_NATIVE_RPC"] = \
+        "1" if transport == "native" else "0"
+    store = _Store()
+
+    def boot():
+        srv = EngineServer(
+            "classifier", CONF,
+            args=ServerArgs(engine="classifier", coordinator="(shared)",
+                            name="bench", listen_addr="127.0.0.1",
+                            thread=8, interval_sec=1e9,
+                            interval_count=1 << 30),
+            coord=MemoryCoordinator(store))
+        srv.start(0)
+        return srv
+
+    servers = []
+    proxy = None
+    procs = []
+    stop_churn = threading.Event()
+    churn_events = [0]
+    try:
+        servers = [boot() for _ in range(backends)]
+        proxy = Proxy(ProxyArgs(engine="classifier", listen_addr="127.0.0.1",
+                                thread=N_CLIENTS,
+                                interconnect_timeout=120.0),
+                      coord=MemoryCoordinator(store))
+        pport = proxy.start(0)
+        if prev is None:
+            os.environ.pop("JUBATUS_TPU_NATIVE_RPC", None)
+        else:
+            os.environ["JUBATUS_TPU_NATIVE_RPC"] = prev
+
+        def churn_loop():
+            rng = _np.random.default_rng(0)
+            while not stop_churn.wait(churn_period):
+                victim_i = int(rng.integers(len(servers)))
+                victim = servers[victim_i]
+                victim.stop()  # hard kill: ephemeral regs vanish
+                churn_events[0] += 1
+                if stop_churn.wait(2.0):  # let breakers/refresh react
+                    return
+                servers[victim_i] = boot()
+                churn_events[0] += 1
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        from bench_mix import scrub_child_env
+
+        env = scrub_child_env(os.environ)
+        # phase 1 (quiescent): same topology, no churn — the p99
+        # baseline the inflation ratio divides by
+        quiet_measure = max(measure / 3.0, 10.0)
+        wl_list = ["numeric" if i % 2 == 0 else "classify"
+                   for i in range(N_CLIENTS)]
+
+        def load(seconds):
+            ps = [subprocess.Popen(
+                [sys.executable, "-c", _CHURN_CLIENT_PROG, str(pport),
+                 str(CALL_BATCH), str(K), str(WARMUP_SECONDS / 2),
+                 str(seconds), wl],
+                env=env, cwd=repo, stdout=subprocess.PIPE, text=True)
+                for wl in wl_list]
+            procs.extend(ps)
+            # quantile hygiene (same stance as run()): drop the clients'
+            # warmup window (compiles, cold sockets) from the phase's
+            # histograms so quiet-vs-churn p99 compares steady states
+            rt = threading.Timer(WARMUP_SECONDS / 2 + 1.0,
+                                 proxy.rpc.trace.reset)
+            rt.daemon = True
+            rt.start()
+            counts = {"numeric": 0, "classify": 0}
+            errs = {"numeric": 0, "classify": 0}
+            calls = {"numeric": 0, "classify": 0}
+            elapsed = 0.0
+            for p in ps:
+                out, _ = p.communicate(timeout=seconds + 300)
+                for line in out.splitlines():
+                    if line.startswith("CHURNCLIENT "):
+                        _, wl, cnt, er, el = line.split()
+                        counts[wl] += int(cnt)
+                        errs[wl] += int(er)
+                        calls[wl] += int(cnt) // CALL_BATCH + int(er)
+                        elapsed = max(elapsed, float(el))
+            return counts, errs, calls, elapsed
+
+        proxy.rpc.trace.reset()
+        load(quiet_measure)
+        quiet = proxy.rpc.trace.trace_status()
+        # phase 2 (churn): kill/boot cycle under the same load
+        proxy.rpc.trace.reset()
+        churner = threading.Thread(target=churn_loop, daemon=True,
+                                   name="churn")
+        churner.start()
+        counts, errs, calls, elapsed = load(measure)
+        stop_churn.set()
+        churner.join(timeout=10.0)
+        churned = proxy.rpc.trace.trace_status()
+    finally:
+        stop_churn.set()
+        if prev is None:
+            os.environ.pop("JUBATUS_TPU_NATIVE_RPC", None)
+        else:
+            os.environ["JUBATUS_TPU_NATIVE_RPC"] = prev
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if proxy is not None:
+            proxy.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+    out = {
+        "e2e_churn_events": churn_events[0],
+        "e2e_churn_mixed_error": round(
+            errs["classify"] / max(calls["classify"], 1), 6),
+        "e2e_churn_train_error": round(
+            errs["numeric"] / max(calls["numeric"], 1), 6),
+        "e2e_churn_mixed_samples_per_sec": round(
+            (counts["numeric"] + counts["classify"]) / elapsed, 1)
+        if elapsed else 0.0,
+    }
+    from jubatus_tpu.coord.memory import MemoryCoordinator as _MC
+
+    from jubatus_tpu.coord import membership as _membership
+
+    out["e2e_churn_epoch"] = _membership.get_epoch(
+        _MC(store), "classifier", "bench")
+    ratios = []
+    for m in ("train", "classify"):
+        q = quiet.get(f"trace.rpc.{m}.p99_ms")
+        c = churned.get(f"trace.rpc.{m}.p99_ms")
+        if q and c:
+            out[f"e2e_churn_rpc_{m}_p99_ms"] = c
+            ratios.append(c / q)
+    if ratios:
+        out["e2e_churn_p99_inflation_ratio"] = round(max(ratios), 3)
+        out["e2e_churn_p99_inflation_ok"] = bool(max(ratios) <= 3.0)
+    return out
+
+
+def run_migration_cycle(rows: int = 2000) -> dict:
+    """Join -> migrate -> drain cycle on a nearest_neighbor cluster
+    (elastic membership, ISSUE 10): measures the state-migration data
+    plane's throughput and proves row parity across a full membership
+    cycle.
+
+    - ``e2e_migration_mb_per_sec`` — chunked double-buffered pull rate
+      (framework/migration.py RangePuller) for a fresh joiner.
+    - ``e2e_churn_rows_lost`` — rows missing from the union of
+      survivors after join + drain (MUST be 0).
+    """
+    import numpy as _np
+
+    from jubatus_tpu.client import Datum as _Datum
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    conf = {"method": "lsh", "parameter": {"hash_num": 64},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    store = _Store()
+
+    def boot(auto=True):
+        srv = EngineServer(
+            "nearest_neighbor", conf,
+            args=ServerArgs(engine="nearest_neighbor",
+                            coordinator="(shared)", name="nn",
+                            listen_addr="127.0.0.1", thread=4,
+                            interval_sec=1e9, interval_count=1 << 30,
+                            auto_rebalance=auto),
+            coord=MemoryCoordinator(store))
+        srv.start(0)
+        return srv
+
+    servers = [boot(), boot()]
+    out: dict = {}
+    try:
+        rng = _np.random.default_rng(7)
+        clients = [RpcClient("127.0.0.1", s.args.rpc_port)
+                   for s in servers]
+        for i in range(rows):
+            d = _Datum({f"f{j}": float(v)
+                        for j, v in enumerate(rng.normal(size=16))})
+            clients[i % 2].call("set_row", "nn", f"row{i:06d}",
+                                d.to_msgpack())
+        # join cold, then a measured explicit rebalance = the migration
+        # data plane's number of record
+        joiner = boot(auto=False)
+        servers.append(joiner)
+        jc = RpcClient("127.0.0.1", joiner.args.rpc_port)
+        pull = jc.call("rebalance", "nn")
+        out["e2e_migration_mb_per_sec"] = float(pull.get("mb_per_sec", 0.0))
+        out["e2e_migration_rows_pulled"] = int(pull.get("rows", 0))
+        # drain the first server; every row must survive on the union
+        clients[0].call("drain", "nn", False)
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            st = clients[0].call("drain_status", "nn")
+            state = st.get("state")
+            state = state.decode() if isinstance(state, bytes) else state
+            if state == "drained":
+                break
+            time.sleep(0.2)
+        survivors = set()
+        for s in servers[1:]:
+            c = RpcClient("127.0.0.1", s.args.rpc_port)
+            for rid in c.call("get_all_rows", "nn"):
+                survivors.add(rid.decode()
+                              if isinstance(rid, bytes) else rid)
+            c.close()
+        expect = {f"row{i:06d}" for i in range(rows)}
+        out["e2e_churn_rows_total"] = rows
+        out["e2e_churn_rows_lost"] = len(expect - survivors)
+        for c in clients:
+            c.close()
+        jc.close()
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+    return out
+
+
 def collect(trials: int = 2) -> dict:
     """Alternate transports and keep each one's best trial: run-to-run
     spread through the device tunnel is ~±10% (host scheduling + tunnel
@@ -821,8 +1166,30 @@ def collect(trials: int = 2) -> dict:
         out["e2e_proxy_vs_direct_note"] = (
             f"median of {len(proxy_runs)} proxy vs "
             f"{len(ratio_direct_runs)} direct runs, adjacent alternation")
+    # elastic membership (ISSUE 10): the churn chaos bench (kill/add one
+    # of N backends under the 16-client mixed load) + the join/migrate/
+    # drain row-parity cycle
+    try:
+        out.update(run_churn(text_tr))
+    except Exception as e:  # noqa: BLE001
+        out["e2e_churn_error"] = repr(e)[:200]
+    try:
+        out.update(run_migration_cycle())
+    except Exception as e:  # noqa: BLE001
+        out["e2e_migration_error"] = repr(e)[:200]
     return out
 
 
 if __name__ == "__main__":
-    print(json.dumps(collect(), indent=1))
+    if len(sys.argv) > 1 and sys.argv[1] == "churn":
+        # the elastic-membership slice on its own (kill/add cycle +
+        # join/migrate/drain parity), for churn iteration without the
+        # full bench's half hour
+        out = {}
+        out.update(run_churn("python",
+                             measure=float(sys.argv[2])
+                             if len(sys.argv) > 2 else 60.0))
+        out.update(run_migration_cycle())
+        print(json.dumps(out, indent=1))
+    else:
+        print(json.dumps(collect(), indent=1))

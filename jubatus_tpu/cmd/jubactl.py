@@ -52,7 +52,7 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("-c", "--cmd", required=True,
                    choices=["start", "stop", "save", "load", "status",
                             "metrics", "breakers", "trace", "alerts",
-                            "watch", "profile"])
+                            "watch", "profile", "drain", "rebalance"])
     p.add_argument("trace_id", nargs="?", default="",
                    help="[trace] trace id to assemble (from a slow-log "
                         "record, a /metrics exemplar, or "
@@ -82,6 +82,16 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--device-seconds", type=float, default=0.0,
                    help="[profile --device] capture duration in seconds "
                         "(0 = just list existing artifacts)")
+    p.add_argument("--target", default="",
+                   help="[drain] the member to drain, as IP_PORT (a node "
+                        "name from -c status)")
+    p.add_argument("--stop", action="store_true",
+                   help="[drain] also unregister the member's nodes/ "
+                        "entry when drained, firing its suicide watcher "
+                        "(the process exits); default leaves it running "
+                        "drained for inspection")
+    p.add_argument("--drain-timeout", type=float, default=120.0,
+                   help="[drain] seconds to wait for the drained state")
     p.add_argument("-s", "--server", default="",
                    help="server name forwarded to jubavisor "
                         "(jubaclassifier or plain engine name)")
@@ -169,10 +179,15 @@ def show_status(coord: Coordinator, engine: str, name: str,
                 show_all: bool = False) -> int:
     nodes = membership.get_all_nodes(coord, engine, name)
     actives = {n.name for n in membership.get_all_actives(coord, engine, name)}
-    print(f"{engine}/{name}: {len(nodes)} node(s), {len(actives)} active")
+    draining = {n.name for n in membership.get_draining(coord, engine, name)}
+    epoch = membership.get_epoch(coord, engine, name)
+    print(f"{engine}/{name}: {len(nodes)} node(s), {len(actives)} active, "
+          f"epoch {epoch}"
+          + (f", {len(draining)} draining" if draining else ""))
     rc = 0
     for node in nodes:
-        mark = "active" if node.name in actives else "standby"
+        mark = ("draining" if node.name in draining
+                else "active" if node.name in actives else "standby")
         print(f"  {node.name}  [{mark}]")
         if not show_all:
             continue
@@ -350,7 +365,12 @@ def collect_watch(coord: Coordinator, engine: str, name: str,
         coord, engine, name)}
     data: Dict[str, Any] = {"engine": engine, "name": name,
                             "window_s": window_s, "nodes": {},
-                            "proxies": {}, "actives": actives}
+                            "proxies": {}, "actives": actives,
+                            "epoch": membership.get_epoch(
+                                coord, engine, name),
+                            "draining": {n.name for n in
+                                         membership.get_draining(
+                                             coord, engine, name)}}
     for node in nodes:
         entry: Dict[str, Any] = {"error": ""}
         try:
@@ -383,7 +403,7 @@ def collect_watch(coord: Coordinator, engine: str, name: str,
 
 
 def _watch_node_row(node_name: str, entry: Dict[str, Any],
-                    active: bool) -> str:
+                    active: bool, draining: bool = False) -> str:
     if entry.get("error"):
         return (f"  {node_name:<22} {'DOWN':<9} "
                 f"<{entry['error'][:60]}>")
@@ -404,7 +424,8 @@ def _watch_node_row(node_name: str, entry: Dict[str, Any],
             if cname.endswith(".errors"):
                 err_s += win.counter_rate(cname)
     health = st.get("health.status", "?")
-    state = health if active else f"{health}/standby"
+    state = (f"{health}/drain" if draining
+             else health if active else f"{health}/standby")
     div = st.get("mixer.health_premix_divergence_mean",
                  st.get("mixer.health_premix_divergence"))
     stale = st.get("mixer.health_staleness_max",
@@ -434,15 +455,20 @@ def render_watch_frame(data: Dict[str, Any], ts: str = "") -> str:
     nodes = data.get("nodes") or {}
     proxies = data.get("proxies") or {}
     actives = data.get("actives") or set()
+    draining = data.get("draining") or set()
     lines.append(f"{data.get('engine')}/{data.get('name')}"
                  f"{'  ' + ts if ts else ''}  "
                  f"window {data.get('window_s', 0):g}s  "
-                 f"({len(nodes)} server(s), {len(proxies)} proxy(ies))")
+                 f"epoch {data.get('epoch', 0)}  "
+                 f"({len(nodes)} server(s), {len(proxies)} proxy(ies)"
+                 + (f", {len(draining)} draining" if draining else "")
+                 + ")")
     lines.append(f"  {'node':<22} {'state':<9} {'req/s':>8} {'err/s':>7}  "
                  f"{'p99 ms (span)':<22} {'mix health':<28} alerts")
     for node_name in sorted(nodes):
         lines.append(_watch_node_row(node_name, nodes[node_name],
-                                     node_name in actives))
+                                     node_name in actives,
+                                     node_name in draining))
     for pname in sorted(proxies):
         p = proxies[pname]
         if p.get("error"):
@@ -481,6 +507,88 @@ def show_watch(coord: Coordinator, engine: str, name: str, *,
             _time.sleep(max(interval, 0.2))
         except KeyboardInterrupt:
             return 0
+
+
+def drain_member(coord: Coordinator, engine: str, name: str, target: str,
+                 stop_after: bool = False, timeout: float = 120.0) -> int:
+    """Elastic membership (ISSUE 10): drive one member through the drain
+    state machine — stop routing new effectful work to it, finish
+    in-flight, hand its rows to the new ring owners, unregister — and
+    poll until ``drained`` (or the process exits, with ``--stop``)."""
+    import time as _time
+
+    if not target:
+        print("drain needs --target IP_PORT (a node name from -c status)",
+              file=sys.stderr)
+        return 1
+    try:
+        node = NodeInfo.from_name(target)
+    except (ValueError, IndexError):
+        print(f"bad --target {target!r}: expected IP_PORT", file=sys.stderr)
+        return 1
+    known = {n.name for n in membership.get_all_nodes(coord, engine, name)}
+    if node.name not in known:
+        print(f"{node.name} is not a registered member of {engine}/{name}",
+              file=sys.stderr)
+        return 1
+    print(f"draining {node.name} (stop_after={stop_after})...")
+    try:
+        with RpcClient(node.host, node.port, timeout=10.0) as c:
+            st = c.call("drain", name, bool(stop_after))
+    except Exception as e:  # noqa: BLE001 — report and fail
+        print(f"drain RPC failed: {e}", file=sys.stderr)
+        return -1
+    deadline = _time.monotonic() + max(timeout, 1.0)
+    while _time.monotonic() < deadline:
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                st = c.call("drain_status", name)
+        except Exception:  # noqa: BLE001 — with --stop the exit IS success
+            if stop_after:
+                print("member exited (drained + unregistered)")
+                return 0
+            raise
+        state = st.get("state")
+        state = state.decode() if isinstance(state, bytes) else state
+        if state == "drained":
+            print(f"drained: {st.get('rows_handed_off', 0)} row(s) "
+                  f"({st.get('bytes_handed_off', 0)} bytes) handed off, "
+                  f"epoch {st.get('epoch')}")
+            if st.get("error"):
+                print(f"  warning: {st['error']}", file=sys.stderr)
+            return 0
+        _time.sleep(0.5)
+    print(f"drain timed out in state {st!r}", file=sys.stderr)
+    return -1
+
+
+def rebalance_cluster(coord: Coordinator, engine: str, name: str) -> int:
+    """Ask every member to pull the rows it owns under the CURRENT ring
+    (the repair action after churn; safe to re-run — rows apply as
+    overwrites)."""
+    nodes = membership.get_all_nodes(coord, engine, name)
+    if not nodes:
+        print(f"no server of {engine}/{name}", file=sys.stderr)
+        return -1
+    rc = 0
+    total_rows = 0
+    for node in nodes:
+        print(f"rebalance {node.name}...", end="", flush=True)
+        try:
+            with RpcClient(node.host, node.port, timeout=600.0) as c:
+                out = c.call("rebalance", name)
+        except Exception as e:  # noqa: BLE001 — report per-host
+            print(f" failed. ({e})")
+            rc = -1
+            continue
+        rows = out.get("rows", 0)
+        total_rows += rows
+        print(f" ok: {rows} row(s), {out.get('mb_per_sec', 0.0)} MB/s"
+              + (f" (failed sources: {out.get('sources_failed')})"
+                 if out.get("sources_failed") else ""))
+    print(f"rebalance complete: {total_rows} row(s) moved, "
+          f"epoch {membership.get_epoch(coord, engine, name)}")
+    return rc
 
 
 def _proxies(coord: Coordinator) -> List[NodeInfo]:
@@ -714,6 +822,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         if ns.cmd == "watch":
             return show_watch(coord, ns.type, ns.name, once=ns.once,
                               interval=ns.interval, window_s=ns.window)
+        if ns.cmd == "drain":
+            return drain_member(coord, ns.type, ns.name, ns.target,
+                                stop_after=ns.stop,
+                                timeout=ns.drain_timeout)
+        if ns.cmd == "rebalance":
+            return rebalance_cluster(coord, ns.type, ns.name)
         if ns.cmd == "profile":
             return show_profile(coord, ns.type, ns.name,
                                 seconds=ns.seconds, folded=ns.folded,

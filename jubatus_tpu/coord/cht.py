@@ -29,9 +29,20 @@ def make_hash(key: str) -> str:
     return hashlib.md5(key.encode("utf-8")).hexdigest()
 
 
+def ring_key(members: Sequence[NodeInfo]) -> Tuple[str, ...]:
+    """Canonical identity of a ring: the sorted member names. Two member
+    lists with the same key build the IDENTICAL ring — the cache key for
+    the proxy/backend ring caches (elastic membership, ISSUE 10)."""
+    return tuple(sorted(m.name for m in members))
+
+
 class CHT:
-    def __init__(self, members: Sequence[NodeInfo]) -> None:
+    def __init__(self, members: Sequence[NodeInfo], epoch: int = 0) -> None:
         self.members = list(members)
+        #: membership epoch this ring was built from (0 = unknown/static).
+        #: Monotone across joins/leaves (coord/membership.py); consumers
+        #: treat ANY difference as "refresh", never as an ordering.
+        self.epoch = int(epoch)
         ring: List[Tuple[str, NodeInfo]] = []
         for m in self.members:
             for i in range(NUM_VSERV):
@@ -39,12 +50,17 @@ class CHT:
         ring.sort(key=lambda e: e[0])
         self._ring = ring
 
+    @property
+    def key(self) -> Tuple[str, ...]:
+        return ring_key(self.members)
+
     @classmethod
     def from_coordinator(
         cls, coord: Coordinator, engine: str, name: str, actives_only: bool = True
     ) -> "CHT":
         get = membership.get_all_actives if actives_only else membership.get_all_nodes
-        return cls(get(coord, engine, name))
+        return cls(get(coord, engine, name),
+                   epoch=membership.get_epoch(coord, engine, name))
 
     def find(self, key: str, n: int = 2) -> List[NodeInfo]:
         """n distinct successors of md5(key) on the ring (cht.cpp:107-143).

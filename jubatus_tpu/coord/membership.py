@@ -9,13 +9,30 @@ Same tree as the reference (membership.hpp:32-36, membership.cpp:59-66):
     /jubatus/config/<type>/<name>                       engine JSON config
     /jubatus/supervisors/<ip>_<port>                    jubavisor daemons
     /jubatus/jubaproxies/<ip>_<port>                    proxies
+
+Beyond the reference — elastic membership (ISSUE 10):
+
+    .../membership_epoch        monotone counter node (create_id bumps)
+    .../membership_epoch_value  readable mirror of the last minted epoch
+    .../draining/<ip>_<port>    members mid-drain (quorum excludes them)
+
+Every ACTUAL actives change (a create that created, a remove that
+removed) mints a new **membership epoch** through the coordinator's
+atomic counter — the ring version proxies and backends compare to decide
+whether their CHT view is current. The mirror node makes the epoch
+READABLE without bumping it; concurrent bumps may briefly publish the
+smaller value, which is harmless because every consumer treats ANY
+difference as "refresh the ring", never as an ordering.
 """
 
 from __future__ import annotations
 
+import logging
 from typing import List
 
 from jubatus_tpu.coord.base import Coordinator, NodeInfo
+
+log = logging.getLogger(__name__)
 
 JUBATUS_BASE = "/jubatus"
 ACTOR_BASE = f"{JUBATUS_BASE}/actors"
@@ -46,18 +63,90 @@ def register_active(
     coord: Coordinator, engine: str, name: str, host: str, port: int
 ) -> str:
     """Join the actives list (membership.cpp:115-145) — proxies route only
-    to actives; the mixer drives transitions on put_diff success/failure."""
+    to actives; the mixer drives transitions on put_diff success/failure.
+    An ACTUAL join (the node was not already active) mints a new
+    membership epoch — re-registration after every healthy put_diff
+    does not."""
     path = f"{actor_path(engine, name)}/actives/{NodeInfo(host, port).name}"
-    coord.create(path, ephemeral=True)
+    if coord.create(path, ephemeral=True):
+        bump_epoch(coord, engine, name)
     return path
 
 
 def unregister_active(
     coord: Coordinator, engine: str, name: str, host: str, port: int
 ) -> bool:
-    return coord.remove(
+    removed = coord.remove(
         f"{actor_path(engine, name)}/actives/{NodeInfo(host, port).name}"
     )
+    if removed:
+        bump_epoch(coord, engine, name)
+    return removed
+
+
+# -- membership epoch (elastic membership, ISSUE 10) --------------------------
+
+def epoch_path(engine: str, name: str) -> str:
+    return f"{actor_path(engine, name)}/membership_epoch"
+
+
+def bump_epoch(coord: Coordinator, engine: str, name: str) -> int:
+    """Mint the next membership epoch (coordinator-atomic counter) and
+    mirror it into the readable value node. Returns the minted epoch.
+    Failures are survivable — the epoch is a freshness signal, not a
+    correctness gate (consumers refresh on ANY mismatch)."""
+    path = epoch_path(engine, name)
+    try:
+        epoch = coord.create_id(path)
+    except Exception:  # broad-ok — a coord hiccup must not kill a join
+        log.warning("membership epoch bump failed for %s/%s", engine, name,
+                    exc_info=True)
+        return 0
+    try:
+        coord.set(f"{path}_value", str(epoch).encode())
+    except Exception:  # broad-ok — mirror is best-effort
+        log.debug("epoch mirror write failed", exc_info=True)
+    return epoch
+
+
+def get_epoch(coord: Coordinator, engine: str, name: str) -> int:
+    """Last published membership epoch (0 before the first join/leave)."""
+    try:
+        raw = coord.read(f"{epoch_path(engine, name)}_value")
+    except Exception:  # broad-ok — treated as "unknown", epoch 0
+        return 0
+    if not raw:
+        return 0
+    try:
+        return int(raw.decode())
+    except (ValueError, UnicodeDecodeError):
+        return 0
+
+
+# -- drain markers (elastic membership, ISSUE 10) -----------------------------
+
+def draining_path(engine: str, name: str) -> str:
+    return f"{actor_path(engine, name)}/draining"
+
+
+def mark_draining(coord: Coordinator, engine: str, name: str,
+                  host: str, port: int) -> str:
+    """Announce a member is draining: still booted (nodes/), no longer
+    routable or quorum-countable. Ephemeral — a drain that dies with its
+    process clears itself."""
+    path = f"{draining_path(engine, name)}/{NodeInfo(host, port).name}"
+    coord.create(path, ephemeral=True)
+    return path
+
+
+def clear_draining(coord: Coordinator, engine: str, name: str,
+                   host: str, port: int) -> bool:
+    return coord.remove(
+        f"{draining_path(engine, name)}/{NodeInfo(host, port).name}")
+
+
+def get_draining(coord: Coordinator, engine: str, name: str) -> List[NodeInfo]:
+    return _nodes_under(coord, draining_path(engine, name))
 
 
 def _nodes_under(coord: Coordinator, path: str) -> List[NodeInfo]:

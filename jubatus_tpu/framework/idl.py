@@ -221,6 +221,10 @@ IDEMPOTENT_BUILTINS: FrozenSet[str] = frozenset({
     "get_proxy_slow_log", "get_proxy_timeseries", "get_proxy_alerts",
     "get_breakers",
     "mix_get_schema", "mix_get_diff", "mix_get_model",
+    # elastic membership (ISSUE 10): epoch/drain/migration READS.
+    # migrate_range is a pure read on the SOURCE (the puller owns the
+    # cursor, so re-issuing a chunk fetch just re-reads the same rows)
+    "get_epoch", "drain_status", "migrate_range", "get_row_count",
 })
 
 #: effectful built-ins, listed for the docs' idempotency matrix (anything
@@ -228,6 +232,9 @@ IDEMPOTENT_BUILTINS: FrozenSet[str] = frozenset({
 EFFECTFUL_BUILTINS: FrozenSet[str] = frozenset({
     "save", "load", "clear", "do_mix", "mix_put_diff", "mix_sync_schema",
     "mix_prepare", "mix_abort",
+    # elastic membership (ISSUE 10): drain flips routing state,
+    # rebalance pulls rows in, put_rows writes rows
+    "drain", "rebalance", "put_rows",
 })
 
 

@@ -229,6 +229,18 @@ class RpcLinearCommunication(LinearCommunication):
 
     def update_members(self) -> List[NodeInfo]:
         self._members = membership.get_all_nodes(self.coord, self.engine, self.name)
+        # elastic membership (ISSUE 10): draining members are mid-exit —
+        # they stopped accepting effectful work and will unregister, so
+        # they must not count against the round's quorum denominator
+        # (the EPOCH's member set, not the booted-process set)
+        try:
+            draining = {m.name for m in membership.get_draining(
+                self.coord, self.engine, self.name)}
+        except Exception:  # broad-ok — a coord hiccup must not stop mix
+            draining = set()
+        if draining:
+            self._members = [m for m in self._members
+                             if m.name not in draining]
         if self._members:
             hosts = self._hosts()
             if self._mc is None:
@@ -237,6 +249,13 @@ class RpcLinearCommunication(LinearCommunication):
             else:
                 self._mc.set_hosts(hosts)
         return self._members
+
+    def membership_epoch(self) -> int:
+        """The ring version this round's member set was read under."""
+        try:
+            return membership.get_epoch(self.coord, self.engine, self.name)
+        except Exception:  # broad-ok
+            return 0
 
     def _lock_path(self) -> str:
         return f"{membership.actor_path(self.engine, self.name)}/master_lock"
@@ -673,10 +692,18 @@ class RpcLinearMixer:
             self.mix_count, len(members), len(packed), time.monotonic() - t0,
         )
         self.last_round_degraded = bool(degraded)
+        # elastic membership (ISSUE 10): stamp the ring version the
+        # round's member set (and therefore its quorum denominator) was
+        # read under — churn forensics read it off the flight record
+        epoch = self.comm.membership_epoch() \
+            if hasattr(self.comm, "membership_epoch") else 0
+        if epoch:
+            self.trace.gauge("mix.epoch", float(epoch))
         return {"members": len(members), "bytes": len(packed),
                 "mode": "rpc", "phases": phases,
                 "contributors": len(payloads),
                 "degraded": True if degraded else None,
+                "epoch": epoch or None,
                 "health": health or None,
                 "acked": sum(bool(v) for v in acks.values())}
 

@@ -345,3 +345,56 @@ class NNBackend:
             vec = [(int(i), float(v)) for i, v in zip(ii, vv)]
             self.set_row(rid, vec, datum=datum)
         self.store.updated_since_mix = {}
+
+
+class NNRowMigration:
+    """Row-migration driver hooks (elastic membership, ISSUE 10) shared
+    by the NNBackend-based engines (nearest_neighbor, recommender).
+
+    Wire row shape: ``[id, idx_list, val_list, datum_msgpack_or_None]``
+    — the ALREADY-HASHED vector, so the destination applies without
+    re-converting (and without the source's converter state). Migrated
+    rows do NOT re-enter the next mix diff (they already live on their
+    owners); ``put_rows`` clears the update tracker for them.
+
+    Mixed into drivers that define ``self.backend`` (an NNBackend);
+    callers (server/base.py migrate_range / put_rows, the drain
+    handoff) hold the driver lock — the RLock makes the decorated
+    methods safe either way.
+    """
+
+    def row_ids(self) -> List[str]:
+        return self.backend.store.all_ids()
+
+    def get_rows(self, ids: Optional[List[str]] = None) -> List[list]:
+        store = self.backend.store
+        out: List[list] = []
+        for rid in (ids if ids is not None else store.all_ids()):
+            rid = rid.decode() if isinstance(rid, bytes) else rid
+            vec = store.get_row(rid)
+            if vec is None:
+                continue  # raced a concurrent remove/evict
+            datum = store.datums.get(rid)
+            out.append([rid, [i for i, _ in vec], [v for _, v in vec],
+                        datum.to_msgpack()
+                        if hasattr(datum, "to_msgpack") else None])
+        return out
+
+    def put_rows(self, rows: List[list]) -> int:
+        from jubatus_tpu.core.datum import Datum
+
+        n = 0
+        for row in rows or []:
+            rid = row[0]
+            rid = rid.decode() if isinstance(rid, bytes) else str(rid)
+            ii, vv = row[1], row[2]
+            datum = row[3] if len(row) > 3 else None
+            if datum is not None:
+                datum = Datum.from_msgpack(datum)
+            self.backend.set_row(
+                rid, [(int(i), float(v)) for i, v in zip(ii, vv)],
+                datum=datum)
+            # migrated rows are not "local updates" for the next mix
+            self.backend.store.updated_since_mix.pop(rid, None)
+            n += 1
+        return n

@@ -23,14 +23,15 @@ from typing import Any, Dict, List, Tuple
 from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.core.fv import make_fv_converter
 from jubatus_tpu.framework.driver import DriverBase, locked
-from jubatus_tpu.models._nn_backend import HASH_METHODS, NNBackend
+from jubatus_tpu.models._nn_backend import (HASH_METHODS, NNBackend,
+                                            NNRowMigration)
 
 
 class NearestNeighborConfigError(ValueError):
     pass
 
 
-class NearestNeighborDriver(DriverBase):
+class NearestNeighborDriver(NNRowMigration, DriverBase):
     TYPE = "nearest_neighbor"
 
     def __init__(self, config: dict, dim_bits: int = 18):

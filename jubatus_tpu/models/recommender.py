@@ -32,7 +32,7 @@ from jubatus_tpu.core.datum import Datum
 from jubatus_tpu.core.fv import make_fv_converter
 from jubatus_tpu.core.sparse import SparseVector
 from jubatus_tpu.framework.driver import DriverBase, locked
-from jubatus_tpu.models._nn_backend import NNBackend
+from jubatus_tpu.models._nn_backend import NNBackend, NNRowMigration
 
 METHODS = ("inverted_index", "inverted_index_euclid", "lsh", "minhash",
            "euclid_lsh", "nearest_neighbor_recommender")
@@ -45,7 +45,7 @@ class RecommenderConfigError(ValueError):
     pass
 
 
-class RecommenderDriver(DriverBase):
+class RecommenderDriver(NNRowMigration, DriverBase):
     TYPE = "recommender"
 
     def __init__(self, config: dict, dim_bits: int = 18):

@@ -27,6 +27,14 @@ ARGUMENT_ERROR = 2
 #: extension code: the server refused/abandoned the call because its
 #: deadline had already expired (rpc/deadline.py)
 DEADLINE_EXCEEDED_ERROR = 3
+#: extension codes (elastic membership, ISSUE 10): the server refused the
+#: call BEFORE applying it — because it is draining (4) or because the
+#: caller's ring view is from another membership epoch (5). Both are
+#: safe to re-route (nothing was applied) and both tell the caller to
+#: refresh its member/ring view first. Legacy peers see an unknown code
+#: and map it to a generic call error.
+NODE_DRAINING_ERROR = 4
+EPOCH_MISMATCH_ERROR = 5
 
 
 class RpcError(RuntimeError):
@@ -85,6 +93,35 @@ class BreakerOpen(RpcError):
         self.target = target
 
 
+class NodeDraining(RpcError):
+    """The target refused an effectful call at dispatch because it is
+    draining (elastic membership, ISSUE 10). The call was NEVER applied,
+    so re-routing it to another replica is safe even for effectful
+    methods — retryable, with a membership refresh first."""
+
+    retryable = True
+
+    def __init__(self, detail: str = "") -> None:
+        super().__init__(detail or "node draining")
+
+
+class EpochMismatch(RpcError):
+    """Caller and callee disagree on the membership epoch — the caller's
+    ring view is stale (or the callee's is). Rejected BEFORE any state
+    change: refresh the ring and re-route. Retryable for the same
+    reason as NodeDraining."""
+
+    retryable = True
+
+    def __init__(self, detail: str = "", expected: int = 0,
+                 got: int = 0) -> None:
+        super().__init__(
+            detail or f"membership epoch mismatch (mine {expected}, "
+                      f"caller {got})")
+        self.expected = expected
+        self.got = got
+
+
 class RpcNoResult(RpcError):
     """Fan-out completed but produced no usable result (≙ rpc_no_result)."""
 
@@ -129,6 +166,10 @@ def error_to_wire(exc: BaseException) -> Any:
         return ARGUMENT_ERROR
     if isinstance(exc, DeadlineExceeded):
         return DEADLINE_EXCEEDED_ERROR
+    if isinstance(exc, NodeDraining):
+        return NODE_DRAINING_ERROR
+    if isinstance(exc, EpochMismatch):
+        return EPOCH_MISMATCH_ERROR
     return str(exc)
 
 
@@ -140,4 +181,8 @@ def wire_to_error(err: Any, method: str = "") -> RpcError:
         return RpcTypeError(f"argument error calling {method}")
     if err == DEADLINE_EXCEEDED_ERROR:
         return DeadlineExceeded(f"{method}: deadline exceeded at server")
+    if err == NODE_DRAINING_ERROR:
+        return NodeDraining(f"{method}: node draining")
+    if err == EPOCH_MISMATCH_ERROR:
+        return EpochMismatch(f"{method}: membership epoch mismatch")
     return RpcCallError(f"{method}: {err!r}")

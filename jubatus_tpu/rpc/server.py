@@ -245,6 +245,12 @@ class RpcServer:
         self._pool: Optional[ThreadPoolExecutor] = None
         self._running = False
         self.port: Optional[int] = None
+        #: elastic-membership gate (ISSUE 10): called with the method
+        #: name before every dispatch; raising rejects the request
+        #: BEFORE any state change (the drain plane rejects effectful
+        #: methods with the retryable NodeDraining so proxies re-route).
+        #: Shared by both transports (NativeRpcServer borrows _invoke).
+        self.dispatch_gate: Optional[Callable[[str], None]] = None
 
     # -- method table (≙ rpc_server::add<T>) --------------------------------
     def register(self, name: str, fn: Callable[..., Any],
@@ -390,7 +396,9 @@ class RpcServer:
                     self._handle_raw(conn, wlock, raw, conn_state)
                 del buf[:msg_start - base]
                 base = msg_start
-        except (OSError, ValueError, struct.error):
+        # RuntimeError: pool.submit after stop() — a hard-killed server's
+        # surviving connection threads must die quietly, not traceback
+        except (OSError, ValueError, struct.error, RuntimeError):
             pass
         finally:
             try:
@@ -466,6 +474,9 @@ class RpcServer:
                 if faults.is_armed():
                     faults.fire(f"rpc.dispatch.{method}")
                 self._check_deadline(method)
+                gate = getattr(self, "dispatch_gate", None)
+                if gate is not None:
+                    gate(method)
                 result = fn(raw_params)
             except Exception as e:  # broad-ok — every failure must answer
                 log.debug("rpc raw method %s raised", method, exc_info=True)
@@ -548,6 +559,9 @@ class RpcServer:
         if faults.is_armed():
             faults.fire(f"rpc.dispatch.{method}")
         self._check_deadline(method)
+        gate = getattr(self, "dispatch_gate", None)
+        if gate is not None:
+            gate(method)
         with self.trace.span(f"rpc.{method}"):
             return fn(*params)
 

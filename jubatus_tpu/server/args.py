@@ -146,6 +146,15 @@ class ServerArgs:
     #: (once per window; 0 disables the tail trigger)
     profile_trigger_breaches: int = 3
     profile_trigger_window: float = 10.0
+    #: elastic membership (ISSUE 10): a joining replica automatically
+    #: streams its owned key ranges from the current owners (drivers
+    #: exposing the row-migration hooks only); disable to join cold and
+    #: repair later with ``jubactl -c rebalance``
+    auto_rebalance: bool = True
+    #: --drain-grace: seconds the drain state machine waits for
+    #: in-flight work (RPC workers + coalescer queues) after the
+    #: dispatch gate flips, before handing rows off
+    drain_grace: float = 1.0
 
     @property
     def is_standalone(self) -> bool:
@@ -338,6 +347,16 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
     p.add_argument("--profile-trigger-window", type=float, default=10.0,
                    help="breach-counting window (seconds) for the "
                         "tail-triggered profile snapshot")
+    p.add_argument("--no-auto-rebalance", dest="auto_rebalance",
+                   action="store_false",
+                   help="do NOT stream owned key ranges from the current "
+                        "owners on join (elastic membership): join cold "
+                        "and repair later with jubactl -c rebalance")
+    p.add_argument("--drain-grace", type=float, default=1.0,
+                   help="seconds the drain state machine waits for "
+                        "in-flight work after new effectful calls start "
+                        "being rejected, before handing rows off to the "
+                        "new ring owners")
     return p
 
 

@@ -128,6 +128,16 @@ class EngineServer:
             self.telemetry.hooks.append(self._model_health_tick)
         self._stop_event = threading.Event()
         self._stop_once = threading.Lock()  # first stop() wins; rest no-op
+        # elastic membership (ISSUE 10): migration counters + the drain
+        # state machine + a cached membership-epoch view (refreshed by
+        # the same actives watch that invalidates the CHT snapshot)
+        from jubatus_tpu.framework.migration import (DrainController,
+                                                     MigrationStats)
+
+        self.migration = MigrationStats(registry=self.rpc.trace)
+        self.drain_ctl = DrainController(
+            self, grace_sec=getattr(self.args, "drain_grace", 1.0))
+        self._epoch_cache: Optional[int] = None
         #: Prometheus /metrics + /healthz endpoint (--metrics-port >= 0)
         self.metrics = None
         #: pooled peer clients for server-side replicated writes
@@ -270,6 +280,135 @@ class EngineServer:
     def _invalidate_cht(self) -> None:
         with self._cht_lock:
             self._cht_cache = None
+            self._epoch_cache = None
+
+    # -- elastic membership (ISSUE 10) ---------------------------------------
+    def membership_epoch(self) -> int:
+        """Current membership epoch, cached alongside the CHT snapshot
+        (both invalidate on the same actives watch). Standalone: 0."""
+        if self.coord is None:
+            return 0
+        with self._cht_lock:
+            cached = self._epoch_cache
+        if cached is not None:
+            return cached
+        epoch = membership.get_epoch(self.coord, self.engine, self.args.name)
+        with self._cht_lock:
+            self._epoch_cache = epoch
+        self.rpc.trace.gauge("cluster.epoch", float(epoch))
+        return epoch
+
+    def get_epoch(self, _name: str = "") -> int:
+        # the CHT cache TTL (2 s) bounds staleness; a watch-driven
+        # invalidation makes it immediate
+        self.cluster_cht()
+        return self.membership_epoch()
+
+    def migrate_range(self, _name: str, epoch: int, target: str,
+                      cursor: str = "", limit: int = 0) -> Dict[str, Any]:
+        """SOURCE side of the state-migration plane: rows after
+        ``cursor`` that ``target`` owns under the current ring. The
+        caller's epoch must match mine — a mismatch is the retryable
+        ``EpochMismatch`` that forces a ring refresh on the puller
+        (framework/migration.py)."""
+        from jubatus_tpu.framework.migration import (DEFAULT_CHUNK_BYTES,
+                                                     serve_range)
+        from jubatus_tpu.rpc.errors import EpochMismatch
+
+        mine = self.get_epoch()
+        if int(epoch) != mine:
+            raise EpochMismatch(expected=mine, got=int(epoch))
+        target = target.decode() if isinstance(target, bytes) else str(target)
+        cursor = cursor.decode() if isinstance(cursor, bytes) else str(cursor)
+        ring = self.cluster_cht()
+        if ring is None:
+            return {"rows": [], "cursor": "", "done": True, "epoch": mine}
+        if target not in {m.name for m in ring.members}:
+            # the joiner may register between my watch ticks: extend the
+            # ring view rather than reject (same members → same ring)
+            from jubatus_tpu.coord.cht import CHT
+
+            try:
+                node = NodeInfo.from_name(target)
+            except (ValueError, IndexError):
+                return {"rows": [], "cursor": "", "done": True,
+                        "epoch": mine}
+            ring = CHT(list(ring.members) + [node], epoch=ring.epoch)
+        with self.driver.lock:
+            doc = serve_range(self.driver, ring, target, cursor,
+                              int(limit) or DEFAULT_CHUNK_BYTES)
+        doc["epoch"] = mine
+        return doc
+
+    def put_rows(self, _name: str, rows: Any) -> int:
+        """Apply migrated rows (already-hashed vectors — no reconvert).
+        Drivers without row hooks accept nothing (0)."""
+        if not hasattr(self.driver, "put_rows"):
+            return 0
+        with self.driver.lock:
+            n = int(self.driver.put_rows(rows or []))
+        return n
+
+    def get_row_count(self, _name: str = "") -> int:
+        if hasattr(self.driver, "row_ids"):
+            with self.driver.lock:
+                return len(self.driver.row_ids())
+        return 0
+
+    def drain(self, _name: str = "", stop_after: bool = False) -> Dict[str, Any]:
+        """Begin the drain state machine (framework/migration.py):
+        reject new effectful work (retryable ``NodeDraining`` — proxies
+        re-route), finish in-flight, hand rows to their new owners,
+        unregister. Idempotent; returns the current state doc."""
+        if self.coord is None:
+            return {"state": "active", "error": "standalone: nothing to drain"}
+        self.drain_ctl.start(stop_after=bool(stop_after))
+        return self.drain_status()
+
+    def drain_status(self, _name: str = "") -> Dict[str, Any]:
+        doc = self.drain_ctl.status()
+        doc["epoch"] = self.membership_epoch()
+        return doc
+
+    def rebalance(self, _name: str = "") -> Dict[str, Any]:
+        """Pull every row this member owns under the CURRENT ring from
+        the other actives — the joining member's half of the migration
+        plane (also the ``jubactl -c rebalance`` repair action). Safe to
+        re-run: rows apply as overwrites."""
+        if self.coord is None or not hasattr(self.driver, "put_rows"):
+            return {"rows": 0, "bytes": 0, "seconds": 0.0,
+                    "mb_per_sec": 0.0, "sources_failed": []}
+        from jubatus_tpu.framework.migration import RangePuller
+
+        me = self.self_nodeinfo()
+        sources = [m for m in membership.get_all_actives(
+            self.coord, self.engine, self.args.name) if m.name != me.name]
+        if not sources:
+            return {"rows": 0, "bytes": 0, "seconds": 0.0,
+                    "mb_per_sec": 0.0, "sources_failed": []}
+
+        def apply_rows(rows) -> int:
+            with self.driver.lock:
+                return int(self.driver.put_rows(rows))
+
+        puller = RangePuller(
+            self.args.name, me.name, apply_rows,
+            client_factory=self.peer_client, stats=self.migration,
+            epoch_of=lambda: self.get_epoch())
+        return puller.pull(sources)
+
+    def _join_migration(self) -> None:
+        """Background join-time pull: a freshly-registered replica
+        streams its owned ranges from the current owners. Best-effort —
+        a failed pull leaves the replica serving what the mix plane
+        replicates; ``jubactl -c rebalance`` repairs."""
+        try:
+            out = self.rebalance(self.args.name)
+            if out.get("rows"):
+                log.info("join migration: pulled %d rows (%.2f MB) in %.2fs",
+                         out["rows"], out["bytes"] / 2 ** 20, out["seconds"])
+        except Exception:  # broad-ok — join must not die on migration
+            log.warning("join migration failed", exc_info=True)
 
     # -- built-in RPCs (server_base.hpp:41-109, client.hpp:30-87) ------------
     def get_config(self, _name: str = "") -> str:
@@ -426,6 +565,9 @@ class EngineServer:
                                 "staleness": getattr(m, "self_staleness", 0)})
             if getattr(m, "collective_dead", False):
                 reasons.append({"kind": "collective_dead"})
+        if self.drain_ctl.state != "active":
+            reasons.append({"kind": "draining",
+                            "state": self.drain_ctl.state})
         return reasons
 
     def _health(self) -> Dict[str, Any]:
@@ -448,6 +590,14 @@ class EngineServer:
             doc["slo_firing"] = len(self.slo.alerts())
         if self.mixer is not None:
             doc["mix_count"] = getattr(self.mixer, "mix_count", 0)
+        # elastic membership (ISSUE 10): one glance says which ring
+        # version this node believes in and whether it is on the way out
+        doc["cluster_epoch"] = self.membership_epoch()
+        doc["drain_state"] = self.drain_ctl.state
+        mig = self.migration.snapshot()
+        if mig.get("active") or mig.get("rows_moved"):
+            doc["migration_rows_moved"] = mig["rows_moved"]
+            doc["migration_active"] = mig["active"]
         # profiler state (ISSUE 8): one glance says whether the sampler
         # is on and collecting (full stats live in get_status)
         pstats = self.profiler.stats()
@@ -519,6 +669,12 @@ class EngineServer:
         reasons = self._degraded_reasons()
         st["health.status"] = "degraded" if reasons else "ok"
         st["health.reasons"] = reasons
+        # elastic membership (ISSUE 10): ring version, drain state, and
+        # the migration plane's lifetime counters
+        st["cluster.epoch"] = self.membership_epoch()
+        st["drain.state"] = self.drain_ctl.state
+        st.update({f"migration.{k}": v
+                   for k, v in self.migration.snapshot().items()})
         if self.timeseries is not None:
             st.update({f"timeseries.{k}": v
                        for k, v in self.timeseries.stats().items()})
@@ -597,6 +753,15 @@ class EngineServer:
             if hasattr(self.driver, "set_assignment"):
                 self._install_assignment(node)
             self.mixer.start()
+            # elastic membership (ISSUE 10): a joining replica streams
+            # its owned key ranges from the current owners in the
+            # background (CHT-routed engines only — drivers exposing the
+            # row hooks). The proxy's double-dispatch window covers the
+            # in-between.
+            if getattr(self.args, "auto_rebalance", True) and \
+                    hasattr(self.driver, "put_rows"):
+                threading.Thread(target=self._join_migration,
+                                 daemon=True, name="join-migrate").start()
         log.info("%s server listening on %s:%d", self.engine,
                  self.args.bind_host, actual)
         return actual

@@ -46,7 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from jubatus_tpu.coord import create_coordinator, membership
 from jubatus_tpu.coord.base import Coordinator, NodeInfo
-from jubatus_tpu.coord.cht import CHT
+from jubatus_tpu.coord.cht import CHT, ring_key
 from jubatus_tpu.framework.idl import INTERNAL, get_service, idempotent_methods
 from jubatus_tpu.rpc import aggregators
 from jubatus_tpu.rpc import deadline as deadlines
@@ -54,8 +54,10 @@ from jubatus_tpu.rpc.breaker import BreakerBoard
 from jubatus_tpu.rpc.client import RpcClient
 from jubatus_tpu.rpc.errors import (
     DeadlineExceeded,
+    EpochMismatch,
     HostError,
     MultiRpcError,
+    NodeDraining,
     RpcIoError,
     RpcNoClient,
     RpcNoResult,
@@ -69,6 +71,76 @@ log = logging.getLogger(__name__)
 
 #: transport-level failures (a breaker's evidence; failover triggers)
 _TRANSPORT_ERRORS = (RpcIoError, RpcTimeoutError, faults.FaultInjected)
+
+#: membership-protocol rejections (elastic membership, ISSUE 10): the
+#: backend refused BEFORE applying anything (draining, or a ring-epoch
+#: disagreement). Safe to re-route even for EFFECTFUL calls — the fix is
+#: a membership refresh, not a backoff
+_MEMBERSHIP_ERRORS = (NodeDraining, EpochMismatch)
+
+
+def _membership_rejection(exc: BaseException) -> bool:
+    """True when ``exc`` is (or a fan-out whose every failure is) a
+    membership-protocol rejection — the caller should refresh its ring
+    and re-route."""
+    if isinstance(exc, _MEMBERSHIP_ERRORS):
+        return True
+    if isinstance(exc, MultiRpcError) and exc.errors:
+        return all(isinstance(e.cause, _MEMBERSHIP_ERRORS)
+                   for e in exc.errors)
+    return False
+
+
+class _RingCache:
+    """CHT snapshots per cluster, rebuilt ONLY when the member list
+    changes (the satellite fix for the per-request ``CHT(actives)``
+    rebuild: 8 MD5 hashes per member per call, pure hot-path tax).
+
+    Each entry remembers the PREVIOUS ring and when the swap happened:
+    for ``handoff_window`` seconds after a membership change the proxy
+    double-dispatches CHT-routed effectful calls to the union of old and
+    new owners, so no key ever has zero owners while rows migrate."""
+
+    def __init__(self, handoff_window: float = 15.0) -> None:
+        self.handoff_window = float(handoff_window)
+        self._lock = threading.Lock()
+        #: name -> (ring_key, ring, prev_ring_or_None, swap_monotonic)
+        self._entries: Dict[str, Tuple[Tuple[str, ...], CHT,
+                                       Optional[CHT], float]] = {}
+        self.builds = 0
+        self.hits = 0
+
+    def get(self, name: str, actives: Sequence[NodeInfo]
+            ) -> Tuple[CHT, Optional[CHT]]:
+        """(current ring, previous ring while inside the handoff
+        window — else None)."""
+        key = ring_key(actives)
+        now = time.monotonic()
+        with self._lock:
+            e = self._entries.get(name)
+            if e is not None and e[0] == key:
+                self.hits += 1
+                ring, prev, swapped = e[1], e[2], e[3]
+                if prev is not None and now - swapped > self.handoff_window:
+                    # window over: forget the old ring
+                    self._entries[name] = (key, ring, None, swapped)
+                    prev = None
+                return ring, prev
+        ring = CHT(actives)
+        with self._lock:
+            e = self._entries.get(name)
+            prev = e[1] if (e is not None and e[0] != key
+                            and e[1].members) else None
+            self._entries[name] = (key, ring, prev, now)
+            self.builds += 1
+        return ring, prev
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"builds": self.builds, "hits": self.hits,
+                    "clusters": len(self._entries),
+                    "in_handoff": sum(1 for e in self._entries.values()
+                                      if e[2] is not None)}
 
 
 @dataclasses.dataclass
@@ -124,6 +196,11 @@ class ProxyArgs:
     #: spans (same semantics as the engine servers)
     profile_trigger_breaches: int = 3
     profile_trigger_window: float = 10.0
+    #: --handoff-window: seconds after a membership change during which
+    #: CHT-routed EFFECTFUL calls double-dispatch to the union of old
+    #: and new ring owners (elastic membership: no key has zero owners
+    #: while rows migrate); idempotent reads fail over old->new instead
+    handoff_window: float = 15.0
 
     @property
     def bind_host(self) -> str:
@@ -256,6 +333,10 @@ class Proxy:
             registry=self.rpc.trace, counter_prefix="proxy.breaker")
         self.retry_budget = RetryBudget(ratio=args.retry_budget_ratio)
         self._idempotent = idempotent_methods(self.engine)
+        #: elastic membership (ISSUE 10): member-list-keyed ring cache
+        #: (no per-request CHT rebuild) + the double-dispatch window
+        self.rings = _RingCache(
+            handoff_window=getattr(args, "handoff_window", 15.0))
         #: C++ relay plane (native transport only): random-routed raw
         #: methods forward in rpc_frontend.cpp without entering Python;
         #: this side only keeps the routing table fresh (clusters seen ->
@@ -512,6 +593,13 @@ class Proxy:
                 self.forward_count += 1
             try:
                 return self._one(node, name, params)
+            except _MEMBERSHIP_ERRORS as e:
+                # pre-apply rejection (draining backend): move to the
+                # next replica regardless of idempotency — nothing was
+                # applied. Refresh so the NEXT request routes clean.
+                self._refresh_members(str(params[0]))
+                last = e
+                continue
             except _TRANSPORT_ERRORS as e:
                 with self._counters_lock:
                     self.forward_errors += 1
@@ -608,15 +696,50 @@ class Proxy:
             except Exception:  # broad-ok — next tick retries
                 log.debug("relay config push failed", exc_info=True)
 
+    def _route_cht(self, name: str, cht_n: int,
+                   reducer: Callable[[Any, Any], Any],
+                   cluster: str, actives: Sequence[NodeInfo],
+                   params: Sequence[Any]) -> Any:
+        """CHT routing over the CACHED ring (rebuilt only on membership
+        change). Inside the handoff window after a change:
+
+        - EFFECTFUL calls double-dispatch to the UNION of old and new
+          owners — no key has zero owners while rows migrate (the
+          per-host-failure tolerance of ``_fan`` means one dead old
+          owner cannot fail the call);
+        - IDEMPOTENT reads try new owners first and fail over to the
+          old ones — whichever actually holds the row answers (a row
+          not yet migrated raises an app error on the new owner)."""
+        key = str(params[1])
+        ring, prev = self.rings.get(cluster, actives)
+        nodes = ring.find(key, cht_n)
+        if prev is None:
+            return self._fan(self._route_candidates(nodes), name, params,
+                             reducer)
+        old_nodes = prev.find(key, cht_n)
+        seen = {n.name for n in nodes}
+        extra = [n for n in old_nodes if n.name not in seen]
+        if name in self._idempotent:
+            # reads: first owner (new ring first, then old) that answers
+            last: Optional[BaseException] = None
+            for node in list(nodes) + extra:
+                with self._counters_lock:
+                    self.forward_count += 1
+                try:
+                    return self._one(node, name, params)
+                except Exception as e:  # broad-ok — try the next owner
+                    last = e
+            if last is not None:
+                raise last
+            raise RpcNoClient(f"no active {self.engine} servers")
+        if extra:
+            self.rpc.trace.count("proxy.double_dispatch")
+        return self._fan(self._route_candidates(list(nodes) + extra),
+                         name, params, reducer)
+
     def _handler(self, name: str, routing: str, cht_n: int,
                  reducer: Callable[[Any, Any], Any]) -> Callable[..., Any]:
-        def handle(*params: Any) -> Any:
-            if params and isinstance(params[0], (str, bytes)):
-                c = params[0]
-                self._note_cluster(c.decode("utf-8", "surrogateescape")
-                                   if isinstance(c, bytes) else c)
-            self._count(name)
-            self._expire_sessions()
+        def handle_once(*params: Any) -> Any:
             actives = self.members.actives(str(params[0]))
             if routing == "broadcast":
                 # writes must reach every member: breakers observe but
@@ -626,14 +749,38 @@ class Proxy:
             if routing == "cht":
                 if len(params) < 2:
                     raise TypeError(f"{name}: cht routing needs a key param")
-                ring = CHT(actives).find(str(params[1]), cht_n)
-                nodes = self._route_candidates(ring)
-                return self._fan(nodes, name, params, reducer)
+                return self._route_cht(name, cht_n, reducer,
+                                       str(params[0]), actives, params)
             # random (proxy.hpp:229-247) + breaker skip + idempotent
             # failover
             return self._call_random(name, actives, params)
 
+        def handle(*params: Any) -> Any:
+            if params and isinstance(params[0], (str, bytes)):
+                c = params[0]
+                self._note_cluster(c.decode("utf-8", "surrogateescape")
+                                   if isinstance(c, bytes) else c)
+            self._count(name)
+            self._expire_sessions()
+            try:
+                return handle_once(*params)
+            except Exception as e:  # broad-ok — refined below, re-raised
+                if not _membership_rejection(e):
+                    raise
+                # the backend(s) rejected BEFORE applying (draining /
+                # stale ring): refresh the membership view and re-route
+                # once — safe for effectful calls too
+                self._refresh_members(str(params[0]))
+                return handle_once(*params)
+
         return handle
+
+    def _refresh_members(self, cluster: str) -> None:
+        """A membership-protocol rejection means this proxy's ring view
+        is stale: drop the actives cache (the ring cache revalidates by
+        member-list key on the next lookup) and count the event."""
+        self.members.invalidate(cluster)
+        self.rpc.trace.count("proxy.ring_refresh")
 
     def _raw_handler(self, name: str) -> Callable[[bytes], Any]:
         """Zero-decode relay for RANDOM-routed methods: forward the raw
@@ -701,6 +848,15 @@ class Proxy:
                 except DeadlineExceeded:
                     sess.client.close()
                     raise
+                except _MEMBERSHIP_ERRORS as e:
+                    # the backend refused BEFORE applying (draining):
+                    # healthy connection, so pool it — then move to the
+                    # next replica regardless of idempotency
+                    self._checkin(node, sess)
+                    self.breakers.record(key, True)
+                    self._refresh_members(cluster)
+                    last = e
+                    continue
                 except Exception:  # broad-ok — app error: backend alive
                     # application error from a HEALTHY backend (non-nil
                     # error span): the connection read the full response —
@@ -778,6 +934,9 @@ class Proxy:
                           arity=2)
         self._register("profile_device", 2, "broadcast", aggregators.merge)
         self._register("do_mix", 1, "random", aggregators.pass_)
+        # elastic membership (ISSUE 10): ring-version probe routes like
+        # any read (all backends agree modulo watch latency)
+        self._register("get_epoch", 1, "random", aggregators.pass_)
         self.rpc.register("get_proxy_status", self.get_proxy_status, arity=1)
         self.rpc.register("get_proxy_metrics", self.get_metrics, arity=1)
         self.rpc.register("get_proxy_spans", self.get_proxy_spans, arity=2)
@@ -905,6 +1064,10 @@ class Proxy:
             1 for b in breakers.values() if b["state"] == "open")
         st["breaker_opened_total"] = sum(
             b["opened_total"] for b in breakers.values())
+        # elastic membership (ISSUE 10): ring-cache engagement + how
+        # many clusters are inside a double-dispatch handoff window
+        for k, v in self.rings.stats().items():
+            st[f"ring.{k}"] = v
         for k, v in self.retry_budget.status().items():
             st[f"retry_budget.{k}"] = v
         st.update(self.args.flags_status())
@@ -1086,6 +1249,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--profile-trigger-window", type=float, default=10.0,
                    help="breach-counting window (seconds) for the "
                         "tail-triggered profile snapshot")
+    p.add_argument("--handoff-window", type=float, default=15.0,
+                   help="seconds after a membership change during which "
+                        "CHT-routed effectful calls double-dispatch to "
+                        "the union of old and new ring owners (no key "
+                        "has zero owners while rows migrate); idempotent "
+                        "reads fail over new->old instead")
     ns = p.parse_args(argv)
     ns.slo = ns.slo or []
     args = ProxyArgs(**{f.name: getattr(ns, f.name)

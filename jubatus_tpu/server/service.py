@@ -75,6 +75,18 @@ def bind_engine(rpc: RpcServer, server: Any) -> None:
     rpc.register("get_profile", server.get_profile, arity=2)
     rpc.register("profile_device", server.profile_device, arity=2)
     rpc.register("do_mix", server.do_mix, arity=1)
+    # elastic membership (ISSUE 10): ring-version + drain control +
+    # the state-migration data plane (framework/migration.py). The
+    # migration payloads ship packed row vectors between our own
+    # servers — binary=True keeps them modern even under --legacy-wire.
+    rpc.register("get_epoch", server.get_epoch, arity=1)
+    rpc.register("drain", server.drain, arity=2)
+    rpc.register("drain_status", server.drain_status, arity=1)
+    rpc.register("rebalance", server.rebalance, arity=1)
+    rpc.register("migrate_range", server.migrate_range, arity=5,
+                 binary=True)
+    rpc.register("put_rows", server.put_rows, arity=2, binary=True)
+    rpc.register("get_row_count", server.get_row_count, arity=1)
     _BINDERS[server.engine](rpc, server)
 
 

@@ -1,0 +1,531 @@
+"""Elastic membership (ISSUE 10): epoch-versioned CHT, live resharding,
+drain under traffic.
+
+Covers the churn acceptance story in-process:
+
+- membership epoch bumps on ACTUAL join/leave only;
+- the proxy's ring cache rebuilds only on membership change (the
+  per-request ``CHT(actives)`` fix) and the double-dispatch window
+  leaves no key with zero owners;
+- drain rejects new effectful work with the retryable ``NodeDraining``
+  (wire code 4) while finishing in-flight work, then hands every row
+  to its new ring owners;
+- migration pulls resume/fail over when a source dies mid-stream;
+- a full join -> migrate -> drain cycle loses zero rows (row-count
+  parity for the get_rows/put_rows driver hooks).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from jubatus_tpu.coord import membership
+from jubatus_tpu.coord.base import NodeInfo
+from jubatus_tpu.coord.cht import CHT, ring_key
+from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.framework import migration
+from jubatus_tpu.rpc.client import RpcClient
+from jubatus_tpu.rpc.errors import (
+    EPOCH_MISMATCH_ERROR,
+    NODE_DRAINING_ERROR,
+    EpochMismatch,
+    NodeDraining,
+    error_to_wire,
+    is_retryable,
+    wire_to_error,
+)
+from jubatus_tpu.server import EngineServer
+from jubatus_tpu.server.args import ServerArgs
+from jubatus_tpu.server.proxy import Proxy, ProxyArgs, _RingCache
+
+ENGINE = "nearest_neighbor"
+NAME = "nn"
+CONF = {"method": "lsh", "parameter": {"hash_num": 8},
+        "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+
+
+def _boot(store, auto_rebalance=True, drain_grace=0.2):
+    args = ServerArgs(engine=ENGINE, coordinator="(shared)", name=NAME,
+                      listen_addr="127.0.0.1", interval_sec=1e9,
+                      interval_count=1 << 30,
+                      auto_rebalance=auto_rebalance,
+                      drain_grace=drain_grace)
+    srv = EngineServer(ENGINE, CONF, args, coord=MemoryCoordinator(store))
+    srv.start(0)
+    return srv
+
+
+def _client(srv) -> RpcClient:
+    return RpcClient("127.0.0.1", srv.args.rpc_port, timeout=30.0)
+
+
+def _datum(i: int) -> Datum:
+    return Datum({"f0": float(i) + 1.0, "f1": float(i % 7) + 1.0})
+
+
+def _wait(pred, timeout=20.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _drain_state(cli) -> str:
+    st = cli.call("drain_status", NAME)
+    state = st.get("state")
+    return state.decode() if isinstance(state, bytes) else state
+
+
+# -- epoch protocol -----------------------------------------------------------
+
+
+def test_epoch_bumps_on_actual_join_and_leave_only():
+    store = _Store()
+    c = MemoryCoordinator(store)
+    assert membership.get_epoch(c, ENGINE, NAME) == 0
+    membership.register_active(c, ENGINE, NAME, "127.0.0.1", 9000)
+    assert membership.get_epoch(c, ENGINE, NAME) == 1
+    # re-registration (the post-put_diff self-promotion path) is NOT a
+    # membership change
+    membership.register_active(c, ENGINE, NAME, "127.0.0.1", 9000)
+    assert membership.get_epoch(c, ENGINE, NAME) == 1
+    membership.register_active(c, ENGINE, NAME, "127.0.0.1", 9001)
+    assert membership.get_epoch(c, ENGINE, NAME) == 2
+    membership.unregister_active(c, ENGINE, NAME, "127.0.0.1", 9000)
+    assert membership.get_epoch(c, ENGINE, NAME) == 3
+    # removing an absent member is not a change either
+    membership.unregister_active(c, ENGINE, NAME, "127.0.0.1", 9000)
+    assert membership.get_epoch(c, ENGINE, NAME) == 3
+    ring = CHT.from_coordinator(c, ENGINE, NAME)
+    assert ring.epoch == 3
+    assert ring.key == ring_key(ring.members)
+
+
+def test_epoch_bumps_when_servers_join_and_drain():
+    store = _Store()
+    s1 = _boot(store)
+    s2 = _boot(store)
+    try:
+        view = MemoryCoordinator(store)
+        assert membership.get_epoch(view, ENGINE, NAME) == 2
+        assert s1.get_epoch() == 2
+        cli = _client(s1)
+        cli.call("drain", NAME, False)
+        assert _wait(lambda: _drain_state(cli) == "drained")
+        # drain = one leave -> one bump; the drained member is marked
+        # then cleared
+        assert membership.get_epoch(view, ENGINE, NAME) == 3
+        assert membership.get_draining(view, ENGINE, NAME) == []
+        cli.close()
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_wire_codes_round_trip_and_are_retryable():
+    assert error_to_wire(NodeDraining()) == NODE_DRAINING_ERROR
+    assert error_to_wire(EpochMismatch()) == EPOCH_MISMATCH_ERROR
+    nd = wire_to_error(NODE_DRAINING_ERROR, "set_row")
+    em = wire_to_error(EPOCH_MISMATCH_ERROR, "migrate_range")
+    assert isinstance(nd, NodeDraining) and is_retryable(nd)
+    assert isinstance(em, EpochMismatch) and is_retryable(em)
+
+
+def test_migrate_range_rejects_stale_epoch():
+    store = _Store()
+    s1 = _boot(store)
+    s2 = _boot(store)
+    try:
+        cli = _client(s1)
+        good = cli.call("migrate_range", NAME, s1.get_epoch(),
+                        s2.self_nodeinfo().name, "", 1 << 20)
+        assert good.get("done") is True
+        with pytest.raises(EpochMismatch):
+            cli.call("migrate_range", NAME, s1.get_epoch() + 17,
+                     s2.self_nodeinfo().name, "", 1 << 20)
+        cli.close()
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+# -- ring cache + double-dispatch window -------------------------------------
+
+
+def test_ring_cache_rebuilds_only_on_membership_change():
+    rings = _RingCache(handoff_window=60.0)
+    a = [NodeInfo("10.0.0.1", 1), NodeInfo("10.0.0.2", 2)]
+    r1, prev = rings.get("c", a)
+    assert prev is None and rings.builds == 1
+    for _ in range(50):
+        r, prev = rings.get("c", list(reversed(a)))  # order-insensitive
+        assert r is r1 and prev is None
+    assert rings.builds == 1 and rings.hits == 50
+
+
+def test_ring_cache_handoff_window_and_expiry():
+    rings = _RingCache(handoff_window=0.2)
+    a = [NodeInfo("10.0.0.1", 1), NodeInfo("10.0.0.2", 2)]
+    b = a + [NodeInfo("10.0.0.3", 3)]
+    r_old, _ = rings.get("c", a)
+    r_new, prev = rings.get("c", b)
+    assert prev is r_old and r_new is not r_old
+    assert rings.stats()["in_handoff"] == 1
+    time.sleep(0.25)
+    _, prev = rings.get("c", b)
+    assert prev is None  # window over: old ring forgotten
+    assert rings.stats()["in_handoff"] == 0
+
+
+def test_double_dispatch_union_leaves_no_key_without_owners():
+    """For any single join/leave, every key's dispatch set during the
+    handoff window (union of old+new owners) contains at least one
+    member of BOTH rings — no zero-owner window, and always a live
+    (new-ring) owner."""
+    base = [NodeInfo("10.0.0.1", 1), NodeInfo("10.0.0.2", 2),
+            NodeInfo("10.0.0.3", 3)]
+    scenarios = [
+        (base, base + [NodeInfo("10.0.0.4", 4)]),       # join
+        (base, base[:-1]),                               # leave
+        (base, base[:-1] + [NodeInfo("10.0.0.5", 5)]),   # replace
+    ]
+    for old_members, new_members in scenarios:
+        old, new = CHT(old_members), CHT(new_members)
+        live = {m.name for m in new_members}
+        stale = {m.name for m in old_members}
+        for i in range(200):
+            key = f"k{i}"
+            union = {n.name for n in new.find(key, 2)} \
+                | {n.name for n in old.find(key, 2)}
+            assert union & live, f"key {key}: no live owner in union"
+            assert union & stale, f"key {key}: old owners dropped"
+
+
+# -- drain under traffic ------------------------------------------------------
+
+
+def test_drain_rejects_new_effectful_finishes_inflight():
+    store = _Store()
+    s1 = _boot(store, drain_grace=0.5)
+    s2 = _boot(store)
+    cli = _client(s1)
+    cli2 = _client(s1)
+    try:
+        cli.call("set_row", NAME, "pre", _datum(0).to_msgpack())
+        # make the NEXT set_row slow: it will be in flight when the
+        # drain gate flips, and must still complete successfully
+        release = threading.Event()
+        entered = threading.Event()
+        real = s1.driver.set_row
+
+        def slow_set_row(rid, datum):
+            entered.set()
+            release.wait(20.0)
+            return real(rid, datum)
+
+        s1.driver.set_row = slow_set_row
+        result: dict = {}
+
+        def inflight():
+            try:
+                result["ok"] = cli2.call("set_row", NAME, "inflight",
+                                         _datum(1).to_msgpack())
+            except Exception as e:  # noqa: BLE001 — asserted below
+                result["err"] = e
+
+        t = threading.Thread(target=inflight, daemon=True)
+        t.start()
+        assert entered.wait(10.0)
+        # drain while the call is in flight
+        cli.call("drain", NAME, False)
+        assert _wait(lambda: _drain_state(cli) in ("draining", "handoff",
+                                                   "drained"))
+        # NEW effectful work is rejected with the retryable NodeDraining
+        with pytest.raises(NodeDraining):
+            cli.call("set_row", NAME, "rejected", _datum(2).to_msgpack())
+        assert s1.rpc.trace.counters().get("rpc.drain_rejected", 0) >= 1
+        # reads keep serving
+        assert isinstance(cli.call("get_all_rows", NAME), list)
+        # the in-flight call finishes (drain waits; handoff needs the
+        # driver lock the slow call holds)
+        release.set()
+        t.join(15.0)
+        assert result.get("ok") is True
+        assert _wait(lambda: _drain_state(cli) == "drained")
+        # ... and the row it wrote was handed off to the survivor
+        c2 = _client(s2)
+        ids = {i.decode() if isinstance(i, bytes) else i
+               for i in c2.call("get_all_rows", NAME)}
+        assert {"pre", "inflight"} <= ids
+        c2.close()
+    finally:
+        cli.close()
+        cli2.close()
+        s1.stop()
+        s2.stop()
+
+
+def test_proxy_reroutes_during_drain_no_client_errors():
+    """The zero-error-spike story in miniature: effectful CHT-routed
+    writes through the proxy keep succeeding while a backend drains
+    (NodeDraining -> ring refresh -> re-route, double-dispatch window
+    covering the swap)."""
+    store = _Store()
+    s1 = _boot(store)
+    s2 = _boot(store)
+    proxy = Proxy(ProxyArgs(engine=ENGINE, listen_addr="127.0.0.1",
+                            interconnect_timeout=30.0),
+                  coord=MemoryCoordinator(store))
+    pport = proxy.start(0)
+    pcli = RpcClient("127.0.0.1", pport, timeout=30.0)
+    cli1 = _client(s1)
+    try:
+        for i in range(10):
+            assert pcli.call("set_row", NAME, f"r{i}",
+                             _datum(i).to_msgpack()) is True
+        cli1.call("drain", NAME, False)
+        # no error spike: every write during and after the drain lands
+        for i in range(10, 30):
+            assert pcli.call("set_row", NAME, f"r{i}",
+                             _datum(i).to_msgpack()) is True
+        assert _wait(lambda: _drain_state(cli1) == "drained")
+        for i in range(30, 40):
+            assert pcli.call("set_row", NAME, f"r{i}",
+                             _datum(i).to_msgpack()) is True
+        # reads during the window resolve too
+        for i in range(0, 40, 7):
+            assert isinstance(
+                pcli.call("neighbor_row_from_id", NAME, f"r{i}", 3), list)
+        # every row survives on the remaining member
+        c2 = _client(s2)
+        ids = {i.decode() if isinstance(i, bytes) else i
+               for i in c2.call("get_all_rows", NAME)}
+        assert {f"r{i}" for i in range(40)} <= ids
+        c2.close()
+    finally:
+        pcli.close()
+        cli1.close()
+        proxy.stop()
+        s1.stop()
+        s2.stop()
+
+
+# -- migration data plane -----------------------------------------------------
+
+
+def test_serve_range_cursor_resume_and_chunking():
+    from jubatus_tpu.models.nearest_neighbor import NearestNeighborDriver
+
+    d = NearestNeighborDriver(CONF)
+    for i in range(50):
+        d.set_row(f"row{i:03d}", _datum(i))
+    members = [NodeInfo("10.0.0.1", 1), NodeInfo("10.0.0.2", 2)]
+    ring = CHT(members)
+    target = "10.0.0.1_1"
+    owned = [rid for rid in sorted(d.row_ids())
+             if migration.row_owned_by(ring, rid, target)]
+    # walk with a tiny byte budget: strictly increasing cursors, exact
+    # coverage, no duplicates
+    got, cursor, chunks = [], "", 0
+    while True:
+        doc = migration.serve_range(d, ring, target, cursor,
+                                    limit_bytes=1)
+        got.extend(r[0] for r in doc["rows"])
+        chunks += 1
+        if doc["done"]:
+            break
+        assert doc["cursor"] > cursor
+        cursor = doc["cursor"]
+    assert got == owned
+    assert chunks >= len(owned)  # 1-byte budget = 1 row per chunk
+    # resume from any midpoint re-serves exactly the tail
+    if len(owned) > 2:
+        mid = owned[len(owned) // 2]
+        doc = migration.serve_range(d, ring, target, mid,
+                                    limit_bytes=1 << 20)
+        assert [r[0] for r in doc["rows"]] == \
+            [rid for rid in owned if rid > mid]
+
+
+def test_migration_resumes_after_midstream_source_crash():
+    """Kill a source after its first chunk: the puller fails over and
+    total coverage still holds because rows are CHT(2)-replicated onto
+    the dead source's ring successor."""
+    store = _Store()
+    servers = [_boot(store, auto_rebalance=False) for _ in range(3)]
+    joiner = _boot(store, auto_rebalance=False)
+    try:
+        nodes = [s.self_nodeinfo() for s in servers]
+        ring = CHT(nodes)
+        clients = {s.self_nodeinfo().name: _client(s) for s in servers}
+        # CHT-correct placement: each row lands on BOTH its ring owners
+        all_rows = [f"row{i:03d}" for i in range(60)]
+        for rid in all_rows:
+            for owner in ring.find(rid, 2):
+                clients[owner.name].call(
+                    "set_row", NAME, rid,
+                    _datum(int(rid[3:])).to_msgpack())
+        me = joiner.self_nodeinfo()
+        victim = servers[0]
+        victim_name = victim.self_nodeinfo().name
+        chunk_log = []
+
+        def apply_rows(rows):
+            chunk_log.append(len(rows))
+            with joiner.driver.lock:
+                n = joiner.driver.put_rows(rows)
+            if len(chunk_log) == 1:
+                victim.stop()  # mid-stream crash after the first chunk
+            return n
+
+        puller = migration.RangePuller(
+            NAME, me.name, apply_rows,
+            client_factory=joiner.peer_client, stats=joiner.migration,
+            chunk_bytes=64,  # force many chunks
+            epoch_of=lambda: joiner.get_epoch())
+        # victim first, so the crash happens mid-pull
+        out = puller.pull([victim.self_nodeinfo()] + nodes[1:])
+        assert out["sources_failed"] == [victim_name]
+        assert joiner.migration.snapshot()["failovers"] >= 1
+        # coverage: every row the joiner owns under the POST-JOIN ring
+        # arrived, despite the dead source
+        new_ring = CHT(nodes[1:] + [me])
+        expected = {rid for rid in all_rows
+                    if migration.row_owned_by(new_ring, rid, me.name)}
+        have = set(joiner.driver.row_ids())
+        assert expected <= have
+        for c in clients.values():
+            c.close()
+    finally:
+        for s in servers + [joiner]:
+            s.stop()
+
+
+@pytest.mark.slow
+def test_full_cycle_join_migrate_leave_row_parity():
+    """Acceptance: zero rows lost across a join -> migrate -> leave
+    cycle, with row-count parity between get_rows and put_rows."""
+    store = _Store()
+    s1 = _boot(store)
+    s2 = _boot(store)
+    servers = [s1, s2]
+    try:
+        c1, c2 = _client(s1), _client(s2)
+        total = 80
+        for i in range(total):
+            (c1 if i % 2 == 0 else c2).call(
+                "set_row", NAME, f"row{i:03d}", _datum(i).to_msgpack())
+        # driver-hook parity: a get_rows/put_rows round trip is exact
+        with s1.driver.lock:
+            rows = s1.driver.get_rows()
+        from jubatus_tpu.models.nearest_neighbor import \
+            NearestNeighborDriver
+
+        scratch = NearestNeighborDriver(CONF)
+        assert scratch.put_rows(rows) == len(rows) == len(s1.driver.row_ids())
+        assert sorted(scratch.row_ids()) == sorted(s1.driver.row_ids())
+        # join: the new member pulls its owned ranges automatically
+        s3 = _boot(store)
+        servers.append(s3)
+        assert _wait(lambda: s3.migration.snapshot()["pulls"] >= 1
+                     and s3.migration.snapshot()["active"] == 0)
+        # leave: drain the most loaded original member
+        c1.call("drain", NAME, False)
+        assert _wait(lambda: _drain_state(c1) == "drained")
+        union = set()
+        for s in servers[1:]:
+            c = _client(s)
+            union |= {i.decode() if isinstance(i, bytes) else i
+                      for i in c.call("get_all_rows", NAME)}
+            c.close()
+        expect = {f"row{i:03d}" for i in range(total)}
+        assert expect - union == set(), "rows lost across the cycle"
+        c1.close()
+        c2.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -- quorum + ops surface -----------------------------------------------------
+
+
+def test_mixer_quorum_excludes_draining_members():
+    from jubatus_tpu.framework.linear_mixer import RpcLinearCommunication
+
+    store = _Store()
+    c = MemoryCoordinator(store)
+    for port in (9000, 9001, 9002):
+        membership.register_actor(c, ENGINE, NAME, "127.0.0.1", port)
+        membership.register_active(c, ENGINE, NAME, "127.0.0.1", port)
+    comm = RpcLinearCommunication(MemoryCoordinator(store), ENGINE, NAME)
+    assert len(comm.update_members()) == 3
+    assert comm.membership_epoch() == 3
+    membership.mark_draining(c, ENGINE, NAME, "127.0.0.1", 9000)
+    members = comm.update_members()
+    assert len(members) == 2
+    assert "127.0.0.1_9000" not in {m.name for m in members}
+    comm.close()
+
+
+def test_jubactl_drain_and_rebalance(capsys, monkeypatch):
+    from jubatus_tpu.cmd import jubactl
+
+    store = _Store()
+    s1 = _boot(store)
+    s2 = _boot(store)
+    servers = [s1, s2]
+    try:
+        c1 = _client(s1)
+        for i in range(20):
+            c1.call("set_row", NAME, f"row{i:03d}", _datum(i).to_msgpack())
+        c1.close()
+        view = MemoryCoordinator(store)
+        # status shows the epoch
+        assert jubactl.show_status(view, ENGINE, NAME) == 0
+        out = capsys.readouterr().out
+        assert "epoch 2" in out
+        # rebalance pulls rows onto the under-replicated member
+        assert jubactl.rebalance_cluster(view, ENGINE, NAME) == 0
+        out = capsys.readouterr().out
+        assert "rebalance complete" in out
+        # drain via the CLI entry point
+        target = s1.self_nodeinfo().name
+        assert jubactl.drain_member(view, ENGINE, NAME, target) == 0
+        out = capsys.readouterr().out
+        assert "drained" in out
+        # bad target is a usage error
+        assert jubactl.drain_member(view, ENGINE, NAME, "") == 1
+        assert jubactl.drain_member(view, ENGINE, NAME, "nope") == 1
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_status_and_watch_carry_epoch_and_drain_state():
+    store = _Store()
+    s1 = _boot(store)
+    s2 = _boot(store)
+    try:
+        cli = _client(s1)
+        st = cli.call("get_status", NAME)
+        doc = next(iter(st.values()))
+        assert doc.get("cluster.epoch") == 2
+        assert doc.get("drain.state") == "active"
+        assert "migration.rows_moved" in doc
+        cli.close()
+        from jubatus_tpu.cmd.jubactl import collect_watch, \
+            render_watch_frame
+
+        view = MemoryCoordinator(store)
+        frame = render_watch_frame(collect_watch(view, ENGINE, NAME, 5.0))
+        assert "epoch 2" in frame
+    finally:
+        s1.stop()
+        s2.stop()

@@ -52,9 +52,11 @@ _HIGHER = re.compile(
 #: the hierarchical-mix scaling plane (ISSUE 9): wire bytes each host
 #: ships per round — the quantity the two-tier reduce holds down, so
 #: growth is a regression exactly like a latency
+#: ``rows_lost`` covers the elastic-membership plane (ISSUE 10): rows
+#: missing after a join/migrate/drain cycle — any growth is data loss
 _LOWER = re.compile(
     r"(_ms($|_)|_ratio($|_)|wire_mb|_per_host($|_)|drift"
-    r"|_error(s)?($|_)|_timeouts|_errors_total|_denials)")
+    r"|_error(s)?($|_)|_timeouts|_errors_total|_denials|rows_lost)")
 
 #: built-in per-key tolerance defaults (explicit --key-tolerance wins):
 #: the nproc16 sweep time-slices 16 gloo processes over however few
@@ -63,6 +65,11 @@ _LOWER = re.compile(
 #: the tight gate
 _DEFAULT_KEY_TOL: List[Tuple[re.Pattern, float]] = [
     (re.compile(r"_ms_nproc16($|_)"), 0.30),
+    # churn-window quantiles ride kill/boot timing on a shared core:
+    # the GATES of record are the error fractions and rows_lost (tight);
+    # the churn latency/throughput keys get a loose band
+    (re.compile(r"_churn_(p99_inflation_ratio|rpc_.*_ms"
+                r"|mixed_samples_per_sec)"), 0.50),
 ]
 
 
