@@ -659,6 +659,52 @@ def scaling_sweep(nprocs=(4, 8, 16), dim_bits: int = NORTH_STAR_BITS,
     return out
 
 
+def async_fold_probe(dim_bits: int = 20, members: int = 4,
+                     trials: int = 5) -> dict:
+    """Fold-phase cost of the async plane's bounded-staleness weights
+    (ISSUE 11): a weighted host fold of ``members`` dense 2^dim_bits
+    f32 diffs vs the sync plane's plain tree_sum over the same
+    payloads. The weighting is one extra multiply per stale
+    contribution — the probe records the measured overhead ratio so
+    "staleness weights are ~free at fold time" stays a number, not a
+    claim. (The round-BARRIER comparison — sync gather stalled by a
+    straggler vs async cadence — is bench_serving's
+    ``e2e_async_mix_straggler_cadence_x``.)"""
+    import numpy as np
+
+    from jubatus_tpu.framework.async_mixer import fold_weight, scale_tree
+    from jubatus_tpu.parallel.mix import tree_sum
+
+    rng = np.random.default_rng(11)
+    d = 1 << dim_bits
+    diffs = [{"w": rng.normal(size=d).astype(np.float32),
+              "b": rng.normal(size=16).astype(np.float32)}
+             for _ in range(members)]
+    # half the members one round stale, one at the bound — the shape a
+    # mildly-degraded fleet folds every tick
+    stal = [0, 1] * (members // 2) + [0] * (members % 2)
+    weights = [fold_weight(s, 8) for s in stal]
+
+    def timed(fn):
+        best = float("inf")
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3
+
+    plain_ms = timed(lambda: tree_sum(diffs))
+    weighted_ms = timed(lambda: tree_sum(
+        [scale_tree(df, w) for df, w in zip(diffs, weights)]))
+    tag = f"d{dim_bits}_m{members}"
+    out = {f"mix_async_fold_ms_{tag}": round(weighted_ms, 3),
+           f"mix_sync_fold_ms_{tag}": round(plain_ms, 3)}
+    if plain_ms > 0:
+        out[f"mix_async_fold_weighted_overhead_ratio_{tag}"] = round(
+            weighted_ms / plain_ms, 3)
+    return out
+
+
 def collect(dev=None) -> dict:
     import jax
 
@@ -682,6 +728,8 @@ def collect(dev=None) -> dict:
     # nproc scaling curve, flat vs hierarchical (ISSUE 9): wire bytes
     # per host must track hosts-on-the-wire, not total processes
     out.update(scaling_sweep())
+    # async mix (ISSUE 11): staleness-weighted fold cost vs plain sum
+    out.update(async_fold_probe())
     # wire-reduction ratio the int8 mode actually achieved at d24, and
     # the round-time comparison against the bf16 baseline (on CPU
     # loopback the quantization compute competes with the saved memcpy

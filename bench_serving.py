@@ -1012,6 +1012,237 @@ def run_migration_cycle(rows: int = 2000) -> dict:
     return out
 
 
+def run_async_mix(rounds: int = 12, storm_seconds: float = 4.0) -> dict:
+    """Asynchronous staleness-bounded mix bench (ISSUE 11): the round
+    barrier off the serving path, measured.
+
+    Phase 1 — drift-parity gate on matched fresh 3-member clusters
+    (sync linear vs --mix-async) fed IDENTICAL training: the async
+    fold's convergence telemetry and folded model must match the sync
+    plane's (``e2e_async_mix_drift_parity_ok``).
+
+    Phase 2 — cadence/stall storm on the async cluster: train/classify
+    clients hammer every member while rounds stream back to back.
+
+    - ``e2e_train_stall_during_mix_ms`` — worst measured model-lock
+      hold attributable to the mix plane (snapshot + apply gauges)
+      while rounds streamed: the "train never waits on a round" claim
+      as a number.
+    - ``e2e_async_mix_rounds_per_sec`` vs ``e2e_sync_mix_rounds_per_sec``
+      — fold cadence under identical load; the async/sync ratio is the
+      cadence headroom (``e2e_async_mix_cadence_x``).
+    - ``e2e_async_classify_p99_during_mix_ms`` — serving tail while
+      rounds stream (and the sync twin for comparison).
+    """
+    import threading as _threading
+
+    import numpy as _np
+
+    from jubatus_tpu.client import Datum as _Datum
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    conf = {"method": "PA",
+            "parameter": {"regularization_weight": 1.0},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+
+    def boot_cluster(mix_async: bool):
+        store = _Store()
+        servers = []
+        for _ in range(3):
+            srv = EngineServer(
+                "classifier", conf,
+                args=ServerArgs(engine="classifier",
+                                coordinator="(shared)", name="asyncmix",
+                                listen_addr="127.0.0.1", thread=4,
+                                interval_sec=1e9,
+                                interval_count=1 << 30,
+                                telemetry_interval=0,
+                                mix_async=mix_async),
+                coord=MemoryCoordinator(store))
+            srv.start(0)
+            servers.append(srv)
+        return servers
+
+    def train(srv, rows):
+        with RpcClient("127.0.0.1", srv.args.rpc_port) as c:
+            c.call("train", "asyncmix",
+                   [[label, _Datum(d).to_msgpack()] for label, d in rows])
+
+    out: dict = {}
+    # -- phase 1: drift parity on identical, quiesced traffic ---------------
+    sync_cluster = boot_cluster(False)
+    async_cluster = boot_cluster(True)
+    try:
+        assert async_cluster[0].mixer.mix_now() is not None  # master+hint
+        rows_by_member = [
+            [("l0", {"x": 1.0, "y": -0.5}), ("l1", {"x": -1.0, "y": 2.0})],
+            [("l0", {"x": 0.5, "y": -2.0}), ("l1", {"x": -0.25, "y": 1.0})],
+            [("l1", {"x": -2.0, "y": 0.75}), ("l0", {"x": 2.0, "y": -1.0})],
+        ]
+        div_sync, div_async = [], []
+        for _ in range(3):
+            for i in range(3):
+                train(sync_cluster[i], rows_by_member[i])
+                train(async_cluster[i], rows_by_member[i])
+            rs = sync_cluster[0].mixer.mix_now()
+            for s in async_cluster[1:]:
+                s.mixer.submit_now()
+            ra = async_cluster[0].mixer.mix_now()
+            div_sync.append((rs or {}).get("health", {}).get(
+                "premix_divergence_mean", 0.0))
+            div_async.append((ra or {}).get("health", {}).get(
+                "premix_divergence_mean", 0.0))
+            rows_by_member = rows_by_member[1:] + rows_by_member[:1]
+        out["e2e_async_mix_divergence_sync"] = round(
+            float(_np.mean(div_sync)), 6)
+        out["e2e_async_mix_divergence_async"] = round(
+            float(_np.mean(div_async)), 6)
+        # identical contributions + all-fresh weights must agree to
+        # float noise; 5% absolute headroom keeps the gate honest
+        # without riding rounding
+        out["e2e_async_mix_drift_parity_ok"] = bool(
+            _np.allclose(div_async, div_sync, rtol=1e-3, atol=0.05))
+
+        # -- phase 2: cadence/stall storm under live traffic ----------------
+        def storm(servers, is_async, window=storm_seconds):
+            stop = _threading.Event()
+            p99_lat: list = []
+
+            def writer(idx):
+                rng = _np.random.default_rng(idx)
+                with RpcClient("127.0.0.1",
+                               servers[idx].args.rpc_port) as c:
+                    k = 0
+                    while not stop.is_set():
+                        d = _Datum({"x": float(rng.normal()),
+                                    "y": float(rng.normal())})
+                        try:
+                            c.call("train", "asyncmix",
+                                   [[f"l{k % 2}", d.to_msgpack()]])
+                        except Exception:  # noqa: BLE001 — bench load
+                            return
+                        k += 1
+
+            def reader():
+                with RpcClient("127.0.0.1",
+                               servers[0].args.rpc_port) as c:
+                    while not stop.is_set():
+                        t0 = time.perf_counter()
+                        try:
+                            c.call("classify", "asyncmix",
+                                   [_Datum({"x": 1.0, "y": 0.0})
+                                    .to_msgpack()])
+                        except Exception:  # noqa: BLE001
+                            return
+                        p99_lat.append(
+                            (time.perf_counter() - t0) * 1e3)
+
+            threads = [_threading.Thread(target=writer, args=(i,))
+                       for i in range(3)]
+            threads.append(_threading.Thread(target=reader))
+            if is_async:
+                # each member pushes on its own background cadence —
+                # the production shape: a delayed submitter blocks only
+                # its own thread, never the fold
+                def submitter(idx):
+                    while not stop.is_set():
+                        try:
+                            servers[idx].mixer.submit_now()
+                        except Exception:  # noqa: BLE001 — bench load
+                            return
+                        time.sleep(0.02)
+
+                threads += [_threading.Thread(target=submitter, args=(i,))
+                            for i in (1, 2)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # traffic flowing before rounds start
+            done_rounds = 0
+            t0 = time.perf_counter()
+            deadline = t0 + window
+            while time.perf_counter() < deadline and \
+                    done_rounds < rounds:
+                if servers[0].mixer.mix_now() is not None:
+                    done_rounds += 1
+            wall = time.perf_counter() - t0
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+            stall = 0.0
+            for s in servers:
+                g = s.rpc.trace.gauges()
+                stall = max(stall,
+                            g.get("mix.apply_stall_ms", 0.0),
+                            g.get("mix.snapshot_stall_ms", 0.0))
+            p99 = float(_np.percentile(p99_lat, 99)) if p99_lat else 0.0
+            return done_rounds / wall if wall > 0 else 0.0, stall, p99
+
+        sync_rps, sync_stall, sync_p99 = storm(sync_cluster, False)
+        async_rps, async_stall, async_p99 = storm(async_cluster, True)
+        out["e2e_sync_mix_rounds_per_sec"] = round(sync_rps, 2)
+        out["e2e_async_mix_rounds_per_sec"] = round(async_rps, 2)
+        if sync_rps > 0:
+            out["e2e_async_mix_cadence_x"] = round(async_rps / sync_rps, 2)
+        out["e2e_train_stall_during_mix_ms"] = round(async_stall, 3)
+        out["e2e_sync_train_stall_during_mix_ms"] = round(sync_stall, 3)
+        out["e2e_async_classify_p99_during_mix_ms"] = round(async_p99, 2)
+        out["e2e_sync_classify_p99_during_mix_ms"] = round(sync_p99, 2)
+        lag = max(getattr(s.mixer, "async_lag_rounds", 0)
+                  for s in async_cluster)
+        out["e2e_async_mix_lag_rounds"] = int(lag)
+        out["e2e_async_mix_dropped_stale"] = int(sum(
+            getattr(s.mixer, "async_dropped_stale", 0)
+            for s in async_cluster))
+
+        # -- phase 3: straggler cadence — the round-barrier number ----------
+        # One member delayed ~10x the round cadence. The sync gather
+        # WAITS for it every round; the async fold never does — the
+        # cadence ratio under the same fault is the headline of record
+        # (ISSUE 11: "mix cadence raisable 10x at the same serving
+        # p99"), and the async p99 must stay flat while it happens.
+        from jubatus_tpu.utils import faults as _faults
+
+        delay = 2.5
+        sync_victim = sync_cluster[2]
+        sync_rule = (f"rpc.call.mix_get_diff."
+                     f"127.0.0.1:{sync_victim.args.rpc_port}"
+                     f":delay:{delay}")
+        async_victim = async_cluster[2]
+        async_rule = (f"mix.async.submit."
+                      f"{async_victim.self_nodeinfo().name}"
+                      f":delay:{delay}")
+        rules = _faults.arm(sync_rule)
+        try:
+            s_rps, _s_stall, s_p99 = storm(sync_cluster, False,
+                                           window=2.5 * delay)
+        finally:
+            _faults.disarm(rules)
+        rules = _faults.arm(async_rule)
+        try:
+            a_rps, a_stall, a_p99 = storm(async_cluster, True,
+                                          window=2.5 * delay)
+        finally:
+            _faults.disarm(rules)
+        out["e2e_sync_mix_straggler_rounds_per_sec"] = round(s_rps, 3)
+        out["e2e_async_mix_straggler_rounds_per_sec"] = round(a_rps, 3)
+        if s_rps > 0:
+            out["e2e_async_mix_straggler_cadence_x"] = round(
+                a_rps / s_rps, 1)
+        out["e2e_async_classify_p99_straggler_ms"] = round(a_p99, 2)
+        out["e2e_sync_classify_p99_straggler_ms"] = round(s_p99, 2)
+        out["e2e_train_stall_straggler_ms"] = round(a_stall, 3)
+    finally:
+        for s in sync_cluster + async_cluster:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+    return out
+
+
 def collect(trials: int = 2) -> dict:
     """Alternate transports and keep each one's best trial: run-to-run
     spread through the device tunnel is ~±10% (host scheduling + tunnel
@@ -1177,11 +1408,21 @@ def collect(trials: int = 2) -> dict:
         out.update(run_migration_cycle())
     except Exception as e:  # noqa: BLE001
         out["e2e_migration_error"] = repr(e)[:200]
+    # async staleness-bounded mix (ISSUE 11): drift parity vs the sync
+    # plane + cadence/stall storm (train-path stall of record)
+    try:
+        out.update(run_async_mix())
+    except Exception as e:  # noqa: BLE001
+        out["e2e_async_mix_error"] = repr(e)[:200]
     return out
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "churn":
+    if len(sys.argv) > 1 and sys.argv[1] == "asyncmix":
+        # the async-mix slice on its own (drift parity + cadence/stall
+        # storm), for ISSUE 11 iteration without the full bench
+        print(json.dumps(run_async_mix(), indent=1))
+    elif len(sys.argv) > 1 and sys.argv[1] == "churn":
         # the elastic-membership slice on its own (kill/add cycle +
         # join/migrate/drain parity), for churn iteration without the
         # full bench's half hour

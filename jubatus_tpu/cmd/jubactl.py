@@ -440,6 +440,13 @@ def _watch_node_row(node_name: str, entry: Dict[str, Any],
         mix_bits.append(f"v{st['mixer.model_version']}")
     if drift is not None:
         mix_bits.append(f"ef {float(drift):.3g}")
+    # async mix (ISSUE 11): this member's distance behind the fold
+    # cadence and, on the master, the pending inbox
+    if st.get("mixer.async_mode"):
+        mix_bits.append(f"lag {int(st.get('mixer.async_lag_rounds', 0))}")
+        depth = st.get("mixer.async_inbox_depth")
+        if depth:
+            mix_bits.append(f"inbox {int(depth)}")
     alerts = ",".join(entry.get("alerts") or []) or "-"
     p99_cell = f"{p99:.1f} {p99_span[4:]}" if p99 is not None else "-"
     return (f"  {node_name:<22} {state:<9} {req_s:>8.1f} {err_s:>7.2f}  "

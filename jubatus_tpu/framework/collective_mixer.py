@@ -428,7 +428,7 @@ class CollectiveMixer(RpcLinearMixer):
         if entry is None:
             return False
         from jubatus_tpu.parallel.collective import (
-            ErrorFeedback, psum_pytree)
+            ErrorFeedback, psum_pytree_start)
 
         if self.ef is None:
             self.ef = ErrorFeedback()
@@ -439,12 +439,20 @@ class CollectiveMixer(RpcLinearMixer):
         # stamps per round). prefer_device: device-resident diff leaves
         # (the JAX models) enter with zero staging and the totals come
         # back as device arrays, which the jitted put_diff consumes
-        # directly — no device→host→device round trip on the apply
+        # directly — no device→host→device round trip on the apply.
+        # The reduce runs as a STREAMING round (psum_pytree_start):
+        # each GO waiter is its own thread, so when rounds come back to
+        # back the next round's early chunk ship/reduce overlaps this
+        # round's readback drain — the dispatch gate in
+        # parallel/collective.py keeps the collective order total
+        # across the overlap (phases stamp the wait as
+        # dispatch_gate_ms).
         self.last_phases = {}
-        totals = psum_pytree(entry["diffs"], compress=self.compress,
-                             phases=self.last_phases, prefer_device=True,
-                             feedback=self.ef,
-                             topology=self._resolve_topology())
+        totals = psum_pytree_start(
+            entry["diffs"], compress=self.compress,
+            phases=self.last_phases, prefer_device=True,
+            feedback=self.ef,
+            topology=self._resolve_topology()).result()
         # mix-convergence telemetry (ISSUE 7): every member measures the
         # distance of its OWN contribution from the folded average — the
         # per-member half of the divergence signal the RPC master

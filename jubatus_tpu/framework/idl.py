@@ -225,6 +225,8 @@ IDEMPOTENT_BUILTINS: FrozenSet[str] = frozenset({
     # migrate_range is a pure read on the SOURCE (the puller owns the
     # cursor, so re-issuing a chunk fetch just re-reads the same rows)
     "get_epoch", "drain_status", "migrate_range", "get_row_count",
+    # async mix (ISSUE 11): the inbox/fold status read is pure
+    "mix_async_status",
 })
 
 #: effectful built-ins, listed for the docs' idempotency matrix (anything
@@ -235,6 +237,11 @@ EFFECTFUL_BUILTINS: FrozenSet[str] = frozenset({
     # elastic membership (ISSUE 10): drain flips routing state,
     # rebalance pulls rows in, put_rows writes rows
     "drain", "rebalance", "put_rows",
+    # async mix (ISSUE 11): a replayed submit is mostly-safe
+    # (latest-wins inbox) but a retry racing a fold can double-count a
+    # delta — classed effectful; the submitter resubmits next tick
+    # instead of retrying
+    "mix_submit_diff",
 })
 
 

@@ -42,6 +42,7 @@ from jubatus_tpu.framework.mixer import IntervalMixer, MixFlightRecorder
 from jubatus_tpu.parallel.mix import tree_sum
 from jubatus_tpu.rpc.breaker import BreakerBoard
 from jubatus_tpu.rpc.client import RpcClient, RpcMClient
+from jubatus_tpu.utils import faults
 from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
 
 log = logging.getLogger(__name__)
@@ -283,12 +284,19 @@ class RpcLinearCommunication(LinearCommunication):
             log.warning("sync_schema failed: %s", e)
 
     def get_diff(self) -> List[Tuple[NodeInfo, bytes]]:
+        # chaos site (utils/faults.py): drop = the whole gather vanishes
+        # on the wire, error/delay model a sick master-side fan-out
+        if faults.is_armed() and faults.fire("mix.comm.get_diff"):
+            return []
         results, errors = self._mc.call_collect("mix_get_diff", self.name)
         for e in errors:
             log.warning("get_diff failed: %s", e)
         return [(NodeInfo(h, p), r) for (h, p), r in results]
 
     def put_diff(self, packed: bytes) -> Dict[str, bool]:
+        # chaos site: drop = the broadcast is lost (no member acks)
+        if faults.is_armed() and faults.fire("mix.comm.put_diff"):
+            return {}
         results, errors = self._mc.call_collect("mix_put_diff", self.name, packed)
         for e in errors:
             log.warning("put_diff failed: %s", e)
@@ -382,6 +390,12 @@ class RpcLinearMixer:
         self._rounds_led = 0
         self._member_last_contrib: Dict[str, int] = {}
         self._member_first_seen: Dict[str, int] = {}
+        #: membership epoch the ledger entries were accumulated under
+        #: (ISSUE 11 fix): when the CHT epoch bumps, entries for names
+        #: no longer in the member set are dropped — a drained node that
+        #: later rejoins under the same name is re-seeded fresh instead
+        #: of inheriting rounds of bogus staleness from its past life
+        self._ledger_epoch = 0
         #: did the last master round this node led proceed without every
         #: member's diff? (/healthz degraded-reason "mix_quorum_degraded")
         self.last_round_degraded = False
@@ -428,24 +442,66 @@ class RpcLinearMixer:
                 ])
         return True
 
-    def local_diff_obj(self) -> Dict[str, Any]:
+    def local_diff_obj(self, materialize: bool = False,
+                       canonical_schema: bool = False) -> Dict[str, Any]:
         """My diff as a payload dict (model read lock;
         linear_mixer.cpp:562-579) — in-process consumers (push exchange)
-        use this directly, skipping the wire compress/decompress."""
-        with self.driver.lock:
-            diffs = {
-                name: m.get_diff() for name, m in self.driver.get_mixables().items()
-            }
-            schema = (
-                self.driver.get_schema() if hasattr(self.driver, "get_schema") else []
-            )
+        use this directly, skipping the wire compress/decompress.
+
+        ``materialize=True`` copies device leaves to host numpy INSIDE
+        the lock: a snapshot that outlives the lock (the RPC pack runs
+        after release; async submissions outlive it by whole
+        submit/fold latencies) races train steps that DONATE the very
+        buffers it references (jitted train paths reuse state buffers)
+        — under write load that race aborted whole sync rounds.
+
+        ``canonical_schema=True`` is the ASYNC plane's extra contract:
+        ``get_schema`` is sorted but diff ROWS sit in slot (training)
+        order, and the async fold has no pre-round schema phase to
+        align contributors — so the snapshot first aligns its own rows
+        to its sorted vocabulary (a no-op in steady state). The sync
+        round must NOT do this: its schema phase already aligned slots
+        to the union, and re-sorting around a just-trained novel label
+        would break the trailing-row pad tree_sum relies on.
+
+        The lock-held time is the snapshot's ENTIRE train-path cost —
+        gauged as ``mix.snapshot_stall_ms`` so the async plane's
+        "train never waits on a round" claim is a measured quantity,
+        not a design assertion."""
+        with self.trace.span("mix.stall.snapshot") as sp:
+            with self.driver.lock:
+                if canonical_schema and self._has_schema() and \
+                        hasattr(self.driver, "sync_schema"):
+                    self.driver.sync_schema(self.driver.get_schema())
+                diffs = {
+                    name: m.get_diff()
+                    for name, m in self.driver.get_mixables().items()
+                }
+                if materialize:
+                    import jax
+                    import numpy as np
+
+                    diffs = jax.tree_util.tree_map(np.asarray, diffs)
+                schema = (
+                    self.driver.get_schema()
+                    if hasattr(self.driver, "get_schema") else []
+                )
+        self.trace.gauge("mix.snapshot_stall_ms", round(sp.seconds * 1e3, 3))
         return {"protocol": PROTOCOL_VERSION, "schema": schema,
                 "version": self.model_version, "diffs": diffs}
 
     def local_get_diff(self) -> bytes:
-        return pack_mix(self.local_diff_obj())
+        # materialize: the pack below runs OUTSIDE the model lock, and
+        # a train step in between may donate the snapshot's buffers —
+        # under write load that race aborted whole rounds ("Array has
+        # been deleted" at pack time, get_diff error at the master)
+        return pack_mix(self.local_diff_obj(materialize=True))
 
     def local_put_diff(self, packed: bytes) -> bool:
+        # chaos site: drop = this member silently loses the broadcast
+        # (it goes stale and recovers via the existing ladder)
+        if faults.is_armed() and faults.fire("mix.put_diff"):
+            return False
         return self.local_put_obj(unpack_mix(packed))
 
     def local_put_obj(self, msg) -> bool:
@@ -475,17 +531,29 @@ class RpcLinearMixer:
             self._required_version = base_version
             ok = False
         else:
-            with self.driver.lock:
-                if msg.get("schema") and hasattr(self.driver, "sync_schema"):
-                    self.driver.sync_schema(list(msg["schema"]))
-                ok = True
-                mixables = self.driver.get_mixables()
-                for name, diff in msg["diffs"].items():
-                    m = mixables.get(name)
-                    if m is not None:
-                        ok = bool(m.put_diff(diff)) and ok
-            if ok:
-                self.model_version = base_version + 1
+            # everything above this lock (unpack, version gate, health
+            # adoption) ran without the model lock: the apply holds it
+            # only for the put_diff swaps — that lock-held time is the
+            # round's whole train-path stall, gauged per apply
+            with self.trace.span("mix.stall.apply") as sp:
+                with self.driver.lock:
+                    if msg.get("schema") and \
+                            hasattr(self.driver, "sync_schema"):
+                        self.driver.sync_schema(list(msg["schema"]))
+                    ok = True
+                    mixables = self.driver.get_mixables()
+                    for name, diff in msg["diffs"].items():
+                        m = mixables.get(name)
+                        if m is not None:
+                            ok = bool(m.put_diff(diff)) and ok
+                    if ok:
+                        # version bump INSIDE the lock: a reader holding
+                        # the model lock sees (model, version) move
+                        # together — no torn snapshot/version pairs
+                        self.model_version = base_version + 1
+            self.trace.gauge("mix.apply_stall_ms",
+                             round(sp.seconds * 1e3, 3))
+        self.trace.gauge("mix.model_version", float(self.model_version))
         self._obsolete = not ok
         # member-side staleness: every member gauges its OWN distance
         # from the cluster's round cadence (applied rounds reset it)
@@ -711,7 +779,23 @@ class RpcLinearMixer:
                           contributed: set) -> Dict[str, Any]:
         """Advance the master-side staleness ledger for one led round
         and return the health fields: per-member rounds since last
-        contribution (0 = contributed this round) and the max."""
+        contribution (0 = contributed this round) and the max.
+
+        The ledger is keyed by node name and survives membership epoch
+        changes by REBASING (ISSUE 11 fix): when the CHT epoch bumps,
+        entries for names no longer registered are dropped, so a node
+        that drained away and later rejoined under the same name is
+        seeded fresh by the setdefault below instead of inheriting the
+        staleness its past incarnation accrued while gone."""
+        epoch = self.comm.membership_epoch() \
+            if hasattr(self.comm, "membership_epoch") else 0
+        if epoch != self._ledger_epoch:
+            current = {m.name for m in members}
+            for ledger in (self._member_last_contrib,
+                           self._member_first_seen):
+                for name in [n for n in ledger if n not in current]:
+                    del ledger[name]
+            self._ledger_epoch = epoch
         self._rounds_led += 1
         idx = self._rounds_led
         staleness: Dict[str, int] = {}

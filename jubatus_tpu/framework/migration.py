@@ -43,6 +43,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from jubatus_tpu.coord.base import NodeInfo
 from jubatus_tpu.coord.cht import CHT
 from jubatus_tpu.rpc.errors import EpochMismatch, RpcError
+from jubatus_tpu.utils import faults
 
 log = logging.getLogger(__name__)
 
@@ -187,6 +188,11 @@ class RangePuller:
         self.epoch_of = epoch_of or (lambda: 0)
 
     def _fetch(self, cli: Any, epoch: int, cursor: str) -> Dict[str, Any]:
+        # chaos site (utils/faults.py): delay models a slow source,
+        # error a mid-stream death — both exercise the puller's
+        # failover/resume ladder deterministically
+        if faults.is_armed():
+            faults.fire("migration.pull")
         doc = cli.call("migrate_range", self.cluster, int(epoch),
                        self.target, cursor, self.chunk_bytes)
         if not isinstance(doc, dict):

@@ -91,6 +91,11 @@ class IntervalMixer:
         self._mix_fn = mix_fn
         self.interval_sec = interval_sec
         self.interval_count = interval_count
+        #: fire the interval tick even with zero local updates — the
+        #: async mix plane (framework/async_mixer.py) sets this: a fold
+        #: tick must consume OTHER members' submitted diffs whether or
+        #: not this process trained anything since the last round
+        self.fire_idle = False
         #: set by the owning server so mix spans land in ITS registry
         self.trace: Registry = default_registry()
         #: per-round flight records; an owning mixer passes its own so
@@ -191,7 +196,8 @@ class IntervalMixer:
                     return
                 elapsed = time.monotonic() - self._last_mix_time
                 due = self._counter >= self.interval_count or (
-                    self._counter > 0 and elapsed >= self.interval_sec
+                    (self._counter > 0 or self.fire_idle)
+                    and elapsed >= self.interval_sec
                 )
             if due:
                 try:

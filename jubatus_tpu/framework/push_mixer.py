@@ -299,17 +299,34 @@ def create_mixer(name: str, driver: Any, comm: LinearCommunication, *,
                  self_node: Optional[NodeInfo] = None,
                  interval_sec: float = 16.0, interval_count: int = 512,
                  mix_bf16: bool = False, quorum_fraction: float = 0.5,
-                 mix_compress: str = "off", mix_topology: str = ""):
+                 mix_compress: str = "off", mix_topology: str = "",
+                 mix_async: bool = False, mix_staleness_bound: int = 8):
     """Mixer factory (≙ create_mixer, mixer_factory.cpp:41-97): selects by
     the --mixer flag. ``mix_compress`` is the collective wire mode
     (off|bf16|int8); the deprecated ``mix_bf16`` bool still resolves to
     bf16 when no explicit mode is given. ``mix_topology`` is the
     collective mixer's hierarchical tier shape (``""``/``auto``/``HxM``,
-    see --mix-topology)."""
+    see --mix-topology). ``mix_async`` swaps the linear mixer for the
+    asynchronous staleness-bounded plane (framework/async_mixer.py):
+    members push diffs in the background and the master folds them with
+    per-member weights decayed by ``mix_staleness_bound`` instead of
+    gathering behind a round barrier."""
     kwargs = dict(self_node=self_node, interval_sec=interval_sec,
                   interval_count=interval_count,
                   quorum_fraction=quorum_fraction)
+    if mix_async and name != "linear_mixer":
+        raise ValueError(
+            f"--mix-async rides the linear mix plane; --mixer {name} "
+            "cannot stream rounds asynchronously (push mixers are "
+            "already leaderless, the collective is a barrier by "
+            "construction)")
     if name == "linear_mixer":
+        if mix_async:
+            from jubatus_tpu.framework.async_mixer import AsyncLinearMixer
+
+            return AsyncLinearMixer(
+                driver, comm, staleness_bound=mix_staleness_bound,
+                **kwargs)
         return RpcLinearMixer(driver, comm, **kwargs)
     if name == "collective_mixer":
         from jubatus_tpu.framework.collective_mixer import CollectiveMixer

@@ -78,6 +78,7 @@ from __future__ import annotations
 import functools
 import math
 import os
+import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
@@ -114,6 +115,82 @@ COMPRESS_MODES = ("off", "bf16", "int8")
 QUANT_BLOCK = int(os.environ.get("JUBATUS_TPU_MIX_QUANT_BLOCK", "256"))
 
 _64BIT = (np.dtype(np.float64), np.dtype(np.int64), np.dtype(np.uint64))
+
+#: process-wide collective dispatch gate (ISSUE 11). Chunk psums are a
+#: SEQUENCE of separate collectives, and XLA matches collectives across
+#: processes by dispatch order — two rounds interleaving their dispatch
+#: in one process would wedge the world. The gate serializes DISPATCH
+#: only: it is released the moment a round's last chunk has been handed
+#: to the runtime, before the reader thread drains the readback. Round
+#: N+1's early chunk ship/reduce therefore overlaps round N's readback
+#: (the ``psum_pytree_start`` streaming shape), while the collective
+#: order every process sees stays total.
+_DISPATCH_GATE = threading.Lock()
+
+
+class _Gate:
+    """One round's hold on the dispatch gate; release is idempotent so
+    the early release at dispatch-complete and the outer safety-net
+    finally compose."""
+
+    def __init__(self) -> None:
+        self._held = False
+
+    def acquire(self) -> float:
+        t0 = time.perf_counter()
+        _DISPATCH_GATE.acquire()
+        self._held = True
+        return time.perf_counter() - t0
+
+    def release(self) -> None:
+        if self._held:
+            self._held = False
+            _DISPATCH_GATE.release()
+
+
+class PendingReduce:
+    """Handle for a streaming round started with ``psum_pytree_start``:
+    the reduce is dispatching/draining on a worker thread; ``result()``
+    joins it and returns the totals (re-raising any failure). While one
+    round's readback drains, the NEXT ``psum_pytree_start`` call's ship
+    and reduce dispatch may already run — the dispatch gate keeps the
+    collective order total across rounds, which is what makes the
+    overlap safe."""
+
+    def __init__(self) -> None:
+        self._thread: Optional[threading.Thread] = None
+        self._box: Dict[str, Any] = {}
+
+    def done(self) -> bool:
+        return self._thread is not None and not self._thread.is_alive()
+
+    def result(self) -> Any:
+        self._thread.join()
+        if "err" in self._box:
+            raise self._box["err"]
+        return self._box["out"]
+
+
+def psum_pytree_start(diff: Any, **kwargs) -> PendingReduce:
+    """Begin one AllReduce round on a worker thread and return a
+    ``PendingReduce`` immediately. Back-to-back rounds stream: round
+    N+1's early chunk ship/reduce overlaps round N's readback, because
+    the dispatch gate serializes only the DISPATCH of collectives (a
+    hard ordering requirement), never the device→host drain. Callers
+    must still collect rounds in the order they started them (every
+    process must run rounds in the same order)."""
+    pending = PendingReduce()
+
+    def work() -> None:
+        try:
+            pending._box["out"] = psum_pytree(diff, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — re-raised in result()
+            pending._box["err"] = e
+
+    t = threading.Thread(target=work, name="mix-round-reduce", daemon=True)
+    pending._thread = t
+    t.start()
+    return pending
 
 
 def _norm_compress(compress: Any) -> str:
@@ -617,7 +694,8 @@ def psum_pytree(diff: Any, compress: Any = False,
                       wire_mb=0.0, wire_mb_ring_model=0.0,
                       wire_bytes_per_host=0, chunks=0,
                       chunk_mb=round(chunk_bytes / 2**20, 2),
-                      overlap_ms_saved=0.0, quant=mode,
+                      overlap_ms_saved=0.0, dispatch_gate_ms=0.0,
+                      quant=mode,
                       topo=topo.signature if hier else "flat")
     if not leaves:
         return diff
@@ -687,6 +765,39 @@ def psum_pytree(diff: Any, compress: Any = False,
     out: List[Any] = [None] * len(metas)
     t_ship = t_reduce = t_readback = t_cast = 0.0
 
+    # dispatch gate (ISSUE 11): held from the first collective dispatch
+    # of this round to the last — released BEFORE the readback drain so
+    # a back-to-back round (psum_pytree_start) ships/reduces its early
+    # chunks while this round's device→host traffic completes. The wait
+    # itself is reported as dispatch_gate_ms.
+    gate = _Gate()
+    gate_wait = gate.acquire()
+    try:
+        return _reduce_under_gate(
+            gate, gate_wait, metas, small_idx, big_idx, big_set, out,
+            treedef, mesh, n, me, sharding, hier, topo, chunk_bytes,
+            block, mode, prefer_device, feedback, phases,
+            _chunk_elems, nbytes, big_bytes, small_bytes,
+            t_ship, t_reduce, t_readback, t_cast)
+    finally:
+        gate.release()
+
+
+def _reduce_under_gate(gate, gate_wait, metas, small_idx, big_idx,
+                       big_set, out, treedef, mesh, n, me, sharding,
+                       hier, topo, chunk_bytes, block, mode,
+                       prefer_device, feedback, phases, _chunk_elems,
+                       nbytes, big_bytes, small_bytes,
+                       t_ship, t_reduce, t_readback, t_cast):
+    """The collective body of one round, entered with the dispatch gate
+    held (see psum_pytree). Split out so the gate's safety-net release
+    wraps every exit path without re-indenting the stream logic."""
+    if hier:
+        mesh2 = host_mesh(topo)
+        sharding2 = NamedSharding(mesh2, _SPEC2)
+        my_devs = [d for row in topo.grid for d in row
+                   if d.process_index == me.process_index]
+
     # -- small leaves: one batched collective (the pre-pipeline shape) --
     if small_idx:
         t0 = time.perf_counter()
@@ -718,6 +829,10 @@ def psum_pytree(diff: Any, compress: Any = False,
         t_ship += t1 - t0
         t_reduce += t2 - t1
         t_readback += t3 - t2
+    if not big_idx:
+        # small-only round: every collective completed above — the next
+        # round may dispatch while we assemble/return
+        gate.release()
 
     # -- big leaves: chunked double-buffered stream ---------------------
     n_chunks = 0
@@ -992,6 +1107,10 @@ def psum_pytree(diff: Any, compress: Any = False,
             dispatch_done = time.perf_counter()
             handoff.append(None)
             ready.release()
+            # every collective of this round is dispatched (or the
+            # round is dead): open the gate BEFORE draining readback so
+            # the next round's ship/reduce overlaps it
+            gate.release()
             reader.join()
         if state["error"] is not None:
             raise state["error"]
@@ -1061,6 +1180,7 @@ def psum_pytree(diff: Any, compress: Any = False,
             chunks=n_chunks,
             chunk_mb=round(chunk_bytes / 2**20, 2),
             overlap_ms_saved=round(overlap_saved * 1e3, 2),
+            dispatch_gate_ms=round(gate_wait * 1e3, 2),
             quant=mode,
             topo=topo.signature if hier else "flat",
         )

@@ -87,6 +87,23 @@ class ServerArgs:
     #: hosts, not total devices. The resolved NxM rides the prepare
     #: signature: heterogeneous fleets fall back to the RPC mix.
     mix_topology: str = ""
+    #: --mix-async: asynchronous staleness-bounded mix
+    #: (framework/async_mixer.py; linear_mixer only). Rounds stream in
+    #: the background: members PUSH diffs to the master's inbox on
+    #: their own cadence, the master folds whatever arrived with
+    #: per-member staleness weights, and nothing on the serving path
+    #: waits for a round — no gather barrier, no quorum abort.
+    mix_async: bool = False
+    #: --mix-staleness-bound: rounds-stale past which a submitted diff
+    #: is dropped from the fold (weight decays 2**-staleness up to the
+    #: bound). The async plane's correctness governor: a straggler
+    #: degrades its own contribution instead of stalling the fleet.
+    mix_staleness_bound: int = 8
+    #: --fault (repeatable): arm a fault-injection rule at boot
+    #: (utils/faults.py; SITE:MODE[:ARG], MODE in {error,delay,drop}) —
+    #: the chaos lever for drills and the straggler/partition tests.
+    #: Also armable via the JUBATUS_TPU_FAULTS env var.
+    fault: List[str] = dataclasses.field(default_factory=list)
     #: Prometheus /metrics + /healthz HTTP port (utils/metrics_http.py):
     #: -1 = off (default), 0 = ephemeral (actual port in get_status)
     metrics_port: int = -1
@@ -275,6 +292,30 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "per host on the inter-host wire; the resolved "
                         "NxM rides the prepare signature so mismatched "
                         "fleets fall back to the RPC mix")
+    p.add_argument("--mix-async", action="store_true",
+                   help="stream mix rounds asynchronously (linear "
+                        "mixer only): members push diffs to the "
+                        "master's inbox in the background and the "
+                        "master folds whatever arrived with per-member "
+                        "bounded-staleness weights — no gather "
+                        "barrier on the serving path, no quorum "
+                        "aborts; a straggler's contribution decays "
+                        "instead of stalling the round")
+    p.add_argument("--mix-staleness-bound", type=int, default=8,
+                   help="rounds-stale past which a submitted diff is "
+                        "dropped from the async fold (its weight "
+                        "decays 2**-staleness up to the bound); the "
+                        "async plane's correctness governor")
+    p.add_argument("--fault", action="append", default=None,
+                   metavar="SITE:MODE[:ARG]",
+                   help="arm a fault-injection rule at boot "
+                        "(repeatable; utils/faults.py). SITE is a "
+                        "dotted glob (e.g. mix.comm.put_diff, "
+                        "rpc.call.train.*), MODE in {error, delay, "
+                        "drop}; delay takes seconds, error a "
+                        "probability, @N suffixes bound firings "
+                        "(e.g. 'mix.put_diff:error@3'). Also armable "
+                        "via JUBATUS_TPU_FAULTS")
     p.add_argument("--metrics-port", type=int, default=-1,
                    help="serve Prometheus /metrics + /healthz on this "
                         "HTTP port (0 = ephemeral; default off)")
@@ -363,7 +404,8 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
 def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
     ns = build_parser().parse_args(argv)
     ns.slo = ns.slo or []  # argparse append default stays None (shared
-    args = ServerArgs(**{  # mutable [] would leak across parses)
+    ns.fault = ns.fault or []  # mutable [] would leak across parses)
+    args = ServerArgs(**{
         f.name: getattr(ns, f.name) for f in dataclasses.fields(ServerArgs)
     })
     if args.thread < 1:
@@ -401,6 +443,20 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
 
         try:  # reject bad grammar at argv time, not at first tick
             parse_slo(spec)
+        except ValueError as e:
+            raise SystemExit(str(e))
+    if args.mix_staleness_bound < 0:
+        raise SystemExit("--mix-staleness-bound must be >= 0")
+    if args.mix_async and args.mixer != "linear_mixer":
+        raise SystemExit(
+            "--mix-async requires -x linear_mixer (push mixers are "
+            "already leaderless; the collective is a barrier by "
+            "construction)")
+    for rule in args.fault:
+        from jubatus_tpu.utils.faults import parse_rule
+
+        try:  # reject bad grammar at argv time, not at first firing
+            parse_rule(rule)
         except ValueError as e:
             raise SystemExit(str(e))
     if args.mix_bf16 and args.mix_compress == "off":

@@ -56,6 +56,16 @@ class EngineServer:
                     f"--shard-devices {self.args.shard_devices} but only "
                     f"{len(devs)} local devices present")
             mesh = Mesh(devs, axis_names=("shard",))
+        # --fault: arm boot-time fault-injection rules (utils/faults.py;
+        # process-global by design — the chaos plane models the process,
+        # not one server object, exactly like the env-var path)
+        fault_rules = getattr(self.args, "fault", None) or []
+        if fault_rules:
+            from jubatus_tpu.utils import faults
+
+            faults.arm(*fault_rules)
+            log.warning("fault injection armed from --fault: %s",
+                        ", ".join(fault_rules))
         self.driver = create_driver(engine, json.loads(config), mesh=mesh)
         # --fv-cache-size: rebound the converter's tokenization/name memo
         # caches (core/fv/converter.py; default matches the flag default)
@@ -169,6 +179,9 @@ class EngineServer:
                 mix_bf16=getattr(self.args, "mix_bf16", False),
                 mix_topology=getattr(self.args, "mix_topology", ""),
                 quorum_fraction=getattr(self.args, "mix_quorum", 0.5),
+                mix_async=getattr(self.args, "mix_async", False),
+                mix_staleness_bound=getattr(
+                    self.args, "mix_staleness_bound", 8),
             )
             self.mixer.set_trace_registry(self.rpc.trace)
             # cluster-unique id minting for the engines that mint ids
@@ -565,6 +578,15 @@ class EngineServer:
                                 "staleness": getattr(m, "self_staleness", 0)})
             if getattr(m, "collective_dead", False):
                 reasons.append({"kind": "collective_dead"})
+            # async mix (ISSUE 11): a member lagging past the staleness
+            # bound is contributing nothing to the fold — surface it
+            # before the ladder demotes it to obsolete
+            lag = getattr(m, "async_lag_rounds", 0)
+            bound = getattr(m, "staleness_bound", 0)
+            if bound and lag > bound:
+                reasons.append({"kind": "mix_async_lagging",
+                                "lag_rounds": lag,
+                                "staleness_bound": bound})
         if self.drain_ctl.state != "active":
             reasons.append({"kind": "draining",
                             "state": self.drain_ctl.state})

@@ -8,16 +8,26 @@ deterministic and surgical: named sites in the RPC and mix planes call
 ``fire(site, ...)``, and a test (or the ``JUBATUS_TPU_FAULTS`` env var,
 for subprocess servers) arms rules against them.
 
-Rule syntax (one per rule, comma-separated in the env var):
+Rule syntax (one per rule, comma-separated in the env var, and one per
+repeated ``--fault`` server flag):
 
     <site-glob>:error            raise FaultInjected at matching sites
     <site-glob>:error:<p>        ... with probability p (seeded RNG)
     <site-glob>:delay:<seconds>  sleep before proceeding
+    <site-glob>:drop             silently lose the operation (fire()
+                                 returns True; drop-aware sites — the
+                                 mixer comm fan-outs, put_diff, the
+                                 async submit path — discard the
+                                 message instead of erroring; sites
+                                 that don't check the return value
+                                 ignore drops by construction)
     <site-glob>:error@<n>        ... only for the first n firings
 
 Sites are dotted names matched with fnmatch, e.g. ``rpc.call.get_diff``,
-``rpc.connect``, ``mix.put_diff``. ``fire`` is a no-op (one dict lookup
-on a module flag) when nothing is armed — safe on hot paths.
+``rpc.connect``, ``mix.put_diff``, ``mix.comm.get_diff``,
+``mix.async.submit.<node>``, ``migration.pull``. ``fire`` is a no-op
+(one dict lookup on a module flag) when nothing is armed — safe on hot
+paths.
 
     with faults.armed("rpc.call.get_diff:error@1"):
         ...  # the next get_diff anywhere in this process fails once
@@ -68,13 +78,13 @@ def parse_rule(text: str) -> _Rule:
     parts = text.strip().split(":")
     action_idx = None
     for i in range(len(parts) - 1, -1, -1):
-        if parts[i].split("@", 1)[0] in ("error", "delay"):
+        if parts[i].split("@", 1)[0] in ("error", "delay", "drop"):
             action_idx = i
             break
     if action_idx is None or action_idx == 0:
         raise ValueError(
             f"bad fault rule {text!r} (want site:action[:arg], action in "
-            "{error, delay})")
+            "{error, delay, drop})")
     pattern = ":".join(parts[:action_idx])
     action = parts[action_idx]
     extra = parts[action_idx + 1:]
@@ -142,12 +152,16 @@ def armed(*rule_texts: str):
         disarm(mine)
 
 
-def fire(site: str) -> None:
-    """Injection point. No-op unless rules are armed."""
+def fire(site: str) -> bool:
+    """Injection point. No-op unless rules are armed. Returns True when
+    a ``drop`` rule matched — drop-aware sites silently discard the
+    operation; everyone else ignores the return value (a drop then has
+    no effect, by design)."""
     if not _armed:
-        return
+        return False
     delay = 0.0
     boom = False
+    dropped = False
     with _lock:
         for r in _rules:
             if r.remaining is not None and r.remaining <= 0:
@@ -162,12 +176,15 @@ def fire(site: str) -> None:
             _fired[site] = _fired.get(site, 0) + 1
             if r.action == "delay":
                 delay = max(delay, r.arg)
+            elif r.action == "drop":
+                dropped = True
             else:
                 boom = True
     if delay:
         time.sleep(delay)
     if boom:
         raise FaultInjected(f"injected fault at {site}")
+    return dropped
 
 
 def stats() -> Dict[str, int]:

@@ -1,0 +1,602 @@
+"""Asynchronous staleness-bounded mix tests (ISSUE 11): fold-weight
+math, diff inbox semantics, the streaming round on a live 3-member
+cluster, the drift-parity gate vs the sync plane, the straggler chaos
+drill (delayed member decays instead of stalling), snapshot
+double-buffering under concurrent train/classify, and the master-side
+staleness ledger's epoch rebase."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from jubatus_tpu.framework.async_mixer import (
+    AsyncLinearMixer,
+    DiffInbox,
+    fold_weight,
+    scale_tree,
+)
+from jubatus_tpu.utils import faults
+
+CONF = {
+    "method": "PA",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+
+
+# -- pure units ---------------------------------------------------------------
+
+
+def test_fold_weight_decay_and_drop():
+    assert fold_weight(0, 4) == 1.0
+    assert fold_weight(1, 4) == 0.5
+    assert fold_weight(3, 4) == 0.125
+    assert fold_weight(4, 4) == 2.0 ** -4  # at the bound: decayed, kept
+    assert fold_weight(5, 4) == 0.0        # past the bound: dropped
+    assert fold_weight(-2, 4) == 1.0       # future-stamped clamps fresh
+    assert fold_weight(1, 0) == 0.0        # bound 0: only fresh folds
+
+
+def test_scale_tree_preserves_dtypes():
+    diff = {"w": np.ones((4,), np.float32) * 8.0,
+            "counts": np.array([4, 8], np.int64),
+            "s": 2.0}
+    out = scale_tree(diff, 0.5)
+    assert out["w"].dtype == np.float32
+    np.testing.assert_allclose(out["w"], 4.0)
+    # integer leaves stay integral (truncation IS the down-weighting)
+    assert out["counts"].dtype == np.int64
+    np.testing.assert_array_equal(out["counts"], [2, 4])
+    assert out["s"] == 1.0
+    # identity weight returns the tree untouched (no copy)
+    assert scale_tree(diff, 1.0) is diff
+
+
+def test_inbox_latest_wins_and_drain():
+    inbox = DiffInbox()
+    inbox.submit("a", {"version": 1, "diffs": {"x": 1}})
+    inbox.submit("b", {"version": 2, "diffs": {"x": 2}})
+    inbox.submit("a", {"version": 3, "diffs": {"x": 30}})  # supersedes
+    assert inbox.depth() == 2
+    assert inbox.submits == 3
+    entries = inbox.drain()
+    assert set(entries) == {"a", "b"}
+    assert entries["a"]["version"] == 3
+    assert entries["a"]["payload"]["diffs"]["x"] == 30
+    # drain consumes: a silent member does not replay its last delta
+    assert inbox.depth() == 0
+    assert inbox.drain() == {}
+
+
+def test_staleness_ledger_rebases_on_epoch_bump():
+    """ISSUE 11 satellite fix: a drained-and-rejoined node must not
+    inherit the staleness its past incarnation accrued while gone."""
+    from jubatus_tpu.coord.base import NodeInfo
+    from jubatus_tpu.framework.linear_mixer import RpcLinearMixer
+
+    class FakeComm:
+        epoch = 1
+
+        def membership_epoch(self):
+            return self.epoch
+
+    class FakeDriver:
+        lock = threading.Lock()
+
+    comm = FakeComm()
+    mixer = RpcLinearMixer(FakeDriver(), comm)
+    a, b = NodeInfo("h", 1), NodeInfo("h", 2)
+    assert mixer._staleness_update([a, b], {a.name, b.name})[
+        "staleness_max"] == 0
+    # b stops contributing for two rounds
+    for _ in range(2):
+        health = mixer._staleness_update([a, b], {a.name})
+    assert health["staleness"][b.name] == 2
+    # b drains away; the epoch bumps; rounds continue without it
+    comm.epoch = 2
+    for _ in range(3):
+        health = mixer._staleness_update([a], {a.name})
+    assert b.name not in health["staleness"]
+    assert b.name not in mixer._member_last_contrib
+    # b rejoins under the SAME name; epoch bumps again: it is seeded
+    # fresh (staleness 1 = "not in this round yet"), not 5+ from its
+    # past life
+    comm.epoch = 3
+    health = mixer._staleness_update([a, b], {a.name})
+    assert health["staleness"][b.name] == 1
+    # same epoch, still silent: staleness now grows normally
+    health = mixer._staleness_update([a, b], {a.name})
+    assert health["staleness"][b.name] == 2
+
+
+def test_create_mixer_async_wiring():
+    from jubatus_tpu.framework.push_mixer import create_mixer
+
+    class FakeDriver:
+        lock = threading.Lock()
+
+    m = create_mixer("linear_mixer", FakeDriver(), None, mix_async=True,
+                     mix_staleness_bound=3)
+    assert isinstance(m, AsyncLinearMixer)
+    assert m.staleness_bound == 3
+    assert m._scheduler.fire_idle is True
+    with pytest.raises(ValueError):
+        create_mixer("random_mixer", FakeDriver(), None, mix_async=True)
+    with pytest.raises(ValueError):
+        create_mixer("collective_mixer", FakeDriver(), None,
+                     mix_async=True)
+
+
+def test_server_args_flags():
+    from jubatus_tpu.server.args import parse_server_args
+
+    args = parse_server_args(
+        ["classifier", "-f", "/dev/null", "--mix-async",
+         "--mix-staleness-bound", "6",
+         "--fault", "mix.put_diff:error@1",
+         "--fault", "migration.pull:delay:0.1"])
+    assert args.mix_async is True
+    assert args.mix_staleness_bound == 6
+    assert args.fault == ["mix.put_diff:error@1",
+                          "migration.pull:delay:0.1"]
+    with pytest.raises(SystemExit):
+        parse_server_args(["classifier", "-f", "/dev/null",
+                           "--mix-async", "-x", "random_mixer"])
+    with pytest.raises(SystemExit):
+        parse_server_args(["classifier", "-f", "/dev/null",
+                           "--mix-staleness-bound", "-1"])
+    with pytest.raises(SystemExit):
+        parse_server_args(["classifier", "-f", "/dev/null",
+                           "--fault", "nonsense-rule"])
+
+
+# -- live cluster -------------------------------------------------------------
+
+
+def _boot_cluster(tmp_path, sub, *, mix_async=True, bound=3, n=3,
+                  interval=1e9):
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    coord_dir = str(tmp_path / sub)
+    servers = []
+    for _ in range(n):
+        srv = EngineServer(
+            "classifier", CONF,
+            args=ServerArgs(engine="classifier", coordinator=coord_dir,
+                            name="am", listen_addr="127.0.0.1",
+                            interval_sec=interval,
+                            interval_count=1 << 30,
+                            telemetry_interval=0,
+                            mix_async=mix_async,
+                            mix_staleness_bound=bound))
+        srv.start(0)
+        servers.append(srv)
+    return servers
+
+
+def _train(srv, rows):
+    from jubatus_tpu.client import ClassifierClient, Datum
+
+    c = ClassifierClient("127.0.0.1", srv.args.rpc_port, "am")
+    c.train([[label, Datum(d)] for label, d in rows])
+    c.close()
+
+
+def _classify(srv, d):
+    from jubatus_tpu.client import ClassifierClient, Datum
+
+    c = ClassifierClient("127.0.0.1", srv.args.rpc_port, "am")
+    out = c.classify([Datum(d)])
+    c.close()
+    return out
+
+
+@pytest.fixture()
+def async_cluster(tmp_path):
+    servers = _boot_cluster(tmp_path, "coord")
+    yield servers
+    faults.disarm_all()
+    for s in servers:
+        s.stop()
+
+
+def test_async_round_streams_and_gauges(async_cluster):
+    """One fold tick consumes whatever arrived — no gather barrier, no
+    quorum abort — and the convergence/async gauges land on every
+    member through the broadcast, like the sync plane's."""
+    servers = async_cluster
+    for i, s in enumerate(servers):
+        _train(s, [(f"l{i % 2}", {"x": float(i + 1)})])
+    # server 0 wins the master lock, publishes the hint, folds its own
+    # diff (nobody else has submitted yet): round completes with ONE
+    # contributor — the sync plane would have gathered and possibly
+    # aborted instead
+    r1 = servers[0].mixer.mix_now()
+    assert r1 is not None and r1["mode"] == "async"
+    assert r1["contributors"] == 1
+    assert "quorum" not in str(r1)
+    # members now push; the next fold consumes both submissions
+    assert servers[1].mixer.submit_now() is True
+    assert servers[2].mixer.submit_now() is True
+    assert servers[0].mixer.inbox.depth() == 2
+    r2 = servers[0].mixer.mix_now()
+    assert r2["contributors"] == 2
+    assert all(w == 1.0 for w in r2["weights"].values())  # all fresh
+    assert r2["base_version"] == 1
+    assert r2["acked"] == 3
+    for s in servers:
+        g = s.rpc.trace.gauges()
+        assert g["mix.model_version"] == 2.0
+        assert g["mix.apply_stall_ms"] >= 0
+        assert s.mixer.model_version == 2
+    g0 = servers[0].rpc.trace.gauges()
+    assert g0["mix.async_fold_weight_min"] == 1.0
+    assert g0["mix.async_inbox_depth"] == 0.0
+    assert servers[0].rpc.trace.counters()["mix.async_rounds"] == 2
+    # flight records carry the async mode + weights
+    recs = [r for r in servers[0].mixer.flight.snapshot()
+            if r["mode"] == "async"]
+    assert len(recs) == 2 and recs[-1]["contributors"] == 2
+    # the member-side lag gauge came from the submit ack
+    st = next(iter(servers[1].get_status().values()))
+    assert st["mixer.async_mode"] is True
+    assert st["mixer.async_lag_rounds"] == 0
+    assert st["mixer.staleness_bound"] == 3
+
+
+def test_async_status_rpc_and_idempotency():
+    from jubatus_tpu.framework.idl import (EFFECTFUL_BUILTINS,
+                                           IDEMPOTENT_BUILTINS)
+
+    assert "mix_async_status" in IDEMPOTENT_BUILTINS
+    assert "mix_submit_diff" in EFFECTFUL_BUILTINS
+
+
+def test_async_status_over_the_wire(async_cluster):
+    from jubatus_tpu.rpc.client import RpcClient
+
+    servers = async_cluster
+    _train(servers[0], [("l0", {"x": 1.0})])
+    servers[0].mixer.mix_now()
+    with RpcClient("127.0.0.1", servers[0].args.rpc_port, 5.0) as c:
+        doc = c.call("mix_async_status", "am")
+    doc = {(k.decode() if isinstance(k, bytes) else k): v
+           for k, v in doc.items()}
+    assert doc["rounds"] == 1
+    assert doc["staleness_bound"] == 3
+    assert doc["model_version"] == 1
+
+
+def test_stale_submission_decays_then_drops(async_cluster):
+    """The bounded-staleness governor itself: a payload snapshot k
+    folds ago folds at weight 2**-k and is dropped past the bound."""
+    from jubatus_tpu.framework.linear_mixer import pack_mix
+    from jubatus_tpu.rpc.client import RpcClient
+
+    servers = async_cluster
+    straggler = servers[2]
+    # both members know both labels up front so every snapshot carries
+    # the same schema (schema churn is its own test below)
+    _train(straggler, [("l1", {"x": -3.0}), ("l0", {"x": 0.25})])
+    _train(servers[0], [("l0", {"x": 0.5}), ("l1", {"x": -0.5})])
+    # snapshot the straggler's diff NOW (version 0) but hold it back,
+    # like a 10x-delayed submit would
+    held = straggler.mixer.local_diff_obj()
+    # two rounds stream past it
+    for k in range(2):
+        _train(servers[0], [("l0", {"x": float(k + 1)})])
+        assert servers[0].mixer.mix_now() is not None
+    assert servers[0].mixer.model_version == 2
+    # the held payload finally arrives: staleness 2 -> weight 0.25
+    with RpcClient("127.0.0.1", servers[0].args.rpc_port, 5.0) as c:
+        c.call("mix_submit_diff", "am",
+               straggler.self_nodeinfo().name, pack_mix(held))
+        _train(servers[0], [("l0", {"x": 9.0})])
+        r = servers[0].mixer.mix_now()
+        assert r["weights"][straggler.self_nodeinfo().name] == 0.25
+        assert not r["dropped_stale"]
+        # one more round streams past (base 4), then the same stale
+        # payload arrives again: staleness 4 > bound 3 — dropped, and
+        # the round continues without it
+        _train(servers[0], [("l0", {"x": 4.0})])
+        assert servers[0].mixer.mix_now() is not None
+        c.call("mix_submit_diff", "am",
+               straggler.self_nodeinfo().name, pack_mix(held))
+        _train(servers[0], [("l0", {"x": 2.0})])
+        r = servers[0].mixer.mix_now()
+    assert r is not None
+    assert r["dropped_stale"] == 1
+    assert straggler.self_nodeinfo().name not in r["weights"]
+    assert servers[0].rpc.trace.counters()["mix.async_dropped_stale"] == 1
+
+
+def test_straggler_chaos_decays_not_stalls(tmp_path):
+    """ISSUE 11 satellite: one member's submissions delayed ~10x the
+    fold cadence under load — rounds keep completing at cadence, the
+    straggler's contribution decays/drops instead of aborting, and the
+    serving path stays responsive throughout."""
+    servers = _boot_cluster(tmp_path, "chaos", bound=2)
+    try:
+        straggler = servers[2]
+        name = straggler.self_nodeinfo().name
+        # aligned label vocabulary everywhere + the master hint
+        for s in servers:
+            _train(s, [("l0", {"x": 1.0}), ("l1", {"x": -1.0})])
+        assert servers[0].mixer.mix_now() is not None
+        # the straggler's submit path sleeps ~10 fold intervals
+        faults.arm(f"mix.async.submit.{name}:delay:1.0")
+        _train(straggler, [("l1", {"x": -5.0})])
+        sub = threading.Thread(target=straggler.mixer.submit_now,
+                               daemon=True)
+        sub.start()
+        # rounds stream at ~0.1s cadence while the straggler sleeps;
+        # serving keeps answering between folds
+        rounds = 0
+        serving_ok = 0
+        for k in range(6):
+            _train(servers[0], [("l0", {"x": float(k)})])
+            _train(servers[1], [("l0", {"x": float(k) + 0.5})])
+            servers[1].mixer.submit_now()
+            if servers[0].mixer.mix_now() is not None:
+                rounds += 1
+            out = _classify(servers[0], {"x": 1.0})
+            serving_ok += bool(out)
+            time.sleep(0.1)
+        sub.join(timeout=10.0)
+        assert not sub.is_alive()
+        assert rounds >= 5  # the fleet never waited for the straggler
+        assert serving_ok == 6
+        # no sync-plane quorum machinery fired
+        reasons = [r.get("reason", "") for r in
+                   servers[0].mixer.flight.snapshot()]
+        assert not any("quorum" in r for r in reasons)
+        assert servers[0].rpc.trace.counters().get(
+            "mix.quorum_aborted", 0) == 0
+        # the straggler's held-back payload arrived rounds late: it was
+        # decayed (weight < 1) or dropped past the bound — never a stall
+        _train(servers[0], [("l0", {"x": 7.0})])
+        r = servers[0].mixer.mix_now()
+        assert r is not None
+        w = r["weights"].get(name)
+        dropped_total = servers[0].rpc.trace.counters().get(
+            "mix.async_dropped_stale", 0)
+        assert (w is not None and w < 1.0) or dropped_total >= 1
+        # the flight records show every round completed without it
+        # stalling the fold phase: fold times stay ~ms
+        for rec in servers[0].mixer.flight.snapshot():
+            if rec["mode"] == "async" and rec.get("phases"):
+                assert rec["phases"]["fold_ms"] < 1000
+    finally:
+        faults.disarm_all()
+        for s in servers:
+            s.stop()
+
+
+def test_drift_parity_async_vs_sync(tmp_path):
+    """The drift-parity gate (ISSUE 11 acceptance): N rounds of async
+    mix with fresh contributors produce the same folded model and the
+    same convergence telemetry as the sync plane on identical traffic —
+    the async plane learns as well as the one it replaces."""
+    sync = _boot_cluster(tmp_path, "sync", mix_async=False)
+    async_ = _boot_cluster(tmp_path, "async", mix_async=True)
+    try:
+        rows = [
+            [("l0", {"x": 1.0, "y": -0.5}), ("l1", {"x": -1.0, "y": 2.0})],
+            [("l0", {"x": 0.5, "y": -2.0}), ("l1", {"x": -0.25, "y": 1.0})],
+            [("l1", {"x": -2.0, "y": 0.75}), ("l0", {"x": 2.0, "y": -1.0})],
+        ]
+        # prime the async plane: the first fold tick elects the master
+        # and publishes the hint members submit to (zero-diff round)
+        assert async_[0].mixer.mix_now() is not None
+        div_sync, div_async = [], []
+        for rnd in range(3):
+            for i in range(3):
+                _train(sync[i], rows[i])
+                _train(async_[i], rows[i])
+            rs = sync[0].mixer.mix_now()
+            assert rs is not None
+            div_sync.append(rs["health"]["premix_divergence_mean"])
+            # async: everyone submits fresh, then the master folds
+            for s in async_[1:]:
+                assert s.mixer.submit_now() is True
+            ra = async_[0].mixer.mix_now()
+            assert ra is not None and ra["contributors"] == 3
+            div_async.append(ra["health"]["premix_divergence_mean"])
+            # rotate the traffic so later rounds genuinely diverge
+            rows = rows[1:] + rows[:1]
+        # identical contributions, all-fresh weights: the telemetry
+        # agrees to float tolerance round by round
+        np.testing.assert_allclose(div_async, div_sync, rtol=1e-5)
+        # and the folded MODELS agree: same scores on a probe
+        probe = {"x": 0.8, "y": -0.3}
+        out_s = _classify(sync[0], probe)
+        out_a = _classify(async_[0], probe)
+        ss = {e[0]: e[1] for e in out_s[0]}
+        sa = {e[0]: e[1] for e in out_a[0]}
+        assert set(ss) == set(sa)
+        for label in ss:
+            assert sa[label] == pytest.approx(ss[label], rel=1e-5)
+        # the async run never held the model lock for long: the whole
+        # measured train-path stall is ~ms per round
+        for s in async_:
+            g = s.rpc.trace.gauges()
+            assert g["mix.apply_stall_ms"] < 500
+    finally:
+        for s in sync + async_:
+            s.stop()
+
+
+def test_double_buffer_concurrent_train_classify(async_cluster):
+    """ISSUE 11 satellite: concurrent train/classify during in-flight
+    background rounds see a consistent (model, version) pair — the
+    version gauge is monotone and no reader ever errors on a torn
+    model."""
+    servers = async_cluster
+    stop = threading.Event()
+    errors: list = []
+    versions: list = []
+
+    def hammer_train(idx):
+        k = 0
+        while not stop.is_set():
+            try:
+                _train(servers[idx], [(f"l{k % 2}", {"x": float(k % 7)})])
+            except Exception as e:  # noqa: BLE001 — the assertion
+                errors.append(e)
+                return
+            k += 1
+
+    def hammer_read(idx):
+        while not stop.is_set():
+            try:
+                out = _classify(servers[idx], {"x": 1.0})
+                # version read under the SAME lock discipline the apply
+                # bumps it under: the pair can never be torn
+                with servers[idx].driver.lock:
+                    versions.append(servers[idx].mixer.model_version)
+                assert out is not None
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer_train, args=(i,))
+               for i in range(3)]
+    threads += [threading.Thread(target=hammer_read, args=(0,))]
+    for t in threads:
+        t.start()
+    # background rounds stream while the hammers run
+    deadline = time.monotonic() + 1.5
+    rounds = 0
+    while time.monotonic() < deadline:
+        for s in servers[1:]:
+            s.mixer.submit_now()
+        if servers[0].mixer.mix_now() is not None:
+            rounds += 1
+        time.sleep(0.02)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not errors, errors
+    assert rounds >= 3
+    # the version gauge never moved backwards on the reader
+    assert versions == sorted(versions)
+    assert versions[-1] >= rounds - 1
+    g = servers[0].rpc.trace.gauges()
+    assert g["mix.model_version"] == float(servers[0].mixer.model_version)
+
+
+def test_submit_faults_drop_and_inbox(async_cluster):
+    servers = async_cluster
+    _train(servers[0], [("l0", {"x": 1.0})])
+    assert servers[0].mixer.mix_now() is not None  # master + hint
+    _train(servers[1], [("l1", {"x": 2.0})])
+    me = servers[1].self_nodeinfo().name
+    # drop at the SENDER: the submit never leaves the member
+    with faults.armed(f"mix.async.submit.{me}:drop"):
+        assert servers[1].mixer.submit_now() is False
+    assert servers[0].mixer.inbox.depth() == 0
+    # drop at the RECEIVER's inbox: the submit is refused, told so
+    with faults.armed("mix.async.inbox.*:drop"):
+        assert servers[1].mixer.submit_now() is False
+    assert servers[0].mixer.inbox.depth() == 0
+    # clean path lands it
+    assert servers[1].mixer.submit_now() is True
+    assert servers[0].mixer.inbox.depth() == 1
+
+
+def test_schema_churn_prefix_folds_nonprefix_defers(async_cluster):
+    """Row-alignment gate: a payload whose sorted vocabulary is a
+    PREFIX of the union folds as-is (trailing rows pad with zeros); a
+    non-prefix payload (a novel EARLY-sorting label appeared
+    elsewhere) cannot be realigned after the fact — it defers one
+    tick while the union broadcast realigns its owner."""
+    servers = async_cluster
+    _train(servers[0], [("l0", {"x": 1.0})])
+    assert servers[0].mixer.mix_now() is not None  # master + hint
+    # member 1 trains a novel label sorting BEFORE l0: member 2's
+    # ["l0"] payload is no longer a prefix of the union ["a0","l0"]
+    _train(servers[1], [("a0", {"x": -2.0})])
+    _train(servers[2], [("l0", {"x": 3.0})])
+    assert servers[1].mixer.submit_now() is True
+    assert servers[2].mixer.submit_now() is True
+    r = servers[0].mixer.mix_now()
+    assert r is not None
+    deferred = r.get("deferred_schema") or 0
+    assert deferred >= 1
+    assert servers[0].rpc.trace.counters()[
+        "mix.async_schema_deferred"] >= 1
+    # after the union broadcast every member's vocabulary agrees;
+    # fresh snapshots fold cleanly
+    _train(servers[1], [("a0", {"x": -1.0})])
+    _train(servers[2], [("l0", {"x": 2.0})])
+    assert servers[1].mixer.submit_now() is True
+    assert servers[2].mixer.submit_now() is True
+    r = servers[0].mixer.mix_now()
+    assert r is not None and not r.get("deferred_schema")
+    assert r["contributors"] == 2
+
+
+def test_nonconcontributor_apply_captures_pending_updates(async_cluster):
+    """Loss-window closure: a fold's broadcast resets EVERY member's
+    accumulation (reference put_diff semantics), including members
+    whose diffs weren't in the fold — the bootstrap case: training
+    done before the first master election must survive the first
+    broadcast and reach the cluster via the capture."""
+    servers = async_cluster
+    # members 0 and 1 train DISJOINT labels before any round exists
+    _train(servers[0], [("l0", {"x": 2.0}), ("l1", {"x": -0.1})])
+    _train(servers[1], [("l1", {"x": -2.0}), ("l0", {"x": 0.1})])
+    # first fold: only the master's own diff is in it; the broadcast
+    # apply would have silently destroyed member 1's training
+    r1 = servers[0].mixer.mix_now()
+    assert r1 is not None and r1["contributors"] == 1
+    assert servers[1].rpc.trace.counters().get("mix.async_captures") == 1
+    # member 1's next submit carries the captured accumulation
+    assert servers[1].mixer.submit_now() is True
+    r2 = servers[0].mixer.mix_now()
+    assert r2["contributors"] == 1
+    # replica 2 never trained: it must now know BOTH members' lessons
+    out = _classify(servers[2], {"x": 2.0})
+    scores = {(e[0].decode() if isinstance(e[0], bytes) else e[0]): e[1]
+              for e in out[0]}
+    assert scores["l0"] > scores["l1"]
+    out = _classify(servers[2], {"x": -2.0})
+    scores = {(e[0].decode() if isinstance(e[0], bytes) else e[0]): e[1]
+              for e in out[0]}
+    assert scores["l1"] > scores["l0"]
+    # contributors never capture: their accumulator content was folded
+    assert not servers[0].rpc.trace.counters().get("mix.async_captures")
+
+
+def test_merge_delta_tree_keeps_normalization_scalars():
+    from jubatus_tpu.framework.async_mixer import _merge_delta_tree
+
+    a = {"dw": np.ones((2, 4), np.float32), "count": np.float32(1.0)}
+    b = {"dw": np.full((3, 4), 2.0, np.float32), "count": np.float32(1.0)}
+    out = _merge_delta_tree(a, b)
+    # arrays add with the trailing-row pad; the equal replica-count
+    # scalar stays 1 (one member's two deltas = ONE replica)
+    assert out["dw"].shape == (3, 4)
+    np.testing.assert_allclose(out["dw"][:2], 3.0)
+    np.testing.assert_allclose(out["dw"][2], 2.0)
+    assert float(out["count"]) == 1.0
+    # genuinely different scalars still add
+    out = _merge_delta_tree({"n": 2.0}, {"n": 3.0})
+    assert float(out["n"]) == 5.0
+
+
+def test_watch_row_shows_async_lag():
+    from jubatus_tpu.cmd.jubactl import _watch_node_row
+
+    row = _watch_node_row("n1", {"status": {
+        "health.status": "ok", "mixer.async_mode": True,
+        "mixer.async_lag_rounds": 2, "mixer.async_inbox_depth": 3,
+        "mixer.model_version": 7}, "error": ""}, active=True)
+    assert "lag 2" in row
+    assert "inbox 3" in row
+    assert "v7" in row

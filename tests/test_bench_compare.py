@@ -48,6 +48,11 @@ def test_direction_inference():
     assert bc.direction("collective_round_drift_vs_f32") == "lower"
     assert bc.direction("e2e_profiling_overhead_ok") == "bool"
     assert bc.direction("mix_under_1s_target") == "bool"
+    # async mix plane (ISSUE 11): serving-path stall and rounds-behind
+    # are down-good; the drift-parity gate is boolean
+    assert bc.direction("e2e_train_stall_during_mix_ms") == "lower"
+    assert bc.direction("e2e_async_mix_lag_rounds") == "lower"
+    assert bc.direction("e2e_async_mix_drift_parity_ok") == "bool"
     assert bc.direction("e2e_clients") is None
 
 

@@ -471,3 +471,54 @@ def test_int8_device_resident_leaves_and_prefer_device():
     assert err.max() > 0  # quantized
     assert err.max() < np.abs(host).max() / 64  # but sane
     assert ef.rounds == 1
+
+
+# -- cross-round streaming (ISSUE 11) ----------------------------------------
+
+def test_psum_pytree_start_streams_back_to_back_rounds():
+    """psum_pytree_start returns a handle immediately; two back-to-back
+    rounds overlap (round N+1's ship/reduce dispatch while round N's
+    readback drains) under the dispatch gate, and both totals are
+    bit-identical to the serial path."""
+    from jubatus_tpu.parallel.collective import psum_pytree_start
+
+    a = {"w": RNG.normal(size=(1 << 18,)).astype(np.float32)}
+    b = {"w": RNG.normal(size=(1 << 18,)).astype(np.float32)}
+    pa, pb = {}, {}
+    ra = psum_pytree_start(a, chunk_mb=0.25, phases=pa)
+    rb = psum_pytree_start(b, chunk_mb=0.25, phases=pb)  # queues on the gate
+    out_b = rb.result()  # collectable out of order (world of 1)
+    out_a = ra.result()
+    assert ra.done() and rb.done()
+    np.testing.assert_array_equal(out_a["w"],
+                                  psum_pytree(a, chunk_mb=0.25)["w"])
+    np.testing.assert_array_equal(out_b["w"],
+                                  psum_pytree(b, chunk_mb=0.25)["w"])
+    # the gate accounting is stamped per round
+    assert "dispatch_gate_ms" in pa and "dispatch_gate_ms" in pb
+    assert pa["dispatch_gate_ms"] >= 0 and pb["dispatch_gate_ms"] >= 0
+
+
+def test_psum_pytree_start_propagates_errors():
+    from jubatus_tpu.parallel.collective import psum_pytree_start
+
+    bad = psum_pytree_start({"x": np.zeros(4, np.float64)})
+    with pytest.raises(ValueError, match="64-bit"):
+        bad.result()
+    # the gate was released on the error path: a clean round still runs
+    out = psum_pytree({"w": np.ones((1 << 16,), np.float32)},
+                      chunk_mb=0.25)
+    np.testing.assert_array_equal(out["w"], 1.0)
+
+
+def test_dispatch_gate_serializes_many_concurrent_rounds():
+    """A pile of concurrent rounds (the 10x-cadence shape) all complete
+    with correct totals — the gate totally orders their collective
+    dispatch, so none can interleave and wedge."""
+    from jubatus_tpu.parallel.collective import psum_pytree_start
+
+    diffs = [{"w": np.full((1 << 16,), float(i + 1), np.float32)}
+             for i in range(6)]
+    handles = [psum_pytree_start(d, chunk_mb=0.0625) for d in diffs]
+    for i, h in enumerate(handles):
+        np.testing.assert_array_equal(h.result()["w"], float(i + 1))

@@ -618,3 +618,56 @@ def test_armed_scopes_compose():
             faults.fire("outer.site")
         faults.fire("inner.site")  # inner rule gone
     assert not faults.is_armed()
+
+
+def test_drop_mode_parse_and_fire():
+    """ISSUE 11: the ``drop`` mode — fire() reports True and drop-aware
+    sites silently lose the operation; error/delay behavior unchanged."""
+    r = faults.parse_rule("mix.comm.put_diff:drop")
+    assert r.action == "drop" and r.prob == 1.0
+    r = faults.parse_rule("mix.comm.*:drop:0.5")
+    assert r.action == "drop" and r.prob == 0.5
+    r = faults.parse_rule("mix.put_diff:drop@2")
+    assert r.remaining == 2
+    with faults.armed("some.site:drop@1"):
+        assert faults.fire("some.site") is True   # dropped once
+        assert faults.fire("some.site") is False  # budget spent
+    with faults.armed("err.site:error"):
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("err.site")
+    assert faults.fire("anything") is False  # disarmed: plain False
+
+
+def test_drop_mode_loses_mix_broadcast():
+    """A dropped put_diff broadcast = no member acks; the sync master
+    demotes nobody it can blame and the next round retries."""
+    from jubatus_tpu.framework.linear_mixer import RpcLinearCommunication
+
+    class _NoMc(RpcLinearCommunication):
+        def __init__(self):  # no coordinator: only the drop path runs
+            self.name = NAME
+
+    comm = _NoMc()
+    with faults.armed("mix.comm.put_diff:drop"):
+        assert comm.put_diff(b"payload") == {}
+    with faults.armed("mix.comm.get_diff:drop"):
+        assert comm.get_diff() == []
+
+
+def test_fault_flag_arms_at_server_boot(tmp_path):
+    """--fault SITE:MODE:ARG rules arm when the server constructs —
+    the operator's chaos-drill lever (same registry as the env var)."""
+    faults.disarm_all()
+    srv = EngineServer(
+        "classifier", CONF,
+        args=ServerArgs(engine="classifier",
+                        fault=["mix.put_diff:error@1"],
+                        telemetry_interval=0))
+    try:
+        assert faults.is_armed()
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("mix.put_diff")
+        faults.fire("mix.put_diff")  # @1 budget spent
+    finally:
+        srv.stop()
+        faults.disarm_all()

@@ -53,10 +53,15 @@ _HIGHER = re.compile(
 #: ships per round — the quantity the two-tier reduce holds down, so
 #: growth is a regression exactly like a latency
 #: ``rows_lost`` covers the elastic-membership plane (ISSUE 10): rows
-#: missing after a join/migrate/drain cycle — any growth is data loss
+#: missing after a join/migrate/drain cycle — any growth is data loss.
+#: ``_stall_ms`` / ``_lag_rounds`` cover the async mix plane (ISSUE
+#: 11): model-lock stall on the serving path and rounds-behind-master
+#: — both down-good (`_stall_ms` already matches `_ms`, listed for the
+#: record; `_lag_rounds` needs its own pattern)
 _LOWER = re.compile(
     r"(_ms($|_)|_ratio($|_)|wire_mb|_per_host($|_)|drift"
-    r"|_error(s)?($|_)|_timeouts|_errors_total|_denials|rows_lost)")
+    r"|_error(s)?($|_)|_timeouts|_errors_total|_denials|rows_lost"
+    r"|_stall_ms($|_)|_lag_rounds($|_))")
 
 #: built-in per-key tolerance defaults (explicit --key-tolerance wins):
 #: the nproc16 sweep time-slices 16 gloo processes over however few
