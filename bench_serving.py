@@ -36,6 +36,11 @@ K = 32                  # numeric features per datum
 WARMUP_SECONDS = 12.0
 MEASURE_SECONDS = 20.0
 TEXT_MEASURE_SECONDS = 12.0
+#: base seed for every client worker's rng (ISSUE 12 satellite): each
+#: client derives its stream from [SEED, client_idx], so a whole run's
+#: traffic trace is reproducible across runs — the pid-seeded rngs the
+#: clients used before made no two runs comparable. --seed overrides.
+SEED = 1729
 
 CONF = {
     "method": "AROW",
@@ -106,7 +111,11 @@ port, call_batch, k, warmup, measure, workload = (
     int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
     float(sys.argv[4]), float(sys.argv[5]), sys.argv[6])
 from jubatus_tpu.client import Datum
-rng = np.random.default_rng(os.getpid())
+# replayable traffic (ISSUE 12): per-client stream derived from the
+# run's base seed + this client's index ("pid" keeps the old behavior)
+seed, idx = sys.argv[7], int(sys.argv[8])
+rng = (np.random.default_rng(os.getpid()) if seed == "pid"
+       else np.random.default_rng([int(seed), idx]))
 VOCAB = [f"w{i:03d}" for i in range(400)]
 
 def mk_datum():
@@ -212,7 +221,7 @@ def run(transport: str = "python", workload: str = "numeric",
         conf: dict = CONF, measure: float = MEASURE_SECONDS,
         tag: str = "", microbatch: int = 0, native_ingest: bool = True,
         forensics: bool = True, model_health=None,
-        profile_hz=None) -> dict:
+        profile_hz=None, seed=None) -> dict:
     from jubatus_tpu.server import EngineServer
     from jubatus_tpu.server.args import ServerArgs
 
@@ -298,9 +307,9 @@ def run(transport: str = "python", workload: str = "numeric",
             subprocess.Popen(
                 [sys.executable, "-c", _CLIENT_PROG, str(port),
                  str(CALL_BATCH), str(K), str(WARMUP_SECONDS), str(measure),
-                 wl],
+                 wl, str(SEED if seed is None else seed), str(idx)],
                 env=env, cwd=repo, stdout=subprocess.PIPE, text=True)
-            for wl in wl_list
+            for idx, wl in enumerate(wl_list)
         ]
         dead: list = []
         for idx, (p, wl) in enumerate(zip(procs, wl_list)):
@@ -622,9 +631,9 @@ def run_proxy(transport: str = "python",
             subprocess.Popen(
                 [sys.executable, "-c", _CLIENT_PROG, str(pport),
                  str(CALL_BATCH), str(K), str(WARMUP_SECONDS), str(measure),
-                 "numeric"],
+                 "numeric", str(SEED), str(idx)],
                 env=env, cwd=repo, stdout=subprocess.PIPE, text=True)
-            for _ in range(N_CLIENTS)
+            for idx in range(N_CLIENTS)
         ]
         total, elapsed_max = 0, 0.0
         for p in procs:
@@ -679,7 +688,11 @@ port, call_batch, k, warmup, measure, workload = (
     int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
     float(sys.argv[4]), float(sys.argv[5]), sys.argv[6])
 from jubatus_tpu.client import Datum
-rng = np.random.default_rng(os.getpid())
+# replayable traffic (ISSUE 12): same per-client stream derivation as
+# the main client program — churn traces replay across runs too
+seed, idx = sys.argv[7], int(sys.argv[8])
+rng = (np.random.default_rng(os.getpid()) if seed == "pid"
+       else np.random.default_rng([int(seed), idx]))
 
 def mk_datum():
     return Datum({f"f{j}": float(v)
@@ -843,9 +856,9 @@ def run_churn(transport: str = "python", measure: float = 60.0,
             ps = [subprocess.Popen(
                 [sys.executable, "-c", _CHURN_CLIENT_PROG, str(pport),
                  str(CALL_BATCH), str(K), str(WARMUP_SECONDS / 2),
-                 str(seconds), wl],
+                 str(seconds), wl, str(SEED), str(idx)],
                 env=env, cwd=repo, stdout=subprocess.PIPE, text=True)
-                for wl in wl_list]
+                for idx, wl in enumerate(wl_list)]
             procs.extend(ps)
             # quantile hygiene (same stance as run()): drop the clients'
             # warmup window (compiles, cold sockets) from the phase's
@@ -1243,6 +1256,400 @@ def run_async_mix(rounds: int = 12, storm_seconds: float = 4.0) -> dict:
     return out
 
 
+def _fleet_sim():
+    """Import tools/fleet_sim.py (tools/ is not a package)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tools = os.path.join(repo, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import fleet_sim
+
+    return fleet_sim
+
+
+def run_fleet(nproc: int = 8, initial: int = 2, max_replicas: int = 6,
+              quiet: float = 12.0, flash_len: float = 28.0,
+              tail: float = 15.0, seed=None,
+              per_flush_s: float = 0.1, flush_examples: int = 12,
+              base_rate: float = 8.0, flash_mult: float = 10.0,
+              call_batch: int = 4, slo_ms: float = 400.0) -> dict:
+    """Autoscale flash-crowd drill (ISSUE 12): a seeded 10x traffic
+    step against proxy + classifier fleet, autoscaled vs a static
+    control fleet.
+
+    Sizing: per-replica capacity is pinned at ``flush_examples /
+    per_flush_s`` = 120 examples/s = 30 req/s. Base load 8 req/s runs
+    the initial 2 replicas at ~13%; the 10x step offers 80 req/s —
+    1.33x the static fleet's capacity (pinned underwater for the whole
+    flash) but 0.44 utilization at the autoscaled max of 6, so
+    queueing settles well under the 400 ms SLO (4 flush quanta) after
+    scale-out. The whole peak stays beneath the one bench core's REAL
+    Python proxy+backend throughput ceiling (~190 req/s measured):
+    above it, CPU — which added replicas share — becomes the binding
+    constraint and the drill would measure the box, not the control
+    loop.
+
+    Each backend's device flush is throttled to a fixed per-flush cost
+    (a GIL-releasing sleep) with the flush size capped at
+    ``flush_examples``, so per-replica capacity is pinned to
+    ``flush_examples / per_flush_s`` examples/s and replica count — not
+    the one bench core — bounds fleet capacity: scale-out genuinely
+    adds capacity, which is the property under test, and overload
+    genuinely backs up in ``microbatch.queue_depth``. Load comes from
+    tools/fleet_sim.py (diurnal curve + zipf hot users + tenant mix +
+    one flash-crowd step at ``quiet`` seconds), identical traffic on
+    both runs (same seed).
+
+    Keys of record:
+
+    - ``e2e_scaleout_recovery_s`` — flash onset to the first 3-second
+      violation-free stretch on the autoscaled fleet (client-observed).
+    - ``e2e_autoscale_slo_violation_s`` / ``e2e_static_slo_violation_s``
+      — violated seconds from flash onset on each fleet;
+      ``e2e_autoscale_beats_static_ok`` gates autoscaled < static.
+    - ``e2e_capacity_per_replica`` — late-flash completed examples/s
+      per serving replica on the autoscaled fleet.
+    - ``e2e_autoscale_scaleout_latency_s`` — flash onset to the first
+      scale_out journal record (the control loop's reaction time).
+    """
+    from jubatus_tpu.coord.autoscaler import (AutoscaleConfig, Autoscaler,
+                                              HookActuator)
+    from jubatus_tpu.coord.base import NodeInfo
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+    from bench_mix import scrub_child_env
+
+    fleet_sim = _fleet_sim()
+    seed = SEED if seed is None else int(seed)
+    seconds = quiet + flash_len + tail
+    model = fleet_sim.TrafficModel(
+        seed=seed, base_rate=base_rate, diurnal_period_s=240.0,
+        diurnal_amplitude=0.15, flash=((quiet, flash_len, flash_mult),))
+
+    prev = os.environ.get("JUBATUS_TPU_NATIVE_RPC")
+    os.environ["JUBATUS_TPU_NATIVE_RPC"] = "0"
+
+    def throttle(srv):
+        # fixed per-flush device cost + capped flush size: capacity
+        # rides replica count, not the shared bench core (the sleep
+        # releases the GIL; the batch itself is never touched — the
+        # pipelined coalescer's device stage receives PREPARED batches
+        # whose shape is the flush fn's business, not ours)
+        for co in srv.coalescers.values():
+            orig = co._flush
+
+            def slowed(batch, _orig=orig):
+                time.sleep(per_flush_s)
+                return _orig(batch)
+
+            co._flush = slowed
+
+    def run_side(autoscaled: bool) -> dict:
+        store = _Store()
+        servers = []
+        srv_lock = threading.Lock()
+        stop = threading.Event()
+
+        def boot():
+            srv = EngineServer(
+                "classifier", CONF,
+                args=ServerArgs(
+                    engine="classifier", coordinator="(shared)",
+                    name="fleet", listen_addr="127.0.0.1", thread=32,
+                    interval_sec=1e9, interval_count=1 << 30,
+                    microbatch_max=flush_examples,
+                    telemetry_interval=1.0,
+                    slo=[f"latency:rpc.train:p99:{slo_ms:g}"],
+                    slo_fast_window=5.0, slo_slow_window=15.0),
+                coord=MemoryCoordinator(store))
+            srv.start(0)
+            throttle(srv)
+            with srv_lock:
+                servers.append(srv)
+            return srv
+
+        def spawn(n):
+            for _ in range(int(n)):
+                boot()
+
+        def drain(target):
+            node = NodeInfo.from_name(target)
+            with srv_lock:
+                victim = next((s for s in servers
+                               if s.args.rpc_port == node.port), None)
+            if victim is None:
+                raise RuntimeError(f"no local server {target}")
+            with RpcClient(node.host, node.port, timeout=30.0) as c:
+                c.call("drain", "fleet", False)
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    st = c.call("drain_status", "fleet")
+                    state = st.get("state")
+                    state = state.decode() if isinstance(state, bytes) \
+                        else state
+                    if state == "drained":
+                        break
+                    time.sleep(0.2)
+            victim.stop()
+            with srv_lock:
+                servers.remove(victim)
+
+        proxy = scaler = None
+        try:
+            for _ in range(initial):
+                boot()
+            # each forwarded train call parks a proxy worker for the
+            # backend's full coalesce latency — the pool must cover the
+            # clients' aggregate in-flight or the PROXY becomes the
+            # capacity ceiling and scale-out can't show
+            proxy = Proxy(ProxyArgs(engine="classifier",
+                                    listen_addr="127.0.0.1", thread=256,
+                                    interconnect_timeout=120.0),
+                          coord=MemoryCoordinator(store))
+            pport = proxy.start(0)
+            # warm the jit caches (first train compiles ~seconds) and
+            # drop the compile-era histograms BEFORE the clock starts:
+            # the drill measures the control loop, not XLA compilation.
+            # In-process replicas share one jit cache, so later spawns
+            # boot warm.
+            from jubatus_tpu.client import Datum as _Datum
+
+            warm_batches = []
+            for tenant, _w in model.tenants:
+                d = _Datum({f"{tenant[:2]}{j}": 0.5 for j in range(8)})
+                for b in (1, call_batch, flush_examples // call_batch):
+                    warm_batches.append([["a", d.to_msgpack()]]
+                                        * max(b, 1))
+            for s in list(servers):
+                with RpcClient("127.0.0.1", s.args.rpc_port,
+                               timeout=60.0) as c:
+                    for batch in warm_batches:
+                        c.call("train", "fleet", batch)
+                s.rpc.trace.reset()
+            cfg = AutoscaleConfig(
+                min_replicas=initial, max_replicas=max_replicas,
+                poll_interval_s=1.0, window_s=8.0, burn_hot=2.0,
+                queue_hot=100.0, queue_cold_fraction=0.3,
+                scale_out_confirm=2, scale_out_step=2,
+                # scale-in is proven by run_fleet_scalein; inside the
+                # drill it must not shrink the fleet mid-phase
+                scale_in_confirm=10_000,
+                cooldown_s=3.0, backoff_initial_s=1.0,
+                dry_run=not autoscaled)
+            scaler = Autoscaler(MemoryCoordinator(store), "classifier",
+                                "fleet", HookActuator(spawn, drain),
+                                config=cfg)
+            sizes = []  # (wall_ts, fleet size) sampled per poll
+
+            def tick_loop():
+                while not stop.wait(cfg.poll_interval_s):
+                    try:
+                        rec = scaler.tick()
+                        sizes.append((rec["ts"],
+                                      rec["signals"]["replicas"]))
+                    except Exception:  # noqa: BLE001 — bench loop
+                        pass
+
+            ctl = threading.Thread(target=tick_loop, daemon=True,
+                                   name="fleet-autoscaler")
+            ctl.start()
+            t0_wall = time.time()
+            out = fleet_sim.drive(
+                pport, model, nproc, seconds, cluster="fleet",
+                workload="train", call_batch=call_batch,
+                lat_slo_ms=slo_ms, inflight_cap=16,
+                env=scrub_child_env(os.environ))
+            stop.set()
+            ctl.join(timeout=10.0)
+            # worker-reported clock anchor beats the pre-spawn wall
+            # time (worker imports cost seconds before the trace runs)
+            out.setdefault("t0_wall", t0_wall)
+            out["journal"] = list(scaler.journal)
+            out["sizes"] = sizes
+            out["final_replicas"] = len(servers)
+            out["counters"] = {
+                k: v for k, v in scaler.registry.counters().items()
+                if k.startswith("autoscale.")}
+            return out
+        finally:
+            stop.set()
+            if scaler is not None:
+                scaler.stop()
+            if proxy is not None:
+                proxy.stop()
+            with srv_lock:
+                doomed = list(servers)
+            for s in doomed:
+                try:
+                    s.stop()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+
+    out: dict = {"e2e_fleet_nproc": nproc, "e2e_fleet_seed": seed,
+                 "e2e_fleet_offered_req_per_sec_base": base_rate,
+                 "e2e_fleet_flash_multiplier": flash_mult}
+    try:
+        auto = run_side(autoscaled=True)
+        static = run_side(autoscaled=False)
+    finally:
+        if prev is None:
+            os.environ.pop("JUBATUS_TPU_NATIVE_RPC", None)
+        else:
+            os.environ["JUBATUS_TPU_NATIVE_RPC"] = prev
+    onset = int(quiet)
+    for tag, side in (("autoscale", auto), ("static", static)):
+        viol = fleet_sim.violation_seconds(
+            side["per_sec"], start=onset, end=int(seconds) + 1)
+        out[f"e2e_{tag}_slo_violation_s"] = len(viol)
+        out[f"e2e_{tag}_done_total"] = side["done"]
+        out[f"e2e_{tag}_shed_total"] = side["shed"]
+        out[f"e2e_{tag}_error_total"] = side["errors"]
+        if side.get("dead"):
+            out[f"e2e_{tag}_dead_clients"] = "; ".join(side["dead"])
+        if tag == "autoscale":
+            rec = fleet_sim.recovery_second(viol, onset,
+                                            horizon=int(seconds))
+            out["e2e_scaleout_recovery_s"] = (
+                round(rec - onset, 1) if rec is not None else -1.0)
+    # control-loop reaction time + fleet trajectory (autoscaled side)
+    spawns = [j for j in auto["journal"] if j["action"] == "scale_out"]
+    if spawns:
+        out["e2e_autoscale_scaleout_latency_s"] = round(
+            spawns[0]["ts"] - (auto["t0_wall"] + quiet), 1)
+    out["e2e_autoscale_spawns"] = auto["counters"].get(
+        "autoscale.spawns", 0)
+    out["e2e_autoscale_drains"] = auto["counters"].get(
+        "autoscale.drains", 0)
+    out["e2e_autoscale_blocked"] = auto["counters"].get(
+        "autoscale.blocked", 0)
+    out["e2e_autoscale_final_replicas"] = auto["final_replicas"]
+    # capacity per replica: late-flash completed examples/s over the
+    # serving fleet size then (sizes sampled per poll, wall-clock)
+    late0, late1 = int(quiet + flash_len - 8), int(quiet + flash_len)
+    done = auto["per_sec"]["done"][late0:late1]
+    late_sizes = [n for ts, n in auto["sizes"]
+                  if auto["t0_wall"] + late0 <= ts
+                  <= auto["t0_wall"] + late1]
+    if done and late_sizes:
+        out["e2e_capacity_per_replica"] = round(
+            (sum(done) * call_batch / len(done))
+            / max(sum(late_sizes) / len(late_sizes), 1.0), 1)
+    both = ("e2e_autoscale_slo_violation_s" in out
+            and "e2e_static_slo_violation_s" in out)
+    if both:
+        out["e2e_autoscale_beats_static_ok"] = bool(
+            out["e2e_autoscale_slo_violation_s"]
+            < out["e2e_static_slo_violation_s"])
+    return out
+
+
+def run_fleet_scalein(rows: int = 600) -> dict:
+    """Scale-in half of the drill: an IDLE 3-member nearest_neighbor
+    fleet goes sustained-cold, the autoscaler drains the least-loaded
+    member through the ISSUE 10 state machine, and every row survives
+    on the remaining members — ``e2e_churn_rows_lost`` must stay 0
+    through an autoscaler-initiated drain."""
+    import numpy as _np
+
+    from jubatus_tpu.client import Datum as _Datum
+    from jubatus_tpu.coord.autoscaler import (AutoscaleConfig, Autoscaler,
+                                              HookActuator)
+    from jubatus_tpu.coord.base import NodeInfo
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    conf = {"method": "lsh", "parameter": {"hash_num": 64},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    store = _Store()
+    servers = []
+
+    def boot():
+        srv = EngineServer(
+            "nearest_neighbor", conf,
+            args=ServerArgs(engine="nearest_neighbor",
+                            coordinator="(shared)", name="fleet",
+                            listen_addr="127.0.0.1", thread=4,
+                            interval_sec=1e9, interval_count=1 << 30,
+                            telemetry_interval=1.0),
+            coord=MemoryCoordinator(store))
+        srv.start(0)
+        servers.append(srv)
+        return srv
+
+    def drain(target):
+        node = NodeInfo.from_name(target)
+        victim = next(s for s in servers
+                      if s.args.rpc_port == node.port)
+        with RpcClient(node.host, node.port, timeout=60.0) as c:
+            c.call("drain", "fleet", False)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                st = c.call("drain_status", "fleet")
+                state = st.get("state")
+                state = state.decode() if isinstance(state, bytes) \
+                    else state
+                if state == "drained":
+                    break
+                time.sleep(0.2)
+        victim.stop()
+        servers.remove(victim)
+
+    out: dict = {}
+    scaler = None
+    try:
+        for _ in range(3):
+            boot()
+        rng = _np.random.default_rng(SEED)
+        clients = [RpcClient("127.0.0.1", s.args.rpc_port, timeout=30.0)
+                   for s in servers]
+        for i in range(rows):
+            d = _Datum({f"f{j}": float(v)
+                        for j, v in enumerate(rng.normal(size=16))})
+            clients[i % 3].call("set_row", "fleet", f"row{i:06d}",
+                                d.to_msgpack())
+        for c in clients:
+            c.close()
+        scaler = Autoscaler(
+            MemoryCoordinator(store), "nearest_neighbor", "fleet",
+            HookActuator(lambda n: boot(), drain),
+            config=AutoscaleConfig(
+                min_replicas=2, max_replicas=3, poll_interval_s=0.5,
+                scale_in_confirm=3, cooldown_s=0.0))
+        deadline = time.monotonic() + 60.0
+        drained = 0
+        while time.monotonic() < deadline and drained == 0:
+            rec = scaler.tick()
+            drained = scaler.registry.counters().get(
+                "autoscale.drains", 0)
+            time.sleep(0.5)
+        out["e2e_autoscale_scalein_drains"] = drained
+        survivors = set()
+        for s in servers:
+            with RpcClient("127.0.0.1", s.args.rpc_port,
+                           timeout=30.0) as c:
+                for rid in c.call("get_all_rows", "fleet"):
+                    survivors.add(rid.decode()
+                                  if isinstance(rid, bytes) else rid)
+        expect = {f"row{i:06d}" for i in range(rows)}
+        out["e2e_churn_rows_total"] = rows
+        out["e2e_churn_rows_lost"] = len(expect - survivors)
+        out["e2e_autoscale_scalein_replicas"] = len(servers)
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+    return out
+
+
 def collect(trials: int = 2) -> dict:
     """Alternate transports and keep each one's best trial: run-to-run
     spread through the device tunnel is ~±10% (host scheduling + tunnel
@@ -1414,11 +1821,36 @@ def collect(trials: int = 2) -> dict:
         out.update(run_async_mix())
     except Exception as e:  # noqa: BLE001
         out["e2e_async_mix_error"] = repr(e)[:200]
+    # autoscaling flash-crowd drill (ISSUE 12): seeded 7x traffic step,
+    # autoscaled vs static control fleet, plus the autoscaler-initiated
+    # scale-in drain's row parity
+    try:
+        out.update(run_fleet())
+    except Exception as e:  # noqa: BLE001
+        out["e2e_fleet_error"] = repr(e)[:200]
+    try:
+        out.update(run_fleet_scalein())
+    except Exception as e:  # noqa: BLE001
+        out["e2e_fleet_scalein_error"] = repr(e)[:200]
     return out
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "asyncmix":
+    # --seed N (ISSUE 12 satellite): override the base traffic seed for
+    # any slice; every client stream derives from [SEED, client_idx]
+    if "--seed" in sys.argv:
+        i = sys.argv.index("--seed")
+        SEED = int(sys.argv[i + 1])
+        del sys.argv[i:i + 2]
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        # the autoscale drill on its own (flash-crowd step + scale-in
+        # row parity), for ISSUE 12 iteration without the full bench
+        out = {}
+        nproc = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        out.update(run_fleet(nproc=nproc))
+        out.update(run_fleet_scalein())
+        print(json.dumps(out, indent=1))
+    elif len(sys.argv) > 1 and sys.argv[1] == "asyncmix":
         # the async-mix slice on its own (drift parity + cadence/stall
         # storm), for ISSUE 11 iteration without the full bench
         print(json.dumps(run_async_mix(), indent=1))

@@ -25,7 +25,13 @@ scrapes every member's span store (``get_spans``) AND every registered
 proxy's (``get_proxy_spans``), stitches the parent/child edges into ONE
 cross-node span tree, and renders it with per-hop timings — the
 distributed answer to "where did this slow request spend its time?".
-``profile`` (ISSUE 8) scrapes every member's folded stack samples
+``autoscale`` (ISSUE 12) runs the autoscaling control loop in the
+foreground — poll SLO burn + queue depth, spawn replicas through
+registered jubavisors, drain the least-loaded member when sustained-cold
+— serving its decision journal over ``get_autoscale_status``;
+``--watch`` renders live frames (attaching to an already-registered
+autoscaler instead of starting a second loop), ``--once`` renders one
+observe-only tick. ``profile`` (ISSUE 8) scrapes every member's folded stack samples
 (``get_profile``) and every proxy's own (``get_proxy_profile``), folds
 them into ONE cluster profile, and renders a top-N self/cumulative
 table — or ``--folded`` collapsed-stack lines for flamegraph.pl /
@@ -52,7 +58,8 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("-c", "--cmd", required=True,
                    choices=["start", "stop", "save", "load", "status",
                             "metrics", "breakers", "trace", "alerts",
-                            "watch", "profile", "drain", "rebalance"])
+                            "watch", "profile", "drain", "rebalance",
+                            "autoscale"])
     p.add_argument("trace_id", nargs="?", default="",
                    help="[trace] trace id to assemble (from a slow-log "
                         "record, a /metrics exemplar, or "
@@ -92,6 +99,44 @@ def _parser() -> argparse.ArgumentParser:
                         "drained for inspection")
     p.add_argument("--drain-timeout", type=float, default=120.0,
                    help="[drain] seconds to wait for the drained state")
+    # autoscaling control plane (ISSUE 12)
+    p.add_argument("--watch", action="store_true",
+                   help="[autoscale] render a live frame every poll "
+                        "(attaches to an already-registered autoscaler's "
+                        "get_autoscale_status instead of starting a "
+                        "second control loop)")
+    p.add_argument("--min", dest="as_min", type=int, default=1,
+                   help="[autoscale] fleet floor — a fleet below it "
+                        "restores immediately, bypassing confirm and "
+                        "cooldown")
+    p.add_argument("--max", dest="as_max", type=int, default=8,
+                   help="[autoscale] fleet ceiling for scale-out")
+    p.add_argument("--autoscale-interval", type=float, default=5.0,
+                   help="[autoscale] control-loop poll period (seconds)")
+    p.add_argument("--cooldown", type=float, default=30.0,
+                   help="[autoscale] quiet period after any actuation")
+    p.add_argument("--scale-out-confirm", type=int, default=2,
+                   help="[autoscale] consecutive hot polls before a "
+                        "scale-out fires (flap suppression)")
+    p.add_argument("--scale-in-confirm", type=int, default=6,
+                   help="[autoscale] consecutive cold polls before a "
+                        "scale-in drains the least-loaded replica")
+    p.add_argument("--burn-hot", type=float, default=2.0,
+                   help="[autoscale] SLO fast-window burn rate at/above "
+                        "which a poll counts hot")
+    p.add_argument("--queue-hot", type=float, default=4096.0,
+                   help="[autoscale] queued examples per replica "
+                        "(microbatch.queue_depth) at/above which a poll "
+                        "counts hot")
+    p.add_argument("--autoscale-port", type=int, default=0,
+                   help="[autoscale] port for the get_autoscale_status "
+                        "RPC (0 = ephemeral); registered under "
+                        "/jubatus/autoscalers")
+    p.add_argument("--dry-run", action="store_true",
+                   help="[autoscale] observe and journal decisions, "
+                        "never actuate (the safe exploration mode; "
+                        "--once defaults to it when no autoscaler is "
+                        "registered)")
     p.add_argument("-s", "--server", default="",
                    help="server name forwarded to jubavisor "
                         "(jubaclassifier or plain engine name)")
@@ -807,6 +852,156 @@ def show_trace(coord: Coordinator, engine: str, name: str,
     return 0
 
 
+def render_autoscale_frame(doc: Dict[str, Any], ts: str = "",
+                           journal_rows: int = 8) -> str:
+    """One autoscaler status frame as text (pure; asserted by tests,
+    printed by --watch/--once): fleet signals, controller state,
+    decision counters, per-replica rows, and the journal tail."""
+    lines: List[str] = []
+    fleet = doc.get("fleet") or {}
+    st = doc.get("state") or {}
+    counters = doc.get("counters") or {}
+    cfg = doc.get("config") or {}
+    lines.append(
+        f"{doc.get('engine')}/{doc.get('name')} autoscaler"
+        f"{'  ' + ts if ts else ''}  "
+        f"fleet {fleet.get('replicas', '?')} replica(s) "
+        f"[{cfg.get('min_replicas', '?')}..{cfg.get('max_replicas', '?')}]"
+        f"  burn {fleet.get('burn_max', 0.0):g}"
+        f"  queue/replica {fleet.get('queue_per_replica', 0.0):g}"
+        f"  req/s {fleet.get('req_per_sec', 0.0):g}"
+        + ("  [dry-run]" if cfg.get("dry_run") else ""))
+    lines.append(
+        f"  state: hot_streak {st.get('hot_streak', 0)}, "
+        f"cold_streak {st.get('cold_streak', 0)}, "
+        f"backoff_s {st.get('backoff_s', 0.0):g}; counters: "
+        + ", ".join(f"{k.split('.', 1)[1]} {counters.get(k, 0)}"
+                    for k in ("autoscale.decisions", "autoscale.spawns",
+                              "autoscale.drains", "autoscale.blocked")))
+    for r in doc.get("replicas") or []:
+        mark = ("drain" if r.get("draining")
+                else "DOWN" if not r.get("reachable", True) else "ok")
+        lines.append(
+            f"  {r.get('name', '?'):<22} {mark:<6} "
+            f"req/s {r.get('req_per_sec', 0.0):>8.1f}  "
+            f"p99 {r.get('p99_ms', 0.0):>8.1f} ms  "
+            f"queue {r.get('queue_depth', 0.0):>8.0f}  "
+            f"burn {r.get('burn_max', 0.0):>6.2f}"
+            + ("  FIRING" if r.get("firing") else ""))
+    tail = (doc.get("journal") or [])[-journal_rows:]
+    moves = [j for j in tail if j.get("action") != "hold"] or tail[-3:]
+    lines.append(f"  journal ({len(doc.get('journal') or [])} record(s) "
+                 "retained):")
+    for j in moves[-journal_rows:]:
+        extra = ""
+        if j.get("target"):
+            extra += f" target={j['target']}"
+        if j.get("count"):
+            extra += f" count={j['count']}"
+        if j.get("error"):
+            extra += f" error={j['error'][:60]}"
+        if j.get("backoff_s"):
+            extra += f" backoff={j['backoff_s']:g}s"
+        lines.append(f"    t={j.get('ts', 0):.1f}  "
+                     f"{j.get('action', '?'):<10} {j.get('reason', ''):<18}"
+                     f" {j.get('signals', {})}{extra}")
+    return "\n".join(lines)
+
+
+def _attach_autoscaler(coord: Coordinator) -> Optional[NodeInfo]:
+    """First reachable registered autoscaler, or None."""
+    for node in membership.get_autoscalers(coord):
+        try:
+            with RpcClient(node.host, node.port, timeout=5.0) as c:
+                c.call("get_autoscale_status", "", 1)
+            return node
+        except Exception:  # noqa: BLE001 — stale ephemeral entry
+            continue
+    return None
+
+
+def run_autoscale(coord: Coordinator, engine: str, name: str,
+                  ns: Any) -> int:
+    """ISSUE 12: the autoscaling control loop. Default: run the loop in
+    the foreground (spawning via registered jubavisors, draining via
+    the member drain RPC), serving ``get_autoscale_status``. With an
+    autoscaler already registered, ``--watch``/``--once`` ATTACH to it
+    and render its journal instead of starting a competing loop; a
+    bare ``--once`` with no autoscaler running does one observe-only
+    (dry-run) tick and renders it."""
+    import time as _time
+
+    from jubatus_tpu.coord.autoscaler import (AutoscaleConfig, Autoscaler,
+                                              VisorActuator)
+
+    remote = _attach_autoscaler(coord) if (ns.watch or ns.once) else None
+    if remote is not None:
+        print(f"attached to autoscaler {remote.name}", file=sys.stderr)
+        while True:
+            try:
+                with RpcClient(remote.host, remote.port, timeout=10.0) as c:
+                    per_node = c.call("get_autoscale_status", name, 32)
+            except Exception as e:  # noqa: BLE001 — it may have exited
+                print(f"autoscaler {remote.name} unreachable: {e}",
+                      file=sys.stderr)
+                return -1
+            doc = next(iter((per_node or {}).values()), {})
+            frame = render_autoscale_frame(doc, ts=_time.strftime("%H:%M:%S"))
+            if ns.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            try:
+                _time.sleep(max(ns.interval, 0.2))
+            except KeyboardInterrupt:
+                return 0
+    try:
+        config = AutoscaleConfig(
+            min_replicas=ns.as_min, max_replicas=ns.as_max,
+            poll_interval_s=ns.autoscale_interval, window_s=ns.window,
+            cooldown_s=ns.cooldown, scale_out_confirm=ns.scale_out_confirm,
+            scale_in_confirm=ns.scale_in_confirm, burn_hot=ns.burn_hot,
+            queue_hot=ns.queue_hot,
+            dry_run=bool(ns.dry_run or ns.once)).validate()
+    except ValueError as e:
+        print(f"autoscale: {e}", file=sys.stderr)
+        return 2
+    if not ns.once and membership.get_autoscalers(coord):
+        # a registered loop exists but did not answer — warn, continue
+        print("warning: another autoscaler is registered for this "
+              "coordinator (stale entry, or it will fight this one)",
+              file=sys.stderr)
+    actuator = VisorActuator(coord, engine, name, server_argv={
+        "thread": ns.thread, "timeout": ns.timeout,
+        "datadir": ns.datadir, "logdir": ns.logdir, "mixer": ns.mixer,
+        "interval_sec": ns.interval_sec,
+        "interval_count": ns.interval_count})
+    scaler = Autoscaler(coord, engine, name, actuator, config=config)
+    if ns.once:
+        rec = scaler.tick()
+        print(render_autoscale_frame(scaler.status()))
+        return 0 if rec else -1
+    port = scaler.serve(ns.autoscale_port)
+    print(f"autoscaler for {engine}/{name} up "
+          f"(get_autoscale_status on 127.0.0.1:{port}, "
+          f"bounds [{config.min_replicas}..{config.max_replicas}]"
+          + (", DRY RUN)" if config.dry_run else ")"), file=sys.stderr)
+    try:
+        while True:
+            scaler.tick()
+            if ns.watch:
+                frame = render_autoscale_frame(
+                    scaler.status(), ts=_time.strftime("%H:%M:%S"))
+                sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+                sys.stdout.flush()
+            _time.sleep(max(config.poll_interval_s, 0.2))
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        scaler.stop()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ns = _parser().parse_args(argv)
     spec = resolve_coordinator(ns.coordinator)
@@ -835,6 +1030,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 timeout=ns.drain_timeout)
         if ns.cmd == "rebalance":
             return rebalance_cluster(coord, ns.type, ns.name)
+        if ns.cmd == "autoscale":
+            return run_autoscale(coord, ns.type, ns.name, ns)
         if ns.cmd == "profile":
             return show_profile(coord, ns.type, ns.name,
                                 seconds=ns.seconds, folded=ns.folded,

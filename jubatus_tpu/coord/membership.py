@@ -39,6 +39,9 @@ ACTOR_BASE = f"{JUBATUS_BASE}/actors"
 CONFIG_BASE = f"{JUBATUS_BASE}/config"
 SUPERVISOR_BASE = f"{JUBATUS_BASE}/supervisors"
 PROXY_BASE = f"{JUBATUS_BASE}/jubaproxies"
+#: autoscaler control loops (ISSUE 12): ephemeral, one per fleet —
+#: jubactl -c autoscale --watch finds the journal/status RPC here
+AUTOSCALER_BASE = f"{JUBATUS_BASE}/autoscalers"
 
 
 def actor_path(engine: str, name: str) -> str:
@@ -178,3 +181,15 @@ def register_supervisor(coord: Coordinator, host: str, port: int) -> str:
     path = f"{SUPERVISOR_BASE}/{NodeInfo(host, port).name}"
     coord.create(path, ephemeral=True)
     return path
+
+
+def register_autoscaler(coord: Coordinator, host: str, port: int) -> str:
+    """Ephemeral autoscaler registration (ISSUE 12) — dies with the
+    control loop, so a crashed autoscaler never shadows a new one."""
+    path = f"{AUTOSCALER_BASE}/{NodeInfo(host, port).name}"
+    coord.create(path, ephemeral=True)
+    return path
+
+
+def get_autoscalers(coord: Coordinator) -> List[NodeInfo]:
+    return _nodes_under(coord, AUTOSCALER_BASE)

@@ -227,6 +227,8 @@ IDEMPOTENT_BUILTINS: FrozenSet[str] = frozenset({
     "get_epoch", "drain_status", "migrate_range", "get_row_count",
     # async mix (ISSUE 11): the inbox/fold status read is pure
     "mix_async_status",
+    # autoscaling control plane (ISSUE 12): journal/status read is pure
+    "get_autoscale_status",
 })
 
 #: effectful built-ins, listed for the docs' idempotency matrix (anything

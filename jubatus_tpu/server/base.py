@@ -521,11 +521,25 @@ class EngineServer:
 
     # -- model-health plane (ISSUE 7) ----------------------------------------
     def _model_health_tick(self) -> None:
-        """One telemetry tick: snapshot the registry into the
-        time-series ring, then re-evaluate every SLO's burn rates
-        against the updated ring."""
+        """One telemetry tick: gauge the coalescer load signals, then
+        snapshot the registry into the time-series ring and re-evaluate
+        every SLO's burn rates against the updated ring."""
         if self.timeseries is None:
             return
+        # ingest backpressure gauges (ISSUE 12): queued examples behind
+        # the current flush + trailing arrival rate, summed over every
+        # train-plane coalescer — the autoscaler's primary signal, so
+        # they must ride /metrics and the time-series ring, not just
+        # the microbatch.<name>.* stats lines in get_status
+        if self.coalescers:
+            depth = arrival = 0.0
+            for co in self.coalescers.values():
+                if hasattr(co, "queue_depth"):
+                    depth += co.queue_depth()
+                    arrival += co.arrival_per_sec()
+            self.rpc.trace.gauge("microbatch.queue_depth", depth)
+            self.rpc.trace.gauge("microbatch.arrival_per_sec",
+                                 round(arrival, 1))
         self.timeseries.sample(self.rpc.trace.snapshot())
         if self.slo is not None:
             self.slo.evaluate()

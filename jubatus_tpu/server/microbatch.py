@@ -82,6 +82,12 @@ class Coalescer:
         #: flush invocations / items flushed (observability; get_status)
         self.flush_count = 0
         self.item_count = 0
+        #: queued-but-unflushed examples (the autoscaler's primary load
+        #: signal: arrival outrunning the device drains HERE first) and
+        #: the cumulative arrival counter its rate derives from
+        self._pending_weight = 0
+        self._arrived = 0
+        self._arrival_ref = (time.monotonic(), 0)
 
     def submit(self, items: Sequence[Any],
                timeout: float | None = 60.0) -> Any:
@@ -107,6 +113,8 @@ class Coalescer:
         with self._lock:
             self._pending_items.extend(items)
             self._pending_tickets.append(ticket)
+            self._pending_weight += weight
+            self._arrived += weight
             i_flush = not self._active
             if i_flush:
                 self._active = True
@@ -119,6 +127,7 @@ class Coalescer:
                     off = sum(t.count for t in self._pending_tickets[:i])
                     del self._pending_items[off:off + ticket.count]
                     self._pending_tickets.pop(i)
+                    self._pending_weight -= ticket.weight
                     raise TimeoutError(
                         "microbatch flush did not start in time "
                         + ("(query withdrawn)" if self._split else
@@ -157,6 +166,7 @@ class Coalescer:
             batch_weight += t.weight
             batch.extend(self._pending_items[:t.count])
             del self._pending_items[:t.count]
+        self._pending_weight -= batch_weight
         return batch, tickets, batch_weight
 
     def _drain(self) -> None:
@@ -190,13 +200,37 @@ class Coalescer:
                 for t in tickets:
                     t.event.set()
 
+    def queue_depth(self) -> int:
+        """Examples queued behind the current flush (0 when idle) —
+        the backpressure signal the autoscaler scales out on."""
+        with self._lock:
+            return self._pending_weight
+
+    def arrival_per_sec(self) -> float:
+        """Trailing arrival rate (examples/s) since the last reference
+        point; the reference re-anchors every ~10 s, so callers polling
+        on the telemetry tick read a short-window rate, not a lifetime
+        mean."""
+        now = time.monotonic()
+        with self._lock:
+            ref_t, ref_c = self._arrival_ref
+            dt = now - ref_t
+            rate = (self._arrived - ref_c) / dt if dt > 0 else 0.0
+            if dt >= 10.0:
+                self._arrival_ref = (now, self._arrived)
+        return rate
+
     def stats(self) -> dict:
+        rate = self.arrival_per_sec()
         with self._lock:
             flushes, items = self.flush_count, self.item_count
+            depth = self._pending_weight
         return {
             "flush_count": flushes,
             "item_count": items,
             "avg_batch": (items / flushes if flushes else 0.0),
+            "queue_depth": depth,
+            "arrival_per_sec": round(rate, 1),
         }
 
 

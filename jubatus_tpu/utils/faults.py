@@ -25,7 +25,11 @@ repeated ``--fault`` server flag):
 
 Sites are dotted names matched with fnmatch, e.g. ``rpc.call.get_diff``,
 ``rpc.connect``, ``mix.put_diff``, ``mix.comm.get_diff``,
-``mix.async.submit.<node>``, ``migration.pull``. ``fire`` is a no-op
+``mix.async.submit.<node>``, ``migration.pull``, and the autoscaler's
+actuation sites ``autoscale.spawn`` / ``autoscale.drain`` (a fired
+error there must surface as a ``blocked`` journal record with
+exponential backoff, never a hot-loop — coord/autoscaler.py). ``fire``
+is a no-op
 (one dict lookup on a module flag) when nothing is armed — safe on hot
 paths.
 

@@ -1,0 +1,538 @@
+"""Autoscaling control plane + fleet simulation (ISSUE 12).
+
+Covers the new subsystem at three altitudes:
+
+- **Controller core** (pure, clock-injected): hysteresis confirm
+  streaks on synthetic burn/queue timelines, flap suppression,
+  cooldown, min/max bounds, floor restore bypassing both, least-loaded
+  scale-in victim selection.
+- **Control loop**: journal + counters, the ``autoscale.spawn`` /
+  ``autoscale.drain`` fault sites — a failing actuation must record
+  ``blocked`` and back off exponentially, never hot-loop — dry-run
+  mode, signal folding from timeseries points, the
+  ``get_autoscale_status`` RPC + registry, the jubactl frame renderer.
+- **Cluster**: a live fleet losing a replica has its floor restored by
+  the loop without operator input (the ISSUE 12 slow drill's in-proc
+  twin).
+- **Traffic model** (tools/fleet_sim.py): seeded replayability, distinct
+  per-client streams, nproc-invariant offered load, flash-crowd rate
+  engagement, zipf hot-key skew, tenant mix, and the violation/recovery
+  clock helpers the fleet bench computes its keys with.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from argparse import Namespace
+
+import pytest
+
+from jubatus_tpu.coord import membership
+from jubatus_tpu.coord.autoscaler import (AutoscaleConfig, Autoscaler,
+                                          AutoscalerCore, FleetSnapshot,
+                                          HookActuator, ReplicaStats,
+                                          _stats_from_points, poll_fleet)
+from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+from jubatus_tpu.utils import faults
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+import fleet_sim  # noqa: E402
+
+
+def snap(n, burn=0.0, queue=0.0, t=0.0, firing=None, queues=None,
+         rates=None):
+    reps = []
+    for i in range(n):
+        reps.append(ReplicaStats(
+            f"127.0.0.1_{9300 + i}",
+            burn_max=burn,
+            firing=(burn >= 2.0) if firing is None else firing,
+            queue_depth=(queues[i] if queues else queue),
+            req_per_sec=(rates[i] if rates else 0.0)))
+    return FleetSnapshot(ts=t, replicas=reps)
+
+
+def cfg(**kw):
+    base = dict(min_replicas=1, max_replicas=4, poll_interval_s=1.0,
+                scale_out_confirm=2, scale_in_confirm=3, cooldown_s=10.0,
+                queue_hot=1000.0, burn_hot=2.0)
+    base.update(kw)
+    return AutoscaleConfig(**base)
+
+
+# -- controller core ----------------------------------------------------------
+
+def test_config_validation_rejects_nonsense():
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=0).validate()
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=4, max_replicas=2).validate()
+    with pytest.raises(ValueError):
+        AutoscaleConfig(scale_out_confirm=0).validate()
+    with pytest.raises(ValueError):
+        AutoscaleConfig(backoff_initial_s=5.0, backoff_max_s=1.0).validate()
+
+
+def test_scale_out_only_on_sustained_burn():
+    core = AutoscalerCore(cfg())
+    # one hot poll is a blip, not a trend
+    assert core.observe(snap(2, burn=5.0, t=1.0)).action == "hold"
+    d = core.observe(snap(2, burn=5.0, t=2.0))
+    assert (d.action, d.reason, d.count) == ("scale_out", "sustained_hot", 1)
+
+
+def test_queue_depth_alone_counts_hot():
+    core = AutoscalerCore(cfg())
+    core.observe(snap(2, queue=2000.0, t=1.0))
+    d = core.observe(snap(2, queue=2000.0, t=2.0))
+    assert d.action == "scale_out"
+
+
+def test_flap_suppression_alternating_signals_never_actuate():
+    core = AutoscalerCore(cfg(scale_out_confirm=2, scale_in_confirm=2,
+                              cooldown_s=0.0))
+    for t in range(40):
+        s = snap(2, burn=5.0 if t % 2 == 0 else 0.0, t=float(t))
+        assert core.observe(s).action == "hold"
+
+
+def test_cooldown_blocks_back_to_back_scaleouts():
+    core = AutoscalerCore(cfg(cooldown_s=10.0))
+    core.observe(snap(2, burn=5.0, t=1.0))
+    assert core.observe(snap(2, burn=5.0, t=2.0)).action == "scale_out"
+    # still hot 3 s later: confirm streak is satisfied again but the
+    # cooldown window holds the fleet steady
+    core.observe(snap(3, burn=5.0, t=4.0))
+    d = core.observe(snap(3, burn=5.0, t=5.0))
+    assert (d.action, d.reason) == ("hold", "cooldown")
+    # past the cooldown the next poll fires — the hot streak kept
+    # building through the cooldown, so no re-confirmation is needed
+    assert core.observe(snap(3, burn=5.0, t=13.0)).action == "scale_out"
+
+
+def test_max_and_min_bounds_are_honored():
+    core = AutoscalerCore(cfg(max_replicas=3, cooldown_s=0.0))
+    core.observe(snap(3, burn=9.0, t=1.0))
+    d = core.observe(snap(3, burn=9.0, t=2.0))
+    assert (d.action, d.reason) == ("hold", "hot_at_max")
+    core = AutoscalerCore(cfg(min_replicas=2, scale_in_confirm=2,
+                              cooldown_s=0.0))
+    core.observe(snap(2, t=1.0))
+    d = core.observe(snap(2, t=2.0))
+    assert (d.action, d.reason) == ("hold", "cold_at_min")
+
+
+def test_floor_restore_bypasses_confirm_and_cooldown():
+    core = AutoscalerCore(cfg(min_replicas=2, cooldown_s=100.0))
+    core.observe(snap(2, burn=5.0, t=1.0))
+    assert core.observe(snap(2, burn=5.0, t=2.0)).action == "scale_out"
+    # a replica dies 1 s into the cooldown: restore NOW, count exact
+    d = core.observe(snap(1, t=3.0))
+    assert (d.action, d.reason, d.count) == \
+        ("scale_out", "below_min_floor", 1)
+    # ...but a REPEAT restore while the spawn is still booting is
+    # spaced by cooldown_s — re-spawning every poll is a spawn storm
+    d = core.observe(FleetSnapshot(ts=4.0, replicas=[]), now=4.0)
+    assert (d.action, d.reason) == ("hold", "floor_restore_pending")
+    d = core.observe(FleetSnapshot(ts=104.0, replicas=[]), now=104.0)
+    assert (d.action, d.count) == ("scale_out", 2)
+
+
+def test_scale_in_after_sustained_cold_picks_least_loaded():
+    core = AutoscalerCore(cfg(min_replicas=1, scale_in_confirm=3,
+                              cooldown_s=0.0))
+    s = snap(3, t=0.0, queues=[50.0, 5.0, 200.0],
+             rates=[10.0, 1.0, 30.0])
+    for t in range(2):
+        assert core.observe(s, now=float(t)).action == "hold"
+    d = core.observe(s, now=2.0)
+    assert (d.action, d.target) == ("scale_in", "127.0.0.1_9301")
+
+
+def test_draining_members_do_not_count_as_capacity():
+    s = snap(3, burn=0.0)
+    s.replicas[0].draining = True
+    assert s.size == 2
+    core = AutoscalerCore(cfg(min_replicas=3))
+    d = core.observe(s, now=1.0)
+    assert (d.action, d.reason) == ("scale_out", "below_min_floor")
+
+
+def test_synthetic_burn_timeline_end_to_end():
+    """The drill's shape as a pure timeline: quiet -> sustained burn ->
+    scale to max -> burn clears -> sustained cold -> scale back in."""
+    core = AutoscalerCore(cfg(min_replicas=1, max_replicas=3,
+                              scale_out_confirm=2, scale_in_confirm=4,
+                              cooldown_s=2.0))
+    n, t, actions = 1, 0.0, []
+    timeline = [0.0] * 3 + [8.0] * 12 + [0.0] * 14
+    for burn in timeline:
+        t += 1.0
+        d = core.observe(snap(n, burn=burn, t=t))
+        actions.append(d.action)
+        if d.action == "scale_out":
+            n += d.count
+        elif d.action == "scale_in":
+            n -= 1
+    assert n == 1
+    assert actions.count("scale_out") == 2      # 1 -> 3 under burn
+    assert actions.count("scale_in") == 2       # 3 -> 1 once quiescent
+    first_out = actions.index("scale_out")
+    assert first_out >= 4  # 3 quiet polls + confirm streak
+
+
+# -- control loop: journal, counters, fault sites, backoff --------------------
+
+def hook(spawned, drained):
+    return HookActuator(lambda n: spawned.append(n),
+                        lambda t: drained.append(t))
+
+
+def mk_scaler(actuator, **kw):
+    base = dict(min_replicas=1, max_replicas=4, poll_interval_s=0.05,
+                scale_out_confirm=1, scale_in_confirm=2, cooldown_s=0.0,
+                backoff_initial_s=0.25, backoff_max_s=2.0)
+    base.update(kw)
+    return Autoscaler(MemoryCoordinator(_Store()), "classifier", "c1",
+                      actuator, config=AutoscaleConfig(**base))
+
+
+def test_tick_journals_decisions_and_counts():
+    spawned, drained = [], []
+    sc = mk_scaler(hook(spawned, drained))
+    sc.tick(snap(1, t=100.0))                    # steady -> hold
+    sc.tick(snap(1, burn=9.0, t=101.0))          # hot x1 (confirm=1)
+    assert spawned == [1]
+    for t in range(2):
+        sc.tick(snap(2, t=102.0 + t))            # cold streak
+    assert drained and drained[0].startswith("127.0.0.1_")
+    c = sc.registry.counters()
+    assert c["autoscale.decisions"] == 4
+    assert c["autoscale.spawns"] == 1
+    assert c["autoscale.drains"] == 1
+    acts = [j["action"] for j in sc.journal]
+    assert acts == ["hold", "scale_out", "hold", "scale_in"]
+    assert all("signals" in j for j in sc.journal)
+    g = sc.registry.gauges()
+    assert "autoscale.replicas" in g and "autoscale.burn_max" in g
+
+
+def test_blocked_spawn_backs_off_and_never_hot_loops():
+    spawned, drained = [], []
+    calls = []
+
+    def failing_spawn(n):
+        calls.append(n)
+        raise RuntimeError("spawn path down")
+
+    sc = mk_scaler(HookActuator(failing_spawn, drained.append))
+    with faults.armed():  # no-op scope; the hook itself fails
+        t = 200.0
+        for i in range(60):
+            sc.tick(snap(1, burn=9.0, t=t))
+            t += 0.01  # 60 polls in 0.6 s of model time
+    # exponential backoff: 0.25 + 0.5 = 0.75 s of backoff inside 0.6 s
+    # of polls -> at most 2 attempts ever reach the actuator
+    assert len(calls) <= 2
+    recs = list(sc.journal)
+    blocked = [j for j in recs if j["action"] == "blocked"]
+    assert blocked and blocked[0]["error"]
+    assert blocked[0]["backoff_s"] == 0.25
+    assert sc.registry.counters()["autoscale.blocked"] == len(calls)
+    assert any(j["reason"] == "backoff" for j in recs)
+    # the actuator recovers: next eligible tick (past backoff) spawns
+    sc.actuator = hook(spawned, drained)
+    sc.tick(snap(1, burn=9.0, t=t + 10.0))
+    assert spawned == [1]
+    assert sc.backoff_until == 0.0
+
+
+def test_autoscale_spawn_fault_site_blocks_with_backoff():
+    spawned, drained = [], []
+    sc = mk_scaler(hook(spawned, drained))
+    with faults.armed("autoscale.spawn:error"):
+        rec = sc.tick(snap(1, burn=9.0, t=300.0))
+    assert rec["action"] == "blocked"
+    assert "FaultInjected" in rec["error"]
+    assert spawned == []                      # site fires BEFORE actuation
+    assert sc.backoff_until > 300.0
+    # after the armed window + backoff expiry, actuation proceeds
+    rec = sc.tick(snap(1, burn=9.0, t=310.0))
+    assert rec["action"] == "scale_out" and spawned == [1]
+
+
+def test_autoscale_drain_fault_site_blocks():
+    spawned, drained = [], []
+    sc = mk_scaler(hook(spawned, drained), min_replicas=1,
+                   scale_in_confirm=1)
+    with faults.armed("autoscale.drain:error"):
+        rec = sc.tick(snap(2, t=400.0))
+    assert rec["action"] == "blocked" and drained == []
+    assert sc.registry.counters()["autoscale.blocked"] == 1
+
+
+def test_dry_run_journals_intent_without_actuating():
+    spawned, drained = [], []
+    sc = mk_scaler(hook(spawned, drained), dry_run=True)
+    rec = sc.tick(snap(1, burn=9.0, t=500.0))
+    assert rec["action"] == "scale_out" and rec["dry_run"] is True
+    assert spawned == []
+    c = sc.registry.counters()
+    assert c["autoscale.decisions"] == 1
+    assert c.get("autoscale.spawns", 0) == 0
+
+
+# -- signal folding -----------------------------------------------------------
+
+def test_stats_from_points_reads_gauges_and_slo_burn():
+    points = [
+        {"ts": 100.0, "hists": {}, "counters": {}, "gauges": {}},
+        {"ts": 110.0, "hists": {}, "counters": {},
+         "gauges": {"microbatch.queue_depth": 1500.0,
+                    "microbatch.arrival_per_sec": 800.0,
+                    "slo.rpc.train.p99.burn_fast": 4.2,
+                    "slo.rpc.train.p99.firing": 1.0,
+                    "slo.other.burn_fast": 0.1}},
+    ]
+    r = _stats_from_points("127.0.0.1_9300", points, 60.0)
+    assert r.queue_depth == 1500.0
+    assert r.arrival_per_sec == 800.0
+    assert r.burn_max == 4.2
+    assert r.firing is True
+
+
+def test_poll_fleet_counts_unreachable_members():
+    store = _Store()
+    coord = MemoryCoordinator(store)
+    # a registered active that answers no RPC (nothing listening)
+    membership.register_active(coord, "classifier", "c1",
+                               "127.0.0.1", 1)
+    s = poll_fleet(coord, "classifier", "c1", timeout=0.5)
+    assert s.size == 1 and not s.replicas[0].reachable
+    assert s.errors
+
+
+# -- status / RPC / rendering -------------------------------------------------
+
+def test_serve_status_rpc_and_registry():
+    from jubatus_tpu.rpc.client import RpcClient
+
+    store = _Store()
+    spawned, drained = [], []
+    sc = Autoscaler(MemoryCoordinator(store), "classifier", "c1",
+                    hook(spawned, drained),
+                    config=AutoscaleConfig(scale_out_confirm=1,
+                                           cooldown_s=0.0))
+    try:
+        port = sc.serve(0)
+        assert [n.name for n in membership.get_autoscalers(
+            MemoryCoordinator(store))] == [f"127.0.0.1_{port}"]
+        sc.tick(snap(1, burn=9.0, t=600.0))
+        with RpcClient("127.0.0.1", port, timeout=10.0) as c:
+            per_node = c.call("get_autoscale_status", "c1", 8)
+        doc = next(iter(per_node.values()))
+        assert doc["counters"]["autoscale.spawns"] == 1
+        assert doc["journal"][-1]["action"] == "scale_out"
+        assert doc["config"]["max_replicas"] == 8
+        assert doc["fleet"]["replicas"] == 1
+    finally:
+        sc.stop()
+
+
+def test_get_autoscale_status_is_idempotent_builtin():
+    from jubatus_tpu.framework.idl import IDEMPOTENT_BUILTINS
+
+    assert "get_autoscale_status" in IDEMPOTENT_BUILTINS
+
+
+def test_render_autoscale_frame():
+    from jubatus_tpu.cmd.jubactl import render_autoscale_frame
+
+    spawned, drained = [], []
+    sc = mk_scaler(hook(spawned, drained))
+    sc.tick(snap(2, burn=9.0, t=700.0, queues=[10.0, 20.0]))
+    frame = render_autoscale_frame(sc.status())
+    assert "classifier/c1 autoscaler" in frame
+    assert "fleet 2 replica(s)" in frame
+    assert "scale_out" in frame
+    assert "127.0.0.1_9300" in frame
+    assert "spawns 1" in frame
+
+
+def test_jubactl_autoscale_once_dry_runs(capsys, monkeypatch):
+    """--once with no registered autoscaler: one observe-only tick
+    rendered — and nothing actuated (dry_run is forced)."""
+    from jubatus_tpu.cmd import jubactl
+    from jubatus_tpu.coord import autoscaler as as_mod
+
+    coord = MemoryCoordinator(_Store())
+    membership.register_active(coord, "classifier", "c1",
+                               "127.0.0.1", 1)
+    monkeypatch.setattr(
+        as_mod, "poll_fleet",
+        lambda *a, **k: snap(1, burn=9.0, t=time.time()))
+    ns = Namespace(watch=False, once=True, interval=2.0, window=30.0,
+                   as_min=1, as_max=4, autoscale_interval=0.5,
+                   cooldown=0.0, scale_out_confirm=1,
+                   scale_in_confirm=2, burn_hot=2.0, queue_hot=1000.0,
+                   autoscale_port=0, dry_run=False, thread=2,
+                   timeout=10, datadir="/tmp", logdir="", mixer="linear",
+                   interval_sec=16, interval_count=512)
+    rc = jubactl.run_autoscale(coord, "classifier", "c1", ns)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "autoscaler" in out and "scale_out" in out
+    assert "[dry-run]" in out
+
+
+# -- cluster: the floor-restore drill -----------------------------------------
+
+ENGINE = "nearest_neighbor"
+NN_CONF = {"method": "lsh", "parameter": {"hash_num": 8},
+           "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+
+
+def _boot_nn(store):
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    srv = EngineServer(
+        ENGINE, NN_CONF,
+        args=ServerArgs(engine=ENGINE, coordinator="(shared)", name="as",
+                        listen_addr="127.0.0.1", interval_sec=1e9,
+                        interval_count=1 << 30, telemetry_interval=0.5),
+        coord=MemoryCoordinator(store))
+    srv.start(0)
+    return srv
+
+
+def test_cluster_replica_death_restores_floor():
+    """Kill a replica of a live fleet: the loop's next poll sees the
+    fleet below min_replicas and spawns a replacement without operator
+    input — ISSUE 12's unattended-recovery contract in-process."""
+    store = _Store()
+    servers = [_boot_nn(store), _boot_nn(store)]
+
+    def spawn(n):
+        for _ in range(int(n)):
+            servers.append(_boot_nn(store))
+
+    sc = Autoscaler(
+        MemoryCoordinator(store), ENGINE, "as",
+        HookActuator(spawn, lambda t: None),
+        config=AutoscaleConfig(min_replicas=2, max_replicas=3,
+                               poll_interval_s=0.2, window_s=10.0,
+                               scale_in_confirm=10_000,
+                               cooldown_s=5.0))
+    try:
+        coord = MemoryCoordinator(store)
+        assert len(membership.get_all_actives(coord, ENGINE, "as")) == 2
+        sc.start()
+        servers[0].stop()  # hard kill: ephemeral registrations vanish
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if len(membership.get_all_actives(coord, ENGINE, "as")) >= 2 \
+                    and len(servers) == 3:
+                break
+            time.sleep(0.1)
+        assert len(servers) == 3, "autoscaler did not spawn a replacement"
+        assert len(membership.get_all_actives(coord, ENGINE, "as")) >= 2
+        restore = [j for j in sc.journal
+                   if j["action"] == "scale_out"
+                   and j["reason"] == "below_min_floor"]
+        assert restore, "floor restore not journaled"
+    finally:
+        sc.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+
+
+# -- traffic model (tools/fleet_sim.py) ---------------------------------------
+
+def _model(**kw):
+    base = dict(seed=7, base_rate=100.0, diurnal_period_s=60.0,
+                diurnal_amplitude=0.25, zipf_s=1.2, n_users=10_000,
+                flash=((8.0, 6.0, 5.0),))
+    base.update(kw)
+    return fleet_sim.TrafficModel(**base)
+
+
+def test_trace_replayable_and_streams_distinct():
+    m = _model()
+    a = fleet_sim.summarize_trace(m, 0, 4, 20.0)
+    assert a == fleet_sim.summarize_trace(m, 0, 4, 20.0)
+    assert a != fleet_sim.summarize_trace(m, 1, 4, 20.0)
+    assert a != fleet_sim.summarize_trace(_model(seed=8), 0, 4, 20.0)
+    assert a["events"] > 100
+
+
+def test_offered_load_invariant_across_nproc():
+    m = _model(flash=())
+    totals = {}
+    for nproc in (4, 8):
+        totals[nproc] = sum(
+            fleet_sim.summarize_trace(m, i, nproc, 30.0)["events"]
+            for i in range(nproc))
+    assert abs(totals[4] - totals[8]) / totals[4] < 0.15
+
+
+def test_flash_crowd_engages_rate_curve():
+    m = _model()
+    per_sec = fleet_sim.summarize_trace(m, 0, 2, 20.0)["per_sec"]
+    base = sum(per_sec[2:8]) / 6.0
+    flash = sum(per_sec[9:13]) / 4.0
+    assert 3.0 < flash / base < 7.5  # nominal 5x
+
+
+def test_zipf_skew_and_tenant_mix():
+    m = _model(flash=(), zipf_s=1.3)
+    doc = fleet_sim.summarize_trace(m, 0, 2, 60.0)
+    # hot head: top-10 users of 10k carry far more than uniform would
+    assert doc["top10_user_share"] > 0.2
+    mix = doc["tenants"]
+    total = sum(mix.values())
+    assert abs(mix.get("checkout", 0) / total - 0.5) < 0.1
+    assert abs(mix.get("ads", 0) / total - 0.2) < 0.1
+
+
+def test_rate_at_composes_diurnal_and_flash():
+    m = _model(base_rate=100.0, diurnal_amplitude=0.0)
+    assert m.rate_at(1.0) == pytest.approx(100.0)
+    assert m.rate_at(9.0) == pytest.approx(500.0)
+    assert m.rate_at(15.0) == pytest.approx(100.0)
+    assert m.max_rate() == pytest.approx(500.0)
+    m2 = _model(diurnal_amplitude=0.5, flash=())
+    assert m2.rate_at(15.0) == pytest.approx(150.0)  # sin peak at T/4
+
+
+def test_model_json_round_trip():
+    m = _model()
+    m2 = fleet_sim.TrafficModel.from_json(m.to_json())
+    assert m2 == m
+
+
+def test_violation_and_recovery_helpers():
+    per_sec = {
+        "done": [100] * 20, "bad": [0] * 20, "shed": [0] * 20,
+        "errors": [0] * 20,
+    }
+    for s in range(8, 14):
+        per_sec["bad"][s] = 50            # 50% bad through the flash
+    viol = fleet_sim.violation_seconds(per_sec)
+    assert viol == list(range(8, 14))
+    rec = fleet_sim.recovery_second(viol, onset=8, horizon=20)
+    assert rec == 14.0
+    # never recovers inside the horizon
+    viol_all = list(range(8, 21))
+    assert fleet_sim.recovery_second(viol_all, onset=8,
+                                     horizon=18) is None
+    # zero-traffic seconds don't count as violations
+    per_sec["done"][3] = 0
+    per_sec["bad"][3] = 0
+    assert 3 not in fleet_sim.violation_seconds(per_sec)

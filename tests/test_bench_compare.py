@@ -56,6 +56,39 @@ def test_direction_inference():
     assert bc.direction("e2e_clients") is None
 
 
+def test_direction_inference_autoscale_keys():
+    """ISSUE 12 autoscaling plane: recovery wall time and seconds in
+    SLO violation gate down-good (a slower control loop is a
+    regression), capacity absorbed per serving replica up-good, the
+    autoscaled-beats-static verdict is a boolean gate."""
+    assert bc.direction("e2e_scaleout_recovery_s") == "lower"
+    assert bc.direction("e2e_autoscale_slo_violation_s") == "lower"
+    assert bc.direction("e2e_static_slo_violation_s") == "lower"
+    assert bc.direction("e2e_capacity_per_replica") == "higher"
+    assert bc.direction("e2e_autoscale_beats_static_ok") == "bool"
+    # neighbors that must NOT accidentally gate
+    assert bc.direction("e2e_autoscale_final_replicas") is None
+    assert bc.direction("e2e_fleet_seed") is None
+
+
+def test_autoscale_keys_gate_in_compare(tmp_path):
+    old = {"e2e_scaleout_recovery_s": 10.0,
+           "e2e_autoscale_slo_violation_s": 12.0,
+           "e2e_capacity_per_replica": 1200.0,
+           "e2e_autoscale_beats_static_ok": True}
+    new = {"e2e_scaleout_recovery_s": 18.0,       # slower: regression
+           "e2e_autoscale_slo_violation_s": 11.0,  # improved
+           "e2e_capacity_per_replica": 900.0,      # shrank: regression
+           "e2e_autoscale_beats_static_ok": False}  # gate flip
+    rows, regs = bc.compare(bc.flatten(old), bc.flatten(new))
+    verdicts = {r["key"]: r["verdict"] for r in rows}
+    assert verdicts["e2e_scaleout_recovery_s"] == "REGRESSED"
+    assert verdicts["e2e_capacity_per_replica"] == "REGRESSED"
+    assert verdicts["e2e_autoscale_beats_static_ok"] == "REGRESSED"
+    assert verdicts["e2e_autoscale_slo_violation_s"] == "improved"
+    assert len(regs) == 3
+
+
 def test_direction_inference_scaling_keys():
     """ISSUE 9 scaling plane: wire bytes per HOST gate down-good (the
     hierarchical reduce's whole claim), the reduction factor up-good —

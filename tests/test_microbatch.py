@@ -354,3 +354,113 @@ def test_pipelined_weigher_bounds_examples():
         t.join()
     assert sum(sizes) == 24
     assert all(s <= 8 for s in sizes)
+
+
+# -- backpressure gauges (ISSUE 12): the autoscaler's primary signal ---------
+
+def test_queue_depth_and_arrival_rate_in_stats():
+    release = threading.Event()
+    started = threading.Event()
+
+    def blocking_flush(batch):
+        started.set()
+        release.wait(5.0)
+        return len(batch)
+
+    co = Coalescer(blocking_flush, max_batch=4)
+    t1 = threading.Thread(target=lambda: co.submit([1, 2]))
+    t1.start()
+    assert started.wait(5.0)
+    # the flusher claimed its own items: queue is empty while it runs
+    assert co.queue_depth() == 0
+    t2 = threading.Thread(target=lambda: co.submit([3, 4, 5]))
+    t2.start()
+    deadline = time.monotonic() + 5.0
+    while co.queue_depth() != 3 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    st = co.stats()
+    assert st["queue_depth"] == 3          # queued behind the flush
+    assert st["arrival_per_sec"] > 0.0     # 5 examples just arrived
+    release.set()
+    t1.join()
+    t2.join()
+    st = co.stats()
+    assert st["queue_depth"] == 0          # drained back to idle
+    assert co.queue_depth() == 0
+
+
+def test_queue_depth_uses_weigher_examples():
+    release = threading.Event()
+    started = threading.Event()
+
+    def blocking_flush(batch):
+        started.set()
+        release.wait(5.0)
+        return len(batch)
+
+    co = Coalescer(blocking_flush, max_batch=100,
+                   weigher=lambda item: item["n"])
+    t1 = threading.Thread(target=lambda: co.submit([{"n": 10}]))
+    t1.start()
+    assert started.wait(5.0)
+    t2 = threading.Thread(target=lambda: co.submit([{"n": 7}, {"n": 5}]))
+    t2.start()
+    deadline = time.monotonic() + 5.0
+    while co.queue_depth() != 12 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert co.queue_depth() == 12          # examples, not items
+    release.set()
+    t1.join()
+    t2.join()
+
+
+def test_timeout_withdrawal_returns_queue_depth():
+    release = threading.Event()
+    started = threading.Event()
+
+    def blocking_flush(batch):
+        started.set()
+        release.wait(5.0)
+        return len(batch)
+
+    co = Coalescer(blocking_flush, max_batch=2)
+    t1 = threading.Thread(target=lambda: co.submit([1, 2]))
+    t1.start()
+    assert started.wait(5.0)
+    with pytest.raises(TimeoutError):
+        co.submit([3, 4], timeout=0.05)
+    assert co.queue_depth() == 0           # withdrawn items left no ghost
+    release.set()
+    t1.join()
+
+
+def test_server_gauges_microbatch_signals(tmp_path):
+    """The telemetry tick gauges microbatch.queue_depth /
+    microbatch.arrival_per_sec into the server registry (-> /metrics,
+    timeseries ring — what the autoscaler polls)."""
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.client import Datum
+    from jubatus_tpu.rpc.client import RpcClient
+
+    conf = {"method": "PA", "parameter": {"regularization_weight": 1.0},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    srv = EngineServer(
+        "classifier", conf,
+        args=ServerArgs(engine="classifier", listen_addr="127.0.0.1",
+                        telemetry_interval=0.0, datadir=str(tmp_path)))
+    try:
+        port = srv.start(0)
+        with RpcClient("127.0.0.1", port, timeout=30.0) as c:
+            c.call("train", "",
+                   [["a", Datum({"f0": 1.0}).to_msgpack()]])
+        srv._model_health_tick()
+        g = srv.rpc.trace.gauges()
+        assert g.get("microbatch.queue_depth") == 0.0
+        assert "microbatch.arrival_per_sec" in g
+        st = next(iter(srv.get_status().values()))
+        mb = [k for k in st if k.startswith("microbatch.")
+              and k.endswith(".queue_depth")]
+        assert mb, "per-coalescer queue_depth missing from get_status"
+    finally:
+        srv.stop()

@@ -44,10 +44,13 @@ from typing import Any, Dict, List, Optional, Tuple
 DEFAULT_TOLERANCE = 0.05
 
 #: key patterns whose larger values are better (checked before _LOWER:
-#: a wire REDUCTION factor beats the _per_host substring it contains)
+#: a wire REDUCTION factor beats the _per_host substring it contains).
+#: ``_capacity_per_replica`` covers the autoscaling plane (ISSUE 12):
+#: steady-state examples/s each serving replica absorbs — shrinkage
+#: means the fleet needs more replicas for the same traffic.
 _HIGHER = re.compile(
     r"(_per_sec($|_)|samples_per_sec|_speedup($|_)|_fraction($|_)"
-    r"|_reduction($|_))")
+    r"|_reduction($|_)|_capacity_per_replica($|_))")
 #: key patterns whose smaller values are better. ``_per_host`` covers
 #: the hierarchical-mix scaling plane (ISSUE 9): wire bytes each host
 #: ships per round — the quantity the two-tier reduce holds down, so
@@ -58,10 +61,15 @@ _HIGHER = re.compile(
 #: 11): model-lock stall on the serving path and rounds-behind-master
 #: — both down-good (`_stall_ms` already matches `_ms`, listed for the
 #: record; `_lag_rounds` needs its own pattern)
+#: ``_recovery_s`` / ``_violation_s`` cover the autoscaling plane
+#: (ISSUE 12): flash-onset-to-recovered wall time and seconds spent in
+#: SLO violation — growth in either means the control loop got slower
+#: at absorbing a traffic step.
 _LOWER = re.compile(
     r"(_ms($|_)|_ratio($|_)|wire_mb|_per_host($|_)|drift"
     r"|_error(s)?($|_)|_timeouts|_errors_total|_denials|rows_lost"
-    r"|_stall_ms($|_)|_lag_rounds($|_))")
+    r"|_stall_ms($|_)|_lag_rounds($|_)"
+    r"|_recovery_s($|_)|_violation_s($|_))")
 
 #: built-in per-key tolerance defaults (explicit --key-tolerance wins):
 #: the nproc16 sweep time-slices 16 gloo processes over however few
