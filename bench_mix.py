@@ -774,5 +774,128 @@ def collect(dev=None) -> dict:
     return out
 
 
+_SHARDED_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+
+dim_bits = int(sys.argv[1]); shards = int(sys.argv[2])
+method = sys.argv[3] if len(sys.argv) > 3 else "AROW"
+B, K, L = 2048, 32, 2
+D = 1 << dim_bits
+from jubatus_tpu.ops import classifier as ops
+from jubatus_tpu.parallel import sharded_model as sm
+
+conf = method in ops.CONFIDENCE_METHODS
+rng = np.random.default_rng(0)
+idx = jnp.asarray(rng.integers(0, D, (B, K)).astype(np.int32))
+val = jnp.asarray(rng.normal(size=(B, K)).astype(np.float32))
+labels = jnp.asarray(rng.integers(0, L, B).astype(np.int32))
+mask = jnp.asarray(np.ones(L, bool))
+qi = jnp.asarray(rng.integers(0, D, (256, K)).astype(np.int32))
+qv = jnp.asarray(rng.normal(size=(256, K)).astype(np.float32))
+
+if shards > 1:
+    mesh = sm.feature_shard_mesh(shards)
+    st = sm.place_state(mesh, ops.init_state(L, D, conf), D)
+    train = lambda s: sm.train_batch(mesh, s, idx, val, labels, mask,
+                                     1.0, method=method)
+    classify = lambda s: sm.scores(mesh, s, qi, qv, mask)
+else:
+    st = ops.init_state(L, D, conf)
+    train = lambda s: ops.train_batch(s, idx, val, labels, mask, 1.0,
+                                      method=method)
+    classify = lambda s: ops.scores(s, qi, qv, mask)
+
+# per-device weight-state footprint: the acceptance criterion's shape
+per_dev = {}
+for leaf in st:
+    for sh in leaf.addressable_shards:
+        per_dev[sh.device.id] = per_dev.get(sh.device.id, 0) + \
+            int(np.prod(sh.data.shape)) * leaf.dtype.itemsize
+total_bytes = sum(int(leaf.nbytes) for leaf in st)
+
+st = train(st); jax.block_until_ready(st)         # compile
+t_train = []
+for _ in range(5):
+    t0 = time.perf_counter()
+    st = train(st); jax.block_until_ready(st)
+    t_train.append(time.perf_counter() - t0)
+sc = classify(st); jax.block_until_ready(sc)      # compile
+t_cls = []
+for _ in range(15):
+    t0 = time.perf_counter()
+    jax.block_until_ready(classify(st))
+    t_cls.append(time.perf_counter() - t0)
+out = {
+    "samples_per_sec": round(B / float(np.median(t_train)), 1),
+    "classify_p99_ms": round(
+        float(np.percentile(np.asarray(t_cls) * 1e3, 99)), 2),
+    "state_bytes_total": total_bytes,
+    "state_bytes_per_device_max": max(per_dev.values()),
+    "devices": len(per_dev),
+}
+print(json.dumps(out))
+"""
+
+
+def run_sharded_model(dim_bits: int = 26, shard_counts=(1, 8),
+                      method: str = "AROW",
+                      timeout: float = 1800.0) -> dict:
+    """Feature-sharded linear model bench (ISSUE 13): train samples/s
+    and classify p99 at D=2^dim_bits, single- vs multi-shard, each in a
+    subprocess with that many virtual devices. Emits
+    ``sharded_train_samples_per_sec_d{bits}_{s}shard`` (up-good) and
+    ``sharded_classify_p99_ms_d{bits}_{s}shard`` (down-good), plus the
+    per-device weight-state footprint that IS the HBM-capacity win —
+    virtual CPU devices share one core, so multi-shard WALL numbers
+    bound orchestration + psum cost, not chip throughput (same caveat
+    as allreduce8)."""
+    import jax
+
+    out: dict = {"sharded_model_platform": jax.devices()[0].platform}
+    for s in shard_counts:
+        env = scrub_child_env(dict(os.environ))
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={max(s, 1)}"])
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _SHARDED_CHILD, str(dim_bits),
+                 str(s), method],
+                capture_output=True, text=True, timeout=timeout, env=env)
+            doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        except Exception as e:  # noqa: BLE001 — partial results beat a dead bench
+            out[f"sharded_model_error_{s}shard"] = repr(e)[:200]
+            continue
+        tag = f"d{dim_bits}_{s}shard"
+        out[f"sharded_train_samples_per_sec_{tag}"] = doc["samples_per_sec"]
+        out[f"sharded_classify_p99_ms_{tag}"] = doc["classify_p99_ms"]
+        out[f"sharded_state_mb_per_device_{tag}"] = round(
+            doc["state_bytes_per_device_max"] / 2 ** 20, 1)
+        out[f"sharded_state_mb_total_{tag}"] = round(
+            doc["state_bytes_total"] / 2 ** 20, 1)
+    # the acceptance shape: per-device footprint <= total / n_shards
+    # (+ O(1) replicated leaves) — recorded as a boolean gate
+    for s in shard_counts:
+        if s <= 1:
+            continue
+        tag = f"d{dim_bits}_{s}shard"
+        per = out.get(f"sharded_state_mb_per_device_{tag}")
+        tot = out.get(f"sharded_state_mb_total_{tag}")
+        if per is not None and tot is not None:
+            out[f"sharded_footprint_sliced_{tag}_ok"] = \
+                bool(per <= tot / s + 1.0)
+    return out
+
+
 if __name__ == "__main__":
-    print(json.dumps(collect(), indent=1))
+    if len(sys.argv) > 1 and sys.argv[1] == "sharded":
+        # the ISSUE 13 slice on its own: feature-sharded train/classify
+        # at D=2^bits (default 26), single- vs N-shard
+        bits = int(sys.argv[2]) if len(sys.argv) > 2 else 26
+        shards = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+        print(json.dumps(run_sharded_model(bits, (1, shards)), indent=1))
+    else:
+        print(json.dumps(collect(), indent=1))

@@ -1650,6 +1650,102 @@ def run_fleet_scalein(rows: int = 600) -> dict:
     return out
 
 
+_SHARDED_KNN_CHILD = r"""
+import json, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+
+rows = int(float(sys.argv[1])); shards = int(sys.argv[2])
+hash_num, B, k = 64, 4, 10
+rng = np.random.default_rng(3)
+from jubatus_tpu.ops import knn
+words = knn.packed_words(hash_num)
+# synthesize the signature table directly: the bench measures the QUERY
+# plane (scan + top-k merge), not 1e8 python-side row inserts
+sigs_h = rng.integers(0, 2 ** 32, size=(rows, words), dtype=np.uint32)
+q = jnp.asarray(rng.integers(0, 2 ** 32, size=(B, words), dtype=np.uint32))
+
+if shards > 1:
+    from jax.sharding import Mesh
+    from jubatus_tpu.parallel import sharded_knn
+    pad = (-rows) % shards
+    if pad:
+        sigs_h = np.pad(sigs_h, ((0, pad), (0, 0)))
+    mesh = Mesh(np.asarray(jax.devices()[:shards]), ("shard",))
+    sigs = sharded_knn.shard_table(mesh, jnp.asarray(sigs_h))
+    valid = sharded_knn.shard_table(
+        mesh, jnp.asarray(np.arange(len(sigs_h)) < rows))
+    query = lambda: sharded_knn.sharded_hamming_topk(
+        mesh, q, sigs, hash_num=hash_num, k=k, valid=valid)
+else:
+    sigs = jnp.asarray(sigs_h)
+
+    import functools
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def dense_topk(q, sigs, k):
+        d = knn._hamming_distances_batch_xla(q, sigs, hash_num=hash_num)
+        nd, idx = jax.lax.top_k(-d, k)
+        return -nd, idx
+    query = lambda: dense_topk(q, sigs, k)
+per_dev = {}
+for sh in sigs.addressable_shards:
+    per_dev[sh.device.id] = per_dev.get(sh.device.id, 0) + int(
+        np.prod(sh.data.shape)) * 4
+jax.block_until_ready(query())          # compile + warm
+trials = 12 if rows >= 10 ** 7 else 25
+ts = []
+for _ in range(trials):
+    t0 = time.perf_counter()
+    jax.block_until_ready(query())
+    ts.append(time.perf_counter() - t0)
+ts = np.asarray(ts) * 1e3
+print(json.dumps({
+    "p99_ms": round(float(np.percentile(ts, 99)), 2),
+    "p50_ms": round(float(np.median(ts)), 2),
+    "table_mb_per_device_max": round(max(per_dev.values()) / 2 ** 20, 1),
+    "trials": trials, "batch": B, "k": k,
+}))
+"""
+
+
+def run_sharded_knn(shard_counts=(1, 8), scales=("1e6", "1e8"),
+                    timeout: float = 3600.0) -> dict:
+    """Sharded row-store query bench (ISSUE 13): global top-k over a
+    synthesized LSH signature table at 10⁶ and 10⁸ rows, single- vs
+    multi-shard (per-shard partial top-k + log-depth on-device merge),
+    each in a subprocess with that many virtual devices. Emits
+    ``knn_query_p99_ms_rows{1e6,1e8}_{s}shard`` (down-good). Virtual
+    CPU devices share one core: multi-shard wall bounds orchestration +
+    merge cost; the per-device table slice is the capacity win."""
+    import bench_mix
+
+    out: dict = {}
+    for scale in scales:
+        for s in shard_counts:
+            env = bench_mix.scrub_child_env(dict(os.environ))
+            flags = [f for f in env.get("XLA_FLAGS", "").split()
+                     if "device_count" not in f]
+            env["XLA_FLAGS"] = " ".join(
+                flags +
+                [f"--xla_force_host_platform_device_count={max(s, 1)}"])
+            tag = f"rows{scale}_{s}shard"
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", _SHARDED_KNN_CHILD, scale,
+                     str(s)],
+                    capture_output=True, text=True, timeout=timeout,
+                    env=env)
+                doc = json.loads(proc.stdout.strip().splitlines()[-1])
+            except Exception as e:  # noqa: BLE001 — partial results
+                out[f"knn_query_error_{tag}"] = repr(e)[:200]
+                continue
+            out[f"knn_query_p99_ms_{tag}"] = doc["p99_ms"]
+            out[f"knn_query_p50_ms_{tag}"] = doc["p50_ms"]
+            out[f"knn_query_table_mb_per_device_{tag}"] = \
+                doc["table_mb_per_device_max"]
+    return out
+
+
 def collect(trials: int = 2) -> dict:
     """Alternate transports and keep each one's best trial: run-to-run
     spread through the device tunnel is ~±10% (host scheduling + tunnel
@@ -1850,6 +1946,13 @@ if __name__ == "__main__":
         out.update(run_fleet(nproc=nproc))
         out.update(run_fleet_scalein())
         print(json.dumps(out, indent=1))
+    elif len(sys.argv) > 1 and sys.argv[1] == "shardedknn":
+        # the ISSUE 13 query slice on its own: 10^6/10^8-row top-k,
+        # single- vs N-shard (default 8)
+        shards = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        scales = tuple(sys.argv[3].split(",")) if len(sys.argv) > 3 \
+            else ("1e6", "1e8")
+        print(json.dumps(run_sharded_knn((1, shards), scales), indent=1))
     elif len(sys.argv) > 1 and sys.argv[1] == "asyncmix":
         # the async-mix slice on its own (drift parity + cadence/stall
         # storm), for ISSUE 11 iteration without the full bench

@@ -254,9 +254,39 @@ def show_status(coord: Coordinator, engine: str, name: str,
                     (f":{r['name']}" if r.get("name") else "")
                     for r in reasons) if isinstance(reasons, list) else ""
                 print(f"    health: {hs}" + (f" [{kinds}]" if kinds else ""))
+            shard_line = _fmt_shard_layout(st)
+            if shard_line:
+                print(f"    {shard_line}")
             for k in sorted(st):
                 print(f"    {k}: {st[k]}")
     return rc
+
+
+def _fmt_shard_layout(st: Dict[str, Any]) -> str:
+    """One-line shard-layout summary from the driver.shard.* gauges
+    (ISSUE 13): ``shards: N × rows/bytes per shard``; "" when the model
+    is unsharded."""
+    count = st.get("driver.shard.count")
+    if not count:
+        return ""
+    count = int(count)
+    rows = st.get("driver.shard.rows", 0)
+    nbytes = int(st.get("driver.shard.bytes_in_use", 0))
+    per = st.get("driver.shard.rows_per_shard")
+    if isinstance(per, (list, tuple)) and per:
+        rows_bit = "/".join(str(int(r)) for r in per[:8])
+        if len(per) > 8:
+            rows_bit += "/…"
+        rows_bit = f"rows {rows_bit}"
+    else:
+        rows_bit = f"rows {int(rows)}"
+    mb = nbytes / 2 ** 20
+    out = (f"shards: {count} × [{rows_bit}, "
+           f"{mb / max(count, 1):.1f} MB/shard]")
+    merge = st.get("driver.shard.topk_merge_ms")
+    if merge is not None:
+        out += f" topk_merge {float(merge):.1f} ms"
+    return out
 
 
 def _fmt_ms(v) -> str:
@@ -492,6 +522,18 @@ def _watch_node_row(node_name: str, entry: Dict[str, Any],
         depth = st.get("mixer.async_inbox_depth")
         if depth:
             mix_bits.append(f"inbox {int(depth)}")
+    # shard layout (ISSUE 13): N shards × live rows (row stores) or
+    # MB/shard (feature-sharded weight state)
+    shards = st.get("driver.shard.count")
+    if shards:
+        nbytes = int(st.get("driver.shard.bytes_in_use", 0))
+        if st.get("driver.shard.rows_per_shard") is not None:
+            mix_bits.append(
+                f"sh {int(shards)}x{int(st.get('driver.shard.rows', 0))}r")
+        else:
+            mix_bits.append(
+                f"sh {int(shards)}x"
+                f"{nbytes / max(int(shards), 1) / 2 ** 20:.0f}MB")
     alerts = ",".join(entry.get("alerts") or []) or "-"
     p99_cell = f"{p99:.1f} {p99_span[4:]}" if p99 is not None else "-"
     return (f"  {node_name:<22} {state:<9} {req_s:>8.1f} {err_s:>7.2f}  "

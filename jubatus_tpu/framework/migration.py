@@ -83,7 +83,12 @@ def serve_range(driver: Any, ring: CHT, target: str, cursor: str,
     that ``target`` owns under ``ring``, up to ``limit_bytes``. Returns
     ``{"rows": [...], "cursor": next, "done": bool}``; ``cursor`` is the
     LAST id included, so resume is exact even if ids are inserted
-    concurrently (sorted-order walk)."""
+    concurrently (sorted-order walk).
+
+    The walk is host-metadata only: ``row_ids``/``get_rows`` read the
+    store's per-shard host arenas (parallel/row_store.py), so serving a
+    range from a device-sharded 10⁸-row store never materializes the
+    device table (tests/test_row_store_sharded.py pins this)."""
     if not hasattr(driver, "get_rows") or not hasattr(driver, "row_ids"):
         return {"rows": [], "cursor": "", "done": True}
     limit_bytes = max(1, int(limit_bytes))

@@ -115,6 +115,22 @@ def abstract_like(state: Any):
     )
 
 
+def shard_layout(state: Any) -> dict:
+    """{axis: size} of the first sharded leaf's mesh — recorded in the
+    system container so operators (jubadump, jubactl) can read what
+    layout wrote a checkpoint without opening the orbax metadata.
+    Informational only: restore re-places by the TEMPLATE's shardings,
+    so a checkpoint written at N shards restores bit-exact at M
+    (reshard-on-restore — orbax reads each host's needed byte ranges)."""
+    for leaf in jax.tree_util.tree_leaves(state):
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        shape = getattr(mesh, "shape", None)
+        if shape:
+            return {str(k): int(v) for k, v in dict(shape).items()}
+    return {}
+
+
 def save_sharded(
     dir_path: str,
     state: Any,
@@ -158,6 +174,7 @@ def save_sharded(
             "config": config,
             "user_data_version": user_data_version,
             "sharded": True,
+            "shard_layout": shard_layout(state),
             "pairing_token": token,
         })
     if jax.process_count() > 1:
@@ -181,7 +198,14 @@ def load_sharded(
     ``template`` is a live state pytree or the result of
     ``abstract_like``. Returns (system container, restored state); raises
     SaveLoadError on metadata mismatch (same checks as the envelope
-    loader: engine type and semantic config equality)."""
+    loader: engine type and semantic config equality).
+
+    Reshard-on-restore (ISSUE 13): the template's shardings govern the
+    restored placement, independent of the layout that WROTE the
+    checkpoint — a save at N shards restores bit-exact onto an M-shard
+    template (N→1, 1→M, N→M; tests/test_sharded_checkpoint.py), which
+    is how a fleet reshape or a single-device debug session opens a
+    pod-scale checkpoint."""
     import orbax.checkpoint as ocp
 
     dir_path = os.path.abspath(dir_path)

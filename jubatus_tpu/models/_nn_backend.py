@@ -33,6 +33,7 @@ import numpy as np
 from jubatus_tpu.core.row_store import RowStore
 from jubatus_tpu.core.sparse import SparseBatch, SparseVector
 from jubatus_tpu.ops import knn
+from jubatus_tpu.parallel.row_store import ShardedRowStore
 
 HASH_METHODS = ("lsh", "minhash", "euclid_lsh")
 EXACT_METHODS = ("inverted_index", "euclid")
@@ -54,6 +55,9 @@ class NNBackend:
         self._mesh = None
         self._mesh_axis = "shard"
         self._mesh_dev = None
+        #: wall ms of the last sharded top-k (device scan + log-depth
+        #: merge + readback) — the shard.topk_merge_ms gauge
+        self.last_topk_ms: Optional[float] = None
         self._init_sigs()
 
     def _init_sigs(self) -> None:
@@ -132,7 +136,14 @@ class NNBackend:
         a device mesh (parallel/sharded_knn.py) instead of one device —
         the capacity-scaling move the reference makes with CHT row
         placement. Exact methods (inverted_index/euclid) keep the dense
-        path. Pass mesh=None to detach."""
+        path. Pass mesh=None to detach.
+
+        Attaching swaps the flat RowStore for the sharded row arena
+        (parallel/row_store.ShardedRowStore): rows land in their
+        CHT-owned shard's slot range, so the [S*C, W] signature table is
+        shard-contiguous by construction and migration-plane rows
+        (NNRowMigration wire format) arrive directly in the owning
+        shard."""
         if mesh is not None and self.method not in HASH_METHODS:
             raise ValueError(
                 f"mesh-sharded serving supports hash methods {HASH_METHODS}, "
@@ -140,6 +151,51 @@ class NNBackend:
         self._mesh = mesh
         self._mesh_axis = axis
         self._mesh_dev = None
+        n = mesh.shape[axis] if mesh is not None else 1
+        self._reshape_store(n if n > 1 else 1)
+
+    def _reshape_store(self, n_shards: int) -> None:
+        """Swap the row store's arena layout (flat <-> N shards),
+        re-placing every live row by ``shard_for`` and re-pending all
+        signatures (slots move). Update/mix trackers carry over."""
+        old = self.store
+        sharded = isinstance(old, ShardedRowStore)
+        if n_shards <= 1 and not sharded:
+            return
+        if sharded and old.n_shards == n_shards:
+            return
+        if n_shards > 1:
+            new: Any = ShardedRowStore(
+                n_shards=n_shards, max_size=old.max_size,
+                keep_datum=old.keep_datum)
+        else:
+            new = RowStore(max_size=old.max_size, keep_datum=old.keep_datum)
+        pending_mix = dict(old.updated_since_mix)
+        for rid in old.all_ids():
+            new.set_row(rid, old.get_row(rid), datum=old.datums.get(rid))
+        if not old.keep_datum:
+            new.datums.update(old.datums)
+        new.updated_since_mix = pending_mix
+        self.store = new
+        self._init_sigs()
+        # every slot moved: recompute every signature at the next flush
+        self._pending = {rid: new.get_row(rid) for rid in new.all_ids()}
+
+    def shard_stats(self) -> Dict[str, Any]:
+        """Shard-layout gauges (shard.{count,rows,bytes_in_use,
+        topk_merge_ms} — OBSERVABILITY.md §7): arena shape + last
+        sharded-query merge wall time."""
+        if isinstance(self.store, ShardedRowStore):
+            st = self.store.shard_stats()
+        else:
+            st = {"count": 1, "rows": len(self.store),
+                  "rows_per_shard": [len(self.store)],
+                  "capacity_per_shard": self.store.capacity,
+                  "bytes_in_use":
+                      int(self.store.idx.nbytes + self.store.val.nbytes)}
+        if self.last_topk_ms is not None:
+            st["topk_merge_ms"] = round(self.last_topk_ms, 3)
+        return st
 
     def _mesh_view(self):
         """(sharded sigs, sharded valid mask) — row count padded up to a
@@ -160,6 +216,8 @@ class NNBackend:
         return sigs, valid
 
     def _mesh_neighbors(self, vecs, k: int) -> List[List[Tuple[str, float]]]:
+        import time
+
         from jubatus_tpu.parallel import sharded_knn
 
         self._flush()
@@ -167,6 +225,7 @@ class NNBackend:
         if k <= 0 or not vecs:
             return [[] for _ in vecs]
         sigs, valid = self._mesh_view()
+        t0 = time.perf_counter()
         sb = SparseBatch.from_vectors(vecs)
         idx, val = jnp.asarray(sb.idx), jnp.asarray(sb.val)
         if self.method == "lsh":
@@ -187,6 +246,7 @@ class NNBackend:
                 self._mesh, q, sigs, hash_num=self.hash_num, k=k,
                 axis=self._mesh_axis, valid=valid)
         d, gidx = np.asarray(d), np.asarray(gidx)
+        self.last_topk_ms = (time.perf_counter() - t0) * 1e3
         out = []
         for b in range(len(vecs)):
             row = [(self.store.ids[int(s)], float(d[b, j]))

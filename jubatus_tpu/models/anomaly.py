@@ -218,8 +218,15 @@ class AnomalyDriver(DriverBase):
         self._next_id = int(obj.get("next_id", 0))
         self._lrd_cache = None
 
+    def shard_stats(self) -> Dict[str, Any]:
+        """Row-shard layout gauges; empty when unsharded."""
+        if self.backend._mesh is None:
+            return {}
+        return self.backend.shard_stats()
+
     @locked
     def get_status(self) -> Dict[str, Any]:
         st = super().get_status()
         st.update(method=self.method, num_rows=len(self.backend.store), k=self.k)
+        st.update({f"shard.{k}": v for k, v in self.shard_stats().items()})
         return st

@@ -43,7 +43,7 @@ class ClassifierDriver(DriverBase):
 
     def __init__(self, config: dict, dim_bits: int = 18,
                  train_mode: str = "parallel", mesh=None,
-                 mesh_axis: str = "shard"):
+                 mesh_axis: str = "shard", shard_features: int = 0):
         super().__init__()
         self.config = config
         self.config_json = json.dumps(config)
@@ -51,10 +51,14 @@ class ClassifierDriver(DriverBase):
         # exact per-datum reference semantics (ops/classifier.py).
         self.train_mode = train_mode
         # mesh: shard the feature dimension of every [L, D] table over the
-        # mesh axis — ONE server exploits all its local chips (GSPMD
-        # partitions the existing gathers/scatters/einsums; no kernel
-        # changes). Orthogonal to cross-server data parallelism via the
-        # mix plane (parallel/spmd.py stacks both for the pod path).
+        # mesh axis — ONE server exploits all its local chips. The hot
+        # train/classify paths run as shard_map programs
+        # (parallel/sharded_model.py): the CSR batch is column-range
+        # partitioned to the owning shard, one psum reduces the [B, L]
+        # logits, and the weight matrix is never gathered. The schema/
+        # combo plans keep GSPMD partitioning of the placed state.
+        # Orthogonal to cross-server data parallelism via the mix plane
+        # (parallel/spmd.py stacks both for the pod path).
         method = config.get("method")
         if method in _NN_METHODS:
             # instance-based classifier over the NN engine — separate driver
@@ -69,6 +73,15 @@ class ClassifierDriver(DriverBase):
         param = config.get("parameter") or {}
         self.param = float(param.get("regularization_weight", 1.0))
         self.converter = make_fv_converter(config.get("converter"), dim_bits=dim_bits)
+        # --shard-features D_PER_SHARD: derive the shard count from the
+        # per-device feature budget (the HBM-capacity lever)
+        if shard_features and mesh is None:
+            from jubatus_tpu.parallel.sharded_model import mesh_for_features
+
+            mesh = mesh_for_features(self.converter.dim, shard_features,
+                                     ClassifierConfigError)
+        self._mesh = mesh
+        self._mesh_axis = mesh_axis
         # sharding derives from the converter's dim, not the dim_bits
         # argument — a config-side "hash_max_size" overrides the latter
         self._sharding = None
@@ -207,16 +220,27 @@ class ClassifierDriver(DriverBase):
             val = np.pad(val, ((0, bsz - b), (0, 0)))
         slots_arr = np.zeros(bsz, dtype=np.int32)
         slots_arr[:b] = slots
-        self.state = ops.train_batch(
-            self.state,
-            jnp.asarray(idx),
-            jnp.asarray(val),
-            jnp.asarray(slots_arr),
-            self._mask(),
-            self.param,
-            method=self.method,
-            mode=self.train_mode,
-        )
+        if self._mesh is not None and self.train_mode == "parallel":
+            # shard_map path: batch routed by column range, one psum for
+            # the logits — weight state never moves (ISSUE 13 tentpole)
+            from jubatus_tpu.parallel import sharded_model as _sm
+
+            self.state = _sm.train_batch(
+                self._mesh, self.state, jnp.asarray(idx), jnp.asarray(val),
+                jnp.asarray(slots_arr), self._mask(), self.param,
+                method=self.method, axis=self._mesh_axis)
+        else:
+            # sequential mode keeps GSPMD partitioning of the placed state
+            self.state = ops.train_batch(
+                self.state,
+                jnp.asarray(idx),
+                jnp.asarray(val),
+                jnp.asarray(slots_arr),
+                self._mask(),
+                self.param,
+                method=self.method,
+                mode=self.train_mode,
+            )
         self.event_model_updated(b)
         return b
 
@@ -428,10 +452,28 @@ class ClassifierDriver(DriverBase):
             if not self.label_slots:
                 return [[] for _ in range(n)]
             slots = list(self.label_slots.items())
-            pending = ops.scores(self.state, didx, dval, self._mask())
+            if self._mesh is not None:
+                from jubatus_tpu.parallel import sharded_model as _sm
+
+                pending = _sm.scores(self._mesh, self.state, didx, dval,
+                                     self._mask(), axis=self._mesh_axis)
+            else:
+                pending = ops.scores(self.state, didx, dval, self._mask())
         sc = np.asarray(pending)[:n]
         return [[(lab, float(row[slot]))
                  for lab, slot in slots] for row in sc]
+
+    def shard_stats(self) -> Dict[str, Any]:
+        """Feature-shard layout gauges (shard.* catalog rows,
+        OBSERVABILITY.md §7): shard count + per-device weight-state
+        bytes. Empty when unsharded."""
+        if self._mesh is None:
+            return {}
+        n = self._mesh.shape[self._mesh_axis]
+        total = sum(int(a.nbytes) for a in self.state)
+        return {"count": n, "rows": self.capacity,
+                "bytes_in_use": total,
+                "bytes_per_shard": total // n}
 
     @locked
     def clear(self) -> None:
@@ -549,6 +591,7 @@ class ClassifierDriver(DriverBase):
             num_labels=len(self.label_slots),
             num_features=self.converter.dim,
         )
+        st.update({f"shard.{k}": v for k, v in self.shard_stats().items()})
         return st
 
 
@@ -570,7 +613,21 @@ class _ClassifierMixable:
         # labels list is ""-padded to capacity by sync_schema. Slicing
         # clamps the (1, 1) no-confidence placeholders untouched.
         n = max(d.label_slots.values(), default=0) + 1
-        if n < diff["dw"].shape[0]:
+        if d._mesh is not None:
+            # feature-sharded state ships PER-SHARD chunks keyed by start
+            # column: each shard's slice copies out independently (no
+            # full-matrix buffer) and enters the chunked/tiered/quantized
+            # mix pipeline on its own. Peers fold chunk-wise — layouts
+            # must match (assemble_chunks validates on apply).
+            from jubatus_tpu.parallel import sharded_model as _sm
+
+            chunked = {}
+            for key in ("dw", "dprec"):
+                a = diff[key]
+                if a.ndim == 2 and a.shape[1] == d.converter.dim:
+                    chunked[key] = _sm.shard_chunks(a, rows=n)
+            diff = dict(diff, **chunked)
+        elif n < diff["dw"].shape[0]:
             diff = dict(diff, dw=diff["dw"][:n], dprec=diff["dprec"][:n])
         diff["label_counts"] = d._dcounts[:n].copy()
         return diff
@@ -579,6 +636,7 @@ class _ClassifierMixable:
         d = self._d
         # the same reduced diff dict is applied to every replica — no mutation
         array_diff = {k: v for k, v in diff.items() if k != "label_counts"}
+        array_diff = _assemble_sharded(d, array_diff, rank=2)
         d.state = ops.put_diff(d.state, array_diff)
         counts = diff.get("label_counts")
         if counts is not None:
@@ -586,6 +644,30 @@ class _ClassifierMixable:
             d.label_counts[:len(counts)] += counts
             d._dcounts[:] = 0.0
         return True
+
+
+def _assemble_sharded(driver, array_diff: dict, rank: int) -> dict:
+    """Reassemble per-shard wire chunks in a diff dict: back onto the
+    receiving driver's shard devices when it is sharded (each chunk
+    lands on its owner — no host concat of the full matrix), or into
+    one host array when an unsharded replica receives a sharded peer's
+    diff (mixed fleets stay correct, just not zero-copy)."""
+    from jubatus_tpu.parallel import sharded_model as _sm
+
+    out = dict(array_diff)
+    for key, v in array_diff.items():
+        if not _sm.is_chunked(v):
+            continue
+        if driver._mesh is not None:
+            out[key] = _sm.assemble_chunks(
+                v, _sm.chunk_sharding(driver._mesh, rank=rank,
+                                      axis=driver._mesh_axis))
+        else:
+            items = sorted(
+                ((int((k.decode() if isinstance(k, bytes) else k)[1:]), c)
+                 for k, c in v.items()), key=lambda kv: kv[0])
+            out[key] = np.concatenate([c for _, c in items], axis=-1)
+    return out
 
 
 def _next_pow2(n: int) -> int:

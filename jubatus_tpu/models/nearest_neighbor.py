@@ -119,10 +119,18 @@ class NearestNeighborDriver(NNRowMigration, DriverBase):
         self.backend.unpack(obj["backend"])
         self.converter.weights.unpack(obj["weights"])
 
+    def shard_stats(self) -> Dict[str, Any]:
+        """Row-shard layout gauges (shard.* catalog rows): arena shape +
+        last sharded top-k merge wall. Empty when unsharded."""
+        if self.backend._mesh is None:
+            return {}
+        return self.backend.shard_stats()
+
     @locked
     def get_status(self) -> Dict[str, Any]:
         st = super().get_status()
         st.update(method=self.method, num_rows=len(self.backend.store))
+        st.update({f"shard.{k}": v for k, v in self.shard_stats().items()})
         return st
 
 

@@ -206,8 +206,15 @@ class RecommenderDriver(NNRowMigration, DriverBase):
         self.backend.unpack(obj["backend"], datum_decoder=Datum.from_msgpack)
         self.converter.weights.unpack(obj["weights"])
 
+    def shard_stats(self) -> Dict[str, Any]:
+        """Row-shard layout gauges; empty when unsharded."""
+        if self.backend._mesh is None:
+            return {}
+        return self.backend.shard_stats()
+
     @locked
     def get_status(self) -> Dict[str, Any]:
         st = super().get_status()
         st.update(method=self.method, num_rows=len(self.backend.store))
+        st.update({f"shard.{k}": v for k, v in self.shard_stats().items()})
         return st

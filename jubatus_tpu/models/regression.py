@@ -29,7 +29,7 @@ class RegressionDriver(DriverBase):
     TYPE = "regression"
 
     def __init__(self, config: dict, dim_bits: int = 18, mesh=None,
-                 mesh_axis: str = "shard"):
+                 mesh_axis: str = "shard", shard_features: int = 0):
         super().__init__()
         self.config = config
         self.config_json = json.dumps(config)
@@ -41,8 +41,17 @@ class RegressionDriver(DriverBase):
         self.sensitivity = float(param.get("sensitivity", 0.1))
         self.c = float(param.get("regularization_weight", 1.0))
         self.converter = make_fv_converter(config.get("converter"), dim_bits=dim_bits)
-        # feature sharding over local devices (--shard-devices), same GSPMD
-        # placement story as the classifier (models/classifier.py)
+        # feature sharding over local devices (--shard-devices /
+        # --shard-features): train/estimate run as shard_map programs
+        # (parallel/sharded_model.py) — batch routed by column range,
+        # per-example psum, [D] weights never gathered
+        if shard_features and mesh is None:
+            from jubatus_tpu.parallel.sharded_model import mesh_for_features
+
+            mesh = mesh_for_features(self.converter.dim, shard_features,
+                                     RegressionConfigError)
+        self._mesh = mesh
+        self._mesh_axis = mesh_axis
         self._sharding = None
         if mesh is not None:
             from jubatus_tpu.parallel.mesh import make_feature_sharding
@@ -97,15 +106,23 @@ class RegressionDriver(DriverBase):
             val = np.pad(val, ((0, bsz - b), (0, 0)))
         tgt = np.zeros(bsz, dtype=np.float32)
         tgt[:n] = targets
-        self.state = ops.train_batch(
-            self.state,
-            jnp.asarray(idx),
-            jnp.asarray(val),
-            jnp.asarray(tgt),
-            self.sensitivity,
-            self.c,
-            method=self.method,
-        )
+        if self._mesh is not None:
+            from jubatus_tpu.parallel import sharded_model as _sm
+
+            self.state = _sm.regression_train_batch(
+                self._mesh, self.state, jnp.asarray(idx), jnp.asarray(val),
+                jnp.asarray(tgt), self.sensitivity, self.c,
+                method=self.method, axis=self._mesh_axis)
+        else:
+            self.state = ops.train_batch(
+                self.state,
+                jnp.asarray(idx),
+                jnp.asarray(val),
+                jnp.asarray(tgt),
+                self.sensitivity,
+                self.c,
+                method=self.method,
+            )
         self.event_model_updated(n)
         return n
 
@@ -131,7 +148,14 @@ class RegressionDriver(DriverBase):
             val = np.pad(val, ((0, b - n), (0, 0)))
         didx, dval = jnp.asarray(idx), jnp.asarray(val)  # staged unlocked
         with self.lock:
-            pending = ops.estimate(self.state, didx, dval)
+            if self._mesh is not None:
+                from jubatus_tpu.parallel import sharded_model as _sm
+
+                pending = _sm.regression_estimate(
+                    self._mesh, self.state, didx, dval,
+                    axis=self._mesh_axis)
+            else:
+                pending = ops.estimate(self.state, didx, dval)
         return [float(x) for x in np.asarray(pending)[:n]]
 
     @locked
@@ -171,9 +195,20 @@ class RegressionDriver(DriverBase):
             ops.RegressionState(w=w, dw=jnp.zeros_like(w)))
         self.converter.weights.unpack(obj["weights"])
 
+    def shard_stats(self) -> Dict[str, Any]:
+        """Feature-shard layout gauges (shard.* catalog rows); empty
+        when unsharded."""
+        if self._mesh is None:
+            return {}
+        n = self._mesh.shape[self._mesh_axis]
+        total = sum(int(a.nbytes) for a in self.state)
+        return {"count": n, "rows": 1, "bytes_in_use": total,
+                "bytes_per_shard": total // n}
+
     def get_status(self) -> Dict[str, Any]:
         st = super().get_status()
         st.update(method=self.method, num_features=self.converter.dim)
+        st.update({f"shard.{k}": v for k, v in self.shard_stats().items()})
         return st
 
 
@@ -182,8 +217,18 @@ class _RegressionMixable:
         self._d = driver
 
     def get_diff(self):
-        return ops.get_diff(self._d.state)
+        diff = ops.get_diff(self._d.state)
+        if self._d._mesh is not None:
+            # per-shard wire chunks, same scheme as the classifier
+            # mixable (models/classifier.py _ClassifierMixable)
+            from jubatus_tpu.parallel import sharded_model as _sm
+
+            diff = dict(diff, dw=_sm.shard_chunks(diff["dw"]))
+        return diff
 
     def put_diff(self, diff) -> bool:
-        self._d.state = ops.put_diff(self._d.state, diff)
+        from jubatus_tpu.models.classifier import _assemble_sharded
+
+        self._d.state = ops.put_diff(
+            self._d.state, _assemble_sharded(self._d, dict(diff), rank=1))
         return True

@@ -152,7 +152,9 @@ def allreduce_diffs(per_replica_diffs: Sequence[Any], mesh: Mesh,
     total = _psum_stacked(stacked, mesh=mesh, axis=axis, compress=compress)
     total = jax.block_until_ready(total)
     t2 = time.perf_counter()
-    out = jax.tree_util.tree_map(lambda x: jax.device_get(x), total)
+    out = jax.tree_util.tree_map(
+        # replicated mix total, not a sharded leaf
+        lambda x: jax.device_get(x), total)  # full-gather-ok — readback
     if phases is not None:
         nbytes = sum(
             x.nbytes // (2 if compress and x.dtype == jnp.float32 else 1)

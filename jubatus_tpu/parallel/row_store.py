@@ -1,0 +1,324 @@
+"""General sharded row store — fixed-capacity per-shard row arenas.
+
+ISSUE 13 tentpole (2): `parallel/sharded_knn.py` grown into the row
+store the instance engines need at 10⁸-row capacity. One store, five
+consumers:
+
+- **Placement** is CHT-compatible: ``coord.cht.shard_for(row_id, S)``
+  picks the owning shard — the same stable hash the migration plane
+  (PR 10, framework/migration.py) and the elastic ring use, so an
+  ``NNRowMigration`` row pushed over the wire lands DIRECTLY in the
+  owning shard's arena, and ``serve_range`` walks shard arenas without
+  ever materializing the device table.
+- **Layout**: global slot = ``shard * capacity_per_shard + local_slot``.
+  The [S*C, K] host mirror is therefore shard-contiguous by
+  construction: ``shard_table`` (parallel/sharded_knn.py) places rows
+  ``[s*C, (s+1)*C)`` on device ``s`` with no permutation, and the
+  signature tables the NN backend aligns to slots inherit the same
+  placement for free.
+- **Queries**: per-shard partial top-k on device, merged with the
+  log-depth on-device reduction (sharded_knn.merge_topk) — O(S·k)
+  candidates over the interconnect, never O(rows).
+- **Mix**: ``updated_since_mix`` rides per shard
+  (``pop_update_diff_sharded``) so each shard's diff enters the mix
+  pipeline independently; rows applied from a mix/migration are
+  excluded from the next diff exactly like the flat store.
+- **Capacity**: per-shard arenas grow by doubling (bounded recompiles,
+  like core/row_store.py); ``max_size`` keeps the reference's LRU
+  unlearner semantics globally.
+
+API-compatible with core/row_store.RowStore (same pack format — flat
+and sharded checkpoints interchange; restore re-places by shard_for).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from jubatus_tpu.coord.cht import shard_for
+from jubatus_tpu.core.sparse import SparseVector
+
+_INITIAL_CAPACITY = 64   # per shard
+_INITIAL_WIDTH = 8
+
+
+def _pow2_at_least(n: int, minimum: int) -> int:
+    p = minimum
+    while p < n:
+        p *= 2
+    return p
+
+
+class ShardedRowStore:
+    """Drop-in RowStore with S fixed-capacity per-shard arenas."""
+
+    def __init__(self, n_shards: int = 1, max_size: Optional[int] = None,
+                 keep_datum: bool = False,
+                 capacity_per_shard: int = _INITIAL_CAPACITY) -> None:
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        self.n_shards = int(n_shards)
+        self.max_size = max_size
+        self.keep_datum = keep_datum
+        self._initial_cap = int(capacity_per_shard)
+        self._init()
+
+    def _init(self) -> None:
+        self.cap_per_shard = self._initial_cap
+        self.width = _INITIAL_WIDTH
+        s, c = self.n_shards, self.cap_per_shard
+        self.idx = np.zeros((s * c, self.width), np.int32)
+        self.val = np.zeros((s * c, self.width), np.float32)
+        self.ids: List[str] = [""] * (s * c)   # slot -> id ("" = dead)
+        self.slots: Dict[str, int] = {}        # id -> global slot
+        self._free: List[List[int]] = [[] for _ in range(s)]
+        self._fill: List[int] = [0] * s        # per-shard high-water mark
+        self._clock = 0
+        self._touch: Dict[str, int] = {}       # id -> last-touch tick (LRU)
+        self.datums: Dict[str, Any] = {}
+        self.updated_since_mix: Dict[str, None] = {}
+        self.version = 0
+        self._dev_cache: Optional[Tuple[int, Any, Any, Any]] = None
+
+    # -- sizing ---------------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.n_shards * self.cap_per_shard
+
+    def __len__(self) -> int:
+        return len(self.slots)
+
+    def __contains__(self, row_id: str) -> bool:
+        return row_id in self.slots
+
+    def shard_of(self, row_id: str) -> int:
+        """The owning shard — CHT-stable, shared with the migration
+        plane's ring math."""
+        return shard_for(row_id, self.n_shards)
+
+    def shard_slot(self, row_id: str) -> Optional[Tuple[int, int]]:
+        """(shard, local slot) of a live row; None when absent."""
+        g = self.slots.get(row_id)
+        if g is None:
+            return None
+        return divmod(g, self.cap_per_shard)
+
+    def _grow_capacity(self) -> None:
+        """Double every shard arena, remapping global slots (local slots
+        are preserved, so per-shard contents never move between shards)."""
+        old_c, s = self.cap_per_shard, self.n_shards
+        new_c = old_c * 2
+        idx = np.zeros((s * new_c, self.width), np.int32)
+        val = np.zeros((s * new_c, self.width), np.float32)
+        ids: List[str] = [""] * (s * new_c)
+        for sh in range(s):
+            idx[sh * new_c: sh * new_c + old_c] = \
+                self.idx[sh * old_c: (sh + 1) * old_c]
+            val[sh * new_c: sh * new_c + old_c] = \
+                self.val[sh * old_c: (sh + 1) * old_c]
+            ids[sh * new_c: sh * new_c + old_c] = \
+                self.ids[sh * old_c: (sh + 1) * old_c]
+        self.idx, self.val, self.ids = idx, val, ids
+        self.cap_per_shard = new_c
+        self.slots = {rid: (g // old_c) * new_c + (g % old_c)
+                      for rid, g in self.slots.items()}
+        self._free = [[(g // old_c) * new_c + (g % old_c) for g in fl]
+                      for fl in self._free]
+
+    def _grow_width(self, need: int) -> None:
+        new_w = _pow2_at_least(need, self.width * 2)
+        pad = new_w - self.width
+        self.idx = np.pad(self.idx, ((0, 0), (0, pad)))
+        self.val = np.pad(self.val, ((0, 0), (0, pad)))
+        self.width = new_w
+
+    def _free_slot(self, shard: int) -> int:
+        if self._free[shard]:
+            return self._free[shard].pop()
+        if self._fill[shard] < self.cap_per_shard:
+            slot = shard * self.cap_per_shard + self._fill[shard]
+            self._fill[shard] += 1
+            return slot
+        self._grow_capacity()
+        slot = shard * self.cap_per_shard + self._fill[shard]
+        self._fill[shard] += 1
+        return slot
+
+    # -- writes ---------------------------------------------------------------
+    def set_row(self, row_id: str, vec: SparseVector,
+                datum: Any = None) -> int:
+        """Insert or overwrite a row in its OWNING shard's arena;
+        returns its global slot. Evicts the least recently touched row
+        (globally) first when max_size is reached."""
+        slot = self.slots.get(row_id)
+        if slot is None:
+            if self.max_size is not None and len(self.slots) >= self.max_size:
+                self._evict_lru()
+            slot = self._free_slot(self.shard_of(row_id))
+            self.ids[slot] = row_id
+            self.slots[row_id] = slot
+        if len(vec) > self.width:
+            self._grow_width(len(vec))
+        self.idx[slot].fill(0)
+        self.val[slot].fill(0.0)
+        k = len(vec)
+        if k:
+            self.idx[slot, :k] = [i for i, _ in vec]
+            self.val[slot, :k] = [w for _, w in vec]
+        if self.keep_datum and datum is not None:
+            self.datums[row_id] = datum
+        self.touch(row_id)
+        self.updated_since_mix[row_id] = None
+        self.version += 1
+        return slot
+
+    def remove_row(self, row_id: str) -> bool:
+        slot = self.slots.pop(row_id, None)
+        if slot is None:
+            return False
+        self.ids[slot] = ""
+        self.idx[slot].fill(0)
+        self.val[slot].fill(0.0)
+        self._free[slot // self.cap_per_shard].append(slot)
+        self._touch.pop(row_id, None)
+        self.datums.pop(row_id, None)
+        self.updated_since_mix.pop(row_id, None)
+        self.version += 1
+        return True
+
+    def clear(self) -> None:
+        self._init()
+
+    def touch(self, row_id: str) -> None:
+        self._clock += 1
+        self._touch[row_id] = self._clock
+
+    def _evict_lru(self) -> None:
+        victim = min(self._touch, key=self._touch.get)
+        self.remove_row(victim)
+
+    # -- reads ----------------------------------------------------------------
+    def get_row(self, row_id: str) -> Optional[SparseVector]:
+        slot = self.slots.get(row_id)
+        if slot is None:
+            return None
+        order = np.nonzero(self.val[slot])[0]
+        return [(int(self.idx[slot, j]), float(self.val[slot, j]))
+                for j in order]
+
+    def all_ids(self) -> List[str]:
+        return list(self.slots.keys())
+
+    def shard_ids(self, shard: int) -> List[str]:
+        """Live row ids in one shard arena — the per-shard walk
+        serve_range and the drain handoff ride (host metadata only; the
+        device table is never touched)."""
+        lo = shard * self.cap_per_shard
+        hi = lo + self.cap_per_shard
+        return [rid for rid in self.ids[lo:hi] if rid]
+
+    def iter_rows(self) -> Iterator[Tuple[str, int]]:
+        return iter(self.slots.items())
+
+    def live_mask(self) -> np.ndarray:
+        m = np.zeros(self.capacity, bool)
+        if self.slots:
+            m[np.fromiter(self.slots.values(), dtype=np.int64,
+                          count=len(self.slots))] = True
+        return m
+
+    def rows_per_shard(self) -> List[int]:
+        counts = [0] * self.n_shards
+        for g in self.slots.values():
+            counts[g // self.cap_per_shard] += 1
+        return counts
+
+    def bytes_in_use(self) -> int:
+        """Host-mirror bytes of the padded arenas (idx int32 + val f32);
+        the device table costs the same per dtype."""
+        return int(self.idx.nbytes + self.val.nbytes)
+
+    def shard_stats(self) -> Dict[str, Any]:
+        per = self.rows_per_shard()
+        return {"count": self.n_shards, "rows": len(self.slots),
+                "rows_per_shard": per,
+                "capacity_per_shard": self.cap_per_shard,
+                "bytes_in_use": self.bytes_in_use()}
+
+    def device_view(self):
+        """(idx, val, live_mask) as device arrays, cached per version."""
+        if self._dev_cache is None or self._dev_cache[0] != self.version:
+            self._dev_cache = (
+                self.version,
+                jnp.asarray(self.idx),
+                jnp.asarray(self.val),
+                jnp.asarray(self.live_mask()),
+            )
+        return self._dev_cache[1], self._dev_cache[2], self._dev_cache[3]
+
+    # -- mix / persistence ----------------------------------------------------
+    def pop_update_diff(self) -> Dict[str, Tuple[list, list, Any]]:
+        """Rows written since the last mix as {id: (idx_list, val_list,
+        datum)}; clears the tracker. Wire-identical to the flat store."""
+        out = {}
+        for rid in self.updated_since_mix:
+            slot = self.slots.get(rid)
+            if slot is None:
+                continue
+            nz = np.nonzero(self.val[slot])[0]
+            out[rid] = (
+                self.idx[slot, nz].tolist(),
+                self.val[slot, nz].tolist(),
+                self.datums.get(rid),
+            )
+        self.updated_since_mix = {}
+        return out
+
+    def pop_update_diff_sharded(self) -> List[Dict[str, Tuple[list, list, Any]]]:
+        """The same diff grouped by owning shard (one dict per shard) —
+        each shard's chunk enters the mix pipeline independently."""
+        out: List[Dict[str, Tuple[list, list, Any]]] = \
+            [{} for _ in range(self.n_shards)]
+        flat = self.pop_update_diff()
+        for rid, row in flat.items():
+            out[self.shard_of(rid)][rid] = row
+        return out
+
+    def apply_update_diff(self, diff: Dict[str, Tuple[list, list, Any]]) -> None:
+        for rid, (ii, vv, datum) in diff.items():
+            rid = rid.decode() if isinstance(rid, bytes) else rid
+            vec = [(int(i), float(v)) for i, v in zip(ii, vv)]
+            self.set_row(rid, vec, datum=datum)
+        # rows arriving via mix are not "local updates" for the next round
+        self.updated_since_mix = {}
+
+    def pack(self) -> Any:
+        return {
+            "rows": {
+                rid: (
+                    self.idx[s][np.nonzero(self.val[s])].tolist(),
+                    self.val[s][np.nonzero(self.val[s])].tolist(),
+                )
+                for rid, s in self.slots.items()
+            },
+            "datums": {rid: d.to_msgpack() if hasattr(d, "to_msgpack") else d
+                       for rid, d in self.datums.items()}
+            if self.keep_datum else {},
+        }
+
+    def unpack(self, obj: Any, datum_decoder=None) -> None:
+        """Restore from the shared pack format. Reshard-on-restore falls
+        out of placement being a pure function of the id: a checkpoint
+        written at N shards (or by the flat store) re-places every row
+        into the CURRENT n_shards' owning arenas."""
+        self._init()
+        for rid, (ii, vv) in obj["rows"].items():
+            rid = rid.decode() if isinstance(rid, bytes) else rid
+            self.set_row(rid, [(int(i), float(v)) for i, v in zip(ii, vv)])
+        for rid, d in (obj.get("datums") or {}).items():
+            rid = rid.decode() if isinstance(rid, bytes) else rid
+            self.datums[rid] = datum_decoder(d) if datum_decoder else d
+        self.updated_since_mix = {}

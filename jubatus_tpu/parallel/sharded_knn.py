@@ -7,8 +7,9 @@ over the mesh's ``shard`` axis).
 One query batch fans out to every shard implicitly (the table is sharded,
 the query replicated), each device scans its slice of the table with the
 same kernels the single-chip path uses (ops/knn; pallas on TPU), takes a
-LOCAL top-k, and one tiny all_gather of [k]-sized candidates merges the
-global top-k — O(shards·k) bytes over ICI instead of O(rows). All three
+LOCAL top-k, and one tiny all_gather of [k]-sized candidates feeds the
+log-depth on-device tree merge (``merge_topk``) — O(shards·k) bytes over
+ICI and log2(shards) selection passes instead of O(rows). All three
 hash methods (lsh/minhash/euclid_lsh) ride the same driver; an optional
 ``valid`` row mask keeps dead/padding slots out of the results (the
 single-chip path's live-mask, models/_nn_backend.py).
@@ -45,6 +46,50 @@ def replicate(mesh: Mesh, x):
     return jax.device_put(x, NamedSharding(mesh, P()))
 
 
+def merge_topk(scores, ids, k: int):
+    """Log-depth on-device merge of per-shard top-k candidate sets.
+
+    ``scores``/``ids``: [S, B, kk] partials (HIGHER score = better).
+    Pairwise tree reduction: each level merges shard pairs with one
+    top_k over the concatenated 2·kk candidates, halving S per level —
+    log2(S) selection passes over O(k)-sized sets instead of one flat
+    [B, S·kk] sort whose cost grows linearly with the shard count.
+    Selection is associative (top-k of a union == top-k of per-part
+    top-ks), so the result matches the flat merge exactly up to
+    equal-score tie order. Returns ([B, k'], [B, k']) with
+    k' = min(k, S·kk)."""
+    s = scores.shape[0]
+    k = min(k, s * scores.shape[2])
+    while s > 1:
+        half = s // 2
+        lo_s, hi_s = scores[:half], scores[half: 2 * half]
+        lo_i, hi_i = ids[:half], ids[half: 2 * half]
+        cat_s = jnp.concatenate([lo_s, hi_s], axis=-1)     # [half, B, 2kk]
+        cat_i = jnp.concatenate([lo_i, hi_i], axis=-1)
+        kk = min(k, cat_s.shape[-1])
+        merged_s, pos = jax.lax.top_k(cat_s, kk)
+        merged_i = jnp.take_along_axis(cat_i, pos, axis=-1)
+        if s % 2:                                          # odd: carry last
+            carry_s, carry_i = scores[-1:], ids[-1:]
+            if carry_s.shape[-1] > kk:    # keep the carry's own top-kk
+                carry_s, pos = jax.lax.top_k(carry_s, kk)
+                carry_i = jnp.take_along_axis(carry_i, pos, axis=-1)
+            pad = kk - carry_s.shape[-1]
+            if pad > 0:    # widen with -inf sentinels (never selected)
+                carry_s = jnp.pad(carry_s, ((0, 0), (0, 0), (0, pad)),
+                                  constant_values=-jnp.inf)
+                carry_i = jnp.pad(carry_i, ((0, 0), (0, 0), (0, pad)))
+            merged_s = jnp.concatenate([merged_s, carry_s], axis=0)
+            merged_i = jnp.concatenate([merged_i, carry_i], axis=0)
+        scores, ids = merged_s, merged_i
+        s = scores.shape[0]
+    out_s, out_i = scores[0], ids[0]
+    if out_s.shape[-1] > k:
+        out_s, pos = jax.lax.top_k(out_s, k)
+        out_i = jnp.take_along_axis(out_i, pos, axis=-1)
+    return out_s, out_i
+
+
 def _sharded_topk(mesh, q, table, local_scores, k: int, axis: str,
                   valid=None):
     """Generic all-gather-merge driver. ``local_scores(q, rows) -> [B, c]``
@@ -62,14 +107,11 @@ def _sharded_topk(mesh, q, table, local_scores, k: int, axis: str,
         neg, idx = jax.lax.top_k(sc, kk)                   # [B, kk]
         shard_id = jax.lax.axis_index(axis)
         gidx = idx + shard_id * c_local                    # global ids
-        # merge across shards: gather the tiny candidate sets
+        # merge across shards: gather the tiny candidate sets, then the
+        # log-depth tree merge (O(S·k) wire bytes, log2(S) selections)
         negs = jax.lax.all_gather(neg, axis, tiled=False)  # [S, B, kk]
         gidxs = jax.lax.all_gather(gidx, axis, tiled=False)
-        s = negs.shape[0]
-        negs = jnp.transpose(negs, (1, 0, 2)).reshape(q.shape[0], s * kk)
-        gidxs = jnp.transpose(gidxs, (1, 0, 2)).reshape(q.shape[0], s * kk)
-        top_neg, pos = jax.lax.top_k(negs, min(k, s * kk))
-        return top_neg, jnp.take_along_axis(gidxs, pos, axis=1)
+        return merge_topk(negs, gidxs, k)
 
     in_specs = [P(), P(axis, *([None] * (table.ndim - 1)))]
     args = [q, table]

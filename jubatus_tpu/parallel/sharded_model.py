@@ -1,0 +1,360 @@
+"""Feature-sharded linear model state — first-class shard_map programs.
+
+ROADMAP item 1 / ISSUE 13 tentpole: the weight matrix of the linear
+engines ([L, D] classifier tables, [D] regression vector) sharded over
+the FEATURE axis of a device mesh, with train/classify executing where
+the shard lives. "Large Scale Distributed Linear Algebra With Tensor
+Processing Units" (PAPERS.md) is the shape: distribute the matrix over
+the mesh, move compute to the shard, reduce only the tiny per-example
+scalars over the interconnect.
+
+Execution model (one shard_map'd jitted program per op):
+
+- Every shard receives the full CSR batch (idx/val [B, K] — kilobytes,
+  vs gigabytes of weight state) and masks it to its OWNED column range
+  ``[shard * D/S, (shard+1) * D/S)`` — the column-range partitioner that
+  routes each batch entry to the owning shard. Unowned entries
+  contribute exact zeros.
+- Partial scores from the local [L, D/S] slice are reduced with a
+  single ``psum`` over the shard axis — the ONLY cross-shard traffic
+  per step is [B, L] logits (+ [B] norms), never weight state.
+- Updates scatter into the local ``dw`` slice only. The weight matrix
+  is never gathered: per-device footprint stays (full size / n_shards)
+  + O(batch).
+
+The same decision kernel as the single-chip path
+(ops/classifier.decide_updates) keeps sharded and unsharded results
+identical to f32 rounding; parallel/spmd.py stacks this body under a
+data-parallel replica axis for the pod path.
+
+Mix integration: ``shard_chunks`` / ``assemble_chunks`` convert a
+feature-sharded leaf to/from per-shard host chunks keyed by start
+column (``c0``, ``c8388608``, ...), so each shard's diff enters the
+chunked/tiered/quantized mix pipeline independently and no step of a
+mix round materializes the full matrix in one buffer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from jubatus_tpu.ops.classifier import (
+    CONFIDENCE_METHODS,
+    ClassifierState,
+    decide_updates,
+)
+from jubatus_tpu.parallel._compat import shard_map
+
+DEFAULT_AXIS = "shard"
+
+
+def feature_shard_mesh(n_shards: int, err_cls=ValueError,
+                       axis: str = DEFAULT_AXIS) -> Mesh:
+    """A 1-D feature-shard mesh over the first ``n_shards`` LOCAL
+    devices (host-major — device_put on non-addressable devices fails
+    on a multi-host runtime). The ``--shard-features`` mesh builder."""
+    from jubatus_tpu.parallel.mesh import host_major
+
+    devs = host_major(jax.local_devices())[:n_shards]
+    if len(devs) < n_shards:
+        raise err_cls(
+            f"feature sharding needs {n_shards} local devices, "
+            f"have {len(devs)}")
+    return Mesh(np.asarray(devs), axis_names=(axis,))
+
+
+def mesh_for_features(dim: int, d_per_shard: int,
+                      err_cls=ValueError) -> Optional[Mesh]:
+    """The ``--shard-features D_PER_SHARD`` resolver: shard count =
+    dim / d_per_shard (must divide; one shard or fewer means no mesh).
+    The per-device feature budget is the HBM-capacity knob — pick the
+    widest slice one device holds and the layout follows."""
+    if d_per_shard <= 0:
+        raise err_cls(f"--shard-features must be > 0, got {d_per_shard}")
+    if dim % d_per_shard:
+        raise err_cls(
+            f"--shard-features {d_per_shard} does not divide the feature "
+            f"dim {dim} (pick a power-of-two slice of 2^dim_bits)")
+    n = dim // d_per_shard
+    if n <= 1:
+        return None
+    return feature_shard_mesh(n, err_cls)
+
+
+def state_spec(leaf, dim: int, axis: str = DEFAULT_AXIS) -> P:
+    """PartitionSpec for one state leaf: trailing (feature) dim sharded
+    when it spans the model dim; (1, 1) placeholders and scalars stay
+    replicated."""
+    shape = getattr(leaf, "shape", ())
+    if len(shape) >= 1 and shape[-1] == dim:
+        return P(*([None] * (len(shape) - 1)), axis)
+    return P()
+
+
+def place_state(mesh: Mesh, state, dim: int, axis: str = DEFAULT_AXIS):
+    """Pin every feature-spanning leaf of a state pytree to the sharded
+    layout (NamedSharding over ``axis``); other leaves replicate."""
+    def put(a):
+        return jax.device_put(
+            a, NamedSharding(mesh, state_spec(a, dim, axis)))
+
+    return jax.tree_util.tree_map(put, state)
+
+
+def _owned(idx, val, d_local, axis):
+    """Column-range partition of one CSR batch: local indices + values
+    for the entries this shard owns, zeros elsewhere."""
+    lo = jax.lax.axis_index(axis) * d_local
+    li_raw = idx - lo
+    owned = (li_raw >= 0) & (li_raw < d_local)
+    li = jnp.where(owned, li_raw, 0)
+    lv = jnp.where(owned, val, 0.0)
+    return li, lv, owned
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "method"), donate_argnums=(1,))
+def train_batch(mesh: Mesh, state: ClassifierState, idx: jax.Array,
+                val: jax.Array, labels: jax.Array, label_mask: jax.Array,
+                param: float, *, method: str,
+                axis: str = DEFAULT_AXIS) -> ClassifierState:
+    """Feature-sharded vectorized microbatch update (the shard_map'd
+    mirror of ops.train_batch_parallel; parallel/spmd.py runs the same
+    body under an extra replica axis). Batch arrays are replicated (the
+    batch is kilobytes; the state is the thing that must not move);
+    state leaves are sharded over ``axis``. One psum of [B, L] partial
+    scores (+ [B] norms) per step — weight state never crosses shards."""
+    confidence = method in CONFIDENCE_METHODS
+    n_shards = mesh.shape[axis]
+    dim = state.w.shape[-1]
+
+    def body(w, dw, prec, dprec, idx, val, labels, label_mask):
+        d_local = w.shape[1]
+        li, lv, owned = _owned(idx, val, d_local, axis)
+
+        eff = w + dw
+        g = jnp.take(eff, li, axis=1)                      # [L, B, K]
+        s = jax.lax.psum(jnp.einsum("lbk,bk->bl", g, lv), axis)
+        x2_vec_l = lv * lv
+        x2 = jax.lax.psum(jnp.sum(x2_vec_l, axis=1), axis)
+
+        if confidence:
+            p = prec + dprec
+            pg = jnp.take(p, li, axis=1)                   # [L, B, K]
+            p_c = jnp.take_along_axis(pg, labels[None, :, None], axis=0)[0]
+            sig_c = jnp.where(owned, 1.0 / p_c, 0.0)
+            wrong0, _, _, _ = decide_updates(
+                s, labels, label_mask, x2, jnp.zeros_like(x2), x2_vec_l,
+                param, method=method)
+            p_w = jnp.take_along_axis(pg, wrong0[None, :, None], axis=0)[0]
+            no_rival = jnp.sum(label_mask) < 2
+            sig_w = jnp.where(owned,
+                              jnp.where(no_rival, 1.0, 1.0 / p_w), 0.0)
+            v = jax.lax.psum(
+                jnp.sum((sig_c + sig_w) * x2_vec_l, axis=1), axis)
+        else:
+            sig_c = sig_w = jnp.where(owned, 1.0, 0.0)
+            v = jnp.zeros_like(x2)
+
+        wrong, alpha, alpha_w, dp = decide_updates(
+            s, labels, label_mask, x2, v, x2_vec_l, param, method=method)
+
+        up_c = alpha[:, None] * sig_c * lv
+        up_w = alpha_w[:, None] * sig_w * lv
+        dw = dw.at[labels[:, None], li].add(jnp.where(owned, up_c, 0.0))
+        dw = dw.at[wrong[:, None], li].add(jnp.where(owned, -up_w, 0.0))
+        if confidence:
+            dp = jnp.where(owned, dp, 0.0)
+            dprec = dprec.at[labels[:, None], li].add(dp)
+            dprec = dprec.at[wrong[:, None], li].add(
+                jnp.where((alpha_w > 0.0)[:, None], dp, 0.0))
+        return w, dw, prec, dprec
+
+    specs = tuple(state_spec(a, dim, axis) for a in state)
+    out = shard_map(
+        body, mesh=mesh,
+        in_specs=specs + (P(), P(), P(), P()),
+        out_specs=specs,
+        check_vma=False,
+    )(state.w, state.dw, state.prec, state.dprec,
+      idx, val, labels, label_mask)
+    return ClassifierState(*out)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def scores(mesh: Mesh, state: ClassifierState, idx: jax.Array,
+           val: jax.Array, label_mask: jax.Array,
+           axis: str = DEFAULT_AXIS) -> jax.Array:
+    """Feature-sharded batch classify: each shard scores its column
+    range, one psum assembles the [B, L] logits (replicated out). Same
+    -inf dead-label convention as ops.scores."""
+    dim = state.w.shape[-1]
+    neg = jnp.float32(-1e30)
+
+    def body(w, dw, idx, val, label_mask):
+        d_local = w.shape[1]
+        li, lv, _ = _owned(idx, val, d_local, axis)
+        eff = w + dw
+        g = jnp.take(eff, li, axis=1)                      # [L, B, K]
+        s = jax.lax.psum(jnp.einsum("lbk,bk->bl", g, lv), axis)
+        return jnp.where(label_mask[None, :], s, neg)
+
+    spec = state_spec(state.w, dim, axis)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(state.w, state.dw, idx, val, label_mask)
+
+
+# -- regression (single weight row) ------------------------------------------
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "method"), donate_argnums=(1,))
+def regression_train_batch(mesh: Mesh, state, idx: jax.Array,
+                           val: jax.Array, targets: jax.Array,
+                           sensitivity: float, c: float, *, method: str,
+                           axis: str = DEFAULT_AXIS):
+    """Feature-sharded PA regression train: the per-example sequential
+    scan of ops/regression.train_batch with the prediction reduced over
+    the shard axis each step (exact reference semantics preserved —
+    the scan stays sequential; only the dot products are sharded)."""
+    from jubatus_tpu.ops.regression import RegressionState
+
+    dim = state.w.shape[-1]
+
+    def body(w, dw, idx, val, targets):
+        d_local = w.shape[0]
+
+        def step(carry, ex):
+            w, dw = carry
+            e_idx, e_val, y = ex
+            lo = jax.lax.axis_index(axis) * d_local
+            li_raw = e_idx - lo
+            owned = (li_raw >= 0) & (li_raw < d_local)
+            li = jnp.where(owned, li_raw, 0)
+            lv = jnp.where(owned, e_val, 0.0)
+            pred = jax.lax.psum(
+                jnp.sum((jnp.take(w, li) + jnp.take(dw, li)) * lv), axis)
+            err = y - pred
+            loss = jnp.abs(err) - sensitivity
+            x2 = jnp.maximum(
+                jax.lax.psum(jnp.sum(lv * lv), axis), 1e-12)
+            if method == "PA":
+                alpha = loss / x2
+            elif method == "PA1":
+                alpha = jnp.minimum(c, loss / x2)
+            elif method == "PA2":
+                alpha = loss / (x2 + 1.0 / (2.0 * c))
+            else:
+                raise ValueError(f"unknown regression method {method!r}")
+            alpha = jnp.where(loss > 0.0, alpha, 0.0)
+            dw = dw.at[li].add(jnp.sign(err) * alpha * lv)
+            return (w, dw), ()
+
+        (w, dw), _ = jax.lax.scan(step, (w, dw), (idx, val, targets))
+        return w, dw
+
+    spec = P(axis)
+    out = shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, P(), P(), P()),
+        out_specs=(spec, spec),
+        check_vma=False,
+    )(state.w, state.dw, idx, val, targets)
+    return RegressionState(*out)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def regression_estimate(mesh: Mesh, state, idx: jax.Array, val: jax.Array,
+                        axis: str = DEFAULT_AXIS) -> jax.Array:
+    """Feature-sharded batch estimates: [B], one psum of the per-shard
+    partial dot products."""
+    def body(w, dw, idx, val):
+        d_local = w.shape[0]
+        li, lv, _ = _owned(idx, val, d_local, axis)
+        eff = jnp.take(w, li) + jnp.take(dw, li)
+        return jax.lax.psum(jnp.einsum("bk,bk->b", eff, lv), axis)
+
+    spec = P(axis)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(state.w, state.dw, idx, val)
+
+
+# -- per-shard diff chunking (mix-plane integration) -------------------------
+
+def shard_chunks(arr, rows: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """A feature-sharded array as per-shard HOST chunks keyed by start
+    column (``c0``, ``c<D/S>``, ...). Each shard's slice copies
+    device→host independently — the full matrix is never materialized
+    in one buffer, and each chunk enters the mix pipeline (tiered +
+    quantized, PR 1/6/9) on its own. ``rows`` trims to the active label
+    rows (the wire cut the classifier mixable already makes)."""
+    out: Dict[str, np.ndarray] = {}
+    for sh in arr.addressable_shards:
+        sl = sh.index[-1]
+        start = sl.start or 0
+        chunk = np.asarray(sh.data)
+        if rows is not None and chunk.ndim == 2 and rows < chunk.shape[0]:
+            chunk = chunk[:rows]
+        out[f"c{start}"] = chunk
+    return out
+
+
+def is_chunked(leaf) -> bool:
+    """Does this diff leaf carry the per-shard chunk wire shape?"""
+    return isinstance(leaf, dict) and leaf and \
+        all(isinstance(k, (str, bytes))
+            and (k.decode() if isinstance(k, bytes) else k).startswith("c")
+            for k in leaf)
+
+
+def assemble_chunks(chunks: Dict[str, np.ndarray], sharding) -> jax.Array:
+    """Per-shard wire chunks back to one feature-sharded device array
+    (the receive half of ``shard_chunks``): each chunk is placed
+    directly on its owning shard's device — no host concatenation of
+    the full matrix, no device gather. Raises ValueError on a layout
+    mismatch (a peer sharded differently — the mix must not fold
+    misaligned columns)."""
+    items = sorted(
+        ((int((k.decode() if isinstance(k, bytes) else k)[1:]), np.asarray(v))
+         for k, v in chunks.items()),
+        key=lambda kv: kv[0])
+    widths = [v.shape[-1] for _, v in items]
+    total = sum(widths)
+    mesh = sharding.mesh
+    devices = list(mesh.devices.flat)
+    if len(items) != len(devices):
+        raise ValueError(
+            f"shard layout mismatch: {len(items)} wire chunks for a "
+            f"{len(devices)}-shard mesh (peers must share one "
+            "--shard-devices/--shard-features layout)")
+    expect = 0
+    for (start, v), dev in zip(items, devices):
+        if start != expect:
+            raise ValueError(
+                f"shard layout mismatch: chunk starts at column {start}, "
+                f"expected {expect}")
+        expect += v.shape[-1]
+    shape = items[0][1].shape[:-1] + (total,)
+    return jax.make_array_from_single_device_arrays(
+        shape, sharding,
+        [jax.device_put(v, dev) for (_, v), dev in zip(items, devices)])
+
+
+def chunk_sharding(mesh: Mesh, rank: int = 2,
+                   axis: str = DEFAULT_AXIS) -> NamedSharding:
+    """The trailing-dim feature sharding ``assemble_chunks`` re-places
+    into (rank 2 for [L, D] tables, 1 for [D] vectors)."""
+    return NamedSharding(mesh, P(*([None] * (rank - 1)), axis))

@@ -39,10 +39,14 @@ class ServerArgs:
     #: many examples (server/microbatch.py); 0 = direct per-RPC path
     microbatch_max: int = 8192
     #: span the model over this many local devices (0/1 = single
-    #: device): feature-sharded tables for linear classifier/regression,
-    #: row-sharded signature tables for NN/recommender/anomaly hash
-    #: methods
+    #: device): feature-sharded tables for linear classifier/regression
+    #: (shard_map'd train/classify), row-sharded arenas + signature
+    #: tables for NN/recommender/anomaly hash methods
     shard_devices: int = 0
+    #: features per shard for the linear engines: the per-device HBM
+    #: budget form of --shard-devices (shard count = D / D_PER_SHARD);
+    #: mutually exclusive with --shard-devices
+    shard_features: int = 0
     #: FORCE every response into the pre-str8/bin msgpack format deployed
     #: jubatus clients require (their vendored msgpack predates those
     #: types); mixer internals keep the modern format (rpc/legacy.py).
@@ -241,8 +245,20 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
     p.add_argument("--shard-devices", type=int, default=0,
                    help="span the model over this many local devices (0/1 = "
                         "single device): feature-sharded tables for linear "
-                        "classifier/regression, row-sharded signature "
-                        "tables for NN/recommender/anomaly hash methods")
+                        "classifier/regression (shard_map'd train/classify "
+                        "— per-device weight state is D/N), row-sharded "
+                        "arenas + signature tables for NN/recommender/"
+                        "anomaly hash methods (rows land in their "
+                        "CHT-owned shard; per-shard top-k with a "
+                        "log-depth on-device merge)")
+    p.add_argument("--shard-features", type=int, default=0, metavar="D_PER_SHARD",
+                   help="feature-shard the linear engines by per-device "
+                        "budget instead of device count: shard count = "
+                        "feature dim / D_PER_SHARD (must divide; needs "
+                        "that many local devices). The HBM-capacity "
+                        "spelling of --shard-devices — pick the widest "
+                        "slice one device holds and the layout follows. "
+                        "Mutually exclusive with --shard-devices")
     p.add_argument("--legacy-wire", action="store_true",
                    help="FORCE all RPC responses into the pre-str8/bin "
                         "msgpack format legacy jubatus clients (vendored "
@@ -414,6 +430,13 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
         raise SystemExit("--microbatch-max must be >= 0")
     if args.shard_devices < 0:
         raise SystemExit("--shard-devices must be >= 0")
+    if args.shard_features < 0:
+        raise SystemExit("--shard-features must be >= 0")
+    if args.shard_features and args.shard_devices:
+        raise SystemExit(
+            "--shard-features and --shard-devices are mutually exclusive "
+            "(the former derives the device count from the per-device "
+            "feature budget)")
     if args.rpc_port < 0 or args.rpc_port > 65535:
         raise SystemExit("--rpc-port out of range")
     if args.metrics_port > 65535:

@@ -40,15 +40,28 @@ DRIVER_CLASSES: Dict[str, Type] = {
 }
 
 
-def create_driver(engine: str, config: Any, mesh=None):
+#: engines with a sharded layout, by mechanism — the error message below
+#: and docs/SHARDING.md must both name these
+FEATURE_SHARDED_ENGINES = ("classifier", "regression")
+ROW_SHARDED_ENGINES = ("nearest_neighbor", "recommender", "anomaly")
+
+
+def create_driver(engine: str, config: Any, mesh=None,
+                  shard_features: int = 0):
     """Instantiate the engine's driver from a JSON config (str or dict).
 
     ``mesh`` (``--shard-devices``): span the model over a local device
     mesh — FEATURE-sharded [.., D] tables for the linear engines
-    (classifier/regression), ROW-sharded signature tables for the
-    instance engines with hash methods (nearest_neighbor, recommender,
-    anomaly, instance classifier — ``NNBackend.attach_mesh``; anomaly's
-    LOF rides the full-distance sharded scan)."""
+    (classifier/regression, shard_map'd train/classify in
+    parallel/sharded_model.py), ROW-sharded arenas + signature tables
+    for the instance engines with hash methods (nearest_neighbor,
+    recommender, anomaly, instance classifier —
+    ``NNBackend.attach_mesh`` over parallel/row_store.py; anomaly's LOF
+    rides the full-distance sharded scan).
+
+    ``shard_features`` (``--shard-features D_PER_SHARD``): linear
+    engines only — derive the shard count from the per-device feature
+    budget instead of naming a device count."""
     if isinstance(config, str):
         config = json.loads(config)
     try:
@@ -57,6 +70,13 @@ def create_driver(engine: str, config: Any, mesh=None):
         raise KeyError(
             f"unknown engine {engine!r}; known: {', '.join(sorted(DRIVER_CLASSES))}"
         )
+    if shard_features and engine not in FEATURE_SHARDED_ENGINES:
+        raise ValueError(
+            f"--shard-features applies to the feature-sharded linear "
+            f"engines ({', '.join(FEATURE_SHARDED_ENGINES)}), not "
+            f"{engine!r}; row-store engines "
+            f"({', '.join(ROW_SHARDED_ENGINES)}) shard rows via "
+            "--shard-devices N")
     # classifier splits by method family: linear (PA/.../NHERD) vs
     # instance-based (NN/cosine/euclidean), like classifier_factory
     if engine == "classifier":
@@ -64,16 +84,23 @@ def create_driver(engine: str, config: Any, mesh=None):
 
         if isinstance(config, dict) and config.get("method") in NN_METHODS:
             return _maybe_attach(ClassifierNNDriver(config), mesh)
-        return cls(config, mesh=mesh)
+        return cls(config, mesh=mesh, shard_features=shard_features)
     if engine == "regression":
-        return cls(config, mesh=mesh)
-    if engine in ("nearest_neighbor", "recommender", "anomaly"):
+        return cls(config, mesh=mesh, shard_features=shard_features)
+    if engine in ROW_SHARDED_ENGINES:
         # anomaly rides sharded_distances (LOF needs full distance
-        # vectors); NN/recommender ride the sharded top-k
+        # vectors); NN/recommender ride the sharded top-k over the
+        # sharded row store
         return _maybe_attach(cls(config), mesh)
     if mesh is not None:
         raise ValueError(
-            f"--shard-devices is not supported for engine {engine!r}")
+            f"--shard-devices is not supported for engine {engine!r}; "
+            f"sharding-capable engines: "
+            f"{', '.join(FEATURE_SHARDED_ENGINES)} (feature-sharded "
+            "weight state; also --shard-features D_PER_SHARD) and "
+            f"{', '.join(ROW_SHARDED_ENGINES)} (row-sharded stores). "
+            "Spell the flag --shard-devices N (local device count) or "
+            "--shard-features D_PER_SHARD (per-device feature budget)")
     return cls(config)
 
 

@@ -71,6 +71,44 @@ def test_direction_inference_autoscale_keys():
     assert bc.direction("e2e_fleet_seed") is None
 
 
+def test_direction_inference_sharded_keys():
+    """ISSUE 13 feature-sharding plane: train throughput at d26 gates
+    up-good per shard count, classify/KNN query p99 down-good — single-
+    AND multi-shard spellings, at both row-count scales."""
+    assert bc.direction("sharded_train_samples_per_sec_d26_1shard") \
+        == "higher"
+    assert bc.direction("sharded_train_samples_per_sec_d26_8shard") \
+        == "higher"
+    assert bc.direction("sharded_classify_p99_ms_d26_1shard") == "lower"
+    assert bc.direction("sharded_classify_p99_ms_d26_8shard") == "lower"
+    assert bc.direction("knn_query_p99_ms_rows1e6_1shard") == "lower"
+    assert bc.direction("knn_query_p99_ms_rows1e6_8shard") == "lower"
+    assert bc.direction("knn_query_p99_ms_rows1e8_1shard") == "lower"
+    assert bc.direction("knn_query_p99_ms_rows1e8_8shard") == "lower"
+    # neighbors that must NOT accidentally gate
+    assert bc.direction("sharded_train_shards") is None
+    assert bc.direction("knn_query_rows_rows1e6") is None
+
+
+def test_sharded_keys_gate_in_compare():
+    old = {"sharded_train_samples_per_sec_d26_8shard": 50000.0,
+           "sharded_classify_p99_ms_d26_8shard": 40.0,
+           "knn_query_p99_ms_rows1e8_8shard": 900.0,
+           "knn_query_p99_ms_rows1e6_1shard": 8.0}
+    new = {"sharded_train_samples_per_sec_d26_8shard": 42000.0,  # regressed
+           "sharded_classify_p99_ms_d26_8shard": 36.0,           # improved
+           "knn_query_p99_ms_rows1e8_8shard": 1100.0,            # regressed
+           "knn_query_p99_ms_rows1e6_1shard": 8.1}               # within tol
+    rows, regs = bc.compare(bc.flatten(old), bc.flatten(new))
+    verdicts = {r["key"]: r["verdict"] for r in rows}
+    assert verdicts["sharded_train_samples_per_sec_d26_8shard"] \
+        == "REGRESSED"
+    assert verdicts["sharded_classify_p99_ms_d26_8shard"] == "improved"
+    assert verdicts["knn_query_p99_ms_rows1e8_8shard"] == "REGRESSED"
+    assert verdicts["knn_query_p99_ms_rows1e6_1shard"] == "ok"
+    assert len(regs) == 2
+
+
 def test_autoscale_keys_gate_in_compare(tmp_path):
     old = {"e2e_scaleout_recovery_s": 10.0,
            "e2e_autoscale_slo_violation_s": 12.0,

@@ -44,6 +44,43 @@ def test_host_cast_gate_fires_and_pragma_opts_out(tmp_path):
     assert ":3:" in cast_hits[0] and ":4:" in cast_hits[1]
 
 
+def test_full_gather_gate_fires_and_pragma_opts_out(tmp_path):
+    """The parallel/+models/ full-gather rule (ISSUE 13): a full-matrix
+    jax.device_get / process_allgather of sharded leaves in a
+    sharded-layout hot path is flagged; the # full-gather-ok pragma and
+    per-shard chunk reads are not."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo / "tools" / "codestyle"))
+    try:
+        import check as codestyle
+    finally:
+        sys.path.pop(0)
+    for sub in ("parallel", "models"):
+        d = tmp_path / "jubatus_tpu" / sub
+        d.mkdir(parents=True)
+        bad = d / "victim.py"
+        bad.write_text(
+            '"""doc."""\n'
+            "import jax\n"
+            "x = jax.device_get(state.w)\n"                       # flagged
+            "y = multihost_utils.process_allgather(state.w)\n"    # flagged
+            "z = jax.device_get(tot)  # full-gather-ok - total\n"  # pragma
+            "w = sharded_model.shard_chunks(state.dw)\n",   # per-shard path
+            encoding="utf-8")
+        problems = codestyle.check_file(str(bad))
+        hits = [p for p in problems if "full-matrix device_get" in p]
+        assert len(hits) == 2, problems
+        assert ":3:" in hits[0] and ":4:" in hits[1]
+    # outside the gated dirs the rule stays silent
+    other = tmp_path / "jubatus_tpu" / "framework"
+    other.mkdir(parents=True)
+    ok = other / "fine.py"
+    ok.write_text('"""doc."""\nimport jax\nx = jax.device_get(a)\n',
+                  encoding="utf-8")
+    assert not [p for p in codestyle.check_file(str(ok))
+                if "full-matrix device_get" in p]
+
+
 def test_metrics_docs_catalog_clean():
     """The metric-catalog gate (ISSUE 7): every literal counter/gauge
     key exported through the tracing registry must appear in the

@@ -122,8 +122,11 @@ def test_driver_level_mesh(mesh, rng):
 @pytest.mark.parametrize("method", HASH_METHODS)
 def test_mesh_full_distances_match_dense(method, mesh, rng):
     """sharded_distances (LOF's full-vector path) must reproduce the
-    dense distances bit-for-bit, including the dead-slot +inf mask and
-    the batched distances_from_slots cache fill."""
+    dense distances bit-for-bit per ROW, including the dead-slot +inf
+    mask and the batched distances_from_slots cache fill. Slot numbers
+    differ by design since ISSUE 13: attach_mesh re-places rows into
+    their CHT-owned shard arenas (parallel/row_store.py), so alignment
+    goes through each backend's own id→slot map."""
     dense = NNBackend(method, dim=DIM, hash_num=32)
     shard = NNBackend(method, dim=DIM, hash_num=32)
     for i in range(21):  # odd count exercises capacity padding
@@ -139,12 +142,23 @@ def test_mesh_full_distances_match_dense(method, mesh, rng):
     # the dense single-query path subtracts directly
     atol = 2e-3 if method == "euclid_lsh" else 1e-6
     q = _vec(rng)
-    np.testing.assert_allclose(shard.distances(q), dense.distances(q),
-                               rtol=1e-4, atol=atol)
-    slots = np.asarray(sorted(dense.store.slots.values())[:6])
-    np.testing.assert_allclose(shard.distances_from_slots(slots),
-                               dense.distances_from_slots(slots),
-                               rtol=1e-4, atol=atol)
+    d_shard = shard.distances(q)
+    d_dense = dense.distances(q)
+    for rid, ds in dense.store.slots.items():
+        np.testing.assert_allclose(d_shard[shard.store.slots[rid]],
+                                   d_dense[ds], rtol=1e-4, atol=atol)
+    # dead slots (including the removed row's) stay +inf on both
+    assert np.all(np.isinf(d_shard[~shard.store.live_mask()]))
+    assert np.all(np.isinf(d_dense[~dense.store.live_mask()]))
+    rids = sorted(dense.store.slots)[:6]
+    out_shard = shard.distances_from_slots(
+        np.asarray([shard.store.slots[r] for r in rids]))
+    out_dense = dense.distances_from_slots(
+        np.asarray([dense.store.slots[r] for r in rids]))
+    for rid2 in dense.store.slots:
+        np.testing.assert_allclose(
+            out_shard[:, shard.store.slots[rid2]],
+            out_dense[:, dense.store.slots[rid2]], rtol=1e-4, atol=atol)
 
 
 def test_anomaly_driver_sharded_lof(mesh, rng):

@@ -1,0 +1,259 @@
+"""Sharded row store tests (parallel/row_store.py, ISSUE 13): CHT-stable
+shard placement, arena growth/eviction parity with the flat store, the
+log-depth on-device top-k merge, migration-plane landing (PR 10 wire
+format rows arrive in the owning shard and stay out of the next mix
+diff), and serve_range walking shards without touching the device
+table."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from jubatus_tpu.coord.cht import CHT, shard_for
+from jubatus_tpu.coord.base import NodeInfo
+from jubatus_tpu.core.row_store import RowStore
+from jubatus_tpu.models._nn_backend import NNBackend
+from jubatus_tpu.parallel.row_store import ShardedRowStore
+
+DIM = 1 << 10
+
+
+def _mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ("shard",))
+
+
+def _vec(rng, nnz=6):
+    idx = rng.integers(1, DIM, size=nnz)
+    val = rng.normal(size=nnz)
+    return [(int(i), float(v)) for i, v in zip(idx, val)]
+
+
+# -- store semantics ---------------------------------------------------------
+
+def test_placement_is_cht_stable(rng):
+    s = ShardedRowStore(n_shards=4)
+    for i in range(300):
+        rid = f"row{i}"
+        s.set_row(rid, _vec(rng))
+        shard, local = s.shard_slot(rid)
+        assert shard == shard_for(rid, 4)
+        assert 0 <= local < s.cap_per_shard
+        assert s.slots[rid] == shard * s.cap_per_shard + local
+    assert sum(s.rows_per_shard()) == 300
+
+
+def test_growth_preserves_rows_and_shards(rng):
+    s = ShardedRowStore(n_shards=3, capacity_per_shard=4)
+    vecs = {f"r{i}": _vec(rng) for i in range(200)}   # forces many doublings
+    for rid, v in vecs.items():
+        s.set_row(rid, v)
+    assert s.cap_per_shard > 4
+    for rid, v in vecs.items():
+        got = s.get_row(rid)
+        assert [i for i, _ in got] == [i for i, _ in v]
+        np.testing.assert_allclose([x for _, x in got], [x for _, x in v],
+                                   rtol=1e-6)   # f32 round-trip
+        assert s.shard_slot(rid)[0] == shard_for(rid, 3)
+    live = s.live_mask()
+    assert live.sum() == 200
+    assert len(live) == s.capacity
+
+
+def test_remove_reuses_slots_and_lru_eviction(rng):
+    s = ShardedRowStore(n_shards=2, max_size=10)
+    for i in range(10):
+        s.set_row(f"r{i}", _vec(rng))
+    s.get_row("r0")
+    s.touch("r0")   # refresh r0; r1 becomes the LRU victim
+    s.set_row("r10", _vec(rng))
+    assert len(s) == 10 and "r1" not in s and "r0" in s
+    cap_before = s.capacity
+    s.remove_row("r2")
+    s.set_row("r11", _vec(rng))
+    assert s.capacity == cap_before   # freed slots are reused
+
+
+def test_flat_parity_and_pack_interchange(rng):
+    """Same rows, same pack format: flat and sharded stores interchange
+    checkpoints, and a 4-shard pack re-places into a 2-shard store
+    (reshard-on-restore for the instance engines)."""
+    flat, sh4 = RowStore(), ShardedRowStore(n_shards=4)
+    vecs = {f"r{i}": _vec(rng) for i in range(64)}
+    for rid, v in vecs.items():
+        flat.set_row(rid, v)
+        sh4.set_row(rid, v)
+    assert sorted(flat.all_ids()) == sorted(sh4.all_ids())
+    p = sh4.pack()
+    assert set(p["rows"]) == set(flat.pack()["rows"])
+    sh2 = ShardedRowStore(n_shards=2)
+    sh2.unpack(p)
+    for rid, v in vecs.items():
+        got = sh2.get_row(rid)
+        assert [i for i, _ in got] == [i for i, _ in v]
+        np.testing.assert_allclose([x for _, x in got], [x for _, x in v],
+                                   rtol=1e-6)   # f32 round-trip
+        assert sh2.shard_slot(rid)[0] == shard_for(rid, 2)
+    back = RowStore()
+    back.unpack(p)
+    assert sorted(back.all_ids()) == sorted(flat.all_ids())
+
+
+def test_per_shard_update_diffs(rng):
+    s = ShardedRowStore(n_shards=4)
+    for i in range(40):
+        s.set_row(f"r{i}", _vec(rng))
+    per = s.pop_update_diff_sharded()
+    assert len(per) == 4
+    assert sum(len(d) for d in per) == 40
+    for k, d in enumerate(per):
+        for rid in d:
+            assert shard_for(rid, 4) == k
+    assert not s.updated_since_mix   # tracker drained
+    # applying a diff does not re-enter the next diff
+    s.apply_update_diff({"rx": ([1, 2], [0.5, 0.5], None)})
+    assert s.pop_update_diff() == {}
+
+
+# -- sharded top-k via the backend -------------------------------------------
+
+@pytest.mark.parametrize("n_shards", (2, 3, 8))
+def test_backend_topk_matches_dense(n_shards, rng):
+    dense = NNBackend("lsh", dim=DIM, hash_num=64)
+    shard = NNBackend("lsh", dim=DIM, hash_num=64)
+    shard.attach_mesh(_mesh(n_shards))
+    assert isinstance(shard.store, ShardedRowStore) or n_shards == 1
+    vecs = {f"r{i}": _vec(rng) for i in range(120)}
+    for rid, v in vecs.items():
+        dense.set_row(rid, v)
+        shard.set_row(rid, v)
+    q = _vec(rng)
+    want = dense.neighbors(q, 9)
+    got = shard.neighbors(q, 9)
+    np.testing.assert_allclose([d for _, d in got], [d for _, d in want],
+                               rtol=1e-5, atol=1e-6)
+    want_by_id = dict(want)
+    for rid, d in got:
+        if rid in want_by_id:   # hash ties may swap equal-distance ids
+            np.testing.assert_allclose(d, want_by_id[rid],
+                                       rtol=1e-5, atol=1e-6)
+    assert shard.last_topk_ms is not None and shard.last_topk_ms > 0
+    st = shard.shard_stats()
+    assert st["count"] == n_shards and st["rows"] == 120
+    assert st["topk_merge_ms"] == round(shard.last_topk_ms, 3)
+
+
+def test_merge_topk_matches_flat_selection(rng):
+    """The log-depth tree merge must pick exactly the global top-k the
+    flat [B, S*kk] selection picks (distinct scores: no tie ambiguity),
+    including non-power-of-two shard counts (odd-carry path)."""
+    import jax.numpy as jnp
+
+    from jubatus_tpu.parallel.sharded_knn import merge_topk
+
+    for s_count in (2, 3, 5, 8):
+        scores = rng.permutation(s_count * 4 * 7).reshape(
+            s_count, 4, 7).astype(np.float32)
+        ids = np.arange(s_count * 4 * 7).reshape(s_count, 4, 7)
+        got_s, got_i = merge_topk(jnp.asarray(scores), jnp.asarray(ids), 5)
+        flat_s = scores.transpose(1, 0, 2).reshape(4, -1)
+        flat_i = ids.transpose(1, 0, 2).reshape(4, -1)
+        order = np.argsort(-flat_s, axis=1)[:, :5]
+        np.testing.assert_allclose(np.asarray(got_s),
+                                   np.take_along_axis(flat_s, order, 1))
+        np.testing.assert_array_equal(np.asarray(got_i),
+                                      np.take_along_axis(flat_i, order, 1))
+
+
+# -- migration plane ---------------------------------------------------------
+
+def _nn_driver(mesh=None):
+    from jubatus_tpu.server.factory import create_driver
+
+    cfg = {"method": "lsh", "parameter": {"hash_num": 64},
+           "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+    return create_driver("nearest_neighbor", cfg, mesh=mesh)
+
+
+def test_migrated_rows_land_in_owning_shard_and_skip_next_diff(rng):
+    """ISSUE 13 satellite: a row pushed via the PR 10 wire format
+    (NNRowMigration.put_rows: [id, idx, val, datum]) lands in the
+    CHT-owned shard arena and is excluded from the next mix diff."""
+    drv = _nn_driver(mesh=_mesh(4))
+    store = drv.backend.store
+    assert isinstance(store, ShardedRowStore)
+    rows = [[f"m{i}",
+             [int(j) for j in rng.integers(1, DIM, size=5)],
+             [float(v) for v in rng.normal(size=5)], None]
+            for i in range(24)]
+    n = drv.put_rows(rows)
+    assert n == 24
+    for row in rows:
+        rid = row[0]
+        shard, _local = store.shard_slot(rid)
+        assert shard == shard_for(rid, 4)
+    # migrated rows already live on their owners: next diff must be empty
+    diff = drv.get_mixables()["rows"].get_diff()
+    assert diff == {}
+    # a LOCAL write after migration does enter the diff
+    from jubatus_tpu.core.datum import Datum
+
+    drv.set_row("local1", Datum({"f0": 1.0}))
+    diff = drv.get_mixables()["rows"].get_diff()
+    assert set(diff) == {"local1"}
+
+
+def test_serve_range_walks_shards_without_device_table(rng):
+    """serve_range over a sharded store must stay on host metadata —
+    the device table (device_view / the mesh signature upload) must
+    never be materialized by a migration walk."""
+    from jubatus_tpu.framework.migration import serve_range
+
+    drv = _nn_driver(mesh=_mesh(4))
+    from jubatus_tpu.core.datum import Datum
+
+    for i in range(60):
+        drv.set_row(f"r{i:03d}", Datum(
+            {f"f{j}": float(v) for j, v in enumerate(rng.normal(size=5))}))
+    store = drv.backend.store
+
+    def boom(*a, **k):   # any device materialization fails the test
+        raise AssertionError("serve_range touched the device table")
+
+    store.device_view = boom
+    drv.backend._mesh_view = boom
+    members = [NodeInfo("10.0.0.1", 9199), NodeInfo("10.0.0.2", 9199)]
+    ring = CHT(members, epoch=1)
+    target = members[0].name
+    got, cursor, rounds = [], "", 0
+    while True:
+        doc = serve_range(drv, ring, target, cursor, limit_bytes=512)
+        got.extend(doc["rows"])
+        rounds += 1
+        if doc["done"]:
+            break
+        cursor = doc["cursor"]
+    assert rounds > 1   # byte budget actually chunked the walk
+    ids = [r[0] for r in got]
+    assert ids == sorted(ids)   # cursor-exact sorted walk
+    from jubatus_tpu.framework.migration import row_owned_by
+
+    want = [rid for rid in sorted(store.all_ids())
+            if row_owned_by(ring, rid, target)]
+    assert ids == want
+
+
+def test_shard_ids_covers_all_rows(rng):
+    s = ShardedRowStore(n_shards=4)
+    for i in range(50):
+        s.set_row(f"q{i}", _vec(rng))
+    seen = []
+    for k in range(4):
+        ids = s.shard_ids(k)
+        for rid in ids:
+            assert shard_for(rid, 4) == k
+        seen.extend(ids)
+    assert sorted(seen) == sorted(s.all_ids())

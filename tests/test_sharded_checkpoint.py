@@ -131,6 +131,64 @@ def test_jubadump_cli_corrupt_dir_exits_cleanly(saved, capsys):
     assert "truncated" in err or "magic" in err
 
 
+@pytest.mark.parametrize("n_from,n_to", [(4, 1), (1, 4), (4, 2), (2, 8)])
+def test_reshard_on_restore(tmp_path, n_from, n_to):
+    """ISSUE 13: a checkpoint saved at N shards restores BIT-EXACT onto
+    an M-shard template (N→1, 1→M, N→M) — the template's shardings
+    govern placement, the bytes are layout-independent."""
+    import jax
+
+    from jubatus_tpu.ops.classifier import init_state
+    from jubatus_tpu.parallel import sharded_model as sm
+
+    dim = 64
+
+    def featured(n):
+        st = init_state(4, dim, True)
+        if n > 1:
+            return sm.place_state(sm.feature_shard_mesh(n), st, dim)
+        return st
+
+    rng = np.random.default_rng(7)
+    src = featured(n_from)
+    src = src._replace(
+        w=src.w + jax.numpy.asarray(rng.normal(size=(4, dim)),
+                                    dtype=jax.numpy.float32),
+        dprec=src.dprec + 0.25)
+    path = str(tmp_path / "ckpt")
+    save_sharded(path, src, engine_type="classifier", model_id="rs",
+                 config=CONFIG)
+    md = checkpoint_metadata(path)
+    if n_from > 1:
+        assert md["system"]["shard_layout"] == {"shard": n_from}
+    tmpl = abstract_like(featured(n_to))
+    system, out = load_sharded(path, tmpl, expected_type="classifier",
+                               expected_config=CONFIG)
+    for name, (a, b) in zip(("w", "dw", "prec", "dprec"), zip(src, out)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)   # bit-exact
+    # restored placement follows the TEMPLATE's layout, not the source's
+    for leaf, want in zip(out, tmpl):
+        assert leaf.sharding == want.sharding
+        if n_to > 1:
+            for shard in leaf.addressable_shards:
+                assert shard.data.shape[-1] == dim // n_to
+
+
+def test_reshard_on_restore_grid(mesh, saved, tmp_path):
+    """The 2-D (replica, shard) pod state reshards too: saved at
+    (2, 4), restored at (2, 2) and (1, 1)-degenerate layouts."""
+    path, st = saved
+    # replica count is part of the stacked shape [R, L, D]; only the
+    # shard axis reshapes freely
+    for r, s in ((2, 2), (2, 1)):
+        tmpl = abstract_like(init_spmd_state(grid_mesh(replica=r, shard=s),
+                                             4, 64))
+        _, out = load_sharded(path, tmpl)
+        np.testing.assert_array_equal(np.asarray(out.w), np.asarray(st.w))
+        assert out.w.sharding == tmpl.w.sharding
+
+
 def test_corrupt_system_sidecar(mesh, saved, tmp_path):
     path, _ = saved
     import os

@@ -64,6 +64,26 @@ HOST_CAST_DIRS = (
 _HOST_CAST_RE = re.compile(r"\.astype\(\s*(np|numpy|ml_dtypes)\.")
 
 
+#: sharded-layout hot paths where a FULL-MATRIX device→host gather
+#: (``jax.device_get`` / ``multihost_utils.process_allgather``) undoes
+#: the entire point of feature/row sharding (ISSUE 13): the weight
+#: matrix lives distributed precisely so no single buffer ever holds
+#: it — one stray gather reintroduces the HBM/host-RAM cliff the
+#: sharded layout removed AND serializes every shard through one copy.
+#: Ship per-shard chunks instead (sharded_model.shard_chunks), or read
+#: back only reduced/replicated results (scores, top-k candidates).
+#: The rare legitimate full readback (a replicated mix total, a debug
+#: dump) opts out per line with a ``# full-gather-ok`` pragma stating
+#: why.
+FULL_GATHER_DIRS = (
+    "jubatus_tpu/parallel/",
+    "jubatus_tpu/models/",
+)
+
+_FULL_GATHER_RE = re.compile(
+    r"\bjax\s*\.\s*device_get\(|\bdevice_get\(|\bprocess_allgather\(")
+
+
 #: serving hot-path directories where a per-datum ``converter.convert()``
 #: call INSIDE a loop/comprehension is the featurization cliff the batch
 #: pipeline exists to remove (ISSUE 5: ~29x between per-datum convert and
@@ -165,6 +185,8 @@ def check_file(path: str) -> List[str]:
         d in posix for d in BROAD_EXCEPT_DIRS)
     host_cast = path.endswith(".py") and any(
         d in posix for d in HOST_CAST_DIRS)
+    full_gather = path.endswith(".py") and any(
+        d in posix for d in FULL_GATHER_DIRS)
     span_timed = path.endswith(".py") and _is_span_timed(posix)
     for i, line in enumerate(text.splitlines(), 1):
         if "\t" in line and not allow_tabs:
@@ -183,6 +205,15 @@ def check_file(path: str) -> List[str]:
                 "ship/reduce path with a jnp dtype instead; append "
                 "'# host-cast-ok — <why>' where a host cast is genuinely "
                 "required)")
+        if full_gather and "# full-gather-ok" not in line and \
+                _FULL_GATHER_RE.search(line):
+            problems.append(
+                f"{path}:{i}: full-matrix device_get/allgather in a "
+                "sharded-layout hot path (materializing a sharded leaf "
+                "reintroduces the memory cliff the layout removed — ship "
+                "per-shard chunks via sharded_model.shard_chunks or read "
+                "back reduced results only; append '# full-gather-ok — "
+                "<why>' where a full readback is genuinely required)")
         if hot_time and "time.time()" in line and "# wall-clock" not in line:
             problems.append(
                 f"{path}:{i}: raw time.time() in a hot-path module (use "
