@@ -221,7 +221,7 @@ def run(transport: str = "python", workload: str = "numeric",
         conf: dict = CONF, measure: float = MEASURE_SECONDS,
         tag: str = "", microbatch: int = 0, native_ingest: bool = True,
         forensics: bool = True, model_health=None,
-        profile_hz=None, seed=None) -> dict:
+        profile_hz=None, events_enabled=None, seed=None) -> dict:
     from jubatus_tpu.server import EngineServer
     from jubatus_tpu.server.args import ServerArgs
 
@@ -253,6 +253,13 @@ def run(transport: str = "python", workload: str = "numeric",
     # the profiling-overhead A/B (0 = sampler thread fully off)
     if profile_hz is not None:
         health_args["profile_hz"] = float(profile_hz)
+    # events_enabled (ISSUE 14): None keeps the stock server (journal at
+    # its default depth + incident triggers armed); False strips the
+    # event plane entirely (capacity 0 = emit() no-ops, auto-capture
+    # off) — the honest "off" side of the event-plane overhead A/B
+    if events_enabled is False:
+        health_args["event_capacity"] = 0
+        health_args["incident_window"] = 0.0
     try:
         srv = EngineServer(
             "classifier", conf,
@@ -521,6 +528,57 @@ def run_observability_overhead(transport: str = "python",
     if p99_on and p99_off:
         out["e2e_observability_overhead_p99_ratio"] = round(
             p99_on / p99_off, 4)
+    return out
+
+
+def run_event_plane_overhead(transport: str = "python",
+                             measure: float = TEXT_MEASURE_SECONDS
+                             ) -> dict:
+    """ISSUE 14 satellite: the event plane ships with its serving cost
+    measured. The plane is OFF the request hot path by design (events
+    fire on state transitions, not per request), so the A/B — journal
+    at default depth + incident triggers armed vs capacity 0 + triggers
+    off — measures the residual hook cost under the same classify
+    workload and <2% p50 budget as the other observability planes.
+    A per-emit microbench (``e2e_event_emit_us``) pins the cost one
+    transition pays when it DOES fire."""
+    out: dict = {}
+    sides = {}
+    for tag, enabled in (("events_on", None), ("events_off", False)):
+        try:
+            r = run(transport, workload="classify", measure=measure,
+                    tag=tag, events_enabled=enabled)
+        except Exception as e:  # noqa: BLE001 — partial results beat none
+            out[f"e2e_{tag}_error"] = repr(e)[:200]
+            continue
+        out.update(r)
+        sides[tag] = r
+    p50_on = sides.get("events_on", {}).get(
+        "e2e_rpc_classify_p50_ms_events_on")
+    p50_off = sides.get("events_off", {}).get(
+        "e2e_rpc_classify_p50_ms_events_off")
+    if p50_on and p50_off:
+        ratio = p50_on / p50_off
+        out["e2e_event_plane_overhead_p50_ratio"] = round(ratio, 4)
+        out["e2e_event_plane_overhead_ok"] = bool(ratio <= 1.02)
+    mean_on = sides.get("events_on", {}).get(
+        "e2e_rpc_classify_mean_ms_events_on")
+    mean_off = sides.get("events_off", {}).get(
+        "e2e_rpc_classify_mean_ms_events_off")
+    if mean_on and mean_off:
+        out["e2e_event_plane_overhead_mean_ratio"] = round(
+            mean_on / mean_off, 4)
+    # per-emit cost: what one state transition pays to land on the
+    # timeline (journal append + HLC tick + trace-context probe)
+    from jubatus_tpu.utils.events import EventJournal
+
+    j = EventJournal(capacity=2048)
+    n = 20000
+    t0 = time.perf_counter()
+    for i in range(n):
+        j.emit("bench", "tick", seq=i)
+    out["e2e_event_emit_us"] = round(
+        (time.perf_counter() - t0) / n * 1e6, 3)
     return out
 
 
@@ -1867,6 +1925,12 @@ def collect(trials: int = 2) -> dict:
         out.update(run_profiling_overhead(text_tr))
     except Exception as e:  # noqa: BLE001
         out["e2e_profiling_overhead_error"] = repr(e)[:200]
+    # event-plane overhead A/B (ISSUE 14): journal + incident triggers
+    # on vs stripped, same <2% p50 budget + the per-emit microbench
+    try:
+        out.update(run_event_plane_overhead(text_tr))
+    except Exception as e:  # noqa: BLE001
+        out["e2e_event_plane_overhead_error"] = repr(e)[:200]
     # proxy tier: same numeric workload through the proxy hop. The
     # REPORTED keys stay best-of, but the ratio uses median-vs-median
     # over ADJACENT alternating (proxy, direct) pairs: the direct side
@@ -1953,6 +2017,12 @@ if __name__ == "__main__":
         scales = tuple(sys.argv[3].split(",")) if len(sys.argv) > 3 \
             else ("1e6", "1e8")
         print(json.dumps(run_sharded_knn((1, shards), scales), indent=1))
+    elif len(sys.argv) > 1 and sys.argv[1] == "events":
+        # the event-plane slice on its own (overhead A/B + per-emit
+        # microbench), for ISSUE 14 iteration without the full bench
+        print(json.dumps(run_event_plane_overhead(
+            measure=float(sys.argv[2]) if len(sys.argv) > 2
+            else TEXT_MEASURE_SECONDS), indent=1))
     elif len(sys.argv) > 1 and sys.argv[1] == "asyncmix":
         # the async-mix slice on its own (drift parity + cadence/stall
         # storm), for ISSUE 11 iteration without the full bench

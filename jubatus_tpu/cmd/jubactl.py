@@ -59,7 +59,7 @@ def _parser() -> argparse.ArgumentParser:
                    choices=["start", "stop", "save", "load", "status",
                             "metrics", "breakers", "trace", "alerts",
                             "watch", "profile", "drain", "rebalance",
-                            "autoscale"])
+                            "autoscale", "timeline", "incident"])
     p.add_argument("trace_id", nargs="?", default="",
                    help="[trace] trace id to assemble (from a slow-log "
                         "record, a /metrics exemplar, or "
@@ -89,6 +89,23 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--device-seconds", type=float, default=0.0,
                    help="[profile --device] capture duration in seconds "
                         "(0 = just list existing artifacts)")
+    # cluster event timeline + incident bundles (ISSUE 14)
+    p.add_argument("--since", type=float, default=0.0,
+                   help="[timeline] only events from the last this many "
+                        "seconds (0 = every retained event)")
+    p.add_argument("--grep", default="",
+                   help="[timeline] substring filter (subsystem, type, "
+                        "node, field values; applied server-side)")
+    p.add_argument("--follow", action="store_true",
+                   help="[timeline] keep polling with per-node HLC "
+                        "cursors and stream new events as they happen "
+                        "(--interval controls the poll period)")
+    p.add_argument("--list", action="store_true",
+                   help="[incident] list captured bundles across the "
+                        "cluster (the default)")
+    p.add_argument("--pull", default="", metavar="ID",
+                   help="[incident] fetch one bundle by id (from "
+                        "--list) and print its full forensic JSON")
     p.add_argument("--target", default="",
                    help="[drain] the member to drain, as IP_PORT (a node "
                         "name from -c status)")
@@ -446,6 +463,11 @@ def collect_watch(coord: Coordinator, engine: str, name: str,
                             "draining": {n.name for n in
                                          membership.get_draining(
                                              coord, engine, name)}}
+    import time as _time
+
+    from jubatus_tpu.utils import events as ev
+
+    ev_since = ev.wall_to_hlc(_time.time() - max(window_s * 5, 600.0))
     for node in nodes:
         entry: Dict[str, Any] = {"error": ""}
         try:
@@ -453,6 +475,12 @@ def collect_watch(coord: Coordinator, engine: str, name: str,
                 status = c.call("get_status", name)
                 ts = c.call("get_timeseries", name)
                 alerts = c.call("get_alerts", name)
+                # event plane (ISSUE 14): recent events feed the
+                # last_event column and the inline firing-SLO lines
+                try:
+                    evs = c.call("get_events", name, ev_since, "")
+                except Exception:  # noqa: BLE001 — pre-event-plane node
+                    evs = {}
         except Exception as e:  # noqa: BLE001 — render the sick node
             entry["error"] = str(e)
             data["nodes"][node.name] = entry
@@ -464,6 +492,8 @@ def collect_watch(coord: Coordinator, engine: str, name: str,
         entry["window"] = window_from_points(points, window_s)
         doc = (alerts or {}).get(node.name) or {}
         entry["alerts"] = [a.get("name") for a in doc.get("alerts") or []]
+        entry["events"] = ((evs or {}).get(node.name) or {}).get(
+            "events") or []
         data["nodes"][node.name] = entry
     for pxy in _proxies(coord):
         try:
@@ -536,8 +566,20 @@ def _watch_node_row(node_name: str, entry: Dict[str, Any],
                 f"{nbytes / max(int(shards), 1) / 2 ** 20:.0f}MB")
     alerts = ",".join(entry.get("alerts") or []) or "-"
     p99_cell = f"{p99:.1f} {p99_span[4:]}" if p99 is not None else "-"
+    # event plane (ISSUE 14): the node's newest event + its age — one
+    # glance says whether something just transitioned here
+    evs = entry.get("events") or []
+    if evs:
+        import time as _time
+
+        last = evs[-1]
+        age = max(0.0, _time.time() - float(last.get("ts", 0.0)))
+        last_evt = f"{last.get('subsystem')}.{last.get('type')} {age:.0f}s"
+    else:
+        last_evt = "-"
     return (f"  {node_name:<22} {state:<9} {req_s:>8.1f} {err_s:>7.2f}  "
-            f"{p99_cell:<22} {' '.join(mix_bits) or '-':<28} {alerts}")
+            f"{p99_cell:<22} {' '.join(mix_bits) or '-':<28} "
+            f"{last_evt:<26} {alerts}")
 
 
 def render_watch_frame(data: Dict[str, Any], ts: str = "") -> str:
@@ -550,15 +592,32 @@ def render_watch_frame(data: Dict[str, Any], ts: str = "") -> str:
     proxies = data.get("proxies") or {}
     actives = data.get("actives") or set()
     draining = data.get("draining") or set()
+    # event plane (ISSUE 14): the header shows not just WHICH epoch the
+    # cluster is on but how long ago membership last CHANGED — the age
+    # of the newest membership event across every node's journal
+    import time as _time
+
+    all_events = [e for entry in nodes.values()
+                  for e in (entry.get("events") or [])]
+    member_evts = [e for e in all_events
+                   if e.get("subsystem") == "membership"]
+    if member_evts:
+        newest = max(member_evts, key=lambda e: e.get("hlc", 0))
+        age = max(0.0, _time.time() - float(newest.get("ts", 0.0)))
+        epoch_bit = (f"epoch {data.get('epoch', 0)} "
+                     f"(last event {age:.0f}s ago)")
+    else:
+        epoch_bit = f"epoch {data.get('epoch', 0)}"
     lines.append(f"{data.get('engine')}/{data.get('name')}"
                  f"{'  ' + ts if ts else ''}  "
                  f"window {data.get('window_s', 0):g}s  "
-                 f"epoch {data.get('epoch', 0)}  "
+                 f"{epoch_bit}  "
                  f"({len(nodes)} server(s), {len(proxies)} proxy(ies)"
                  + (f", {len(draining)} draining" if draining else "")
                  + ")")
     lines.append(f"  {'node':<22} {'state':<9} {'req/s':>8} {'err/s':>7}  "
-                 f"{'p99 ms (span)':<22} {'mix health':<28} alerts")
+                 f"{'p99 ms (span)':<22} {'mix health':<28} "
+                 f"{'last_event':<26} alerts")
     for node_name in sorted(nodes):
         lines.append(_watch_node_row(node_name, nodes[node_name],
                                      node_name in actives,
@@ -577,6 +636,16 @@ def render_watch_frame(data: Dict[str, Any], ts: str = "") -> str:
     firing = sorted({a for e in nodes.values()
                      for a in (e.get("alerts") or [])})
     lines.append("  alerts firing: " + (", ".join(firing) or "none"))
+    # firing-SLO events inline (ISSUE 14): the fire/clear EDGES of the
+    # recent window, so a cleared-but-recent page is still visible
+    slo_edges = sorted((e for e in all_events
+                        if e.get("subsystem") == "slo"),
+                       key=lambda e: e.get("hlc", 0))
+    for e in slo_edges[-4:]:
+        age = max(0.0, _time.time() - float(e.get("ts", 0.0)))
+        lines.append(f"  ! {age:>4.0f}s ago  {e.get('node', '?'):<22} "
+                     f"slo {e.get('type')} {e.get('name', '?')} "
+                     f"burn_fast={e.get('burn_fast', 0)}")
     return "\n".join(lines)
 
 
@@ -894,6 +963,174 @@ def show_trace(coord: Coordinator, engine: str, name: str,
     return 0
 
 
+def collect_events(coord: Coordinator, engine: str, name: str,
+                   cursors: Optional[Dict[str, int]] = None,
+                   since: int = 0, grep: str = ""
+                   ) -> List[Dict[str, Any]]:
+    """Scrape every member's event journal (``get_events``) and every
+    registered proxy's own (``get_proxy_events``), each with its own
+    HLC cursor (clocks differ per node — one shared cursor would skip
+    or duplicate), and fold into one causally ordered timeline. Updates
+    ``cursors`` in place (the ``--follow`` loop's state)."""
+    from jubatus_tpu.utils import events as ev
+
+    cursors = cursors if cursors is not None else {}
+    lists: List[List[Dict[str, Any]]] = []
+    for node, method in (
+            [(n, "get_events")
+             for n in membership.get_all_nodes(coord, engine, name)]
+            + [(pxy, "get_proxy_events") for pxy in _proxies(coord)]):
+        cur = cursors.get(node.name, since)
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                per_node = c.call(method, name, int(cur), grep)
+        except Exception as e:  # noqa: BLE001 — partial timeline beats none
+            print(f"  <{node.name}: {method} failed: {e}>", file=sys.stderr)
+            continue
+        for node_name, doc in (per_node or {}).items():
+            recs = (doc or {}).get("events") or []
+            for rec in recs:
+                rec.setdefault("node", node_name)
+            lists.append(recs)
+            if recs:
+                cursors[node.name] = max(
+                    cursors.get(node.name, since),
+                    max(int(r.get("hlc", 0)) for r in recs))
+    return ev.merge_events(lists)
+
+
+_SEV_MARK = {"debug": " ", "info": " ", "warning": "!", "error": "E"}
+
+#: event-record keys that are rendered structurally, not as k=v fields
+_EVENT_META = ("hlc", "ts", "node", "subsystem", "type", "severity",
+               "trace_id")
+
+
+def render_event_line(rec: Dict[str, Any]) -> str:
+    """One timeline row: wall time, severity mark, node, subsystem.type,
+    the remaining fields as k=v, and the trace id when one was active."""
+    import time as _time
+
+    ts = float(rec.get("ts", 0.0))
+    clock = _time.strftime("%H:%M:%S", _time.localtime(ts)) + \
+        f".{int(ts * 1000) % 1000:03d}"
+    sev = str(rec.get("severity", "info"))
+    fields = " ".join(f"{k}={rec[k]}" for k in rec
+                      if k not in _EVENT_META)
+    tid = rec.get("trace_id", "")
+    return (f"{clock} {_SEV_MARK.get(sev, ' ')} "
+            f"{rec.get('node', '?'):<22} "
+            f"{rec.get('subsystem', '?')}.{rec.get('type', '?'):<20} "
+            f"{fields}"
+            + (f"  trace={tid}" if tid else ""))
+
+
+def show_timeline(coord: Coordinator, engine: str, name: str, *,
+                  since_s: float = 0.0, grep: str = "",
+                  follow: bool = False, interval: float = 2.0) -> int:
+    """ISSUE 14 acceptance: ONE interleaved cluster narrative — every
+    node's state-transition events merged in causal (HLC) order.
+    ``--follow`` streams: per-node cursors advance to the max HLC seen,
+    so each poll prints exactly the events emitted since."""
+    import time as _time
+
+    from jubatus_tpu.utils import events as ev
+
+    since = ev.wall_to_hlc(_time.time() - since_s) if since_s > 0 else 0
+    cursors: Dict[str, int] = {}
+    first = True
+    while True:
+        recs = collect_events(coord, engine, name, cursors=cursors,
+                              since=since, grep=grep)
+        if first and not recs and not follow:
+            print(f"no events retained for {engine}/{name}"
+                  + (f" matching {grep!r}" if grep else ""),
+                  file=sys.stderr)
+            return -1
+        if first:
+            nodes = {r.get("node", "?") for r in recs}
+            print(f"{engine}/{name}: {len(recs)} event(s) across "
+                  f"{len(nodes)} node(s)"
+                  + (f", since {since_s:g}s" if since_s else "")
+                  + (f", grep {grep!r}" if grep else "")
+                  + ("  [following]" if follow else ""), file=sys.stderr)
+        for rec in recs:
+            print(render_event_line(rec))
+        if not follow:
+            return 0
+        first = False
+        sys.stdout.flush()
+        try:
+            _time.sleep(max(interval, 0.2))
+        except KeyboardInterrupt:
+            return 0
+
+
+def show_incidents(coord: Coordinator, engine: str, name: str, *,
+                   pull: str = "") -> int:
+    """ISSUE 14: the incident-bundle surface. Default lists every
+    node's captured bundles (id, reason, age, size, correlated trace
+    count); ``--pull ID`` prints one bundle's full forensic JSON on
+    stdout (header on stderr — pipe it to jq/a file)."""
+    import json as _json
+    import time as _time
+
+    targets = ([(n, "get_incidents")
+                for n in membership.get_all_nodes(coord, engine, name)]
+               + [(pxy, "get_proxy_incidents")
+                  for pxy in _proxies(coord)])
+    if pull:
+        for node, method in targets:
+            try:
+                with RpcClient(node.host, node.port, timeout=10.0) as c:
+                    per_node = c.call(method, name, pull)
+            except Exception as e:  # noqa: BLE001 — try the next node
+                print(f"  <{node.name}: {method} failed: {e}>",
+                      file=sys.stderr)
+                continue
+            for node_name, doc in (per_node or {}).items():
+                if isinstance(doc, dict) and "error" not in doc:
+                    print(f"incident {pull} from {node_name}",
+                          file=sys.stderr)
+                    print(_json.dumps(doc, indent=2, default=str))
+                    return 0
+        print(f"incident {pull!r} not found on any node", file=sys.stderr)
+        return -1
+    rows = []
+    scraped = 0
+    for node, method in targets:
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                per_node = c.call(method, name, "")
+        except Exception as e:  # noqa: BLE001 — partial list beats none
+            print(f"  <{node.name}: {method} failed: {e}>", file=sys.stderr)
+            continue
+        scraped += 1
+        for node_name, doc in sorted((per_node or {}).items()):
+            for meta in (doc or {}).get("incidents") or []:
+                meta = dict(meta)
+                meta["node"] = node_name
+                rows.append(meta)
+    if not scraped:
+        print(f"no member of {engine}/{name} answered get_incidents",
+              file=sys.stderr)
+        return -1
+    rows.sort(key=lambda m: m.get("hlc", 0))
+    print(f"{engine}/{name}: {len(rows)} incident bundle(s) across "
+          f"{scraped} node(s)")
+    if rows:
+        print(f"  {'id':<24} {'node':<22} {'age':>8} {'bytes':>9} "
+              f"{'traces':>6}  reason")
+        now = _time.time()
+        for m in rows:
+            age = now - float(m.get("ts", now))
+            print(f"  {m.get('id', '?'):<24} {m.get('node', '?'):<22} "
+                  f"{age:>7.0f}s {m.get('bytes', 0):>9} "
+                  f"{len(m.get('trace_ids') or []):>6}  "
+                  f"{m.get('reason', '')}")
+    return 0
+
+
 def render_autoscale_frame(doc: Dict[str, Any], ts: str = "",
                            journal_rows: int = 8) -> str:
     """One autoscaler status frame as text (pure; asserted by tests,
@@ -1066,6 +1303,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         if ns.cmd == "watch":
             return show_watch(coord, ns.type, ns.name, once=ns.once,
                               interval=ns.interval, window_s=ns.window)
+        if ns.cmd == "timeline":
+            return show_timeline(coord, ns.type, ns.name,
+                                 since_s=ns.since, grep=ns.grep,
+                                 follow=ns.follow, interval=ns.interval)
+        if ns.cmd == "incident":
+            return show_incidents(coord, ns.type, ns.name, pull=ns.pull)
         if ns.cmd == "drain":
             return drain_member(coord, ns.type, ns.name, ns.target,
                                 stop_after=ns.stop,

@@ -52,7 +52,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from jubatus_tpu.coord import membership
 from jubatus_tpu.coord.base import Coordinator, NodeInfo
-from jubatus_tpu.utils import faults
+from jubatus_tpu.utils import events, faults
 from jubatus_tpu.utils.timeseries import window_from_points
 from jubatus_tpu.utils.tracing import Registry
 
@@ -435,9 +435,24 @@ class Autoscaler:
     # -- journal -------------------------------------------------------------
     def _record(self, action: str, reason: str, snap: FleetSnapshot,
                 now: float, **extra: Any) -> Dict[str, Any]:
-        rec = {"ts": round(now, 3), "action": action, "reason": reason,
-               "signals": snap.signals()}
+        # ISSUE 14 satellite: journal entries ride the event plane's HLC
+        # helper (ordering agrees with `jubactl -c timeline`), and every
+        # decision of consequence emits a timeline event whose id the
+        # journal entry cross-links (event_hlc)
+        h = events.hlc_now()
+        rec = {"ts": round(now, 3), "hlc": h, "action": action,
+               "reason": reason, "signals": snap.signals()}
         rec.update(extra)
+        if action != "hold":
+            evt = self.registry.events.emit(
+                "autoscale", action,
+                severity="warning" if action == "blocked" else "info",
+                reason=reason, target=extra.get("target") or None,
+                count=extra.get("count") or None,
+                dry_run=extra.get("dry_run") or None,
+                replicas=snap.size)
+            if evt is not None:
+                rec["event_hlc"] = evt["hlc"]
         with self._jlock:
             self.journal.append(rec)
         self.registry.count("autoscale.decisions")

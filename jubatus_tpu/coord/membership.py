@@ -109,6 +109,13 @@ def bump_epoch(coord: Coordinator, engine: str, name: str) -> int:
         coord.set(f"{path}_value", str(epoch).encode())
     except Exception:  # broad-ok — mirror is best-effort
         log.debug("epoch mirror write failed", exc_info=True)
+    # event plane (ISSUE 14): the ring version changing is the root of
+    # most reshard/re-route cascades — first line of any timeline.
+    # Default journal: this module has no registry; get_events merges it.
+    from jubatus_tpu.utils import events
+
+    events.emit("membership", "epoch_bump", epoch=epoch,
+                cluster=f"{engine}/{name}")
     return epoch
 
 

@@ -507,6 +507,15 @@ class AsyncLinearMixer(RpcLinearMixer):
             return None  # submit-only tick; someone else folds
         try:
             self._publish_master_hint()
+            if self.self_node is not None and \
+                    self.async_master != self.self_node.name:
+                # event plane (ISSUE 14): a new fold-lock winner is an
+                # async-mix master election — emitted only on CHANGE
+                # (the same master re-winning every tick is not news)
+                self.trace.events.emit(
+                    "mix", "async_master_elected",
+                    master=self.self_node.name,
+                    previous=self.async_master or None)
             if self.self_node is not None:
                 self.async_master = self.self_node.name
             return self._fold_round(members)

@@ -414,6 +414,7 @@ class CollectiveMixer(RpcLinearMixer):
 
     def _kill_world(self) -> None:
         self.collective_dead = True
+        self.trace.events.emit("mix", "collective_dead", severity="error")
         try:
             import jax
 
@@ -542,6 +543,15 @@ class CollectiveMixer(RpcLinearMixer):
             self.trace.gauge("mix.ef_residual_drift_rate",
                              round(norms["contrib_residual_norm"] - prev, 9))
 
+    def _note_fallback(self, reason: str) -> None:
+        """One collective→RPC demotion: counter + timeline event
+        (ISSUE 14) — the fallback cascade is exactly what an incident
+        timeline must interleave with breaker/membership events."""
+        self.fallback_rounds += 1
+        self._count("mix.fallback_rounds")
+        self.trace.events.emit("mix", "fallback", severity="warning",
+                               reason=reason)
+
     # -- master round --------------------------------------------------------
     def _run_as_master(self, members: Sequence[NodeInfo]) -> Optional[Dict[str, Any]]:
         import jax
@@ -550,8 +560,8 @@ class CollectiveMixer(RpcLinearMixer):
             # world torn down by a bounded-entry timeout, or replicas are
             # not one jax world (not all joined yet): the collective
             # cannot span them — mix over RPC
-            self.fallback_rounds += 1
-            self._count("mix.fallback_rounds")
+            self._note_fallback("collective_dead" if self.collective_dead
+                                else "world_mismatch")
             self.flight.record(
                 "collective", ok=False,
                 reason=("collective_dead" if self.collective_dead
@@ -564,8 +574,7 @@ class CollectiveMixer(RpcLinearMixer):
             # a member with an OPEN breaker cannot be counted on to enter
             # the psum — the collective is all-or-wedge, so route the
             # round to the RPC mix, whose fan-out skips/degrades per host
-            self.fallback_rounds += 1
-            self._count("mix.fallback_rounds")
+            self._note_fallback("breaker_open_member")
             self.flight.record("collective", ok=False,
                                reason="breaker_open_member",
                                members=len(members))
@@ -597,8 +606,7 @@ class CollectiveMixer(RpcLinearMixer):
         if errors or len(results) != len(members) or len(sigs) != 1 \
                 or "unsupported" in sigs:
             self.comm.collect("mix_abort", rid)
-            self.fallback_rounds += 1
-            self._count("mix.fallback_rounds")
+            self._note_fallback("prepare_not_viable")
             log.info("collective round %s not viable (%d errors, sigs %s); "
                      "falling back to rpc mix", rid, len(errors), len(sigs))
             self.flight.record(
@@ -619,8 +627,7 @@ class CollectiveMixer(RpcLinearMixer):
                 raise RuntimeError("coordinator refused the GO write")
         except Exception:  # broad-ok
             self.comm.collect("mix_abort", rid)
-            self.fallback_rounds += 1
-            self._count("mix.fallback_rounds")
+            self._note_fallback("go_write_failed")
             log.warning("collective round %s: GO write failed; falling "
                         "back to rpc mix", rid, exc_info=True)
             self.flight.record("collective", ok=False, round_id=rid,

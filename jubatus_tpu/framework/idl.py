@@ -229,6 +229,11 @@ IDEMPOTENT_BUILTINS: FrozenSet[str] = frozenset({
     "mix_async_status",
     # autoscaling control plane (ISSUE 12): journal/status read is pure
     "get_autoscale_status",
+    # event plane + incident bundles (ISSUE 14): journal/bundle reads
+    # are pure (get_events is cursor-driven; a replayed read re-serves
+    # the same events)
+    "get_events", "get_incidents", "get_proxy_events",
+    "get_proxy_incidents",
 })
 
 #: effectful built-ins, listed for the docs' idempotency matrix (anything

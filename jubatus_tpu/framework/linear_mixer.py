@@ -42,7 +42,7 @@ from jubatus_tpu.framework.mixer import IntervalMixer, MixFlightRecorder
 from jubatus_tpu.parallel.mix import tree_sum
 from jubatus_tpu.rpc.breaker import BreakerBoard
 from jubatus_tpu.rpc.client import RpcClient, RpcMClient
-from jubatus_tpu.utils import faults
+from jubatus_tpu.utils import events, faults
 from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
 
 log = logging.getLogger(__name__)
@@ -580,6 +580,11 @@ class RpcLinearMixer:
         norm = {k.decode() if isinstance(k, bytes) else str(k): v
                 for k, v in health.items()}
         self.last_health = norm
+        # HLC causality (ISSUE 14): adopting a round's health payload is
+        # receiving a message from the master — merge its clock so this
+        # member's subsequent events sort after the fold that drove them
+        if norm.get("hlc"):
+            events.observe(norm["hlc"])
         for key in ("premix_divergence_mean", "premix_divergence_max",
                     "premix_divergence", "update_norm", "staleness_max",
                     "contributors"):
@@ -700,6 +705,9 @@ class RpcLinearMixer:
                           "%.0f%%)", len(payloads), len(members),
                           self.quorum_fraction * 100)
                 self._count("mix.quorum_aborted")
+                self.trace.events.emit(
+                    "mix", "quorum_abort", severity="error",
+                    contributors=len(payloads), members=len(members))
                 self.flight.record(
                     "rpc", ok=False,
                     reason=f"quorum_not_met: {len(payloads)}/{len(members)}",
@@ -708,6 +716,9 @@ class RpcLinearMixer:
             degraded = len(payloads) < len(members)
             if degraded:
                 self._count("mix.quorum_degraded")
+                self.trace.events.emit(
+                    "mix", "quorum_degraded", severity="warning",
+                    contributors=len(payloads), members=len(members))
         phases["get_diff_ms"] = round(sp.seconds * 1e3, 2)
         # phase 3: pairwise fold per mixable (linear_mixer.cpp:481-499)
         with self.trace.span("mix.phase.fold") as sp:
@@ -738,6 +749,11 @@ class RpcLinearMixer:
                                 _sum_names(mixables))
             health.update(self._staleness_update(
                 members, {node.name for node, _ in entries}))
+            # event plane (ISSUE 14): the master's HLC rides the
+            # broadcast; receivers observe() it in _note_health, so a
+            # member's post-apply events sort after the round that
+            # caused them even under skewed wall clocks
+            health["hlc"] = events.hlc_now()
             packed = pack_mix(
                 {"protocol": PROTOCOL_VERSION, "schema": schema_union,
                  "base_version": base_version, "diffs": totals,

@@ -306,7 +306,7 @@ class DrainController:
         self.server = server
         self.grace_sec = float(grace_sec)
         self.chunk_bytes = int(chunk_bytes)
-        self.state = "active"
+        self.state = "active"  # no-event — initial state, not a transition
         self.rows_handed_off = 0
         self.bytes_handed_off = 0
         self.error = ""
@@ -336,6 +336,9 @@ class DrainController:
             self.state = state
         trace = self.server.rpc.trace
         trace.gauge("drain.state", float(self.STATES.index(state)))
+        # event plane (ISSUE 14): every drain phase edge on the timeline
+        trace.events.emit("drain", state,
+                          rows_handed_off=self.rows_handed_off or None)
         log.info("drain: %s", state)
 
     def _wait_inflight(self) -> None:

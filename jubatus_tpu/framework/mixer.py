@@ -15,7 +15,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-from jubatus_tpu.utils import tracing
+from jubatus_tpu.utils import events, tracing
 from jubatus_tpu.utils.tracing import Registry, default_registry
 
 
@@ -40,9 +40,15 @@ class MixFlightRecorder:
                reason: str = "", duration_ms: Optional[float] = None,
                phases: Optional[Dict[str, Any]] = None,
                **fields: Any) -> Dict[str, Any]:
+        # ISSUE 14 satellite: flight records ride the event plane's HLC
+        # helper instead of an ad-hoc wall-clock stamp, so `jubactl -c
+        # timeline` and the mix history agree on ordering; ``ts`` stays
+        # the human-readable wall seconds derived from the same tick
+        h = events.hlc_now()
         rec: Dict[str, Any] = {
             "mode": mode, "ok": bool(ok),
-            "ts": round(time.time(), 3),  # wall-clock
+            "hlc": h,
+            "ts": round(events.hlc_wall_s(h), 3),
             "node": self.node,
         }
         if round_id:
@@ -139,16 +145,24 @@ class IntervalMixer:
         with self._mix_serialize, tracing.use_trace(ctx):
             with self._cond:
                 self._counter = 0
+            # event plane (ISSUE 14): round start/end bracket the
+            # timeline; the end event's hlc cross-links into the flight
+            # record (event_hlc) so -c timeline and --mix-history agree
+            self.trace.events.emit("mix", "round_start", severity="debug")
             try:
                 with self.trace.span("mix.round") as sp:
                     result = self._mix_fn()
             except Exception as e:  # broad-ok — mix_fn is arbitrary
                 self.trace.count("mix.round.errors")
+                evt = self.trace.events.emit(
+                    "mix", "round_error", severity="error",
+                    reason=f"{type(e).__name__}: {e}")
                 self.flight.record(
                     "error", ok=False,
                     reason=f"{type(e).__name__}: {e}",
                     duration_ms=sp.seconds * 1e3,
-                    trace_id=ctx.trace_id)
+                    trace_id=ctx.trace_id,
+                    event_hlc=evt["hlc"] if evt else None)
                 raise
             with self._cond:
                 self.last_mix_duration = sp.seconds
@@ -162,12 +176,18 @@ class IntervalMixer:
                 phases = extra.pop("phases", None)
                 rid = extra.pop("round_id", "")
                 for k in ("ok", "reason", "duration_ms", "ts", "node",
-                          "seq", "trace_id"):
+                          "seq", "trace_id", "hlc", "event_hlc"):
                     extra.pop(k, None)  # reserved record fields
+                evt = self.trace.events.emit(
+                    "mix", "round", mode=mode, round_id=rid or None,
+                    duration_ms=round(self.last_mix_duration * 1e3, 1),
+                    degraded=extra.get("degraded"),
+                    contributors=extra.get("contributors"))
                 self.flight.record(
                     mode, ok=True, round_id=rid, phases=phases,
                     duration_ms=self.last_mix_duration * 1e3,
-                    trace_id=ctx.trace_id, **extra)
+                    trace_id=ctx.trace_id,
+                    event_hlc=evt["hlc"] if evt else None, **extra)
             return result
 
     # -- background loop ------------------------------------------------------

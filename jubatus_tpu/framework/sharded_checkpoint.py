@@ -184,6 +184,12 @@ def save_sharded(
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("jubatus_tpu:sharded_save")
+    # event plane (ISSUE 14): checkpoint saves land on the timeline
+    # (default journal — this plane has no registry in reach)
+    from jubatus_tpu.utils import events
+
+    events.emit("checkpoint", "save", dir=dir_path, model_id=model_id,
+                shard_layout=shard_layout(state) or None)
 
 
 def load_sharded(
@@ -234,6 +240,19 @@ def load_sharded(
                 "(interrupted overwrite?) — the sidecar describes a "
                 "different checkpoint than the state directory holds")
     state = ckptr.restore(state_path, abstract)
+    # event plane (ISSUE 14): restores — and RESHAPES (the template's
+    # layout differing from the one that wrote the checkpoint, i.e.
+    # reshard-on-restore actually engaging) — land on the timeline
+    from jubatus_tpu.utils import events
+
+    saved_layout = system.get("shard_layout") or {}
+    restored_layout = shard_layout(state) or {}
+    resharded = bool(saved_layout) != bool(restored_layout) or \
+        saved_layout != restored_layout
+    events.emit("checkpoint", "reshard" if resharded else "restore",
+                dir=dir_path,
+                saved_layout=saved_layout or None,
+                restored_layout=restored_layout or None)
     return system, state
 
 

@@ -40,7 +40,8 @@ class CircuitBreaker:
 
     __slots__ = ("window_sec", "failure_threshold", "cooldown_sec",
                  "_lock", "_failures", "_state", "_opened_at",
-                 "_probe_in_flight", "opened_total", "name")
+                 "_probe_in_flight", "opened_total", "name",
+                 "_half_open_evt")
 
     def __init__(self, *, window_sec: float = 30.0,
                  failure_threshold: int = 5,
@@ -51,10 +52,13 @@ class CircuitBreaker:
         self.name = name
         self._lock = threading.Lock()
         self._failures: deque = deque()  # monotonic timestamps
-        self._state = CLOSED
+        self._state = CLOSED  # no-event — initial state, not a transition
         self._opened_at = 0.0
         self._probe_in_flight = False
         self.opened_total = 0
+        #: set on the open→half_open transition inside allow(); the
+        #: owning BreakerBoard consumes it to emit the half_open event
+        self._half_open_evt = False
 
     def _prune(self, now: float) -> None:
         horizon = now - self.window_sec
@@ -72,8 +76,9 @@ class CircuitBreaker:
             if self._state == OPEN:
                 if now - self._opened_at < self.cooldown_sec:
                     return False
-                self._state = HALF_OPEN
+                self._state = HALF_OPEN  # no-event — surfaced by the board
                 self._probe_in_flight = True
+                self._half_open_evt = True
                 return True
             # HALF_OPEN: one probe at a time
             if self._probe_in_flight:
@@ -98,6 +103,14 @@ class CircuitBreaker:
                 return HALF_OPEN
             return self._state
 
+    def pop_half_open(self) -> bool:
+        """Consume the open→half_open transition flag (board-side event
+        emission; at most one per transition)."""
+        with self._lock:
+            v = self._half_open_evt
+            self._half_open_evt = False
+            return v
+
     def record_success(self) -> bool:
         """Returns True when this success CLOSED a half-open breaker."""
         with self._lock:
@@ -105,7 +118,7 @@ class CircuitBreaker:
             if self._state in (HALF_OPEN, OPEN):
                 # OPEN can still see a success: a call admitted before the
                 # trip returning late — treat it as the probe's evidence
-                self._state = CLOSED
+                self._state = CLOSED  # no-event — surfaced by the board
                 self._failures.clear()
                 return True
             return False
@@ -116,7 +129,7 @@ class CircuitBreaker:
         with self._lock:
             self._probe_in_flight = False
             if self._state == HALF_OPEN:
-                self._state = OPEN
+                self._state = OPEN  # no-event — surfaced by the board
                 self._opened_at = now
                 self.opened_total += 1
                 return True
@@ -124,7 +137,7 @@ class CircuitBreaker:
             self._prune(now)
             if self._state == CLOSED and \
                     len(self._failures) >= self.failure_threshold:
-                self._state = OPEN
+                self._state = OPEN  # no-event — surfaced by the board
                 self._opened_at = now
                 self.opened_total += 1
                 return True
@@ -176,12 +189,28 @@ class BreakerBoard:
         if self.registry is not None:
             self.registry.count(name)
 
+    def _emit(self, etype: str, key: Hashable, severity: str = "info",
+              **fields: Any) -> None:
+        """One breaker state-transition event (ISSUE 14) into the
+        owning registry's journal (proxy/mixer attribution) — or the
+        process default journal for registry-less boards."""
+        from jubatus_tpu.utils import events
+
+        journal = self.registry.events if self.registry is not None \
+            else events.default_journal()
+        journal.emit("breaker", etype, severity=severity,
+                     backend=str(key), plane=self.counter_prefix, **fields)
+
     def allow(self, key: Hashable) -> bool:
         from jubatus_tpu.utils import faults
 
         if faults.is_armed():
             faults.fire(f"breaker.allow.{key}")
-        return self.get(key).allow()
+        b = self.get(key)
+        admitted = b.allow()
+        if b.pop_half_open():
+            self._emit("half_open", key)
+        return admitted
 
     def available(self, key: Hashable) -> bool:
         """Peek (no probe claim) — candidate filtering."""
@@ -190,14 +219,17 @@ class BreakerBoard:
     def record(self, key: Hashable, ok: bool) -> None:
         """Fold one call outcome into the backend's breaker; counts
         ``<prefix>_open`` on a trip and ``<prefix>_close`` on a
-        half-open probe's success."""
+        half-open probe's success, emitting the matching breaker event."""
         b = self.get(key)
         if ok:
             if b.record_success():
                 self._count(f"{self.counter_prefix}_close")
+                self._emit("close", key)
         else:
             if b.record_failure():
                 self._count(f"{self.counter_prefix}_open")
+                self._emit("open", key, severity="warning",
+                           opened_total=b.opened_total)
 
     def any_open(self) -> bool:
         with self._lock:

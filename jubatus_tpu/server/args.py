@@ -176,6 +176,19 @@ class ServerArgs:
     #: in-flight work (RPC workers + coalescer queues) after the
     #: dispatch gate flips, before handing rows off
     drain_grace: float = 1.0
+    #: --event-capacity: events retained in the cluster event journal
+    #: (utils/events.py, ISSUE 14) — typed HLC-stamped state-transition
+    #: events served by ``get_events`` / ``jubactl -c timeline``;
+    #: 0 disables emission entirely
+    event_capacity: int = 2048
+    #: --incident-window: debounce window (seconds) for automatic
+    #: incident forensics bundles (utils/incidents.py): an SLO
+    #: transitioning to firing or /healthz going degraded captures ONE
+    #: correlated forensic snapshot per window; 0 disables auto-capture
+    incident_window: float = 300.0
+    #: --incident-dir: capped artifacts dir for incident bundles
+    #: (oldest pruned); empty = <datadir>/jubatus_incidents_<engine>_<port>
+    incident_dir: str = ""
 
     @property
     def is_standalone(self) -> bool:
@@ -414,6 +427,23 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "in-flight work after new effectful calls start "
                         "being rejected, before handing rows off to the "
                         "new ring owners")
+    p.add_argument("--event-capacity", type=int, default=2048,
+                   help="events retained in the cluster event journal "
+                        "(typed, HLC-stamped state-transition events "
+                        "served by get_events / jubactl -c timeline); "
+                        "0 disables emission entirely")
+    p.add_argument("--incident-window", type=float, default=300.0,
+                   help="debounce window (seconds) for automatic "
+                        "incident forensics bundles: an SLO firing or "
+                        "/healthz going degraded captures ONE correlated "
+                        "snapshot (event window, timeseries, slow log, "
+                        "flight records, profiler tail) per window; "
+                        "0 disables auto-capture")
+    p.add_argument("--incident-dir", default="",
+                   help="capped artifacts dir for incident bundles "
+                        "(oldest pruned past a fixed cap; jubactl -c "
+                        "incident lists/pulls them); empty = under "
+                        "--datadir")
     return p
 
 
@@ -461,6 +491,10 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
         raise SystemExit("--profile-trigger-breaches must be >= 0")
     if args.profile_trigger_window <= 0:
         raise SystemExit("--profile-trigger-window must be > 0")
+    if args.event_capacity < 0:
+        raise SystemExit("--event-capacity must be >= 0")
+    if args.incident_window < 0:
+        raise SystemExit("--incident-window must be >= 0")
     for spec in args.slo:
         from jubatus_tpu.utils.slo import parse_slo
 

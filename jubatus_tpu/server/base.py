@@ -138,6 +138,24 @@ class EngineServer:
                 burn_threshold=getattr(
                     self.args, "slo_burn_threshold", 2.0))
             self.telemetry.hooks.append(self._model_health_tick)
+        # cluster event plane + incident bundles (ISSUE 14): bound the
+        # journal from the flag, and arm the two incident triggers —
+        # SLO transitioning to firing, /healthz transitioning degraded
+        from jubatus_tpu.utils.incidents import IncidentManager
+
+        self.rpc.trace.events.set_capacity(
+            getattr(self.args, "event_capacity", 2048))
+        self.incidents = IncidentManager(
+            self.rpc.trace, self._incident_state, self._incident_dir,
+            window_s=getattr(self.args, "incident_window", 300.0),
+            journal=self.rpc.trace.events)
+        if self.slo is not None:
+            self.slo.on_fire = self._on_slo_fire
+        self._was_degraded = False
+        #: re-entrancy guard: the incident collector reads _health(),
+        #: whose telemetry.status() re-runs the sampler hooks — the
+        #: tick must not recurse into itself mid-capture
+        self._in_health_tick = False
         self._stop_event = threading.Event()
         self._stop_once = threading.Lock()  # first stop() wins; rest no-op
         # elastic membership (ISSUE 10): migration counters + the drain
@@ -521,13 +539,102 @@ class EngineServer:
             self.rpc.trace.count("profiler.device_captures")
         return {node.name: doc}
 
+    # -- event plane + incident bundles (ISSUE 14) ---------------------------
+    def get_events(self, _name: str = "", since: int = 0,
+                   grep: str = "") -> Dict[str, Any]:
+        """This node's cluster-event view, keyed like get_status: the
+        server registry's journal MERGED with the process default
+        journal (membership/fault/checkpoint emissions), causally
+        ordered by HLC. ``since`` is an HLC cursor (return events
+        strictly after it — the ``--follow`` contract); ``grep`` is a
+        substring filter applied server-side."""
+        from jubatus_tpu.utils import events as ev
+
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        grep = grep.decode() if isinstance(grep, bytes) else str(grep or "")
+        recs = ev.merge_events([
+            self.rpc.trace.events.snapshot(since=int(since or 0), grep=grep),
+            ev.default_journal().snapshot(since=int(since or 0), grep=grep),
+        ])
+        return {node.name: {"events": recs, "hlc_now": ev.hlc_now(),
+                            "stats": self.rpc.trace.events.stats()}}
+
+    def get_incidents(self, _name: str = "",
+                      incident_id: str = "") -> Dict[str, Any]:
+        """Incident-bundle surface (utils/incidents.py): an empty id
+        lists the capped artifacts dir, a concrete id returns that
+        bundle's full forensic doc."""
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        incident_id = incident_id.decode() \
+            if isinstance(incident_id, bytes) else str(incident_id or "")
+        if incident_id:
+            return {node.name: self.incidents.get(incident_id)}
+        return {node.name: self.incidents.list()}
+
+    def _incident_dir(self) -> str:
+        return getattr(self.args, "incident_dir", "") or os.path.join(
+            self.args.datadir,
+            f"jubatus_incidents_{self.engine}_"
+            f"{self.rpc.port or self.args.rpc_port}")
+
+    def _on_slo_fire(self, name: str, _state: Dict[str, Any]) -> None:
+        """SLO transitioned to firing: capture one incident bundle,
+        seeded with the breaching trace_ids from the slow log (the
+        requests that spent the error budget)."""
+        ids = [r.get("trace_id", "")
+               for r in self.rpc.trace.slowlog.snapshot(last=16)]
+        self.incidents.trigger(f"slo_firing:{name}",
+                               trace_ids=[t for t in ids if t][-8:])
+
+    def _incident_state(self) -> Dict[str, Any]:
+        """The correlated forensic snapshot one bundle holds: event
+        window, timeseries window, slow log, mix flight records,
+        profiler tail snapshots, breaker state, health verdict."""
+        from jubatus_tpu.utils import events as ev
+
+        doc: Dict[str, Any] = {
+            "node": NodeInfo(self.args.eth,
+                             self.rpc.port or self.args.rpc_port).name,
+            "events": ev.merge_events([
+                self.rpc.trace.events.snapshot(limit=256),
+                ev.default_journal().snapshot(limit=64)]),
+            "slow_log": self.rpc.trace.slowlog.snapshot(last=64),
+            "health": self._health(),
+        }
+        if self.timeseries is not None:
+            doc["timeseries"] = self.timeseries.points(last=60)
+        if self.mixer is not None and \
+                getattr(self.mixer, "flight", None) is not None:
+            doc["mix_history"] = self.mixer.flight.snapshot(last=32)
+            breakers = getattr(getattr(self.mixer, "comm", None),
+                               "breakers", None)
+            if breakers is not None:
+                doc["breakers"] = breakers.snapshot()
+        try:
+            prof = self.profiler.profile(30.0)
+            folded = prof.get("folded") or {}
+            top = dict(sorted(folded.items(), key=lambda kv: -kv[1])[:50])
+            doc["profile"] = {"folded_top": top,
+                              "snapshots": prof.get("snapshots") or [],
+                              "stats": prof.get("stats") or {}}
+        except Exception:  # broad-ok — a sick profiler must not block capture
+            log.debug("incident profile fold failed", exc_info=True)
+        return doc
+
     # -- model-health plane (ISSUE 7) ----------------------------------------
     def _model_health_tick(self) -> None:
         """One telemetry tick: gauge the coalescer load signals, then
         snapshot the registry into the time-series ring and re-evaluate
         every SLO's burn rates against the updated ring."""
-        if self.timeseries is None:
+        if self.timeseries is None or self._in_health_tick:
             return
+        self._in_health_tick = True
+        try:
+            self._model_health_tick_inner()
+        finally:
+            self._in_health_tick = False
+
+    def _model_health_tick_inner(self) -> None:
         # ingest backpressure gauges (ISSUE 12): queued examples behind
         # the current flush + trailing arrival rate, summed over every
         # train-plane coalescer — the autoscaler's primary signal, so
@@ -559,6 +666,15 @@ class EngineServer:
         self.timeseries.sample(self.rpc.trace.snapshot())
         if self.slo is not None:
             self.slo.evaluate()
+        # incident trigger #2 (ISSUE 14): /healthz transitioning
+        # ok -> degraded captures a bundle (the SLO on_fire trigger
+        # usually beats it; the debounce window keeps it to ONE)
+        reasons = self._degraded_reasons()
+        if reasons and not self._was_degraded:
+            self.incidents.trigger(
+                "healthz_degraded:" + ",".join(
+                    sorted({str(r.get("kind", "?")) for r in reasons})))
+        self._was_degraded = bool(reasons)
 
     def get_timeseries(self, _name: str = "") -> Dict[str, Any]:
         """This node's metric time-series ring (utils/timeseries.py),
@@ -656,6 +772,9 @@ class EngineServer:
         doc["profiler_hz"] = pstats["hz"]
         doc["profiler_samples"] = pstats["samples"]
         doc["profiler_snapshots"] = pstats["snapshots_taken"]
+        # incident bundles (ISSUE 14): how many forensic snapshots this
+        # process has auto-captured (the dir is in get_incidents)
+        doc["incidents_captured"] = self.incidents.stats()["captured"]
         # runtime telemetry summary (full key set lives in get_status)
         rt = self.telemetry.status()
         for k in ("rss_bytes", "open_fds", "threads",
@@ -733,6 +852,11 @@ class EngineServer:
         if self.slo is not None:
             st["slo.configured"] = len(self.slo.specs)
             st["slo.firing"] = len(self.slo.alerts())
+        # event plane + incident bundles (ISSUE 14)
+        st.update({f"events.{k}": v
+                   for k, v in self.rpc.trace.events.stats().items()})
+        st.update({f"incident.{k}": v
+                   for k, v in self.incidents.stats().items()})
         # process-wide counters (zk session events, ...) live in the
         # default registry; surface them without clobbering our own
         from jubatus_tpu.utils import tracing as _tracing
@@ -757,6 +881,15 @@ class EngineServer:
             host=self.args.bind_host,
         )
         self.args.rpc_port = actual
+        # event plane (ISSUE 14): journals attribute events by node name,
+        # which an ephemeral-port bind only resolves now; the process
+        # default journal keeps the FIRST server's name (one server per
+        # process in production)
+        from jubatus_tpu.utils import events as _events
+
+        self.rpc.trace.events.node = NodeInfo(self.args.eth, actual).name
+        if not _events.default_journal().node:
+            _events.default_journal().node = self.rpc.trace.events.node
         self.telemetry.start()
         self.profiler.start()
         if getattr(self.args, "metrics_port", -1) >= 0:

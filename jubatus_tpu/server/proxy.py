@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import random
 import threading
 import time
@@ -201,6 +202,16 @@ class ProxyArgs:
     #: and new ring owners (elastic membership: no key has zero owners
     #: while rows migrate); idempotent reads fail over old->new instead
     handoff_window: float = 15.0
+    #: --event-capacity: cluster event journal depth at the PROXY hop
+    #: (utils/events.py, ISSUE 14) — breaker transitions and proxy SLO
+    #: edges land here; 0 disables emission
+    event_capacity: int = 2048
+    #: --incident-window: debounce window (seconds) for automatic
+    #: incident bundles at the proxy hop (0 disables auto-capture)
+    incident_window: float = 300.0
+    #: --incident-dir: capped bundle artifacts dir; empty = under /tmp
+    #: keyed by the bound port
+    incident_dir: str = ""
 
     @property
     def bind_host(self) -> str:
@@ -390,6 +401,23 @@ class Proxy:
                 slow_window_s=getattr(args, "slo_slow_window", 3600.0),
                 burn_threshold=getattr(args, "slo_burn_threshold", 2.0))
             self.telemetry.hooks.append(self._model_health_tick)
+        # cluster event plane + incident bundles (ISSUE 14) at the
+        # proxy hop: breaker transitions and proxy-side SLO edges land
+        # in this journal; the same two triggers capture bundles
+        from jubatus_tpu.utils.incidents import IncidentManager
+
+        self.rpc.trace.events.set_capacity(
+            getattr(args, "event_capacity", 2048))
+        self.incidents = IncidentManager(
+            self.rpc.trace, self._incident_state, self._incident_dir,
+            window_s=getattr(args, "incident_window", 300.0),
+            journal=self.rpc.trace.events)
+        if self.slo is not None:
+            self.slo.on_fire = self._on_slo_fire
+        self._was_degraded = False
+        #: re-entrancy guard (see EngineServer): the incident
+        #: collector's _health() re-runs the telemetry hooks
+        self._in_health_tick = False
         self._register_methods()
         if hasattr(self.rpc, "relay_config"):
             t = threading.Thread(target=self._relay_refresher, daemon=True,
@@ -933,6 +961,17 @@ class Proxy:
                               "get_profile", self.get_proxy_profile),
                           arity=2)
         self._register("profile_device", 2, "broadcast", aggregators.merge)
+        # event plane + incident bundles (ISSUE 14): one call against
+        # the proxy returns the whole cluster's causally merged events /
+        # bundle index (backends broadcast + the proxy's own folded in)
+        self.rpc.register("get_events",
+                          self._forensics_handler(
+                              "get_events", self.get_proxy_events),
+                          arity=3)
+        self.rpc.register("get_incidents",
+                          self._forensics_handler(
+                              "get_incidents", self.get_proxy_incidents),
+                          arity=2)
         self._register("do_mix", 1, "random", aggregators.pass_)
         # elastic membership (ISSUE 10): ring-version probe routes like
         # any read (all backends agree modulo watch latency)
@@ -947,6 +986,10 @@ class Proxy:
         self.rpc.register("get_proxy_alerts", self.get_proxy_alerts,
                           arity=1)
         self.rpc.register("get_proxy_profile", self.get_proxy_profile,
+                          arity=2)
+        self.rpc.register("get_proxy_events", self.get_proxy_events,
+                          arity=3)
+        self.rpc.register("get_proxy_incidents", self.get_proxy_incidents,
                           arity=2)
         self.rpc.register("get_breakers", self.get_breakers, arity=1)
 
@@ -990,12 +1033,90 @@ class Proxy:
         return {node.name: self.rpc.trace.slowlog.snapshot()}
 
     def _model_health_tick(self) -> None:
-        """Telemetry tick: ring sample + SLO evaluation (ISSUE 7)."""
-        if self.timeseries is None:
+        """Telemetry tick: ring sample + SLO evaluation (ISSUE 7) +
+        the degraded-healthz incident trigger (ISSUE 14)."""
+        if self.timeseries is None or self._in_health_tick:
             return
-        self.timeseries.sample(self.rpc.trace.snapshot())
-        if self.slo is not None:
-            self.slo.evaluate()
+        self._in_health_tick = True
+        try:
+            self.timeseries.sample(self.rpc.trace.snapshot())
+            if self.slo is not None:
+                self.slo.evaluate()
+            degraded = bool(self._health().get("degraded_reasons"))
+            if degraded and not self._was_degraded:
+                self.incidents.trigger("healthz_degraded")
+            self._was_degraded = degraded
+        finally:
+            self._in_health_tick = False
+
+    # -- event plane + incident bundles (ISSUE 14) ----------------------------
+    def get_proxy_events(self, _name: str = "", since: int = 0,
+                         grep: str = "") -> Dict[str, Any]:
+        """This proxy's OWN event journal (breaker transitions, SLO
+        edges at the proxy hop) merged with the process default journal;
+        the RPC-routed ``get_events`` additionally broadcasts."""
+        from jubatus_tpu.utils import events as ev
+
+        node = NodeInfo(self.args.bind_host,
+                        self.rpc.port or self.args.rpc_port)
+        grep = grep.decode() if isinstance(grep, bytes) else str(grep or "")
+        recs = ev.merge_events([
+            self.rpc.trace.events.snapshot(since=int(since or 0), grep=grep),
+            ev.default_journal().snapshot(since=int(since or 0), grep=grep),
+        ])
+        return {node.name: {"events": recs, "hlc_now": ev.hlc_now(),
+                            "stats": self.rpc.trace.events.stats()}}
+
+    def get_proxy_incidents(self, _name: str = "",
+                            incident_id: str = "") -> Dict[str, Any]:
+        """This proxy's incident bundles: empty id lists, a concrete id
+        returns the full forensic doc."""
+        node = NodeInfo(self.args.bind_host,
+                        self.rpc.port or self.args.rpc_port)
+        incident_id = incident_id.decode() \
+            if isinstance(incident_id, bytes) else str(incident_id or "")
+        if incident_id:
+            return {node.name: self.incidents.get(incident_id)}
+        return {node.name: self.incidents.list()}
+
+    def _incident_dir(self) -> str:
+        return getattr(self.args, "incident_dir", "") or os.path.join(
+            "/tmp", f"jubatus_incidents_{self.engine}_proxy_"
+            f"{self.rpc.port or self.args.rpc_port}")
+
+    def _on_slo_fire(self, name: str, _state: Dict[str, Any]) -> None:
+        ids = [r.get("trace_id", "")
+               for r in self.rpc.trace.slowlog.snapshot(last=16)]
+        self.incidents.trigger(f"slo_firing:{name}",
+                               trace_ids=[t for t in ids if t][-8:])
+
+    def _incident_state(self) -> Dict[str, Any]:
+        """Proxy-flavored forensic snapshot: events, timeseries, slow
+        log, per-backend breaker state, profiler tail, health."""
+        from jubatus_tpu.utils import events as ev
+
+        doc: Dict[str, Any] = {
+            "node": NodeInfo(self.args.bind_host,
+                             self.rpc.port or self.args.rpc_port).name,
+            "events": ev.merge_events([
+                self.rpc.trace.events.snapshot(limit=256),
+                ev.default_journal().snapshot(limit=64)]),
+            "slow_log": self.rpc.trace.slowlog.snapshot(last=64),
+            "breakers": self.breakers.snapshot(),
+            "health": self._health(),
+        }
+        if self.timeseries is not None:
+            doc["timeseries"] = self.timeseries.points(last=60)
+        try:
+            prof = self.profiler.profile(30.0)
+            folded = prof.get("folded") or {}
+            top = dict(sorted(folded.items(), key=lambda kv: -kv[1])[:50])
+            doc["profile"] = {"folded_top": top,
+                              "snapshots": prof.get("snapshots") or [],
+                              "stats": prof.get("stats") or {}}
+        except Exception:  # broad-ok — forensics must not block capture
+            log.debug("incident profile fold failed", exc_info=True)
+        return doc
 
     def get_proxy_timeseries(self, _name: str = "") -> Dict[str, Any]:
         """This proxy's OWN metric time-series ring (the RPC-routed
@@ -1081,6 +1202,10 @@ class Proxy:
                    for k, v in self.rpc.trace.slowlog.stats().items()})
         st.update({f"profiler.{k}": v
                    for k, v in self.profiler.stats().items()})
+        st.update({f"events.{k}": v
+                   for k, v in self.rpc.trace.events.stats().items()})
+        st.update({f"incident.{k}": v
+                   for k, v in self.incidents.stats().items()})
         return {node.name: st}
 
     def get_metrics(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
@@ -1131,6 +1256,10 @@ class Proxy:
             host=self.args.bind_host,
         )
         self.args.rpc_port = actual
+        # event plane (ISSUE 14): attribute this proxy's events by its
+        # bound node name
+        self.rpc.trace.events.node = NodeInfo(self.args.bind_host,
+                                              actual).name
         self.telemetry.start()
         self.profiler.start()
         if getattr(self.args, "metrics_port", -1) >= 0:
@@ -1255,6 +1384,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "the union of old and new ring owners (no key "
                         "has zero owners while rows migrate); idempotent "
                         "reads fail over new->old instead")
+    p.add_argument("--event-capacity", type=int, default=2048,
+                   help="cluster event journal depth at the proxy hop "
+                        "(breaker transitions, proxy SLO edges; served "
+                        "by get_events / jubactl -c timeline); 0 "
+                        "disables emission")
+    p.add_argument("--incident-window", type=float, default=300.0,
+                   help="debounce window (seconds) for automatic "
+                        "incident bundles at the proxy hop: a firing "
+                        "proxy SLO or degraded /healthz captures ONE "
+                        "correlated snapshot per window; 0 disables")
+    p.add_argument("--incident-dir", default="",
+                   help="capped incident-bundle artifacts dir (oldest "
+                        "pruned); empty = under /tmp keyed by the "
+                        "bound port")
     ns = p.parse_args(argv)
     ns.slo = ns.slo or []
     args = ProxyArgs(**{f.name: getattr(ns, f.name)

@@ -74,6 +74,10 @@ def bind_engine(rpc: RpcServer, server: Any) -> None:
     # on-demand XLA device capture
     rpc.register("get_profile", server.get_profile, arity=2)
     rpc.register("profile_device", server.profile_device, arity=2)
+    # cluster event plane + incident bundles (ISSUE 14): HLC-ordered
+    # event journal (cursor-resumable) + the capped forensic bundles
+    rpc.register("get_events", server.get_events, arity=3)
+    rpc.register("get_incidents", server.get_incidents, arity=2)
     rpc.register("do_mix", server.do_mix, arity=1)
     # elastic membership (ISSUE 10): ring-version + drain control +
     # the state-migration data plane (framework/migration.py). The

@@ -117,6 +117,12 @@ def arm(*rule_texts: str) -> List[_Rule]:
     with _lock:
         _rules.extend(parsed)
         _armed = True
+    # event plane (ISSUE 14): an armed fault site is cluster state an
+    # incident timeline must show — chaos drills self-document
+    from jubatus_tpu.utils import events
+
+    events.emit("faults", "armed", severity="warning",
+                rules=list(rule_texts))
     return parsed
 
 
@@ -184,6 +190,14 @@ def fire(site: str) -> bool:
                 dropped = True
             else:
                 boom = True
+    if delay or boom or dropped:
+        # a fault actually FIRING is a timeline event (emitted outside
+        # the rule lock; the no-rule fast path above never reaches here)
+        from jubatus_tpu.utils import events
+
+        events.emit("faults", "fired", severity="warning", site=site,
+                    action=("error" if boom else
+                            "drop" if dropped else "delay"))
     if delay:
         time.sleep(delay)
     if boom:

@@ -144,6 +144,11 @@ class SloEngine:
         self.burn_threshold = float(burn_threshold)
         #: per-SLO evaluated state (name -> dict); see evaluate()
         self._state: Dict[str, Dict[str, Any]] = {}
+        #: incident hook (ISSUE 14): called as ``on_fire(name, state)``
+        #: when an SLO TRANSITIONS to firing (never on re-evaluation of
+        #: an already-firing one); exceptions are swallowed — forensics
+        #: must not break the telemetry tick
+        self.on_fire: Optional[Any] = None
 
     # -- burn math ------------------------------------------------------------
     def _bad_fraction(self, spec: SloSpec,
@@ -194,19 +199,37 @@ class SloEngine:
                       "firing": False, "since_ts": 0.0,
                       "transitions": 0}
                 self._state[spec.name] = st
-            if firing != st["firing"]:
+            transitioned = firing != st["firing"]
+            # commit the new state BEFORE any edge hook runs: the
+            # incident trigger's forensic collector may (transitively)
+            # re-enter evaluate, and a not-yet-committed transition
+            # would read as a SECOND edge
+            st["firing"] = firing
+            st["burn_fast"] = round(burn_fast, 4)
+            st["burn_slow"] = round(burn_slow, 4)
+            st["burn_threshold"] = self.burn_threshold
+            if transitioned:
                 st["transitions"] += 1
                 st["since_ts"] = round(now, 3)
                 self.registry.count("slo.transitions")
+                # event plane (ISSUE 14): every fire/clear edge is a
+                # timeline event; fires additionally run the incident
+                # trigger hook
+                self.registry.events.emit(
+                    "slo", "firing" if firing else "resolved",
+                    severity="warning" if firing else "info",
+                    name=spec.name, burn_fast=round(burn_fast, 4),
+                    burn_slow=round(burn_slow, 4))
+                if firing and self.on_fire is not None:
+                    try:
+                        self.on_fire(spec.name, dict(st))
+                    except Exception:  # noqa: BLE001 — hook must not break
+                        log.debug("slo on_fire hook failed", exc_info=True)
                 (log.warning if firing else log.info)(
                     "SLO %s %s (burn fast=%.2f slow=%.2f, threshold %.2f): "
                     "%s", spec.name, "FIRING" if firing else "resolved",
                     burn_fast, burn_slow, self.burn_threshold,
                     spec.describe())
-            st["firing"] = firing
-            st["burn_fast"] = round(burn_fast, 4)
-            st["burn_slow"] = round(burn_slow, 4)
-            st["burn_threshold"] = self.burn_threshold
             slug = _slug(spec.name)
             self.registry.gauge(f"slo.{slug}.burn_fast", round(burn_fast, 4))
             self.registry.gauge(f"slo.{slug}.burn_slow", round(burn_slow, 4))

@@ -42,6 +42,7 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+from jubatus_tpu.utils.events import EventJournal
 from jubatus_tpu.utils.slowlog import SlowLog
 
 # -- histogram geometry -------------------------------------------------------
@@ -305,6 +306,10 @@ class Registry:
         #: tail-based slow-request ring (utils/slowlog.py); servers tune
         #: it from --slowlog-* flags via slowlog.configure()
         self.slowlog = SlowLog()
+        #: cluster event journal (utils/events.py, ISSUE 14): typed,
+        #: HLC-stamped state-transition events served over get_events;
+        #: counts event.emitted/event.dropped into this registry
+        self.events = EventJournal(counter=self.count)
         #: span store + slow log master switch (histograms stay on):
         #: bench_serving.py's overhead A/B flips it
         self._forensics = True
@@ -523,6 +528,7 @@ class Registry:
             self._spans.clear()
             self._by_trace.clear()
         self.slowlog.clear()
+        self.events.clear()
 
 
 def _esc(v: Any) -> str:

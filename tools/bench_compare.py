@@ -65,8 +65,11 @@ _HIGHER = re.compile(
 #: (ISSUE 12): flash-onset-to-recovered wall time and seconds spent in
 #: SLO violation — growth in either means the control loop got slower
 #: at absorbing a traffic step.
+#: ``_us`` covers the event plane (ISSUE 14): per-emit microseconds
+#: (``e2e_event_emit_us``) — a hot-path cost, down-good like any
+#: latency.
 _LOWER = re.compile(
-    r"(_ms($|_)|_ratio($|_)|wire_mb|_per_host($|_)|drift"
+    r"(_ms($|_)|_ratio($|_)|_us($|_)|wire_mb|_per_host($|_)|drift"
     r"|_error(s)?($|_)|_timeouts|_errors_total|_denials|rows_lost"
     r"|_stall_ms($|_)|_lag_rounds($|_)"
     r"|_recovery_s($|_)|_violation_s($|_))")

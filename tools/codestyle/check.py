@@ -132,6 +132,67 @@ def _check_convert_loops(path: str, tree: "ast.AST",
     return problems
 
 
+#: event-coverage gate (ISSUE 14): the audited state-transition sites —
+#: a breaker outcome folding into its state machine, a drain phase set,
+#: an SLO firing edge, an autoscaler journal write — must emit a typed
+#: event into the cluster event plane (utils/events.py), or the
+#: `jubactl -c timeline` narrative silently loses that subsystem. The
+#: marker regex matches the transition line; the ENCLOSING FUNCTION must
+#: contain an ``events.emit(`` / ``.events.emit(`` / ``self._emit(``
+#: call. A transition genuinely surfaced elsewhere opts out per line
+#: with a ``# no-event`` pragma stating where.
+EVENT_SITES = (
+    ("jubatus_tpu/rpc/breaker.py",
+     re.compile(r"record_(failure|success)\(\)"),
+     "breaker state transition"),
+    ("jubatus_tpu/framework/migration.py",
+     re.compile(r"self\.state\s*="),
+     "drain phase transition"),
+    ("jubatus_tpu/utils/slo.py",
+     re.compile(r"st\[\"firing\"\]\s*="),
+     "SLO firing transition"),
+    ("jubatus_tpu/coord/autoscaler.py",
+     re.compile(r"self\.journal\.append\("),
+     "autoscaler decision/actuation record"),
+)
+
+_EMIT_RE = re.compile(r"(\bevents\.emit\(|\.events\.emit\(|self\._emit\()")
+
+
+def _check_event_coverage(path: str, posix: str, tree: "ast.AST",
+                          lines: List[str]) -> List[str]:
+    """Marker lines from EVENT_SITES must sit inside a function whose
+    source contains an event-emission call (or carry ``# no-event``)."""
+    sites = [(pat, desc) for suffix, pat, desc in EVENT_SITES
+             if posix.endswith(suffix)]
+    if not sites:
+        return []
+    # map line -> innermost enclosing function's (start, end) span
+    funcs: List[tuple] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.append((node.lineno, node.end_lineno))
+    problems = []
+    for i, line in enumerate(lines, 1):
+        for pat, desc in sites:
+            if not pat.search(line) or "# no-event" in line:
+                continue
+            spans = [f for f in funcs if f[0] <= i <= f[1]]
+            if spans:
+                start, end = max(spans, key=lambda f: f[0])  # innermost
+                body = "\n".join(lines[start - 1:end])
+            else:
+                body = line
+            if not _EMIT_RE.search(body):
+                problems.append(
+                    f"{path}:{i}: {desc} without an events.emit call in "
+                    "the enclosing function (the cluster event timeline "
+                    "loses this transition — emit into the owning "
+                    "registry's journal, or append '# no-event — <where "
+                    "it IS surfaced>')")
+    return problems
+
+
 def _is_span_timed(posix_path: str) -> bool:
     """Files whose hot-path timing must go through the tracing registry's
     ``span()`` helper (ISSUE 4): RPC dispatch and the mixer round paths.
@@ -248,6 +309,8 @@ def check_file(path: str) -> List[str]:
         if any(d in posix for d in CONVERT_LOOP_DIRS):
             problems.extend(_check_convert_loops(path, tree,
                                                  text.splitlines()))
+        problems.extend(_check_event_coverage(path, posix, tree,
+                                              text.splitlines()))
     return problems
 
 
