@@ -1314,6 +1314,197 @@ def run_async_mix(rounds: int = 12, storm_seconds: float = 4.0) -> dict:
     return out
 
 
+def run_poison_drill(rounds: int = 6) -> dict:
+    """Model-integrity poison drill (ISSUE 15): the guard, measured as
+    load-bearing.
+
+    Phase 1 — guarded fleet vs clean twin: a 3-member cluster under
+    ``--mix-guard quarantine`` with member 2 armed as a poisoner
+    (``mix.diff.poison.<node>:nan``, then a fresh cluster with
+    ``scale:1e6``) runs ``rounds`` mix rounds of fixed traffic. The
+    twin runs the same traffic with member 2 simply NOT training —
+    which is exactly what a perfect quarantine reduces the poisoner
+    to. Keys:
+
+    - ``e2e_poison_quarantined_total`` — contributions the guard kept
+      out of folds (must be > 0: the poisoner is caught every round);
+    - ``e2e_poison_zero_nonfinite_applied_ok`` — no member's model
+      ever carries a non-finite weight;
+    - ``e2e_poison_drift_vs_clean`` — relative L2 distance between the
+      guarded fleet's folded model and the clean twin's (float noise:
+      the quarantine removed the poison and nothing else).
+
+    Phase 2 — rollback recovery: a hand-poisoned put_diff total against
+    a snapshotted member must be refused, auto-roll back to last-good,
+    and leave the member serving — ``e2e_rollback_recovery_s`` is
+    refusal→serving wall time.
+
+    Phase 3 — the control: the SAME nan poisoner against a fleet with
+    ``--mix-guard off`` must corrupt the model
+    (``e2e_poison_unguarded_corrupted``) — proving the guard is what
+    stood between the drill and a poisoned fleet
+    (``e2e_poison_guard_load_bearing_ok``)."""
+    import jax as _jax
+    import numpy as _np
+
+    from jubatus_tpu.client import Datum as _Datum
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.utils import faults as _faults
+
+    conf = {"method": "PA",
+            "parameter": {"regularization_weight": 1.0},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+
+    def boot(name: str, guard: str, n: int = 3):
+        store = _Store()
+        servers = []
+        for _ in range(n):
+            srv = EngineServer(
+                "classifier", conf,
+                args=ServerArgs(engine="classifier",
+                                coordinator="(shared)", name=name,
+                                listen_addr="127.0.0.1", thread=2,
+                                interval_sec=1e9,
+                                interval_count=1 << 30,
+                                telemetry_interval=0,
+                                mix_guard=guard, mix_norm_bound=8.0),
+                coord=MemoryCoordinator(store))
+            srv.start(0)
+            servers.append(srv)
+        return servers
+
+    def train(srv, name, rows):
+        with RpcClient("127.0.0.1", srv.args.rpc_port) as c:
+            c.call("train", name,
+                   [[label, _Datum(d).to_msgpack()] for label, d in rows])
+
+    def float_leaves(srv):
+        leaves = _jax.tree_util.tree_flatten(srv.driver.pack())[0]
+        out = []
+        for x in leaves:
+            a = _np.asarray(x)
+            if a.dtype != object and _np.issubdtype(a.dtype,
+                                                    _np.floating):
+                out.append(a.reshape(-1))
+        return out
+
+    def model_finite(srv) -> bool:
+        return all(bool(_np.isfinite(a).all()) for a in float_leaves(srv))
+
+    def model_vec(srv):
+        parts = float_leaves(srv)
+        return _np.concatenate(parts) if parts else _np.zeros(1)
+
+    def rows_of(rnd: int, i: int):
+        return [("l0", {"x": float(rnd + 1), "y": -0.5 * (i + 1)}),
+                ("l1", {"x": -1.0 * (i + 1), "y": float(rnd + 1)})]
+
+    def drive(servers, name, victim_trains=True, rule=""):
+        rules = _faults.arm(rule) if rule else []
+        try:
+            for rnd in range(rounds):
+                for i, s in enumerate(servers):
+                    if i == 2 and not victim_trains:
+                        continue
+                    train(s, name, rows_of(rnd, i))
+                servers[0].mixer.mix_now()
+        finally:
+            if rules:
+                _faults.disarm(rules)
+
+    def quarantined_total(servers) -> int:
+        return int(sum(s.rpc.trace.counters().get("mix.quarantined", 0)
+                       for s in servers))
+
+    def rel_drift(a, b) -> float:
+        va, vb = model_vec(a), model_vec(b)
+        if va.shape != vb.shape:
+            return float("inf")
+        denom = float(_np.linalg.norm(vb)) + 1e-12
+        return float(_np.linalg.norm(va - vb)) / denom
+
+    out: dict = {}
+    clusters: list = []
+    try:
+        # -- phase 1: guarded drill vs clean twin, nan then scale -------
+        drifts = []
+        quarantined = 0
+        finite_ok = True
+        for tag, mode_rule in (("nan", "nan"), ("scale", "scale:1e6")):
+            drill = boot(f"pd_{tag}", "quarantine")
+            # the twin is the fleet a PERFECT quarantine reduces the
+            # drill to: the poisoner's whole contribution (count leaf
+            # included) absent from every fold — i.e. a 2-member
+            # cluster running members 0/1's identical traffic
+            twin = boot(f"pt_{tag}", "quarantine", n=2)
+            clusters += [drill, twin]
+            victim = drill[2].self_nodeinfo().name
+            drive(drill, f"pd_{tag}",
+                  rule=f"mix.diff.poison.{victim}:{mode_rule}")
+            drive(twin, f"pt_{tag}")
+            quarantined += quarantined_total(drill)
+            finite_ok = finite_ok and all(model_finite(s) for s in drill)
+            drifts.append(rel_drift(drill[0], twin[0]))
+            out[f"e2e_poison_{tag}_quarantined"] = quarantined_total(drill)
+        out["e2e_poison_quarantined_total"] = quarantined
+        out["e2e_poison_zero_nonfinite_applied_ok"] = bool(finite_ok)
+        out["e2e_poison_drift_vs_clean"] = round(max(drifts), 6)
+        out["e2e_poison_drift_ok"] = bool(max(drifts) < 1e-3)
+
+        # -- phase 2: rollback recovery ---------------------------------
+        from jubatus_tpu.framework.linear_mixer import PROTOCOL_VERSION
+
+        srv = clusters[0][0]
+        srv.take_snapshot()
+        m = srv.mixer
+        with srv.driver.lock:
+            diffs = {n: mx.get_diff()
+                     for n, mx in srv.driver.get_mixables().items()}
+
+        def _nanify(x):
+            a = _np.asarray(x)
+            if a.dtype != object and _np.issubdtype(a.dtype,
+                                                    _np.floating):
+                return _np.full_like(a, _np.nan)
+            return a
+
+        poisoned = {"protocol": PROTOCOL_VERSION,
+                    "schema": m.local_get_schema(),
+                    "base_version": m.model_version,
+                    "diffs": _jax.tree_util.tree_map(_nanify, diffs)}
+        t0 = time.perf_counter()
+        applied = m.local_put_obj(poisoned)
+        with RpcClient("127.0.0.1", srv.args.rpc_port) as c:
+            c.call("classify", srv.args.name,
+                   [_Datum({"x": 1.0, "y": 0.0}).to_msgpack()])
+        recovery = time.perf_counter() - t0
+        out["e2e_rollback_recovery_s"] = round(recovery, 3)
+        out["e2e_rollback_refused_and_restored_ok"] = bool(
+            not applied and srv.rollbacks >= 1 and model_finite(srv))
+
+        # -- phase 3: guard off — the poison lands (the control) --------
+        exposed = boot("pd_off", "off")
+        clusters.append(exposed)
+        victim = exposed[2].self_nodeinfo().name
+        drive(exposed, "pd_off",
+              rule=f"mix.diff.poison.{victim}:nan")
+        corrupted = not all(model_finite(s) for s in exposed)
+        out["e2e_poison_unguarded_corrupted"] = float(corrupted)
+        out["e2e_poison_guard_load_bearing_ok"] = bool(
+            corrupted and finite_ok and quarantined > 0)
+    finally:
+        for cluster in clusters:
+            for s in cluster:
+                try:
+                    s.stop()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+    return out
+
+
 def _fleet_sim():
     """Import tools/fleet_sim.py (tools/ is not a package)."""
     repo = os.path.dirname(os.path.abspath(__file__))
@@ -1981,6 +2172,13 @@ def collect(trials: int = 2) -> dict:
         out.update(run_async_mix())
     except Exception as e:  # noqa: BLE001
         out["e2e_async_mix_error"] = repr(e)[:200]
+    # model-integrity poison drill (ISSUE 15): armed poisoner
+    # quarantined every round, guarded fleet matches a clean twin,
+    # non-finite total auto-rolls back, guard-off control corrupts
+    try:
+        out.update(run_poison_drill())
+    except Exception as e:  # noqa: BLE001
+        out["e2e_poison_error"] = repr(e)[:200]
     # autoscaling flash-crowd drill (ISSUE 12): seeded 7x traffic step,
     # autoscaled vs static control fleet, plus the autoscaler-initiated
     # scale-in drain's row parity
@@ -2027,6 +2225,13 @@ if __name__ == "__main__":
         # the async-mix slice on its own (drift parity + cadence/stall
         # storm), for ISSUE 11 iteration without the full bench
         print(json.dumps(run_async_mix(), indent=1))
+    elif len(sys.argv) > 1 and sys.argv[1] == "poison":
+        # the model-integrity slice on its own (poison drill +
+        # rollback recovery + unguarded control), for ISSUE 15
+        # iteration without the full bench
+        print(json.dumps(run_poison_drill(
+            rounds=int(sys.argv[2]) if len(sys.argv) > 2 else 6),
+            indent=1))
     elif len(sys.argv) > 1 and sys.argv[1] == "churn":
         # the elastic-membership slice on its own (kill/add cycle +
         # join/migrate/drain parity), for churn iteration without the

@@ -59,7 +59,8 @@ def _parser() -> argparse.ArgumentParser:
                    choices=["start", "stop", "save", "load", "status",
                             "metrics", "breakers", "trace", "alerts",
                             "watch", "profile", "drain", "rebalance",
-                            "autoscale", "timeline", "incident"])
+                            "autoscale", "timeline", "incident",
+                            "rollback"])
     p.add_argument("trace_id", nargs="?", default="",
                    help="[trace] trace id to assemble (from a slow-log "
                         "record, a /metrics exemplar, or "
@@ -107,8 +108,10 @@ def _parser() -> argparse.ArgumentParser:
                    help="[incident] fetch one bundle by id (from "
                         "--list) and print its full forensic JSON")
     p.add_argument("--target", default="",
-                   help="[drain] the member to drain, as IP_PORT (a node "
-                        "name from -c status)")
+                   help="[drain|rollback] the member to act on, as "
+                        "IP_PORT (a node name from -c status); rollback "
+                        "without --target rolls back EVERY member (the "
+                        "fleet-wide recovery after a poisoning incident)")
     p.add_argument("--stop", action="store_true",
                    help="[drain] also unregister the member's nodes/ "
                         "entry when drained, firing its suicide watcher "
@@ -271,12 +274,38 @@ def show_status(coord: Coordinator, engine: str, name: str,
                     (f":{r['name']}" if r.get("name") else "")
                     for r in reasons) if isinstance(reasons, list) else ""
                 print(f"    health: {hs}" + (f" [{kinds}]" if kinds else ""))
+            guard_line = _fmt_guard(st)
+            if guard_line:
+                print(f"    {guard_line}")
             shard_line = _fmt_shard_layout(st)
             if shard_line:
                 print(f"    {shard_line}")
             for k in sorted(st):
                 print(f"    {k}: {st[k]}")
     return rc
+
+
+def _fmt_guard(st: Dict[str, Any]) -> str:
+    """One-line model-integrity summary (ISSUE 15): guard mode,
+    quarantined members, snapshot/rollback state; "" when the guard is
+    off and nothing ever rolled back."""
+    mode = st.get("mixer.guard_mode")
+    rolls = int(st.get("rollback.count", 0) or 0)
+    if (not mode or mode == "off") and not rolls:
+        return ""
+    bits = [f"guard: {mode or 'off'}"]
+    q = st.get("mixer.guard_quarantined") or []
+    if q:
+        names = ", ".join(s.decode() if isinstance(s, bytes) else str(s)
+                          for s in q)
+        bits.append(f"quarantined [{names}]")
+    snaps = st.get("snapshot.count")
+    if snaps:
+        bits.append(f"snapshots {int(snaps)} "
+                    f"(v{st.get('snapshot.last_model_version', '?')})")
+    if rolls:
+        bits.append(f"rollbacks {rolls}")
+    return "  ".join(bits)
 
 
 def _fmt_shard_layout(st: Dict[str, Any]) -> str:
@@ -545,6 +574,13 @@ def _watch_node_row(node_name: str, entry: Dict[str, Any],
         mix_bits.append(f"v{st['mixer.model_version']}")
     if drift is not None:
         mix_bits.append(f"ef {float(drift):.3g}")
+    # model-integrity plane (ISSUE 15): members this node's guard holds
+    # in quarantine, and rollbacks this model took
+    q = st.get("mixer.guard_quarantined")
+    if q:
+        mix_bits.append(f"quar {len(q)}")
+    if st.get("rollback.count"):
+        mix_bits.append(f"rb {int(st['rollback.count'])}")
     # async mix (ISSUE 11): this member's distance behind the fold
     # cadence and, on the master, the pending inbox
     if st.get("mixer.async_mode"):
@@ -723,6 +759,49 @@ def drain_member(coord: Coordinator, engine: str, name: str, target: str,
         _time.sleep(0.5)
     print(f"drain timed out in state {st!r}", file=sys.stderr)
     return -1
+
+
+def rollback_member(coord: Coordinator, engine: str, name: str,
+                    target: str) -> int:
+    """Model-integrity plane (ISSUE 15): restore one member's last-good
+    model snapshot (``rollback`` RPC — the ring the server keeps under
+    ``--model-snapshot-interval``). ``--target IP_PORT`` names the node
+    (a name from ``-c status``); without it, every registered member
+    rolls back (the fleet-wide recovery after a poisoning incident)."""
+    nodes = membership.get_all_nodes(coord, engine, name)
+    if not nodes:
+        print(f"no server of {engine}/{name}", file=sys.stderr)
+        return -1
+    if target:
+        try:
+            node = NodeInfo.from_name(target)
+        except (ValueError, IndexError):
+            print(f"bad --target {target!r}: expected IP_PORT",
+                  file=sys.stderr)
+            return 1
+        if node.name not in {n.name for n in nodes}:
+            print(f"{node.name} is not a registered member of "
+                  f"{engine}/{name}", file=sys.stderr)
+            return 1
+        nodes = [node]
+    rc = 0
+    for node in nodes:
+        print(f"rollback {node.name}...", end="", flush=True)
+        try:
+            with RpcClient(node.host, node.port, timeout=60.0) as c:
+                out = c.call("rollback", name, "operator")
+        except Exception as e:  # noqa: BLE001 — report per-host
+            print(f" failed. ({e})")
+            rc = -1
+            continue
+        if out.get("rolled_back"):
+            print(f" ok: model_version {out.get('model_version')} "
+                  f"(snapshot age "
+                  f"{out.get('snapshots', {}).get('last_age_s', '?')}s)")
+        else:
+            print(f" refused: {out.get('error')}")
+            rc = -1
+    return rc
 
 
 def rebalance_cluster(coord: Coordinator, engine: str, name: str) -> int:
@@ -1315,6 +1394,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 timeout=ns.drain_timeout)
         if ns.cmd == "rebalance":
             return rebalance_cluster(coord, ns.type, ns.name)
+        if ns.cmd == "rollback":
+            return rollback_member(coord, ns.type, ns.name, ns.target)
         if ns.cmd == "autoscale":
             return run_autoscale(coord, ns.type, ns.name, ns)
         if ns.cmd == "profile":

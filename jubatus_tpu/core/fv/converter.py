@@ -34,6 +34,17 @@ from jubatus_tpu.core.fv.weight_manager import WeightManager
 from jubatus_tpu.core.sparse import CSRBatch, SparseVector
 
 
+def _count_nonfinite(n: int) -> None:
+    """Count ingest-rejected non-finite num values into the process
+    default registry (ISSUE 15) — surfaces as
+    ``trace.counter.fv.nonfinite_rejected`` in every server's
+    get_status and on /metrics."""
+    from jubatus_tpu.utils import tracing
+
+    _registry = tracing.default_registry()
+    _registry.count("fv.nonfinite_rejected", n)
+
+
 class ConverterError(ValueError):
     pass
 
@@ -447,6 +458,24 @@ class DatumToFVConverter:
         snapshot the combo cross product feeds on."""
         cfg = self.config
         datum = self._apply_filters(datum)
+        # ingest hardening (ISSUE 15): a single inf/NaN num value from
+        # a client would flow straight into the weights (train adds the
+        # feature value into the model; NaN is absorbing and the next
+        # mix round would broadcast it fleet-wide). Reject non-finite
+        # num values HERE — after filters, so a filter emitting
+        # non-finite output is caught too — counted, never silently
+        # trained. Runs for every convert path (per-datum, batch,
+        # named).
+        if datum.num_values and any(
+                isinstance(v, float) and not math.isfinite(v)
+                for _, v in datum.num_values):
+            kept = [kv for kv in datum.num_values
+                    if not (isinstance(kv[1], float)
+                            and not math.isfinite(kv[1]))]
+            _count_nonfinite(len(datum.num_values) - len(kept))
+            datum = Datum(string_values=datum.string_values,
+                          num_values=kept,
+                          binary_values=datum.binary_values)
         features: Dict[str, float] = {}
 
         # string rules

@@ -62,7 +62,7 @@ import functools
 import logging
 import threading
 import time
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from jubatus_tpu.coord import membership
 from jubatus_tpu.coord.base import NodeInfo
@@ -74,6 +74,7 @@ from jubatus_tpu.framework.linear_mixer import (
     pack_mix,
     unpack_mix,
 )
+from jubatus_tpu.framework import model_guard
 from jubatus_tpu.parallel.mix import tree_sum
 from jubatus_tpu.rpc.client import RpcClient
 from jubatus_tpu.utils import faults
@@ -135,7 +136,9 @@ def _merge_delta_tree(a: Any, b: Any) -> Any:
                     return x
             except (TypeError, ValueError):
                 pass
-        return tree_sum([x, y])
+        return tree_sum([x, y])  # no-guard — one member's own two deltas
+        # (capture + fresh snapshot); admission screening happens when
+        # the merged payload reaches an inbox or fold
 
     return jax.tree_util.tree_map(comb, a, b)
 
@@ -243,6 +246,25 @@ class AsyncLinearMixer(RpcLinearMixer):
         msg = unpack_mix(packed)
         if msg.get("protocol") != PROTOCOL_VERSION:
             return {"accepted": False, "base": int(self.model_version)}
+        # inbox admission screen (ISSUE 15): the async plane has no
+        # gather phase, so the finite screen runs the moment a payload
+        # arrives — a poisoned submission never even occupies an inbox
+        # slot (norm outliers are judged at fold time, where the peer
+        # distribution exists). warn mode flags and admits.
+        if self.guard.enabled:
+            reason = self.guard.screen_payload(
+                member, msg.get("diffs") or {},
+                _sum_names(self.driver.get_mixables()))
+            if reason is not None:
+                if reason == "nonfinite":
+                    self._count("mix.guard.nonfinite")
+                if self.guard.mode == "quarantine":
+                    self._count("mix.quarantined")
+                    self.trace.events.emit(
+                        "mix", "inbox_rejected", severity="warning",
+                        member=member, reason=reason)
+                    return {"accepted": False, "quarantined": True,
+                            "base": int(self.model_version)}
         self.inbox.submit(member, msg)
         self._count("mix.async_submits")
         self.trace.gauge("mix.async_inbox_depth", float(self.inbox.depth()))
@@ -568,7 +590,8 @@ class AsyncLinearMixer(RpcLinearMixer):
             return None  # everything stale/deferred; next tick retries
         packed, meta = folded
         with self.trace.span("mix.phase.put_diff") as sp:
-            acks = self.comm.put_diff(packed)
+            # broadcast of a fold that _weighted_fold already screened
+            acks = self.comm.put_diff(packed)  # no-guard — pre-screened
         phases["put_diff_ms"] = round(sp.seconds * 1e3, 2)
         for member in members:
             if not acks.get(member.name, False):
@@ -591,6 +614,7 @@ class AsyncLinearMixer(RpcLinearMixer):
                 "contributors": meta["contributors"],
                 "dropped_stale": meta["dropped"] or None,
                 "deferred_schema": meta["deferred"] or None,
+                "quarantined": meta.get("quarantined"),
                 "weights": meta["weights"],
                 "base_version": meta["base_version"],
                 "epoch": epoch or None,
@@ -621,6 +645,24 @@ class AsyncLinearMixer(RpcLinearMixer):
             self._count("mix.async_dropped_stale", dropped)
         if not live:
             return None
+        # model-integrity admission screen (ISSUE 15): the inbox's
+        # finite screen ran at submit time, but the NORM screen needs
+        # this fold's peer distribution — and the master's own
+        # in-process enqueue skipped the inbox screen entirely. Same
+        # ladder as the sync master: warn counts, quarantine drops.
+        quarantined_round: List[str] = []
+        if self.guard.enabled:
+            rep = self._guard_screen(
+                {m: e["payload"]["diffs"] for m, e in live.items()},
+                _sum_names(self.driver.get_mixables()))
+            quarantined_round = sorted(rep.flagged)
+            if self.guard.mode == "quarantine" and rep.flagged:
+                for m in rep.flagged:
+                    live.pop(m, None)
+                    weights.pop(m, None)
+                if not live:
+                    self._count("mix.guard.all_quarantined")
+                    return None
         # schema gate. The broadcast's schema must be the union of the
         # WHOLE cluster's vocabularies, not just this fold's
         # contributors — members apply it via sync_schema, and a
@@ -689,6 +731,19 @@ class AsyncLinearMixer(RpcLinearMixer):
         if weights:
             self.trace.gauge("mix.async_fold_weight_min",
                              min(weights.values()))
+        # fold-total finite screen (ISSUE 15): same contract as the
+        # sync master — a non-finite total is never broadcast in
+        # quarantine mode (warn counts and proceeds)
+        if self.guard.enabled and \
+                model_guard.payload_nonfinite(totals,
+                                              _sum_names(mixables)):
+            self._count("mix.guard.nonfinite_total")
+            self.trace.events.emit(
+                "mix", "nonfinite_fold_total", severity="error",
+                mode=self.guard.mode)
+            if self.guard.mode == "quarantine":
+                log.error("async fold aborted: total is non-finite")
+                return None
         health = mix_health([p["diffs"] for _, p in payloads], totals,
                             _sum_names(mixables))
         members = self.comm._members if hasattr(self.comm, "_members") \
@@ -703,6 +758,7 @@ class AsyncLinearMixer(RpcLinearMixer):
              "contributors": sorted(live), "health": health})
         return packed, {"contributors": len(live), "dropped": dropped,
                         "deferred": deferred, "weights": weights,
+                        "quarantined": quarantined_round or None,
                         "base_version": base_version, "health": health}
 
     def get_status(self) -> Dict[str, Any]:

@@ -182,6 +182,13 @@ class CollectiveMixer(RpcLinearMixer):
         #: timeout with the coordinator unreadable): the collective plane
         #: is gone for this process; every later round mixes over RPC
         self.collective_dead = False
+        #: model-integrity plane (ISSUE 15): rounds this member will
+        #: answer "unsupported" at prepare after a chunk integrity
+        #: failure (CRC mismatch / non-finite totals) — the next round
+        #: mixes over RPC instead of re-entering a collective that just
+        #: shipped or produced garbage; decremented per prepare
+        self._force_rpc_rounds = 0
+        self.integrity_failures = 0
         #: per-phase wall times of the last collective entry this member
         #: ran (cast/ship/reduce/readback ms + payload/wire MB) — the
         #: per-round log the reference keeps (linear_mixer.cpp:553-558)
@@ -258,6 +265,13 @@ class CollectiveMixer(RpcLinearMixer):
             if union and hasattr(self.driver, "sync_schema"):
                 self.driver.sync_schema(union)
             mixables = self.driver.get_mixables()
+            if self._force_rpc_rounds > 0:
+                # a chunk integrity failure last round (ISSUE 15):
+                # route this round to the RPC mix — its fold-time
+                # guard screens payloads on the host — instead of
+                # re-entering the collective that shipped garbage
+                self._force_rpc_rounds -= 1
+                return [int(self.model_version), "unsupported"]
             if self.collective_dead or \
                     not all(_summable(m) for m in mixables.values()):
                 # a dead world would fail the psum and demote this member;
@@ -448,12 +462,35 @@ class CollectiveMixer(RpcLinearMixer):
         # parallel/collective.py keeps the collective order total
         # across the overlap (phases stamp the wait as
         # dispatch_gate_ms).
+        from jubatus_tpu.parallel.collective import ChunkIntegrityError
+
         self.last_phases = {}
-        totals = psum_pytree_start(
-            entry["diffs"], compress=self.compress,
-            phases=self.last_phases, prefer_device=True,
-            feedback=self.ef,
-            topology=self._resolve_topology()).result()
+        try:
+            totals = psum_pytree_start(
+                entry["diffs"], compress=self.compress,
+                phases=self.last_phases, prefer_device=True,
+                feedback=self.ef, guard=self.guard.mode,
+                topology=self._resolve_topology()).result()
+        except ChunkIntegrityError as e:
+            # model-integrity plane (ISSUE 15): a corrupted staged
+            # chunk (CRC) or a non-finite reduced total — the round is
+            # dead for this member (nothing applied), and the NEXT
+            # round routes to the RPC mix whose fold-time guard screens
+            # on the host
+            self.integrity_failures += 1
+            self._force_rpc_rounds = max(self._force_rpc_rounds, 1)
+            self._count("mix.guard.chunk_crc_mismatch" if e.kind == "crc"
+                        else "mix.guard.nonfinite_total")
+            self.trace.events.emit(
+                "mix", "chunk_integrity_failure", severity="error",
+                kind=e.kind, round_id=rid)
+            log.error("collective round %s: %s; next round falls back "
+                      "to the RPC mix", rid, e)
+            self.flight.record(
+                "collective", ok=False, round_id=rid,
+                reason=f"chunk_integrity_{e.kind}",
+                phases=dict(self.last_phases) or None)
+            return False
         # mix-convergence telemetry (ISSUE 7): every member measures the
         # distance of its OWN contribution from the folded average — the
         # per-member half of the divergence signal the RPC master
@@ -466,6 +503,10 @@ class CollectiveMixer(RpcLinearMixer):
             "base_version": base_version,
             "diffs": totals,
             "health": health,
+            # the collective already finite-screened these totals ON
+            # DEVICE (psum_pytree guard); re-screening here would force
+            # a full device→host copy of a prefer_device payload
+            "guard_screened": True,
         })
         if ok:
             self._note_round_telemetry()
@@ -702,6 +743,7 @@ class CollectiveMixer(RpcLinearMixer):
         topo = self._resolve_topology()
         st.update(collective_rounds=self.collective_rounds,
                   fallback_rounds=self.fallback_rounds,
+                  integrity_failures=self.integrity_failures,
                   mix_compress=_norm_compress(self.compress),
                   mix_topology=topo.signature if topo is not None
                   else "flat")

@@ -249,6 +249,9 @@ EFFECTFUL_BUILTINS: FrozenSet[str] = frozenset({
     # delta — classed effectful; the submitter resubmits next tick
     # instead of retrying
     "mix_submit_diff",
+    # model-integrity plane (ISSUE 15): rollback rewrites the live
+    # model from the snapshot ring — effectful by definition
+    "rollback",
 })
 
 

@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from jubatus_tpu.coord import membership
 from jubatus_tpu.coord.base import Coordinator, NodeInfo
 from jubatus_tpu.framework.mixer import IntervalMixer, MixFlightRecorder
+from jubatus_tpu.framework.model_guard import MixGuard, payload_nonfinite
 from jubatus_tpu.parallel.mix import tree_sum
 from jubatus_tpu.rpc.breaker import BreakerBoard
 from jubatus_tpu.rpc.client import RpcClient, RpcMClient
@@ -342,10 +343,20 @@ class RpcLinearMixer:
         interval_sec: float = 16.0,
         interval_count: int = 512,
         quorum_fraction: float = 0.5,
+        guard: Optional[MixGuard] = None,
     ) -> None:
         self.driver = driver
         self.comm = comm
         self.self_node = self_node
+        #: model-integrity admission guard (ISSUE 15,
+        #: framework/model_guard.py): screens every contribution before
+        #: it enters a fold and every folded total before it applies —
+        #: --mix-guard {off,warn,quarantine} + --mix-norm-bound
+        self.guard = guard if guard is not None else MixGuard()
+        #: set by the owning server: called when put_diff refuses a
+        #: non-finite folded total, so the server can auto-roll back to
+        #: its last-good model snapshot (+ incident bundle)
+        self.on_poisoned_total: Optional[Any] = None
         #: minimum fraction of members whose diffs must arrive for the
         #: round to proceed (--mix-quorum). The reference aborts only
         #: when ALL get_diffs fail — a round folding 1 of 50 diffs then
@@ -487,6 +498,21 @@ class RpcLinearMixer:
                     if hasattr(self.driver, "get_schema") else []
                 )
         self.trace.gauge("mix.snapshot_stall_ms", round(sp.seconds * 1e3, 3))
+        # chaos site (ISSUE 15): nan patches one element of a float
+        # leaf (a single bad datum), scale:F multiplies the whole
+        # contribution (a runaway learner) — the poisons the admission
+        # guard must catch. The site carries this NODE's name (like
+        # mix.async.submit.<node>) so a drill can poison exactly one
+        # member of an in-process cluster; arm `mix.diff.poison*` to
+        # hit any member. Mutates only the outgoing snapshot (leaves
+        # copy), never the model.
+        if faults.is_armed():
+            site = "mix.diff.poison" + (
+                f".{self.self_node.name}" if self.self_node is not None
+                else "")
+            mut = faults.fire_mutate(site)
+            if mut is not None:
+                diffs = faults.poison_tree(diffs, mut)
         return {"protocol": PROTOCOL_VERSION, "schema": schema,
                 "version": self.model_version, "diffs": diffs}
 
@@ -521,6 +547,27 @@ class RpcLinearMixer:
         health = msg.get("health")
         if isinstance(health, dict):
             self._note_health(health)
+        # model-integrity plane (ISSUE 15): the last line of defense —
+        # a non-finite folded total must NEVER reach the weights (NaN
+        # is absorbing under the apply's adds; one poisoned broadcast
+        # resets every member to garbage). quarantine mode refuses the
+        # apply and asks the owning server to roll back to last-good
+        # (an unguarded/old master may have applied it locally — our
+        # own snapshot is the only provably-clean state); warn mode
+        # counts and proceeds. The obsolete/recovery ladder is skipped
+        # on refusal: the model we HOLD is good, and a peer pull could
+        # import the very poison we just refused.
+        # guard_screened: the collective entry already screened these
+        # totals on device — a host re-screen would force a full
+        # device→host copy of a prefer_device payload
+        if self.guard.enabled and not msg.get("guard_screened") and \
+                self._total_poisoned(msg):
+            if self.guard.mode != "quarantine":
+                log.warning("mix guard (warn): non-finite folded total "
+                            "applied anyway")
+            else:
+                self._poisoned_total_rollback()
+                return False
         base_version = int(msg.get("base_version", 0))
         if self.model_version < base_version:
             # I missed rounds (fresh boot / restart): the fold is deltas
@@ -591,6 +638,80 @@ class RpcLinearMixer:
             v = norm.get(key)
             if isinstance(v, (int, float)):
                 self.trace.gauge(f"mix.{key}", float(v))
+
+    def _total_poisoned(self, msg) -> bool:
+        """Finite screen of an incoming folded total over the summable
+        mixables (model-integrity plane, ISSUE 15)."""
+        try:
+            names = _sum_names(self.driver.get_mixables())
+            return payload_nonfinite(msg.get("diffs") or {}, names)
+        except Exception:  # broad-ok — the screen must never fail an apply
+            log.warning("guard total screen failed", exc_info=True)
+            return False
+
+    def _poisoned_total_rollback(self) -> None:
+        """One refused non-finite total: count, emit, and hand the
+        server the auto-rollback trigger (mix.rollbacks is counted by
+        the server where the snapshot ring lives)."""
+        log.error("mix guard: refusing non-finite folded total "
+                  "(rolling back to last-good)")
+        self._count("mix.guard.nonfinite_total")
+        self.trace.events.emit(
+            "mix", "poisoned_total_refused", severity="error")
+        if self.on_poisoned_total is not None:
+            try:
+                self.on_poisoned_total()
+            except Exception:  # broad-ok — rollback failure must not
+                log.exception("auto-rollback failed")  # kill the apply path
+
+    def _guard_round(self, entries, mixables):
+        """Master-side admission screen (ISSUE 15): screen every
+        contribution's summable mixables for non-finite leaves and
+        update-norm outliers before anything enters the fold. Returns
+        the surviving (node, payload) list + the GuardReport (None when
+        the guard is off). Counting/events happen here so the keys land
+        in the owning server's registry; a quarantined member's entry
+        is dropped, which also keeps it out of the round's contributed
+        set — its staleness ledger entry grows exactly like a member
+        whose get_diff failed."""
+        guard = self.guard
+        if not guard.enabled or not entries:
+            return entries, None
+        rep = self._guard_screen(
+            {node.name: p["diffs"] for node, p in entries},
+            _sum_names(mixables))
+        if guard.mode != "quarantine":
+            return entries, rep
+        return [(n, p) for n, p in entries
+                if n.name in rep.admitted], rep
+
+    def _guard_screen(self, by_member, names):
+        """Run one full guard screen over member -> diffs and turn the
+        report into counters/events/gauges in the owning registry (the
+        sync master and the async fold share this)."""
+        guard = self.guard
+        rep = guard.screen(by_member, names)
+        for member, reason in rep.flagged.items():
+            if reason in ("nonfinite", "norm_outlier"):
+                self._count(f"mix.guard.{reason}")
+        if rep.flagged:
+            if guard.mode == "quarantine":
+                self._count("mix.quarantined", len(rep.flagged))
+            self.trace.events.emit(
+                "mix", "guard_flagged", severity="warning",
+                mode=guard.mode, flagged=dict(rep.flagged))
+        for member in rep.quarantined_now:
+            log.error("mix guard: member %s quarantined", member)
+            self.trace.events.emit("mix", "member_quarantined",
+                                   severity="error", member=member)
+        for member in rep.released:
+            log.info("mix guard: member %s released from quarantine",
+                     member)
+            self.trace.events.emit("mix", "member_released",
+                                   member=member)
+        self.trace.gauge("mix.guard.quarantined_members",
+                         float(len(guard.quarantined())))
+        return rep
 
     def _recover_soon(self) -> None:
         time.sleep(0.2)  # let the master finish broadcasting this round
@@ -723,6 +844,19 @@ class RpcLinearMixer:
         # phase 3: pairwise fold per mixable (linear_mixer.cpp:481-499)
         with self.trace.span("mix.phase.fold") as sp:
             mixables = self.driver.get_mixables()
+            # model-integrity admission screen (ISSUE 15): quarantine a
+            # poisoned contribution BEFORE it enters the fold — NaN is
+            # absorbing under tree_sum, and the broadcast would poison
+            # every member in one round
+            entries, guard_rep = self._guard_round(entries, mixables)
+            if not entries:
+                log.error("mix aborted: every contribution quarantined")
+                self._count("mix.guard.all_quarantined")
+                self.flight.record("rpc", ok=False,
+                                   reason="all_quarantined",
+                                   members=len(members))
+                return None
+            payloads = [p for _, p in entries]
             totals: Dict[str, Any] = {}
             for name, mixable in mixables.items():
                 diffs = [p["diffs"][name] for p in payloads
@@ -749,6 +883,22 @@ class RpcLinearMixer:
                                 _sum_names(mixables))
             health.update(self._staleness_update(
                 members, {node.name for node, _ in entries}))
+            # master-side total screen (ISSUE 15): even with every
+            # contribution admitted, the FOLD can overflow to inf —
+            # never broadcast a non-finite total (quarantine mode
+            # aborts the round; warn counts and proceeds)
+            if self.guard.enabled and \
+                    payload_nonfinite(totals, _sum_names(mixables)):
+                self._count("mix.guard.nonfinite_total")
+                self.trace.events.emit(
+                    "mix", "nonfinite_fold_total", severity="error",
+                    mode=self.guard.mode)
+                if self.guard.mode == "quarantine":
+                    log.error("mix aborted: folded total is non-finite")
+                    self.flight.record("rpc", ok=False,
+                                       reason="nonfinite_fold_total",
+                                       members=len(members))
+                    return None
             # event plane (ISSUE 14): the master's HLC rides the
             # broadcast; receivers observe() it in _note_health, so a
             # member's post-apply events sort after the round that
@@ -789,6 +939,8 @@ class RpcLinearMixer:
                 "degraded": True if degraded else None,
                 "epoch": epoch or None,
                 "health": health or None,
+                "quarantined": sorted(guard_rep.flagged)
+                if guard_rep is not None and guard_rep.flagged else None,
                 "acked": sum(bool(v) for v in acks.values())}
 
     def _staleness_update(self, members: Sequence[NodeInfo],
@@ -873,6 +1025,8 @@ class RpcLinearMixer:
                    "quorum_fraction": self.quorum_fraction,
                    "self_staleness": self.self_staleness,
                    "last_round_degraded": self.last_round_degraded})
+        # model-integrity plane (ISSUE 15): guard mode + quarantine set
+        st.update(self.guard.status())
         for k, v in self.last_health.items():
             if isinstance(v, (int, float, dict)):
                 st[f"health_{k}"] = v

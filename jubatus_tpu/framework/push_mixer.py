@@ -240,6 +240,24 @@ class RpcPushMixer(RpcLinearMixer):
             log.info("adopted full model v%d from %s before exchange",
                      mv, peer_name)
         mixables = self.driver.get_mixables()
+        # model-integrity admission screen (ISSUE 15): a 2-party
+        # exchange has no peer distribution for the norm screen, but
+        # the finite screen + quarantine breaker still gate the fold —
+        # a poisoned peer fails the exchange instead of poisoning us
+        # (warn mode flags and folds; the apply-side total screen in
+        # local_put_obj is the backstop either way)
+        if self.guard.enabled:
+            from jubatus_tpu.framework.linear_mixer import _sum_names
+
+            reason = self.guard.screen_payload(
+                peer_name, hers.get("diffs") or {}, _sum_names(mixables))
+            if reason is not None:
+                if reason == "nonfinite":
+                    self._count("mix.guard.nonfinite")
+                if self.guard.mode == "quarantine":
+                    self._count("mix.quarantined")
+                    raise RuntimeError(
+                        f"peer diff rejected by mix guard: {reason}")
         totals: Dict[str, Any] = {}
         for name, mixable in mixables.items():
             diffs = [p["diffs"][name] for p in (mine, hers)
@@ -300,7 +318,8 @@ def create_mixer(name: str, driver: Any, comm: LinearCommunication, *,
                  interval_sec: float = 16.0, interval_count: int = 512,
                  mix_bf16: bool = False, quorum_fraction: float = 0.5,
                  mix_compress: str = "off", mix_topology: str = "",
-                 mix_async: bool = False, mix_staleness_bound: int = 8):
+                 mix_async: bool = False, mix_staleness_bound: int = 8,
+                 mix_guard: str = "warn", mix_norm_bound: float = 10.0):
     """Mixer factory (≙ create_mixer, mixer_factory.cpp:41-97): selects by
     the --mixer flag. ``mix_compress`` is the collective wire mode
     (off|bf16|int8); the deprecated ``mix_bf16`` bool still resolves to
@@ -310,10 +329,16 @@ def create_mixer(name: str, driver: Any, comm: LinearCommunication, *,
     asynchronous staleness-bounded plane (framework/async_mixer.py):
     members push diffs in the background and the master folds them with
     per-member weights decayed by ``mix_staleness_bound`` instead of
-    gathering behind a round barrier."""
+    gathering behind a round barrier. ``mix_guard``/``mix_norm_bound``
+    configure the model-integrity admission guard
+    (framework/model_guard.py, ISSUE 15) every strategy carries."""
+    from jubatus_tpu.framework.model_guard import MixGuard
+
     kwargs = dict(self_node=self_node, interval_sec=interval_sec,
                   interval_count=interval_count,
-                  quorum_fraction=quorum_fraction)
+                  quorum_fraction=quorum_fraction,
+                  guard=MixGuard(mode=mix_guard,
+                                 norm_bound=mix_norm_bound))
     if mix_async and name != "linear_mixer":
         raise ValueError(
             f"--mix-async rides the linear mix plane; --mixer {name} "

@@ -71,11 +71,12 @@ def read_envelope(raw: bytes, where: str):
     return body[:ssize], body[ssize:ssize + usize]
 
 
-def write_envelope(path: str, system_data: bytes,
-                   user_data: bytes = b"") -> None:
-    """Atomic envelope write: header + CRC, tmp + fsync + rename. Shared
-    by save_model and the sharded-checkpoint sidecar (the reference
-    additionally flocks against concurrent saves, server_base.cpp:152-159)."""
+def pack_envelope(system_data: bytes, user_data: bytes = b"") -> bytes:
+    """Header + CRC + body as one in-memory blob — the same bytes
+    write_envelope persists. The model-integrity plane's in-process
+    snapshot ring (framework/model_guard.ModelSnapshotRing) stores
+    these so every rollback restore revalidates the CRC exactly like a
+    checkpoint load would."""
     crc = zlib.crc32(system_data + user_data) & 0xFFFFFFFF
     header = _HEADER.pack(
         MAGIC,
@@ -85,11 +86,17 @@ def write_envelope(path: str, system_data: bytes,
         len(system_data),
         len(user_data),
     )
+    return header + system_data + user_data
+
+
+def write_envelope(path: str, system_data: bytes,
+                   user_data: bytes = b"") -> None:
+    """Atomic envelope write: header + CRC, tmp + fsync + rename. Shared
+    by save_model and the sharded-checkpoint sidecar (the reference
+    additionally flocks against concurrent saves, server_base.cpp:152-159)."""
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(header)
-        f.write(system_data)
-        f.write(user_data)
+        f.write(pack_envelope(system_data, user_data))
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)

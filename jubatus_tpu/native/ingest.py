@@ -332,12 +332,27 @@ class IngestParser:
     def _idx_val(out: "_Out"):
         """Copy the [B, K] arrays out of a parse result (one place owns
         the ctypes-extraction dance: shapes, .copy() before free, and the
-        empty-batch dtype fallback)."""
+        empty-batch dtype fallback). Also the native path's half of the
+        ingest hardening (ISSUE 15): the C++ parser never sees the
+        Python converter's finite screen, so a client's inf/NaN num
+        value would flow straight into the weights here — non-finite
+        entries are zeroed into the padding slot (index 0 — features
+        never hash there) and counted, exactly like the converter-path
+        rejection."""
         b, w = out.batch, out.width
         idx = np.ctypeslib.as_array(out.idx, shape=(b, w)).copy() \
             if b else np.zeros((0, 8), np.int32)
         val = np.ctypeslib.as_array(out.val, shape=(b, w)).copy() \
             if b else np.zeros((0, 8), np.float32)
+        bad = ~np.isfinite(val)
+        if bad.any():
+            n = int(bad.sum())
+            val[bad] = 0.0
+            idx[bad] = 0
+            from jubatus_tpu.utils import tracing
+
+            _registry = tracing.default_registry()
+            _registry.count("fv.nonfinite_rejected", n)
         return idx, val
 
     def _weight_args(self, weights):

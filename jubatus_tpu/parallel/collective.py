@@ -89,6 +89,20 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jubatus_tpu.parallel._compat import shard_map
 from jubatus_tpu.parallel.mesh import HostTopology, host_mesh, host_topology
+from jubatus_tpu.utils import faults
+
+
+class ChunkIntegrityError(RuntimeError):
+    """A wire chunk failed its integrity screen (ISSUE 15): ``kind`` is
+    ``"crc"`` (a staged chunk's CRC32 no longer matches — corruption in
+    the host staging window) or ``"nonfinite"`` (the reduced total
+    carries NaN/Inf — some contributor shipped poison, or the fold
+    overflowed). The collective mixer catches this, counts it, and
+    routes the next round to the RPC mix instead of applying garbage."""
+
+    def __init__(self, kind: str, detail: str = "") -> None:
+        super().__init__(f"chunk integrity failure ({kind}): {detail}")
+        self.kind = kind
 
 #: pipeline chunk size in MiB (uncompressed leaf bytes). Leaves at or
 #: above this split into chunks and double-buffer; smaller leaves batch
@@ -588,6 +602,62 @@ def _hier_quant_fns(mesh: Mesh, celems: int, block: int):
     return intra_j, inter_j
 
 
+@functools.lru_cache(maxsize=8)
+def _finite_all_fn():
+    """On-device isfinite reduction of one reduced chunk — the
+    collective path's half of the fold-time finite screen (the RPC mix
+    screens payloads on the host; device-resident totals must be
+    screened where they live). Returns a device scalar so the pipeline
+    never blocks per chunk; the flags fold into one host readback at
+    round end."""
+    return jax.jit(lambda x: jnp.isfinite(x).all())
+
+
+def _finite_flag(arr):
+    """Device bool scalar (or None for non-float dtypes, which cannot
+    carry NaN/Inf)."""
+    if not np.issubdtype(np.dtype(arr.dtype), np.floating):
+        return None
+    return _finite_all_fn()(arr)
+
+
+def _crc_stage(chunk: np.ndarray, state: Dict[str, int],
+               guard: str) -> np.ndarray:
+    """CRC32-bracketed staging of one host wire chunk (ISSUE 15): stamp
+    the contribution's checksum, pass through the ``mix.wire.corrupt``
+    chaos window (bitflip models transport/DMA corruption), and verify
+    before the bytes reach the device. The bracket covers the host
+    staging window — device-side transport integrity is the runtime's
+    job, and the reduced total's finite screen is the cross-member
+    backstop. The chunk also gets the CONTRIBUTION-side finite screen
+    here: the int8 transport's requant LAUNDERS a NaN/Inf block into
+    zeros (NaN fails the ``amax > 0`` scale test), so poison must be
+    caught before it quantizes, not after it reduces. ``quarantine``
+    raises (the round dies instead of shipping garbage); ``warn``
+    counts and ships."""
+    from jubatus_tpu import native
+
+    if np.issubdtype(chunk.dtype, np.floating) and \
+            not np.isfinite(chunk).all():
+        state["nonfinite"] += 1
+        if guard == "quarantine":
+            raise ChunkIntegrityError(
+                "nonfinite", "staged contribution chunk carries NaN/Inf")
+    buf = chunk.tobytes()
+    crc0 = native.crc32(buf)
+    if faults.is_armed():
+        mut = faults.fire_mutate("mix.wire.corrupt")
+        if mut is not None and mut[0] == "bitflip":
+            buf = faults.flip_byte(buf)
+    if native.crc32(buf) != crc0:
+        state["crc"] += 1
+        if guard == "quarantine":
+            raise ChunkIntegrityError(
+                "crc", f"staged chunk of {len(buf)} bytes")
+        return np.frombuffer(buf, dtype=chunk.dtype)
+    return chunk
+
+
 def _leaf_meta(leaf) -> Tuple[Any, np.dtype, Tuple[int, ...]]:
     """(leaf, dtype, shape) WITHOUT materializing device arrays on the
     host (np.asarray on a jax.Array is a full device→host copy)."""
@@ -604,7 +674,8 @@ def psum_pytree(diff: Any, compress: Any = False,
                 chunk_mb: Optional[float] = None,
                 prefer_device: bool = False,
                 feedback: Optional[ErrorFeedback] = None,
-                topology: Any = None) -> Any:
+                topology: Any = None,
+                guard: str = "off") -> Any:
     """AllReduce ``diff`` (pytree of arrays/scalars) across the process
     world. Every process must call this with an identically-shaped
     pytree and the same ``compress`` and ``chunk_mb`` (both ride the
@@ -663,6 +734,21 @@ def psum_pytree(diff: Any, compress: Any = False,
     model inter-host bytes one HOST ships per round — the scaling
     gate's key: flat grows it with devices, hierarchical holds it at
     the host count)."""
+    # model-integrity screens (ISSUE 15; ``guard`` mirrors the owning
+    # mixer's --mix-guard): when not "off", every host-staged wire
+    # chunk is CRC32-bracketed through the ``mix.wire.corrupt`` chaos
+    # window (_crc_stage) and every reduced total gets a finite screen
+    # (on device for prefer_device consumers — flags fold into ONE
+    # scalar readback at round end, so the pipeline never stalls per
+    # chunk). ``quarantine`` raises ChunkIntegrityError — BEFORE the
+    # feedback commit, so a poisoned round leaves the EF residuals of
+    # the last good round intact; ``warn`` stamps ``finite_ok`` /
+    # ``crc_mismatch_chunks`` / ``nonfinite_chunks`` into ``phases``
+    # and proceeds.
+    guard = (guard or "off").lower() if isinstance(guard, str) else \
+        ("quarantine" if guard else "off")
+    if guard not in ("off", "warn", "quarantine"):
+        raise ValueError(f"unknown guard mode {guard!r}")
     mode = _norm_compress(compress)
     # a 1x1 (trivial) topology still rides the hier code path — the
     # world-1 parity gates prove that path bit-identical to flat
@@ -696,6 +782,8 @@ def psum_pytree(diff: Any, compress: Any = False,
                       chunk_mb=round(chunk_bytes / 2**20, 2),
                       overlap_ms_saved=0.0, dispatch_gate_ms=0.0,
                       quant=mode,
+                      guard=guard, finite_ok=True,
+                      crc_mismatch_chunks=0, nonfinite_chunks=0,
                       topo=topo.signature if hier else "flat")
     if not leaves:
         return diff
@@ -778,7 +866,7 @@ def psum_pytree(diff: Any, compress: Any = False,
             treedef, mesh, n, me, sharding, hier, topo, chunk_bytes,
             block, mode, prefer_device, feedback, phases,
             _chunk_elems, nbytes, big_bytes, small_bytes,
-            t_ship, t_reduce, t_readback, t_cast)
+            t_ship, t_reduce, t_readback, t_cast, guard)
     finally:
         gate.release()
 
@@ -788,7 +876,8 @@ def _reduce_under_gate(gate, gate_wait, metas, small_idx, big_idx,
                        hier, topo, chunk_bytes, block, mode,
                        prefer_device, feedback, phases, _chunk_elems,
                        nbytes, big_bytes, small_bytes,
-                       t_ship, t_reduce, t_readback, t_cast):
+                       t_ship, t_reduce, t_readback, t_cast,
+                       guard="off"):
     """The collective body of one round, entered with the dispatch gate
     held (see psum_pytree). Split out so the gate's safety-net release
     wraps every exit path without re-indenting the stream logic."""
@@ -797,6 +886,45 @@ def _reduce_under_gate(gate, gate_wait, metas, small_idx, big_idx,
         sharding2 = NamedSharding(mesh2, _SPEC2)
         my_devs = [d for row in topo.grid for d in row
                    if d.process_index == me.process_index]
+
+    # integrity state (ISSUE 15): per-round CRC/finite tallies, plus
+    # the deferred on-device finite flags (one readback at round end)
+    integ = {"crc": 0, "nonfinite": 0}
+    finite_flags: List[Any] = []
+
+    def _screen_total(arr, on_device: bool) -> None:
+        """Queue (device) or run (host) the finite screen of one
+        reduced total; tallies fold in _finite_verdict."""
+        if guard == "off":
+            return
+        if on_device:
+            f = _finite_flag(arr)
+            if f is not None:
+                finite_flags.append(f)
+        elif np.issubdtype(np.dtype(arr.dtype), np.floating) and \
+                not np.isfinite(arr).all():
+            integ["nonfinite"] += 1
+
+    def _finite_verdict() -> None:
+        """Fold the deferred device flags (one blocking readback for
+        the whole round), stamp the phases, and — in quarantine mode —
+        refuse a poisoned round before anything consumes it (and, for
+        int8, before the error-feedback residuals commit)."""
+        if guard == "off":
+            return
+        if finite_flags:
+            integ["nonfinite"] += sum(
+                0 if bool(f) else 1 for f in finite_flags)
+            finite_flags.clear()
+        if phases is not None:
+            phases.update(crc_mismatch_chunks=integ["crc"],
+                          nonfinite_chunks=integ["nonfinite"],
+                          finite_ok=not (integ["crc"]
+                                         or integ["nonfinite"]))
+        if guard == "quarantine" and integ["nonfinite"]:
+            raise ChunkIntegrityError(
+                "nonfinite", f"{integ['nonfinite']} reduced chunk(s) "
+                "carry NaN/Inf")
 
     # -- small leaves: one batched collective (the pre-pipeline shape) --
     if small_idx:
@@ -825,6 +953,7 @@ def _reduce_under_gate(gate, gate_wait, metas, small_idx, big_idx,
         for i, tot in zip(small_idx, total):
             local = tot.addressable_shards[0].data
             out[i] = local if prefer_device else np.asarray(local)
+            _screen_total(out[i], on_device=prefer_device)
         t3 = time.perf_counter()
         t_ship += t1 - t0
         t_reduce += t2 - t1
@@ -833,6 +962,7 @@ def _reduce_under_gate(gate, gate_wait, metas, small_idx, big_idx,
         # small-only round: every collective completed above — the next
         # round may dispatch while we assemble/return
         gate.release()
+        _finite_verdict()
 
     # -- big leaves: chunked double-buffered stream ---------------------
     n_chunks = 0
@@ -897,13 +1027,26 @@ def _reduce_under_gate(gate, gate_wait, metas, small_idx, big_idx,
             chunk = flat[start:stop]
             pad = celems - (stop - start)
             if isinstance(flat, jax.Array):
+                # device-resident leaf: zero host staging, so there is
+                # no host window to checksum — the runtime owns the
+                # buffer end to end; the contribution's finite screen
+                # runs ON DEVICE instead (deferred flag, one readback
+                # per round)
                 if pad:
                     chunk = jnp.concatenate(
                         [chunk, jnp.zeros(pad, chunk.dtype)])
+                if guard != "off":
+                    f = _finite_flag(chunk)
+                    if f is not None:
+                        finite_flags.append(f)
             else:
                 if pad:
                     chunk = np.concatenate(
                         [chunk, np.zeros(pad, chunk.dtype)])
+                if guard != "off":
+                    # CRC-bracketed staging + the mix.wire.corrupt
+                    # chaos window (ISSUE 15)
+                    chunk = _crc_stage(chunk, integ, guard)
             if hier:
                 # the wire prep (bf16 cast / int8 quantization) happens
                 # INSIDE the collective, after the exact intra-host
@@ -1021,6 +1164,7 @@ def _reduce_under_gate(gate, gate_wait, metas, small_idx, big_idx,
             i, start, stop = entry
             if prefer_device:
                 local = reduced.addressable_shards[0].data
+                _screen_total(local, on_device=True)
                 chunks_out[i].append(
                     local[: stop - start] if stop - start != local.shape[0]
                     else local)
@@ -1028,6 +1172,7 @@ def _reduce_under_gate(gate, gate_wait, metas, small_idx, big_idx,
                 # fully replicated → np.asarray is legal and reuses the
                 # copy_to_host_async started right after dispatch
                 host = np.asarray(reduced)
+                _screen_total(host, on_device=False)
                 chunks_out[i].append(host[: stop - start])
 
         # chunk 0 runs serially with explicit barriers: the block after
@@ -1121,6 +1266,11 @@ def _reduce_under_gate(gate, gate_wait, metas, small_idx, big_idx,
         # with the main thread's ship/reduce stream (clamped at 0 for
         # the degenerate no-pipelined-chunks case)
         overlap_saved = max(0.0, state["blocked"] - t_join)
+
+        # integrity verdict BEFORE the residual commit: a poisoned
+        # round must leave the EF state of the last good round intact
+        # (quarantine raises here; warn stamps and proceeds)
+        _finite_verdict()
 
         # the whole stream completed: NOW the carried residuals advance
         # (an exception above leaves the last successful round's state)

@@ -103,6 +103,27 @@ class ServerArgs:
     #: bound). The async plane's correctness governor: a straggler
     #: degrades its own contribution instead of stalling the fleet.
     mix_staleness_bound: int = 8
+    #: --mix-guard: model-integrity admission guard
+    #: (framework/model_guard.py, ISSUE 15). ``off`` = no screening;
+    #: ``warn`` (default) = screen every contribution for non-finite
+    #: leaves and update-norm outliers, count + emit, fold anyway;
+    #: ``quarantine`` = drop flagged contributions from the fold,
+    #: refuse non-finite folded totals (auto-rollback to the last-good
+    #: snapshot), and trip a per-member quarantine breaker on repeat
+    #: offenders (released after K clean rounds). The collective path
+    #: additionally CRC32-checks every staged wire chunk and finite-
+    #: screens reduced totals under any non-off mode.
+    mix_guard: str = "warn"
+    #: --mix-norm-bound: norm-outlier multiplier — a contribution whose
+    #: update norm exceeds this multiple of its PEERS' median norm is
+    #: flagged (leave-one-out median; a quiet fleet judges nothing)
+    mix_norm_bound: float = 10.0
+    #: --model-snapshot-interval: seconds between in-process model
+    #: snapshots into the rollback ring (save_load envelope + CRC32,
+    #: bounded depth). 0 = off. The snapshots are what
+    #: ``jubactl -c rollback`` and the non-finite-total auto-rollback
+    #: restore.
+    model_snapshot_interval: float = 0.0
     #: --fault (repeatable): arm a fault-injection rule at boot
     #: (utils/faults.py; SITE:MODE[:ARG], MODE in {error,delay,drop}) —
     #: the chaos lever for drills and the straggler/partition tests.
@@ -335,6 +356,33 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "dropped from the async fold (its weight "
                         "decays 2**-staleness up to the bound); the "
                         "async plane's correctness governor")
+    p.add_argument("--mix-guard", default="warn",
+                   choices=["off", "warn", "quarantine"],
+                   help="model-integrity admission guard: screen every "
+                        "mix contribution for non-finite leaves and "
+                        "update-norm outliers before it enters a fold "
+                        "(and every folded total before it applies). "
+                        "off = no screening; warn = count + emit, fold "
+                        "anyway; quarantine = drop flagged "
+                        "contributions, refuse non-finite totals with "
+                        "auto-rollback to the last-good snapshot, and "
+                        "exclude repeat offenders until they screen "
+                        "clean. The collective path also CRC32-checks "
+                        "staged wire chunks under any non-off mode")
+    p.add_argument("--mix-norm-bound", type=float, default=10.0,
+                   help="norm-outlier multiplier for the mix guard: a "
+                        "contribution whose update norm exceeds this "
+                        "multiple of its peers' median norm this round "
+                        "is flagged (leave-one-out median — robust "
+                        "from 2 contributors up; a quiet fleet judges "
+                        "nothing)")
+    p.add_argument("--model-snapshot-interval", type=float, default=0.0,
+                   help="seconds between in-process model snapshots "
+                        "into the bounded rollback ring (save_load "
+                        "envelope format, CRC32-validated on restore); "
+                        "0 disables. The ring is what jubactl -c "
+                        "rollback and the guard's non-finite-total "
+                        "auto-rollback restore")
     p.add_argument("--fault", action="append", default=None,
                    metavar="SITE:MODE[:ARG]",
                    help="arm a fault-injection rule at boot "
@@ -504,6 +552,10 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
             raise SystemExit(str(e))
     if args.mix_staleness_bound < 0:
         raise SystemExit("--mix-staleness-bound must be >= 0")
+    if args.mix_norm_bound <= 0:
+        raise SystemExit("--mix-norm-bound must be > 0")
+    if args.model_snapshot_interval < 0:
+        raise SystemExit("--model-snapshot-interval must be >= 0")
     if args.mix_async and args.mixer != "linear_mixer":
         raise SystemExit(
             "--mix-async requires -x linear_mixer (push mixers are "

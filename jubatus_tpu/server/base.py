@@ -168,6 +168,21 @@ class EngineServer:
         self.drain_ctl = DrainController(
             self, grace_sec=getattr(self.args, "drain_grace", 1.0))
         self._epoch_cache: Optional[int] = None
+        # model-integrity plane (ISSUE 15): bounded ring of periodic
+        # in-process model snapshots (save_load envelope + CRC32) —
+        # the "last good" that jubactl -c rollback and the guard's
+        # non-finite-total auto-rollback restore. Ticked by the same
+        # telemetry thread that owns all periodic observability work.
+        from jubatus_tpu.framework.model_guard import ModelSnapshotRing
+
+        self.snapshots = ModelSnapshotRing()
+        self._snapshot_interval = getattr(
+            self.args, "model_snapshot_interval", 0.0)
+        self._last_snapshot = 0.0
+        self.rollbacks = 0
+        self._last_rollback_ts = 0.0
+        if self._snapshot_interval > 0:
+            self.telemetry.hooks.append(self._model_snapshot_tick)
         #: Prometheus /metrics + /healthz endpoint (--metrics-port >= 0)
         self.metrics = None
         #: pooled peer clients for server-side replicated writes
@@ -202,8 +217,15 @@ class EngineServer:
                 mix_async=getattr(self.args, "mix_async", False),
                 mix_staleness_bound=getattr(
                     self.args, "mix_staleness_bound", 8),
+                mix_guard=getattr(self.args, "mix_guard", "warn"),
+                mix_norm_bound=getattr(
+                    self.args, "mix_norm_bound", 10.0),
             )
             self.mixer.set_trace_registry(self.rpc.trace)
+            # model-integrity plane (ISSUE 15): a put_diff refusing a
+            # non-finite folded total auto-rolls back to last-good
+            if hasattr(self.mixer, "on_poisoned_total"):
+                self.mixer.on_poisoned_total = self._auto_rollback
             # cluster-unique id minting for the engines that mint ids
             # (≙ global_id_generator_zk: anomaly add, graph create_node/edge)
             if hasattr(self.driver, "set_id_generator"):
@@ -442,6 +464,74 @@ class EngineServer:
                          out["rows"], out["bytes"] / 2 ** 20, out["seconds"])
         except Exception:  # broad-ok — join must not die on migration
             log.warning("join migration failed", exc_info=True)
+
+    # -- model-integrity plane: snapshots + rollback (ISSUE 15) --------------
+    def _model_snapshot_tick(self) -> None:
+        """One telemetry tick: take a model snapshot into the rollback
+        ring when the interval elapsed (the first tick seeds the
+        baseline — a poisoning incident in the first minutes of a
+        process's life still has a last-good to return to)."""
+        now = time.monotonic()
+        if self._last_snapshot and \
+                now - self._last_snapshot < self._snapshot_interval:
+            return
+        try:
+            self.take_snapshot()
+        except Exception:  # broad-ok — a failed snapshot must not kill
+            log.warning("model snapshot failed", exc_info=True)  # the tick
+
+    def take_snapshot(self) -> Dict[str, Any]:
+        """Capture one in-process model snapshot (CRC-stamped save_load
+        envelope) into the bounded rollback ring."""
+        version = getattr(self.mixer, "model_version", 0) \
+            if self.mixer is not None else 0
+        with self.driver.lock:
+            entry = self.snapshots.snapshot(self.driver, version)
+        self._last_snapshot = time.monotonic()
+        self.rpc.trace.gauge("mix.snapshots",
+                             float(self.snapshots.stats()["count"]))
+        return {k: v for k, v in entry.items() if k != "blob"}
+
+    def rollback(self, _name: str = "", reason: str = "") -> Dict[str, Any]:
+        """Restore the newest last-good snapshot into the live model
+        (``jubactl -c rollback --target`` / the guard's auto-rollback).
+        The restore revalidates the envelope CRC; the mixer's model
+        version rebases to the snapshot's — in a healthy cluster the
+        next round's version gate then pulls this node forward again,
+        while in a poisoning incident (every guarded member refused the
+        same total) the fleet stays consistently on last-good."""
+        reason = reason.decode() if isinstance(reason, bytes) \
+            else str(reason or "operator")
+        entry = self.snapshots.latest()
+        if entry is None:
+            return {"rolled_back": False,
+                    "error": "no model snapshot retained "
+                             "(--model-snapshot-interval off?)"}
+        with self.driver.lock:
+            version = self.snapshots.restore(self.driver)
+        if self.mixer is not None and \
+                hasattr(self.mixer, "model_version"):
+            self.mixer.model_version = version
+        self.rollbacks += 1
+        self._last_rollback_ts = time.monotonic()
+        self.rpc.trace.count("mix.rollbacks")
+        self.rpc.trace.events.emit(
+            "mix", "rollback", severity="error", reason=reason,
+            model_version=version)
+        # a rollback is a forensics moment: bundle the window around it
+        self.incidents.trigger(f"rollback:{reason}")
+        log.error("model rolled back to snapshot v%d (%s)", version,
+                  reason)
+        return {"rolled_back": True, "model_version": version,
+                "snapshot_ts": entry["ts"], "reason": reason,
+                "snapshots": self.snapshots.stats()}
+
+    def _auto_rollback(self) -> None:
+        """Wired as the mixer's on_poisoned_total callback: put_diff
+        refused a non-finite folded total — return to last-good."""
+        out = self.rollback(self.args.name, reason="nonfinite_total")
+        if not out.get("rolled_back"):
+            log.error("auto-rollback unavailable: %s", out.get("error"))
 
     # -- built-in RPCs (server_base.hpp:41-109, client.hpp:30-87) ------------
     def get_config(self, _name: str = "") -> str:
@@ -733,6 +823,22 @@ class EngineServer:
                 reasons.append({"kind": "mix_async_lagging",
                                 "lag_rounds": lag,
                                 "staleness_bound": bound})
+            # model-integrity plane (ISSUE 15): peers behind the
+            # quarantine breaker mean part of the fleet's training is
+            # being excluded from folds — an operator should look
+            guard = getattr(m, "guard", None)
+            if guard is not None and guard.enabled:
+                q = guard.quarantined()
+                if q:
+                    reasons.append({"kind": "mix_member_quarantined",
+                                    "members": sorted(q)})
+        if self.rollbacks and \
+                time.monotonic() - self._last_rollback_ts < 600.0:
+            # recent rollback (10 min window): the model moved backwards
+            # — visible on /healthz while the incident is fresh, then
+            # clears (the counter stays in get_status forever)
+            reasons.append({"kind": "model_rolled_back",
+                            "count": self.rollbacks})
         if self.drain_ctl.state != "active":
             reasons.append({"kind": "draining",
                             "state": self.drain_ctl.state})
@@ -775,6 +881,10 @@ class EngineServer:
         # incident bundles (ISSUE 14): how many forensic snapshots this
         # process has auto-captured (the dir is in get_incidents)
         doc["incidents_captured"] = self.incidents.stats()["captured"]
+        # model-integrity plane (ISSUE 15): one glance says whether a
+        # last-good exists and whether this model ever rolled back
+        doc["model_snapshots"] = self.snapshots.stats()["count"]
+        doc["model_rollbacks"] = self.rollbacks
         # runtime telemetry summary (full key set lives in get_status)
         rt = self.telemetry.status()
         for k in ("rss_bytes", "open_fds", "threads",
@@ -852,6 +962,11 @@ class EngineServer:
         if self.slo is not None:
             st["slo.configured"] = len(self.slo.specs)
             st["slo.firing"] = len(self.slo.alerts())
+        # model-integrity plane (ISSUE 15): snapshot ring + rollbacks
+        # (guard state rides mixer.guard_* via the mixer's get_status)
+        st.update({f"snapshot.{k}": v
+                   for k, v in self.snapshots.stats().items()})
+        st["rollback.count"] = self.rollbacks
         # event plane + incident bundles (ISSUE 14)
         st.update({f"events.{k}": v
                    for k, v in self.rpc.trace.events.stats().items()})

@@ -91,6 +91,8 @@ def bind_engine(rpc: RpcServer, server: Any) -> None:
                  binary=True)
     rpc.register("put_rows", server.put_rows, arity=2, binary=True)
     rpc.register("get_row_count", server.get_row_count, arity=1)
+    # model-integrity plane (ISSUE 15): restore the last-good snapshot
+    rpc.register("rollback", server.rollback, arity=2)
     _BINDERS[server.engine](rpc, server)
 
 

@@ -21,6 +21,14 @@ repeated ``--fault`` server flag):
                                  message instead of erroring; sites
                                  that don't check the return value
                                  ignore drops by construction)
+    <site-glob>:nan              poison: patch one element of a random
+                                 float diff leaf to NaN (mutation-aware
+                                 sites only — fire_mutate callers)
+    <site-glob>:scale:<F>        poison: multiply every float leaf of a
+                                 contribution by F (a runaway learner's
+                                 norm-exploded diff)
+    <site-glob>:bitflip          corruption: flip one byte of a staged
+                                 wire chunk (mutation-aware sites only)
     <site-glob>:error@<n>        ... only for the first n firings
 
 Sites are dotted names matched with fnmatch, e.g. ``rpc.call.get_diff``,
@@ -28,8 +36,14 @@ Sites are dotted names matched with fnmatch, e.g. ``rpc.call.get_diff``,
 ``mix.async.submit.<node>``, ``migration.pull``, and the autoscaler's
 actuation sites ``autoscale.spawn`` / ``autoscale.drain`` (a fired
 error there must surface as a ``blocked`` journal record with
-exponential backoff, never a hot-loop — coord/autoscaler.py). ``fire``
-is a no-op
+exponential backoff, never a hot-loop — coord/autoscaler.py). The
+model-integrity plane (ISSUE 15) adds two MUTATION-aware sites:
+``mix.diff.poison`` (the member's diff snapshot, as it leaves the
+model lock — ``nan``/``scale:F`` model a sick replica) and
+``mix.wire.corrupt`` (each staged collective wire chunk — ``bitflip``
+models transport corruption the chunk CRC must catch). Mutation rules
+fire only through ``fire_mutate``; plain ``fire`` sites ignore them by
+construction. ``fire`` is a no-op
 (one dict lookup on a module flag) when nothing is armed — safe on hot
 paths.
 
@@ -48,7 +62,12 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 __all__ = ["FaultInjected", "arm", "disarm", "disarm_all", "armed", "fire",
-           "is_armed", "stats"]
+           "fire_mutate", "poison_tree", "flip_byte", "is_armed", "stats"]
+
+#: actions that MUTATE data instead of dropping/raising: returned by
+#: fire_mutate for the caller to apply (poison_tree / flip_byte), and
+#: invisible to plain fire() sites by construction
+MUTATE_ACTIONS = ("nan", "scale", "bitflip")
 
 
 class FaultInjected(RuntimeError):
@@ -82,13 +101,14 @@ def parse_rule(text: str) -> _Rule:
     parts = text.strip().split(":")
     action_idx = None
     for i in range(len(parts) - 1, -1, -1):
-        if parts[i].split("@", 1)[0] in ("error", "delay", "drop"):
+        if parts[i].split("@", 1)[0] in ("error", "delay", "drop",
+                                         "nan", "scale", "bitflip"):
             action_idx = i
             break
     if action_idx is None or action_idx == 0:
         raise ValueError(
             f"bad fault rule {text!r} (want site:action[:arg], action in "
-            "{error, delay, drop})")
+            "{error, delay, drop, nan, scale, bitflip})")
     pattern = ":".join(parts[:action_idx])
     action = parts[action_idx]
     extra = parts[action_idx + 1:]
@@ -101,6 +121,10 @@ def parse_rule(text: str) -> _Rule:
     if action == "delay":
         if not extra:
             raise ValueError(f"delay rule needs seconds: {text!r}")
+        arg = float(extra[0])
+    elif action == "scale":
+        if not extra:
+            raise ValueError(f"scale rule needs a factor: {text!r}")
         arg = float(extra[0])
     elif extra:  # error with probability
         prob = float(extra[0])
@@ -162,19 +186,20 @@ def armed(*rule_texts: str):
         disarm(mine)
 
 
-def fire(site: str) -> bool:
-    """Injection point. No-op unless rules are armed. Returns True when
-    a ``drop`` rule matched — drop-aware sites silently discard the
-    operation; everyone else ignores the return value (a drop then has
-    no effect, by design)."""
-    if not _armed:
-        return False
+def _fire(site: str, mutate: bool):
+    """Shared firing core: sleeps delays, raises errors, and returns
+    (dropped, mutation) where mutation is the strongest matched
+    ``(action, arg)`` mutation pair (only when ``mutate`` — plain
+    fire() sites never consume or observe mutation rules)."""
     delay = 0.0
     boom = False
     dropped = False
+    mutation: Optional[tuple] = None
     with _lock:
         for r in _rules:
             if r.remaining is not None and r.remaining <= 0:
+                continue
+            if r.action in MUTATE_ACTIONS and not mutate:
                 continue
             if not fnmatch.fnmatch(site, r.pattern):
                 continue
@@ -188,21 +213,89 @@ def fire(site: str) -> bool:
                 delay = max(delay, r.arg)
             elif r.action == "drop":
                 dropped = True
+            elif r.action in MUTATE_ACTIONS:
+                if mutation is None:
+                    mutation = (r.action, r.arg)
             else:
                 boom = True
-    if delay or boom or dropped:
+    if delay or boom or dropped or mutation:
         # a fault actually FIRING is a timeline event (emitted outside
         # the rule lock; the no-rule fast path above never reaches here)
         from jubatus_tpu.utils import events
 
         events.emit("faults", "fired", severity="warning", site=site,
                     action=("error" if boom else
-                            "drop" if dropped else "delay"))
+                            "drop" if dropped else
+                            mutation[0] if mutation else "delay"))
     if delay:
         time.sleep(delay)
     if boom:
         raise FaultInjected(f"injected fault at {site}")
+    return dropped, mutation
+
+
+def fire(site: str) -> bool:
+    """Injection point. No-op unless rules are armed. Returns True when
+    a ``drop`` rule matched — drop-aware sites silently discard the
+    operation; everyone else ignores the return value (a drop then has
+    no effect, by design). Mutation rules (nan/scale/bitflip) never
+    match here — only ``fire_mutate`` sites apply them."""
+    if not _armed:
+        return False
+    dropped, _ = _fire(site, mutate=False)
     return dropped
+
+
+def fire_mutate(site: str) -> Optional[tuple]:
+    """Mutation-aware injection point (the model-integrity chaos sites):
+    error/delay rules behave as at any site, and the strongest matched
+    mutation rule is returned as ``(action, arg)`` for the caller to
+    apply with ``poison_tree`` / ``flip_byte``. None = leave the data
+    alone."""
+    if not _armed:
+        return None
+    _, mutation = _fire(site, mutate=True)
+    return mutation
+
+
+def poison_tree(diffs, mutation: tuple):
+    """Apply a ``nan``/``scale:F`` mutation to a materialized (host
+    numpy) diff payload — the ``mix.diff.poison`` drill. ``nan``
+    patches ONE element of the first float leaf encountered (a single
+    bad datum's footprint); ``scale`` multiplies every float leaf by F
+    (a runaway learner). Leaves are copied — the caller's model state
+    is never touched, only the outgoing snapshot."""
+    import jax
+    import numpy as np
+
+    action, arg = mutation
+    state = {"done": False}
+
+    def mutate(x):
+        if not isinstance(x, np.ndarray) or \
+                not np.issubdtype(x.dtype, np.floating) or x.size == 0:
+            return x
+        if action == "scale":
+            return x * np.asarray(arg, dtype=x.dtype)
+        if state["done"]:
+            return x
+        state["done"] = True
+        y = x.copy()
+        y.reshape(-1)[_rng.randrange(x.size)] = np.nan
+        return y
+
+    return jax.tree_util.tree_map(mutate, diffs)
+
+
+def flip_byte(buf: bytes) -> bytes:
+    """One-byte corruption of a staged wire chunk (the ``bitflip``
+    drill): returns a copy with a single bit flipped at a seeded-random
+    offset."""
+    if not buf:
+        return buf
+    out = bytearray(buf)
+    out[_rng.randrange(len(out))] ^= 0x40
+    return bytes(out)
 
 
 def stats() -> Dict[str, int]:

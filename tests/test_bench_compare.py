@@ -127,6 +127,40 @@ def test_autoscale_keys_gate_in_compare(tmp_path):
     assert len(regs) == 3
 
 
+def test_direction_inference_poison_keys():
+    """ISSUE 15 model-integrity plane: the poison drill arms a KNOWN
+    poisoner, so quarantined counts gate up-good (falling means the
+    guard stopped catching it), drift vs the clean twin and rollback
+    recovery wall time gate down-good, the load-bearing verdicts are
+    boolean gates."""
+    assert bc.direction("e2e_poison_quarantined_total") == "higher"
+    assert bc.direction("e2e_poison_nan_quarantined") == "higher"
+    assert bc.direction("e2e_poison_drift_vs_clean") == "lower"
+    assert bc.direction("e2e_rollback_recovery_s") == "lower"
+    assert bc.direction("e2e_poison_guard_load_bearing_ok") == "bool"
+    assert bc.direction("e2e_poison_zero_nonfinite_applied_ok") == "bool"
+    # neighbors that must NOT accidentally gate
+    assert bc.direction("e2e_poison_unguarded_corrupted") is None
+
+
+def test_poison_keys_gate_in_compare():
+    old = {"e2e_poison_quarantined_total": 12,
+           "e2e_poison_drift_vs_clean": 0.0001,
+           "e2e_rollback_recovery_s": 0.1,
+           "e2e_poison_guard_load_bearing_ok": True}
+    new = {"e2e_poison_quarantined_total": 4,     # guard missing: regression
+           "e2e_poison_drift_vs_clean": 0.02,     # drifted: regression
+           "e2e_rollback_recovery_s": 0.08,       # improved
+           "e2e_poison_guard_load_bearing_ok": False}  # gate flip
+    rows, regs = bc.compare(bc.flatten(old), bc.flatten(new))
+    verdicts = {r["key"]: r["verdict"] for r in rows}
+    assert verdicts["e2e_poison_quarantined_total"] == "REGRESSED"
+    assert verdicts["e2e_poison_drift_vs_clean"] == "REGRESSED"
+    assert verdicts["e2e_rollback_recovery_s"] == "improved"
+    assert verdicts["e2e_poison_guard_load_bearing_ok"] == "REGRESSED"
+    assert len(regs) == 3
+
+
 def test_direction_inference_scaling_keys():
     """ISSUE 9 scaling plane: wire bytes per HOST gate down-good (the
     hierarchical reduce's whole claim), the reduction factor up-good —

@@ -1,0 +1,801 @@
+"""Model-integrity plane tests (ISSUE 15): finite/norm screens on
+synthetic diffs, the quarantine breaker's trip + K-clean release, async
+inbox admission, collective chunk CRC mismatch -> RPC fallback, the
+rollback ring's bounds + CRC validation + auto-rollback on a non-finite
+folded total, envelope compat on both transports, ingest hardening at
+fv convert time, the codestyle guard-coverage gate, and a live 3-member
+acceptance drill with an armed poisoner."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from jubatus_tpu.framework.model_guard import (
+    DEFAULT_QUARANTINE_AFTER,
+    DEFAULT_RELEASE_AFTER,
+    MixGuard,
+    ModelSnapshotRing,
+    norm_outliers,
+    payload_nonfinite,
+    payload_norm,
+)
+from jubatus_tpu.utils import faults
+
+CONF = {
+    "method": "PA",
+    "parameter": {"regularization_weight": 1.0},
+    "converter": {"num_rules": [{"key": "*", "type": "num"}]},
+}
+
+NAMES = ["w"]
+GOOD = {"w": np.ones(8, np.float32)}
+NAN = {"w": np.array([1.0, np.nan, 2.0], np.float32)}
+INF = {"w": np.array([np.inf, 0.0], np.float32)}
+BIG = {"w": np.ones(8, np.float32) * 1e6}
+
+
+# -- pure units ---------------------------------------------------------------
+
+
+def test_finite_screen_on_synthetic_diffs():
+    assert not payload_nonfinite(GOOD, NAMES)
+    assert payload_nonfinite(NAN, NAMES)
+    assert payload_nonfinite(INF, NAMES)
+    # only the named (summable) mixables are screened
+    assert not payload_nonfinite({"other": NAN["w"]}, NAMES)
+    # int leaves cannot carry NaN and must not break the screen
+    assert not payload_nonfinite({"w": np.array([1, 2], np.int32)}, NAMES)
+    # nested trees screen leaf-wise
+    assert payload_nonfinite({"w": {"a": GOOD["w"], "b": NAN["w"]}}, NAMES)
+
+
+def test_norm_screen_leave_one_out_median():
+    assert payload_norm(GOOD, NAMES) == pytest.approx(np.sqrt(8.0))
+    # 1e6-scaled member is judged against its PEERS, not a median it
+    # dominates — robust at N=2
+    out = norm_outliers({"a": 1.0, "b": 1e6}, 10.0)
+    assert set(out) == {"b"}
+    out = norm_outliers({"a": 1.0, "b": 1.1, "c": 1e6}, 10.0)
+    assert set(out) == {"c"}
+    # a quiet fleet (peer median 0) judges nothing
+    assert norm_outliers({"a": 5.0, "b": 0.0}, 10.0) == {}
+    # single contributor: no distribution, no verdict
+    assert norm_outliers({"a": 1e9}, 10.0) == {}
+    # bound <= 0 disables the screen
+    assert norm_outliers({"a": 1.0, "b": 1e6}, 0.0) == {}
+
+
+def test_guard_mode_ladder():
+    off = MixGuard(mode="off")
+    rep = off.screen({"a": GOOD, "b": NAN}, NAMES)
+    assert set(rep.admitted) == {"a", "b"} and not rep.flagged
+
+    warn = MixGuard(mode="warn")
+    rep = warn.screen({"a": GOOD, "b": NAN}, NAMES)
+    assert set(rep.admitted) == {"a", "b"}  # flags, folds anyway
+    assert rep.flagged == {"b": "nonfinite"}
+
+    q = MixGuard(mode="quarantine", norm_bound=4.0)
+    rep = q.screen({"a": GOOD, "b": NAN, "c": BIG}, NAMES)
+    assert set(rep.admitted) == {"a"}
+    assert rep.flagged == {"b": "nonfinite", "c": "norm_outlier"}
+    with pytest.raises(ValueError):
+        MixGuard(mode="nonsense")
+
+
+def test_quarantine_breaker_trip_and_k_clean_release():
+    g = MixGuard(mode="quarantine", quarantine_after=2, release_after=3)
+    # first offense: rejected but not yet behind the breaker
+    rep = g.screen({"a": GOOD, "b": NAN}, NAMES)
+    assert rep.flagged == {"b": "nonfinite"} and not rep.quarantined_now
+    assert not g.is_quarantined("b")
+    # second consecutive offense trips it
+    rep = g.screen({"a": GOOD, "b": NAN}, NAMES)
+    assert rep.quarantined_now == ["b"] and g.is_quarantined("b")
+    # now clean payloads still stay OUT of the fold until K clean rounds
+    for i in range(2):
+        rep = g.screen({"a": GOOD, "b": GOOD}, NAMES)
+        assert rep.flagged == {"b": "quarantined"}
+        assert set(rep.admitted) == {"a"} and not rep.released
+    # third clean round releases and re-admits
+    rep = g.screen({"a": GOOD, "b": GOOD}, NAMES)
+    assert rep.released == ["b"] and set(rep.admitted) == {"a", "b"}
+    assert not g.is_quarantined("b")
+    # a clean round between offenses resets the streak (no trip)
+    g2 = MixGuard(mode="quarantine", quarantine_after=2)
+    g2.screen({"a": GOOD, "b": NAN}, NAMES)
+    g2.screen({"a": GOOD, "b": GOOD}, NAMES)
+    rep = g2.screen({"a": GOOD, "b": NAN}, NAMES)
+    assert not rep.quarantined_now and not g2.is_quarantined("b")
+    assert DEFAULT_QUARANTINE_AFTER >= 2 and DEFAULT_RELEASE_AFTER >= 1
+
+
+def test_screen_payload_inbox_semantics():
+    g = MixGuard(mode="quarantine", quarantine_after=2, release_after=2)
+    assert g.screen_payload("m", GOOD, NAMES) is None
+    assert g.screen_payload("m", NAN, NAMES) == "nonfinite"
+    assert g.screen_payload("m", NAN, NAMES) == "nonfinite"  # trips
+    assert g.is_quarantined("m")
+    # clean submissions count toward release even while refused
+    assert g.screen_payload("m", GOOD, NAMES) == "quarantined"
+    assert g.screen_payload("m", GOOD, NAMES) is None  # released
+    assert not g.is_quarantined("m")
+    # warn mode flags but never rejects / trips
+    w = MixGuard(mode="warn", quarantine_after=1)
+    assert w.screen_payload("m", NAN, NAMES) == "nonfinite"
+    assert not w.is_quarantined("m")
+    # off mode screens nothing
+    assert MixGuard().screen_payload("m", NAN, NAMES) is None
+
+
+def test_fault_mutation_modes():
+    r = faults.parse_rule("mix.diff.poison*:nan")
+    assert r.action == "nan"
+    r = faults.parse_rule("mix.diff.poison*:scale:1e6")
+    assert r.action == "scale" and r.arg == 1e6
+    assert faults.parse_rule("mix.wire.corrupt:bitflip").action == "bitflip"
+    with pytest.raises(ValueError):
+        faults.parse_rule("site:scale")  # needs a factor
+    with pytest.raises(ValueError):
+        faults.parse_rule("site:frobnicate")
+    with faults.armed("x.y:nan"):
+        assert faults.fire("x.y") is False  # plain sites ignore mutations
+        assert faults.fire_mutate("x.y") == ("nan", 0.0)
+    assert faults.fire_mutate("x.y") is None  # disarmed
+    # nan patches exactly ONE element of one float leaf (copies, never
+    # the caller's array); ints are untouched
+    tree = {"w": np.ones(16, np.float32), "n": np.array([3], np.int64)}
+    out = faults.poison_tree(tree, ("nan", 0.0))
+    assert int(np.isnan(out["w"]).sum()) == 1
+    assert not np.isnan(tree["w"]).any()
+    assert out["n"] is tree["n"]
+    # scale multiplies every float leaf
+    out = faults.poison_tree(tree, ("scale", 1e6))
+    assert float(out["w"][0]) == 1e6
+    # bitflip changes exactly the buffer, not its length
+    flipped = faults.flip_byte(b"abcdef")
+    assert len(flipped) == 6 and flipped != b"abcdef"
+
+
+def test_snapshot_ring_bounds_and_crc():
+    class FakeDriver:
+        TYPE = "classifier"
+        USER_DATA_VERSION = 1
+
+        def __init__(self):
+            self.state = {"w": [1.0, 2.0]}
+
+        def pack(self):
+            return dict(self.state)
+
+        def unpack(self, data):
+            self.state = dict(data)
+
+    d = FakeDriver()
+    ring = ModelSnapshotRing(capacity=3)
+    assert ring.latest() is None
+    with pytest.raises(RuntimeError):
+        ring.restore(d)
+    for v in range(5):
+        d.state["w"] = [float(v)]
+        ring.snapshot(d, model_version=v)
+    # bounded: oldest two rotated out
+    assert ring.stats()["count"] == 3 and ring.stats()["taken"] == 5
+    assert [e["model_version"] for e in ring.list()] == [2, 3, 4]
+    # restore newest, CRC-validated
+    d.state["w"] = [999.0]
+    assert ring.restore(d) == 4
+    assert d.state["w"] == [4.0]
+    assert ring.stats()["restored"] == 1
+    # a rotted snapshot refuses to apply (envelope CRC catches it)
+    from jubatus_tpu.framework.save_load import SaveLoadError
+
+    entry = ring.latest()
+    blob = bytearray(entry["blob"])
+    blob[60] ^= 0xFF
+    entry["blob"] = bytes(blob)
+    with pytest.raises(SaveLoadError):
+        ring.restore(d, entry)
+
+
+def test_pack_envelope_matches_file_format(tmp_path):
+    from jubatus_tpu.framework.save_load import (pack_envelope,
+                                                 read_envelope,
+                                                 write_envelope)
+
+    blob = pack_envelope(b"sys", b"user")
+    s, u = read_envelope(blob, "mem")
+    assert s == b"sys" and u == b"user"
+    path = str(tmp_path / "m.jubatus")
+    write_envelope(path, b"sys", b"user")
+    with open(path, "rb") as f:
+        assert f.read() == blob
+
+
+def test_server_args_guard_flags():
+    from jubatus_tpu.server.args import parse_server_args
+
+    args = parse_server_args(
+        ["classifier", "-f", "/dev/null", "--mix-guard", "quarantine",
+         "--mix-norm-bound", "6.5", "--model-snapshot-interval", "30",
+         "--fault", "mix.diff.poison*:nan",
+         "--fault", "mix.wire.corrupt:bitflip"])
+    assert args.mix_guard == "quarantine"
+    assert args.mix_norm_bound == 6.5
+    assert args.model_snapshot_interval == 30.0
+    assert parse_server_args(
+        ["classifier", "-f", "/dev/null"]).mix_guard == "warn"
+    with pytest.raises(SystemExit):
+        parse_server_args(["classifier", "-f", "/dev/null",
+                           "--mix-guard", "nonsense"])
+    with pytest.raises(SystemExit):
+        parse_server_args(["classifier", "-f", "/dev/null",
+                           "--mix-norm-bound", "0"])
+    with pytest.raises(SystemExit):
+        parse_server_args(["classifier", "-f", "/dev/null",
+                           "--model-snapshot-interval", "-1"])
+
+
+def test_create_mixer_carries_guard():
+    from jubatus_tpu.framework.push_mixer import create_mixer
+
+    class FakeDriver:
+        lock = threading.Lock()
+
+    m = create_mixer("linear_mixer", FakeDriver(), None,
+                     mix_guard="quarantine", mix_norm_bound=5.0)
+    assert m.guard.mode == "quarantine" and m.guard.norm_bound == 5.0
+    m = create_mixer("random_mixer", FakeDriver(), None, mix_guard="off")
+    assert m.guard.mode == "off"
+    m = create_mixer("linear_mixer", FakeDriver(), None, mix_async=True,
+                     mix_guard="warn")
+    assert m.guard.mode == "warn"
+
+
+def test_rollback_classed_effectful():
+    from jubatus_tpu.framework.idl import EFFECTFUL_BUILTINS
+
+    assert "rollback" in EFFECTFUL_BUILTINS
+
+
+# -- fv ingest hardening ------------------------------------------------------
+
+
+def test_fv_rejects_nonfinite_num_values():
+    from jubatus_tpu.core.datum import Datum
+    from jubatus_tpu.core.fv import make_fv_converter
+    from jubatus_tpu.utils import tracing
+
+    conv = make_fv_converter(
+        {"num_rules": [{"key": "*", "type": "num"}]}, dim_bits=16)
+    before = tracing.default_registry().counters().get(
+        "fv.nonfinite_rejected", 0)
+    d = Datum(num_values=[("good", 2.0), ("bad", float("inf")),
+                          ("worse", float("nan"))])
+    named = conv.convert_named(d)
+    assert named == {"good@num": 2.0}
+    # batch path rides the same screen
+    batch = conv.convert_batch([d, Datum(num_values=[("good", 1.0)])])
+    assert batch.row_offsets.tolist() == [0, 1, 2]
+    after = tracing.default_registry().counters().get(
+        "fv.nonfinite_rejected", 0)
+    assert after - before == 4  # 2 per conversion of d (convert_named +
+    # convert_batch each screened the same two bad values)
+    # finite-only data pays nothing and counts nothing
+    fv = conv.convert(Datum(num_values=[("x", 1.5)]))
+    assert len(fv) == 1
+    assert tracing.default_registry().counters().get(
+        "fv.nonfinite_rejected", 0) == after
+
+
+def test_native_ingest_rejects_nonfinite_num_values():
+    """The C++ ingest fast path never sees the Python converter's
+    screen, so the [B,K] extraction zeroes non-finite entries into the
+    padding slot and counts them (found by driving a real server: an
+    inf feature flowed straight through the native plane)."""
+    import msgpack
+
+    from jubatus_tpu.core.datum import Datum
+    from jubatus_tpu.native import ingest
+    from jubatus_tpu.utils import tracing
+
+    if not ingest.available():
+        pytest.skip("native toolchain unavailable")
+    conv = {"num_rules": [{"key": "*", "type": "num"}]}
+    p = ingest.IngestParser(ingest.spec_from_converter_config(conv), 16)
+    before = tracing.default_registry().counters().get(
+        "fv.nonfinite_rejected", 0)
+    data = [("l0", Datum(num_values=[("x", 1.0), ("bad", float("inf"))])),
+            ("l1", Datum(num_values=[("y", float("nan"))]))]
+    raw = msgpack.packb(["c", [[l, d.to_msgpack()] for l, d in data]])
+    labels, idx, val = p.parse(raw)
+    assert np.isfinite(val).all()
+    # the finite feature survived; bad entries landed in the pad slot
+    kept = [(a, b) for a, b in zip(idx[0], val[0]) if a != 0]
+    assert len(kept) == 1 and kept[0][1] == 1.0
+    assert not [(a, b) for a, b in zip(idx[1], val[1]) if a != 0]
+    after = tracing.default_registry().counters().get(
+        "fv.nonfinite_rejected", 0)
+    assert after - before == 2
+
+
+# -- collective integrity -----------------------------------------------------
+
+
+def test_psum_chunk_crc_and_finite_screens():
+    from jubatus_tpu.parallel.collective import (ChunkIntegrityError,
+                                                 psum_pytree)
+
+    clean = {"big": np.ones(2 * 2**20, np.float32)}
+    phases: dict = {}
+    psum_pytree(dict(clean), phases=phases, chunk_mb=2, guard="warn")
+    assert phases["finite_ok"] is True
+    assert phases["crc_mismatch_chunks"] == 0
+
+    poisoned = {"big": np.ones(2 * 2**20, np.float32)}
+    poisoned["big"][777] = np.nan
+    phases = {}
+    psum_pytree(dict(poisoned), phases=phases, chunk_mb=2, guard="warn")
+    assert phases["finite_ok"] is False and phases["nonfinite_chunks"] >= 1
+    with pytest.raises(ChunkIntegrityError) as ei:
+        psum_pytree(dict(poisoned), phases={}, chunk_mb=2,
+                    guard="quarantine")
+    assert ei.value.kind == "nonfinite"
+    # prefer_device consumers get the same verdict (device-side screen)
+    with pytest.raises(ChunkIntegrityError):
+        psum_pytree(dict(poisoned), phases={}, chunk_mb=2,
+                    guard="quarantine", prefer_device=True)
+
+    # bitflip in the staging window: CRC catches it
+    with faults.armed("mix.wire.corrupt:bitflip@1"):
+        with pytest.raises(ChunkIntegrityError) as ei:
+            psum_pytree(dict(clean), phases={}, chunk_mb=2,
+                        guard="quarantine")
+    assert ei.value.kind == "crc"
+    with faults.armed("mix.wire.corrupt:bitflip@1"):
+        phases = {}
+        psum_pytree(dict(clean), phases=phases, chunk_mb=2, guard="warn")
+    assert phases["crc_mismatch_chunks"] == 1
+    # guard off: no screens, no phases noise
+    phases = {}
+    psum_pytree(dict(poisoned), phases=phases, chunk_mb=2, guard="off")
+    assert phases["finite_ok"] is True
+
+
+def test_psum_quarantine_preserves_ef_residuals():
+    """A poisoned int8 round must leave the error-feedback chains of
+    the last good round intact (the verdict fires before the commit)."""
+    from jubatus_tpu.parallel.collective import (ChunkIntegrityError,
+                                                 ErrorFeedback,
+                                                 psum_pytree)
+
+    rng = np.random.default_rng(7)
+    clean = {"big": rng.normal(size=2 * 2**18).astype(np.float32)}
+    ef = ErrorFeedback()
+    psum_pytree(dict(clean), chunk_mb=0.5, compress="int8", feedback=ef,
+                guard="quarantine")
+    assert ef.rounds == 1
+    keys = set(ef.contrib)
+    poisoned = {"big": clean["big"].copy()}
+    poisoned["big"][5] = np.inf
+    with pytest.raises(ChunkIntegrityError):
+        psum_pytree(poisoned, chunk_mb=0.5, compress="int8", feedback=ef,
+                    guard="quarantine")
+    assert ef.rounds == 1 and set(ef.contrib) == keys
+
+
+def test_collective_chunk_integrity_forces_rpc_fallback(monkeypatch):
+    """A ChunkIntegrityError inside the collective entry: counted,
+    flight-recorded, nothing applied, and the NEXT round's prepare
+    answers "unsupported" so the master mixes over RPC."""
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.parallel import collective as pcoll
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    store = _Store()
+    srv = EngineServer(
+        "classifier", CONF,
+        args=ServerArgs(engine="classifier", coordinator="(shared)",
+                        name="crc", listen_addr="127.0.0.1",
+                        mixer="collective_mixer", mix_guard="quarantine",
+                        interval_sec=1e9, interval_count=1 << 30,
+                        telemetry_interval=0),
+        coord=MemoryCoordinator(store))
+    srv.start(0)
+    try:
+        from jubatus_tpu.client import ClassifierClient, Datum
+
+        c = ClassifierClient("127.0.0.1", srv.args.rpc_port, "crc")
+        c.train([["a", Datum({"x": 1.0})], ["b", Datum({"x": -1.0})]])
+        c.close()
+        m = srv.mixer
+        version_before = m.model_version
+
+        class _Boom:
+            def result(self):
+                raise pcoll.ChunkIntegrityError("crc", "injected")
+
+        monkeypatch.setattr(pcoll, "psum_pytree_start",
+                            lambda *a, **k: _Boom())
+        ver, sig = m.local_prepare("r1", [])
+        assert sig != "unsupported"
+        assert m._enter_collective("r1", int(ver), 1) is False
+        assert m.model_version == version_before  # nothing applied
+        assert m.integrity_failures == 1
+        assert srv.rpc.trace.counters()[
+            "mix.guard.chunk_crc_mismatch"] == 1
+        recs = [r for r in m.flight.snapshot() if not r["ok"]]
+        assert recs and recs[-1]["reason"] == "chunk_integrity_crc"
+        evs = srv.rpc.trace.events.snapshot(
+            grep="chunk_integrity_failure")
+        assert evs and evs[-1]["kind"] == "crc"
+        # next prepare routes the round to the RPC mix, exactly once
+        _, sig = m.local_prepare("r2", [])
+        assert sig == "unsupported"
+        _, sig3 = m.local_prepare("r3", [])
+        assert sig3 != "unsupported"
+        m.local_abort("r3")
+        m.local_abort("r2")
+    finally:
+        srv.stop()
+
+
+# -- live clusters ------------------------------------------------------------
+
+
+def _boot(tmp_path, sub, n=3, **kw):
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    coord_dir = str(tmp_path / sub)
+    defaults = dict(engine="classifier", coordinator=coord_dir,
+                    name="mg", listen_addr="127.0.0.1",
+                    interval_sec=1e9, interval_count=1 << 30,
+                    telemetry_interval=0, mix_guard="quarantine",
+                    mix_norm_bound=8.0)
+    defaults.update(kw)
+    servers = []
+    for _ in range(n):
+        srv = EngineServer("classifier", CONF,
+                           args=ServerArgs(**defaults))
+        srv.start(0)
+        servers.append(srv)
+    return servers
+
+
+def _train(srv, rows):
+    from jubatus_tpu.client import ClassifierClient, Datum
+
+    c = ClassifierClient("127.0.0.1", srv.args.rpc_port, "mg")
+    c.train([[label, Datum(d)] for label, d in rows])
+    c.close()
+
+
+def _model_finite(srv) -> bool:
+    import jax
+
+    for leaf in jax.tree_util.tree_flatten(srv.driver.pack())[0]:
+        a = np.asarray(leaf)
+        if a.dtype != object and np.issubdtype(a.dtype, np.floating) \
+                and not np.isfinite(a).all():
+            return False
+    return True
+
+
+def test_live_poisoner_quarantined_and_released(tmp_path):
+    """The acceptance drill: one member armed with a NaN poisoner is
+    flagged + dropped from every fold (its staleness grows in the
+    ledger), the breaker trips on the repeat offense, models stay
+    finite everywhere, and K clean rounds after disarm the member folds
+    again."""
+    servers = _boot(tmp_path, "coord")
+    victim = servers[2]
+    try:
+        rules = faults.arm(
+            f"mix.diff.poison.{victim.self_nodeinfo().name}:nan")
+        try:
+            for rnd in range(3):
+                for i, s in enumerate(servers):
+                    _train(s, [(f"l{i % 2}", {"x": float(rnd + i + 1)})])
+                r = servers[0].mixer.mix_now()
+                assert r is not None
+                assert r["contributors"] == 2
+                assert r["quarantined"] == [victim.self_nodeinfo().name]
+        finally:
+            faults.disarm(rules)
+        master = servers[0]
+        counters = master.rpc.trace.counters()
+        assert counters["mix.quarantined"] == 3
+        assert counters["mix.guard.nonfinite"] == 3
+        # breaker tripped on the repeat offense (event emitted once)
+        assert master.mixer.guard.is_quarantined(
+            victim.self_nodeinfo().name)
+        evs = master.rpc.trace.events.snapshot(grep="member_quarantined")
+        assert len(evs) == 1
+        # quarantined member is NOT contributing: its ledger staleness
+        # grew while the healthy members' stayed 0
+        recs = [rec for rec in master.mixer.flight.snapshot()
+                if rec.get("health")]
+        stale = recs[-1]["health"]["staleness"]
+        assert stale[victim.self_nodeinfo().name] >= 2
+        # no non-finite weight anywhere, ever
+        assert all(_model_finite(s) for s in servers)
+        # victim still RECEIVES broadcasts (serves converged model)
+        assert victim.mixer.model_version == master.mixer.model_version
+        # guard state surfaces in get_status
+        st = next(iter(master.get_status().values()))
+        assert st["mixer.guard_mode"] == "quarantine"
+        assert st["mixer.guard_quarantined"] == [
+            victim.self_nodeinfo().name]
+        # K clean rounds release the member back into the fold
+        released_round = None
+        for rnd in range(DEFAULT_RELEASE_AFTER + 1):
+            for i, s in enumerate(servers):
+                _train(s, [(f"l{i % 2}", {"x": 1.0})])
+            r = servers[0].mixer.mix_now()
+            if r["contributors"] == 3:
+                released_round = rnd
+                break
+        assert released_round is not None
+        assert not master.mixer.guard.is_quarantined(
+            victim.self_nodeinfo().name)
+        assert [e for e in master.rpc.trace.events.snapshot(
+            grep="member_released")]
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_live_scale_poisoner_trips_norm_screen(tmp_path):
+    servers = _boot(tmp_path, "coord2")
+    victim = servers[2]
+    try:
+        rules = faults.arm(
+            f"mix.diff.poison.{victim.self_nodeinfo().name}:scale:1e6")
+        try:
+            for i, s in enumerate(servers):
+                _train(s, [(f"l{i % 2}", {"x": float(i + 1)})])
+            r = servers[0].mixer.mix_now()
+        finally:
+            faults.disarm(rules)
+        assert r["contributors"] == 2
+        assert servers[0].rpc.trace.counters()[
+            "mix.guard.norm_outlier"] == 1
+        assert all(_model_finite(s) for s in servers)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_live_warn_mode_flags_but_folds(tmp_path):
+    servers = _boot(tmp_path, "coord3", mix_guard="warn")
+    victim = servers[2]
+    try:
+        rules = faults.arm(
+            f"mix.diff.poison.{victim.self_nodeinfo().name}:scale:1e6")
+        try:
+            for i, s in enumerate(servers):
+                _train(s, [(f"l{i % 2}", {"x": float(i + 1)})])
+            r = servers[0].mixer.mix_now()
+        finally:
+            faults.disarm(rules)
+        # flagged + counted, but warn mode folds everything
+        assert r["contributors"] == 3
+        assert r["quarantined"] == [victim.self_nodeinfo().name]
+        counters = servers[0].rpc.trace.counters()
+        assert counters["mix.guard.norm_outlier"] == 1
+        assert "mix.quarantined" not in counters
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_async_inbox_admission(tmp_path):
+    """A poisoned async submission is refused at the inbox in
+    quarantine mode (counted + evented), and the sender is told."""
+    from jubatus_tpu.framework.linear_mixer import pack_mix
+
+    servers = _boot(tmp_path, "coord4", mix_async=True)
+    try:
+        master = servers[0]
+        m = master.mixer
+        good = {"protocol": 2, "schema": [], "version": 0,
+                "diffs": {"weights": np.ones(4, np.float32)}}
+        ack = m.local_submit_diff("peer_1", pack_mix(good))
+        assert ack["accepted"] is True
+        assert m.inbox.depth() == 1
+        # mixable names gate the screen: use a summable name. The
+        # classifier driver's mixables are what the screen iterates, so
+        # poison one of ITS names.
+        names = list(master.driver.get_mixables())
+        bad = {"protocol": 2, "schema": [], "version": 0,
+               "diffs": {names[0]: np.array([np.nan], np.float32)}}
+        ack = m.local_submit_diff("peer_2", pack_mix(bad))
+        assert ack["accepted"] is False and ack.get("quarantined")
+        assert m.inbox.depth() == 1  # never occupied a slot
+        counters = master.rpc.trace.counters()
+        assert counters["mix.quarantined"] == 1
+        assert counters["mix.guard.nonfinite"] == 1
+        assert master.rpc.trace.events.snapshot(grep="inbox_rejected")
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_rollback_ring_and_auto_rollback(tmp_path):
+    """Snapshot → poison the apply path → put_diff refuses the
+    non-finite total, auto-rolls back to last-good, and the model
+    weights come back bit-identical."""
+    import jax
+
+    from jubatus_tpu.framework.linear_mixer import PROTOCOL_VERSION
+
+    servers = _boot(tmp_path, "coord5", n=1,
+                    model_snapshot_interval=3600.0)
+    srv = servers[0]
+    try:
+        _train(srv, [("l0", {"x": 1.0}), ("l1", {"x": -2.0})])
+        snap = srv.take_snapshot()
+        assert snap["model_version"] == srv.mixer.model_version
+        want = srv.driver.pack()
+        _train(srv, [("l0", {"x": 5.0})])  # post-snapshot training
+
+        def _nanify(x):
+            a = np.asarray(x)
+            if a.dtype != object and np.issubdtype(a.dtype, np.floating):
+                return np.full_like(a, np.nan)
+            return a
+
+        with srv.driver.lock:
+            diffs = {n: mx.get_diff()
+                     for n, mx in srv.driver.get_mixables().items()}
+        poisoned = {"protocol": PROTOCOL_VERSION,
+                    "schema": srv.mixer.local_get_schema(),
+                    "base_version": srv.mixer.model_version,
+                    "diffs": jax.tree_util.tree_map(_nanify, diffs)}
+        ok = srv.mixer.local_put_obj(poisoned)
+        assert ok is False
+        assert srv.rollbacks == 1
+        assert srv.rpc.trace.counters()["mix.rollbacks"] == 1
+        assert srv.rpc.trace.counters()["mix.guard.nonfinite_total"] == 1
+        assert srv.rpc.trace.events.snapshot(grep="rollback")
+        # refusal must NOT start the obsolete/recovery ladder
+        assert srv.mixer._obsolete is False
+        # weights restored bit-identically to the snapshot
+        got = srv.driver.pack()
+        for a, b in zip(jax.tree_util.tree_flatten(want)[0],
+                        jax.tree_util.tree_flatten(got)[0]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # degraded reason is visible while the incident is fresh
+        kinds = {r["kind"] for r in srv._degraded_reasons()}
+        assert "model_rolled_back" in kinds
+        # snapshot/rollback state in get_status + /healthz doc
+        st = next(iter(srv.get_status().values()))
+        assert st["snapshot.count"] == 1
+        assert st["rollback.count"] == 1
+        assert srv._health()["model_rollbacks"] == 1
+    finally:
+        srv.stop()
+
+
+def test_rollback_without_snapshot_refuses(tmp_path):
+    servers = _boot(tmp_path, "coord6", n=1)
+    try:
+        out = servers[0].rollback("mg", "operator")
+        assert out["rolled_back"] is False and "no model snapshot" in \
+            out["error"]
+    finally:
+        servers[0].stop()
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_rollback_rpc_envelope_compat(tmp_path, monkeypatch, native):
+    """The rollback RPC answers plain 4-element AND traced/deadlined
+    5/6-element envelopes on both transports."""
+    from jubatus_tpu.rpc import native_server
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.utils import tracing
+
+    if native and not native_server.available():
+        pytest.skip("native transport unavailable")
+    monkeypatch.setenv("JUBATUS_TPU_NATIVE_RPC", "1" if native else "0")
+    servers = _boot(tmp_path, f"coord7{int(native)}", n=1)
+    srv = servers[0]
+    try:
+        _train(srv, [("l0", {"x": 1.0})])
+        srv.take_snapshot()
+        port = srv.args.rpc_port
+        with RpcClient("127.0.0.1", port) as c:
+            out = c.call("rollback", "mg", "drill")
+        assert out[b"rolled_back" if isinstance(
+            next(iter(out)), bytes) else "rolled_back"]
+        ctx = tracing.new_root()
+        from jubatus_tpu.rpc import deadline as deadlines
+
+        with tracing.use_trace(ctx), deadlines.deadline_after(30.0):
+            with RpcClient("127.0.0.1", port) as c:
+                out = c.call("rollback", "mg", "drill")
+        vals = {(k.decode() if isinstance(k, bytes) else k): v
+                for k, v in out.items()}
+        assert vals["rolled_back"] is True
+        assert srv.rollbacks == 2
+    finally:
+        srv.stop()
+
+
+# -- jubactl rendering --------------------------------------------------------
+
+
+def test_jubactl_guard_render():
+    from jubatus_tpu.cmd.jubactl import _fmt_guard, _watch_node_row
+
+    assert _fmt_guard({"mixer.guard_mode": "off"}) == ""
+    line = _fmt_guard({"mixer.guard_mode": "quarantine",
+                       "mixer.guard_quarantined": ["10.0.0.1_9199"],
+                       "snapshot.count": 2,
+                       "snapshot.last_model_version": 7,
+                       "rollback.count": 1})
+    assert "quarantine" in line and "10.0.0.1_9199" in line
+    assert "snapshots 2" in line and "rollbacks 1" in line
+    row = _watch_node_row("n1", {"status": {
+        "health.status": "ok",
+        "mixer.guard_quarantined": ["a_1", "b_2"],
+        "rollback.count": 3}}, active=True)
+    assert "quar 2" in row and "rb 3" in row
+
+
+# -- codestyle gate self-test -------------------------------------------------
+
+
+def test_guard_coverage_gate():
+    import ast
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "codestyle_check", os.path.join(repo, "tools", "codestyle",
+                                        "check.py"))
+    check = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(check)
+
+    assert check._is_guard_gated("jubatus_tpu/framework/linear_mixer.py")
+    assert check._is_guard_gated("jubatus_tpu/framework/async_mixer.py")
+    assert not check._is_guard_gated("jubatus_tpu/framework/driver.py")
+    assert not check._is_guard_gated("jubatus_tpu/server/base.py")
+
+    bad = ("def fold(diffs):\n"
+           "    return tree_sum(diffs)\n")
+    probs = check._check_guard_coverage(
+        "x.py", ast.parse(bad), bad.splitlines())
+    assert len(probs) == 1 and "model-guard" in probs[0]
+
+    good = ("def fold(self, diffs):\n"
+            "    self.guard.screen(diffs, [])\n"
+            "    return tree_sum(diffs)\n")
+    assert check._check_guard_coverage(
+        "x.py", ast.parse(good), good.splitlines()) == []
+
+    pragma = ("def fold(diffs):\n"
+              "    return tree_sum(diffs)  # no-guard — pre-screened\n")
+    assert check._check_guard_coverage(
+        "x.py", ast.parse(pragma), pragma.splitlines()) == []
+
+    apply_site = ("def apply(m, diff):\n"
+                  "    return m.put_diff(diff)\n")
+    assert len(check._check_guard_coverage(
+        "x.py", ast.parse(apply_site), apply_site.splitlines())) == 1
+
+    # the real mixer modules are clean under the gate
+    for mod in ("linear_mixer", "async_mixer", "collective_mixer",
+                "push_mixer", "mixer"):
+        path = os.path.join(repo, "jubatus_tpu", "framework",
+                            f"{mod}.py")
+        with open(path) as f:
+            text = f.read()
+        assert check._check_guard_coverage(
+            path, ast.parse(text), text.splitlines()) == []

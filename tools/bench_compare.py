@@ -48,9 +48,14 @@ DEFAULT_TOLERANCE = 0.05
 #: ``_capacity_per_replica`` covers the autoscaling plane (ISSUE 12):
 #: steady-state examples/s each serving replica absorbs — shrinkage
 #: means the fleet needs more replicas for the same traffic.
+#: ``_quarantined`` covers the model-integrity plane (ISSUE 15): the
+#: poison drill arms a known poisoner, so quarantined counts falling
+#: means the guard stopped catching it — a regression exactly like a
+#: throughput drop (its companion drift/recovery keys are down-good
+#: via the _LOWER patterns).
 _HIGHER = re.compile(
     r"(_per_sec($|_)|samples_per_sec|_speedup($|_)|_fraction($|_)"
-    r"|_reduction($|_)|_capacity_per_replica($|_))")
+    r"|_reduction($|_)|_capacity_per_replica($|_)|_quarantined($|_))")
 #: key patterns whose smaller values are better. ``_per_host`` covers
 #: the hierarchical-mix scaling plane (ISSUE 9): wire bytes each host
 #: ships per round — the quantity the two-tier reduce holds down, so

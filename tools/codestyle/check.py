@@ -159,6 +159,54 @@ EVENT_SITES = (
 _EMIT_RE = re.compile(r"(\bevents\.emit\(|\.events\.emit\(|self\._emit\()")
 
 
+#: model-integrity coverage gate (ISSUE 15): mix is model averaging —
+#: one admitted NaN/norm-exploded contribution poisons every peer's
+#: weights in a single round. So every FOLD site (``tree_sum(...)``)
+#: and APPLY site (``<...>.put_diff(...)``) in the mixer modules
+#: (``framework/*mixer*.py``) must sit in a function that routes
+#: through the admission guard (framework/model_guard.py) — a
+#: ``guard`` reference in the enclosing function is the evidence. A
+#: site that is genuinely pre-screened elsewhere (a broadcast of an
+#: already-screened fold, a member's own two deltas merging) opts out
+#: per line with a ``# no-guard`` pragma stating where the screen IS.
+_GUARD_SITE_RE = re.compile(r"(\btree_sum\(|\.put_diff\()")
+_GUARD_REF_RE = re.compile(r"(\bguard\b|_guard\b)")
+
+
+def _is_guard_gated(posix_path: str) -> bool:
+    return ("jubatus_tpu/framework/" in posix_path
+            and "mixer" in os.path.basename(posix_path))
+
+
+def _check_guard_coverage(path: str, tree: "ast.AST",
+                          lines: List[str]) -> List[str]:
+    """tree_sum/put_diff call sites in mixer modules must sit inside a
+    function referencing the admission guard (or carry ``# no-guard``)."""
+    funcs: List[tuple] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.append((node.lineno, node.end_lineno))
+    problems = []
+    for i, line in enumerate(lines, 1):
+        if not _GUARD_SITE_RE.search(line) or "# no-guard" in line:
+            continue
+        spans = [f for f in funcs if f[0] <= i <= f[1]]
+        if spans:
+            start, end = max(spans, key=lambda f: f[0])  # innermost
+            body = "\n".join(lines[start - 1:end])
+        else:
+            body = line
+        if not _GUARD_REF_RE.search(body):
+            problems.append(
+                f"{path}:{i}: mix fold/apply site without a model-guard "
+                "reference in the enclosing function (screen the "
+                "payloads through framework/model_guard.MixGuard before "
+                "they fold or apply — one admitted NaN poisons the whole "
+                "fleet in a round; append '# no-guard — <where the "
+                "screen is>' where the site is genuinely pre-screened)")
+    return problems
+
+
 def _check_event_coverage(path: str, posix: str, tree: "ast.AST",
                           lines: List[str]) -> List[str]:
     """Marker lines from EVENT_SITES must sit inside a function whose
@@ -311,6 +359,9 @@ def check_file(path: str) -> List[str]:
                                                  text.splitlines()))
         problems.extend(_check_event_coverage(path, posix, tree,
                                               text.splitlines()))
+        if _is_guard_gated(posix):
+            problems.extend(_check_guard_coverage(path, tree,
+                                                  text.splitlines()))
     return problems
 
 
