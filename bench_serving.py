@@ -1995,6 +1995,235 @@ def run_sharded_knn(shard_counts=(1, 8), scales=("1e6", "1e8"),
     return out
 
 
+_SHARDED_IVF_CHILD = r"""
+import json, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+
+rows = int(float(sys.argv[1])); shards = int(sys.argv[2])
+n_cells = int(sys.argv[3]); nprobe = int(sys.argv[4])
+hash_num, B, k = 64, 4, 10
+rng = np.random.default_rng(3)
+from jubatus_tpu.ops import ivf, knn
+from jax.sharding import Mesh
+from jubatus_tpu.parallel import sharded_knn
+from jubatus_tpu.parallel.sharded_ivf import sharded_ivf_topk
+
+words = knn.packed_words(hash_num)
+assert rows % shards == 0
+c_local = rows // shards
+
+# CLUSTERED table — the regime an IVF tier serves (and the regime real
+# row stores live in): 4096 planted centers, each row = its center XOR
+# sparse bit-noise (AND of 4 random words ~= 2 flipped bits per 64)
+n_true = 4096
+centers = rng.integers(0, 2 ** 32, size=(n_true, words), dtype=np.uint32)
+owner = rng.integers(0, n_true, size=rows)
+noise = rng.integers(0, 2 ** 32, size=(rows, words), dtype=np.uint32)
+for _ in range(3):
+    noise &= rng.integers(0, 2 ** 32, size=(rows, words), dtype=np.uint32)
+sigs_h = centers[owner] ^ noise
+del noise, owner
+
+# queries: perturbed planted centers (near-data, like live traffic)
+qc = centers[rng.integers(0, n_true, size=64)]
+qn = rng.integers(0, 2 ** 32, size=(64, words), dtype=np.uint32)
+for _ in range(3):
+    qn &= rng.integers(0, 2 ** 32, size=(64, words), dtype=np.uint32)
+q_all = jnp.asarray(qc ^ qn)
+q = q_all[:B]
+
+# ---- build the IVF index (timed: ann_build_rows_per_sec) ----------------
+t_build0 = time.perf_counter()
+samp = sigs_h[rng.choice(rows, size=min(rows, 65536), replace=False)]
+emb_s = np.asarray(ivf.embed_signatures(jnp.asarray(samp),
+                                        method="lsh", hash_num=hash_num))
+cen = np.array(ivf.train_centroids(emb_s, n_cells, iters=4, seed=0))
+n_super = max(8, 2 * int(np.sqrt(n_cells)))
+supers, members = ivf.build_super(cen, n_super=n_super, seed=0)
+cells = np.empty(rows, np.int32)
+CHUNK = 1 << 21
+for a in range(0, rows, CHUNK):
+    b = min(a + CHUNK, rows)
+    e = np.asarray(ivf.embed_signatures(jnp.asarray(sigs_h[a:b]),
+                                        method="lsh", hash_num=hash_num))
+    cells[a:b] = ivf.assign_cells_grouped(e, cen, supers, members,
+                                          top_supers=2)
+# split hot cells on TRUE counts (the online tier's resplit, done once
+# at build): a cell past 1.5x the mean forces the fixed-shape slot cap
+# -- and the rescore gather cost is nprobe*cap -- so k-sub-means each
+# hot cell into ~mean-sized children before laying out the table
+mean_c = rows / n_cells
+T = int(2.0 * mean_c)
+cnt0 = np.bincount(cells, minlength=n_cells)
+hot = np.nonzero(cnt0 > T)[0]
+if hot.size:
+    lut = np.full(n_cells, -1, np.int32)
+    lut[hot] = np.arange(hot.size, dtype=np.int32)
+    idxs = np.nonzero(lut[cells] >= 0)[0]
+    hcells = cells[idxs]
+    he = np.empty((idxs.size, hash_num), np.float32)
+    for a in range(0, idxs.size, CHUNK):
+        b = min(a + CHUNK, idxs.size)
+        he[a:b] = np.asarray(ivf.embed_signatures(
+            jnp.asarray(sigs_h[idxs[a:b]]), method="lsh",
+            hash_num=hash_num))
+    def np_kmeans(pts, k2, seed):
+        # pure-numpy lloyd: the split fit is tiny (<=16384 x E, 3
+        # iters) and per-cell shapes all differ -- jitting each would
+        # mean hundreds of one-shot XLA compiles
+        r2 = np.random.default_rng(seed)
+        c0 = pts[r2.choice(pts.shape[0], size=k2, replace=False)].copy()
+        for _ in range(3):
+            a0 = np.argmin((c0 * c0).sum(1)[None] - 2.0 * (pts @ c0.T), 1)
+            for j in range(k2):
+                m2 = a0 == j
+                if m2.any():
+                    c0[j] = pts[m2].mean(0)
+        return c0
+    extra, next_id = [], n_cells
+    fit_rng = np.random.default_rng(7)
+    for ci in hot:
+        mi = np.nonzero(hcells == ci)[0]
+        sub_k = max(2, int(np.ceil(cnt0[ci] / mean_c)))
+        fit = mi if mi.size <= 16384 else fit_rng.choice(mi, 16384,
+                                                        replace=False)
+        sc = np_kmeans(he[fit], sub_k, seed=int(ci))
+        a2 = np.argmin((sc * sc).sum(1)[None] - 2.0 * (he[mi] @ sc.T), 1)
+        ids = np.concatenate(
+            [[ci], next_id + np.arange(sub_k - 1)]).astype(np.int32)
+        cells[idxs[mi]] = ids[a2]
+        cen[ci] = sc[0]
+        extra.append(sc[1:])
+        next_id += sub_k - 1
+    cen = np.concatenate([cen] + extra).astype(np.float32)
+    del he, idxs, hcells, lut
+cen_j = jnp.asarray(cen)
+n_cells_f = cen.shape[0]
+# group rows into per-(shard, cell) slot lists: [S*n_cells, cap] int32,
+# -1 padded, LOCAL slots -- and permute each shard's arena CELL-
+# CONTIGUOUS (the compacted layout a rebuild converges to) so a probed
+# cell's rescore gather is a sequential stream, not C/S-wide random
+# cache misses
+key = cells.astype(np.int64) + (np.arange(rows) // c_local) * n_cells_f
+order = np.argsort(key, kind="stable")
+sigs_h = sigs_h[order]
+cnt = np.bincount(key, minlength=shards * n_cells_f)
+starts = np.concatenate([[0], np.cumsum(cnt)[:-1]])
+cap = 1 << int(np.ceil(np.log2(max(int(cnt.max()), 1))))
+table = np.full((shards * n_cells_f, cap), -1, np.int32)
+ks = key[order]
+pos = np.arange(rows) - starts[ks]
+table[ks, pos] = (np.arange(rows) % c_local).astype(np.int32)
+build_s = time.perf_counter() - t_build0
+del key, order, ks, pos
+
+mesh = Mesh(np.asarray(jax.devices()[:shards]), ("shard",))
+sigs = sharded_knn.shard_table(mesh, jnp.asarray(sigs_h))
+slots = sharded_knn.shard_table(mesh, jnp.asarray(table))
+cen_r = sharded_knn.replicate(mesh, cen_j)
+del table
+
+def embed(qq):
+    return ivf.embed_signatures(qq, method="lsh", hash_num=hash_num)
+
+ivf_query = lambda qq: sharded_ivf_topk(
+    mesh, qq, embed(qq), sigs, cen_r, slots,
+    method="lsh", hash_num=hash_num, k=k, nprobe=nprobe)
+exact_query = lambda qq: sharded_knn.sharded_hamming_topk(
+    mesh, qq, sigs, hash_num=hash_num, k=k)
+
+def p99(fn, qq, trials):
+    jax.block_until_ready(fn(qq))            # compile + warm
+    ts = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(qq))
+        ts.append(time.perf_counter() - t0)
+    ts = np.asarray(ts) * 1e3
+    return (round(float(np.percentile(ts, 99)), 2),
+            round(float(np.median(ts)), 2))
+
+trials = 12 if rows >= 10 ** 7 else 25
+ivf_p99, ivf_p50 = p99(ivf_query, q, max(trials, 25))
+exact_p99, exact_p50 = p99(exact_query, q, trials)
+
+# recall@10 over 64 near-data queries, by distance threshold: an IVF
+# answer counts if its distance <= the exact 10th-nearest distance
+# (hamming quantizes hard — id-set overlap would punish legal tie
+# resolution, not index quality)
+hit = tot = 0
+for a in range(0, 64, 8):
+    qq = q_all[a:a + 8]
+    ed, _ = exact_query(qq)
+    ad, _ = ivf_query(qq)
+    kth = np.sort(np.asarray(ed), axis=1)[:, k - 1:k]
+    hit += int((np.asarray(ad)[:, :k] <= kth + 1e-6).sum())
+    tot += 8 * k
+print(json.dumps({
+    "ivf_p99_ms": ivf_p99, "ivf_p50_ms": ivf_p50,
+    "exact_p99_ms": exact_p99, "exact_p50_ms": exact_p50,
+    "recall_at_10": round(hit / tot, 4),
+    "build_rows_per_sec": round(rows / build_s, 1),
+    "build_s": round(build_s, 2), "cells": n_cells_f, "nprobe": nprobe,
+    "cells_base": n_cells, "hot_split": int(n_cells_f - n_cells),
+    "cell_cap": int(cap), "trials": trials, "batch": B, "k": k,
+}))
+"""
+
+
+def run_sharded_knn_ivf(scales=("1e6", "1e8"), shards: int = 8,
+                        timeout: float = 7200.0) -> dict:
+    """IVF ANN-tier bench (ISSUE 16): two-phase probe+rescore vs the
+    exact sharded scan over a CLUSTERED signature table (4096 planted
+    centers — the exact-scan cliff is identical, but the data has the
+    cell structure real row stores do). Emits
+    ``knn_query_p99_ms_rows{scale}_{S}shard_ivf`` (down-good),
+    ``ann_recall_at_10_rows{scale}`` (up-good, distance-threshold
+    recall vs the exact scan on the SAME table) and
+    ``ann_build_rows_per_sec`` (up-good, train + assign + group wall).
+    Exact p99 is re-measured in the same child so the speedup quote is
+    same-process, same-table honest."""
+    import math
+
+    import bench_mix
+
+    out: dict = {}
+    for scale in scales:
+        rows = int(float(scale))
+        n_cells = min(8192,
+                      max(64, 1 << int(round(math.log2(rows ** 0.5)))))
+        nprobe = max(32, n_cells // 256)
+        env = bench_mix.scrub_child_env(dict(os.environ))
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={shards}"])
+        tag = f"rows{scale}_{shards}shard"
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _SHARDED_IVF_CHILD, scale,
+                 str(shards), str(n_cells), str(nprobe)],
+                capture_output=True, text=True, timeout=timeout, env=env)
+            if not proc.stdout.strip():
+                raise RuntimeError(
+                    f"exit {proc.returncode}: "
+                    + (proc.stderr or "")[-250:])
+            doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        except Exception as e:  # noqa: BLE001 — partial results
+            out[f"knn_query_error_{tag}_ivf"] = repr(e)[:200]
+            continue
+        out[f"knn_query_p99_ms_{tag}_ivf"] = doc["ivf_p99_ms"]
+        out[f"knn_query_p50_ms_{tag}_ivf"] = doc["ivf_p50_ms"]
+        out[f"knn_query_p99_ms_{tag}"] = doc["exact_p99_ms"]
+        out[f"knn_query_p50_ms_{tag}"] = doc["exact_p50_ms"]
+        out[f"ann_recall_at_10_rows{scale}"] = doc["recall_at_10"]
+        out[f"ann_cells_rows{scale}"] = doc["cells"]
+        out[f"ann_nprobe_rows{scale}"] = doc["nprobe"]
+        out["ann_build_rows_per_sec"] = doc["build_rows_per_sec"]
+    return out
+
+
 def collect(trials: int = 2) -> dict:
     """Alternate transports and keep each one's best trial: run-to-run
     spread through the device tunnel is ~±10% (host scheduling + tunnel
@@ -2215,6 +2444,11 @@ if __name__ == "__main__":
         scales = tuple(sys.argv[3].split(",")) if len(sys.argv) > 3 \
             else ("1e6", "1e8")
         print(json.dumps(run_sharded_knn((1, shards), scales), indent=1))
+    elif len(sys.argv) > 1 and sys.argv[1] == "shardedivf":
+        shards = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        scales = tuple(sys.argv[3].split(",")) if len(sys.argv) > 3 \
+            else ("1e6", "1e8")
+        print(json.dumps(run_sharded_knn_ivf(scales, shards), indent=1))
     elif len(sys.argv) > 1 and sys.argv[1] == "events":
         # the event-plane slice on its own (overhead A/B + per-emit
         # microbench), for ISSUE 14 iteration without the full bench

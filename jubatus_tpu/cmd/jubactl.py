@@ -280,6 +280,9 @@ def show_status(coord: Coordinator, engine: str, name: str,
             shard_line = _fmt_shard_layout(st)
             if shard_line:
                 print(f"    {shard_line}")
+            ann_line = _fmt_ann(st)
+            if ann_line:
+                print(f"    {ann_line}")
             for k in sorted(st):
                 print(f"    {k}: {st[k]}")
     return rc
@@ -333,6 +336,30 @@ def _fmt_shard_layout(st: Dict[str, Any]) -> str:
     if merge is not None:
         out += f" topk_merge {float(merge):.1f} ms"
     return out
+
+
+def _fmt_ann(st: Dict[str, Any]) -> str:
+    """One-line ANN-tier summary from the driver.ann.* gauges (ISSUE
+    16): mode, cell count, last probe/rescore widths, rolling recall
+    probe; "" when the tier is off."""
+    mode = st.get("driver.ann.mode")
+    if not mode or mode == "off":
+        return ""
+    bits = [f"ann: {mode}"]
+    if st.get("driver.ann.degraded"):
+        bits.append("DEGRADED(exact fallback)")
+    cells = st.get("driver.ann.cells")
+    if cells:
+        bits.append(f"{int(cells)} cells "
+                    f"(probe {int(st.get('driver.ann.nprobe', 0))})")
+    probed = st.get("driver.ann.probed_cells")
+    cand = st.get("driver.ann.rescore_candidates")
+    if probed:
+        bits.append(f"last {int(probed)}c/{int(cand or 0)}r")
+    recall = st.get("driver.ann.recall_probe")
+    if recall is not None:
+        bits.append(f"recall~{float(recall):.2f}")
+    return "  ".join(bits)
 
 
 def _fmt_ms(v) -> str:
@@ -600,6 +627,13 @@ def _watch_node_row(node_name: str, entry: Dict[str, Any],
             mix_bits.append(
                 f"sh {int(shards)}x"
                 f"{nbytes / max(int(shards), 1) / 2 ** 20:.0f}MB")
+    # ANN tier (ISSUE 16): cell count when armed, or DEG on degrade
+    ann_mode = st.get("driver.ann.mode")
+    if ann_mode and ann_mode != "off":
+        if st.get("driver.ann.degraded"):
+            mix_bits.append("ann DEG")
+        else:
+            mix_bits.append(f"ann {int(st.get('driver.ann.cells', 0))}c")
     alerts = ",".join(entry.get("alerts") or []) or "-"
     p99_cell = f"{p99:.1f} {p99_span[4:]}" if p99 is not None else "-"
     # event plane (ISSUE 14): the node's newest event + its age — one

@@ -58,7 +58,26 @@ class NNBackend:
         #: wall ms of the last sharded top-k (device scan + log-depth
         #: merge + readback) — the shard.topk_merge_ms gauge
         self.last_topk_ms: Optional[float] = None
+        # -- ANN (IVF) tier, ISSUE 16: OFF by default — exact scans stay
+        # the baseline until configure_ann("ivf") arms the lazy build
+        self.ann_mode = "off"
+        self.ann_cells = 0        # 0 = auto (pow2 ≈ √rows)
+        self.ann_nprobe = 8
+        self.ann_min_rows = 128   # lazy build once this many rows live
+        self.ann_split_width = 0  # 0 = auto; cells past it re-split
+        self._ann_reset()
         self._init_sigs()
+
+    def _ann_reset(self) -> None:
+        self._ann_centroids: Optional[np.ndarray] = None
+        self._ann_arenas: Optional[Any] = None
+        self._ann_degraded = False
+        self._ann_counters = {"builds": 0, "resplits": 0,
+                              "rebuild_failed": 0}
+        self._ann_last = {"probed_cells": 0, "rescore_candidates": 0}
+        self._ann_recall_probe: Optional[float] = None
+        self._ann_queries = 0
+        self._ann_dev: Optional[Tuple[Any, ...]] = None
 
     def _init_sigs(self) -> None:
         c = self.store.capacity
@@ -67,6 +86,11 @@ class NNBackend:
         elif self.method == "minhash":
             self._sigs = np.zeros((c, self.hash_num), np.uint32)
         elif self.method == "euclid_lsh":
+            self._sigs = np.zeros((c, self.hash_num), np.float32)
+        elif getattr(self, "ann_mode", "off") == "ivf":
+            # exact methods hold no signatures — unless the IVF tier is
+            # on, which PROBES by the same JL projection euclid_lsh
+            # stores (the rescore stays the exact cosine/euclid math)
             self._sigs = np.zeros((c, self.hash_num), np.float32)
         else:
             self._sigs = None
@@ -81,11 +105,14 @@ class NNBackend:
 
     def remove_row(self, row_id: str) -> bool:
         self._pending.pop(row_id, None)
+        if self._ann_arenas is not None:
+            self._ann_arenas.remove(row_id)
         return self.store.remove_row(row_id)
 
     def clear(self) -> None:
         self.store.clear()
         self._pending.clear()
+        self._ann_reset()
         self._init_sigs()
 
     # -- signature maintenance -----------------------------------------------
@@ -124,11 +151,226 @@ class NNBackend:
             self._sigs[self.store.slots[rid]] = sigs[row]
         self._sig_dev = None
         self._mesh_dev = None
+        if self._ann_arenas is not None:
+            # online insertion: append each new row to its owning cell,
+            # then re-split any cell that overflowed its width
+            self._ann_assign(sigs[: len(items)], [rid for rid, _ in items])
+            self._ann_maintain()
 
     def _sig_view(self):
         if self._sig_dev is None or self._sig_dev[0] != self.store.version:
             self._sig_dev = (self.store.version, jnp.asarray(self._sigs))
         return self._sig_dev[1]
+
+    # -- ANN (IVF) tier (ISSUE 16) ---------------------------------------------
+    def configure_ann(self, mode: str, *, cells: int = 0, nprobe: int = 8,
+                      min_rows: int = 128) -> None:
+        """Arm or disarm the IVF tier. ``mode="ivf"`` schedules a lazy
+        index build (first query past ``min_rows`` live rows trains the
+        coarse partitioner); ``"off"`` restores pure exact scans —
+        bit-identical to a backend that never had ANN. Reconfiguring
+        drops any existing index (and clears a degraded latch); exact
+        methods additionally allocate the JL probe-projection table and
+        re-pend every row to fill it."""
+        if mode not in ("off", "ivf"):
+            raise ValueError(f"unknown ann mode {mode!r} "
+                             "(expected 'off' or 'ivf')")
+        self.ann_mode = mode
+        self.ann_cells = max(0, int(cells))
+        self.ann_nprobe = max(1, int(nprobe))
+        self.ann_min_rows = max(1, int(min_rows))
+        self._ann_reset()
+        had_sigs = self._sigs is not None
+        if self.method in EXACT_METHODS and (mode == "ivf") != had_sigs:
+            self._init_sigs()
+            self._pending = {rid: self.store.get_row(rid)
+                             for rid in self.store.all_ids()}
+
+    def _ann_ready(self) -> bool:
+        """True when queries should ride the IVF path: armed, not
+        degraded, and the index is live (or lazily buildable now)."""
+        if self.ann_mode != "ivf" or self._ann_degraded:
+            return False
+        if self._ann_arenas is not None:
+            return True
+        if len(self.store) < self.ann_min_rows:
+            return False
+        return self._ann_rebuild()
+
+    def _ann_embed(self, sig_rows):
+        from jubatus_tpu.ops import ivf
+
+        return ivf.embed_signatures(jnp.asarray(sig_rows),
+                                    method=self.method,
+                                    hash_num=self.hash_num)
+
+    def _ann_rebuild(self) -> bool:
+        """(Re)train centroids from a row sample (``kmeans_fit`` as the
+        coarse partitioner) and cell-assign every live row. The
+        ``ann.rebuild`` fault site degrades the tier to the exact scan
+        — sticky until reconfigured — instead of ever wrong-answering."""
+        from jubatus_tpu.ops import ivf
+        from jubatus_tpu.parallel.row_store import CellArenas
+        from jubatus_tpu.utils import faults
+
+        self._flush()
+        try:
+            faults.fire("ann.rebuild")
+        except faults.FaultInjected:
+            self._ann_counters["rebuild_failed"] += 1
+            self._ann_degrade("rebuild_fault")
+            return False
+        ids = self.store.all_ids()
+        if not ids:
+            return False
+        slots = np.fromiter((self.store.slots[r] for r in ids),
+                            np.int64, len(ids))
+        n_cells = self.ann_cells or ivf.auto_cells(len(ids))
+        n_cells = max(1, min(n_cells, len(ids)))
+        if len(slots) > 65536:
+            rng = np.random.default_rng(self.seed)
+            sample = np.sort(rng.choice(slots, 65536, replace=False))
+        else:
+            sample = slots
+        emb_s = self._ann_embed(self._sigs[sample])
+        self._ann_centroids = ivf.train_centroids(emb_s, n_cells,
+                                                  seed=self.seed)
+        arenas = CellArenas(self.store, n_cells)
+        cen = jnp.asarray(self._ann_centroids)
+        for lo in range(0, len(ids), 65536):
+            chunk = slots[lo: lo + 65536]
+            asg = np.asarray(ivf.assign_cells(
+                self._ann_embed(self._sigs[chunk]), cen))
+            for i, cell in enumerate(asg):
+                arenas.assign(ids[lo + i], int(cell))
+        self._ann_arenas = arenas
+        self._ann_dev = None
+        self._ann_counters["builds"] += 1
+        # cells can come out of training already past the width budget
+        # (skewed data); give them the same re-split pass inserts get
+        self._ann_maintain()
+        return not self._ann_degraded
+
+    def _ann_assign(self, sig_rows, rids) -> None:
+        """Cell-assign freshly flushed rows against the live centroids
+        (one [B, K]×[K, E] matmul)."""
+        from jubatus_tpu.ops import ivf
+
+        if not rids:
+            return
+        cells = np.asarray(ivf.assign_cells(
+            self._ann_embed(sig_rows), jnp.asarray(self._ann_centroids)))
+        arenas = self._ann_arenas
+        for rid, cell in zip(rids, cells):
+            arenas.assign(rid, int(cell))
+
+    def _ann_split_width(self) -> int:
+        return self.ann_split_width or max(
+            64, 4 * max(1, len(self.store) // self._ann_arenas.n_cells))
+
+    def _ann_maintain(self) -> None:
+        """Background re-split: any cell past its width budget splits
+        2-means into itself + a fresh cell (one rare recompile per cell
+        count change). A fault at ``ann.rebuild`` degrades to exact."""
+        from jubatus_tpu.utils import faults
+
+        arenas = self._ann_arenas
+        if arenas is None:
+            return
+        width = self._ann_split_width()
+        over = [c for c, n in enumerate(arenas.sizes()) if n > width]
+        try:
+            for cell in over:
+                faults.fire("ann.rebuild")
+                self._ann_split_cell(cell)
+                self._ann_counters["resplits"] += 1
+        except faults.FaultInjected:
+            self._ann_counters["rebuild_failed"] += 1
+            self._ann_degrade("resplit_fault")
+
+    def _ann_split_cell(self, cell: int) -> None:
+        from jubatus_tpu.ops import ivf
+        from jubatus_tpu.utils import events
+
+        arenas = self._ann_arenas
+        members = [rid for rid in arenas.members(cell)
+                   if rid in self.store.slots]
+        if len(members) < 2:
+            return
+        slots = np.fromiter((self.store.slots[r] for r in members),
+                            np.int64, len(members))
+        emb = self._ann_embed(self._sigs[slots])
+        cents = ivf.train_centroids(emb, 2, seed=len(members))
+        asg = np.asarray(ivf.assign_cells(emb, jnp.asarray(cents)))
+        new_cell = arenas.add_cell()
+        cen = np.array(self._ann_centroids, np.float32)
+        cen[cell] = cents[0]
+        self._ann_centroids = np.vstack([cen, cents[1:2]])
+        for rid, side in zip(members, asg):
+            arenas.assign(rid, new_cell if side else cell)
+        self._ann_dev = None
+        events.emit("ann", "resplit", cell=int(cell),
+                    new_cell=int(new_cell), rows=len(members))
+
+    def _ann_degrade(self, reason: str) -> None:
+        """Drop the index and latch the tier off: every later query
+        takes the exact path (approximate answers are never served from
+        a half-built index)."""
+        from jubatus_tpu.utils import events
+
+        self._ann_degraded = True
+        self._ann_arenas = None
+        self._ann_dev = None
+        events.emit("ann", "degraded", severity="warning", reason=reason)
+
+    def _ann_restore(self, centroids: np.ndarray) -> None:
+        """Adopt checkpointed centroids over the CURRENT store shape:
+        arenas start empty and every (re-pended) row re-partitions via
+        the stored centroids at the next flush — reshard-on-restore."""
+        from jubatus_tpu.parallel.row_store import CellArenas
+
+        self._ann_centroids = np.array(centroids, np.float32)
+        self._ann_arenas = CellArenas(self.store,
+                                      self._ann_centroids.shape[0])
+        self._ann_degraded = False
+        self._ann_dev = None
+
+    def _ann_device_state(self):
+        """(centroids, cell tables, cell_cap) device views — sharded
+        over the mesh when attached; cached per (store, arena) version."""
+        arenas = self._ann_arenas
+        key = (self.store.version, arenas.version)
+        if self._ann_dev is not None and self._ann_dev[0] == key:
+            return self._ann_dev[1:]
+        tab, cap = arenas.device_tables()
+        cen = jnp.asarray(self._ann_centroids)
+        if self._mesh is not None:
+            from jubatus_tpu.parallel.sharded_knn import (replicate,
+                                                          shard_table)
+            tab = shard_table(self._mesh, tab, self._mesh_axis)
+            cen = replicate(self._mesh, cen)
+        self._ann_dev = (key, cen, tab, cap)
+        return cen, tab, cap
+
+    def ann_stats(self) -> Dict[str, Any]:
+        """ANN index gauges (ann.* — OBSERVABILITY.md §7); {} when the
+        tier is off."""
+        if self.ann_mode == "off":
+            return {}
+        arenas = self._ann_arenas
+        st: Dict[str, Any] = {
+            "mode": self.ann_mode,
+            "built": arenas is not None,
+            "degraded": self._ann_degraded,
+            "nprobe": self.ann_nprobe,
+            "cells": arenas.n_cells if arenas is not None else 0,
+            "rows_indexed": len(arenas) if arenas is not None else 0,
+        }
+        st.update(self._ann_counters)
+        st.update(self._ann_last)
+        if self._ann_recall_probe is not None:
+            st["recall_probe"] = self._ann_recall_probe
+        return st
 
     # -- mesh-sharded serving (≙ CHT row sharding, SURVEY.md §5) -------------
     def attach_mesh(self, mesh, axis: str = "shard") -> None:
@@ -180,6 +422,11 @@ class NNBackend:
         self._init_sigs()
         # every slot moved: recompute every signature at the next flush
         self._pending = {rid: new.get_row(rid) for rid in new.all_ids()}
+        if self._ann_centroids is not None and not self._ann_degraded:
+            # reshard re-partitions via the STORED centroids: fresh
+            # arenas over the new shard shape; the re-pended rows above
+            # re-assign cells at the next flush
+            self._ann_restore(self._ann_centroids)
 
     def shard_stats(self) -> Dict[str, Any]:
         """Shard-layout gauges (shard.{count,rows,bytes_in_use,
@@ -215,10 +462,37 @@ class NNBackend:
         self._mesh_dev = (self.store.version, sigs, valid)
         return sigs, valid
 
+    def _query_sigs_batch(self, vecs):
+        """[B, W/H] query signatures (hash methods) or JL projections
+        (exact methods' ANN probe space) in one batched kernel call."""
+        sb = SparseBatch.from_vectors(list(vecs))
+        idx, val = jnp.asarray(sb.idx), jnp.asarray(sb.val)
+        if self.method == "lsh":
+            return knn.lsh_signature(idx, val, hash_num=self.hash_num,
+                                     seed=self.seed)
+        if self.method == "minhash":
+            return knn.minhash_signature(idx, val, hash_num=self.hash_num,
+                                         seed=self.seed)
+        return knn.euclid_projection(idx, val, hash_num=self.hash_num,
+                                     seed=self.seed)
+
+    def _mesh_exact_topk(self, q, sigs, valid, k: int):
+        """Exact sharded top-k dispatch for pre-computed query sigs."""
+        from jubatus_tpu.parallel import sharded_knn
+
+        if self.method == "lsh":
+            return sharded_knn.sharded_hamming_topk(
+                self._mesh, q, sigs, hash_num=self.hash_num, k=k,
+                axis=self._mesh_axis, valid=valid)
+        if self.method == "minhash":
+            return sharded_knn.sharded_minhash_topk(
+                self._mesh, q, sigs, k=k, axis=self._mesh_axis, valid=valid)
+        return sharded_knn.sharded_euclid_lsh_topk(
+            self._mesh, q, sigs, hash_num=self.hash_num, k=k,
+            axis=self._mesh_axis, valid=valid)
+
     def _mesh_neighbors(self, vecs, k: int) -> List[List[Tuple[str, float]]]:
         import time
-
-        from jubatus_tpu.parallel import sharded_knn
 
         self._flush()
         k = min(k, len(self.store))
@@ -226,25 +500,24 @@ class NNBackend:
             return [[] for _ in vecs]
         sigs, valid = self._mesh_view()
         t0 = time.perf_counter()
-        sb = SparseBatch.from_vectors(vecs)
-        idx, val = jnp.asarray(sb.idx), jnp.asarray(sb.val)
-        if self.method == "lsh":
-            q = knn.lsh_signature(idx, val, hash_num=self.hash_num,
-                                  seed=self.seed)
-            d, gidx = sharded_knn.sharded_hamming_topk(
-                self._mesh, q, sigs, hash_num=self.hash_num, k=k,
-                axis=self._mesh_axis, valid=valid)
-        elif self.method == "minhash":
-            q = knn.minhash_signature(idx, val, hash_num=self.hash_num,
-                                      seed=self.seed)
-            d, gidx = sharded_knn.sharded_minhash_topk(
-                self._mesh, q, sigs, k=k, axis=self._mesh_axis, valid=valid)
+        q = self._query_sigs_batch(vecs)
+        ann_used = self._ann_ready()
+        if ann_used:
+            from jubatus_tpu.ops import ivf
+            from jubatus_tpu.parallel import sharded_ivf
+
+            emb = ivf.embed_signatures(q, method=self.method,
+                                       hash_num=self.hash_num)
+            cen, tab, cap = self._ann_device_state()
+            nprobe = min(self.ann_nprobe, self._ann_arenas.n_cells)
+            d, gidx = sharded_ivf.sharded_ivf_topk(
+                self._mesh, q, emb, sigs, cen, tab, method=self.method,
+                hash_num=self.hash_num, k=k, nprobe=nprobe,
+                axis=self._mesh_axis)
+            self._ann_last = {"probed_cells": nprobe,
+                              "rescore_candidates": nprobe * cap}
         else:
-            q = knn.euclid_projection(idx, val, hash_num=self.hash_num,
-                                      seed=self.seed)
-            d, gidx = sharded_knn.sharded_euclid_lsh_topk(
-                self._mesh, q, sigs, hash_num=self.hash_num, k=k,
-                axis=self._mesh_axis, valid=valid)
+            d, gidx = self._mesh_exact_topk(q, sigs, valid, k)
         d, gidx = np.asarray(d), np.asarray(gidx)
         self.last_topk_ms = (time.perf_counter() - t0) * 1e3
         out = []
@@ -252,7 +525,81 @@ class NNBackend:
             row = [(self.store.ids[int(s)], float(d[b, j]))
                    for j, s in enumerate(gidx[b]) if np.isfinite(d[b, j])]
             out.append(row)
+        if ann_used:
+            self._ann_queries += 1
+            if self._ann_queries % 64 == 1:
+                # shadow one query down the exact path: ann.recall_probe
+                de, ge = self._mesh_exact_topk(q[:1], sigs, valid, k)
+                de, ge = np.asarray(de), np.asarray(ge)
+                exact_ids = {self.store.ids[int(s)]
+                             for j, s in enumerate(ge[0])
+                             if np.isfinite(de[0, j])}
+                got = {rid for rid, _ in out[0]}
+                if exact_ids:
+                    self._ann_recall_probe = round(
+                        len(exact_ids & got) / len(exact_ids), 4)
         return out
+
+    def _ann_neighbors_flat(self, vecs, k: int) -> \
+            List[List[Tuple[str, float]]]:
+        """Single-device two-phase IVF query (ops/ivf.py): probe +
+        exact rescore over the probed cells only."""
+        from jubatus_tpu.ops import ivf
+
+        q = self._query_sigs_batch(vecs)
+        emb = ivf.embed_signatures(q, method=self.method,
+                                   hash_num=self.hash_num)
+        cen, tab, cap = self._ann_device_state()
+        nprobe = min(self.ann_nprobe, self._ann_arenas.n_cells)
+        if self.method in HASH_METHODS:
+            d, slots = ivf.ivf_topk(
+                q, emb, self._sig_view(), cen, tab, method=self.method,
+                hash_num=self.hash_num, k=k, nprobe=nprobe)
+        else:
+            idx, val, _ = self.store.device_view()
+            qd = np.zeros((len(vecs), self.dim), np.float32)
+            for b, vec in enumerate(vecs):
+                for i, v in vec:
+                    qd[b, i] += v
+            d, slots = ivf.ivf_topk_exact(
+                jnp.asarray(qd), emb, idx, val, cen, tab,
+                method=self.method, k=k, nprobe=nprobe)
+        self._ann_last = {"probed_cells": nprobe,
+                          "rescore_candidates": nprobe * cap}
+        d, slots = np.asarray(d), np.asarray(slots)
+        out = []
+        for b in range(len(vecs)):
+            out.append([(self.store.ids[int(s)], float(d[b, j]))
+                        for j, s in enumerate(slots[b])
+                        if np.isfinite(d[b, j])])
+        return out
+
+    def _ann_query(self, vecs, k: int) -> List[List[Tuple[str, float]]]:
+        self._flush()
+        k = min(k, len(self.store))
+        if k <= 0 or not vecs:
+            return [[] for _ in vecs]
+        out = self._ann_neighbors_flat(vecs, k)
+        self._ann_queries += 1
+        if self._ann_queries % 64 == 1:
+            self._ann_probe_recall(vecs[0], out[0], k)
+        return out
+
+    def _ann_probe_recall(self, vec, approx, k: int) -> None:
+        """Shadow one query down the exact path and record overlap@k —
+        the ann.recall_probe gauge (every 64th ANN batch, flat path)."""
+        d = self.distances(vec)
+        kk = min(k, len(self.store))
+        if kk <= 0:
+            return
+        order = np.argpartition(d, kk - 1)[:kk]
+        exact_ids = {self.store.ids[int(s)] for s in order
+                     if np.isfinite(d[s])}
+        if not exact_ids:
+            return
+        got = {rid for rid, _ in approx}
+        self._ann_recall_probe = round(
+            len(exact_ids & got) / len(exact_ids), 4)
 
     # -- queries ---------------------------------------------------------------
     def _query_sig(self, vec: SparseVector):
@@ -320,6 +667,9 @@ class NNBackend:
         """k nearest as (id, distance), ascending."""
         if self._mesh is not None:
             return self._mesh_neighbors([vec], k)[0]
+        self._flush()
+        if self._ann_ready():
+            return self._ann_query([vec], k)[0]
         d = self.distances(vec)
         k = min(k, len(self.store))
         if k <= 0:
@@ -331,9 +681,13 @@ class NNBackend:
     def neighbors_batch(self, vecs: List[SparseVector],
                         k: int) -> List[List[Tuple[str, float]]]:
         """Batched k-nearest: one sharded scan for the whole batch when a
-        mesh is attached, else per-query dense scans."""
+        mesh is attached, else per-query dense scans (one batched IVF
+        probe when the ANN tier is live)."""
         if self._mesh is not None:
             return self._mesh_neighbors(list(vecs), k)
+        self._flush()
+        if self._ann_ready():
+            return self._ann_query(list(vecs), k)
         return [self.neighbors(v, k) for v in vecs]
 
     def similar(self, vec: SparseVector, k: int) -> List[Tuple[str, float]]:
@@ -384,7 +738,15 @@ class NNBackend:
     # -- persistence / mix -----------------------------------------------------
     def pack(self) -> Any:
         self._flush()
-        return {"store": self.store.pack()}
+        out: Dict[str, Any] = {"store": self.store.pack()}
+        if self.ann_mode == "ivf" and self._ann_centroids is not None:
+            cen = np.ascontiguousarray(self._ann_centroids, np.float32)
+            # centroid tables ride the save_load envelope (CRC'd like
+            # any other mixable payload) as raw bytes + shape
+            out["ann"] = {"cells": int(cen.shape[0]),
+                          "dim": int(cen.shape[1]),
+                          "centroids": cen.tobytes()}
+        return out
 
     def unpack(self, obj: Any, datum_decoder=None) -> None:
         self.clear()
@@ -393,6 +755,10 @@ class NNBackend:
             vec = self.store.get_row(rid)
             if self._sigs is not None:
                 self._pending[rid] = vec
+        ann = obj.get("ann") if isinstance(obj, dict) else None
+        if ann is not None and self.ann_mode == "ivf":
+            cen = np.frombuffer(ann["centroids"], np.float32)
+            self._ann_restore(cen.reshape(ann["cells"], ann["dim"]))
 
     def pop_update_diff(self):
         return self.store.pop_update_diff()

@@ -126,11 +126,16 @@ class NearestNeighborDriver(NNRowMigration, DriverBase):
             return {}
         return self.backend.shard_stats()
 
+    def ann_stats(self) -> Dict[str, Any]:
+        """IVF ANN-tier gauges (ann.* catalog rows); empty when --ann off."""
+        return self.backend.ann_stats()
+
     @locked
     def get_status(self) -> Dict[str, Any]:
         st = super().get_status()
         st.update(method=self.method, num_rows=len(self.backend.store))
         st.update({f"shard.{k}": v for k, v in self.shard_stats().items()})
+        st.update({f"ann.{k}": v for k, v in self.ann_stats().items()})
         return st
 
 

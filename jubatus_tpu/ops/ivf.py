@@ -1,0 +1,346 @@
+"""IVF (inverted-file) approximate-NN kernels — the coarse-quantized
+query tier that kills the 10⁸-row exact-scan cliff (ISSUE 16).
+
+The exact scan (ops/knn.py) prices every query at O(rows): ~3.1 s p99 at
+10⁸ rows even row-sharded over 8 devices (BENCH_SHARD_r01_knn.json).
+IVF replaces the full sweep with two phases, both batched matmuls:
+
+1. **Probe**: embed the query into the method's float space and rank the
+   k-means cell centroids against it — one [B, K]×[K, E] matmul
+   (pairwise_sq_dists' cross term) + a top-``nprobe`` selection. The
+   centroid table is tiny (cells × E floats) and replicated.
+2. **Rescore**: gather ONLY the probed cells' member rows from the
+   fixed-shape cell-slot table ([n_cells, cell_cap] int32, −1-padded)
+   and score them with the method's EXACT distance — the same
+   XOR+popcount / lane-match / JL math the full scan uses (and the
+   cosine/euclid kernels' expansion for the exact methods), evaluated
+   candidate-shaped instead of arena-shaped. Results are therefore
+   drawn from the true metric; the only approximation is which rows get
+   scored.
+
+Embedding spaces are chosen so k-means cells align with each method's
+metric (a cell partition in the wrong geometry probes garbage):
+
+  lsh         unpacked ±1 sign bits — ||a−b||² = 4·hamming exactly, so
+              euclidean k-means IS hamming k-means.
+  minhash     per-lane derived uniform of the lane's winning feature id
+              (counter-based threefry, no HBM table): two rows differ
+              in a lane ⇒ expected squared lane gap is a constant, so
+              squared euclidean distance ∝ expected mismatch count.
+  euclid_lsh  the JL projection itself (already the metric space).
+  inverted_index / euclid
+              the same JL projection of the raw row (ops/knn.py
+              euclid_projection) — a distance-faithful sketch for
+              PROBING; the rescore stays the exact cosine/euclid math.
+
+Coarse partitioning: ``ops/clustering.py kmeans_fit`` for small cell
+counts; its kmeans++ seeding loop is O(K²·N) so large cell counts use
+sample-seeded Lloyd iterations (same update rule, same MXU matmuls).
+``build_super``/``assign_cells_hier`` give the two-level assignment used
+when labeling 10⁸ rows: route each row through ``n_super`` group
+centroids first — per-row cost drops from K·E to (G + M·top)·E flops.
+
+Everything here is single-device; parallel/sharded_ivf.py wraps the same
+phases in a shard_map with the log-depth cross-shard merge.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jubatus_tpu.ops.clustering import kmeans_fit, pairwise_sq_dists
+
+#: kmeans_fit's kmeans++ seeding loop is O(K²·N); above this cell count
+#: train_centroids switches to sample-seeded Lloyd (same refinement)
+_PLUS_PLUS_MAX_CELLS = 256
+
+#: lane-hash constants for the minhash embedding (splitmix-style mixer)
+_MIX1 = np.uint32(0x9E3779B9)
+_MIX2 = np.uint32(0x85EBCA6B)
+_MIX3 = np.uint32(0xC2B2AE35)
+
+
+def auto_cells(n_rows: int) -> int:
+    """Default cell count: power of two nearest √rows, floored at 8 —
+    the classical IVF balance point (probe cost ≈ rescore cost)."""
+    if n_rows <= 64:
+        return 8
+    return max(8, 2 ** int(round(math.log2(max(math.sqrt(n_rows), 8.0)))))
+
+
+# ---------------------------------------------------------------------------
+# embeddings (signature → metric-aligned float space)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("method", "hash_num"))
+def embed_signatures(sigs, *, method: str, hash_num: int):
+    """[N, W/H] signature rows → [N, E] float32 embedding whose squared
+    euclidean distance tracks the method's distance (module docstring).
+    ``euclid_lsh`` and the exact methods' stored JL projections pass
+    through unchanged."""
+    if method == "lsh":
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+        bits = (sigs[:, :, None] >> shifts[None, None, :]) & jnp.uint32(1)
+        bits = bits.reshape(sigs.shape[0], -1)[:, :hash_num]
+        return bits.astype(jnp.float32) * 2.0 - 1.0
+    if method == "minhash":
+        lane = jnp.arange(sigs.shape[1], dtype=jnp.uint32)[None, :]
+        h = (sigs + lane * _MIX1).astype(jnp.uint32)
+        h = (h ^ (h >> 16)) * _MIX2
+        h = (h ^ (h >> 13)) * _MIX3
+        h = h ^ (h >> 16)
+        return h.astype(jnp.float32) * (2.0 / 4294967295.0) - 1.0
+    # euclid_lsh + exact methods: the JL projection is the metric space
+    return sigs.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# coarse partitioner (kmeans_fit small-K; sample-seeded Lloyd at scale)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("iters",))
+def _lloyd_refine(x, centers0, *, iters: int):
+    """Unweighted Lloyd iterations from given seeds (kmeans_fit's update
+    rule, minus its O(K²·N) kmeans++ seeding loop)."""
+    k = centers0.shape[0]
+
+    def lloyd(_, centers):
+        d2 = pairwise_sq_dists(x, centers)                    # [N, k]
+        assign = jnp.argmin(d2, axis=1)
+        onehot = jax.nn.one_hot(assign, k, dtype=x.dtype)     # [N, k]
+        sums = onehot.T @ x                                   # [k, E] MXU
+        counts = jnp.sum(onehot, axis=0)[:, None]
+        return jnp.where(counts > 0,
+                         sums / jnp.maximum(counts, 1e-30), centers)
+
+    return jax.lax.fori_loop(0, iters, lloyd, centers0)
+
+
+def train_centroids(emb, n_cells: int, *, iters: int = 8,
+                    seed: int = 0) -> np.ndarray:
+    """Centroids [n_cells, E] float32 from (a sample of) the embedded
+    rows. Small cell counts ride ``clustering.kmeans_fit`` verbatim
+    (the ISSUE's coarse partitioner); larger ones seed Lloyd from a
+    deterministic row sample instead of the quadratic kmeans++ loop."""
+    emb = jnp.asarray(emb, jnp.float32)
+    n = emb.shape[0]
+    if n == 0:
+        raise ValueError("train_centroids needs at least one row")
+    if n_cells <= _PLUS_PLUS_MAX_CELLS:
+        centers, _ = kmeans_fit(emb, jnp.ones((n,), jnp.float32),
+                                k=n_cells, iters=max(iters, 1), seed=seed)
+        return np.asarray(centers, np.float32)
+    rng = np.random.default_rng(seed)
+    if n >= n_cells:
+        pick = rng.choice(n, size=n_cells, replace=False)
+    else:  # degenerate: fewer rows than cells — repeat rows as seeds
+        pick = rng.integers(0, n, size=n_cells)
+    seeds = jnp.asarray(np.asarray(emb)[np.sort(pick)])
+    return np.asarray(_lloyd_refine(emb, seeds, iters=max(iters, 1)),
+                      np.float32)
+
+
+@jax.jit
+def assign_cells(emb, centroids):
+    """Nearest-centroid cell per row: one [N, K]×[K, E] matmul expansion
+    + argmin. [N] int32."""
+    return jnp.argmin(pairwise_sq_dists(emb, centroids),
+                      axis=1).astype(jnp.int32)
+
+
+def build_super(centroids: np.ndarray, *, n_super: int,
+                seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Two-level routing tables for bulk assignment: cluster the cell
+    centroids into ``n_super`` groups → (supers [G, E] float32,
+    members [G, M] int32, −1-padded; M = max group size)."""
+    n_super = max(1, min(n_super, centroids.shape[0]))
+    supers = train_centroids(centroids, n_super, seed=seed)
+    owner = np.asarray(assign_cells(jnp.asarray(centroids),
+                                    jnp.asarray(supers)))
+    m = max(1, int(np.bincount(owner, minlength=n_super).max()))
+    members = np.full((n_super, m), -1, np.int32)
+    fill = np.zeros(n_super, np.int64)
+    for cell, g in enumerate(owner):
+        members[g, fill[g]] = cell
+        fill[g] += 1
+    return supers, members
+
+
+@functools.partial(jax.jit, static_argnames=("top_supers",))
+def assign_cells_hier(emb, centroids, supers, members, *,
+                      top_supers: int = 2):
+    """Two-level cell assignment: rank super-groups, then argmin over
+    the union of the top groups' member cells — (G + top·M)·E flops per
+    row instead of K·E. Exact when the nearest cell's group is among
+    the probed groups (overwhelmingly so for top_supers ≥ 2)."""
+    ds = pairwise_sq_dists(emb, supers)                       # [N, G]
+    top = min(top_supers, supers.shape[0])
+    _, gsel = jax.lax.top_k(-ds, top)                         # [N, top]
+    cand = members[gsel].reshape(emb.shape[0], -1)            # [N, top·M]
+    valid = cand >= 0
+    safe = jnp.maximum(cand, 0)
+    # expansion form ‖c‖² − 2⟨e, c⟩ (row's own ‖e‖² is argmin-invariant):
+    # one batched dot over the gathered centroids instead of the
+    # [N, C', E] difference tensor the naive sq-dist materializes twice
+    cn2 = jnp.sum(jnp.square(centroids), axis=-1)             # [K]
+    dots = jnp.einsum("nce,ne->nc", centroids[safe], emb)
+    d2 = jnp.where(valid, cn2[safe] - 2.0 * dots, jnp.inf)
+    best = jnp.argmin(d2, axis=1)
+    return jnp.take_along_axis(safe, best[:, None],
+                               axis=1)[:, 0].astype(jnp.int32)
+
+
+def assign_cells_grouped(emb: np.ndarray, centroids: np.ndarray,
+                         supers: np.ndarray, members: np.ndarray,
+                         top_supers: int = 2) -> np.ndarray:
+    """Bulk two-level assignment, host-side: rows GROUP by their
+    ranked super so each group is one dense [n_g, E]×[E, M] BLAS gemm
+    against a centroid block that stays cache-resident — no per-row
+    gather tensor at all. Same answer as ``assign_cells_hier``; this
+    is the 10⁸-row index-build path (ops are memory-bound there, and
+    the gather formulation moves ~100 KB per row where this moves
+    ~E·4·top bytes)."""
+    emb = np.asarray(emb, np.float32)
+    n = emb.shape[0]
+    n_super = supers.shape[0]
+    top = max(1, min(top_supers, n_super))
+    cn2 = np.sum(np.square(centroids), axis=-1)
+    sn2 = np.sum(np.square(supers), axis=-1)
+    sd = sn2[None, :] - 2.0 * (emb @ supers.T)                # [N, G]
+    if top < n_super:
+        gtop = np.argpartition(sd, top, axis=1)[:, :top]
+    else:
+        gtop = np.tile(np.arange(n_super), (n, 1))
+    out = np.zeros(n, np.int32)
+    best = np.full(n, np.inf, np.float32)
+    for t in range(top):
+        gs = gtop[:, t]
+        order = np.argsort(gs, kind="stable")
+        bounds = np.searchsorted(gs[order], np.arange(n_super + 1))
+        for g in range(n_super):
+            lo, hi = bounds[g], bounds[g + 1]
+            if lo == hi:
+                continue
+            idx = order[lo:hi]
+            cells = members[g]
+            cells = cells[cells >= 0]
+            if cells.size == 0:
+                continue
+            d = cn2[cells][None, :] - 2.0 * (emb[idx] @ centroids[cells].T)
+            am = np.argmin(d, axis=1)
+            dm = d[np.arange(len(idx)), am]
+            upd = dm < best[idx]
+            out[idx[upd]] = cells[am[upd]]
+            best[idx[upd]] = dm[upd]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# probe + candidate-shaped exact rescore
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("nprobe",))
+def probe_cells(q_emb, centroids, *, nprobe: int):
+    """Top-``nprobe`` nearest cells per query: [B, P] int32 cell ids."""
+    d2 = pairwise_sq_dists(q_emb, centroids)
+    _, cells = jax.lax.top_k(-d2, min(nprobe, centroids.shape[0]))
+    return cells.astype(jnp.int32)
+
+
+def candidate_sig_distances(q_sigs, cand_sigs, *, method: str,
+                            hash_num: int):
+    """The method's EXACT signature distance over gathered candidates —
+    the same math as the arena-wide kernels (ops/knn.py), evaluated
+    [B, C'] candidate-shaped. q_sigs [B, W/H], cand_sigs [B, C', W/H]."""
+    if method == "lsh":
+        x = jnp.bitwise_xor(q_sigs[:, None, :], cand_sigs)
+        return jnp.sum(jax.lax.population_count(x),
+                       axis=-1).astype(jnp.float32) / float(hash_num)
+    if method == "minhash":
+        match = (q_sigs[:, None, :] == cand_sigs).astype(jnp.float32)
+        return 1.0 - jnp.mean(match, axis=-1)
+    # euclid_lsh: same ||q||²−2q·r+||r||² expansion as the batch kernel
+    dots = jnp.sum(q_sigs[:, None, :] * cand_sigs, axis=-1)
+    rn = jnp.sum(cand_sigs * cand_sigs, axis=-1)
+    qn = jnp.sum(q_sigs * q_sigs, axis=-1)[:, None]
+    return jnp.sqrt(jnp.maximum(qn - 2.0 * dots + rn, 0.0)) \
+        / jnp.sqrt(float(hash_num))
+
+
+def candidate_exact_distances(q_dense, cand_idx, cand_val, *, method: str):
+    """Exact cosine/euclid distance over gathered sparse candidate rows
+    (the ops/knn.py cosine_scores / euclid_distances expansion,
+    candidate-shaped). q_dense [B, D]; cand_idx/val [B, C', K]."""
+    gathered = jax.vmap(lambda q, i: q[i])(q_dense, cand_idx)  # [B,C',K]
+    dots = jnp.sum(cand_val * gathered, axis=-1)               # [B, C']
+    rn2 = jnp.sum(cand_val * cand_val, axis=-1)
+    qn2 = jnp.sum(q_dense * q_dense, axis=-1)[:, None]
+    if method == "inverted_index":
+        denom = jnp.sqrt(rn2) * jnp.sqrt(qn2)
+        sim = jnp.where(denom > 0, dots / jnp.maximum(denom, 1e-30), 0.0)
+        return 1.0 - sim
+    return jnp.sqrt(jnp.maximum(rn2 - 2.0 * dots + qn2, 0.0))
+
+
+def _tie_ordered(scores, ids):
+    """Pin equal-score ordering: score descending, id ascending —
+    deterministic results independent of gather/merge order."""
+    order = jnp.lexsort((ids, -scores), axis=-1)
+    return (jnp.take_along_axis(scores, order, axis=-1),
+            jnp.take_along_axis(ids, order, axis=-1))
+
+
+def _probe_gather(q_emb, centroids, cell_slots, nprobe: int):
+    """Shared probe phase: [B, P·cap] candidate slot ids (−1 = padding)
+    from the top-``nprobe`` cells."""
+    cells = probe_cells(q_emb, centroids, nprobe=nprobe)      # [B, P]
+    cand = cell_slots[cells]                                  # [B, P, cap]
+    return cand.reshape(q_emb.shape[0], -1)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("method", "hash_num", "k", "nprobe"))
+def ivf_topk(q_sigs, q_emb, sig_table, centroids, cell_slots, *,
+             method: str, hash_num: int, k: int, nprobe: int):
+    """Single-device two-phase IVF query for the signature methods.
+
+    q_sigs [B, W/H] + q_emb [B, E] (embed_signatures of q_sigs);
+    sig_table [C, W/H] full arena; centroids [n_cells, E];
+    cell_slots [n_cells, cap] int32 slot ids, −1-padded.
+    Returns (distances [B, k'], slots [B, k']) — k' = min(k, P·cap),
+    non-finite distance = no candidate (slot is then meaningless)."""
+    cand = _probe_gather(q_emb, centroids, cell_slots, nprobe)
+    valid = cand >= 0
+    safe = jnp.maximum(cand, 0)
+    cand_sigs = sig_table[safe]                               # [B, C', W]
+    d = candidate_sig_distances(q_sigs, cand_sigs, method=method,
+                                hash_num=hash_num)
+    sc = jnp.where(valid, -d, -jnp.inf)
+    kk = min(k, sc.shape[-1])
+    neg, pos = jax.lax.top_k(sc, kk)
+    slots = jnp.take_along_axis(safe, pos, axis=-1)
+    neg, slots = _tie_ordered(neg, slots)
+    return -neg, slots
+
+
+@functools.partial(jax.jit, static_argnames=("method", "k", "nprobe"))
+def ivf_topk_exact(q_dense, q_emb, row_idx, row_val, centroids,
+                   cell_slots, *, method: str, k: int, nprobe: int):
+    """Single-device two-phase IVF query for the EXACT methods
+    (inverted_index/euclid): probe by the stored JL projections, rescore
+    the gathered sparse rows with the exact cosine/euclid expansion.
+    q_dense [B, D]; row_idx/val [C, K] padded sparse arena."""
+    cand = _probe_gather(q_emb, centroids, cell_slots, nprobe)
+    valid = cand >= 0
+    safe = jnp.maximum(cand, 0)
+    d = candidate_exact_distances(q_dense, row_idx[safe], row_val[safe],
+                                  method=method)
+    sc = jnp.where(valid, -d, -jnp.inf)
+    kk = min(k, sc.shape[-1])
+    neg, pos = jax.lax.top_k(sc, kk)
+    slots = jnp.take_along_axis(safe, pos, axis=-1)
+    neg, slots = _tie_ordered(neg, slots)
+    return -neg, slots

@@ -322,3 +322,130 @@ class ShardedRowStore:
             rid = rid.decode() if isinstance(rid, bytes) else rid
             self.datums[rid] = datum_decoder(d) if datum_decoder else d
         self.updated_since_mix = {}
+
+
+class CellArenas:
+    """Per-shard IVF cell arenas layered over a row store (ISSUE 16).
+
+    The physical arenas above stay the single source of truth — rows
+    never move when their cell changes, checkpoints and migration are
+    untouched. CellArenas is an INDEX over them: a host-side
+    ``id → cell`` map plus per-cell insertion-ordered member sets,
+    materialized on demand as the fixed-shape device table the IVF
+    probe gathers from:
+
+        tables[s * n_cells + c] = int32 LOCAL slots of shard s's live
+                                  members of cell c, −1-padded to a
+                                  pow2 ``cell_cap``
+
+    Sharded P(axis) over the leading dim, each device sees exactly its
+    own [n_cells, cap] block, and a gathered local slot indexes the
+    shard's own arena block directly. Flat stores (no mesh) are the
+    S = 1 special case with local slot == global slot.
+
+    Liveness is LAZY: the store can evict/remove rows without telling
+    us (LRU eviction fires inside ``set_row``); dead ids are pruned at
+    the next table build, and the ``(store.version, version)`` cache
+    key guarantees a build happens before any query sees the change.
+    ``cell_cap`` is pow2-bucketed so online insertion only recompiles
+    the query when a cell DOUBLES, not on every append.
+    """
+
+    _MIN_CAP = 8
+
+    def __init__(self, store: Any, n_cells: int) -> None:
+        if n_cells < 1:
+            raise ValueError(f"n_cells must be >= 1, got {n_cells}")
+        self.store = store
+        self.n_shards = int(getattr(store, "n_shards", 1))
+        self._members: List[Dict[str, None]] = [{} for _ in range(n_cells)]
+        self._cell_of: Dict[str, int] = {}
+        self.version = 0
+        self._table_cache: Optional[Tuple[Tuple[int, int], Any, int]] = None
+
+    @property
+    def n_cells(self) -> int:
+        return len(self._members)
+
+    def __len__(self) -> int:
+        return len(self._cell_of)
+
+    def cell_of(self, row_id: str) -> Optional[int]:
+        return self._cell_of.get(row_id)
+
+    def assign(self, row_id: str, cell: int) -> None:
+        """Bind a row to a cell (moving it if already bound elsewhere) —
+        online insertion appends to the owning cell's member set."""
+        old = self._cell_of.get(row_id)
+        if old == cell:
+            return
+        if old is not None:
+            self._members[old].pop(row_id, None)
+        self._members[cell][row_id] = None
+        self._cell_of[row_id] = cell
+        self.version += 1
+
+    def remove(self, row_id: str) -> bool:
+        cell = self._cell_of.pop(row_id, None)
+        if cell is None:
+            return False
+        self._members[cell].pop(row_id, None)
+        self.version += 1
+        return True
+
+    def add_cell(self) -> int:
+        """Append an empty cell (re-split target); returns its id."""
+        self._members.append({})
+        self.version += 1
+        return len(self._members) - 1
+
+    def members(self, cell: int) -> List[str]:
+        return list(self._members[cell])
+
+    def sizes(self) -> List[int]:
+        """Member count per cell (may include not-yet-pruned dead ids;
+        exact again after any table build)."""
+        return [len(m) for m in self._members]
+
+    def clear(self) -> None:
+        self._members = [{} for _ in self._members]
+        self._cell_of = {}
+        self.version += 1
+        self._table_cache = None
+
+    def _shard_slot(self, row_id: str) -> Optional[Tuple[int, int]]:
+        if hasattr(self.store, "shard_slot"):
+            return self.store.shard_slot(row_id)
+        g = self.store.slots.get(row_id)
+        return None if g is None else (0, g)
+
+    def device_tables(self) -> Tuple[Any, int]:
+        """(tables [S*n_cells, cap] int32 device array, cap). Dead ids
+        are pruned as a side effect; cached per (store, index) version."""
+        key = (self.store.version, self.version)
+        if self._table_cache is not None and self._table_cache[0] == key:
+            return self._table_cache[1], self._table_cache[2]
+        buckets: List[List[List[int]]] = \
+            [[[] for _ in self._members] for _ in range(self.n_shards)]
+        dead: List[Tuple[str, int]] = []
+        for cell, mem in enumerate(self._members):
+            for rid in mem:
+                loc = self._shard_slot(rid)
+                if loc is None:
+                    dead.append((rid, cell))
+                    continue
+                buckets[loc[0]][cell].append(loc[1])
+        for rid, cell in dead:
+            self._members[cell].pop(rid, None)
+            self._cell_of.pop(rid, None)
+        widest = max((len(b) for per in buckets for b in per), default=0)
+        cap = _pow2_at_least(max(widest, 1), self._MIN_CAP)
+        tab = np.full((self.n_shards * len(self._members), cap), -1,
+                      np.int32)
+        for s, per in enumerate(buckets):
+            for cell, slots in enumerate(per):
+                if slots:
+                    tab[s * len(self._members) + cell, :len(slots)] = slots
+        dev = jnp.asarray(tab)
+        self._table_cache = (key, dev, cap)
+        return dev, cap

@@ -55,9 +55,10 @@ def merge_topk(scores, ids, k: int):
     log2(S) selection passes over O(k)-sized sets instead of one flat
     [B, S·kk] sort whose cost grows linearly with the shard count.
     Selection is associative (top-k of a union == top-k of per-part
-    top-ks), so the result matches the flat merge exactly up to
-    equal-score tie order. Returns ([B, k'], [B, k']) with
-    k' = min(k, S·kk)."""
+    top-ks), so the result matches the flat merge exactly; equal-score
+    ties are pinned to ascending id so the result is deterministic and
+    independent of shard pairing order. Returns ([B, k'], [B, k'])
+    with k' = min(k, S·kk)."""
     s = scores.shape[0]
     k = min(k, s * scores.shape[2])
     while s > 1:
@@ -87,6 +88,11 @@ def merge_topk(scores, ids, k: int):
     if out_s.shape[-1] > k:
         out_s, pos = jax.lax.top_k(out_s, k)
         out_i = jnp.take_along_axis(out_i, pos, axis=-1)
+    # pin tie order: score desc, then id asc (−(−inf) = +inf keeps
+    # dead/padding sentinels last) — deterministic across shard counts
+    order = jnp.lexsort((out_i, -out_s), axis=-1)
+    out_s = jnp.take_along_axis(out_s, order, axis=-1)
+    out_i = jnp.take_along_axis(out_i, order, axis=-1)
     return out_s, out_i
 
 

@@ -47,6 +47,16 @@ class ServerArgs:
     #: budget form of --shard-devices (shard count = D / D_PER_SHARD);
     #: mutually exclusive with --shard-devices
     shard_features: int = 0
+    #: approximate-NN tier for the instance engines (NN/recommender/
+    #: anomaly): "off" = every query is the exact scan (baseline);
+    #: "ivf" = coarse k-means cells, probe top-P + exact rescore over
+    #: only the probed candidates (ops/ivf.py, parallel/sharded_ivf.py)
+    ann: str = "off"
+    #: IVF cell count; 0 = auto (power of two near √rows)
+    ann_cells: int = 0
+    #: cells probed per query — the recall/latency dial (higher = more
+    #: exact, slower)
+    ann_nprobe: int = 8
     #: FORCE every response into the pre-str8/bin msgpack format deployed
     #: jubatus clients require (their vendored msgpack predates those
     #: types); mixer internals keep the modern format (rpc/legacy.py).
@@ -293,6 +303,28 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "spelling of --shard-devices — pick the widest "
                         "slice one device holds and the layout follows. "
                         "Mutually exclusive with --shard-devices")
+    p.add_argument("--ann", choices=("off", "ivf"), default="off",
+                   help="approximate-NN tier for the instance engines "
+                        "(nearest_neighbor/recommender/anomaly): 'off' "
+                        "(default) keeps every query on the exact "
+                        "brute-force scan; 'ivf' partitions rows into "
+                        "k-means cells and answers queries by probing "
+                        "the nearest cells + an exact rescore of only "
+                        "their rows — the 10^8-row p99 drops ~50x at "
+                        ">=0.95 recall@10 (PERF_NOTES.md Round 16). "
+                        "LOF density scans and anomaly scores stay "
+                        "exact either way")
+    p.add_argument("--ann-cells", type=int, default=0, metavar="K",
+                   help="IVF cell count for --ann ivf; 0 (default) "
+                        "auto-sizes to a power of two near sqrt(rows) "
+                        "— the classical probe-cost/rescore-cost "
+                        "balance point")
+    p.add_argument("--ann-nprobe", type=int, default=8, metavar="P",
+                   help="cells probed per query for --ann ivf — the "
+                        "recall/latency dial: each probed cell adds "
+                        "~rows/cells candidates to the exact rescore; "
+                        "raise toward the cell count to converge on "
+                        "the exact result")
     p.add_argument("--legacy-wire", action="store_true",
                    help="FORCE all RPC responses into the pre-str8/bin "
                         "msgpack format legacy jubatus clients (vendored "
@@ -515,6 +547,12 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
             "--shard-features and --shard-devices are mutually exclusive "
             "(the former derives the device count from the per-device "
             "feature budget)")
+    if args.ann_cells < 0:
+        raise SystemExit("--ann-cells must be >= 0 (0 = auto)")
+    if args.ann_nprobe < 1:
+        raise SystemExit("--ann-nprobe must be >= 1")
+    if args.ann_cells and args.ann_nprobe > args.ann_cells:
+        raise SystemExit("--ann-nprobe cannot exceed --ann-cells")
     if args.rpc_port < 0 or args.rpc_port > 65535:
         raise SystemExit("--rpc-port out of range")
     if args.metrics_port > 65535:

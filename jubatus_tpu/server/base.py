@@ -68,7 +68,10 @@ class EngineServer:
                         ", ".join(fault_rules))
         self.driver = create_driver(
             engine, json.loads(config), mesh=mesh,
-            shard_features=getattr(self.args, "shard_features", 0))
+            shard_features=getattr(self.args, "shard_features", 0),
+            ann=getattr(self.args, "ann", "off"),
+            ann_cells=getattr(self.args, "ann_cells", 0),
+            ann_nprobe=getattr(self.args, "ann_nprobe", 8))
         # --fv-cache-size: rebound the converter's tokenization/name memo
         # caches (core/fv/converter.py; default matches the flag default)
         conv = getattr(self.driver, "converter", None)
@@ -753,6 +756,20 @@ class EngineServer:
                 if doc.get("topk_merge_ms") is not None:
                     self.rpc.trace.gauge("shard.topk_merge_ms",
                                          float(doc["topk_merge_ms"]))
+        # ANN index gauges (ISSUE 16): cell count, probe width, rescore
+        # candidate budget, and the shadow-query recall estimate
+        ann_stats = getattr(self.driver, "ann_stats", None)
+        if ann_stats is not None:
+            doc = ann_stats()
+            if doc:
+                self.rpc.trace.gauge("ann.cells", float(doc.get("cells", 0)))
+                self.rpc.trace.gauge("ann.probed_cells",
+                                     float(doc.get("probed_cells", 0)))
+                self.rpc.trace.gauge("ann.rescore_candidates",
+                                     float(doc.get("rescore_candidates", 0)))
+                if doc.get("recall_probe") is not None:
+                    self.rpc.trace.gauge("ann.recall_probe",
+                                         float(doc["recall_probe"]))
         self.timeseries.sample(self.rpc.trace.snapshot())
         if self.slo is not None:
             self.slo.evaluate()

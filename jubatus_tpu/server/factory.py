@@ -47,7 +47,8 @@ ROW_SHARDED_ENGINES = ("nearest_neighbor", "recommender", "anomaly")
 
 
 def create_driver(engine: str, config: Any, mesh=None,
-                  shard_features: int = 0):
+                  shard_features: int = 0, ann: str = "off",
+                  ann_cells: int = 0, ann_nprobe: int = 8):
     """Instantiate the engine's driver from a JSON config (str or dict).
 
     ``mesh`` (``--shard-devices``): span the model over a local device
@@ -61,7 +62,12 @@ def create_driver(engine: str, config: Any, mesh=None,
 
     ``shard_features`` (``--shard-features D_PER_SHARD``): linear
     engines only — derive the shard count from the per-device feature
-    budget instead of naming a device count."""
+    budget instead of naming a device count.
+
+    ``ann`` (``--ann {off,ivf}``): arm the IVF approximate-NN tier on
+    the instance engines' NN backend (ISSUE 16) — default "off" keeps
+    every query on the exact scan. ``ann_cells``/``ann_nprobe`` map to
+    ``--ann-cells``/``--ann-nprobe``."""
     if isinstance(config, str):
         config = json.loads(config)
     try:
@@ -87,11 +93,22 @@ def create_driver(engine: str, config: Any, mesh=None,
         return cls(config, mesh=mesh, shard_features=shard_features)
     if engine == "regression":
         return cls(config, mesh=mesh, shard_features=shard_features)
+    if ann != "off" and engine not in ROW_SHARDED_ENGINES:
+        raise ValueError(
+            f"--ann applies to the instance engines "
+            f"({', '.join(ROW_SHARDED_ENGINES)}), not {engine!r}")
     if engine in ROW_SHARDED_ENGINES:
         # anomaly rides sharded_distances (LOF needs full distance
         # vectors); NN/recommender ride the sharded top-k over the
         # sharded row store
-        return _maybe_attach(cls(config), mesh)
+        driver = _maybe_attach(cls(config), mesh)
+        if ann != "off":
+            backend = getattr(driver, "backend", None)
+            if backend is None:
+                raise ValueError(
+                    "--ann: this method has no NN backend to index")
+            backend.configure_ann(ann, cells=ann_cells, nprobe=ann_nprobe)
+        return driver
     if mesh is not None:
         raise ValueError(
             f"--shard-devices is not supported for engine {engine!r}; "

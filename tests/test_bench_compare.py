@@ -161,6 +161,38 @@ def test_poison_keys_gate_in_compare():
     assert len(regs) == 3
 
 
+def test_direction_inference_ann_keys():
+    """ISSUE 16 ANN tier: recall@k against the exact scan gates
+    up-good (falling recall = wrong neighbors), index build throughput
+    rides the existing _per_sec pattern, the IVF query p99 gates
+    down-good via _p99_ms like every latency key."""
+    assert bc.direction("ann_recall_at_10_rows1e8") == "higher"
+    assert bc.direction("ann_recall_at_10_rows1e6") == "higher"
+    assert bc.direction("ann_build_rows_per_sec") == "higher"
+    assert bc.direction("knn_query_p99_ms_rows1e8_8shard_ivf") == "lower"
+    # neighbors that must NOT accidentally gate
+    assert bc.direction("ann_nprobe") is None
+    assert bc.direction("ann_cells_rows1e8") is None
+
+
+def test_ann_keys_gate_in_compare():
+    old = {"ann_recall_at_10_rows1e8": 0.97,
+           "ann_build_rows_per_sec": 500000.0,
+           "knn_query_p99_ms_rows1e8_8shard_ivf": 40.0,
+           "ann_nprobe": 8}
+    new = {"ann_recall_at_10_rows1e8": 0.80,              # recall fell: bad
+           "ann_build_rows_per_sec": 650000.0,            # improved
+           "knn_query_p99_ms_rows1e8_8shard_ivf": 55.0,   # slower: bad
+           "ann_nprobe": 16}                              # info only
+    rows, regs = bc.compare(bc.flatten(old), bc.flatten(new))
+    verdicts = {r["key"]: r["verdict"] for r in rows}
+    assert verdicts["ann_recall_at_10_rows1e8"] == "REGRESSED"
+    assert verdicts["ann_build_rows_per_sec"] == "improved"
+    assert verdicts["knn_query_p99_ms_rows1e8_8shard_ivf"] == "REGRESSED"
+    assert verdicts["ann_nprobe"] == "info"
+    assert len(regs) == 2
+
+
 def test_direction_inference_scaling_keys():
     """ISSUE 9 scaling plane: wire bytes per HOST gate down-good (the
     hierarchical reduce's whole claim), the reduction factor up-good —

@@ -81,6 +81,43 @@ def test_full_gather_gate_fires_and_pragma_opts_out(tmp_path):
                 if "full-matrix device_get" in p]
 
 
+def test_full_scan_gate_fires_and_pragma_opts_out(tmp_path):
+    """The ANN query-path rule (ISSUE 16): an arena-wide distance sweep
+    inside an ivf module is flagged; the # full-scan-ok pragma and the
+    candidate-only rescore kernels are not, and non-ivf modules are
+    exempt."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo / "tools" / "codestyle"))
+    try:
+        import check as codestyle
+    finally:
+        sys.path.pop(0)
+    d = tmp_path / "jubatus_tpu" / "ops"
+    d.mkdir(parents=True)
+    bad = d / "ivf_extra.py"
+    bad.write_text(
+        '"""doc."""\n'
+        "from jubatus_tpu.ops import knn\n"
+        "a = knn._hamming_distances_batch_xla(q, rows, hash_num=64)\n"  # hit
+        "b = knn.cosine_scores(ri, rv, qd)\n"                           # hit
+        "c = sharded_distances(mesh, q, rows)\n"                        # hit
+        "d = knn.cosine_scores(ri, rv, qd)  # full-scan-ok - probe\n"
+        "e = candidate_sig_distances(qs, cand, method=m, hash_num=h)\n",
+        encoding="utf-8")
+    problems = codestyle.check_file(str(bad))
+    hits = [p for p in problems if "arena-wide distance sweep" in p]
+    assert len(hits) == 3, problems
+    assert ":3:" in hits[0] and ":4:" in hits[1] and ":5:" in hits[2]
+    # the same sweep OUTSIDE an ivf module stays legal (it IS the
+    # exact path there)
+    ok = d / "knn_like.py"
+    ok.write_text(
+        '"""doc."""\n'
+        "a = knn.cosine_scores(ri, rv, qd)\n", encoding="utf-8")
+    assert not [p for p in codestyle.check_file(str(ok))
+                if "arena-wide distance sweep" in p]
+
+
 def test_metrics_docs_catalog_clean():
     """The metric-catalog gate (ISSUE 7): every literal counter/gauge
     key exported through the tracing registry must appear in the

@@ -671,3 +671,53 @@ def test_fault_flag_arms_at_server_boot(tmp_path):
     finally:
         srv.stop()
         faults.disarm_all()
+
+
+def test_ann_rebuild_fault_degrades_to_exact_scan():
+    """ISSUE 16 fault site ``ann.rebuild``: an injected index-build
+    failure degrades the ANN tier to the exact scan — counted, evented,
+    and NEVER wrong-answering (the degraded tier's results match a
+    backend that never armed ANN at all)."""
+    import numpy as np
+
+    from jubatus_tpu.models._nn_backend import NNBackend
+    from jubatus_tpu.utils import events
+
+    rng = np.random.default_rng(7)
+
+    def vec():
+        idx = rng.integers(1, 64, size=6)
+        val = rng.normal(size=6)
+        return [(int(i), float(v)) for i, v in zip(idx, val)]
+
+    rows = {f"r{i}": vec() for i in range(160)}
+    plain = NNBackend("lsh", dim=64, hash_num=64)
+    ann = NNBackend("lsh", dim=64, hash_num=64)
+    ann.configure_ann("ivf", cells=4, nprobe=2)
+    for rid, v in rows.items():
+        plain.set_row(rid, v)
+        ann.set_row(rid, v)
+
+    j = events.default_journal()
+    cursor = max([r["hlc"] for r in j.snapshot()] or [0])
+    q = vec()
+    with faults.armed("ann.rebuild:error"):
+        got = ann.neighbors(q, 5)          # build attempt fires the fault
+    want = plain.neighbors(q, 5)
+    assert got == want                      # degraded == exact, not wrong
+    st = ann.ann_stats()
+    assert st["degraded"] is True and st["built"] is False
+    assert st["rebuild_failed"] == 1
+    evs = j.snapshot(since=cursor, grep="ann")
+    assert any(e["type"] == "degraded" and e["subsystem"] == "ann"
+               for e in evs)
+    # the latch is sticky: later queries stay exact with no retry storm
+    q2 = vec()
+    assert ann.neighbors(q2, 5) == plain.neighbors(q2, 5)
+    assert ann.ann_stats()["rebuild_failed"] == 1
+    # and explicit re-configure re-arms the tier cleanly
+    ann.configure_ann("ivf", cells=4, nprobe=4)
+    assert ann.ann_stats()["degraded"] is False
+    res = ann.neighbors(q2, 5)
+    assert ann.ann_stats()["built"] is True
+    assert [r for r, _ in res]              # non-empty approximate answer

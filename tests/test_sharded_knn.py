@@ -72,3 +72,56 @@ def test_sharded_topk_k_larger_than_shard(shard_mesh, rng):
     full = np.asarray(knn._hamming_distances_batch_xla(q, rows, hash_num=64))
     np.testing.assert_allclose(np.sort(np.asarray(dist), axis=1),
                                np.sort(full, axis=1)[:, :k], atol=1e-6)
+
+
+# -- merge_topk edge cases (ISSUE 16 satellite) ------------------------------
+
+def _merge(scores, ids, k):
+    from jubatus_tpu.parallel.sharded_knn import merge_topk
+    s, i = merge_topk(jnp.asarray(scores, jnp.float32),
+                      jnp.asarray(ids, jnp.int32), k)
+    return np.asarray(s), np.asarray(i)
+
+
+def test_merge_topk_k_exceeds_live_rows():
+    """k past the live-candidate count: the dead (-inf) sentinels fill
+    the tail slots and every live candidate still surfaces, ordered."""
+    ninf = -np.inf
+    scores = np.array([[[5.0, ninf, ninf, ninf]],
+                       [[3.0, 2.0, ninf, ninf]],
+                       [[ninf, ninf, ninf, ninf]],
+                       [[9.0, ninf, ninf, ninf]]])  # [S=4, B=1, kk=4]
+    ids = np.arange(16, dtype=np.int32).reshape(4, 1, 4)
+    s, i = _merge(scores, ids, k=10)
+    assert s.shape == (1, 10)
+    live = s[0][np.isfinite(s[0])]
+    np.testing.assert_allclose(live, [9.0, 5.0, 3.0, 2.0])
+    assert list(i[0][:4]) == [12, 0, 4, 5]
+    assert not np.isfinite(s[0][4:]).any()
+
+
+def test_merge_topk_all_dead_shards():
+    """Every slot dead (fresh/empty arenas): the merge must return a
+    full [B, k] frame of non-finite scores, not crash or fabricate."""
+    scores = np.full((8, 2, 4), -np.inf)
+    ids = np.zeros((8, 2, 4), np.int32)
+    s, i = _merge(scores, ids, k=4)
+    assert s.shape == (2, 4) and i.shape == (2, 4)
+    assert not np.isfinite(s).any()
+
+
+def test_merge_topk_cross_shard_ties_pin_ascending_id():
+    """Equal scores arriving from different shards order by ascending
+    id regardless of shard pairing — the determinism contract the ANN
+    and exact paths both lean on for reproducible answers."""
+    scores = np.array([[[1.0, 0.5]], [[1.0, 0.25]],
+                       [[1.0, 0.125]], [[1.0, 0.0625]]])  # 4-way tie at 1.0
+    ids = np.array([[[30, 31]], [[10, 11]],
+                    [[20, 21]], [[0, 1]]], np.int32)
+    s, i = _merge(scores, ids, k=4)
+    np.testing.assert_allclose(s[0], [1.0, 1.0, 1.0, 1.0])
+    assert list(i[0]) == [0, 10, 20, 30]
+    # shard order reversed → identical answer
+    s2, i2 = _merge(scores[::-1].copy(), ids[::-1].copy(), k=4)
+    np.testing.assert_allclose(s2[0], s[0])
+    assert list(i2[0]) == list(i[0])

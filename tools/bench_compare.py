@@ -53,9 +53,15 @@ DEFAULT_TOLERANCE = 0.05
 #: means the guard stopped catching it — a regression exactly like a
 #: throughput drop (its companion drift/recovery keys are down-good
 #: via the _LOWER patterns).
+#: ``_recall_at_`` covers the ANN tier (ISSUE 16): recall@k of the IVF
+#: approximate top-k against the exact scan — any fall means the index
+#: started returning wrong neighbors, the one regression an ANN tier
+#: must never trade for speed. Its build throughput rides the existing
+#: ``_per_sec`` pattern (``ann_build_rows_per_sec``).
 _HIGHER = re.compile(
     r"(_per_sec($|_)|samples_per_sec|_speedup($|_)|_fraction($|_)"
-    r"|_reduction($|_)|_capacity_per_replica($|_)|_quarantined($|_))")
+    r"|_reduction($|_)|_capacity_per_replica($|_)|_quarantined($|_)"
+    r"|_recall_at_)")
 #: key patterns whose smaller values are better. ``_per_host`` covers
 #: the hierarchical-mix scaling plane (ISSUE 9): wire bytes each host
 #: ships per round — the quantity the two-tier reduce holds down, so

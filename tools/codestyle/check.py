@@ -84,6 +84,26 @@ _FULL_GATHER_RE = re.compile(
     r"\bjax\s*\.\s*device_get\(|\bdevice_get\(|\bprocess_allgather\(")
 
 
+#: ANN query-path gate (ISSUE 16): the IVF tier's entire reason to
+#: exist is that a query touches only the probed cells' rows — a call
+#: to any ARENA-WIDE scorer (the batch distance kernels that sweep
+#: every row, or the sharded full scan) inside an ``ivf`` module
+#: silently reintroduces the exact-scan cliff the tier removed while
+#: still reporting "approximate" latencies. Score gathered candidates
+#: with ops/ivf.py's candidate_* kernels instead. The rare legitimate
+#: full sweep (a recall-probe shadow query, a rebuild pass) opts out
+#: per line with a ``# full-scan-ok`` pragma stating why.
+_FULL_SCAN_RE = re.compile(
+    r"\b(_?hamming_distances_batch(_xla)?|_?minhash_distances_batch(_xla)?"
+    r"|euclid_lsh_distances_batch|cosine_scores|euclid_distances"
+    r"|sharded_distances)\s*\(")
+
+
+def _is_ann_query_path(posix_path: str) -> bool:
+    return ("/jubatus_tpu/" in posix_path
+            and "ivf" in os.path.basename(posix_path))
+
+
 #: serving hot-path directories where a per-datum ``converter.convert()``
 #: call INSIDE a loop/comprehension is the featurization cliff the batch
 #: pipeline exists to remove (ISSUE 5: ~29x between per-datum convert and
@@ -296,6 +316,7 @@ def check_file(path: str) -> List[str]:
         d in posix for d in HOST_CAST_DIRS)
     full_gather = path.endswith(".py") and any(
         d in posix for d in FULL_GATHER_DIRS)
+    ann_path = path.endswith(".py") and _is_ann_query_path(posix)
     span_timed = path.endswith(".py") and _is_span_timed(posix)
     for i, line in enumerate(text.splitlines(), 1):
         if "\t" in line and not allow_tabs:
@@ -323,6 +344,15 @@ def check_file(path: str) -> List[str]:
                 "per-shard chunks via sharded_model.shard_chunks or read "
                 "back reduced results only; append '# full-gather-ok — "
                 "<why>' where a full readback is genuinely required)")
+        if ann_path and "# full-scan-ok" not in line and \
+                _FULL_SCAN_RE.search(line):
+            problems.append(
+                f"{path}:{i}: arena-wide distance sweep in an ANN query "
+                "path (scanning every row reintroduces the exact-scan "
+                "cliff the IVF tier removed — rescore only the probed "
+                "cells' gathered candidates via ops/ivf.py candidate_* "
+                "kernels; append '# full-scan-ok — <why>' where a full "
+                "sweep is genuinely required)")
         if hot_time and "time.time()" in line and "# wall-clock" not in line:
             problems.append(
                 f"{path}:{i}: raw time.time() in a hot-path module (use "
