@@ -221,7 +221,8 @@ def run(transport: str = "python", workload: str = "numeric",
         conf: dict = CONF, measure: float = MEASURE_SECONDS,
         tag: str = "", microbatch: int = 0, native_ingest: bool = True,
         forensics: bool = True, model_health=None,
-        profile_hz=None, events_enabled=None, seed=None) -> dict:
+        profile_hz=None, events_enabled=None, quality=None,
+        seed=None) -> dict:
     from jubatus_tpu.server import EngineServer
     from jubatus_tpu.server.args import ServerArgs
 
@@ -260,6 +261,16 @@ def run(transport: str = "python", workload: str = "numeric",
     if events_enabled is False:
         health_args["event_capacity"] = 0
         health_args["incident_window"] = 0.0
+    # quality (ISSUE 17): None keeps the stock server (data-quality
+    # plane at its default sampling); True arms it at the documented
+    # production rate (5% of train/score rows feed the sketches);
+    # False disarms it entirely (sample 0.0 = admit() never fires,
+    # recorder calls are a single float compare) — the honest "off"
+    # side of the quality-overhead A/B
+    if quality is True:
+        health_args["quality_sample"] = 0.05
+    elif quality is False:
+        health_args["quality_sample"] = 0.0
     try:
         srv = EngineServer(
             "classifier", conf,
@@ -634,6 +645,320 @@ def run_profiling_overhead(transport: str = "python",
     if r_p99:
         out["e2e_profiling_overhead_p99_ratio"] = round(
             float(_np.median(r_p99)), 4)
+    return out
+
+
+def run_quality_overhead(transport: str = "python",
+                         measure: float = TEXT_MEASURE_SECONDS,
+                         pairs: int = 3) -> dict:
+    """ISSUE 17: the data-quality plane ships with its serving cost
+    measured. Adjacent A/B PAIRS on the classify plane — recorder
+    armed at the documented 5% sample vs ``--quality-sample 0`` (the
+    off side's recorder calls collapse to one float compare in
+    ``admit``) — through the Python converter so the ``convert_batch``
+    recording hook sits ON the measured path. Same protocol and <2%
+    budget as run_profiling_overhead: a single pair swings ~±10% on
+    the shared core, so the verdict is the MEDIAN-of-pairs mean ratio,
+    with the median p50 ratio held to one histogram bucket step
+    (~19%)."""
+    out: dict = {}
+    r_p50, r_mean = [], []
+    for i in range(max(1, pairs)):
+        sides = {}
+        for tag, armed in (("quality_on", True), ("quality_off", False)):
+            try:
+                r = run(transport, workload="classify", measure=measure,
+                        tag=tag, native_ingest=False, quality=armed)
+            except Exception as e:  # noqa: BLE001 — partial beats none
+                out[f"e2e_{tag}_error"] = repr(e)[:200]
+                continue
+            if i == 0:
+                out.update(r)  # per-side keys of record: first pair
+            sides[tag] = r
+        for key, acc in (("p50_ms", r_p50), ("mean_ms", r_mean)):
+            on = sides.get("quality_on", {}).get(
+                f"e2e_rpc_classify_{key}_quality_on")
+            off = sides.get("quality_off", {}).get(
+                f"e2e_rpc_classify_{key}_quality_off")
+            if on and off:
+                acc.append(on / off)
+    import numpy as _np
+
+    if r_p50 and r_mean:
+        med_p50 = float(_np.median(r_p50))
+        med_mean = float(_np.median(r_mean))
+        out["e2e_quality_overhead_p50_ratio"] = round(med_p50, 4)
+        out["e2e_quality_overhead_mean_ratio"] = round(med_mean, 4)
+        out["e2e_quality_overhead_ok"] = bool(
+            med_mean <= 1.02 and med_p50 <= 1.19)
+        out["e2e_quality_overhead_note"] = (
+            f"median of {len(r_mean)} adjacent on/off pairs; the mean "
+            "ratio carries the <2% verdict, p50 is bucket-quantized "
+            "(~19% steps)")
+    return out
+
+
+def run_quality_prequential(batches: int = 80, batch: int = 40,
+                            holdout: int = 400) -> dict:
+    """ISSUE 17: the prequential (test-then-train) estimate must TRACK
+    reality. Margin-separated linear labels (PA converges within the
+    first batches), microbatch OFF so the train handler's current-model
+    scoring is synchronous and deterministic, one quality window that
+    never rolls. After training, a FRESH holdout is classified with the
+    final model; the streaming estimate must sit within one point of
+    that held-out accuracy (``e2e_prequential_tracks_holdout_ok``)."""
+    import numpy as np
+    from jubatus_tpu.client import Datum
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    rng = np.random.default_rng(SEED)
+    w = rng.standard_normal(8)
+    w /= float(np.linalg.norm(w))
+
+    def draw(n):
+        rows = []
+        while len(rows) < n:
+            x = rng.uniform(-1.0, 1.0, size=8)
+            m = float(x @ w)
+            if abs(m) < 0.3:  # margin: PA separates this in one pass
+                continue
+            rows.append(("pos" if m > 0 else "neg",
+                         Datum({f"f{j}": float(x[j]) for j in range(8)})))
+        return rows
+
+    prev = os.environ.get("JUBATUS_TPU_NATIVE_RPC")
+    os.environ["JUBATUS_TPU_NATIVE_RPC"] = "0"
+    srv = None
+    out: dict = {}
+    try:
+        srv = EngineServer(
+            "classifier", CONF,
+            args=ServerArgs(engine="classifier", listen_addr="127.0.0.1",
+                            thread=4, microbatch_max=0,
+                            telemetry_interval=0.0, quality_sample=1.0,
+                            quality_window=1e6))
+        port = srv.start(0)
+        with RpcClient("127.0.0.1", port, timeout=120.0) as c:
+            for _ in range(batches):
+                c.call("train", "quality",
+                       [[lab, d.to_msgpack()] for lab, d in draw(batch)])
+            ok = n = 0
+            rows = draw(holdout)
+            for i in range(0, len(rows), 50):
+                chunk = rows[i:i + 50]
+                ranked = c.call("classify", "quality",
+                                [d.to_msgpack() for _lab, d in chunk])
+                for (lab, _d), r in zip(chunk, ranked):
+                    n += 1
+                    if not r:
+                        continue
+                    top = max(r, key=lambda kv: float(kv[1]))[0]
+                    if isinstance(top, bytes):
+                        top = top.decode()
+                    ok += int(top == lab)
+        st = srv.quality.stats()
+    finally:
+        if srv is not None:
+            srv.stop()
+        if prev is None:
+            os.environ.pop("JUBATUS_TPU_NATIVE_RPC", None)
+        else:
+            os.environ["JUBATUS_TPU_NATIVE_RPC"] = prev
+    preq = st.get("prequential_accuracy")
+    hold = round(ok / max(n, 1), 4)
+    out["e2e_prequential_accuracy"] = preq
+    out["e2e_holdout_accuracy"] = hold
+    out["e2e_prequential_scored_rows"] = st.get("scored_rows", 0)
+    if preq is not None:
+        out["e2e_prequential_tracks_holdout_ok"] = bool(
+            abs(preq - hold) <= 0.01 + 1e-9)
+    return out
+
+
+def run_quality_drift_drill(nproc: int = 4, shift_at: float = 15.0,
+                            magnitude: float = 1.5, window_s: float = 6.0,
+                            base_rate: float = 80.0,
+                            threshold: float = 0.2) -> dict:
+    """ISSUE 17 drill: a seeded mid-run covariate+concept shift
+    (fleet_sim ``--shift-at``) must light the whole reporting chain:
+    ``quality.drift.<group>`` crosses the threshold within two windows
+    of the shift, the drift SLO (plain ``gauge:`` grammar — zero new
+    SLO machinery) fires, and exactly ONE incident bundle captures the
+    offending feature group's reference/live sketch pair.
+
+    ``e2e_drift_baseline_psi`` is the pre-shift false-alarm level
+    (down-good: a rising baseline means the detector is noisy);
+    ``e2e_shift_peak_score`` records the drill's magnitude for context
+    (its absolute value tracks the injected shift, not code quality).
+
+    Sizing: clean-window PSI noise rides the number of DISTINCT user
+    draws per group-window (``call_batch`` duplicates the same datum,
+    adding no information). 80 req/s over 6 s windows gives the
+    smallest tenant (ads, weight 0.2) ~96 draws/window — enough to
+    hold the clean-phase level under the 0.2 operating point."""
+    import tempfile
+
+    from jubatus_tpu.client import Datum
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from bench_mix import scrub_child_env
+
+    fleet_sim = _fleet_sim()
+    seconds = 2.0 * shift_at  # symmetric clean/shifted phases
+    model = fleet_sim.TrafficModel(
+        seed=SEED, base_rate=base_rate, diurnal_amplitude=0.0,
+        shift_at=shift_at, shift_magnitude=magnitude)
+    feature_groups = {t[:2] for t, _w in model.tenants}
+    prev = os.environ.get("JUBATUS_TPU_NATIVE_RPC")
+    prev_ing = os.environ.get("JUBATUS_TPU_NATIVE_INGEST")
+    os.environ["JUBATUS_TPU_NATIVE_RPC"] = "0"
+    # Python ingest: feature NAMES must reach the recorder so drift
+    # lands in the per-tenant groups the incident is meant to name
+    # (the native raw path records under the one "hashed" group)
+    os.environ["JUBATUS_TPU_NATIVE_INGEST"] = "0"
+    inc_dir = tempfile.mkdtemp(prefix="jubatus_quality_drill_")
+    srv = None
+    res: dict = {}
+    records: list = []
+    stop = threading.Event()
+    out: dict = {
+        "e2e_shift_at_s": shift_at, "e2e_shift_magnitude": magnitude,
+        "e2e_quality_window_s": window_s}
+    try:
+        srv = EngineServer(
+            "classifier", CONF,
+            args=ServerArgs(
+                engine="classifier", name="fleet",
+                listen_addr="127.0.0.1", thread=32,
+                interval_sec=1e9, interval_count=1 << 30,
+                telemetry_interval=1.0,
+                quality_sample=1.0, quality_window=window_s,
+                quality_ref_windows=1,
+                slo=[f"drift=gauge:quality.drift.max:{threshold:g}"],
+                slo_fast_window=window_s, slo_slow_window=2 * window_s,
+                incident_dir=inc_dir))
+        port = srv.start(0)
+        # warm the jit caches before the clock starts (the first train
+        # compiles ~seconds and would eat the clean phase) WITHOUT
+        # letting the constant warm-up rows pollute the reference
+        # window the clean traffic pins
+        srv.quality.arm(sample=0.0)
+        warm = [["a", Datum({f"{t[:2]}{j}": 0.5 for j in range(8)}
+                            ).to_msgpack()] for t, _w in model.tenants]
+        with RpcClient("127.0.0.1", port, timeout=120.0) as c:
+            c.call("train", "fleet", warm * 4)
+        srv.rpc.trace.reset()
+
+        from jubatus_tpu.utils.quality import OUTPUT_DRIFT_KEYS
+
+        def monitor():
+            while not stop.wait(0.5):
+                try:
+                    scores = {g: v for g, v in
+                              srv.quality.drift_scores().items()
+                              if g not in OUTPUT_DRIFT_KEYS}
+                    records.append({
+                        "ts": time.time(),
+                        "drift_max": max(scores.values())
+                        if scores else 0.0,
+                        "alerts": [a["name"] for a in
+                                   (srv.slo.alerts() if srv.slo
+                                    else [])]})
+                except Exception:  # noqa: BLE001 — bench monitor
+                    pass
+
+        mon = threading.Thread(target=monitor, daemon=True,
+                               name="quality-drill-monitor")
+        mon.start()
+        # re-arm just after the workers' start barrier falls, so the
+        # first live window (-> the pinned reference) covers exactly
+        # one window of real traffic, not the idle warm-up stretch
+        rearm = threading.Timer(5.3, srv.quality.arm, kwargs={
+            "sample": 1.0})
+        rearm.daemon = True
+        rearm.start()
+        res = fleet_sim.drive(
+            port, model, nproc, seconds, cluster="fleet",
+            workload="train", call_batch=4, lat_slo_ms=1000.0,
+            inflight_cap=16, start_delay_s=5.0,
+            env=scrub_child_env(os.environ))
+        # grace: the final window's drift + the SLO's slow-burn window
+        # may settle a few ticks after the trace ends
+        deadline = time.monotonic() + 3.0 * window_s
+        while time.monotonic() < deadline:
+            if records and records[-1]["alerts"]:
+                break
+            time.sleep(0.5)
+        stop.set()
+        mon.join(timeout=5.0)
+        scores = srv.quality.drift_scores()
+        inc = srv.incidents.list()
+        bundles = inc.get("incidents", [])
+        inc_doc = (srv.incidents.get(bundles[0]["id"])
+                   if len(bundles) == 1 else {})
+    finally:
+        stop.set()
+        if srv is not None:
+            srv.stop()
+        if prev is None:
+            os.environ.pop("JUBATUS_TPU_NATIVE_RPC", None)
+        else:
+            os.environ["JUBATUS_TPU_NATIVE_RPC"] = prev
+        if prev_ing is None:
+            os.environ.pop("JUBATUS_TPU_NATIVE_INGEST", None)
+        else:
+            os.environ["JUBATUS_TPU_NATIVE_INGEST"] = prev_ing
+    if res.get("dead"):
+        out["e2e_drift_drill_dead_clients"] = "; ".join(res["dead"])
+    shift_wall = res.get("t0_wall", 0.0) + shift_at
+    clean = [r["drift_max"] for r in records if r["ts"] < shift_wall]
+    out["e2e_drift_baseline_psi"] = round(max(clean), 4) if clean else 0.0
+    out["e2e_shift_peak_score"] = round(
+        max((r["drift_max"] for r in records), default=0.0), 4)
+    first = next((r for r in records if r["ts"] >= shift_wall
+                  and r["drift_max"] > threshold), None)
+    lag = round(first["ts"] - shift_wall, 1) if first else -1.0
+    out["e2e_drift_detection_lag_s"] = lag
+    # "within two windows" with one tick of slack: the live window only
+    # crosses min-count ~a second into the shifted regime
+    out["e2e_drift_detected_ok"] = bool(
+        first is not None and lag <= 2.0 * window_s + 1.5)
+    out["e2e_drift_slo_fired_ok"] = any(
+        "drift" in r["alerts"] for r in records)
+    feat = {g: v for g, v in scores.items() if g in feature_groups}
+    if feat:
+        out["e2e_shift_group"] = max(feat.items(),
+                                     key=lambda kv: kv[1])[0]
+    out["e2e_drift_incident_count"] = len(bundles)
+    top = (inc_doc.get("quality") or {}).get("top_drift_group", "") \
+        if inc_doc else ""
+    out["e2e_drift_incident_ok"] = bool(
+        len(bundles) == 1 and top in feature_groups)
+    if top:
+        out["e2e_drift_incident_group"] = top
+    return out
+
+
+def run_quality(transport: str = "python",
+                measure: float = TEXT_MEASURE_SECONDS) -> dict:
+    """ISSUE 17 slice: quality-plane overhead A/B + prequential-vs-
+    holdout tracking + the seeded concept-shift drill."""
+    out: dict = {}
+    try:
+        out.update(run_quality_overhead(transport, measure))
+    except Exception as e:  # noqa: BLE001 — partial results beat none
+        out["e2e_quality_overhead_error"] = repr(e)[:200]
+    try:
+        out.update(run_quality_prequential())
+    except Exception as e:  # noqa: BLE001
+        out["e2e_prequential_error"] = repr(e)[:200]
+    try:
+        out.update(run_quality_drift_drill())
+    except Exception as e:  # noqa: BLE001
+        out["e2e_drift_drill_error"] = repr(e)[:200]
     return out
 
 
@@ -2351,6 +2676,13 @@ def collect(trials: int = 2) -> dict:
         out.update(run_event_plane_overhead(text_tr))
     except Exception as e:  # noqa: BLE001
         out["e2e_event_plane_overhead_error"] = repr(e)[:200]
+    # data-quality plane (ISSUE 17): recorder overhead A/B (<2% mean),
+    # prequential-vs-holdout tracking, and the seeded concept-shift
+    # drill (drift detection -> SLO -> incident bundle)
+    try:
+        out.update(run_quality(text_tr))
+    except Exception as e:  # noqa: BLE001
+        out["e2e_quality_error"] = repr(e)[:200]
     # proxy tier: same numeric workload through the proxy hop. The
     # REPORTED keys stay best-of, but the ratio uses median-vs-median
     # over ADJACENT alternating (proxy, direct) pairs: the direct side
@@ -2453,6 +2785,13 @@ if __name__ == "__main__":
         # the event-plane slice on its own (overhead A/B + per-emit
         # microbench), for ISSUE 14 iteration without the full bench
         print(json.dumps(run_event_plane_overhead(
+            measure=float(sys.argv[2]) if len(sys.argv) > 2
+            else TEXT_MEASURE_SECONDS), indent=1))
+    elif len(sys.argv) > 1 and sys.argv[1] == "quality":
+        # the data-quality slice on its own (overhead A/B +
+        # prequential tracking + concept-shift drill), for ISSUE 17
+        # iteration without the full bench
+        print(json.dumps(run_quality(
             measure=float(sys.argv[2]) if len(sys.argv) > 2
             else TEXT_MEASURE_SECONDS), indent=1))
     elif len(sys.argv) > 1 and sys.argv[1] == "asyncmix":

@@ -36,7 +36,11 @@ observe-only tick. ``profile`` (ISSUE 8) scrapes every member's folded stack sam
 them into ONE cluster profile, and renders a top-N self/cumulative
 table — or ``--folded`` collapsed-stack lines for flamegraph.pl /
 speedscope; ``--device`` lists or triggers on-demand XLA captures
-(``profile_device``) instead.
+(``profile_device``) instead. ``quality`` (ISSUE 17) scrapes the
+data-quality plane (``get_quality``; proxies fold the fleet) and
+renders per-group PSI drift vs the pinned reference, prequential
+(test-then-train) accuracy, the confidence-calibration table, and the
+recent accuracy/drift trend — see docs/OBSERVABILITY.md §10.
 Server flags (-C/-T/-D/-X/-S/-I/...) are forwarded to visor-spawned
 processes (jubactl.cpp:90-110).
 """
@@ -60,7 +64,7 @@ def _parser() -> argparse.ArgumentParser:
                             "metrics", "breakers", "trace", "alerts",
                             "watch", "profile", "drain", "rebalance",
                             "autoscale", "timeline", "incident",
-                            "rollback"])
+                            "rollback", "quality"])
     p.add_argument("trace_id", nargs="?", default="",
                    help="[trace] trace id to assemble (from a slow-log "
                         "record, a /metrics exemplar, or "
@@ -500,6 +504,122 @@ def show_alerts(coord: Coordinator, engine: str, name: str) -> int:
     return 0
 
 
+def collect_quality(coord: Coordinator, engine: str,
+                    name: str) -> Dict[str, Dict[str, Any]]:
+    """Every member's ``get_quality`` doc keyed by node name. A proxy
+    answers for the whole fleet in one call (broadcast + fold), so try
+    proxies first and fall back to scraping members directly."""
+    docs: Dict[str, Dict[str, Any]] = {}
+    for pxy in _proxies(coord):
+        try:
+            with RpcClient(pxy.host, pxy.port, timeout=10.0) as c:
+                per_node = c.call("get_quality", name)
+        except Exception as e:  # noqa: BLE001 — fall back to members
+            print(f"  <{pxy.name}: get_quality failed: {e}>",
+                  file=sys.stderr)
+            continue
+        docs.update({k: v for k, v in (per_node or {}).items() if v})
+    if docs:
+        return docs
+    for node in membership.get_all_nodes(coord, engine, name):
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                per_node = c.call("get_quality", name)
+        except Exception as e:  # noqa: BLE001 — partial view beats none
+            print(f"  <{node.name}: get_quality failed: {e}>",
+                  file=sys.stderr)
+            continue
+        docs.update({k: v for k, v in (per_node or {}).items() if v})
+    return docs
+
+
+def render_quality(engine: str, name: str,
+                   docs: Dict[str, Dict[str, Any]]) -> str:
+    """The ``-c quality`` view (pure; asserted by tests): fleet-merged
+    per-feature drift table, prequential accuracy trend, calibration
+    bins. Fleet drift is recomputed from the MERGED sketches
+    (utils/quality.merge_quality), not averaged node scores."""
+    from jubatus_tpu.utils import quality as q
+
+    lines: List[str] = []
+    fleet = q.merge_quality(list(docs.values()))
+    lines.append(f"{engine}/{name}: data quality across "
+                 f"{fleet['nodes']} node(s), "
+                 f"sample {fleet.get('sample', 0.0):g}")
+    drift = fleet.get("drift") or {}
+    ref = fleet.get("reference") or {}
+    live = fleet.get("live") or {}
+    if drift:
+        lines.append(f"  {'feature group':<24} {'psi':>8}  "
+                     f"{'ref n':>9} {'live n':>9}  verdict")
+        for g in sorted(drift, key=lambda g: -drift[g]):
+            rn = int(((ref.get("features") or {}).get(g) or {})
+                     .get("count", 0)) if g not in (
+                "labels", "label_predictions") else \
+                int((ref.get("labels") or {}).get("total", 0))
+            ln_ = int(((live.get("features") or {}).get(g) or {})
+                      .get("count", 0)) if g not in (
+                "labels", "label_predictions") else \
+                int((live.get("labels") or {}).get("total", 0))
+            verdict = "DRIFTING" if drift[g] >= q.DEFAULT_DRIFT_THRESHOLD \
+                else "ok"
+            lines.append(f"  {g:<24} {drift[g]:>8.3f}  "
+                         f"{rn:>9} {ln_:>9}  {verdict}")
+    else:
+        lines.append("  (no drift scores yet — reference window still "
+                     "filling, or the quality plane is disarmed)")
+    preq = fleet.get("prequential") or {}
+    n = int(preq.get("n", 0))
+    if n:
+        acc = q.prequential_accuracy(preq)
+        mae = q.prequential_mae(preq)
+        bits = [f"prequential n={n}"]
+        if preq.get("correct") or (acc is not None and acc > 0):
+            bits.append(f"accuracy {acc:.4f}")
+        if preq.get("abs_err"):
+            bits.append(f"mae {mae:.4f}")
+        ece = q.calibration_ece(preq)
+        if ece is not None and any(int(r[0]) for r in
+                                   (preq.get("conf") or [])):
+            bits.append(f"ece {ece:.4f}")
+        lines.append("  " + "  ".join(bits))
+        conf = preq.get("conf") or []
+        if any(int(r[0]) for r in conf):
+            lines.append(f"  {'confidence':<12} {'n':>7} "
+                         f"{'accuracy':>9} {'mean conf':>10}")
+            for i, (cn, correct, conf_sum) in enumerate(conf):
+                if not cn:
+                    continue
+                lines.append(
+                    f"  [{i / 10:.1f},{(i + 1) / 10:.1f}){'':<3} {cn:>7} "
+                    f"{correct / cn:>9.3f} {conf_sum / cn:>10.3f}")
+    else:
+        lines.append("  (no prequential scores yet — the hook samples "
+                     "the train path; raise --quality-sample)")
+    trend = fleet.get("trend") or []
+    accs = [p["accuracy"] for p in trend if p.get("accuracy") is not None]
+    if len(accs) >= 2:
+        lines.append("  accuracy trend (old -> new): "
+                     + " ".join(f"{a:.3f}" for a in accs[-12:]))
+    drift_pts = [p.get("drift_max", 0.0) for p in trend]
+    if len(drift_pts) >= 2:
+        lines.append("  drift_max trend (old -> new): "
+                     + " ".join(f"{d:.2f}" for d in drift_pts[-12:]))
+    return "\n".join(lines)
+
+
+def show_quality(coord: Coordinator, engine: str, name: str) -> int:
+    """Data-quality plane (ISSUE 17): fleet-wide drift / prequential /
+    calibration view from merged ``get_quality`` sketches."""
+    docs = collect_quality(coord, engine, name)
+    if not docs:
+        print(f"no member of {engine}/{name} answered get_quality",
+              file=sys.stderr)
+        return -1
+    print(render_quality(engine, name, docs))
+    return 0
+
+
 def collect_watch(coord: Coordinator, engine: str, name: str,
                   window_s: float = 60.0) -> Dict[str, Any]:
     """One scrape of the whole cluster for the watch view: per-member
@@ -634,6 +754,19 @@ def _watch_node_row(node_name: str, entry: Dict[str, Any],
             mix_bits.append("ann DEG")
         else:
             mix_bits.append(f"ann {int(st.get('driver.ann.cells', 0))}c")
+    # ANN shadow recall (ISSUE 16 gauge, trended since ISSUE 17): sag
+    # here is the early warning the recall-deficit SLO alarms on
+    recall = st.get("driver.ann.recall_probe")
+    if recall is not None:
+        mix_bits.append(f"rec {float(recall):.2f}")
+    # data-quality plane (ISSUE 17): PSI drift vs the pinned reference
+    # + prequential (test-then-train) accuracy
+    qd = st.get("quality.drift_max")
+    if qd is not None and st.get("quality.reference_pinned"):
+        mix_bits.append(f"drift {float(qd):.2f}")
+    qa = st.get("quality.prequential_accuracy")
+    if qa is not None:
+        mix_bits.append(f"acc {float(qa):.3f}")
     alerts = ",".join(entry.get("alerts") or []) or "-"
     p99_cell = f"{p99:.1f} {p99_span[4:]}" if p99 is not None else "-"
     # event plane (ISSUE 14): the node's newest event + its age — one
@@ -1413,6 +1546,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return show_trace(coord, ns.type, ns.name, ns.trace_id)
         if ns.cmd == "alerts":
             return show_alerts(coord, ns.type, ns.name)
+        if ns.cmd == "quality":
+            return show_quality(coord, ns.type, ns.name)
         if ns.cmd == "watch":
             return show_watch(coord, ns.type, ns.name, once=ns.once,
                               interval=ns.interval, window_s=ns.window)

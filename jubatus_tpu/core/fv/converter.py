@@ -393,6 +393,9 @@ class DatumToFVConverter:
         self._token_memo: Dict[tuple, tuple] = {}
         self._name_memo: Dict[str, Tuple[int, int]] = {}
         self._combo_plans: Dict[tuple, _ComboPlan] = {}
+        # optional data-quality recorder: called from convert_batch with
+        # (flat feature names, weighted values); the callee self-samples
+        self.quality_hook = None
 
     @property
     def dim(self) -> int:
@@ -757,6 +760,22 @@ class DatumToFVConverter:
         if user_mask.any():
             flat_val[user_mask] *= self.weights.user_weight_many(
                 flat_idx[user_mask])
+
+        hook = self.quality_hook
+        if hook is not None:
+            try:
+                if not combo:
+                    hook(flat_names, flat_val)
+                else:
+                    row_names: List[List[str]] = [[]] * b
+                    for names_t, members in groups.items():
+                        nm = list(names_t) + \
+                            list(self._combo_plan_for(names_t).slot_names)
+                        for r in members:
+                            row_names[r] = nm
+                    hook([n for rn in row_names for n in rn], flat_val)
+            except Exception:  # broad-ok — quality stats must not break FV
+                pass
 
         # per-row merge by hashed index (convert()'s sorted-dict
         # semantics): stable lexsort keeps insertion order for colliding

@@ -234,6 +234,8 @@ IDEMPOTENT_BUILTINS: FrozenSet[str] = frozenset({
     # the same events)
     "get_events", "get_incidents", "get_proxy_events",
     "get_proxy_incidents",
+    # data-quality plane (ISSUE 17): the sketch/drift doc read is pure
+    "get_quality", "get_proxy_quality",
 })
 
 #: effectful built-ins, listed for the docs' idempotency matrix (anything

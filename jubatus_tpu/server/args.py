@@ -220,6 +220,17 @@ class ServerArgs:
     #: --incident-dir: capped artifacts dir for incident bundles
     #: (oldest pruned); empty = <datadir>/jubatus_incidents_<engine>_<port>
     incident_dir: str = ""
+    #: --quality-sample: fraction of train/FV batches the data-quality
+    #: plane (utils/quality.py, ISSUE 17) records into its drift
+    #: sketches and scores prequentially; 0 disarms the plane
+    quality_sample: float = 0.05
+    #: --quality-window: seconds per quality window — the live sketch
+    #: rolls into the reference-vs-live ring at this cadence and drift
+    #: (PSI) is recomputed against the pinned reference
+    quality_window: float = 60.0
+    #: --quality-ref-windows: completed windows merged into the pinned
+    #: reference before drift scoring starts
+    quality_ref_windows: int = 2
 
     @property
     def is_standalone(self) -> bool:
@@ -524,6 +535,19 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "(oldest pruned past a fixed cap; jubactl -c "
                         "incident lists/pulls them); empty = under "
                         "--datadir")
+    p.add_argument("--quality-sample", type=float, default=0.05,
+                   help="fraction of train/FV batches the data-quality "
+                        "plane records into its drift sketches and "
+                        "scores prequentially (test-then-train); "
+                        "0 disarms the plane")
+    p.add_argument("--quality-window", type=float, default=60.0,
+                   help="seconds per data-quality window: the live "
+                        "sketches roll into the reference-vs-live ring "
+                        "at this cadence and PSI drift is recomputed "
+                        "against the pinned reference")
+    p.add_argument("--quality-ref-windows", type=int, default=2,
+                   help="completed windows merged into the pinned "
+                        "reference before drift scoring starts")
     return p
 
 
@@ -581,6 +605,12 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
         raise SystemExit("--event-capacity must be >= 0")
     if args.incident_window < 0:
         raise SystemExit("--incident-window must be >= 0")
+    if not 0.0 <= args.quality_sample <= 1.0:
+        raise SystemExit("--quality-sample must be in [0, 1]")
+    if args.quality_window <= 0:
+        raise SystemExit("--quality-window must be > 0")
+    if args.quality_ref_windows < 1:
+        raise SystemExit("--quality-ref-windows must be >= 1")
     for spec in args.slo:
         from jubatus_tpu.utils.slo import parse_slo
 

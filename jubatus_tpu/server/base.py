@@ -155,6 +155,23 @@ class EngineServer:
         if self.slo is not None:
             self.slo.on_fire = self._on_slo_fire
         self._was_degraded = False
+        # data-quality plane (ISSUE 17): mergeable drift sketches +
+        # prequential accuracy, sampled by --quality-sample and ticked
+        # by the same telemetry thread (gauges land BEFORE the ring
+        # samples, so quality.drift.* is SLO-able with zero new grammar)
+        from jubatus_tpu.utils.quality import QualityPlane
+
+        self.quality: Optional[QualityPlane] = None
+        qs = getattr(self.args, "quality_sample", 0.05)
+        if qs > 0:
+            self.quality = QualityPlane(
+                sample=qs,
+                window_s=getattr(self.args, "quality_window", 60.0),
+                ref_windows=getattr(self.args, "quality_ref_windows", 2),
+                registry=self.rpc.trace)
+            conv = getattr(self.driver, "converter", None)
+            if conv is not None and hasattr(conv, "quality_hook"):
+                conv.quality_hook = self.quality.record_named
         #: re-entrancy guard: the incident collector reads _health(),
         #: whose telemetry.status() re-runs the sampler hooks — the
         #: tick must not recurse into itself mid-capture
@@ -696,6 +713,10 @@ class EngineServer:
         }
         if self.timeseries is not None:
             doc["timeseries"] = self.timeseries.points(last=60)
+        if self.quality is not None:
+            # names the top drifting group and carries its reference /
+            # live sketch pair — the drift-SLO forensic payload
+            doc["quality"] = self.quality.incident_doc()
         if self.mixer is not None and \
                 getattr(self.mixer, "flight", None) is not None:
             doc["mix_history"] = self.mixer.flight.snapshot(last=32)
@@ -770,6 +791,17 @@ class EngineServer:
                 if doc.get("recall_probe") is not None:
                     self.rpc.trace.gauge("ann.recall_probe",
                                          float(doc["recall_probe"]))
+                    # the SLO grammar alarms on HIGH gauges, so recall
+                    # sag trends as a deficit: gauge:ann.recall_probe_
+                    # deficit:0.1 fires when shadow recall dips < 0.9
+                    self.rpc.trace.gauge(
+                        "ann.recall_probe_deficit",
+                        round(1.0 - float(doc["recall_probe"]), 4))
+        # data-quality plane (ISSUE 17): roll windows, recompute PSI
+        # drift + prequential gauges — BEFORE the ring samples, so
+        # quality.drift.* is visible to gauge: SLOs this same tick
+        if self.quality is not None:
+            self.quality.tick()
         self.timeseries.sample(self.rpc.trace.snapshot())
         if self.slo is not None:
             self.slo.evaluate()
@@ -793,6 +825,16 @@ class EngineServer:
             return {node.name: {"stats": {}, "points": []}}
         return {node.name: {"stats": self.timeseries.stats(),
                             "points": self.timeseries.points()}}
+
+    def get_quality(self, _name: str = "") -> Dict[str, Any]:
+        """This node's data-quality doc (utils/quality.py): reference
+        and live sketch states, drift scores, prequential totals, trend
+        — mergeable, so the proxy folds the fleet with merge_quality
+        and drift is recomputed exactly from the merged sketches."""
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        if self.quality is None:
+            return {node.name: {}}
+        return {node.name: self.quality.snapshot()}
 
     def get_alerts(self, _name: str = "") -> Dict[str, Any]:
         """This node's SLO state (utils/slo.py): currently-firing
@@ -979,6 +1021,10 @@ class EngineServer:
         if self.slo is not None:
             st["slo.configured"] = len(self.slo.specs)
             st["slo.firing"] = len(self.slo.alerts())
+        # data-quality plane (ISSUE 17)
+        if self.quality is not None:
+            st.update({f"quality.{k}": v
+                       for k, v in self.quality.stats().items()})
         # model-integrity plane (ISSUE 15): snapshot ring + rollbacks
         # (guard state rides mixer.guard_* via the mixer's get_status)
         st.update({f"snapshot.{k}": v

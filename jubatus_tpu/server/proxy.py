@@ -952,6 +952,14 @@ class Proxy:
                           self._forensics_handler(
                               "get_alerts", self.get_proxy_alerts),
                           arity=1)
+        # data-quality plane (ISSUE 17): one call against the proxy
+        # returns every backend's mergeable sketch doc keyed by node —
+        # jubactl folds them with quality.merge_quality, so fleet drift
+        # is recomputed exactly from the merged sketches
+        self.rpc.register("get_quality",
+                          self._forensics_handler(
+                              "get_quality", self.get_proxy_quality),
+                          arity=1)
         # continuous profiling plane (ISSUE 8): one get_profile against
         # the proxy returns the whole cluster's folded stacks (backends
         # broadcast + the proxy's own samples); device captures
@@ -984,6 +992,8 @@ class Proxy:
         self.rpc.register("get_proxy_timeseries", self.get_proxy_timeseries,
                           arity=1)
         self.rpc.register("get_proxy_alerts", self.get_proxy_alerts,
+                          arity=1)
+        self.rpc.register("get_proxy_quality", self.get_proxy_quality,
                           arity=1)
         self.rpc.register("get_proxy_profile", self.get_proxy_profile,
                           arity=2)
@@ -1134,6 +1144,12 @@ class Proxy:
             return {node.name: {"alerts": [], "slos": []}}
         return {node.name: {"alerts": self.slo.alerts(),
                             "slos": self.slo.status()}}
+
+    def get_proxy_quality(self, _name: str = "") -> Dict[str, Any]:
+        """The proxy hop has no train path, so it contributes no
+        quality doc of its own — the RPC-routed ``get_quality`` is the
+        backend broadcast folded over this empty dict."""
+        return {}
 
     def get_proxy_profile(self, _name: str = "",
                           seconds: float = 0.0) -> Dict[str, Any]:

@@ -70,6 +70,8 @@ def bind_engine(rpc: RpcServer, server: Any) -> None:
     # model-health plane (ISSUE 7): metric time-series + SLO alerts
     rpc.register("get_timeseries", server.get_timeseries, arity=1)
     rpc.register("get_alerts", server.get_alerts, arity=1)
+    # data-quality plane (ISSUE 17): mergeable drift/prequential doc
+    rpc.register("get_quality", server.get_quality, arity=1)
     # continuous profiling plane (ISSUE 8): folded stack profile +
     # on-demand XLA device capture
     rpc.register("get_profile", server.get_profile, arity=2)
@@ -249,6 +251,62 @@ class _ComboPlanCache:
         return (plan, val)
 
 
+def _quality_observe_pairs(server: Any, pairs) -> None:
+    """Prequential (test-then-train) hook for the generic train path
+    (ISSUE 17): on sampled batches, score a bounded prefix with the
+    CURRENT model before the update is submitted, and record the label
+    distribution. Reads are snapshot reads (no driver lock), failures
+    never reach the ingest path."""
+    q = getattr(server, "quality", None)
+    if q is None or not pairs or not q.admit("train"):
+        return
+    d = server.driver
+    sub = pairs[:q.max_score_rows]
+    try:
+        q.record_labels(p[0] for p in pairs)
+        data = [p[1] for p in sub]
+        if isinstance(sub[0][0], str) and hasattr(d, "classify"):
+            for (truth, _dat), ranked in zip(sub, d.classify(data)):
+                q.record_classified(truth, ranked)
+        elif hasattr(d, "estimate"):
+            for (truth, _dat), est in zip(sub, d.estimate(data)):
+                q.record_estimated(float(truth), float(est))
+    except Exception:  # broad-ok — quality scoring must not break ingest
+        log.debug("prequential hook failed", exc_info=True)
+
+
+def _quality_observe_raw(server: Any, item, numeric: bool) -> None:
+    """Prequential + feature-stat hook for the native raw-ingest path:
+    names never materialize here, so values record under the ``hashed``
+    group; scoring rides classify_hashed/estimate_hashed on a bounded
+    row prefix. Combo-plan items skip scoring (the base arrays are not
+    the model's input rows)."""
+    q = getattr(server, "quality", None)
+    if q is None or not q.admit("train"):
+        return
+    d = server.driver
+    tag, labels, idx, val = item
+    try:
+        q.record_hashed(val)
+        if tag[0] != "plain":
+            return
+        k = min(q.max_score_rows, idx.shape[0])
+        if numeric:
+            if hasattr(d, "estimate_hashed"):
+                for t, e in zip(labels[:k], d.estimate_hashed(idx[:k],
+                                                              val[:k])):
+                    q.record_estimated(float(t), float(e))
+        else:
+            uniq, lidx = labels
+            q.record_labels(uniq[int(j)] for j in lidx)
+            if hasattr(d, "classify_hashed"):
+                ranked = d.classify_hashed(idx[:k], val[:k])
+                for j, r in enumerate(ranked):
+                    q.record_classified(uniq[int(lidx[j])], r)
+    except Exception:  # broad-ok — quality scoring must not break ingest
+        log.debug("raw prequential hook failed", exc_info=True)
+
+
 def _register_train(rpc: RpcServer, server: Any, decode_pair,
                     train_fn) -> None:
     """Register "train" with microbatch coalescing (server/microbatch.py):
@@ -265,11 +323,12 @@ def _register_train(rpc: RpcServer, server: Any, decode_pair,
     max_batch = getattr(server.args, "microbatch_max", 8192)
     flush = _updating(server, train_fn, count=lambda r: r)
     if not max_batch:
-        rpc.register(
-            "train",
-            lambda name, data: flush([decode_pair(p) for p in data]),
-            arity=2,
-        )
+        def train_direct(name, data):
+            pairs = [decode_pair(p) for p in data]
+            _quality_observe_pairs(server, pairs)
+            return flush(pairs)
+
+        rpc.register("train", train_direct, arity=2)
         return
     driver = server.driver
     featurize = getattr(driver, "featurize_train", None)
@@ -295,6 +354,8 @@ def _register_train(rpc: RpcServer, server: Any, decode_pair,
         pairs = [decode_pair(p) for p in data]
         if not pairs:
             return 0
+        # test-then-train: prequential scoring sees the pre-update model
+        _quality_observe_pairs(server, pairs)
         co.submit(pairs, timeout=wait_s)
         return len(pairs)
 
@@ -506,6 +567,8 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
         n = item[2].shape[0]
         if n == 0:
             return 0
+        # test-then-train: prequential scoring sees the pre-update model
+        _quality_observe_raw(server, item, numeric)
         if max_batch:
             co.submit([item], timeout=wait_s)
         else:

@@ -90,6 +90,46 @@ def test_direction_inference_sharded_keys():
     assert bc.direction("knn_query_rows_rows1e6") is None
 
 
+def test_direction_inference_quality_keys():
+    """ISSUE 17 data-quality plane: PSI drift scores gate down-good,
+    prequential/holdout accuracy and ANN recall gate up-good, the
+    overhead and tracks-holdout verdicts are boolean gates."""
+    assert bc.direction("e2e_drift_baseline_psi") == "lower"
+    assert bc.direction("e2e_quality_overhead_mean_ratio") == "lower"
+    assert bc.direction("e2e_prequential_accuracy") == "higher"
+    assert bc.direction("e2e_holdout_accuracy") == "higher"
+    assert bc.direction("e2e_ann_recall") == "higher"
+    assert bc.direction("e2e_prequential_tracks_holdout_ok") == "bool"
+    assert bc.direction("e2e_quality_overhead_ok") == "bool"
+    # the drill verdicts carry "drift" (a bare _LOWER pattern) but the
+    # _ok suffix must win: a fired drift alarm in the drill is GOOD
+    assert bc.direction("e2e_drift_detected_ok") == "bool"
+    assert bc.direction("e2e_drift_slo_fired_ok") == "bool"
+    assert bc.direction("e2e_drift_incident_ok") == "bool"
+    # neighbors that must NOT accidentally gate
+    assert bc.direction("e2e_shift_peak_score") is None
+    assert bc.direction("e2e_quality_sample") is None
+    assert bc.direction("e2e_recalled_total") is None  # no _recall edge
+
+
+def test_quality_keys_gate_in_compare():
+    old = {"e2e_drift_baseline_psi": 0.03,
+           "e2e_prequential_accuracy": 0.90,
+           "e2e_ann_recall": 0.80,
+           "e2e_prequential_tracks_holdout_ok": True}
+    new = {"e2e_drift_baseline_psi": 0.40,     # false alarms leaked: bad
+           "e2e_prequential_accuracy": 0.70,   # accuracy fell: bad
+           "e2e_ann_recall": 0.99,             # improved
+           "e2e_prequential_tracks_holdout_ok": False}  # gate flip
+    rows, regs = bc.compare(bc.flatten(old), bc.flatten(new))
+    verdicts = {r["key"]: r["verdict"] for r in rows}
+    assert verdicts["e2e_drift_baseline_psi"] == "REGRESSED"
+    assert verdicts["e2e_prequential_accuracy"] == "REGRESSED"
+    assert verdicts["e2e_ann_recall"] == "improved"
+    assert verdicts["e2e_prequential_tracks_holdout_ok"] == "REGRESSED"
+    assert len(regs) == 3
+
+
 def test_sharded_keys_gate_in_compare():
     old = {"sharded_train_samples_per_sec_d26_8shard": 50000.0,
            "sharded_classify_p99_ms_d26_8shard": 40.0,

@@ -118,6 +118,52 @@ def test_full_scan_gate_fires_and_pragma_opts_out(tmp_path):
                 if "arena-wide distance sweep" in p]
 
 
+def test_quality_coverage_gate_fires_and_pragma_opts_out(tmp_path):
+    """The server/ train-registration rule (ISSUE 17): a function that
+    registers a "train" handler without referencing the quality
+    recorder is flagged; routing through the _quality_observe_* helpers
+    (or server.quality) and the # no-quality pragma are not, and files
+    outside server/ are exempt."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo / "tools" / "codestyle"))
+    try:
+        import check as codestyle
+    finally:
+        sys.path.pop(0)
+    d = tmp_path / "jubatus_tpu" / "server"
+    d.mkdir(parents=True)
+    bad = d / "victim.py"
+    bad.write_text(
+        '"""doc."""\n'
+        "def _bind_bad(server, rpc):\n"
+        "    rpc.register(\"train\", lambda n, d: 0, arity=2)\n"  # flagged
+        "def _bind_raw_bad(server, rpc):\n"
+        "    rpc.register_raw(\"train\", h)\n"                    # flagged
+        "def _bind_ok(server, rpc):\n"
+        "    def train(name, data):\n"
+        "        _quality_observe_pairs(server, data)\n"
+        "        return 0\n"
+        "    rpc.register(\"train\", train, arity=2)\n"           # routed
+        "def _bind_pragma(server, rpc):\n"
+        "    rpc.register(\"train\", h, arity=2)"
+        "  # no-quality - scored upstream\n",
+        encoding="utf-8")
+    problems = codestyle.check_file(str(bad))
+    hits = [p for p in problems if "quality-recorder" in p]
+    assert len(hits) == 2, problems
+    assert ":3:" in hits[0] and ":5:" in hits[1]
+    # outside server/ the rule stays silent
+    other = tmp_path / "jubatus_tpu" / "framework"
+    other.mkdir(parents=True)
+    ok = other / "fine.py"
+    ok.write_text(
+        '"""doc."""\n'
+        "def _bind(rpc):\n"
+        "    rpc.register(\"train\", h, arity=2)\n", encoding="utf-8")
+    assert not [p for p in codestyle.check_file(str(ok))
+                if "quality-recorder" in p]
+
+
 def test_metrics_docs_catalog_clean():
     """The metric-catalog gate (ISSUE 7): every literal counter/gauge
     key exported through the tracing registry must appear in the

@@ -58,10 +58,13 @@ DEFAULT_TOLERANCE = 0.05
 #: started returning wrong neighbors, the one regression an ANN tier
 #: must never trade for speed. Its build throughput rides the existing
 #: ``_per_sec`` pattern (``ann_build_rows_per_sec``).
+#: ``_accuracy`` / ``_recall`` cover the data-quality plane (ISSUE 17):
+#: prequential accuracy and shadow recall — model quality going DOWN is
+#: the regression the whole plane exists to catch.
 _HIGHER = re.compile(
     r"(_per_sec($|_)|samples_per_sec|_speedup($|_)|_fraction($|_)"
     r"|_reduction($|_)|_capacity_per_replica($|_)|_quarantined($|_)"
-    r"|_recall_at_)")
+    r"|_recall_at_|_accuracy($|_)|_recall($|_))")
 #: key patterns whose smaller values are better. ``_per_host`` covers
 #: the hierarchical-mix scaling plane (ISSUE 9): wire bytes each host
 #: ships per round — the quantity the two-tier reduce holds down, so
@@ -79,11 +82,15 @@ _HIGHER = re.compile(
 #: ``_us`` covers the event plane (ISSUE 14): per-emit microseconds
 #: (``e2e_event_emit_us``) — a hot-path cost, down-good like any
 #: latency.
+#: ``_drift_score`` / ``_psi`` cover the data-quality plane (ISSUE 17):
+#: PSI drift between reference and live windows — on an unshifted
+#: stream any growth means a false drift alarm (the bare ``drift``
+#: pattern already matches ``_drift_score``; ``_psi`` needs its own).
 _LOWER = re.compile(
     r"(_ms($|_)|_ratio($|_)|_us($|_)|wire_mb|_per_host($|_)|drift"
     r"|_error(s)?($|_)|_timeouts|_errors_total|_denials|rows_lost"
     r"|_stall_ms($|_)|_lag_rounds($|_)"
-    r"|_recovery_s($|_)|_violation_s($|_))")
+    r"|_recovery_s($|_)|_violation_s($|_)|_psi($|_))")
 
 #: built-in per-key tolerance defaults (explicit --key-tolerance wins):
 #: the nproc16 sweep time-slices 16 gloo processes over however few

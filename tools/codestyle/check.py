@@ -227,6 +227,48 @@ def _check_guard_coverage(path: str, tree: "ast.AST",
     return problems
 
 
+#: data-quality coverage gate (ISSUE 17): a train path that bypasses
+#: the quality recorder is invisible to the drift/prequential plane —
+#: the model silently trains on a stream nobody is evaluating. So every
+#: ``register("train", ...)`` / ``register_raw("train", ...)`` site in
+#: ``jubatus_tpu/server/`` must sit in a function that routes through
+#: the quality recorder (a ``quality`` reference in the enclosing
+#: function is the evidence). A train path genuinely recorded elsewhere
+#: opts out per line with ``# no-quality`` stating where.
+_QUALITY_SITE_RE = re.compile(r"\.register(?:_raw)?\(\s*f?\"train\"")
+_QUALITY_REF_RE = re.compile(r"quality")
+
+
+def _check_quality_coverage(path: str, tree: "ast.AST",
+                            lines: List[str]) -> List[str]:
+    """train registration sites in server modules must sit inside a
+    function referencing the quality recorder (or carry
+    ``# no-quality``)."""
+    funcs: List[tuple] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.append((node.lineno, node.end_lineno))
+    problems = []
+    for i, line in enumerate(lines, 1):
+        if not _QUALITY_SITE_RE.search(line) or "# no-quality" in line:
+            continue
+        spans = [f for f in funcs if f[0] <= i <= f[1]]
+        if spans:
+            start, end = max(spans, key=lambda f: f[0])  # innermost
+            body = "\n".join(lines[start - 1:end])
+        else:
+            body = line
+        if not _QUALITY_REF_RE.search(body):
+            problems.append(
+                f"{path}:{i}: train registration without a quality-"
+                "recorder reference in the enclosing function (route the "
+                "path through server.quality — utils/quality.py — so the "
+                "drift/prequential plane sees this stream; append "
+                "'# no-quality — <where it IS recorded>' where the path "
+                "is genuinely recorded elsewhere)")
+    return problems
+
+
 def _check_event_coverage(path: str, posix: str, tree: "ast.AST",
                           lines: List[str]) -> List[str]:
     """Marker lines from EVENT_SITES must sit inside a function whose
@@ -389,6 +431,9 @@ def check_file(path: str) -> List[str]:
                                                  text.splitlines()))
         problems.extend(_check_event_coverage(path, posix, tree,
                                               text.splitlines()))
+        if "jubatus_tpu/server/" in posix:
+            problems.extend(_check_quality_coverage(path, tree,
+                                                    text.splitlines()))
         if _is_guard_gated(posix):
             problems.extend(_check_guard_coverage(path, tree,
                                                   text.splitlines()))
