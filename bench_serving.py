@@ -1322,6 +1322,155 @@ def run_churn(transport: str = "python", measure: float = 60.0,
     return out
 
 
+def run_killall_drill(nodes: int = 3, train_seconds: float = 10.0,
+                      store_interval: float = 0.4) -> dict:
+    """Kill-everything chaos drill (durable model plane, ISSUE 18): a
+    fleet uploading to a shared snapshot store is hard-killed in its
+    entirety — no drain, no save, every process gone at once — then
+    rebooted from the store alone.
+
+    Keys of record:
+
+    - ``e2e_fleet_coldstart_to_serving_s`` — boot an EMPTY fleet and
+      train it to its working model: the price of losing the model.
+    - ``e2e_warmboot_recovery_s`` — boot the SAME fleet from the store
+      after the massacre: snapshot download + chain replay, no
+      retraining.
+    - ``e2e_warmboot_beats_cold_ok`` — the whole point: recovery must
+      beat retraining.
+    - ``e2e_killall_model_loss_rows`` — acked training rows lost BEYOND
+      the diff-chain tail. The store's contract is bounded loss: rows
+      trained after the last uploaded record (the tail window, at most
+      one ``--store-interval``) may die with the fleet; anything the
+      chain acknowledged must replay. This key must be 0.
+    - ``e2e_killall_tail_window_rows`` — rows in the allowed tail
+      window (informational: bounded by interval x ingest rate).
+    """
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.framework.model_store import LocalDirBackend, ModelStore
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    store_dir = _tempfile.mkdtemp(prefix="jubatus_killall_store_")
+    coord_store = _Store()
+
+    def boot():
+        srv = EngineServer(
+            "classifier", CONF,
+            args=ServerArgs(engine="classifier", coordinator="(shared)",
+                            name="bench", listen_addr="127.0.0.1",
+                            thread=4, interval_sec=1e9,
+                            interval_count=1 << 30,
+                            telemetry_interval=0.1,
+                            store_dir=store_dir,
+                            store_interval=store_interval,
+                            store_compact_every=6),
+            coord=MemoryCoordinator(coord_store))
+        srv.start(0)
+        return srv
+
+    def boot_fleet():
+        """All processes restart concurrently after a massacre — boot
+        in parallel, exactly like init respawning the whole host."""
+        slots: list = [None] * nodes
+        def one(i):
+            slots[i] = boot()
+        ts = [threading.Thread(target=one, args=(i,)) for i in range(nodes)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if any(s is None for s in slots):
+            raise RuntimeError("fleet boot failed")
+        return slots
+
+    def first_classify(srv):
+        """Serving = the node answers a query. Returns the client."""
+        c = ClassifierClient("127.0.0.1", srv.rpc.port, "bench",
+                             timeout=10.0)
+        c.classify([Datum({f"f{j}": 0.0 for j in range(4)})])
+        return c
+
+    def datum(rng):
+        return Datum({f"f{j}": float(v)
+                      for j, v in enumerate(rng.normal(size=4))})
+
+    rng = __import__("numpy").random.default_rng(SEED)
+    servers: list = []
+    out: dict = {}
+    try:
+        # ---- phase 1: cold start — empty store, boot + train to the
+        # working model. This is what dying WITHOUT a store costs.
+        t0 = time.monotonic()
+        servers = boot_fleet()
+        clients = [first_classify(s) for s in servers]
+        acked = [0] * nodes
+        deadline = time.monotonic() + train_seconds
+        while time.monotonic() < deadline:
+            for i, c in enumerate(clients):
+                batch = [("pos" if rng.random() < 0.5 else "neg",
+                          datum(rng)) for _ in range(50)]
+                acked[i] += c.train(batch)
+        for c in clients:
+            c.classify([datum(rng)])
+        cold_s = time.monotonic() - t0
+        out["e2e_fleet_coldstart_to_serving_s"] = round(cold_s, 3)
+        # let the last diff land, then freeze the per-node chain tails:
+        # everything at/under these versions MUST survive the massacre
+        time.sleep(store_interval + 0.5)
+        reader = ModelStore(LocalDirBackend(store_dir), cluster="bench",
+                            engine="classifier")
+        tails = {}
+        for rec in reader.records():
+            tails[rec.node] = max(tails.get(rec.node, 0), rec.version)
+        acked_by_node = {s._store_node_name(): acked[i]
+                        for i, s in enumerate(servers)}
+        # ---- phase 2: the massacre — every process hard-killed at
+        # once (stop() drops ephemeral regs and persists NOTHING)
+        for s in servers:
+            s.stop()
+        servers = []
+        # ---- phase 3: warm reboot from the store alone
+        t0 = time.monotonic()
+        servers = boot_fleet()
+        clients = [first_classify(s) for s in servers]
+        warm_s = time.monotonic() - t0
+        out["e2e_warmboot_recovery_s"] = round(warm_s, 3)
+        out["e2e_warmboot_beats_cold_ok"] = bool(warm_s < cold_s)
+        outcomes = [s.warmboot.get("outcome") for s in servers]
+        out["e2e_killall_warm_nodes"] = outcomes.count("warm")
+        out["e2e_warmboot_load_s"] = round(max(
+            float(s.warmboot.get("seconds", 0.0)) for s in servers), 3)
+        out["e2e_warmboot_chain_len"] = max(
+            int(s.warmboot.get("chain_len", 0)) for s in servers)
+        # ---- verdict: replay every pre-kill chain and count rows lost
+        # beyond each tail (must be 0 — the chain acked them), plus the
+        # allowed tail window (acked but never uploaded before death)
+        loss_beyond_tail = 0
+        tail_window = 0
+        for node, tail_version in tails.items():
+            _blob, meta = reader.materialize(node=node)
+            loss_beyond_tail += max(0, tail_version
+                                    - int(meta["model_version"]))
+            tail_window += max(0, acked_by_node.get(node, 0)
+                               - tail_version)
+        out["e2e_killall_model_loss_rows"] = loss_beyond_tail
+        out["e2e_killall_tail_window_rows"] = tail_window
+        out["e2e_killall_acked_rows"] = sum(acked)
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+        _shutil.rmtree(store_dir, ignore_errors=True)
+    return out
+
+
 def run_migration_cycle(rows: int = 2000) -> dict:
     """Join -> migrate -> drain cycle on a nearest_neighbor cluster
     (elastic membership, ISSUE 10): measures the state-migration data
@@ -2751,6 +2900,13 @@ def collect(trials: int = 2) -> dict:
         out.update(run_fleet_scalein())
     except Exception as e:  # noqa: BLE001
         out["e2e_fleet_scalein_error"] = repr(e)[:200]
+    # durable model plane (ISSUE 18): kill-everything drill — whole
+    # fleet hard-killed, rebooted from the shared snapshot store; zero
+    # acked-row loss beyond the diff-chain tail, warm beats cold
+    try:
+        out.update(run_killall_drill())
+    except Exception as e:  # noqa: BLE001
+        out["e2e_killall_error"] = repr(e)[:200]
     return out
 
 
@@ -2794,6 +2950,12 @@ if __name__ == "__main__":
         print(json.dumps(run_quality(
             measure=float(sys.argv[2]) if len(sys.argv) > 2
             else TEXT_MEASURE_SECONDS), indent=1))
+    elif len(sys.argv) > 1 and sys.argv[1] == "killall":
+        # the ISSUE 18 chaos slice on its own: kill-everything, reboot
+        # from the shared snapshot store, prove bounded loss
+        print(json.dumps(run_killall_drill(
+            train_seconds=float(sys.argv[2]) if len(sys.argv) > 2
+            else 6.0), indent=1))
     elif len(sys.argv) > 1 and sys.argv[1] == "asyncmix":
         # the async-mix slice on its own (drift parity + cadence/stall
         # storm), for ISSUE 11 iteration without the full bench

@@ -64,7 +64,7 @@ def _parser() -> argparse.ArgumentParser:
                             "metrics", "breakers", "trace", "alerts",
                             "watch", "profile", "drain", "rebalance",
                             "autoscale", "timeline", "incident",
-                            "rollback", "quality"])
+                            "rollback", "quality", "restore"])
     p.add_argument("trace_id", nargs="?", default="",
                    help="[trace] trace id to assemble (from a slow-log "
                         "record, a /metrics exemplar, or "
@@ -112,10 +112,21 @@ def _parser() -> argparse.ArgumentParser:
                    help="[incident] fetch one bundle by id (from "
                         "--list) and print its full forensic JSON")
     p.add_argument("--target", default="",
-                   help="[drain|rollback] the member to act on, as "
-                        "IP_PORT (a node name from -c status); rollback "
-                        "without --target rolls back EVERY member (the "
-                        "fleet-wide recovery after a poisoning incident)")
+                   help="[drain|rollback|restore] the member to act on, "
+                        "as IP_PORT (a node name from -c status); "
+                        "rollback/restore without --target act on EVERY "
+                        "member (the fleet-wide recovery)")
+    # durable model plane (ISSUE 18): point-in-time restore from the
+    # shared snapshot store (--store-dir on the servers)
+    p.add_argument("--at", default="latest", metavar="HLC|latest",
+                   help="[restore] point in time to restore to: a packed "
+                        "HLC (from -c timeline or store.head_hlc in "
+                        "-c status) or 'latest' (the default). Each "
+                        "member materializes the newest snapshot+diff "
+                        "chain at/before that instant and re-imports its "
+                        "owned rows under the CURRENT hash ring, so a "
+                        "fleet restored at a different size than the one "
+                        "that saved (N->M reshard) comes back complete")
     p.add_argument("--stop", action="store_true",
                    help="[drain] also unregister the member's nodes/ "
                         "entry when drained, firing its suicide watcher "
@@ -971,6 +982,60 @@ def rollback_member(coord: Coordinator, engine: str, name: str,
     return rc
 
 
+def restore_fleet(coord: Coordinator, engine: str, name: str,
+                  target: str, at: str) -> int:
+    """Durable model plane (ISSUE 18): point-in-time restore from the
+    shared snapshot store. Every member (or just ``--target``)
+    materializes the newest full snapshot + diff chain at/before
+    ``--at`` (a packed HLC, or ``latest``) and re-imports the rows it
+    owns under the CURRENT ring — restoring an 8-shard save into a
+    2-shard fleet (or 1 into 8) resharded-on-the-fly."""
+    if at == "latest":
+        at_hlc = 0
+    else:
+        try:
+            at_hlc = int(at)
+        except ValueError:
+            print(f"bad --at {at!r}: expected a packed HLC or 'latest'",
+                  file=sys.stderr)
+            return 1
+    nodes = membership.get_all_nodes(coord, engine, name)
+    if not nodes:
+        print(f"no server of {engine}/{name}", file=sys.stderr)
+        return -1
+    if target:
+        try:
+            node = NodeInfo.from_name(target)
+        except (ValueError, IndexError):
+            print(f"bad --target {target!r}: expected IP_PORT",
+                  file=sys.stderr)
+            return 1
+        if node.name not in {n.name for n in nodes}:
+            print(f"{node.name} is not a registered member of "
+                  f"{engine}/{name}", file=sys.stderr)
+            return 1
+        nodes = [node]
+    rc = 0
+    for node in nodes:
+        print(f"restore {node.name} @ {at}...", end="", flush=True)
+        try:
+            with RpcClient(node.host, node.port, timeout=600.0) as c:
+                out = c.call("store_restore", name, at_hlc)
+        except Exception as e:  # noqa: BLE001 — report per-host
+            print(f" failed. ({e})")
+            rc = -1
+            continue
+        if out.get("restored"):
+            print(f" ok: model_version {out.get('model_version')} "
+                  f"hlc {out.get('hlc')} chain {out.get('chain_len')} "
+                  f"(+{out.get('rows_imported', 0)} row(s) resharded, "
+                  f"{out.get('seconds', 0)}s)")
+        else:
+            print(f" refused: {out.get('error')}")
+            rc = -1
+    return rc
+
+
 def rebalance_cluster(coord: Coordinator, engine: str, name: str) -> int:
     """Ask every member to pull the rows it owns under the CURRENT ring
     (the repair action after churn; safe to re-run — rows apply as
@@ -1565,6 +1630,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             return rebalance_cluster(coord, ns.type, ns.name)
         if ns.cmd == "rollback":
             return rollback_member(coord, ns.type, ns.name, ns.target)
+        if ns.cmd == "restore":
+            return restore_fleet(coord, ns.type, ns.name, ns.target,
+                                 ns.at)
         if ns.cmd == "autoscale":
             return run_autoscale(coord, ns.type, ns.name, ns)
         if ns.cmd == "profile":

@@ -363,6 +363,10 @@ class VisorActuator:
         self.engine = engine
         self.name = name
         self.server_argv = dict(server_argv or {})
+        #: ISSUE 18: replicas spawned with --store-dir warm-boot from
+        #: the shared model store instead of cold-joining — scale-out
+        #: recovery is bounded by snapshot download, not re-training
+        self.warm_spawn = bool(self.server_argv.get("store_dir"))
         self.timeout = timeout
         self._rr = 0  # round-robin cursor over visors
 
@@ -382,6 +386,9 @@ class VisorActuator:
         if not visors:
             raise RuntimeError("no jubavisor registered to spawn on")
         target = f"{self.engine}/{self.name}"
+        if self.warm_spawn:
+            log.info("spawning %d replica(s) with --store-dir: they will "
+                     "warm-boot from the shared model store", count)
         for i in range(int(count)):
             visor = visors[(self._rr + i) % len(visors)]
             with RpcClient(visor.host, visor.port,
@@ -504,9 +511,15 @@ class Autoscaler:
                 backoff_s=round(self._backoff_s, 3))
         self._backoff_s = 0.0
         self.backoff_until = 0.0
+        extra: Dict[str, Any] = {}
+        if decision.action == "scale_out" and \
+                getattr(self.actuator, "warm_spawn", False):
+            # ISSUE 18: the journal/timeline distinguishes warm scale-out
+            # (replicas boot from the shared store) from cold
+            extra["warm_spawn"] = True
         return self._record(decision.action, decision.reason, snap, now,
                             target=decision.target, count=decision.count,
-                            dry_run=False)
+                            dry_run=False, **extra)
 
     # -- one control cycle ---------------------------------------------------
     def tick(self, snap: Optional[FleetSnapshot] = None,

@@ -236,6 +236,9 @@ IDEMPOTENT_BUILTINS: FrozenSet[str] = frozenset({
     "get_proxy_incidents",
     # data-quality plane (ISSUE 17): the sketch/drift doc read is pure
     "get_quality", "get_proxy_quality",
+    # durable model plane (ISSUE 18): the store/warm-boot status read
+    # is pure
+    "get_store_status",
 })
 
 #: effectful built-ins, listed for the docs' idempotency matrix (anything
@@ -254,6 +257,9 @@ EFFECTFUL_BUILTINS: FrozenSet[str] = frozenset({
     # model-integrity plane (ISSUE 15): rollback rewrites the live
     # model from the snapshot ring — effectful by definition
     "rollback",
+    # durable model plane (ISSUE 18): point-in-time restore rewrites
+    # the live model from the shared store — effectful by definition
+    "store_restore",
 })
 
 

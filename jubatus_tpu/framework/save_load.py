@@ -129,13 +129,29 @@ def load_model(
     *,
     expected_config: Optional[str] = None,
 ) -> Tuple[dict, Any]:
-    """Validate + load a checkpoint into the driver.
+    """Validate + load a checkpoint file into the driver.
 
     Returns (system_data, user_data_version). Raises SaveLoadError on any
     validation failure, mirroring the reference's checks."""
     with open(path, "rb") as f:
         raw = f.read()
-    system_bytes, user_bytes = read_envelope(raw, path)
+    return load_model_bytes(raw, driver, where=path,
+                            expected_config=expected_config)
+
+
+def load_model_bytes(
+    raw: bytes,
+    driver,
+    *,
+    where: str = "<bytes>",
+    expected_config: Optional[str] = None,
+) -> Tuple[dict, Any]:
+    """The byte-level half of load_model: same validation ladder (magic,
+    CRC, type, semantic config, user-data version) over an in-memory
+    envelope — what the durable model plane (framework/model_store.py)
+    feeds from store records during warm-boot and fleet restore."""
+    system_bytes, user_bytes = read_envelope(raw, where)
+    path = where
     system = unpack_obj(system_bytes)
     if system["type"] != driver.TYPE:
         raise SaveLoadError(

@@ -231,6 +231,34 @@ class ServerArgs:
     #: --quality-ref-windows: completed windows merged into the pinned
     #: reference before drift scoring starts
     quality_ref_windows: int = 2
+    #: --store-dir: root of the shared snapshot store (the durable
+    #: model plane, framework/model_store.py, ISSUE 18) — a directory
+    #: every member and jubactl can reach (NFS/fuse mount stands in for
+    #: an object store; the backend API is shaped for one). Empty
+    #: disables the plane: no uploads, no warm-boot, save/load stay
+    #: node-local.
+    store_dir: str = ""
+    #: --store-interval: seconds between background store uploads
+    #: (full snapshot first, then incremental diff records vs the
+    #: uploaded chain); 0 disables the uploader (the store still serves
+    #: save/load/restore)
+    store_interval: float = 0.0
+    #: --store-compact-every: diff records per chain before the
+    #: uploader re-anchors with a fresh full snapshot and the store
+    #: folds the old chain (bounds restore cost AND the lossy tail
+    #: under --store-compress int8)
+    store_compact_every: int = 8
+    #: --store-compress: diff-record encoding. ``off`` ships lossless
+    #: f32 deltas (bit-exact replay); ``int8`` block-quantizes float
+    #: deltas (~4x smaller, same scheme as --mix-compress int8) with an
+    #: uploader-held error-feedback residual so chain replay error is
+    #: bounded by ONLY the last diff's quantization
+    store_compress: str = "off"
+    #: --no-store-warmboot: boot cold even when --store-dir is set (the
+    #: store still receives uploads). Default: a booting replica loads
+    #: the freshest store snapshot + diff chain BEFORE entering the
+    #: ring, then catches up via the normal mix plane
+    store_warmboot: bool = True
 
     @property
     def is_standalone(self) -> bool:
@@ -548,6 +576,40 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
     p.add_argument("--quality-ref-windows", type=int, default=2,
                    help="completed windows merged into the pinned "
                         "reference before drift scoring starts")
+    p.add_argument("--store-dir", default="",
+                   help="root of the shared snapshot store (durable "
+                        "model plane, framework/model_store.py): a "
+                        "directory every member and jubactl can reach "
+                        "(NFS stands in for an object store). Enables "
+                        "warm-boot, background diff-chain uploads "
+                        "(--store-interval), store-backed save/load, "
+                        "and jubactl -c restore. Empty = node-local "
+                        "durability only")
+    p.add_argument("--store-interval", type=float, default=0.0,
+                   help="seconds between background snapshot uploads "
+                        "to --store-dir (a full envelope first, then "
+                        "incremental diff records against the chain); "
+                        "0 disables the uploader thread while the "
+                        "store still serves save/load/restore")
+    p.add_argument("--store-compact-every", type=int, default=8,
+                   help="diff records per chain before the uploader "
+                        "re-anchors with a fresh full snapshot and the "
+                        "store folds the chain (bounds restore cost "
+                        "and the int8 tail)")
+    p.add_argument("--store-compress", default="off",
+                   choices=["off", "int8"],
+                   help="diff-record encoding: off = lossless f32 "
+                        "deltas (bit-exact chain replay); int8 = "
+                        "block-quantized deltas (~4x smaller, the "
+                        "--mix-compress int8 scheme) with an error-"
+                        "feedback residual so replay error is bounded "
+                        "by only the LAST diff's quantization")
+    p.add_argument("--no-store-warmboot", dest="store_warmboot",
+                   action="store_false",
+                   help="boot cold even when --store-dir is set: skip "
+                        "the warm-boot ladder (load freshest store "
+                        "snapshot + diff chain before entering the "
+                        "ring) and rely on join migration alone")
     return p
 
 
@@ -636,6 +698,12 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
             parse_rule(rule)
         except ValueError as e:
             raise SystemExit(str(e))
+    if args.store_interval < 0:
+        raise SystemExit("--store-interval must be >= 0")
+    if args.store_compact_every < 1:
+        raise SystemExit("--store-compact-every must be >= 1")
+    if args.store_interval > 0 and not args.store_dir:
+        raise SystemExit("--store-interval requires --store-dir")
     if args.mix_bf16 and args.mix_compress == "off":
         args.mix_compress = "bf16"  # deprecated alias resolves here
     if not args.is_standalone and not args.name:

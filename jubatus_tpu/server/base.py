@@ -203,6 +203,25 @@ class EngineServer:
         self._last_rollback_ts = 0.0
         if self._snapshot_interval > 0:
             self.telemetry.hooks.append(self._model_snapshot_tick)
+        # durable model plane (ISSUE 18): the shared snapshot store +
+        # the background diff-chain uploader (created at start(), once
+        # the bound port names this node) + warm-boot bookkeeping
+        self.store = None
+        self.store_uploader = None
+        self.warmboot: Dict[str, Any] = {}
+        self._store_interval = getattr(self.args, "store_interval", 0.0)
+        self._last_store_upload = 0.0
+        store_dir = getattr(self.args, "store_dir", "")
+        if store_dir:
+            from jubatus_tpu.framework.model_store import (LocalDirBackend,
+                                                           ModelStore)
+
+            self.store = ModelStore(
+                LocalDirBackend(store_dir),
+                cluster=self.args.name or "standalone", engine=engine,
+                counter=self.rpc.trace.count)
+            if self._store_interval > 0:
+                self.telemetry.hooks.append(self._store_upload_tick)
         #: Prometheus /metrics + /healthz endpoint (--metrics-port >= 0)
         self.metrics = None
         #: pooled peer clients for server-side replicated writes
@@ -553,6 +572,200 @@ class EngineServer:
         if not out.get("rolled_back"):
             log.error("auto-rollback unavailable: %s", out.get("error"))
 
+    # -- durable model plane: store uploads + warm-boot + restore (ISSUE 18) --
+    def _store_node_name(self) -> str:
+        return NodeInfo(self.args.eth,
+                        self.rpc.port or self.args.rpc_port).name
+
+    def _store_upload_tick(self) -> None:
+        """One telemetry tick of the background uploader: snapshot →
+        diff vs the chain's belief → upload (full every
+        --store-compact-every diffs, with store-side compaction).
+        Upload failures are counted by the store and must never touch
+        the serving path."""
+        if self.store_uploader is None:
+            return
+        now = time.monotonic()
+        if self._last_store_upload and \
+                now - self._last_store_upload < self._store_interval:
+            return
+        self._last_store_upload = now
+        # upload clock: local training progress + mix progress — either
+        # one advancing means the model changed (a mix-only replica has
+        # update_count 0; a mix-never fleet has model_version 0)
+        version = int(self.driver.update_count)
+        if self.mixer is not None:
+            version += int(getattr(self.mixer, "model_version", 0) or 0)
+        if version == 0 and not self.last_loaded:
+            return  # pristine model: nothing worth a store record yet
+        try:
+            self.store_uploader.tick(self.driver, version)
+        except Exception:  # broad-ok — a flaky store must not kill the tick
+            log.warning("store upload failed", exc_info=True)
+
+    def _warm_boot(self) -> None:
+        """The warm-boot ladder (boot-time, BEFORE the ring sees this
+        node): load the freshest store snapshot + diff chain into the
+        driver, rebase the mixer's model version to the chain head, and
+        let the normal mix plane (put_diff version gate → obsolete
+        recovery) catch the tail up. ANY failure — no snapshot, CRC
+        refusal, config mismatch, flaky store — degrades to cold boot +
+        join migration, never a partial model (counted + evented)."""
+        from jubatus_tpu.framework.save_load import (SaveLoadError,
+                                                     load_model_bytes)
+
+        t0 = time.monotonic()
+        self.rpc.trace.count("warmboot.attempts")
+        outcome = "cold"
+        meta: Dict[str, Any] = {}
+        try:
+            got = self.store.latest()
+            if got is None:
+                if self.store.records(kind="full"):
+                    # records exist but NONE materialized (corrupt/flaky
+                    # store): that is a degrade, not a clean cold boot
+                    raise SaveLoadError(
+                        "store records present but none materializable")
+                self.rpc.trace.count("warmboot.no_snapshot")
+            else:
+                blob, meta = got
+                with self.driver.lock:
+                    load_model_bytes(blob, self.driver,
+                                     where=f"store:{meta['key']}",
+                                     expected_config=self.config_json)
+                if self.mixer is not None and \
+                        hasattr(self.mixer, "model_version"):
+                    self.mixer.model_version = int(meta["model_version"])
+                self.last_loaded = time.time()  # wall-clock
+                outcome = "warm"
+                self.rpc.trace.count("warmboot.warm")
+        except Exception as e:  # broad-ok — ANY failure degrades to cold
+            outcome = "degraded_to_cold"
+            self.rpc.trace.count("warmboot.degraded_to_cold")
+            self.rpc.trace.events.emit(
+                "warmboot", "degraded_to_cold", severity="warning",
+                error=str(e)[:200])
+            log.warning("warm boot degraded to cold: %s", e)
+        seconds = round(time.monotonic() - t0, 3)
+        self.rpc.trace.gauge("warmboot.seconds", seconds)
+        self.warmboot = {
+            "outcome": outcome, "seconds": seconds,
+            "model_version": int(meta.get("model_version", 0)),
+            "chain_len": int(meta.get("chain_len", 0)),
+            "hlc": int(meta.get("hlc", 0)),
+        }
+        if outcome == "warm":
+            self.rpc.trace.events.emit(
+                "warmboot", "loaded", model_version=meta["model_version"],
+                chain_len=meta["chain_len"], seconds=seconds)
+            log.info("warm boot: model v%d (+%d diffs) in %.3fs",
+                     meta["model_version"], meta["chain_len"], seconds)
+
+    def store_restore(self, _name: str = "", at: int = 0) -> Dict[str, Any]:
+        """Point-in-time restore from the store (``jubactl -c restore
+        --at HLC|latest`` fans this out fleet-wide). Loads the freshest
+        snapshot at/before ``at`` (0 = latest) as this node's model,
+        then — for row-holding drivers — unions in the rows THIS node
+        owns under the CURRENT ring from every other uploading node's
+        snapshot: an N-shard fleet snapshot restores onto an M-shard
+        fleet (reshard-on-restore through the store)."""
+        from jubatus_tpu.framework.save_load import (SaveLoadError,
+                                                     load_model_bytes)
+
+        if self.store is None:
+            return {"restored": False, "error": "no --store-dir configured"}
+        hlc_at = int(at or 0) or None
+        t0 = time.monotonic()
+        got = self.store.latest(at=hlc_at)
+        if got is None:
+            return {"restored": False,
+                    "error": "no store snapshot"
+                             + (f" at hlc<={hlc_at}" if hlc_at else "")}
+        blob, meta = got
+        try:
+            with self.driver.lock:
+                load_model_bytes(blob, self.driver,
+                                 where=f"store:{meta['key']}",
+                                 expected_config=self.config_json)
+        except SaveLoadError as e:
+            return {"restored": False, "error": str(e)[:300]}
+        if self.mixer is not None and hasattr(self.mixer, "model_version"):
+            self.mixer.model_version = int(meta["model_version"])
+        rows = self._restore_rows(hlc_at, skip_node=meta["node"])
+        self.last_loaded = time.time()  # wall-clock
+        self.rpc.trace.count("store.restores")
+        doc = {"restored": True, "model_version": int(meta["model_version"]),
+               "hlc": int(meta["hlc"]), "chain_len": int(meta["chain_len"]),
+               "primary_node": meta["node"], "rows_imported": rows,
+               "seconds": round(time.monotonic() - t0, 3)}
+        self.rpc.trace.events.emit("store", "restored", **doc)
+        return doc
+
+    def _restore_rows(self, hlc_at: Optional[int], skip_node: str) -> int:
+        """Reshard-on-restore: walk every OTHER uploading node's
+        materialized snapshot through a scratch driver and put_rows the
+        rows this member owns under the current ring (standalone: all
+        of them). Row-less drivers import nothing — the primary
+        envelope already carried the whole model."""
+        if not hasattr(self.driver, "put_rows"):
+            return 0
+        from jubatus_tpu.framework.migration import serve_range
+        from jubatus_tpu.server.factory import create_driver
+        from jubatus_tpu.utils.serialization import unpack_obj
+
+        ring = self.cluster_cht()
+        me = self._store_node_name()
+        imported = 0
+        for node, (blob, _meta) in sorted(
+                self.store.materialize_all(at=hlc_at).items()):
+            if node == skip_node:
+                continue
+            try:
+                from jubatus_tpu.framework.save_load import read_envelope
+
+                _sys, user_bytes = read_envelope(blob, f"store:{node}")
+                _uv, state = unpack_obj(user_bytes)
+                scratch = create_driver(self.engine,
+                                        json.loads(self.config_json))
+                scratch.unpack(state)
+            except Exception:  # broad-ok — a sick snapshot skips, never aborts
+                log.warning("restore: skipping node %s snapshot", node,
+                            exc_info=True)
+                continue
+            if not hasattr(scratch, "row_ids"):
+                continue
+            if ring is None:
+                ids = sorted(scratch.row_ids())
+                rows = scratch.get_rows(ids)
+                with self.driver.lock:
+                    imported += int(self.driver.put_rows(rows))
+                continue
+            cursor = ""
+            while True:
+                doc = serve_range(scratch, ring, me, cursor)
+                if doc["rows"]:
+                    with self.driver.lock:
+                        imported += int(self.driver.put_rows(doc["rows"]))
+                if doc["done"]:
+                    break
+                cursor = doc["cursor"]
+        return imported
+
+    def get_store_status(self, _name: str = "") -> Dict[str, Any]:
+        """The durable plane's view, keyed like get_status: record
+        counts, head HLC, per-node chains, this node's warm-boot
+        outcome — what ``jubactl -c restore`` consults for --at."""
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        if self.store is None:
+            return {node.name: {}}
+        doc: Dict[str, Any] = dict(self.store.stats())
+        doc["warmboot"] = dict(self.warmboot)
+        doc["store_dir"] = getattr(self.args, "store_dir", "")
+        doc["records"] = [
+            {"kind": r.kind, "hlc": r.hlc, "version": r.version,
+             "node": r.node} for r in self.store.records()[-64:]]
+        return {node.name: doc}
+
     # -- built-in RPCs (server_base.hpp:41-109, client.hpp:30-87) ------------
     def get_config(self, _name: str = "") -> str:
         return self.config_json
@@ -565,17 +778,85 @@ class EngineServer:
         )
 
     def save(self, _name: str, model_id: str) -> Dict[str, str]:
+        """Write the node-local envelope AND (durable model plane,
+        ISSUE 18) upload the same bytes to the shared store, so the
+        snapshot survives the node that took it. The reply carries the
+        per-node path plus the store id under ``store:<node>`` — a
+        later ``load`` on ANY member accepts ``store:<key>``."""
+        model_id = model_id.decode() if isinstance(model_id, bytes) \
+            else str(model_id)
         path = self.model_path(model_id)
         with self.driver.lock:
             save_model(path, self.driver, model_id=model_id,
                        config=self.config_json)
         self.last_saved = time.time()  # wall-clock
         node = NodeInfo(self.args.eth, self.args.rpc_port)
-        return {node.name: path}
+        out = {node.name: path}
+        if self.store is not None:
+            version = getattr(self.mixer, "model_version", 0) \
+                if self.mixer is not None else int(self.driver.update_count)
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+                out[f"store:{node.name}"] = self.store.put_blob(
+                    blob, kind="full", node=node.name,
+                    model_version=version)
+            except Exception:  # broad-ok — local save stands on its own
+                log.warning("save: store upload failed", exc_info=True)
+        return out
 
     def load(self, _name: str, model_id: str) -> bool:
-        self.load_file(self.model_path(model_id))
+        """Load by model id. Accepts a store id from a save reply
+        (``store:<key>`` — fetched + CRC-validated from the shared
+        store), and falls back to the store when the node-local file is
+        missing (a replacement node loading a snapshot its predecessor
+        took): the newest full record whose system container carries
+        this model id."""
+        from jubatus_tpu.framework.save_load import load_model_bytes
+
+        model_id = model_id.decode() if isinstance(model_id, bytes) \
+            else str(model_id)
+        if model_id.startswith("store:") and self.store is not None:
+            key = model_id[len("store:"):]
+            blob = self.store.fetch(key)
+            with self.driver.lock:
+                load_model_bytes(blob, self.driver, where=f"store:{key}",
+                                 expected_config=self.config_json)
+            self.last_loaded = time.time()  # wall-clock
+            return True
+        try:
+            self.load_file(self.model_path(model_id))
+        except FileNotFoundError:
+            if self.store is None or not self._load_from_store(model_id):
+                raise
         return True
+
+    def _load_from_store(self, model_id: str) -> bool:
+        """Store fallback for ``load``: scan the newest full records for
+        one saved under ``model_id`` (bounded scan — save-uploaded
+        records, not the background chain, carry ids)."""
+        from jubatus_tpu.framework.save_load import (SaveLoadError,
+                                                     load_model_bytes,
+                                                     read_envelope)
+        from jubatus_tpu.utils.serialization import unpack_obj
+
+        for rec in reversed(self.store.records(kind="full")[-32:]):
+            try:
+                blob = self.store.fetch(rec.key)
+                system = unpack_obj(read_envelope(blob, rec.key)[0])
+                if system.get("id") != model_id:
+                    continue
+                with self.driver.lock:
+                    load_model_bytes(blob, self.driver,
+                                     where=f"store:{rec.key}",
+                                     expected_config=self.config_json)
+            except (SaveLoadError, OSError):
+                continue  # corrupt/missing record: keep scanning
+            self.last_loaded = time.time()  # wall-clock
+            log.info("load: %s restored from store record %s",
+                     model_id, rec.key)
+            return True
+        return False
 
     def load_file(self, path: str) -> None:
         with self.driver.lock:
@@ -1030,6 +1311,12 @@ class EngineServer:
         st.update({f"snapshot.{k}": v
                    for k, v in self.snapshots.stats().items()})
         st["rollback.count"] = self.rollbacks
+        # durable model plane (ISSUE 18): store record counts + this
+        # node's warm-boot outcome (counters ride trace.counter.store.*)
+        if self.store is not None:
+            st.update(self.store.stats())
+            st.update({f"warmboot.{k}": v
+                       for k, v in self.warmboot.items()})
         # event plane + incident bundles (ISSUE 14)
         st.update({f"events.{k}": v
                    for k, v in self.rpc.trace.events.stats().items()})
@@ -1053,12 +1340,32 @@ class EngineServer:
         bind_engine(self.rpc, self)
         if self.mixer is not None:
             self.mixer.register_api(self.rpc)
+        # durable model plane (ISSUE 18): warm-boot BEFORE the socket
+        # serves and BEFORE membership registration — a spawning
+        # replica loads the freshest store snapshot + diff chain, then
+        # enters the ring already warm and catches the tail up via the
+        # normal mix plane (an autoscaler spawn whose argv carries
+        # --store-dir takes this path automatically)
+        if self.store is not None \
+                and getattr(self.args, "store_warmboot", True) \
+                and not self.driver.update_count and not self.last_loaded:
+            self._warm_boot()
         actual = self.rpc.serve_background(
             port if port is not None else self.args.rpc_port,
             nthreads=self.args.thread,
             host=self.args.bind_host,
         )
         self.args.rpc_port = actual
+        # the background uploader needs the BOUND port for its node
+        # name (ephemeral-port starts resolve it only now)
+        if self.store is not None and self._store_interval > 0:
+            from jubatus_tpu.framework.model_store import StoreUploader
+
+            self.store_uploader = StoreUploader(
+                self.store, self._store_node_name(),
+                model_id="auto", config=self.config_json,
+                compress=getattr(self.args, "store_compress", "off"),
+                compact_every=getattr(self.args, "store_compact_every", 8))
         # event plane (ISSUE 14): journals attribute events by node name,
         # which an ephemeral-port bind only resolves now; the process
         # default journal keeps the FIRST server's name (one server per

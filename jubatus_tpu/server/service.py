@@ -95,6 +95,10 @@ def bind_engine(rpc: RpcServer, server: Any) -> None:
     rpc.register("get_row_count", server.get_row_count, arity=1)
     # model-integrity plane (ISSUE 15): restore the last-good snapshot
     rpc.register("rollback", server.rollback, arity=2)
+    # durable model plane (ISSUE 18): point-in-time restore from the
+    # shared snapshot store + the store's status read
+    rpc.register("store_restore", server.store_restore, arity=2)
+    rpc.register("get_store_status", server.get_store_status, arity=1)
     _BINDERS[server.engine](rpc, server)
 
 

@@ -41,9 +41,14 @@ model-integrity plane (ISSUE 15) adds two MUTATION-aware sites:
 ``mix.diff.poison`` (the member's diff snapshot, as it leaves the
 model lock — ``nan``/``scale:F`` model a sick replica) and
 ``mix.wire.corrupt`` (each staged collective wire chunk — ``bitflip``
-models transport corruption the chunk CRC must catch). Mutation rules
-fire only through ``fire_mutate``; plain ``fire`` sites ignore them by
-construction. ``fire`` is a no-op
+models transport corruption the chunk CRC must catch). The durable
+model plane (ISSUE 18) adds ``store.put`` / ``store.get`` (the blob
+backend choke points — ``error``/``delay``/``drop``, plus ``bitflip``
+through ``fire_mutate`` to corrupt the bytes so the envelope CRC
+refusal is what gets exercised) and ``store.compact`` (compaction is
+advisory: a fired error must leave the chain replayable). Mutation
+rules fire only through ``fire_mutate``; plain ``fire`` sites ignore
+them by construction. ``fire`` is a no-op
 (one dict lookup on a module flag) when nothing is armed — safe on hot
 paths.
 

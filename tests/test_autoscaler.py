@@ -536,3 +536,32 @@ def test_violation_and_recovery_helpers():
     per_sec["done"][3] = 0
     per_sec["bad"][3] = 0
     assert 3 not in fleet_sim.violation_seconds(per_sec)
+
+
+def test_warm_spawn_flag_rides_scale_out_journal():
+    """ISSUE 18: when the actuator spawns replicas with --store-dir
+    (they warm-boot from the shared model store), the scale_out journal
+    record says so — the operator can tell warm capacity from cold."""
+    from jubatus_tpu.coord.autoscaler import VisorActuator
+
+    # VisorActuator derives the flag from the spawn argv it will pass
+    warm = VisorActuator(MemoryCoordinator(_Store()), "classifier", "c1",
+                         server_argv={"store_dir": "/mnt/models"})
+    cold = VisorActuator(MemoryCoordinator(_Store()), "classifier", "c1",
+                         server_argv={})
+    assert warm.warm_spawn and not cold.warm_spawn
+
+    spawned, drained = [], []
+    actuator = hook(spawned, drained)
+    actuator.warm_spawn = True
+    sc = mk_scaler(actuator)
+    sc.tick(snap(1, t=400.0))                    # hold
+    rec = sc.tick(snap(1, burn=9.0, t=401.0))    # scale_out
+    assert rec["action"] == "scale_out" and spawned == [1]
+    assert rec["warm_spawn"] is True
+    # a cold actuator's record carries no warm_spawn claim
+    sc2 = mk_scaler(hook(spawned, drained))
+    sc2.tick(snap(1, t=410.0))
+    rec2 = sc2.tick(snap(1, burn=9.0, t=411.0))
+    assert rec2["action"] == "scale_out"
+    assert "warm_spawn" not in rec2

@@ -130,6 +130,39 @@ def test_quality_keys_gate_in_compare():
     assert len(regs) == 3
 
 
+def test_direction_inference_durable_model_keys():
+    """ISSUE 18 durable model plane: coldstart-to-serving wall time and
+    killall model loss gate down-good (the loss contract is zero rows
+    beyond the diff-chain tail), warm-boot recovery rides the existing
+    ``_recovery_s`` pattern, warm-beats-cold is a boolean gate."""
+    assert bc.direction("e2e_fleet_coldstart_to_serving_s") == "lower"
+    assert bc.direction("e2e_killall_model_loss_rows") == "lower"
+    assert bc.direction("e2e_warmboot_recovery_s") == "lower"
+    assert bc.direction("e2e_warmboot_beats_cold_ok") == "bool"
+    # neighbors that must NOT accidentally gate: raw diagnostics
+    assert bc.direction("e2e_killall_tail_window_rows") is None
+    assert bc.direction("e2e_warmboot_chain_len") is None
+    assert bc.direction("e2e_killall_acked_rows") is None
+
+
+def test_durable_model_keys_gate_in_compare():
+    old = {"e2e_fleet_coldstart_to_serving_s": 9.0,
+           "e2e_warmboot_recovery_s": 1.5,
+           "e2e_killall_model_loss_rows": 0,
+           "e2e_warmboot_beats_cold_ok": True}
+    new = {"e2e_fleet_coldstart_to_serving_s": 14.0,  # slower: regression
+           "e2e_warmboot_recovery_s": 1.2,            # improved
+           "e2e_killall_model_loss_rows": 120,        # durability loss
+           "e2e_warmboot_beats_cold_ok": False}       # gate flip
+    rows, regs = bc.compare(bc.flatten(old), bc.flatten(new))
+    verdicts = {r["key"]: r["verdict"] for r in rows}
+    assert verdicts["e2e_fleet_coldstart_to_serving_s"] == "REGRESSED"
+    assert verdicts["e2e_killall_model_loss_rows"] == "REGRESSED"
+    assert verdicts["e2e_warmboot_beats_cold_ok"] == "REGRESSED"
+    assert verdicts["e2e_warmboot_recovery_s"] == "improved"
+    assert len(regs) == 3
+
+
 def test_sharded_keys_gate_in_compare():
     old = {"sharded_train_samples_per_sec_d26_8shard": 50000.0,
            "sharded_classify_p99_ms_d26_8shard": 40.0,

@@ -164,3 +164,53 @@ def test_visor_spawns_and_jubactl_controls(tmp_path):
         view.close()
     finally:
         visor.stop()
+
+
+# -- jubactl restore (durable model plane, ISSUE 18) --------------------------
+
+
+def test_jubactl_restore_point_in_time(tmp_path):
+    """`jubactl -c restore` drives every registered member through the
+    store_restore RPC: the model rewinds to the newest store snapshot
+    at-or-before --at (default latest), and a malformed --at is a
+    usage error, not a crash."""
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.cmd import jubactl
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+
+    coord_dir = str(tmp_path / "coord")
+    srv = EngineServer(
+        "classifier", CONF,
+        args=ServerArgs(engine="classifier", coordinator=coord_dir,
+                        name="v1", listen_addr="127.0.0.1",
+                        interval_sec=1e9, interval_count=1 << 30,
+                        telemetry_interval=0,
+                        store_dir=str(tmp_path / "store"),
+                        store_interval=30.0))
+    srv.start(0)
+    try:
+        with ClassifierClient("127.0.0.1", srv.rpc.port, "v1",
+                              timeout=30.0) as c:
+            assert c.train([["pos", Datum({"x": 1.0})],
+                            ["neg", Datum({"x": -1.0})]]) == 2
+        # snapshot the model into the store, then train PAST it: the
+        # restore must visibly rewind to the snapshot moment
+        srv.store_uploader.tick(srv.driver, int(srv.driver.update_count))
+        probe = Datum({"x": 0.5})
+        at_snapshot = srv.driver.classify([probe])
+        with ClassifierClient("127.0.0.1", srv.rpc.port, "v1",
+                              timeout=30.0) as c:
+            c.train([["neg", Datum({"x": 1.0})]] * 8)
+        assert srv.driver.classify([probe]) != at_snapshot
+        assert jubactl.main(["-c", "restore", "-t", "classifier",
+                             "-n", "v1", "-z", coord_dir]) == 0
+        assert srv.driver.classify([probe]) == at_snapshot
+        assert srv.rpc.trace.counters().get("store.restores", 0) == 1
+        # malformed --at: usage error before any RPC goes out
+        assert jubactl.main(["-c", "restore", "-t", "classifier",
+                             "-n", "v1", "-z", coord_dir,
+                             "--at", "yesterday"]) == 1
+        assert srv.rpc.trace.counters().get("store.restores", 0) == 1
+    finally:
+        srv.stop()

@@ -164,6 +164,51 @@ def test_quality_coverage_gate_fires_and_pragma_opts_out(tmp_path):
                 if "quality-recorder" in p]
 
 
+def test_store_crc_gate_fires_and_pragma_opts_out(tmp_path):
+    """The model-store write rule (ISSUE 18): a backend put/put_blob
+    site in a model_store module whose enclosing function shows no
+    envelope evidence is flagged; pack_envelope/read_envelope in the
+    function and the # no-crc pragma are not, and files without
+    model_store in the name are exempt."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo / "tools" / "codestyle"))
+    try:
+        import check as codestyle
+    finally:
+        sys.path.pop(0)
+    d = tmp_path / "jubatus_tpu" / "framework"
+    d.mkdir(parents=True)
+    bad = d / "model_store_extra.py"
+    bad.write_text(
+        '"""doc."""\n'
+        "def unstamped(self, key, data):\n"
+        "    self.backend.put(key, data)\n"                       # flagged
+        "def unstamped_blob(self, blob):\n"
+        "    return self.put_blob(blob, kind=\"full\")\n"         # flagged
+        "def stamped(self, system, payload):\n"
+        "    blob = pack_envelope(system, payload)\n"
+        "    self.backend.put(self._key(), blob)\n"               # stamped
+        "def verified(self, blob):\n"
+        "    read_envelope(blob, \"store:full\")\n"
+        "    self.backend.put(self._key(), blob)\n"               # verified
+        "def pragma(self, blob):\n"
+        "    self.put_blob(blob)  # no-crc - stamped by caller\n",
+        encoding="utf-8")
+    problems = codestyle.check_file(str(bad))
+    hits = [p for p in problems if "CRC-envelope" in p]
+    assert len(hits) == 2, problems
+    assert ":3:" in hits[0] and ":5:" in hits[1]
+    # the same write OUTSIDE a model_store module stays legal (dict
+    # .put()-alikes, queue puts, unrelated backends)
+    ok = d / "row_store.py"
+    ok.write_text(
+        '"""doc."""\n'
+        "def write(self, key, data):\n"
+        "    self.backend.put(key, data)\n", encoding="utf-8")
+    assert not [p for p in codestyle.check_file(str(ok))
+                if "CRC-envelope" in p]
+
+
 def test_metrics_docs_catalog_clean():
     """The metric-catalog gate (ISSUE 7): every literal counter/gauge
     key exported through the tracing registry must appear in the

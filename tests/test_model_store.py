@@ -1,0 +1,477 @@
+"""Durable model plane (ISSUE 18): shared snapshot store, diff chains,
+warm-boot, point-in-time restore, chaos.
+
+Covers the robustness acceptance story in-process:
+
+- diff documents round-trip losslessly (``compress="off"``) and the
+  int8 mode's quantization error telescopes to the LAST diff only
+  (error feedback: each diff is computed against the replayer's
+  belief, not the true state);
+- chain replay refuses to cross a gap (a deleted middle diff truncates
+  at the longest valid prefix — never skips records), and replaying a
+  chain equals the compacted full bit-for-bit;
+- the store refuses unstamped blobs at put and CRC-refuses corrupt
+  bytes at get (counted, evented, never partially loaded);
+- a flaky store degrades warm boot to a cold boot — counted + evented
+  — and never serves a wrong model;
+- the save/load RPCs ride the store: save replies carry a store id,
+  load accepts one (and falls back to a store scan when the local
+  checkpoint file is gone);
+- reshard-on-restore: a 1-node fleet's store snapshot restores onto 8
+  shards and an 8-node fleet's onto 2, row-parity and bit-exact
+  against a direct checkpoint load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from jubatus_tpu.core.datum import Datum
+from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+from jubatus_tpu.framework.model_store import (
+    LocalDirBackend,
+    ModelStore,
+    StoreUploader,
+    apply_diff,
+    diff_tree,
+)
+from jubatus_tpu.framework.save_load import (
+    SaveLoadError,
+    pack_envelope,
+    read_envelope,
+)
+from jubatus_tpu.rpc.client import RpcClient
+from jubatus_tpu.server import EngineServer
+from jubatus_tpu.server.args import ServerArgs
+from jubatus_tpu.server.factory import create_driver
+from jubatus_tpu.utils import events, faults
+from jubatus_tpu.utils.serialization import pack_obj, unpack_obj
+
+CLF_CONF = {"method": "AROW", "parameter": {"regularization_weight": 1.0},
+            "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+NN_CONF = {"method": "lsh", "parameter": {"hash_num": 8},
+           "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+
+
+class _Counts(dict):
+    def __call__(self, name, n=1):
+        self[name] = self.get(name, 0) + n
+
+
+def _mkstore(tmp_path, counter=None, engine="classifier"):
+    return ModelStore(LocalDirBackend(str(tmp_path / "store")),
+                      cluster="t", engine=engine, counter=counter)
+
+
+def _clf_driver(trained_rows=0, seed=0):
+    d = create_driver("classifier", CLF_CONF)
+    rng = np.random.default_rng(seed)
+    for i in range(trained_rows):
+        d.train([("pos" if rng.random() < 0.5 else "neg",
+                  Datum({"f0": float(rng.normal()),
+                         "f1": float(rng.normal())}))])
+    return d
+
+
+def _tree_equal(a, b):
+    if isinstance(a, dict):
+        return isinstance(b, dict) and set(a) == set(b) and \
+            all(_tree_equal(a[k], b[k]) for k in a)
+    if isinstance(a, (list, tuple)):
+        return isinstance(b, (list, tuple)) and len(a) == len(b) and \
+            all(_tree_equal(x, y) for x, y in zip(a, b))
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return isinstance(a, np.ndarray) and isinstance(b, np.ndarray) \
+            and a.dtype == b.dtype and bool(np.array_equal(a, b))
+    return a == b
+
+
+# -- diff documents -----------------------------------------------------------
+
+
+def test_diff_tree_lossless_roundtrip():
+    base = {"w": np.arange(8, dtype=np.float32),
+            "meta": {"n": 3, "tag": "x"},
+            "rows": [np.ones(4, dtype=np.float32), "keep"]}
+    new = {"w": np.arange(8, dtype=np.float32) * 1.7 + 0.1,
+           "meta": {"n": 5, "tag": "x"},
+           "rows": [np.ones(4, dtype=np.float32) * 2.0, "keep"]}
+    doc, belief = diff_tree(base, new)
+    replay = apply_diff(unpack_obj(pack_obj(base)), doc)
+    assert _tree_equal(replay, new)
+    assert _tree_equal(belief, new)
+    # unchanged leaves don't appear in the doc
+    paths = [tuple(p) for p, _ in doc["changed"]]
+    assert ("rows", 1) not in paths and ("meta", "tag") not in paths
+
+
+def test_diff_tree_structure_change_ships_raw():
+    base = {"labels": {"pos": np.zeros(4, dtype=np.float32)}}
+    new = {"labels": {"pos": np.zeros(4, dtype=np.float32),
+                      "neg": np.ones(4, dtype=np.float32)}}
+    doc, belief = diff_tree(base, new)
+    replay = apply_diff(unpack_obj(pack_obj(base)), doc)
+    assert _tree_equal(replay, new)
+    # key-set change replaces the container whole
+    (path, spec), = doc["changed"]
+    assert spec["m"] == "raw"
+
+
+def test_diff_chain_int8_error_feedback_telescopes():
+    """In int8 mode, belief == what a replayer reconstructs (exactly),
+    so chain error never accumulates past the last diff's quantization
+    residual."""
+    rng = np.random.default_rng(7)
+    state = {"w": rng.normal(size=512).astype(np.float32)}
+    belief = unpack_obj(pack_obj(state))
+    replay = unpack_obj(pack_obj(state))
+    for _ in range(5):
+        new = {"w": (state["w"] + rng.normal(size=512).astype(np.float32)
+                     * 0.01).astype(np.float32)}
+        doc, belief = diff_tree(belief, new, compress="int8")
+        replay = apply_diff(replay, doc)
+        state = new
+    # the invariant that bounds the tail: replayer state == belief
+    assert _tree_equal(replay, belief)
+    # and the residual vs truth is one quantization step, not five
+    err = float(np.abs(replay["w"] - state["w"]).max())
+    assert err < 1e-3
+
+
+# -- chain semantics ----------------------------------------------------------
+
+
+def _upload_chain(store, driver, ticks=3, rows_per_tick=20, seed=1):
+    up = StoreUploader(store, "n1", config=json.dumps(CLF_CONF))
+    rng = np.random.default_rng(seed)
+    version = 0
+    for _ in range(ticks):
+        for _i in range(rows_per_tick):
+            driver.train([("pos" if rng.random() < 0.5 else "neg",
+                           Datum({"f0": float(rng.normal()),
+                                  "f1": float(rng.normal())}))])
+        version += rows_per_tick
+        up.tick(driver, version)
+    return up
+
+
+def test_chain_gap_refused_truncates_at_prefix(tmp_path):
+    store = _mkstore(tmp_path)
+    d = _clf_driver()
+    _upload_chain(store, d, ticks=4)
+    recs = store.records(kind="diff")
+    assert len(recs) == 3
+    # replaying the intact chain reaches the head
+    _, meta = store.materialize(node="n1")
+    assert meta["chain_len"] == 3
+    # lose the MIDDLE diff: replay must stop before it, not skip it
+    store.backend.delete(recs[1].key)
+    _, meta = store.materialize(node="n1")
+    assert meta["chain_len"] == 1
+    assert meta["model_version"] == recs[0].version
+
+
+def test_chain_replay_equals_compacted_full(tmp_path):
+    store = _mkstore(tmp_path)
+    d = _clf_driver()
+    _upload_chain(store, d, ticks=4)
+    blob_replay, meta = store.materialize(node="n1")
+    assert meta["chain_len"] == 3
+    key = store.compact(node="n1")
+    assert key is not None
+    # the folded diffs are gone; the compacted full IS the replay
+    assert store.records(kind="diff", node="n1") == []
+    blob_compact, meta2 = store.materialize(node="n1")
+    assert meta2["chain_len"] == 0
+    _, user_replay = read_envelope(blob_replay, "replay")
+    _, user_compact = read_envelope(blob_compact, "compact")
+    assert _tree_equal(unpack_obj(user_replay), unpack_obj(user_compact))
+
+
+def test_point_in_time_resolve_picks_newest_at_or_before(tmp_path):
+    store = _mkstore(tmp_path)
+    d = _clf_driver()
+    _upload_chain(store, d, ticks=3)
+    recs = store.records()
+    mid_hlc = recs[1].hlc  # full + first diff
+    _, meta = store.materialize(at=mid_hlc, node="n1")
+    assert meta["chain_len"] == 1
+    assert meta["hlc"] == mid_hlc
+    _, meta_latest = store.materialize(node="n1")
+    assert meta_latest["hlc"] == recs[-1].hlc
+
+
+# -- CRC refusal + fault sites ------------------------------------------------
+
+
+def test_put_blob_refuses_unstamped_bytes(tmp_path):
+    store = _mkstore(tmp_path)
+    with pytest.raises(SaveLoadError):
+        store.put_blob(b"not an envelope", kind="full", node="n1",
+                       model_version=1)
+    assert store.records() == []
+
+
+def test_corrupt_get_is_refused_counted_and_evented(tmp_path):
+    counts = _Counts()
+    store = _mkstore(tmp_path, counter=counts)
+    blob = pack_envelope(pack_obj({"type": "classifier"}), pack_obj([1, {}]))
+    key = store.put_blob(blob, kind="full", node="n1", model_version=1)
+    before = events.hlc_now()
+    with faults.armed("store.get:bitflip"):
+        with pytest.raises(SaveLoadError):
+            store.fetch(key)
+        # a fully corrupt store yields NO snapshot — never a partial one
+        assert store.latest() is None
+    assert counts.get("store.crc_refused", 0) >= 1
+    evs = events.default_journal().snapshot(since=before, grep="crc_refused")
+    assert evs and evs[-1]["subsystem"] == "store"
+    # disarmed, the same record reads back intact
+    assert store.fetch(key) == blob
+
+
+def test_put_fault_counted_chain_consistent(tmp_path):
+    counts = _Counts()
+    store = _mkstore(tmp_path, counter=counts)
+    d = _clf_driver()
+    up = _upload_chain(store, d, ticks=2)
+    with faults.armed("store.put:error"):
+        d.train([("pos", Datum({"f0": 1.0}))])
+        with pytest.raises(faults.FaultInjected):
+            up.tick(d, 999)
+    assert counts.get("store.put_errors", 0) >= 1
+    # the chain on disk still replays to its pre-fault head
+    _, meta = store.materialize(node="n1")
+    assert meta["chain_len"] == 1
+
+
+def test_compact_fault_is_advisory(tmp_path):
+    store = _mkstore(tmp_path)
+    d = _clf_driver()
+    _upload_chain(store, d, ticks=3)
+    with faults.armed("store.compact:error"):
+        with pytest.raises(faults.FaultInjected):
+            store.compact(node="n1")
+    # nothing was deleted; the chain replays exactly as before
+    _, meta = store.materialize(node="n1")
+    assert meta["chain_len"] == 2
+
+
+# -- server integration: warm boot, save/load, degrade-to-cold ----------------
+
+
+def _clf_args(tmp_path, **over):
+    base = dict(engine="classifier", listen_addr="127.0.0.1",
+                datadir=str(tmp_path / "data"), timeout=10.0,
+                store_dir=str(tmp_path / "store"), store_interval=30.0,
+                interval_sec=1e9, interval_count=1 << 30)
+    base.update(over)
+    os.makedirs(base["datadir"], exist_ok=True)
+    return ServerArgs(**base)
+
+
+def _train_and_tick(srv, rows=40, seed=3):
+    rng = np.random.default_rng(seed)
+    srv.driver.train([("pos" if rng.random() < 0.5 else "neg",
+                       Datum({"f0": float(rng.normal()),
+                              "f1": float(rng.normal())}))
+                      for _ in range(rows)])
+    # bypass the interval throttle: tests tick the uploader directly
+    srv.store_uploader.tick(srv.driver, int(srv.driver.update_count))
+
+
+def test_warm_boot_restores_identical_model(tmp_path):
+    s1 = EngineServer("classifier", CLF_CONF, _clf_args(tmp_path))
+    s1.start(0)
+    try:
+        _train_and_tick(s1)
+        probe = Datum({"f0": 0.5, "f1": -0.5})
+        before = s1.driver.classify([probe])
+    finally:
+        s1.stop()  # hard kill: stop() persists nothing
+    s2 = EngineServer("classifier", CLF_CONF, _clf_args(tmp_path))
+    s2.start(0)
+    try:
+        assert s2.warmboot["outcome"] == "warm"
+        assert s2.warmboot["model_version"] == 40
+        after = s2.driver.classify([probe])
+        assert _tree_equal(before, after)
+        st = list(s2.get_status().values())[0]
+        assert st["warmboot.outcome"] == "warm"
+        assert st["store.records_full"] >= 1
+    finally:
+        s2.stop()
+
+
+def test_flaky_store_degrades_warm_to_cold_never_wrong(tmp_path):
+    s1 = EngineServer("classifier", CLF_CONF, _clf_args(tmp_path))
+    s1.start(0)
+    try:
+        _train_and_tick(s1)
+    finally:
+        s1.stop()
+    before = events.hlc_now()
+    # every store read corrupts: warm boot must refuse the bytes and
+    # fall back to a cold boot — never load a CRC-broken model
+    with faults.armed("store.get:bitflip"):
+        s2 = EngineServer("classifier", CLF_CONF, _clf_args(tmp_path))
+        s2.start(0)
+        try:
+            assert s2.warmboot["outcome"] == "degraded_to_cold"
+            assert s2.driver.update_count == 0  # pristine, not partial
+            counters = s2.rpc.trace.counters()
+            assert counters.get("warmboot.degraded_to_cold", 0) == 1
+            assert counters.get("store.crc_refused", 0) >= 1
+            evs = s2.rpc.trace.events.snapshot(grep="degraded_to_cold")
+            assert evs and evs[-1]["subsystem"] == "warmboot"
+        finally:
+            s2.stop()
+    # the store's own CRC refusals ride the process journal
+    evs = events.default_journal().snapshot(since=before, grep="crc_refused")
+    assert evs and evs[-1]["subsystem"] == "store"
+
+
+def test_no_snapshot_cold_boot_counted(tmp_path):
+    srv = EngineServer("classifier", CLF_CONF, _clf_args(tmp_path))
+    srv.start(0)
+    try:
+        assert srv.warmboot["outcome"] == "cold"
+        assert srv.rpc.trace.counters().get("warmboot.no_snapshot", 0) == 1
+    finally:
+        srv.stop()
+
+
+def test_save_reply_carries_store_id_and_load_accepts_it(tmp_path):
+    s1 = EngineServer("classifier", CLF_CONF, _clf_args(tmp_path))
+    s1.start(0)
+    try:
+        _train_and_tick(s1)
+        probe = Datum({"f0": 1.5, "f1": 0.25})
+        want = s1.driver.classify([probe])
+        reply = s1.save("t", "snap1")
+        store_keys = [v for k, v in reply.items()
+                      if str(k).startswith("store:")]
+        assert len(store_keys) == 1 and store_keys[0].endswith(".jub")
+    finally:
+        s1.stop()
+    # a FRESH node (empty datadir) loads by explicit store key...
+    args2 = _clf_args(tmp_path, datadir=str(tmp_path / "data2"),
+                      store_warmboot=False)
+    s2 = EngineServer("classifier", CLF_CONF, args2)
+    s2.start(0)
+    try:
+        assert s2.load("t", "store:" + store_keys[0])
+        assert _tree_equal(s2.driver.classify([probe]), want)
+        s2.driver.clear()
+        # ...and by plain id, via the store-scan fallback when the
+        # local checkpoint file does not exist
+        assert s2.load("t", "snap1")
+        assert _tree_equal(s2.driver.classify([probe]), want)
+    finally:
+        s2.stop()
+
+
+# -- reshard-on-restore through the store -------------------------------------
+
+
+def _nn_args(tmp_path, name="nn", **over):
+    base = dict(engine="nearest_neighbor", coordinator="(shared)",
+                name=name, listen_addr="127.0.0.1",
+                datadir=str(tmp_path / "data"), timeout=30.0,
+                store_dir=str(tmp_path / "store"), store_interval=30.0,
+                interval_sec=1e9, interval_count=1 << 30)
+    base.update(over)
+    os.makedirs(base["datadir"], exist_ok=True)
+    return ServerArgs(**base)
+
+
+def _nn_boot(tmp_path, coord_store, **over):
+    srv = EngineServer("nearest_neighbor", NN_CONF,
+                       _nn_args(tmp_path, **over),
+                       coord=MemoryCoordinator(coord_store))
+    srv.start(0)
+    return srv
+
+
+def _nn_datum(i):
+    return Datum({"f0": float(i) + 1.0, "f1": float(i % 7) + 1.0})
+
+
+def _direct_rows(tmp_path, engine="nearest_neighbor"):
+    """Ground truth: every row from every node's snapshot, loaded
+    directly from the store's checkpoint envelopes (no server)."""
+    store = ModelStore(LocalDirBackend(str(tmp_path / "store")),
+                       cluster="nn", engine=engine)
+    rows = {}
+    for _node, (blob, _meta) in store.materialize_all().items():
+        system_b, user_b = read_envelope(blob, "direct")
+        system = unpack_obj(system_b)
+        scratch = create_driver(engine, json.loads(system["config"]))
+        _ver, state = unpack_obj(user_b)
+        scratch.unpack(state)
+        for row in scratch.get_rows():
+            rows[row[0]] = pack_obj(row[1:])
+    return rows
+
+
+def _fleet_rows(servers):
+    rows = {}
+    for s in servers:
+        for row in s.driver.get_rows():
+            got = pack_obj(row[1:])
+            assert rows.get(row[0], got) == got, \
+                f"row {row[0]} differs between fleet members"
+            rows[row[0]] = got
+    return rows
+
+
+def _reshard_cycle(tmp_path, n_from, n_to, rows=48):
+    """Boot ``n_from`` NN servers on a shared store, spread rows across
+    them, upload, hard-kill, boot ``n_to`` fresh servers on the SAME
+    store, restore fleet-wide, and return (direct, restored) row maps."""
+    coord = _Store()
+    fleet = [_nn_boot(tmp_path, coord) for _ in range(n_from)]
+    try:
+        for i in range(rows):
+            fleet[i % n_from].driver.set_row(f"row{i:03d}", _nn_datum(i))
+        for s in fleet:
+            s.store_uploader.tick(s.driver, int(s.driver.update_count))
+    finally:
+        for s in fleet:
+            s.stop()
+    direct = _direct_rows(tmp_path)
+    assert len(direct) == rows
+    coord2 = _Store()
+    fleet2 = [_nn_boot(tmp_path, coord2, store_warmboot=False)
+              for _ in range(n_to)]
+    try:
+        # wait until every member sees the full ring before restoring
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if all(len(s.cluster_cht().members) == n_to for s in fleet2):
+                break
+            time.sleep(0.05)
+        for s in fleet2:
+            with RpcClient("127.0.0.1", s.rpc.port, timeout=60.0) as c:
+                doc = c.call("store_restore", "nn", 0)
+            assert doc.get("restored"), doc
+        restored = _fleet_rows(fleet2)
+    finally:
+        for s in fleet2:
+            s.stop()
+    return direct, restored
+
+
+def test_reshard_restore_1_to_8(tmp_path):
+    direct, restored = _reshard_cycle(tmp_path, 1, 8)
+    assert restored == direct
+
+
+def test_reshard_restore_8_to_2(tmp_path):
+    direct, restored = _reshard_cycle(tmp_path, 8, 2)
+    assert restored == direct

@@ -86,11 +86,20 @@ _HIGHER = re.compile(
 #: PSI drift between reference and live windows — on an unshifted
 #: stream any growth means a false drift alarm (the bare ``drift``
 #: pattern already matches ``_drift_score``; ``_psi`` needs its own).
+#: ``_coldstart_to_serving_s`` / ``_model_loss_rows`` cover the durable
+#: model plane (ISSUE 18): fleet wall time from first boot to first
+#: served answer, and rows the killall drill lost BEYOND the diff-chain
+#: tail — growth in the former means recovery got slower, any growth in
+#: the latter is durability loss (the contract is zero). The warm-boot
+#: wall time rides the existing ``_recovery_s`` pattern
+#: (``e2e_warmboot_recovery_s``) and the warm-beats-cold verdict rides
+#: ``_ok`` (``e2e_warmboot_beats_cold_ok``).
 _LOWER = re.compile(
     r"(_ms($|_)|_ratio($|_)|_us($|_)|wire_mb|_per_host($|_)|drift"
     r"|_error(s)?($|_)|_timeouts|_errors_total|_denials|rows_lost"
     r"|_stall_ms($|_)|_lag_rounds($|_)"
-    r"|_recovery_s($|_)|_violation_s($|_)|_psi($|_))")
+    r"|_recovery_s($|_)|_violation_s($|_)|_psi($|_)"
+    r"|_coldstart_to_serving_s($|_)|_model_loss_rows($|_))")
 
 #: built-in per-key tolerance defaults (explicit --key-tolerance wins):
 #: the nproc16 sweep time-slices 16 gloo processes over however few
@@ -185,7 +194,15 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
             o, n = float(o), float(n)
             change = (n - o) / abs(o) if o else (0.0 if n == o else None)
             verdict = "info"
-            if d == "higher":
+            if change is None and d in ("higher", "lower"):
+                # zero baseline, nonzero now: relative change is
+                # unbounded, which is the OPPOSITE of ungateable — a
+                # loss counter (rows_lost, _model_loss_rows) whose
+                # contract is exactly zero must trip on ANY growth
+                grew = n > o
+                verdict = "REGRESSED" if grew == (d == "lower") \
+                    else "improved"
+            elif d == "higher":
                 verdict = "REGRESSED" if (change is not None
                                           and change < -tol) else \
                     ("improved" if change is not None and change > tol

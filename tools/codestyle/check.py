@@ -227,6 +227,59 @@ def _check_guard_coverage(path: str, tree: "ast.AST",
     return problems
 
 
+#: durable-model-plane coverage gate (ISSUE 18): every blob the model
+#: store writes outlives the process that wrote it — a snapshot written
+#: WITHOUT the CRC envelope stamp (framework/save_load.pack_envelope)
+#: is silent corruption waiting for the warm-boot that trusts it, and
+#: the store's read side refuses unstamped bytes by contract. So every
+#: backend write site (``.put(...)`` / ``.put_blob(...)``) in a
+#: model-store module must sit in a function that shows envelope
+#: evidence — a ``pack_envelope`` (stamping) or ``read_envelope``
+#: (verify-before-write precondition) reference in the enclosing
+#: function. A site whose bytes are genuinely stamped upstream opts out
+#: per line with a ``# no-crc`` pragma stating where the stamp IS.
+_STORE_WRITE_RE = re.compile(r"(\.put\(|\.put_blob\()")
+_CRC_REF_RE = re.compile(r"(pack_envelope|read_envelope)")
+
+
+def _is_store_gated(posix_path: str) -> bool:
+    return ("/jubatus_tpu/" in posix_path
+            and "model_store" in os.path.basename(posix_path))
+
+
+def _check_store_crc_coverage(path: str, tree: "ast.AST",
+                              lines: List[str]) -> List[str]:
+    """put/put_blob call sites in model-store modules must sit inside a
+    function referencing the CRC envelope (or carry ``# no-crc``)."""
+    funcs: List[tuple] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.append((node.lineno, node.end_lineno))
+    problems = []
+    for i, line in enumerate(lines, 1):
+        if not _STORE_WRITE_RE.search(line) or "# no-crc" in line:
+            continue
+        if re.search(r"def\s+put(_blob)?\s*\(", line):
+            continue  # the definition, not a write site
+        spans = [f for f in funcs if f[0] <= i <= f[1]]
+        if spans:
+            start, end = max(spans, key=lambda f: f[0])  # innermost
+            body = "\n".join(lines[start - 1:end])
+        else:
+            body = line
+        if not _CRC_REF_RE.search(body):
+            problems.append(
+                f"{path}:{i}: store write site without a CRC-envelope "
+                "reference in the enclosing function (stamp the blob "
+                "with save_load.pack_envelope — or verify it with "
+                "read_envelope — before it hits the backend; an "
+                "unstamped snapshot is silent corruption for the warm-"
+                "boot that trusts it; append '# no-crc — <where the "
+                "stamp is>' where the bytes are genuinely stamped "
+                "upstream)")
+    return problems
+
+
 #: data-quality coverage gate (ISSUE 17): a train path that bypasses
 #: the quality recorder is invisible to the drift/prequential plane —
 #: the model silently trains on a stream nobody is evaluating. So every
@@ -437,6 +490,9 @@ def check_file(path: str) -> List[str]:
         if _is_guard_gated(posix):
             problems.extend(_check_guard_coverage(path, tree,
                                                   text.splitlines()))
+        if _is_store_gated(posix):
+            problems.extend(_check_store_crc_coverage(path, tree,
+                                                      text.splitlines()))
     return problems
 
 
