@@ -222,7 +222,7 @@ def run(transport: str = "python", workload: str = "numeric",
         tag: str = "", microbatch: int = 0, native_ingest: bool = True,
         forensics: bool = True, model_health=None,
         profile_hz=None, events_enabled=None, quality=None,
-        seed=None) -> dict:
+        usage=None, seed=None) -> dict:
     from jubatus_tpu.server import EngineServer
     from jubatus_tpu.server.args import ServerArgs
 
@@ -271,6 +271,15 @@ def run(transport: str = "python", workload: str = "numeric",
         health_args["quality_sample"] = 0.05
     elif quality is False:
         health_args["quality_sample"] = 0.0
+    # usage (ISSUE 19): None keeps the stock server (usage ledger armed
+    # at its default top-64 table); True pins the documented default
+    # explicitly; False disarms the attribution plane entirely (top 0 =
+    # no ledger object, the span sink is never installed, recorder
+    # hooks stay None) — the honest "off" side of the usage-overhead A/B
+    if usage is True:
+        health_args["usage_top"] = 64
+    elif usage is False:
+        health_args["usage_top"] = 0
     try:
         srv = EngineServer(
             "classifier", conf,
@@ -695,6 +704,196 @@ def run_quality_overhead(transport: str = "python",
             f"median of {len(r_mean)} adjacent on/off pairs; the mean "
             "ratio carries the <2% verdict, p50 is bucket-quantized "
             "(~19% steps)")
+    return out
+
+
+def run_usage_overhead(transport: str = "python",
+                       measure: float = TEXT_MEASURE_SECONDS,
+                       pairs: int = 3) -> dict:
+    """ISSUE 19: the usage-attribution plane ships with its serving
+    cost measured. Adjacent A/B PAIRS on the classify plane — ledger
+    armed at the documented top-64 table vs ``--usage-top 0`` (the off
+    side never constructs a ledger: no span sink, no recorder hooks,
+    no per-request principal swap billing) — same protocol and <2%
+    budget as run_quality_overhead: a single pair swings ~±10% on the
+    shared core, so the verdict is the MEDIAN-of-pairs mean ratio,
+    with the median p50 ratio held to one histogram bucket step
+    (~19%)."""
+    out: dict = {}
+    r_p50, r_mean = [], []
+    for i in range(max(1, pairs)):
+        sides = {}
+        for tag, armed in (("usage_on", True), ("usage_off", False)):
+            try:
+                r = run(transport, workload="classify", measure=measure,
+                        tag=tag, native_ingest=False, usage=armed)
+            except Exception as e:  # noqa: BLE001 — partial beats none
+                out[f"e2e_{tag}_error"] = repr(e)[:200]
+                continue
+            if i == 0:
+                out.update(r)  # per-side keys of record: first pair
+            sides[tag] = r
+        for key, acc in (("p50_ms", r_p50), ("mean_ms", r_mean)):
+            on = sides.get("usage_on", {}).get(
+                f"e2e_rpc_classify_{key}_usage_on")
+            off = sides.get("usage_off", {}).get(
+                f"e2e_rpc_classify_{key}_usage_off")
+            if on and off:
+                acc.append(on / off)
+    import numpy as _np
+
+    if r_p50 and r_mean:
+        med_p50 = float(_np.median(r_p50))
+        med_mean = float(_np.median(r_mean))
+        out["e2e_usage_overhead_p50_ratio"] = round(med_p50, 4)
+        out["e2e_usage_overhead_mean_ratio"] = round(med_mean, 4)
+        out["e2e_usage_overhead_ok"] = bool(
+            med_mean <= 1.02 and med_p50 <= 1.19)
+        out["e2e_usage_overhead_note"] = (
+            f"median of {len(r_mean)} adjacent on/off pairs; the mean "
+            "ratio carries the <2% verdict, p50 is bucket-quantized "
+            "(~19% steps)")
+    return out
+
+
+def run_usage_attribution(nproc: int = 4, seconds: float = 18.0,
+                          base_rate: float = 40.0, seed=None) -> dict:
+    """ISSUE 19: the usage ledger's books must BALANCE. A mixed
+    3-tenant fleet_sim profile (checkout/search/ads, tenant id on the
+    envelope's 7th element) drives proxy + two backends; afterwards the
+    conservation gate compares, per node, the ledger's accounted
+    CPU-thread-seconds against the span plane's process totals (sum of
+    ``rpc.*`` dispatch-histogram ``total_s``, client spans excluded) and
+    the accounted device-seconds against the coalescers' measured device
+    time. Both sides observe the SAME work through different pipes — a
+    gap means requests are escaping attribution.
+
+    Keys of record:
+
+    - ``e2e_usage_attribution_err_frac`` — worst per-node relative gap
+      across both planes; gated ≤ 0.10 (``..._ok``).
+    - ``e2e_usage_tenants_distinct_ok`` — the fleet-merged doc (live
+      ``get_usage`` through the proxy, folded with
+      ``usage.merge_usage``) shows ≥ 2 tenants with distinct nonzero
+      CPU cost — attribution, not just accounting.
+    - ``e2e_capacity_headroom`` — a backend's published headroom gauge
+      after a forced capacity tick.
+    """
+    from jubatus_tpu.coord.memory import MemoryCoordinator, _Store
+    from jubatus_tpu.rpc.client import RpcClient
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.server.args import ServerArgs
+    from jubatus_tpu.server.proxy import Proxy, ProxyArgs
+    from jubatus_tpu.utils import usage as usage_mod
+    from bench_mix import scrub_child_env
+
+    fleet_sim = _fleet_sim()
+    seed = SEED if seed is None else int(seed)
+    # flat rate, no flash: the gate is about books, not elasticity
+    model = fleet_sim.TrafficModel(seed=seed, base_rate=base_rate,
+                                   diurnal_amplitude=0.0)
+    prev = os.environ.get("JUBATUS_TPU_NATIVE_RPC")
+    os.environ["JUBATUS_TPU_NATIVE_RPC"] = "0"
+    servers: list = []
+    proxy = None
+    out: dict = {}
+    try:
+        store = _Store()
+        for _ in range(2):
+            srv = EngineServer(
+                "classifier", CONF,
+                args=ServerArgs(engine="classifier",
+                                coordinator="(shared)", name="usage",
+                                listen_addr="127.0.0.1", thread=32,
+                                interval_sec=1e9, interval_count=1 << 30,
+                                telemetry_interval=1.0),
+                coord=MemoryCoordinator(store))
+            srv.start(0)
+            servers.append(srv)
+        proxy = Proxy(ProxyArgs(engine="classifier",
+                                listen_addr="127.0.0.1", thread=64,
+                                interconnect_timeout=120.0),
+                      coord=MemoryCoordinator(store))
+        pport = proxy.start(0)
+        res = fleet_sim.drive(
+            pport, model, nproc, seconds, cluster="usage",
+            workload="train", call_batch=4, lat_slo_ms=1000.0,
+            inflight_cap=16, env=scrub_child_env(os.environ))
+        out["e2e_usage_driven_done"] = int(res.get("done", 0))
+
+        # -- conservation: ledger vs span plane, per node ---------------
+        errs = []
+        for node in servers + [proxy]:
+            hists = node.rpc.trace.snapshot()["hists"]
+            span_s = sum(
+                h["total_s"] for n, h in hists.items()
+                if n.startswith("rpc.") and
+                not n.startswith("rpc.client."))
+            tot = node.usage.totals()
+            if span_s > 1e-3:
+                errs.append(abs(tot["cpu_seconds"] - span_s) / span_s)
+        # device plane: billed device shares vs the coalescers' clock
+        dev_led = sum(s.usage.totals()["device_seconds"]
+                      for s in servers)
+        dev_clock = sum(
+            co.stats().get("device_seconds", 0.0)
+            for s in servers for co in s.coalescers.values())
+        if dev_clock > 1e-3:
+            errs.append(abs(dev_led - dev_clock) / dev_clock)
+        err = max(errs) if errs else 1.0
+        out["e2e_usage_attribution_err_frac"] = round(err, 4)
+        out["e2e_usage_attribution_ok"] = bool(err <= 0.10)
+
+        # -- distinct per-tenant cost via the LIVE fold path ------------
+        # (the same pipe jubactl -c usage reads: get_usage through the
+        # proxy broadcasts to members; merge is sketch/table fold,
+        # never gauge averaging)
+        with RpcClient("127.0.0.1", pport, timeout=30.0) as c:
+            docs = c.call("get_usage", "usage")
+        fleet = usage_mod.merge_usage(
+            [d for d in docs.values() if d])
+        rows = usage_mod.principal_rows(fleet)
+        tenant_cpu = {p: agg["cpu_seconds"] for p, agg in rows
+                      if not p.startswith("(") and
+                      agg["cpu_seconds"] > 0.0}
+        out["e2e_usage_tenants_seen"] = len(tenant_cpu)
+        out["e2e_usage_tenants_distinct_ok"] = bool(
+            len(tenant_cpu) >= 2 and
+            len(set(round(v, 6) for v in tenant_cpu.values())) >= 2)
+        for p, v in sorted(tenant_cpu.items()):
+            out[f"e2e_usage_cpu_s_{p}"] = round(v, 4)
+
+        # -- capacity headroom gauge ------------------------------------
+        srv0 = servers[0]
+        srv0.usage.tick(srv0._capacity_rows_per_sec())
+        st = srv0.usage.stats()
+        if "headroom" in st:
+            out["e2e_capacity_headroom"] = round(
+                float(st["headroom"]), 4)
+    finally:
+        if prev is None:
+            os.environ.pop("JUBATUS_TPU_NATIVE_RPC", None)
+        else:
+            os.environ["JUBATUS_TPU_NATIVE_RPC"] = prev
+        if proxy is not None:
+            proxy.stop()
+        for s in servers:
+            s.stop()
+    return out
+
+
+def run_usage(transport: str = "python",
+              measure: float = TEXT_MEASURE_SECONDS) -> dict:
+    """ISSUE 19 aggregate: attribution conservation + overhead A/B."""
+    out: dict = {}
+    try:
+        out.update(run_usage_attribution())
+    except Exception as e:  # noqa: BLE001 — partial beats none
+        out["e2e_usage_attribution_error"] = repr(e)[:200]
+    try:
+        out.update(run_usage_overhead(transport, measure=measure))
+    except Exception as e:  # noqa: BLE001 — partial beats none
+        out["e2e_usage_overhead_error"] = repr(e)[:200]
     return out
 
 
@@ -2832,6 +3031,13 @@ def collect(trials: int = 2) -> dict:
         out.update(run_quality(text_tr))
     except Exception as e:  # noqa: BLE001
         out["e2e_quality_error"] = repr(e)[:200]
+    # usage-attribution plane (ISSUE 19): 3-tenant conservation gate
+    # (accounted CPU/device within 10% of process totals) + ledger
+    # overhead A/B (<2% mean)
+    try:
+        out.update(run_usage(text_tr))
+    except Exception as e:  # noqa: BLE001
+        out["e2e_usage_error"] = repr(e)[:200]
     # proxy tier: same numeric workload through the proxy hop. The
     # REPORTED keys stay best-of, but the ratio uses median-vs-median
     # over ADJACENT alternating (proxy, direct) pairs: the direct side
@@ -2948,6 +3154,13 @@ if __name__ == "__main__":
         # prequential tracking + concept-shift drill), for ISSUE 17
         # iteration without the full bench
         print(json.dumps(run_quality(
+            measure=float(sys.argv[2]) if len(sys.argv) > 2
+            else TEXT_MEASURE_SECONDS), indent=1))
+    elif len(sys.argv) > 1 and sys.argv[1] == "usage":
+        # the usage-attribution slice on its own (3-tenant conservation
+        # gate + ledger overhead A/B), for ISSUE 19 iteration without
+        # the full bench
+        print(json.dumps(run_usage(
             measure=float(sys.argv[2]) if len(sys.argv) > 2
             else TEXT_MEASURE_SECONDS), indent=1))
     elif len(sys.argv) > 1 and sys.argv[1] == "killall":

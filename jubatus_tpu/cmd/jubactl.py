@@ -41,6 +41,14 @@ data-quality plane (``get_quality``; proxies fold the fleet) and
 renders per-group PSI drift vs the pinned reference, prequential
 (test-then-train) accuracy, the confidence-calibration table, and the
 recent accuracy/drift trend — see docs/OBSERVABILITY.md §10.
+``usage`` (ISSUE 19) scrapes the usage-attribution plane
+(``get_usage``; proxies fold the fleet) and renders the per-tenant
+bill: requests/errors/retries, CPU-thread-seconds, coalescer queue +
+device seconds, rows and bytes per principal, ranked by CPU — folded
+with utils/usage.merge_usage (exact-table sums + heavy-hitter sketch
+merge, never gauge averaging) plus the fleet capacity/saturation/
+headroom picture; ``--top N`` bounds the table — see
+docs/OBSERVABILITY.md §11.
 Server flags (-C/-T/-D/-X/-S/-I/...) are forwarded to visor-spawned
 processes (jubactl.cpp:90-110).
 """
@@ -64,7 +72,7 @@ def _parser() -> argparse.ArgumentParser:
                             "metrics", "breakers", "trace", "alerts",
                             "watch", "profile", "drain", "rebalance",
                             "autoscale", "timeline", "incident",
-                            "rollback", "quality", "restore"])
+                            "rollback", "quality", "restore", "usage"])
     p.add_argument("trace_id", nargs="?", default="",
                    help="[trace] trace id to assemble (from a slow-log "
                         "record, a /metrics exemplar, or "
@@ -86,7 +94,8 @@ def _parser() -> argparse.ArgumentParser:
                         "lines (flamegraph.pl / speedscope input) "
                         "instead of the top-N table")
     p.add_argument("--top", type=int, default=30,
-                   help="[profile] rows in the self/cumulative table")
+                   help="[profile] rows in the self/cumulative table; "
+                        "[usage] principals in the per-tenant table")
     p.add_argument("--device", action="store_true",
                    help="[profile] on-demand XLA device capture instead "
                         "of stack sampling: list existing artifacts, or "
@@ -631,6 +640,104 @@ def show_quality(coord: Coordinator, engine: str, name: str) -> int:
     return 0
 
 
+def collect_usage(coord: Coordinator, engine: str,
+                  name: str) -> Dict[str, Dict[str, Any]]:
+    """Every node's ``get_usage`` ledger doc keyed by node name
+    (proxy hops included — they bill their own dispatch cost). A proxy
+    answers for the whole fleet in one call (broadcast + fold), so try
+    proxies first and fall back to scraping members directly."""
+    docs: Dict[str, Dict[str, Any]] = {}
+    for pxy in _proxies(coord):
+        try:
+            with RpcClient(pxy.host, pxy.port, timeout=10.0) as c:
+                per_node = c.call("get_usage", name)
+        except Exception as e:  # noqa: BLE001 — fall back to members
+            print(f"  <{pxy.name}: get_usage failed: {e}>",
+                  file=sys.stderr)
+            continue
+        docs.update({k: v for k, v in (per_node or {}).items() if v})
+    if docs:
+        return docs
+    for node in membership.get_all_nodes(coord, engine, name):
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                per_node = c.call("get_usage", name)
+        except Exception as e:  # noqa: BLE001 — partial view beats none
+            print(f"  <{node.name}: get_usage failed: {e}>",
+                  file=sys.stderr)
+            continue
+        docs.update({k: v for k, v in (per_node or {}).items() if v})
+    return docs
+
+
+def render_usage(engine: str, name: str,
+                 docs: Dict[str, Dict[str, Any]], top: int = 0) -> str:
+    """The ``-c usage`` view (pure; asserted by tests): the fleet-wide
+    per-tenant bill from MERGED ledgers (utils/usage.merge_usage —
+    exact-table sums + sketch merge, never gauge averaging), ranked by
+    CPU-thread-seconds, plus the capacity/headroom footer."""
+    from jubatus_tpu.utils import sketches
+    from jubatus_tpu.utils import usage as u
+
+    fleet = u.merge_usage(list(docs.values()))
+    lines: List[str] = []
+    lines.append(f"{engine}/{name}: usage across "
+                 f"{fleet.get('nodes', 0)} node(s)")
+    rows = u.principal_rows(fleet)
+    shown = rows[:top] if top and top > 0 else rows
+    if shown:
+        lines.append(
+            f"  {'principal':<24} {'req':>9} {'err':>6} {'rty':>5} "
+            f"{'cpu s':>9} {'dev s':>8} {'queue s':>8} {'rows':>10} "
+            f"{'MB in':>8} {'MB out':>8} {'rows/s':>8}")
+        for p, agg in shown:
+            lines.append(
+                f"  {p:<24} {int(agg['requests']):>9} "
+                f"{int(agg['errors']):>6} {int(agg['retries']):>5} "
+                f"{agg['cpu_seconds']:>9.3f} "
+                f"{agg['device_seconds']:>8.3f} "
+                f"{agg['queue_seconds']:>8.3f} {int(agg['rows']):>10} "
+                f"{agg['bytes_in'] / 2 ** 20:>8.2f} "
+                f"{agg['bytes_out'] / 2 ** 20:>8.2f} "
+                f"{agg['demand_rows_per_sec']:>8.1f}")
+        if top and top > 0 and len(rows) > top:
+            lines.append(f"  ... {len(rows) - top} more principal(s) "
+                         f"(raise --top)")
+    else:
+        lines.append("  (no usage recorded yet — the ledger fills as "
+                     "requests dispatch; tag tenants via the envelope "
+                     "principal, see docs/OBSERVABILITY.md §11)")
+    # heavy-hitter lane: tenants still identifiable past the exact cap
+    freqs = sketches.categorical_freqs(fleet.get("sketch") or {})
+    hh = [p for p, _n in sorted(freqs.items(), key=lambda kv: -kv[1])
+          if p not in (fleet.get("table") or {})]
+    if hh:
+        lines.append("  beyond-cap heavy hitters (sketch lane): "
+                     + " ".join(hh[:8]))
+    cap = float(fleet.get("capacity_rows_per_sec", 0.0))
+    if cap > 0.0:
+        lines.append(f"  capacity {cap:g} rows/s  "
+                     f"saturation {fleet.get('saturation', 0.0):.3f}  "
+                     f"headroom {fleet.get('headroom', 0.0):.3f}")
+    else:
+        lines.append("  (no capacity estimate yet — replicas learn "
+                     "theirs from measured flush throughput)")
+    return "\n".join(lines)
+
+
+def show_usage(coord: Coordinator, engine: str, name: str,
+               top: int = 0) -> int:
+    """Usage-attribution plane (ISSUE 19): fleet-wide per-tenant cost
+    view from merged ``get_usage`` ledgers."""
+    docs = collect_usage(coord, engine, name)
+    if not docs:
+        print(f"no member of {engine}/{name} answered get_usage",
+              file=sys.stderr)
+        return -1
+    print(render_usage(engine, name, docs, top=top))
+    return 0
+
+
 def collect_watch(coord: Coordinator, engine: str, name: str,
                   window_s: float = 60.0) -> Dict[str, Any]:
     """One scrape of the whole cluster for the watch view: per-member
@@ -778,6 +885,14 @@ def _watch_node_row(node_name: str, entry: Dict[str, Any],
     qa = st.get("quality.prequential_accuracy")
     if qa is not None:
         mix_bits.append(f"acc {float(qa):.3f}")
+    # usage-attribution plane (ISSUE 19): the tenant currently
+    # demanding the most of this replica + its remaining headroom
+    tp = st.get("usage.top_principal")
+    if tp:
+        mix_bits.append(f"ten {tp}")
+    hr = st.get("usage.headroom")
+    if hr is not None:
+        mix_bits.append(f"hr {float(hr):.2f}")
     alerts = ",".join(entry.get("alerts") or []) or "-"
     p99_cell = f"{p99:.1f} {p99_span[4:]}" if p99 is not None else "-"
     # event plane (ISSUE 14): the node's newest event + its age — one
@@ -1613,6 +1728,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return show_alerts(coord, ns.type, ns.name)
         if ns.cmd == "quality":
             return show_quality(coord, ns.type, ns.name)
+        if ns.cmd == "usage":
+            return show_usage(coord, ns.type, ns.name, top=ns.top)
         if ns.cmd == "watch":
             return show_watch(coord, ns.type, ns.name, once=ns.once,
                               interval=ns.interval, window_s=ns.window)

@@ -236,6 +236,9 @@ IDEMPOTENT_BUILTINS: FrozenSet[str] = frozenset({
     "get_proxy_incidents",
     # data-quality plane (ISSUE 17): the sketch/drift doc read is pure
     "get_quality", "get_proxy_quality",
+    # usage-attribution plane (ISSUE 19): the ledger doc read is pure —
+    # a retried get_usage re-serves the same mergeable snapshot
+    "get_usage", "get_proxy_usage",
     # durable model plane (ISSUE 18): the store/warm-boot status read
     # is pure
     "get_store_status",

@@ -31,6 +31,7 @@ import msgpack
 
 from jubatus_tpu.framework.idl import CLIENT_SAFE_RETRY
 from jubatus_tpu.rpc import deadline as deadlines
+from jubatus_tpu.rpc import principal as principals
 from jubatus_tpu.rpc.breaker import BreakerBoard
 from jubatus_tpu.rpc.errors import (
     BreakerOpen,
@@ -46,7 +47,7 @@ from jubatus_tpu.rpc.errors import (
 )
 from jubatus_tpu.rpc.retry import DEFAULT_POLICY, RetryBudget, RetryPolicy
 from jubatus_tpu.rpc.server import REQUEST, RESPONSE, _to_wire
-from jubatus_tpu.utils import faults, tracing
+from jubatus_tpu.utils import faults, tracing, usage
 
 #: transport-level failures an idempotent call may retry (FaultInjected
 #: included: injected faults stand in for the IO errors they model)
@@ -133,6 +134,10 @@ class RpcClient:
                     self._registry.count("rpc.retry_budget_exhausted")
                     raise
                 self._registry.count("rpc.retries")
+                # retry attribution (ISSUE 19): the server just sees
+                # another request — only the client knows this attempt
+                # is amplification, so bill it here
+                usage.note_retry(method)
                 sleep = self.retry_policy.sleep_for(attempt, rem)
                 if sleep > 0:
                     time.sleep(sleep)
@@ -175,9 +180,10 @@ class RpcClient:
             faults.fire(f"rpc.call.{method}.{self.host}:{self.port}")
         # trace context rides the envelope as an OPTIONAL 5th element
         # ({"t": trace_id, "s": span_id}), the remaining deadline budget
-        # as an OPTIONAL 6th (seconds, float). Either is attached only
-        # when this thread carries one; plain client calls stay
-        # wire-identical to msgpack-rpc. The wire element carries a fresh
+        # as an OPTIONAL 6th (seconds, float), the principal (tenant id)
+        # as an OPTIONAL 7th (string). Each is attached only when this
+        # thread carries one; plain client calls stay wire-identical to
+        # msgpack-rpc. The wire element carries a fresh
         # CHILD span id — the call itself is a span (rpc.client.<method>
         # in this registry, so the forensics tree shows the hop's wire+
         # queue time between the caller's dispatch and the callee's)
@@ -185,15 +191,21 @@ class RpcClient:
         child = tracing.child_of(ctx) if ctx is not None else None
         eff_timeout = self._effective_timeout(method)
         dl = deadlines.to_wire()
+        pr = principals.to_wire()
         with self._lock:
             self._msgid = (self._msgid + 1) & 0xFFFFFFFF
             msgid = self._msgid
             env: list = [REQUEST, msgid, method, list(args)]
-            if child is not None or dl is not None:
+            # nil-pad earlier absent slots: the principal is positional
+            # (7th), so a tagged-but-untraced call still ships
+            # [.., None, None, principal]
+            if child is not None or dl is not None or pr is not None:
                 env.append(tracing.to_wire(child)
                            if child is not None else None)
-            if dl is not None:
+            if dl is not None or pr is not None:
                 env.append(dl)
+            if pr is not None:
+                env.append(pr)
             # surrogateescape: params a proxy forwards may hold surrogate-
             # bearing strings (legacy non-UTF8 raw decoded upstream); they
             # must re-encode to the original bytes, not raise pre-send
@@ -239,6 +251,7 @@ class RpcClient:
         child = tracing.child_of(ctx) if ctx is not None else None
         eff_timeout = self._effective_timeout(method)
         dl = deadlines.to_wire()
+        pr = principals.to_wire()
         with self._lock:
             self._msgid = (self._msgid + 1) & 0xFFFFFFFF
             msgid = self._msgid
@@ -250,10 +263,12 @@ class RpcClient:
             # degrade other clients' responses. str8 pins it modern.
             mb = method.encode()
             # trailing elements: 5-element envelope with a trace span,
-            # 6-element with trace + deadline (trace packs nil when only
-            # a deadline is active — the backend splits both off the
-            # params span)
-            n_extra = 2 if dl is not None else (1 if child is not None else 0)
+            # 6-element with trace + deadline, 7-element with trace +
+            # deadline + principal (earlier absent slots pack nil — the
+            # elements are positional and the backend splits them all
+            # off the params span)
+            n_extra = 3 if pr is not None else \
+                (2 if dl is not None else (1 if child is not None else 0))
             env0 = bytes([0x94 + n_extra]) + b"\x00"
             head = (env0 + msgpack.packb(msgid)
                     + b"\xd9" + bytes([len(mb)]) + mb)
@@ -261,8 +276,11 @@ class RpcClient:
             if n_extra >= 1:
                 bufs.append(msgpack.packb(tracing.to_wire(child))
                             if child is not None else b"\xc0")
-            if n_extra == 2:
-                bufs.append(msgpack.packb(float(dl)))
+            if n_extra >= 2:
+                bufs.append(msgpack.packb(float(dl))
+                            if dl is not None else b"\xc0")
+            if n_extra == 3:
+                bufs.append(msgpack.packb(pr))
             sock = self._connect()
             try:
                 with contextlib.ExitStack() as stk:
@@ -413,15 +431,18 @@ class RpcMClient:
     def _fan_out(self, method: str, args: Sequence[Any]):
         results: List[Tuple[Tuple[str, int], Any]] = []
         errors: List[HostError] = []
-        # the fan-out hops threads: carry the caller's trace context AND
-        # deadline into the executor so every per-host call ships the
-        # same trace_id (a mix round's get_diff spans assemble under the
-        # round's trace) and derives its timeout from the shared budget
+        # the fan-out hops threads: carry the caller's trace context,
+        # deadline AND principal into the executor so every per-host
+        # call ships the same trace_id (a mix round's get_diff spans
+        # assemble under the round's trace), derives its timeout from
+        # the shared budget, and bills to the same tenant
         ctx = tracing.current_trace()
         dl = deadlines.current()
+        pr = principals.current()
 
         def one(hp: Tuple[str, int]):
-            with tracing.use_trace(ctx), deadlines.use(dl):
+            with tracing.use_trace(ctx), deadlines.use(dl), \
+                    principals.use(pr):
                 return self._client(hp).call(method, *args)
 
         futs = {}

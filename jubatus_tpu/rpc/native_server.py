@@ -135,6 +135,10 @@ class NativeRpcServer:
         # silently halves at the relay tier; blocked threads are cheap
         self._bulk_pool = ThreadPoolExecutor(
             max_workers=64, thread_name_prefix="native-rpc-bulk")
+        #: usage ledger (utils/usage.py, ISSUE 19) — same contract as
+        #: RpcServer.usage_recorder (the borrowed _execute* note errors
+        #: into it; the dispatch paths here note bytes)
+        self.usage_recorder: Optional[Any] = None
         self._lib = _load_lib()
         if self._lib is None:
             raise RuntimeError("native rpc front-end unavailable (no g++?)")
@@ -184,23 +188,33 @@ class NativeRpcServer:
     _POOL_THRESHOLD = 4096
 
     def _dispatch_fast_bulk(self, conn_id, msgid, method, raw,
-                            conn_state, trace=None, dl=None) -> None:
+                            conn_state, trace=None, dl=None,
+                            pr=None) -> None:
         try:
             from jubatus_tpu.rpc import deadline as deadlines
+            from jubatus_tpu.rpc import principal as principals
             from jubatus_tpu.utils import tracing
 
             prev = tracing.swap_trace(tracing.from_wire(trace))
             prev_dl = deadlines.swap(deadlines.adopt_wire(dl))
+            p_req = principals.adopt_wire(pr)
+            prev_pr = principals.swap(p_req)
             try:
                 error, result = self._execute_fast(method, raw, conn_state)
             finally:
                 tracing.swap_trace(prev)
                 deadlines.swap(prev_dl)
+                principals.swap(prev_pr)
             if self._stopped:
                 return  # teardown: the C++ handle may be going away
             payload = build_response(
                 msgid, error, result,
                 legacy=self.response_legacy(method, conn_state))
+            rec = self.usage_recorder
+            if rec is not None:
+                rec.account(method, principal=p_req, resolve=False,
+                            bytes_in=float(len(raw)),
+                            bytes_out=float(len(payload)))
             self._lib.jt_rpc_respond(self._handle, conn_id, payload,
                                      len(payload))
         except Exception:  # broad-ok — never die silently on the pool
@@ -209,17 +223,19 @@ class NativeRpcServer:
     def _dispatch(self, conn_id: int, msgid: int, method: str,
                   raw: bytes, envelope_flags: int = 0) -> None:
         from jubatus_tpu.rpc import deadline as deadlines
+        from jubatus_tpu.rpc import principal as principals
         from jubatus_tpu.utils import tracing
 
         envelope_modern = bool(envelope_flags & 1)
-        trace = dl = None
+        trace = dl = pr = None
+        nbytes = len(raw)
         if envelope_flags & 2:
-            # traced/deadlined (5/6-element) envelope: the C++ framer
-            # hands us params [+ trace [+ deadline]] as one span; split
-            # at the params boundary (rpc/server.py owns the walk)
+            # extended (5/6/7-element) envelope: the C++ framer hands us
+            # params [+ trace [+ deadline [+ principal]]] as one span;
+            # split at the params boundary (rpc/server.py owns the walk)
             from jubatus_tpu.rpc.server import split_extras
 
-            raw, trace, dl = split_extras(raw, 0)
+            raw, trace, dl, pr = split_extras(raw, 0)
         conn_state = None
         if self.wire_detect and not self.legacy_wire:
             with self._wire_lock:
@@ -255,18 +271,26 @@ class NativeRpcServer:
             if len(raw) >= self._POOL_THRESHOLD and not self._stopped:
                 self._bulk_pool.submit(self._dispatch_fast_bulk, conn_id,
                                        msgid, method, raw, conn_state,
-                                       trace, dl)
+                                       trace, dl, pr)
                 return
             prev = tracing.swap_trace(tracing.from_wire(trace))
             prev_dl = deadlines.swap(deadlines.adopt_wire(dl))
+            p_req = principals.adopt_wire(pr)
+            prev_pr = principals.swap(p_req)
             try:
                 error, result = self._execute_fast(method, raw, conn_state)
             finally:
                 tracing.swap_trace(prev)
                 deadlines.swap(prev_dl)
+                principals.swap(prev_pr)
             payload = build_response(
                 msgid, error, result,
                 legacy=self.response_legacy(method, conn_state))
+            rec = self.usage_recorder
+            if rec is not None:
+                rec.account(method, principal=p_req, resolve=False,
+                            bytes_in=float(nbytes),
+                            bytes_out=float(len(payload)))
             self._lib.jt_rpc_respond(self._handle, conn_id, payload,
                                      len(payload))
             return
@@ -279,16 +303,24 @@ class NativeRpcServer:
         else:
             prev = tracing.swap_trace(tracing.from_wire(trace))
             prev_dl = deadlines.swap(deadlines.adopt_wire(dl))
+            p_req = principals.adopt_wire(pr)
+            prev_pr = principals.swap(p_req)
             try:
                 error, result = self._execute(method, params)
             finally:
                 tracing.swap_trace(prev)
                 deadlines.swap(prev_dl)
+                principals.swap(prev_pr)
         if msgid == self._NOTIFY:
             return  # notification: no response on the wire
         payload = build_response(
             msgid, error, result,
             legacy=self.response_legacy(method, conn_state))
+        rec = self.usage_recorder
+        if rec is not None:
+            rec.account(method, principal=principals.adopt_wire(pr),
+                        resolve=False, bytes_in=float(nbytes),
+                        bytes_out=float(len(payload)))
         self._lib.jt_rpc_respond(self._handle, conn_id, payload, len(payload))
 
     # -- C++ relay plane (proxies only) ---------------------------------------

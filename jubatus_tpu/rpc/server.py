@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, Optional
 import msgpack
 
 from jubatus_tpu.rpc import deadline as deadlines
+from jubatus_tpu.rpc import principal as principals
 from jubatus_tpu.rpc.errors import (
     DeadlineExceeded,
     RpcMethodNotFound,
@@ -147,13 +148,14 @@ def _parse_response_envelope(raw: bytes) -> int:
 
 def _parse_envelope(raw: bytes):
     """Request envelope without decoding params: ``[0, msgid, method,
-    params]``, the traced 5-element variant ``[..., trace]``, or the
-    deadline-bearing 6-element variant ``[..., trace, deadline]`` ->
-    (msgid, method, params_offset, n_extra), or None for anything else
-    (notify, malformed, exotic headers) — those take the generic decode
-    path."""
+    params]``, the traced 5-element variant ``[..., trace]``, the
+    deadline-bearing 6-element variant ``[..., trace, deadline]``, or
+    the principal-bearing 7-element variant ``[..., trace, deadline,
+    principal]`` -> (msgid, method, params_offset, n_extra), or None
+    for anything else (notify, malformed, exotic headers) — those take
+    the generic decode path."""
     try:
-        if raw[0] not in (0x94, 0x95, 0x96) or raw[1] != 0x00:  # REQUEST
+        if raw[0] not in (0x94, 0x95, 0x96, 0x97) or raw[1] != 0x00:  # REQUEST
             return None
         n_extra = raw[0] - 0x94
         i = 2
@@ -185,21 +187,24 @@ def _parse_envelope(raw: bytes):
 
 def split_extras(raw: bytes, off: int):
     """Split a request's params span from its OPTIONAL trailing envelope
-    elements (trace, then deadline) — shared by both transports. Returns
-    (params_span, trace_wire, deadline_wire); a malformed tail degrades
-    to (everything, None, None) — a bad extra element must not 500 the
-    request."""
+    elements (trace, then deadline, then principal) — shared by both
+    transports. Returns (params_span, trace_wire, deadline_wire,
+    principal_wire); a malformed tail degrades to (everything, None,
+    None, None) — a bad extra element must not 500 the request."""
     try:
         pend = msgpack_span_end(raw, off)
-        trace_w = dl_w = None
+        trace_w = dl_w = pr_w = None
         if pend < len(raw):
             tend = msgpack_span_end(raw, pend)
             trace_w = msgpack.unpackb(raw[pend:tend], raw=False)
             if tend < len(raw):
-                dl_w = msgpack.unpackb(raw[tend:], raw=False)
-        return raw[off:pend], trace_w, dl_w
+                dend = msgpack_span_end(raw, tend)
+                dl_w = msgpack.unpackb(raw[tend:dend], raw=False)
+                if dend < len(raw):
+                    pr_w = msgpack.unpackb(raw[dend:], raw=False)
+        return raw[off:pend], trace_w, dl_w, pr_w
     except Exception:  # broad-ok — a bad trailing element must not 500
-        return raw[off:], None, None
+        return raw[off:], None, None, None
 
 
 class RpcServer:
@@ -251,6 +256,11 @@ class RpcServer:
         #: methods with the retryable NodeDraining so proxies re-route).
         #: Shared by both transports (NativeRpcServer borrows _invoke).
         self.dispatch_gate: Optional[Callable[[str], None]] = None
+        #: usage ledger (utils/usage.py, ISSUE 19): the dispatch layer
+        #: notes per-method errors and bytes in/out into it; CPU-seconds
+        #: arrive via the registry's usage_sink, not here. Shared by
+        #: both transports (NativeRpcServer borrows _execute*).
+        self.usage_recorder: Optional[Any] = None
 
     # -- method table (≙ rpc_server::add<T>) --------------------------------
     def register(self, name: str, fn: Callable[..., Any],
@@ -411,41 +421,51 @@ class RpcServer:
         env = _parse_envelope(raw)
         if env is not None:
             msgid, method, off, n_extra = env
-            params_span, trace, dl = raw[off:], None, None
+            params_span, trace, dl, pr = raw[off:], None, None, None
             if n_extra:
-                # traced/deadlined envelope: split the params span from
-                # the trailing elements (the walk is paid only on
-                # extended requests)
-                params_span, trace, dl = split_extras(raw, off)
+                # traced/deadlined/principal envelope: split the params
+                # span from the trailing elements (the walk is paid only
+                # on extended requests)
+                params_span, trace, dl, pr = split_extras(raw, off)
             if method in self._raw_methods and self._pool is not None:
                 self._pool.submit(self._dispatch_fast, conn, wlock, msgid,
-                                  method, params_span, conn_state, trace, dl)
+                                  method, params_span, conn_state, trace,
+                                  dl, pr)
                 return
         msg = msgpack.unpackb(raw, raw=False, strict_map_key=False,
                               use_list=True,
                               unicode_errors="surrogateescape")
-        self._handle(conn, wlock, msg, conn_state)
+        self._handle(conn, wlock, msg, conn_state, nbytes=len(raw))
 
     def _dispatch_fast(self, conn, wlock, msgid, method,
                        raw_params: bytes,
                        conn_state: Optional[dict] = None,
-                       trace: Any = None, dl: Any = None) -> None:
-        # adopt the caller's trace context (or root a fresh one) AND its
-        # deadline for the duration of the dispatch; restore after —
-        # pool threads are reused
+                       trace: Any = None, dl: Any = None,
+                       pr: Any = None) -> None:
+        # adopt the caller's trace context (or root a fresh one), its
+        # deadline AND its principal for the duration of the dispatch;
+        # restore after — pool threads are reused
         ctx = tracing.from_wire(trace)
         if conn_state is not None:
             ctx.peer = conn_state.get("peer", "")
         prev = tracing.swap_trace(ctx)
         prev_dl = deadlines.swap(deadlines.adopt_wire(dl))
+        p_req = principals.adopt_wire(pr)
+        prev_pr = principals.swap(p_req)
         try:
             error, result = self._execute_fast(method, raw_params, conn_state)
         finally:
             tracing.swap_trace(prev)
             deadlines.swap(prev_dl)
+            principals.swap(prev_pr)
         payload = build_response(
             msgid, error, result,
             legacy=self.response_legacy(method, conn_state))
+        rec = self.usage_recorder
+        if rec is not None:
+            rec.account(method, principal=p_req, resolve=False,
+                        bytes_in=float(len(raw_params)),
+                        bytes_out=float(len(payload)))
         try:
             with wlock:
                 conn.sendall(payload)
@@ -481,6 +501,9 @@ class RpcServer:
             except Exception as e:  # broad-ok — every failure must answer
                 log.debug("rpc raw method %s raised", method, exc_info=True)
                 self.trace.count(f"rpc.{method}.errors")
+                rec = getattr(self, "usage_recorder", None)
+                if rec is not None:
+                    rec.note_error(method)
                 return error_to_wire(e), None
             if result is not RAW_FALLBACK:
                 return None, result
@@ -491,19 +514,22 @@ class RpcServer:
         return self._execute(method, params)
 
     def _handle(self, conn: socket.socket, wlock: threading.Lock, msg: Any,
-                conn_state: Optional[dict] = None) -> None:
+                conn_state: Optional[dict] = None,
+                nbytes: int = 0) -> None:
         if not isinstance(msg, (list, tuple)) or not msg:
             return
-        if msg[0] == REQUEST and len(msg) in (4, 5, 6):
+        if msg[0] == REQUEST and len(msg) in (4, 5, 6, 7):
             # 5th element: optional trace context ({"t","s"}); 6th:
-            # optional deadline budget (remaining seconds) — see
-            # rpc/client.py; plain msgpack-rpc peers send 4
+            # optional deadline budget (remaining seconds); 7th:
+            # optional principal (tenant id) — see rpc/client.py; plain
+            # msgpack-rpc peers send 4
             _, msgid, method, params = msg[:4]
             trace = msg[4] if len(msg) >= 5 else None
-            dl = msg[5] if len(msg) == 6 else None
+            dl = msg[5] if len(msg) >= 6 else None
+            pr = msg[6] if len(msg) == 7 else None
             if self._pool is not None:
                 self._pool.submit(self._dispatch, conn, wlock, msgid, method,
-                                  params, conn_state, trace, dl)
+                                  params, conn_state, trace, dl, pr, nbytes)
         elif msg[0] == NOTIFY and len(msg) == 3:
             _, method, params = msg
             if self._pool is not None:
@@ -511,20 +537,29 @@ class RpcServer:
 
     def _dispatch(self, conn, wlock, msgid, method, params,
                   conn_state: Optional[dict] = None,
-                  trace: Any = None, dl: Any = None) -> None:
+                  trace: Any = None, dl: Any = None,
+                  pr: Any = None, nbytes: int = 0) -> None:
         ctx = tracing.from_wire(trace)
         if conn_state is not None:
             ctx.peer = conn_state.get("peer", "")
         prev = tracing.swap_trace(ctx)
         prev_dl = deadlines.swap(deadlines.adopt_wire(dl))
+        p_req = principals.adopt_wire(pr)
+        prev_pr = principals.swap(p_req)
         try:
             error, result = self._execute(method, params)
         finally:
             tracing.swap_trace(prev)
             deadlines.swap(prev_dl)
+            principals.swap(prev_pr)
         payload = build_response(
             msgid, error, result,
             legacy=self.response_legacy(method, conn_state))
+        rec = self.usage_recorder
+        if rec is not None:
+            rec.account(method, principal=p_req, resolve=False,
+                        bytes_in=float(nbytes),
+                        bytes_out=float(len(payload)))
         try:
             with wlock:
                 conn.sendall(payload)
@@ -542,6 +577,9 @@ class RpcServer:
             # per-method failure counter: the dispatch span times success
             # and failure identically, so error RATE needs its own series
             self.trace.count(f"rpc.{method}.errors")
+            rec = getattr(self, "usage_recorder", None)
+            if rec is not None:
+                rec.note_error(method)
             error = error_to_wire(e)
         return error, result
 

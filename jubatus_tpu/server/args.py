@@ -231,6 +231,15 @@ class ServerArgs:
     #: --quality-ref-windows: completed windows merged into the pinned
     #: reference before drift scoring starts
     quality_ref_windows: int = 2
+    #: --usage-top: principals tracked EXACTLY by the usage ledger
+    #: (utils/usage.py, ISSUE 19) before the long tail folds into
+    #: ``(other)`` (the sketch lane still ranks everyone); 0 disarms
+    #: the attribution plane entirely
+    usage_top: int = 64
+    #: --usage-gauge-principals: top-demand principals published as
+    #: ``usage.<principal>.*`` gauges per telemetry tick (bounds the
+    #: gauge namespace under high tenant cardinality)
+    usage_gauge_principals: int = 8
     #: --store-dir: root of the shared snapshot store (the durable
     #: model plane, framework/model_store.py, ISSUE 18) — a directory
     #: every member and jubactl can reach (NFS/fuse mount stands in for
@@ -576,6 +585,17 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
     p.add_argument("--quality-ref-windows", type=int, default=2,
                    help="completed windows merged into the pinned "
                         "reference before drift scoring starts")
+    p.add_argument("--usage-top", type=int, default=64,
+                   help="principals (tenant ids) the usage ledger "
+                        "tracks exactly before the long tail folds "
+                        "into (other); the heavy-hitter sketch still "
+                        "ranks everyone. 0 disarms per-tenant "
+                        "attribution entirely")
+    p.add_argument("--usage-gauge-principals", type=int, default=8,
+                   help="top-demand principals published as "
+                        "usage.<principal>.* gauges per telemetry "
+                        "tick (bounds the gauge namespace under high "
+                        "tenant cardinality)")
     p.add_argument("--store-dir", default="",
                    help="root of the shared snapshot store (durable "
                         "model plane, framework/model_store.py): a "
@@ -673,6 +693,10 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
         raise SystemExit("--quality-window must be > 0")
     if args.quality_ref_windows < 1:
         raise SystemExit("--quality-ref-windows must be >= 1")
+    if args.usage_top < 0:
+        raise SystemExit("--usage-top must be >= 0")
+    if args.usage_gauge_principals < 1:
+        raise SystemExit("--usage-gauge-principals must be >= 1")
     for spec in args.slo:
         from jubatus_tpu.utils.slo import parse_slo
 

@@ -172,6 +172,27 @@ class EngineServer:
             conv = getattr(self.driver, "converter", None)
             if conv is not None and hasattr(conv, "quality_hook"):
                 conv.quality_hook = self.quality.record_named
+        # usage-attribution plane (ISSUE 19): per-principal resource
+        # ledger. Wired three ways: the registry's usage_sink feeds it
+        # every rpc.<method> span's CPU-seconds while the dispatch
+        # thread still holds the request's principal; the transport's
+        # usage_recorder notes errors + bytes; service.py binds the
+        # coalescer usage_hook for queue/device attribution. Ticked by
+        # the telemetry thread (gauges land BEFORE the ring samples, so
+        # capacity.saturation is SLO-able with zero new grammar).
+        from jubatus_tpu.utils import usage as usage_mod
+
+        self.usage: Optional[usage_mod.UsageLedger] = None
+        ut = getattr(self.args, "usage_top", 64)
+        if ut > 0:
+            self.usage = usage_mod.UsageLedger(
+                top=ut,
+                gauge_principals=getattr(
+                    self.args, "usage_gauge_principals", 8),
+                registry=self.rpc.trace)
+            self.rpc.usage_recorder = self.usage
+            self.rpc.trace.usage_sink = self.usage.span_sink
+            usage_mod.attach(self.usage)
         #: re-entrancy guard: the incident collector reads _health(),
         #: whose telemetry.status() re-runs the sampler hooks — the
         #: tick must not recurse into itself mid-capture
@@ -998,6 +1019,10 @@ class EngineServer:
             # names the top drifting group and carries its reference /
             # live sketch pair — the drift-SLO forensic payload
             doc["quality"] = self.quality.incident_doc()
+        if self.usage is not None:
+            # who was spending the replica when it breached: top
+            # principals by CPU with full rows + the capacity picture
+            doc["usage"] = self.usage.incident_doc()
         if self.mixer is not None and \
                 getattr(self.mixer, "flight", None) is not None:
             doc["mix_history"] = self.mixer.flight.snapshot(last=32)
@@ -1083,6 +1108,12 @@ class EngineServer:
         # quality.drift.* is visible to gauge: SLOs this same tick
         if self.quality is not None:
             self.quality.tick()
+        # usage-attribution plane (ISSUE 19): per-principal demand vs
+        # this replica's measured flush throughput — BEFORE the ring
+        # samples, so usage.* / capacity.saturation are SLO-able via
+        # gauge: this same tick
+        if self.usage is not None:
+            self.usage.tick(self._capacity_rows_per_sec())
         self.timeseries.sample(self.rpc.trace.snapshot())
         if self.slo is not None:
             self.slo.evaluate()
@@ -1106,6 +1137,32 @@ class EngineServer:
             return {node.name: {"stats": {}, "points": []}}
         return {node.name: {"stats": self.timeseries.stats(),
                             "points": self.timeseries.points()}}
+
+    def _capacity_rows_per_sec(self) -> float:
+        """This replica's capacity estimate: rows the device plane
+        drains per busy second, from the same measured per-flush
+        throughput the autoscaler's signals derive from (coalescer
+        stats). 0 until a device stage has actually run — a cold
+        replica publishes no headroom rather than a fictitious one."""
+        rows = busy = 0.0
+        for co in self.coalescers.values():
+            st = co.stats() if hasattr(co, "stats") else {}
+            dev = float(st.get("device_seconds", 0.0))
+            if dev > 0.0:
+                rows += float(st.get("item_count", 0))
+                busy += dev
+        return rows / busy if busy > 0.0 else 0.0
+
+    def get_usage(self, _name: str = "") -> Dict[str, Any]:
+        """This node's usage-attribution doc (utils/usage.py): the
+        per-principal × method exact table, heavy-hitter sketch state,
+        and capacity picture — mergeable, so the proxy folds the fleet
+        with merge_usage (sketch merge + table sum, never gauge
+        averaging)."""
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        if self.usage is None:
+            return {node.name: {}}
+        return {node.name: self.usage.snapshot()}
 
     def get_quality(self, _name: str = "") -> Dict[str, Any]:
         """This node's data-quality doc (utils/quality.py): reference
@@ -1306,6 +1363,11 @@ class EngineServer:
         if self.quality is not None:
             st.update({f"quality.{k}": v
                        for k, v in self.quality.stats().items()})
+        # usage-attribution plane (ISSUE 19): the per-tenant summary
+        # jubactl -c watch's tenant column reads
+        if self.usage is not None:
+            st.update({f"usage.{k}": v
+                       for k, v in self.usage.stats().items()})
         # model-integrity plane (ISSUE 15): snapshot ring + rollbacks
         # (guard state rides mixer.guard_* via the mixer's get_status)
         st.update({f"snapshot.{k}": v
@@ -1475,6 +1537,13 @@ class EngineServer:
         # can both call stop() concurrently from different threads
         if not self._stop_once.acquire(blocking=False):
             return
+        if self.usage is not None:
+            # drop out of the process-wide retry fan-in: a stopped
+            # server's ledger must not keep collecting another server's
+            # client retries (multi-server tests/benches)
+            from jubatus_tpu.utils import usage as usage_mod
+
+            usage_mod.detach(self.usage)
         try:
             # each step independently: stop() is unretryable (_stop_once),
             # so one failing step must not skip the others

@@ -31,18 +31,30 @@ import threading
 import time
 from typing import Any, Callable, List, Sequence
 
+from jubatus_tpu.rpc import principal as principals
+
 __all__ = ["Coalescer", "PipelinedCoalescer"]
 
 
 class _Ticket:
-    __slots__ = ("event", "result", "error", "count", "weight")
+    __slots__ = ("event", "result", "error", "count", "weight",
+                 "principal", "enq", "claimed")
 
-    def __init__(self, count: int, weight: int) -> None:
+    def __init__(self, count: int, weight: int,
+                 principal: str | None = None, enq: float = 0.0) -> None:
         self.event = threading.Event()
         self.result: Any = None
         self.error: BaseException | None = None
         self.count = count    # item-list slots (queue bookkeeping)
         self.weight = weight  # examples represented (max_batch accounting)
+        #: usage attribution (ISSUE 19): the submitting RPC thread's
+        #: principal rides the ticket into the flush — the flusher runs
+        #: on ANOTHER ticket's thread, so the thread-local is useless by
+        #: flush time — plus the enqueue/claim stamps queue residency
+        #: derives from
+        self.principal = principal
+        self.enq = enq
+        self.claimed = 0.0
 
 
 class Coalescer:
@@ -88,6 +100,13 @@ class Coalescer:
         self._pending_weight = 0
         self._arrived = 0
         self._arrival_ref = (time.monotonic(), 0)
+        #: usage attribution (ISSUE 19): when set, called once per
+        #: completed ticket as hook(principal, rows, queue_seconds,
+        #: device_share_seconds) — the flush's device time amortized by
+        #: rows contributed. The service layer binds it to the usage
+        #: ledger with the method name closed over.
+        self.usage_hook: Callable[[str | None, int, float, float],
+                                  None] | None = None
 
     def submit(self, items: Sequence[Any],
                timeout: float | None = 60.0) -> Any:
@@ -109,7 +128,15 @@ class Coalescer:
             timeout = None
         weight = (sum(self._weigher(i) for i in items)
                   if self._weigher is not None else len(items))
-        ticket = _Ticket(len(items), weight)
+        # stamp the principal HERE, on the submitting RPC thread, where
+        # the dispatch swap still holds it (only when billing is on —
+        # the disarmed path stays a None check)
+        ticket = _Ticket(len(items), weight,
+                         principal=(principals.current()
+                                    if self.usage_hook is not None
+                                    else None),
+                         enq=(time.perf_counter()
+                              if self.usage_hook is not None else 0.0))
         with self._lock:
             self._pending_items.extend(items)
             self._pending_tickets.append(ticket)
@@ -167,7 +194,29 @@ class Coalescer:
             batch.extend(self._pending_items[:t.count])
             del self._pending_items[:t.count]
         self._pending_weight -= batch_weight
+        if self.usage_hook is not None:
+            now = time.perf_counter()
+            for t in tickets:
+                t.claimed = now
         return batch, tickets, batch_weight
+
+    def _bill(self, tickets: List[_Ticket], batch_weight: int,
+              device_dt: float) -> None:
+        """Per-ticket usage attribution at flush completion: queue
+        residency (claim - enqueue) plus the flush's device time
+        amortized by rows contributed. Never raises — billing must not
+        fail a flush that already succeeded."""
+        hook = self.usage_hook
+        if hook is None:
+            return
+        for t in tickets:
+            share = (device_dt * t.weight / batch_weight
+                     if batch_weight else 0.0)
+            queued = max(0.0, t.claimed - t.enq) if t.enq else 0.0
+            try:
+                hook(t.principal, t.weight, queued, share)
+            except Exception:  # broad-ok — billing is best-effort
+                pass
 
     def _drain(self) -> None:
         while True:
@@ -176,6 +225,7 @@ class Coalescer:
                 if claimed is None:
                     return
                 batch, tickets, batch_weight = claimed
+            t0 = time.perf_counter() if self.usage_hook is not None else 0.0
             try:
                 result = self._flush(batch)
                 if self._split:
@@ -197,6 +247,9 @@ class Coalescer:
                 with self._lock:
                     self.flush_count += 1
                     self.item_count += batch_weight  # examples, not items
+                # single-stage flush: the whole flush IS the device step
+                self._bill(tickets, batch_weight,
+                           time.perf_counter() - t0 if t0 else 0.0)
                 for t in tickets:
                     t.event.set()
 
@@ -283,10 +336,12 @@ class PipelinedCoalescer(Coalescer):
                 t += time.perf_counter() - self._dev_busy_since
             return t
 
-    def _finish(self, tickets: List[_Ticket], batch_weight: int) -> None:
+    def _finish(self, tickets: List[_Ticket], batch_weight: int,
+                device_dt: float = 0.0) -> None:
         with self._lock:
             self.flush_count += 1
             self.item_count += batch_weight
+        self._bill(tickets, batch_weight, device_dt)
         for t in tickets:
             t.event.set()
 
@@ -323,7 +378,7 @@ class PipelinedCoalescer(Coalescer):
                     self._dev_busy_total += dt
                     self.device_seconds += dt
                     self._dev_busy_since = None
-                self._finish(tickets, batch_weight)
+                self._finish(tickets, batch_weight, device_dt=dt)
                 self._dev_slot.release()
 
     def _drain(self) -> None:

@@ -51,6 +51,7 @@ from jubatus_tpu.coord.cht import CHT, ring_key
 from jubatus_tpu.framework.idl import INTERNAL, get_service, idempotent_methods
 from jubatus_tpu.rpc import aggregators
 from jubatus_tpu.rpc import deadline as deadlines
+from jubatus_tpu.rpc import principal as principals
 from jubatus_tpu.rpc.breaker import BreakerBoard
 from jubatus_tpu.rpc.client import RpcClient
 from jubatus_tpu.rpc.errors import (
@@ -212,6 +213,13 @@ class ProxyArgs:
     #: --incident-dir: capped bundle artifacts dir; empty = under /tmp
     #: keyed by the bound port
     incident_dir: str = ""
+    #: --usage-top: exact per-principal ledger rows at the PROXY hop
+    #: (utils/usage.py, ISSUE 19) — the proxy is in the request path,
+    #: so it attributes its own dispatch cost per tenant; 0 disables
+    usage_top: int = 64
+    #: --usage-gauge-principals: top-N principals published as
+    #: usage.<principal>.* gauges each telemetry tick
+    usage_gauge_principals: int = 8
 
     @property
     def bind_host(self) -> str:
@@ -414,6 +422,22 @@ class Proxy:
             journal=self.rpc.trace.events)
         if self.slo is not None:
             self.slo.on_fire = self._on_slo_fire
+        # usage-attribution plane (ISSUE 19) at the proxy hop: the proxy
+        # is in the request path, so it keeps its OWN per-tenant ledger
+        # (dispatch spans + request/response bytes); jubactl -c usage
+        # folds it with the backends' via usage.merge_usage
+        from jubatus_tpu.utils import usage as usage_mod
+
+        self.usage: Optional[usage_mod.UsageLedger] = None
+        ut = getattr(args, "usage_top", 64)
+        if ut > 0:
+            self.usage = usage_mod.UsageLedger(
+                top=ut,
+                gauge_principals=getattr(args, "usage_gauge_principals", 8),
+                registry=self.rpc.trace)
+            self.rpc.usage_recorder = self.usage
+            self.rpc.trace.usage_sink = self.usage.span_sink
+            usage_mod.attach(self.usage)
         self._was_degraded = False
         #: re-entrancy guard (see EngineServer): the incident
         #: collector's _health() re-runs the telemetry hooks
@@ -508,14 +532,17 @@ class Proxy:
             self.forward_count += len(nodes)
         if len(nodes) == 1:
             return self._one(nodes[0], method, args)
-        # the fan-out hops threads: carry this request's trace context
-        # AND deadline into the executor so each backend call ships the
-        # same trace_id and derives its timeout from the remaining budget
+        # the fan-out hops threads: carry this request's trace context,
+        # deadline AND principal into the executor so each backend call
+        # ships the same trace_id, derives its timeout from the remaining
+        # budget, and bills the same tenant (ISSUE 19)
         ctx = tracing.current_trace()
         dl = deadlines.current()
+        pr = principals.current()
 
         def call(n: NodeInfo) -> Any:
-            with tracing.use_trace(ctx), deadlines.use(dl):
+            with tracing.use_trace(ctx), deadlines.use(dl), \
+                    principals.use(pr):
                 return self._one(n, method, args)
 
         futs: Dict[Any, NodeInfo] = {
@@ -960,6 +987,14 @@ class Proxy:
                           self._forensics_handler(
                               "get_quality", self.get_proxy_quality),
                           arity=1)
+        # usage-attribution plane (ISSUE 19): one call against the
+        # proxy returns every node's mergeable ledger doc keyed by node
+        # (proxy hop included) — jubactl folds them with
+        # usage.merge_usage (sketch merge, never gauge averaging)
+        self.rpc.register("get_usage",
+                          self._forensics_handler(
+                              "get_usage", self.get_proxy_usage),
+                          arity=1)
         # continuous profiling plane (ISSUE 8): one get_profile against
         # the proxy returns the whole cluster's folded stacks (backends
         # broadcast + the proxy's own samples); device captures
@@ -994,6 +1029,8 @@ class Proxy:
         self.rpc.register("get_proxy_alerts", self.get_proxy_alerts,
                           arity=1)
         self.rpc.register("get_proxy_quality", self.get_proxy_quality,
+                          arity=1)
+        self.rpc.register("get_proxy_usage", self.get_proxy_usage,
                           arity=1)
         self.rpc.register("get_proxy_profile", self.get_proxy_profile,
                           arity=2)
@@ -1049,6 +1086,10 @@ class Proxy:
             return
         self._in_health_tick = True
         try:
+            # proxies have no device plane: capacity 0 keeps the
+            # capacity.* gauges quiet while per-tenant demand publishes
+            if self.usage is not None:
+                self.usage.tick(0.0)
             self.timeseries.sample(self.rpc.trace.snapshot())
             if self.slo is not None:
                 self.slo.evaluate()
@@ -1115,6 +1156,8 @@ class Proxy:
             "breakers": self.breakers.snapshot(),
             "health": self._health(),
         }
+        if self.usage is not None:
+            doc["usage"] = self.usage.incident_doc()
         if self.timeseries is not None:
             doc["timeseries"] = self.timeseries.points(last=60)
         try:
@@ -1150,6 +1193,17 @@ class Proxy:
         quality doc of its own — the RPC-routed ``get_quality`` is the
         backend broadcast folded over this empty dict."""
         return {}
+
+    def get_proxy_usage(self, _name: str = "") -> Dict[str, Any]:
+        """This proxy's OWN per-tenant ledger doc, keyed by proxy node
+        name — unlike quality, the proxy hop has real cost to report
+        (every forward dispatches here). The RPC-routed ``get_usage``
+        is the backend broadcast folded over this."""
+        node = NodeInfo(self.args.bind_host,
+                        self.rpc.port or self.args.rpc_port)
+        if self.usage is None:
+            return {node.name: {}}
+        return {node.name: self.usage.snapshot()}
 
     def get_proxy_profile(self, _name: str = "",
                           seconds: float = 0.0) -> Dict[str, Any]:
@@ -1222,6 +1276,10 @@ class Proxy:
                    for k, v in self.rpc.trace.events.stats().items()})
         st.update({f"incident.{k}": v
                    for k, v in self.incidents.stats().items()})
+        # usage-attribution plane (ISSUE 19): the per-tenant summary
+        if self.usage is not None:
+            st.update({f"usage.{k}": v
+                       for k, v in self.usage.stats().items()})
         return {node.name: st}
 
     def get_metrics(self, _name: str = "") -> Dict[str, Dict[str, Any]]:
@@ -1301,6 +1359,10 @@ class Proxy:
         self._stop_event.wait()
 
     def stop(self) -> None:
+        if self.usage is not None:
+            from jubatus_tpu.utils import usage as usage_mod
+
+            usage_mod.detach(self.usage)
         self.rpc.stop()
         self.telemetry.stop()
         self.profiler.stop()
@@ -1414,6 +1476,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="capped incident-bundle artifacts dir (oldest "
                         "pruned); empty = under /tmp keyed by the "
                         "bound port")
+    p.add_argument("--usage-top", type=int, default=64,
+                   help="exact per-principal usage-ledger rows at the "
+                        "proxy hop (overflow folds into the '(other)' "
+                        "row backed by a heavy-hitter sketch; 0 "
+                        "disables the ledger)")
+    p.add_argument("--usage-gauge-principals", type=int, default=8,
+                   help="top-N principals published as "
+                        "usage.<principal>.* gauges each telemetry tick")
     ns = p.parse_args(argv)
     ns.slo = ns.slo or []
     args = ProxyArgs(**{f.name: getattr(ns, f.name)

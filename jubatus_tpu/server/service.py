@@ -72,6 +72,8 @@ def bind_engine(rpc: RpcServer, server: Any) -> None:
     rpc.register("get_alerts", server.get_alerts, arity=1)
     # data-quality plane (ISSUE 17): mergeable drift/prequential doc
     rpc.register("get_quality", server.get_quality, arity=1)
+    # usage-attribution plane (ISSUE 19): per-principal cost ledger doc
+    rpc.register("get_usage", server.get_usage, arity=1)
     # continuous profiling plane (ISSUE 8): folded stack profile +
     # on-demand XLA device capture
     rpc.register("get_profile", server.get_profile, arity=2)
@@ -311,6 +313,22 @@ def _quality_observe_raw(server: Any, item, numeric: bool) -> None:
         log.debug("raw prequential hook failed", exc_info=True)
 
 
+def _usage_batch_hook(server: Any, method: str):
+    """Microbatch billing hook (ISSUE 19): the coalescer calls this once
+    per ticket per flush with the submitting tenant, its row weight, its
+    queue residency and its amortized share of the flush's device time —
+    the ledger rolls them into the ``usage.<principal>.*`` gauges. None
+    (no hook, zero overhead) when the ledger is disabled."""
+    u = getattr(server, "usage", None)
+    if u is None:
+        return None
+
+    def hook(principal, rows, queue_s, device_s):
+        u.record_batch(principal, method, rows, queue_s, device_s)
+
+    return hook
+
+
 def _register_train(rpc: RpcServer, server: Any, decode_pair,
                     train_fn) -> None:
     """Register "train" with microbatch coalescing (server/microbatch.py):
@@ -350,6 +368,7 @@ def _register_train(rpc: RpcServer, server: Any, decode_pair,
 
         co = Coalescer(flush, max_batch=max_batch)
     server.coalescers["train"] = co
+    co.usage_hook = _usage_batch_hook(server, "train")
 
     # -t 0 conventionally means "no timeout" — map to an unbounded wait
     wait_s = server.args.timeout * 6 if server.args.timeout > 0 else None
@@ -545,6 +564,7 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
             prep_requests, device_step, max_batch=max_batch,
             weigher=lambda item: item[2].shape[0], trace=rpc.trace)
         server.coalescers["train_raw"] = co
+        co.usage_hook = _usage_batch_hook(server, "train")
     trace = rpc.trace
 
     def train_raw(raw_params: bytes):
@@ -639,6 +659,9 @@ def _register_train_raw(rpc: RpcServer, server: Any, numeric: bool) -> None:
                         weigher=lambda it: it[0].shape[0],
                         split_results=True)
         server.coalescers[name] = qco
+        # bill under the wire method ("classify"), not the coalescer key
+        qco.usage_hook = _usage_batch_hook(
+            server, name[:-4] if name.endswith("_raw") else name)
 
         def raw_handler(raw_params: bytes):
             with trace.span("fv.convert"):
@@ -704,11 +727,10 @@ def _bind_classifier(rpc: RpcServer, server: Any) -> None:
     _register_train(rpc, server,
                     lambda p: (p[0], _datum(p[1])), d.train)
     _register_train_raw(rpc, server, numeric=False)
-    rpc.register(
-        "classify",
-        lambda name, data: [_scored(r) for r in d.classify(_datums(data))],
-        arity=2,
-    )
+    rpc.register("classify",  # no-usage — uncoalesced path: dispatch-span billing covers it
+                 lambda name, data: [_scored(r)
+                                     for r in d.classify(_datums(data))],
+                 arity=2)
     rpc.register("get_labels", lambda name: {k: int(v) for k, v in d.get_labels().items()}, arity=1)
     rpc.register("set_label", _updating(server, lambda name, lbl: d.set_label(lbl)), arity=2)
     rpc.register("delete_label", _updating(server, lambda name, lbl: d.delete_label(lbl)), arity=2)

@@ -40,7 +40,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from jubatus_tpu.utils.events import EventJournal
 from jubatus_tpu.utils.slowlog import SlowLog
@@ -313,6 +313,11 @@ class Registry:
         #: span store + slow log master switch (histograms stay on):
         #: bench_serving.py's overhead A/B flips it
         self._forensics = True
+        #: usage-ledger tap (utils/usage.py, ISSUE 19): every recorded
+        #: span duration is offered to the ledger, which attributes it
+        #: to the dispatch thread's principal. Called OUTSIDE the
+        #: registry lock (the sink takes its own).
+        self.usage_sink: Optional[Callable[[str, float], None]] = None
 
     @contextlib.contextmanager
     def span(self, name: str) -> Iterator[_SpanHandle]:
@@ -378,6 +383,9 @@ class Registry:
                     self._by_trace.setdefault(ctx.trace_id, []).append(rec)
         if slow_thr is not None:
             self._capture_slow(name, seconds, slow_thr, ctx)
+        sink = self.usage_sink
+        if sink is not None:
+            sink(name, seconds)
 
     def _capture_slow(self, name: str, seconds: float, threshold: float,
                       ctx: Optional[TraceContext]) -> None:

@@ -669,10 +669,11 @@ const uint8_t* parse_frame(Server* s, uint64_t conn_id,
   uint64_t type = 0, msgid = kNotifyMsgid;
   const uint8_t* mdata;
   int64_t mlen;
-  // request; 5 = traced envelope, 6 = traced + deadline envelope (the
-  // trailing elements are split off by the Python layer / the receiving
-  // backend — this framer only needs to not reject them)
-  if (count >= 4 && count <= 6) {
+  // request; 5 = traced envelope, 6 = traced + deadline envelope, 7 =
+  // traced + deadline + principal envelope (the trailing elements are
+  // split off by the Python layer / the receiving backend — this framer
+  // only needs to not reject them)
+  if (count >= 4 && count <= 7) {
     if (!read_uint(q, frame_end, &type) || type != 0) return malformed();
     // both sentinels are reserved: a wire msgid equal to kCloseId would
     // spoof a connection-close notification into the Python layer
@@ -685,14 +686,14 @@ const uint8_t* parse_frame(Server* s, uint64_t conn_id,
     return malformed();
   }
   int32_t envelope_flags = (q < frame_end && *q == 0xd9) ? 1 : 0;
-  if (count >= 5) envelope_flags |= 2;  // trailing trace [+ deadline]
+  // trailing trace [+ deadline [+ principal]]
+  if (count >= 5) envelope_flags |= 2;
   if (!read_str(q, frame_end, &mdata, &mlen)) return malformed();
   // relay hot path: configured methods forward to a backend without ever
   // entering Python (the frame is consumed when relay_try returns true).
-  // Traced/deadlined (5/6-element) frames forward verbatim too — the
-  // trailing elements ride through to the backend, which splits them
-  // off itself.
-  if (count >= 4 && count <= 6 &&
+  // Extended (5/6/7-element) frames forward verbatim too — the trailing
+  // elements ride through to the backend, which splits them off itself.
+  if (count >= 4 && count <= 7 &&
       s->relay.enabled.load(std::memory_order_relaxed) &&
       relay_try(s, conn, p, frame_end, msgid, mdata, mlen, q))
     return frame_end;

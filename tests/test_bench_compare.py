@@ -71,6 +71,47 @@ def test_direction_inference_autoscale_keys():
     assert bc.direction("e2e_fleet_seed") is None
 
 
+def test_direction_inference_usage_keys():
+    """ISSUE 19 usage-attribution plane: the conservation gap gates
+    down-good (growth = requests escaping attribution), capacity
+    headroom up-good (shrinkage at the same load = costlier replica),
+    the overhead verdicts ride the existing _ratio/_ok patterns."""
+    assert bc.direction("e2e_usage_attribution_err_frac") == "lower"
+    assert bc.direction("e2e_capacity_headroom") == "higher"
+    assert bc.direction("e2e_usage_overhead_mean_ratio") == "lower"
+    assert bc.direction("e2e_usage_overhead_p50_ratio") == "lower"
+    assert bc.direction("e2e_usage_overhead_ok") == "bool"
+    assert bc.direction("e2e_usage_attribution_ok") == "bool"
+    assert bc.direction("e2e_usage_tenants_distinct_ok") == "bool"
+    # neighbors that must NOT accidentally gate
+    assert bc.direction("e2e_usage_tenants_seen") is None
+    assert bc.direction("e2e_usage_driven_done") is None
+
+
+def test_usage_keys_gate_over_fixtures():
+    """The err_frac/headroom directions drive real verdicts: a grown
+    conservation gap and a shrunken headroom each REGRESS; the gap
+    shrinking and headroom growing each count as improvements."""
+    old = {"e2e_usage_attribution_err_frac": 0.02,
+           "e2e_capacity_headroom": 0.9,
+           "e2e_usage_overhead_ok": True}
+    new = {"e2e_usage_attribution_err_frac": 0.09,
+           "e2e_capacity_headroom": 0.4,
+           "e2e_usage_overhead_ok": False}
+    rows, regs = bc.compare(old, new, tolerance=0.05)
+    assert {r["key"] for r in regs} == \
+        {"e2e_usage_attribution_err_frac", "e2e_capacity_headroom",
+         "e2e_usage_overhead_ok"}
+    better = {"e2e_usage_attribution_err_frac": 0.01,
+              "e2e_capacity_headroom": 0.95,
+              "e2e_usage_overhead_ok": True}
+    rows, regs = bc.compare(old, better, tolerance=0.05)
+    assert regs == []
+    verdicts = {r["key"]: r["verdict"] for r in rows}
+    assert verdicts["e2e_usage_attribution_err_frac"] == "improved"
+    assert verdicts["e2e_capacity_headroom"] == "improved"
+
+
 def test_direction_inference_sharded_keys():
     """ISSUE 13 feature-sharding plane: train throughput at d26 gates
     up-good per shard count, classify/KNN query p99 down-good — single-

@@ -164,6 +164,50 @@ def test_quality_coverage_gate_fires_and_pragma_opts_out(tmp_path):
                 if "quality-recorder" in p]
 
 
+def test_usage_coverage_gate_fires_and_pragma_opts_out(tmp_path):
+    """The server/ train/classify-registration rule (ISSUE 19): a
+    function that registers a "train" or "classify" handler without
+    referencing the usage recorder is flagged; routing through
+    server.usage (or any usage-named helper) and the # no-usage pragma
+    are not, and files outside server/ are exempt."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo / "tools" / "codestyle"))
+    try:
+        import check as codestyle
+    finally:
+        sys.path.pop(0)
+    d = tmp_path / "jubatus_tpu" / "server"
+    d.mkdir(parents=True)
+    bad = d / "victim.py"
+    bad.write_text(
+        '"""doc."""\n'
+        "def _bind_bad(server, rpc):\n"
+        "    rpc.register(\"classify\", lambda n, d: 0, arity=2)\n"  # hit
+        "def _bind_raw_bad(server, rpc):\n"
+        "    rpc.register_raw(\"train\", h)\n"                       # hit
+        "def _bind_ok(server, rpc):\n"
+        "    co.usage_hook = _usage_batch_hook(server, \"train\")\n"
+        "    rpc.register(\"train\", h, arity=2)\n"                  # billed
+        "def _bind_pragma(server, rpc):\n"
+        "    rpc.register(\"classify\", h, arity=2)"
+        "  # no-usage - span-billed\n",
+        encoding="utf-8")
+    problems = codestyle.check_file(str(bad))
+    hits = [p for p in problems if "usage-recorder" in p]
+    assert len(hits) == 2, problems
+    assert ":3:" in hits[0] and ":5:" in hits[1]
+    # outside server/ the rule stays silent
+    other = tmp_path / "jubatus_tpu" / "framework"
+    other.mkdir(parents=True)
+    ok = other / "fine.py"
+    ok.write_text(
+        '"""doc."""\n'
+        "def _bind(rpc):\n"
+        "    rpc.register(\"classify\", h, arity=2)\n", encoding="utf-8")
+    assert not [p for p in codestyle.check_file(str(ok))
+                if "usage-recorder" in p]
+
+
 def test_store_crc_gate_fires_and_pragma_opts_out(tmp_path):
     """The model-store write rule (ISSUE 18): a backend put/put_blob
     site in a model_store module whose enclosing function shows no

@@ -1,0 +1,234 @@
+"""Usage-attribution plane (ISSUE 19): the 7-element principal
+envelope on BOTH transports (4/5/6/7-element frames, old-peer interop,
+malformed principals degrading instead of erroring, verbatim C++
+relay), the per-tenant ledger end to end on a live server, and the
+mergeable get_usage doc fold."""
+
+from __future__ import annotations
+
+import socket
+
+import msgpack
+import pytest
+
+from jubatus_tpu.rpc import native_server
+from jubatus_tpu.rpc import principal as principals
+from jubatus_tpu.rpc.client import RpcClient
+from jubatus_tpu.rpc.server import RpcServer
+
+CONF = {"method": "PA", "converter": {
+    "num_rules": [{"key": "*", "type": "num"}]}}
+
+
+def _whoami_server(native: bool):
+    srv = native_server.NativeRpcServer() if native else RpcServer()
+    srv.register("whoami", lambda: principals.current() or "", arity=0)
+    srv.serve_background(0, host="127.0.0.1")
+    return srv
+
+
+def _roundtrip(port: int, frame: bytes):
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(frame)
+        unp = msgpack.Unpacker(raw=False)
+        s.settimeout(10)
+        while True:
+            data = s.recv(65536)
+            assert data, "server closed without answering"
+            unp.feed(data)
+            for msg in unp:
+                return msg
+    finally:
+        s.close()
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_envelope_4_to_7_elements_both_transports(native):
+    """Every historical envelope shape answers; the 7th element lands
+    as the dispatch principal; earlier slots stay nil-paddable."""
+    if native and not native_server.available():
+        pytest.skip("native transport unavailable")
+    srv = _whoami_server(native)
+    try:
+        cases = [
+            ([0, 1, "whoami", []], ""),                      # plain
+            ([0, 2, "whoami", [], {}], ""),                  # traced
+            ([0, 3, "whoami", [], None, 30.0], ""),          # deadlined
+            ([0, 4, "whoami", [], None, None, "tenant-a"],   # principal
+             "tenant-a"),
+            ([0, 5, "whoami", [], {}, 30.0, "tenant-b"],     # all slots
+             "tenant-b"),
+        ]
+        for env, expect in cases:
+            msg = _roundtrip(srv.port, msgpack.packb(env))
+            assert msg[0] == 1 and msg[1] == env[1]
+            assert msg[2] is None, f"error for {env}: {msg[2]}"
+            assert msg[3] == expect, env
+    finally:
+        srv.stop()
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_malformed_principal_degrades_not_errors(native):
+    """A garbage 7th element bills as untagged/garbage — the dispatch
+    itself must still succeed (a bad tag is not a bad request)."""
+    if native and not native_server.available():
+        pytest.skip("native transport unavailable")
+    srv = _whoami_server(native)
+    try:
+        for seventh in (42, [], {}, b"\xff\xfebytes", ""):
+            env = [0, 9, "whoami", [], None, None, seventh]
+            msg = _roundtrip(srv.port, msgpack.packb(env))
+            assert msg[0] == 1 and msg[2] is None, (seventh, msg)
+            # non-string garbage degrades to no-principal; raw bytes
+            # decode with replacement and still bill SOMEONE
+            if not isinstance(seventh, (bytes, str)) or seventh == "":
+                assert msg[3] == ""
+    finally:
+        srv.stop()
+
+
+def test_old_peer_interop_untagged_client_stays_4_element():
+    """A client with no principal (and no trace/deadline) must emit the
+    byte-identical pre-ISSUE-19 4-element frame — old peers never see a
+    shape they don't know. With a principal set, the envelope grows to
+    exactly 7 with nil-padded trace/deadline slots."""
+    seen = []
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(4)
+    port = lsock.getsockname()[1]
+    import threading
+
+    def serve():
+        while True:
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            unp = msgpack.Unpacker(raw=False)
+            data = conn.recv(65536)
+            unp.feed(data)
+            for msg in unp:
+                seen.append(msg)
+                conn.sendall(msgpack.packb([1, msg[1], None, "ok"]))
+            conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        with RpcClient("127.0.0.1", port, timeout=10) as c:
+            assert c.call("ping") == "ok"
+        with principals.use("acme"):
+            with RpcClient("127.0.0.1", port, timeout=10) as c:
+                assert c.call("ping") == "ok"
+    finally:
+        lsock.close()
+    assert len(seen) == 2
+    assert len(seen[0]) == 4, seen[0]
+    assert len(seen[1]) == 7, seen[1]
+    assert seen[1][4] is None and seen[1][5] is None
+    assert seen[1][6] == "acme"
+
+
+def test_cpp_relay_forwards_principal_verbatim():
+    """The C++ relay forwards the whole 7-element frame verbatim: the
+    BACKEND's dispatch sees the tenant, with zero relay-side decode."""
+    if not native_server.available():
+        pytest.skip("native transport unavailable")
+    back = native_server.NativeRpcServer()
+    back.register("probe",
+                  lambda n: principals.current() or "", arity=1)
+    bport = back.serve_background(0, host="127.0.0.1")
+    front = native_server.NativeRpcServer()
+    front.register("probe", lambda n: "(python)", arity=1)
+    front.serve_background(0, host="127.0.0.1")
+    try:
+        assert front.relay_config(
+            ["probe"], {"c": [("127.0.0.1", bport)]}, timeout=5.0)
+        env = [0, 11, "probe", ["c"], None, None, "relayed-tenant"]
+        msg = _roundtrip(front.port, msgpack.packb(env))
+        assert msg[2] is None and msg[3] == "relayed-tenant", msg
+    finally:
+        front.stop()
+        back.stop()
+
+
+# -- the ledger end to end -----------------------------------------------------
+
+
+def test_server_bills_tenants_and_serves_get_usage():
+    """Tagged train/classify traffic lands in the per-tenant table; the
+    untagged stream bills (untagged); get_usage serves the mergeable
+    doc; the conservation identity (ledger CPU == span-plane CPU) holds
+    on a live server."""
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.server import EngineServer
+    from jubatus_tpu.utils import usage as usage_mod
+
+    srv = EngineServer("classifier", CONF)
+    port = srv.start(0)
+    try:
+        rows = [["a", Datum({"x": 1.0})]]
+        with principals.use("checkout"):
+            c = ClassifierClient("127.0.0.1", port, "")
+            for _ in range(5):
+                c.train(rows)
+            c.classify([Datum({"x": 1.0})])
+            c.close()
+        c = ClassifierClient("127.0.0.1", port, "")
+        c.train(rows)  # untagged stream
+        c.close()
+
+        doc = srv.usage.snapshot()
+        table = doc["table"]
+        assert "checkout" in table and "train" in table["checkout"]
+        assert table["checkout"]["train"][0] >= 5   # requests
+        assert "(untagged)" in table
+        # bytes flow both ways on every billed request
+        tot = srv.usage.totals()
+        assert tot["bytes_in"] > 0 and tot["bytes_out"] > 0
+
+        # conservation: the ledger's CPU books equal the span plane's
+        hists = srv.rpc.trace.snapshot()["hists"]
+        span_s = sum(h["total_s"] for n, h in hists.items()
+                     if n.startswith("rpc.") and
+                     not n.startswith("rpc.client."))
+        assert tot["cpu_seconds"] == pytest.approx(span_s, rel=1e-6)
+
+        # the RPC view is the same doc, keyed by node name
+        with RpcClient("127.0.0.1", port, timeout=10) as rc:
+            served = rc.call("get_usage", "")
+        (served_doc,) = served.values()
+        assert "checkout" in served_doc["table"]
+
+        # fold two node docs: cells SUM, capacity sums — never averages
+        fleet = usage_mod.merge_usage([doc, served_doc])
+        folded = {p: agg for p, agg in usage_mod.principal_rows(fleet)}
+        assert folded["checkout"]["requests"] >= \
+            2 * table["checkout"]["train"][0]
+    finally:
+        srv.stop()
+
+
+def test_get_status_carries_usage_rows():
+    """jubactl -c watch's tenant column reads usage.* rows straight off
+    get_status."""
+    from jubatus_tpu.client import ClassifierClient, Datum
+    from jubatus_tpu.server import EngineServer
+
+    srv = EngineServer("classifier", CONF)
+    port = srv.start(0)
+    try:
+        with principals.use("ads"):
+            c = ClassifierClient("127.0.0.1", port, "")
+            c.train([["a", Datum({"x": 1.0})]])
+            c.close()
+        srv.usage.tick(0.0)
+        with RpcClient("127.0.0.1", port, timeout=10) as rc:
+            st = next(iter(rc.call("get_status", "").values()))
+        assert st["usage.principals"] >= 1
+        assert st["usage.top_principal"]
+    finally:
+        srv.stop()

@@ -61,10 +61,14 @@ DEFAULT_TOLERANCE = 0.05
 #: ``_accuracy`` / ``_recall`` cover the data-quality plane (ISSUE 17):
 #: prequential accuracy and shadow recall — model quality going DOWN is
 #: the regression the whole plane exists to catch.
+#: ``_headroom`` covers the usage-attribution plane (ISSUE 19):
+#: ``capacity.headroom`` (spare capacity after per-tenant demand) —
+#: shrinking headroom at the same offered load means the replica got
+#: more expensive to run.
 _HIGHER = re.compile(
     r"(_per_sec($|_)|samples_per_sec|_speedup($|_)|_fraction($|_)"
     r"|_reduction($|_)|_capacity_per_replica($|_)|_quarantined($|_)"
-    r"|_recall_at_|_accuracy($|_)|_recall($|_))")
+    r"|_recall_at_|_accuracy($|_)|_recall($|_)|_headroom($|_))")
 #: key patterns whose smaller values are better. ``_per_host`` covers
 #: the hierarchical-mix scaling plane (ISSUE 9): wire bytes each host
 #: ships per round — the quantity the two-tier reduce holds down, so
@@ -94,12 +98,19 @@ _HIGHER = re.compile(
 #: wall time rides the existing ``_recovery_s`` pattern
 #: (``e2e_warmboot_recovery_s``) and the warm-beats-cold verdict rides
 #: ``_ok`` (``e2e_warmboot_beats_cold_ok``).
+#: ``_err_frac`` covers the usage-attribution plane (ISSUE 19): the
+#: conservation gap between the ledger's accounted CPU/device time and
+#: the span plane's process totals
+#: (``e2e_usage_attribution_err_frac``) — growth means requests are
+#: escaping attribution. The overhead verdicts ride the existing
+#: ``_ratio`` pattern (``e2e_usage_overhead_mean_ratio``).
 _LOWER = re.compile(
     r"(_ms($|_)|_ratio($|_)|_us($|_)|wire_mb|_per_host($|_)|drift"
     r"|_error(s)?($|_)|_timeouts|_errors_total|_denials|rows_lost"
     r"|_stall_ms($|_)|_lag_rounds($|_)"
     r"|_recovery_s($|_)|_violation_s($|_)|_psi($|_)"
-    r"|_coldstart_to_serving_s($|_)|_model_loss_rows($|_))")
+    r"|_coldstart_to_serving_s($|_)|_model_loss_rows($|_)"
+    r"|_err_frac($|_))")
 
 #: built-in per-key tolerance defaults (explicit --key-tolerance wins):
 #: the nproc16 sweep time-slices 16 gloo processes over however few

@@ -322,6 +322,50 @@ def _check_quality_coverage(path: str, tree: "ast.AST",
     return problems
 
 
+#: usage-attribution coverage gate (ISSUE 19): a train/classify path
+#: that bypasses the usage recorder serves tenants whose cost nobody
+#: accounts — the capacity model under-reads demand and the
+#: conservation gate drifts. So every ``register("train"|"classify",
+#: ...)`` / ``register_raw(...)`` site in ``jubatus_tpu/server/`` must
+#: sit in a function that routes through the usage recorder (a
+#: ``usage`` reference in the enclosing function is the evidence). A
+#: path genuinely billed elsewhere — e.g. covered by the dispatch-span
+#: sink alone — opts out per line with ``# no-usage`` stating where.
+_USAGE_SITE_RE = re.compile(
+    r"\.register(?:_raw)?\(\s*f?\"(?:train|classify)\"")
+_USAGE_REF_RE = re.compile(r"usage")
+
+
+def _check_usage_coverage(path: str, tree: "ast.AST",
+                          lines: List[str]) -> List[str]:
+    """train/classify registration sites in server modules must sit
+    inside a function referencing the usage recorder (or carry
+    ``# no-usage``)."""
+    funcs: List[tuple] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.append((node.lineno, node.end_lineno))
+    problems = []
+    for i, line in enumerate(lines, 1):
+        if not _USAGE_SITE_RE.search(line) or "# no-usage" in line:
+            continue
+        spans = [f for f in funcs if f[0] <= i <= f[1]]
+        if spans:
+            start, end = max(spans, key=lambda f: f[0])  # innermost
+            body = "\n".join(lines[start - 1:end])
+        else:
+            body = line
+        if not _USAGE_REF_RE.search(body):
+            problems.append(
+                f"{path}:{i}: train/classify registration without a "
+                "usage-recorder reference in the enclosing function "
+                "(bill the path through server.usage — utils/usage.py — "
+                "so per-tenant cost and the capacity model see this "
+                "stream; append '# no-usage — <where it IS billed>' "
+                "where the path is genuinely billed elsewhere)")
+    return problems
+
+
 def _check_event_coverage(path: str, posix: str, tree: "ast.AST",
                           lines: List[str]) -> List[str]:
     """Marker lines from EVENT_SITES must sit inside a function whose
@@ -487,6 +531,8 @@ def check_file(path: str) -> List[str]:
         if "jubatus_tpu/server/" in posix:
             problems.extend(_check_quality_coverage(path, tree,
                                                     text.splitlines()))
+            problems.extend(_check_usage_coverage(path, tree,
+                                                  text.splitlines()))
         if _is_guard_gated(posix):
             problems.extend(_check_guard_coverage(path, tree,
                                                   text.splitlines()))
