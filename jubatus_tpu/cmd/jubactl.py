@@ -49,6 +49,12 @@ with utils/usage.merge_usage (exact-table sums + heavy-hitter sketch
 merge, never gauge averaging) plus the fleet capacity/saturation/
 headroom picture; ``--top N`` bounds the table — see
 docs/OBSERVABILITY.md §11.
+``tune`` (ISSUE 20) scrapes the self-tuning performance plane
+(``get_tune``) and renders per-node tuner state — mode, the mix plan
+hill-climb (live/best wire+chunk, trials, convergence), coalescer and
+cadence gate state, actuation backoff — plus the recent decision
+journal (probe/retune/deepen/shallow/quicken/relax/blocked records,
+dry-run-tagged under ``--auto-tune observe``).
 Server flags (-C/-T/-D/-X/-S/-I/...) are forwarded to visor-spawned
 processes (jubactl.cpp:90-110).
 """
@@ -72,7 +78,8 @@ def _parser() -> argparse.ArgumentParser:
                             "metrics", "breakers", "trace", "alerts",
                             "watch", "profile", "drain", "rebalance",
                             "autoscale", "timeline", "incident",
-                            "rollback", "quality", "restore", "usage"])
+                            "rollback", "quality", "restore", "usage",
+                            "tune"])
     p.add_argument("trace_id", nargs="?", default="",
                    help="[trace] trace id to assemble (from a slow-log "
                         "record, a /metrics exemplar, or "
@@ -735,6 +742,98 @@ def show_usage(coord: Coordinator, engine: str, name: str,
               file=sys.stderr)
         return -1
     print(render_usage(engine, name, docs, top=top))
+    return 0
+
+
+def collect_tune(coord: Coordinator, engine: str,
+                 name: str) -> Dict[str, Dict[str, Any]]:
+    """Every member's ``get_tune`` doc keyed by node name. Per-node
+    state (each process tunes its own knobs), so members are scraped
+    directly; failures degrade per node."""
+    docs: Dict[str, Dict[str, Any]] = {}
+    for node in membership.get_all_nodes(coord, engine, name):
+        try:
+            with RpcClient(node.host, node.port, timeout=10.0) as c:
+                per_node = c.call("get_tune", name)
+        except Exception as e:  # noqa: BLE001 — partial view beats none
+            print(f"  <{node.name}: get_tune failed: {e}>",
+                  file=sys.stderr)
+            continue
+        docs.update(per_node or {})
+    return docs
+
+
+def render_tune(engine: str, name: str,
+                docs: Dict[str, Dict[str, Any]], last: int = 8) -> str:
+    """The ``-c tune`` view (pure; asserted by tests): per-node tuner
+    mode + plane state + the recent decision journal."""
+    lines: List[str] = [f"{engine}/{name}: auto-tune across "
+                        f"{len(docs)} node(s)"]
+    for node in sorted(docs):
+        st = docs[node] or {}
+        if not st:
+            lines.append(f"  {node}: tuner off (--auto-tune off)")
+            continue
+        head = f"  {node}: mode {st.get('mode', '?')}"
+        backoff = float(st.get("backoff_s") or 0.0)
+        if backoff > 0:
+            head += f"  backoff {backoff:g}s"
+        lines.append(head)
+        mix = st.get("mix")
+        if mix:
+            plan = f"{mix.get('wire')}/{mix.get('chunk_mb'):g}MB"
+            bits = [f"plan {plan}", f"trials {mix.get('trials', 0)}",
+                    "converged" if mix.get("converged") else "searching"]
+            if mix.get("best_wire") is not None:
+                bits.append(f"best {mix['best_wire']}/"
+                            f"{mix['best_chunk_mb']:g}MB"
+                            + (f" {mix['best_ms']:g}ms"
+                               if mix.get("best_ms") is not None else ""))
+            if mix.get("int8_blacklisted"):
+                bits.append("int8 BLACKLISTED (ef drift)")
+            lines.append("    mix: " + "  ".join(bits))
+        for cname, gate in sorted((st.get("coalescers") or {}).items()):
+            lines.append(f"    coalescer {cname}: streaks "
+                         f"hot {gate.get('hot_streak', 0)} / "
+                         f"cold {gate.get('cold_streak', 0)}")
+        gate = st.get("cadence") or {}
+        if gate:
+            lines.append(f"    cadence: streaks "
+                         f"hot {gate.get('hot_streak', 0)} / "
+                         f"cold {gate.get('cold_streak', 0)}")
+        journal = (st.get("journal") or [])[-max(0, last):]
+        for rec in journal:
+            action = rec.get("action", "?")
+            tag = " [dry-run]" if rec.get("dry_run") else ""
+            tgt = rec.get("target")
+            sig = rec.get("signals") or {}
+            detail = ""
+            if "wire" in sig:
+                detail = f" -> {sig.get('wire')}/{sig.get('chunk_mb')}MB"
+            elif "depth" in sig:
+                detail = f" -> depth {sig.get('depth')}"
+            elif "interval_sec" in sig:
+                detail = f" -> {sig.get('interval_sec')}s"
+            err = f"  ({rec['error']})" if rec.get("error") else ""
+            lines.append(f"    [{rec.get('ts', 0):.1f}] {action:<8} "
+                         f"{rec.get('reason', '')}"
+                         f"{' @' + str(tgt) if tgt else ''}"
+                         f"{detail}{tag}{err}")
+        if not journal:
+            lines.append("    (no decisions journaled yet)")
+    return "\n".join(lines)
+
+
+def show_tune(coord: Coordinator, engine: str, name: str,
+              last: int = 8) -> int:
+    """Self-tuning performance plane (ISSUE 20): per-node tuner state
+    and decision journal from ``get_tune``."""
+    docs = collect_tune(coord, engine, name)
+    if not docs:
+        print(f"no member of {engine}/{name} answered get_tune",
+              file=sys.stderr)
+        return -1
+    print(render_tune(engine, name, docs))
     return 0
 
 
@@ -1730,6 +1829,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return show_quality(coord, ns.type, ns.name)
         if ns.cmd == "usage":
             return show_usage(coord, ns.type, ns.name, top=ns.top)
+        if ns.cmd == "tune":
+            return show_tune(coord, ns.type, ns.name)
         if ns.cmd == "watch":
             return show_watch(coord, ns.type, ns.name, once=ns.once,
                               interval=ns.interval, window_s=ns.window)

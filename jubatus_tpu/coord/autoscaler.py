@@ -39,6 +39,14 @@ bumps the ``autoscale.{decisions,spawns,drains,blocked}`` counters;
 ``get_autoscale_status`` (served when :meth:`Autoscaler.serve` is up,
 registered under ``/jubatus/autoscalers``) exposes config, live state,
 and the journal tail to ``jubactl -c autoscale --watch``.
+
+ISSUE 20 extracted the generic halves — the confirm-streak/cooldown
+hysteresis and the journal/backoff/fault-site actuation discipline —
+into coord/controller.py (:class:`~jubatus_tpu.coord.controller
+.StreakGate` / :class:`~jubatus_tpu.coord.controller.ControllerLoop`)
+so the self-tuning performance plane (coord/perf_tuner.py) rides the
+same machinery. This module keeps the fleet-specific halves: signal
+polling, the min/max-bounded scale decision, and the visor actuators.
 """
 
 from __future__ import annotations
@@ -47,12 +55,11 @@ import dataclasses
 import logging
 import threading
 import time
-from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from jubatus_tpu.coord import membership
 from jubatus_tpu.coord.base import Coordinator, NodeInfo
-from jubatus_tpu.utils import events, faults
+from jubatus_tpu.coord.controller import ControllerLoop, StreakGate
 from jubatus_tpu.utils.timeseries import window_from_points
 from jubatus_tpu.utils.tracing import Registry
 
@@ -240,16 +247,17 @@ class Decision:
     target: str = ""          # scale_in: member to drain
 
 
-class AutoscalerCore:
+class AutoscalerCore(StreakGate):
     """The pure decision state machine — no RPC, no threads, clock
     injected: synthetic burn/queue timelines drive it in tests exactly
-    like production snapshots do."""
+    like production snapshots do. The streak/cooldown half is the
+    shared :class:`StreakGate` (coord/controller.py); this class adds
+    the fleet-shape classification and the bounded scale decision."""
 
     def __init__(self, config: AutoscaleConfig) -> None:
         self.config = config.validate()
-        self.hot_streak = 0
-        self.cold_streak = 0
-        self.last_action_ts = 0.0
+        StreakGate.__init__(self, config.scale_out_confirm,
+                            config.scale_in_confirm, config.cooldown_s)
         self.last_floor_restore_ts = 0.0
 
     # -- classification ------------------------------------------------------
@@ -279,8 +287,7 @@ class AutoscalerCore:
         now = snap.ts if now is None else float(now)
         n = snap.size
         hot, cold = self.is_hot(snap), self.is_cold(snap)
-        self.hot_streak = self.hot_streak + 1 if hot else 0
-        self.cold_streak = self.cold_streak + 1 if cold else 0
+        self.step(hot, cold)
         # floor restore: a dead replica must come back NOW — no confirm
         # streak, and a cooldown from a prior hot/cold action does not
         # delay it (the bench kills a member and times this). Repeat
@@ -295,28 +302,24 @@ class AutoscalerCore:
             self.last_action_ts = now
             return Decision("scale_out", "below_min_floor",
                             count=cfg.min_replicas - n)
-        in_cooldown = now - self.last_action_ts < cfg.cooldown_s \
-            and self.last_action_ts > 0
-        if hot and self.hot_streak >= cfg.scale_out_confirm:
+        if hot and self.hot_confirmed:
             if n >= cfg.max_replicas:
                 return Decision("hold", "hot_at_max")
-            if in_cooldown:
+            if self.in_cooldown(now):
                 return Decision("hold", "cooldown")
-            self.last_action_ts = now
-            self.hot_streak = 0
+            self.fired_hot(now)
             return Decision(
                 "scale_out", "sustained_hot",
                 count=min(cfg.scale_out_step, cfg.max_replicas - n))
-        if cold and self.cold_streak >= cfg.scale_in_confirm:
+        if cold and self.cold_confirmed:
             if n <= cfg.min_replicas:
                 return Decision("hold", "cold_at_min")
-            if in_cooldown:
+            if self.in_cooldown(now):
                 return Decision("hold", "cooldown")
             victim = self.least_loaded(snap)
             if victim is None:
                 return Decision("hold", "no_drainable_replica")
-            self.last_action_ts = now
-            self.cold_streak = 0
+            self.fired_cold(now)
             return Decision("scale_in", "sustained_cold",
                             target=victim.name)
         if hot:
@@ -326,10 +329,8 @@ class AutoscalerCore:
         return Decision("hold", "steady")
 
     def state(self) -> Dict[str, Any]:
-        return {"hot_streak": self.hot_streak,
-                "cold_streak": self.cold_streak,
-                "last_action_ts": self.last_action_ts,
-                "last_floor_restore_ts": self.last_floor_restore_ts}
+        return dict(self.gate_state(),
+                    last_floor_restore_ts=self.last_floor_restore_ts)
 
 
 class HookActuator:
@@ -407,110 +408,88 @@ class VisorActuator:
             c.call("drain", self.name, True)
 
 
-class Autoscaler:
+class Autoscaler(ControllerLoop):
     """The control loop: poll → decide → actuate → journal.
 
     ``tick()`` runs one cycle (tests and ``--once`` call it directly);
     ``start()`` runs it on a daemon thread every ``poll_interval_s``;
     ``serve()`` additionally exposes ``get_autoscale_status`` over RPC
-    and registers under ``/jubatus/autoscalers`` for the watch view."""
+    and registers under ``/jubatus/autoscalers`` for the watch view.
+    The journal/eventing/backoff machinery is the shared
+    :class:`ControllerLoop` (coord/controller.py)."""
+
+    subsystem = "autoscale"
 
     def __init__(self, coord: Coordinator, engine: str, name: str,
                  actuator: Any, config: Optional[AutoscaleConfig] = None,
                  registry: Optional[Registry] = None,
                  poller: Optional[Callable[..., FleetSnapshot]] = None
                  ) -> None:
+        self.config = (config or AutoscaleConfig()).validate()
+        ControllerLoop.__init__(self, self.config.journal_capacity,
+                                registry)
         self.coord = coord
         self.engine = engine
         self.name = name
         self.actuator = actuator
-        self.config = (config or AutoscaleConfig()).validate()
         self.core = AutoscalerCore(self.config)
-        self.registry = registry or Registry()
         self._poller = poller
-        self.journal: deque = deque(maxlen=self.config.journal_capacity)
-        self._jlock = threading.Lock()
-        #: actuation-failure backoff state (the never-hot-loop guard)
-        self.backoff_until = 0.0
-        self._backoff_s = 0.0
         self.last_snapshot: Optional[FleetSnapshot] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.rpc = None
         self.start_time = time.time()  # wall-clock
 
+    # -- ControllerLoop hooks ------------------------------------------------
+    def _counter_suffix(self, action: str,
+                        extra: Dict[str, Any]) -> Optional[str]:
+        return {"scale_out": "spawns", "scale_in": "drains",
+                "blocked": "blocked"}.get(action)
+
+    def _event_fields(self, signals: Dict[str, Any],
+                      extra: Dict[str, Any]) -> Dict[str, Any]:
+        return {"target": extra.get("target") or None,
+                "count": extra.get("count") or None,
+                "dry_run": extra.get("dry_run") or None,
+                "replicas": signals["replicas"]}
+
+    def _gauge_signals(self, signals: Dict[str, Any]) -> None:
+        self.registry.gauge("autoscale.replicas",
+                            float(signals["replicas"]))
+        self.registry.gauge("autoscale.burn_max", signals["burn_max"])
+        self.registry.gauge("autoscale.queue_per_replica",
+                            signals["queue_per_replica"])
+
+    def _on_actuation_failure(self) -> None:
+        # a failed actuation must not start the cooldown clock (or
+        # the floor-restore spacing) — the retry after backoff
+        # would otherwise wait both out
+        self.core.reset_clock()
+        self.core.last_floor_restore_ts = 0.0
+
+    def _backoff_bounds(self):
+        return self.config.backoff_initial_s, self.config.backoff_max_s
+
     # -- journal -------------------------------------------------------------
     def _record(self, action: str, reason: str, snap: FleetSnapshot,
                 now: float, **extra: Any) -> Dict[str, Any]:
-        # ISSUE 14 satellite: journal entries ride the event plane's HLC
-        # helper (ordering agrees with `jubactl -c timeline`), and every
-        # decision of consequence emits a timeline event whose id the
-        # journal entry cross-links (event_hlc)
-        h = events.hlc_now()
-        rec = {"ts": round(now, 3), "hlc": h, "action": action,
-               "reason": reason, "signals": snap.signals()}
-        rec.update(extra)
-        if action != "hold":
-            evt = self.registry.events.emit(
-                "autoscale", action,
-                severity="warning" if action == "blocked" else "info",
-                reason=reason, target=extra.get("target") or None,
-                count=extra.get("count") or None,
-                dry_run=extra.get("dry_run") or None,
-                replicas=snap.size)
-            if evt is not None:
-                rec["event_hlc"] = evt["hlc"]
-        with self._jlock:
-            self.journal.append(rec)
-        self.registry.count("autoscale.decisions")
-        if extra.get("dry_run"):
-            pass  # intent only: spawns/drains count actuations
-        elif action == "scale_out":
-            self.registry.count("autoscale.spawns")
-        elif action == "scale_in":
-            self.registry.count("autoscale.drains")
-        elif action == "blocked":
-            self.registry.count("autoscale.blocked")
-        sig = snap.signals()
-        self.registry.gauge("autoscale.replicas", float(sig["replicas"]))
-        self.registry.gauge("autoscale.burn_max", sig["burn_max"])
-        self.registry.gauge("autoscale.queue_per_replica",
-                            sig["queue_per_replica"])
-        if action != "hold":
-            log.info("autoscale %s (%s): %s%s", action, reason, sig,
-                     f" target={extra.get('target')}"
-                     if extra.get("target") else "")
-        return rec
+        return self.record(action, reason, snap.signals(), now, **extra)
 
-    # -- actuation (fault sites + backoff live here) -------------------------
+    # -- actuation (fault sites + backoff live in ControllerLoop) ------------
     def _actuate(self, decision: Decision, snap: FleetSnapshot,
                  now: float) -> Dict[str, Any]:
         site = "autoscale.spawn" if decision.action == "scale_out" \
             else "autoscale.drain"
-        try:
-            faults.fire(site)
-            if decision.action == "scale_out":
-                self.actuator.spawn(decision.count)
-            else:
-                self.actuator.drain(decision.target)
-        except Exception as e:  # broad-ok — actuation failure is a
-            # first-class outcome: journal it, back off, never hot-loop
-            self._backoff_s = min(
-                self.config.backoff_max_s,
-                (self._backoff_s * 2) or self.config.backoff_initial_s)
-            self.backoff_until = now + self._backoff_s
-            # a failed actuation must not start the cooldown clock (or
-            # the floor-restore spacing) — the retry after backoff
-            # would otherwise wait both out
-            self.core.last_action_ts = 0.0
-            self.core.last_floor_restore_ts = 0.0
-            return self._record(
-                "blocked", decision.reason, snap, now,
-                wanted=decision.action, target=decision.target,
-                count=decision.count, error=repr(e)[:200],
-                backoff_s=round(self._backoff_s, 3))
-        self._backoff_s = 0.0
-        self.backoff_until = 0.0
+        if decision.action == "scale_out":
+            fn = lambda: self.actuator.spawn(decision.count)  # noqa: E731
+        else:
+            fn = lambda: self.actuator.drain(decision.target)  # noqa: E731
+        ok, blocked = self.guarded(
+            site, fn, reason=decision.reason, signals=snap.signals(),
+            now=now, wanted=decision.action, target=decision.target,
+            count=decision.count)
+        if not ok:
+            return blocked
         extra: Dict[str, Any] = {}
         if decision.action == "scale_out" and \
                 getattr(self.actuator, "warm_spawn", False):
@@ -533,10 +512,10 @@ class Autoscaler:
         decision = self.core.observe(snap, now=now)
         if decision.action == "hold":
             return self._record("hold", decision.reason, snap, now)
-        if now < self.backoff_until:
+        if self.in_backoff(now):
             # intent survives (streaks rebuilt next tick), attempt
             # suppressed: this is the "never hot-loop" half of backoff
-            self.core.last_action_ts = 0.0
+            self.core.reset_clock()
             return self._record(
                 "hold", "backoff", snap, now, wanted=decision.action,
                 backoff_remaining_s=round(self.backoff_until - now, 3))
@@ -549,15 +528,12 @@ class Autoscaler:
 
     # -- status / RPC --------------------------------------------------------
     def status(self, last: int = 32) -> Dict[str, Any]:
-        with self._jlock:
-            tail = list(self.journal)[-max(0, int(last)):]
+        tail = self.journal_tail(last)
         doc: Dict[str, Any] = {
             "engine": self.engine, "name": self.name,
             "uptime_s": int(time.time() - self.start_time),  # wall-clock
             "config": dataclasses.asdict(self.config),
-            "state": dict(self.core.state(),
-                          backoff_until=round(self.backoff_until, 3),
-                          backoff_s=round(self._backoff_s, 3)),
+            "state": dict(self.core.state(), **self.backoff_state()),
             "counters": {k: v for k, v in self.registry.counters().items()
                          if k.startswith("autoscale.")},
             "gauges": {k: v for k, v in self.registry.gauges().items()

@@ -145,6 +145,16 @@ class CollectiveMixer(RpcLinearMixer):
         #: mixed-mode cluster falls back to the RPC mix instead of
         #: wedging the collective.
         self.compress = compress
+        #: per-process chunk plan override (ISSUE 20): None rides the
+        #: module default (collective.DEFAULT_CHUNK_MB). The mix-plane
+        #: tuner retargets this via set_wire_plan(); because the chunk
+        #: plan rides the prepare signature, a fleet mid-transition
+        #: mismatches at prepare and the round falls back to the RPC
+        #: mix — at most one fallback round per coherent plan change,
+        #: never a wedged collective.
+        self.chunk_mb: Optional[float] = None
+        #: monotonically bumped by set_wire_plan (status/journal hook)
+        self.plan_version = 0
         #: --mix-topology: the hierarchical-mix tier shape. ``""`` keeps
         #: the flat single-tier psum (and the legacy prepare-signature
         #: format — old peers interoperate); ``auto`` derives N hosts ×
@@ -197,6 +207,26 @@ class CollectiveMixer(RpcLinearMixer):
         #: get_status and the drift-rate gauge read these instead of
         #: paying device reductions per scrape
         self._ef_norms: Dict[str, float] = {}
+
+    def set_wire_plan(self, chunk_mb: Optional[float] = None,
+                      compress: Any = None) -> Dict[str, Any]:
+        """Retarget this member's wire plan (ISSUE 20 mix-plane tuner
+        actuator). Only the NEXT prepare signs the new plan — a round
+        already staged runs the plan it signed — and because the plan
+        rides the prepare signature, a fleet applying a change
+        non-simultaneously mismatches at prepare and mixes that round
+        over RPC: at most one fallback round per coherent transition,
+        never a wedged collective. Returns the applied plan."""
+        from jubatus_tpu.parallel.collective import _norm_compress
+
+        if chunk_mb is not None:
+            self.chunk_mb = max(0.25, float(chunk_mb))
+        if compress is not None:
+            self.compress = _norm_compress(compress)
+        self.plan_version += 1
+        return {"chunk_mb": self.chunk_mb,
+                "compress": _norm_compress(self.compress),
+                "plan_version": self.plan_version}
 
     def _resolve_topology(self) -> Optional[Any]:
         """The hierarchical tier shape this member will sign and enter
@@ -279,6 +309,7 @@ class CollectiveMixer(RpcLinearMixer):
                 return [int(self.model_version), "unsupported"]
             diffs = {name: m.get_diff() for name, m in mixables.items()}
         sig = _signature(diffs)
+        plan: Optional[Dict[str, Any]] = None
         if sig != "unsupported":
             # the compress mode AND the chunk plan ride the signature so
             # a mixed-mode or mixed-chunk-size cluster mismatches at
@@ -295,11 +326,19 @@ class CollectiveMixer(RpcLinearMixer):
             from jubatus_tpu.parallel.collective import (
                 DEFAULT_CHUNK_MB, QUANT_BLOCK, _norm_compress)
 
+            # snapshot the live plan ONCE: the signed plan and the plan
+            # the staged entry will enter the collective with must be
+            # the same object even if the tuner retargets mid-round
+            # (set_wire_plan between prepare and GO) — the entry runs
+            # the OLD signed plan, the NEW plan signs from next round
             mode = _norm_compress(self.compress)
+            chunk = DEFAULT_CHUNK_MB if self.chunk_mb is None \
+                else float(self.chunk_mb)
+            plan = {"mode": mode, "chunk_mb": chunk}
             sig += f"|bf16={int(mode == 'bf16')}"
             if mode == "int8":
                 sig += f"|quant=int8:{QUANT_BLOCK}"
-            sig += f"|chunk={DEFAULT_CHUNK_MB}"
+            sig += f"|chunk={chunk}"
             topo = self._resolve_topology()
             if topo is not None:
                 # hierarchical rounds sign their tier shape: a member
@@ -312,8 +351,11 @@ class CollectiveMixer(RpcLinearMixer):
         with self._staged_lock:
             # one staged round at a time: a newer prepare supersedes any
             # stale round a dead master left behind (its waiter sees the
-            # stage gone and exits)
-            self._staged = {rid: {"diffs": diffs, "union": union}}
+            # stage gone and exits). The SIGNED wire plan rides the stage:
+            # _enter_collective runs exactly what prepare signed, even if
+            # the tuner retargets the live plan between prepare and GO.
+            self._staged = {rid: {"diffs": diffs, "union": union,
+                                  "plan": plan}}
         threading.Thread(target=self._wait_for_go, args=(rid,), daemon=True,
                          name="mix-go-wait").start()
         return [int(self.model_version), sig]
@@ -465,9 +507,16 @@ class CollectiveMixer(RpcLinearMixer):
         from jubatus_tpu.parallel.collective import ChunkIntegrityError
 
         self.last_phases = {}
+        # enter with the plan prepare SIGNED, not the live attributes: a
+        # set_wire_plan() between prepare and GO must not change what
+        # this round runs (the peers verified the signed plan; a skewed
+        # chunk sequence would wedge the world). Legacy stages without a
+        # plan ride the live attributes, matching what they signed.
+        plan = entry.get("plan") or {}
         try:
             totals = psum_pytree_start(
-                entry["diffs"], compress=self.compress,
+                entry["diffs"], compress=plan.get("mode", self.compress),
+                chunk_mb=plan.get("chunk_mb", self.chunk_mb),
                 phases=self.last_phases, prefer_device=True,
                 feedback=self.ef, guard=self.guard.mode,
                 topology=self._resolve_topology()).result()
@@ -745,6 +794,8 @@ class CollectiveMixer(RpcLinearMixer):
                   fallback_rounds=self.fallback_rounds,
                   integrity_failures=self.integrity_failures,
                   mix_compress=_norm_compress(self.compress),
+                  mix_chunk_mb=self.chunk_mb,
+                  mix_plan_version=self.plan_version,
                   mix_topology=topo.signature if topo is not None
                   else "flat")
         if self._reps:

@@ -242,6 +242,9 @@ IDEMPOTENT_BUILTINS: FrozenSet[str] = frozenset({
     # durable model plane (ISSUE 18): the store/warm-boot status read
     # is pure
     "get_store_status",
+    # self-tuning performance plane (ISSUE 20): tuner state/journal
+    # read is pure
+    "get_tune",
 })
 
 #: effectful built-ins, listed for the docs' idempotency matrix (anything

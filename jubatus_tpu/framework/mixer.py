@@ -130,6 +130,16 @@ class IntervalMixer:
         """Synchronous mix (the reference's do_mix RPC)."""
         return self._run_mix()
 
+    def set_interval(self, sec: float) -> float:
+        """Retarget the cadence (ISSUE 20 async-mix cadence tuner). The
+        loop polls, so a new interval takes effect within POLL_SEC; the
+        caller (the tuner) owns the floor/ceiling policy — here we only
+        refuse non-positive values. Returns the applied interval."""
+        with self._cond:
+            self.interval_sec = max(0.001, float(sec))
+            self._cond.notify()
+            return self.interval_sec
+
     def _run_mix(self) -> Any:
         """Execute one mix round WITHOUT holding the condition lock: updated()
         callers (the train hot path) must never block behind a collective.

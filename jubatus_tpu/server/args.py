@@ -128,6 +128,18 @@ class ServerArgs:
     #: update norm exceeds this multiple of its PEERS' median norm is
     #: flagged (leave-one-out median; a quiet fleet judges nothing)
     mix_norm_bound: float = 10.0
+    #: --auto-tune: the self-tuning performance plane
+    #: (coord/perf_tuner.py, ISSUE 20). ``off`` = no tuner; ``observe``
+    #: = run every tuner core off the telemetry tick and journal
+    #: dry-run recommendations (``jubactl -c tune``) without touching a
+    #: knob; ``on`` = actuate — wire mode + chunk size through the
+    #: re-signed prepare plan, microbatch depth via Little's law, and
+    #: the async-mix cadence within the floor/ceiling below.
+    auto_tune: str = "off"
+    #: --tune-interval-floor/-ceiling: operator bounds (seconds) the
+    #: cadence tuner must stay inside when retargeting the mix interval
+    tune_interval_floor: float = 1.0
+    tune_interval_ceiling: float = 120.0
     #: --model-snapshot-interval: seconds between in-process model
     #: snapshots into the rollback ring (save_load envelope + CRC32,
     #: bounded depth). 0 = off. The snapshots are what
@@ -456,6 +468,24 @@ def build_parser(prog: str = "jubatus_tpu.server") -> argparse.ArgumentParser:
                         "is flagged (leave-one-out median — robust "
                         "from 2 contributors up; a quiet fleet judges "
                         "nothing)")
+    p.add_argument("--auto-tune", default="off",
+                   choices=["off", "observe", "on"],
+                   help="self-tuning performance plane "
+                        "(coord/perf_tuner.py): close the loop from "
+                        "telemetry to knobs. off = static flags only; "
+                        "observe = journal dry-run recommendations "
+                        "(jubactl -c tune) without touching anything; "
+                        "on = actuate — mix wire mode + chunk size "
+                        "(re-signed prepare plan, at most one RPC-"
+                        "fallback round per transition), microbatch "
+                        "depth (Little's-law residency target), and "
+                        "the async-mix cadence")
+    p.add_argument("--tune-interval-floor", type=float, default=1.0,
+                   help="cadence tuner floor (seconds): auto-tune "
+                        "never quickens the mix interval below this")
+    p.add_argument("--tune-interval-ceiling", type=float, default=120.0,
+                   help="cadence tuner ceiling (seconds): auto-tune "
+                        "never relaxes the mix interval above this")
     p.add_argument("--model-snapshot-interval", type=float, default=0.0,
                    help="seconds between in-process model snapshots "
                         "into the bounded rollback ring (save_load "
@@ -706,6 +736,11 @@ def parse_server_args(argv: Optional[List[str]] = None) -> ServerArgs:
             raise SystemExit(str(e))
     if args.mix_staleness_bound < 0:
         raise SystemExit("--mix-staleness-bound must be >= 0")
+    if args.tune_interval_floor <= 0:
+        raise SystemExit("--tune-interval-floor must be > 0")
+    if args.tune_interval_floor > args.tune_interval_ceiling:
+        raise SystemExit("--tune-interval-floor must not exceed "
+                         "--tune-interval-ceiling")
     if args.mix_norm_bound <= 0:
         raise SystemExit("--mix-norm-bound must be > 0")
     if args.model_snapshot_interval < 0:

@@ -302,6 +302,42 @@ class EngineServer:
 
             self.driver.event_model_updated = chained  # type: ignore[assignment]
 
+        # self-tuning performance plane (ISSUE 20): the telemetry-to-
+        # knobs loop (coord/perf_tuner.py) rides the same telemetry tick
+        # as every other periodic plane. Created AFTER the mixer block —
+        # its adapter reads self.mixer/self.coalescers as they exist now.
+        from jubatus_tpu.coord.perf_tuner import (PerfTuner,
+                                                  ServerTuneAdapter,
+                                                  TunerConfig)
+
+        self.tuner: Optional[PerfTuner] = None
+        tune_mode = getattr(self.args, "auto_tune", "off")
+        if tune_mode != "off":
+            self.tuner = PerfTuner(
+                TunerConfig(
+                    mode=tune_mode,
+                    interval_floor_s=getattr(
+                        self.args, "tune_interval_floor", 1.0),
+                    interval_ceiling_s=getattr(
+                        self.args, "tune_interval_ceiling", 120.0)),
+                ServerTuneAdapter(self), registry=self.rpc.trace)
+            self.telemetry.hooks.append(self._tune_tick)
+
+    def _tune_tick(self) -> None:
+        """One perf-tuner pass per telemetry tick (PerfTuner.tick never
+        raises — a sick adapter must not kill the telemetry thread)."""
+        if self.tuner is not None:
+            self.tuner.tick()
+
+    def get_tune(self, _name: str = "") -> Dict[str, Any]:
+        """This node's self-tuning state (coord/perf_tuner.py): mode,
+        per-plane core state, backoff, and the decision journal — the
+        per-node half of ``jubactl -c tune``."""
+        node = NodeInfo(self.args.eth, self.rpc.port or self.args.rpc_port)
+        if self.tuner is None:
+            return {node.name: {}}
+        return {node.name: self.tuner.status()}
+
     # -- construction from files/argv (run_server, server_util.hpp:139-176) --
     @classmethod
     def from_args(cls, args: ServerArgs,
@@ -1062,10 +1098,18 @@ class EngineServer:
         # the microbatch.<name>.* stats lines in get_status
         if self.coalescers:
             depth = arrival = 0.0
-            for co in self.coalescers.values():
+            for name, co in self.coalescers.items():
                 if hasattr(co, "queue_depth"):
                     depth += co.queue_depth()
                     arrival += co.arrival_per_sec()
+                # trailing flush-duration EWMA per queue (ISSUE 20): the
+                # one drain-rate estimate the coalescer tuner's Little's-
+                # law target and the capacity model both read
+                st = co.stats() if hasattr(co, "stats") else {}
+                fm = st.get("flush_ms_ewma")
+                if isinstance(fm, (int, float)) and fm > 0:
+                    self.rpc.trace.gauge(
+                        f"microbatch.{name}.flush_ms_ewma", float(fm))
             self.rpc.trace.gauge("microbatch.queue_depth", depth)
             self.rpc.trace.gauge("microbatch.arrival_per_sec",
                                  round(arrival, 1))
@@ -1139,19 +1183,20 @@ class EngineServer:
                             "points": self.timeseries.points()}}
 
     def _capacity_rows_per_sec(self) -> float:
-        """This replica's capacity estimate: rows the device plane
-        drains per busy second, from the same measured per-flush
-        throughput the autoscaler's signals derive from (coalescer
-        stats). 0 until a device stage has actually run — a cold
-        replica publishes no headroom rather than a fictitious one."""
-        rows = busy = 0.0
+        """This replica's capacity estimate: rows the drain plane moves
+        per busy second, from each queue's trailing flush EWMA × its
+        average batch — the SAME estimate the coalescer tuner's
+        Little's-law target reads (one throughput model, two consumers;
+        ISSUE 20). 0 until a flush has actually run — a cold replica
+        publishes no headroom rather than a fictitious one."""
+        total = 0.0
         for co in self.coalescers.values():
             st = co.stats() if hasattr(co, "stats") else {}
-            dev = float(st.get("device_seconds", 0.0))
-            if dev > 0.0:
-                rows += float(st.get("item_count", 0))
-                busy += dev
-        return rows / busy if busy > 0.0 else 0.0
+            flush_ms = float(st.get("flush_ms_ewma", 0.0))
+            avg_batch = float(st.get("avg_batch", 0.0))
+            if flush_ms > 0.0 and avg_batch > 0.0:
+                total += avg_batch / (flush_ms / 1e3)
+        return total
 
     def get_usage(self, _name: str = "") -> Dict[str, Any]:
         """This node's usage-attribution doc (utils/usage.py): the

@@ -35,6 +35,11 @@ from jubatus_tpu.rpc import principal as principals
 
 __all__ = ["Coalescer", "PipelinedCoalescer"]
 
+#: trailing flush-duration EWMA weight (ISSUE 20): one estimate shared
+#: by the coalescer tuner's Little's-law target and the capacity model
+#: in utils/usage.py — ~10 flushes of memory, newest weighted heaviest
+FLUSH_EWMA_ALPHA = 0.2  # knob-ok — the smoothing weight, not a depth
+
 
 class _Ticket:
     __slots__ = ("event", "result", "error", "count", "weight",
@@ -100,6 +105,12 @@ class Coalescer:
         self._pending_weight = 0
         self._arrived = 0
         self._arrival_ref = (time.monotonic(), 0)
+        #: trailing flush-duration EWMA (ms); 0 until a flush has run.
+        #: The single-stage coalescer folds the whole flush in, the
+        #: pipelined one folds only the device stage — either way this
+        #: is the drain-rate estimate the coalescer tuner and the
+        #: capacity model share (ISSUE 20)
+        self._flush_ms_ewma = 0.0
         #: usage attribution (ISSUE 19): when set, called once per
         #: completed ticket as hook(principal, rows, queue_seconds,
         #: device_share_seconds) — the flush's device time amortized by
@@ -218,6 +229,28 @@ class Coalescer:
             except Exception:  # broad-ok — billing is best-effort
                 pass
 
+    def _note_flush_ms(self, dt_s: float) -> None:
+        """Fold one flush's duration into the trailing EWMA (under the
+        queue lock — stats() reads it there)."""
+        ms = dt_s * 1e3
+        with self._lock:
+            self._flush_ms_ewma = ms if self._flush_ms_ewma == 0.0 else \
+                FLUSH_EWMA_ALPHA * ms \
+                + (1.0 - FLUSH_EWMA_ALPHA) * self._flush_ms_ewma
+
+    def set_max_batch(self, depth: int) -> int:
+        """Retarget the per-flush example bound (the coalescer tuner's
+        actuation point, ISSUE 20). Clamped to >= 1 — a zero depth
+        would wedge every submit. Returns the applied value."""
+        depth = max(1, int(depth))
+        with self._lock:
+            self._max_batch = depth
+        return depth
+
+    @property
+    def max_batch(self) -> int:
+        return self._max_batch
+
     def _drain(self) -> None:
         while True:
             with self._lock:
@@ -225,7 +258,7 @@ class Coalescer:
                 if claimed is None:
                     return
                 batch, tickets, batch_weight = claimed
-            t0 = time.perf_counter() if self.usage_hook is not None else 0.0
+            t0 = time.perf_counter()
             try:
                 result = self._flush(batch)
                 if self._split:
@@ -244,12 +277,13 @@ class Coalescer:
                 for t in tickets:
                     t.error = e
             finally:
+                dt = time.perf_counter() - t0
                 with self._lock:
                     self.flush_count += 1
                     self.item_count += batch_weight  # examples, not items
+                self._note_flush_ms(dt)
                 # single-stage flush: the whole flush IS the device step
-                self._bill(tickets, batch_weight,
-                           time.perf_counter() - t0 if t0 else 0.0)
+                self._bill(tickets, batch_weight, dt)
                 for t in tickets:
                     t.event.set()
 
@@ -278,12 +312,16 @@ class Coalescer:
         with self._lock:
             flushes, items = self.flush_count, self.item_count
             depth = self._pending_weight
+            flush_ms = self._flush_ms_ewma
+            max_batch = self._max_batch
         return {
             "flush_count": flushes,
             "item_count": items,
             "avg_batch": (items / flushes if flushes else 0.0),
             "queue_depth": depth,
             "arrival_per_sec": round(rate, 1),
+            "flush_ms_ewma": round(flush_ms, 3),
+            "max_batch": max_batch,
         }
 
 
@@ -378,6 +416,9 @@ class PipelinedCoalescer(Coalescer):
                     self._dev_busy_total += dt
                     self.device_seconds += dt
                     self._dev_busy_since = None
+                # the device stage IS the drain rate here — the prep
+                # stage overlaps it, so only stage 2 bounds throughput
+                self._note_flush_ms(dt)
                 self._finish(tickets, batch_weight, device_dt=dt)
                 self._dev_slot.release()
 

@@ -74,6 +74,8 @@ def bind_engine(rpc: RpcServer, server: Any) -> None:
     rpc.register("get_quality", server.get_quality, arity=1)
     # usage-attribution plane (ISSUE 19): per-principal cost ledger doc
     rpc.register("get_usage", server.get_usage, arity=1)
+    # self-tuning performance plane (ISSUE 20): tuner state + journal
+    rpc.register("get_tune", server.get_tune, arity=1)
     # continuous profiling plane (ISSUE 8): folded stack profile +
     # on-demand XLA device capture
     rpc.register("get_profile", server.get_profile, arity=2)

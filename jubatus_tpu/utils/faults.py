@@ -37,6 +37,11 @@ Sites are dotted names matched with fnmatch, e.g. ``rpc.call.get_diff``,
 actuation sites ``autoscale.spawn`` / ``autoscale.drain`` (a fired
 error there must surface as a ``blocked`` journal record with
 exponential backoff, never a hot-loop — coord/autoscaler.py). The
+self-tuning plane (ISSUE 20) actuates through
+``tune.mix.apply`` / ``tune.coalescer.apply`` / ``tune.cadence.apply``
+(coord/perf_tuner.py) with the same blocked/backoff contract — and
+because the sites fire BEFORE the knob mutates, a failed apply leaves
+the fleet on its previous coherent plan, never a mixed one. The
 model-integrity plane (ISSUE 15) adds two MUTATION-aware sites:
 ``mix.diff.poison`` (the member's diff snapshot, as it leaves the
 model lock — ``nan``/``scale:F`` model a sick replica) and

@@ -462,3 +462,36 @@ def test_glob_picks_latest_two(tmp_path, capsys):
     assert "BENCH_r02.json" in out and "BENCH_r03.json" in out
     with pytest.raises(ValueError):
         bc.pick_latest_two(str(tmp_path / "nope*.json"))
+
+
+def test_direction_inference_tune_keys():
+    """ISSUE 20 self-tuning plane: regret (tuned-vs-hand-tuned round
+    time) rides the _ratio pattern; the rounds-to-converge count is its
+    own down-good pattern (growth = the search got slower); the
+    observe-mode A/B overhead rides _ratio too."""
+    assert bc.direction("e2e_tune_regret_ratio") == "lower"
+    assert bc.direction("e2e_tune_converge_rounds") == "lower"
+    assert bc.direction("e2e_tune_observe_overhead_ratio") == "lower"
+    # neighbors that must NOT accidentally gate
+    assert bc.direction("e2e_tune_rounds_total") is None
+    assert bc.direction("e2e_tune_plans_scored") is None
+
+
+def test_tune_keys_gate_over_fixtures():
+    """The regret/converge directions drive real verdicts: regret
+    drifting up or the search needing more rounds each REGRESS; both
+    shrinking count as improvements."""
+    old = {"e2e_tune_regret_ratio": 1.10,
+           "e2e_tune_converge_rounds": 8}
+    worse = {"e2e_tune_regret_ratio": 1.40,
+             "e2e_tune_converge_rounds": 14}
+    rows, regs = bc.compare(old, worse, tolerance=0.05)
+    assert {r["key"] for r in regs} == \
+        {"e2e_tune_regret_ratio", "e2e_tune_converge_rounds"}
+    better = {"e2e_tune_regret_ratio": 1.02,
+              "e2e_tune_converge_rounds": 5}
+    rows, regs = bc.compare(old, better, tolerance=0.05)
+    assert regs == []
+    verdicts = {r["key"]: r["verdict"] for r in rows}
+    assert verdicts["e2e_tune_regret_ratio"] == "improved"
+    assert verdicts["e2e_tune_converge_rounds"] == "improved"

@@ -263,3 +263,56 @@ def test_metrics_docs_catalog_clean():
         capture_output=True, text=True, cwd=str(repo))
     assert r.returncode == 0, \
         f"undocumented metric keys:\n{r.stdout[-4000:]}\n{r.stderr[-2000:]}"
+
+
+def test_knob_gate_fires_and_pragma_opts_out(tmp_path):
+    """The tuner-knob rule (ISSUE 20): a hard-coded numeric for a
+    tuner-actuated knob (chunk size, coalescer depth, mix cadence)
+    inside an actuated module is flagged — it is a second source of
+    truth the runtime tuner would silently fight; the # knob-ok pragma,
+    reads of the live attribute, and non-gated modules are not."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo / "tools" / "codestyle"))
+    try:
+        import check as codestyle
+    finally:
+        sys.path.pop(0)
+    d = tmp_path / "jubatus_tpu" / "framework"
+    d.mkdir(parents=True)
+    bad = d / "mixer.py"
+    bad.write_text(
+        '"""doc."""\n'
+        "self.chunk_mb = 8.0\n"                                # flagged
+        "CHUNK_MB = 4.0\n"                                     # flagged
+        "self.interval_sec = 16\n"                             # flagged
+        "depth = co.max_batch\n"                               # a read
+        "self.chunk_mb = max(0.25, float(v))\n"                # computed
+        "self.chunk_mb = 2.0  # knob-ok - compat default\n",   # pragma
+        encoding="utf-8")
+    problems = codestyle.check_file(str(bad))
+    hits = [p for p in problems if "tuner knob constant" in p]
+    assert len(hits) == 3, problems
+    assert ":2:" in hits[0] and ":3:" in hits[1] and ":4:" in hits[2]
+    # the SAME text in a module the tuner does not actuate is clean
+    other = tmp_path / "jubatus_tpu" / "framework" / "other.py"
+    other.write_text('"""doc."""\nself.chunk_mb = 8.0\n', encoding="utf-8")
+    assert not [p for p in codestyle.check_file(str(other))
+                if "tuner knob constant" in p]
+
+
+def test_controller_journal_is_event_covered():
+    """The EVENT_SITES gate follows the journal: the shared controller
+    core (coord/controller.py) owns the decision-journal append the
+    autoscaler used to — the marker must still be registered there and
+    the real file must pass (record() emits into the event plane)."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo / "tools" / "codestyle"))
+    try:
+        import check as codestyle
+    finally:
+        sys.path.pop(0)
+    gated = [s for s, _, _ in codestyle.EVENT_SITES]
+    assert "jubatus_tpu/coord/controller.py" in gated
+    assert "jubatus_tpu/coord/autoscaler.py" not in gated
+    real = repo / "jubatus_tpu" / "coord" / "controller.py"
+    assert codestyle.check_file(str(real)) == []

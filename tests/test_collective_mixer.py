@@ -676,3 +676,175 @@ def test_ef_residual_survives_failed_collective_entry(monkeypatch):
         c.close()
     finally:
         srv.stop()
+
+
+def test_set_wire_plan_resigns_prepare_and_stages_plan():
+    """The tuner's actuator (ISSUE 20): ``set_wire_plan`` re-signs the
+    NEXT prepare with the new ``|chunk=``/``|quant=`` components, and a
+    round snapshots its plan at prepare — a plan change landing between
+    prepare and GO must not diverge the signed plan from the entered
+    plan (the staged snapshot, not the live knob, enters the psum)."""
+    store = _Store()
+    args = ServerArgs(engine="classifier", coordinator="(shared)",
+                      name=NAME, listen_addr="127.0.0.1",
+                      mixer="collective_mixer",
+                      interval_sec=1e9, interval_count=1 << 30)
+    srv = EngineServer("classifier", CONF, args,
+                       coord=MemoryCoordinator(store))
+    srv.start(0)
+    try:
+        from jubatus_tpu.client import ClassifierClient, Datum
+
+        c = ClassifierClient("127.0.0.1", srv.args.rpc_port, NAME)
+        c.train([["pos", Datum({"a": 1.0})]])
+        _v, sig_a = srv.mixer.local_prepare("r-a", [])
+        srv.mixer.local_abort("r-a")
+
+        st = srv.mixer.set_wire_plan(chunk_mb=2.0, compress="bf16")
+        assert st == {"chunk_mb": 2.0, "compress": "bf16",
+                      "plan_version": 1}
+        _v, sig_b = srv.mixer.local_prepare("r-b", [])
+        assert sig_b.endswith("|bf16=1|chunk=2.0"), sig_b
+        assert sig_b != sig_a
+        staged = srv.mixer._staged["r-b"]["plan"]
+        assert staged == {"mode": "bf16", "chunk_mb": 2.0}
+        # a plan change BETWEEN prepare and GO leaves the staged round
+        # on the plan it signed
+        srv.mixer.set_wire_plan(chunk_mb=16.0, compress="int8")
+        assert srv.mixer._staged["r-b"]["plan"] == \
+            {"mode": "bf16", "chunk_mb": 2.0}
+        srv.mixer.local_abort("r-b")
+        # ...and the round AFTER it signs the new plan
+        from jubatus_tpu.parallel.collective import QUANT_BLOCK
+
+        _v, sig_c = srv.mixer.local_prepare("r-c", [])
+        srv.mixer.local_abort("r-c")
+        assert sig_c.endswith(
+            f"|bf16=0|quant=int8:{QUANT_BLOCK}|chunk=16.0"), sig_c
+        # jubactl-facing: the live plan is visible in get_status
+        status = srv.mixer.get_status()
+        assert status["mix_chunk_mb"] == 16.0
+        assert status["mix_plan_version"] == 2
+        c.close()
+    finally:
+        srv.stop()
+
+
+_CHILD_PLAN_CHANGE = r"""
+import os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); n = int(sys.argv[2])
+jax_port, coord_dir = sys.argv[3], sys.argv[4]
+from jubatus_tpu.parallel.multihost import enable_cpu_collectives
+enable_cpu_collectives()
+jax.distributed.initialize(f"127.0.0.1:{jax_port}", num_processes=n,
+                           process_id=pid)
+assert jax.process_count() == n
+
+from jubatus_tpu.client import ClassifierClient, Datum
+from jubatus_tpu.coord import membership
+from jubatus_tpu.server import EngineServer
+from jubatus_tpu.server.args import ServerArgs
+
+CONF = {"method": "PA", "parameter": {"regularization_weight": 1.0},
+        "converter": {"num_rules": [{"key": "*", "type": "num"}]}}
+args = ServerArgs(engine="classifier", coordinator=coord_dir, name="cm",
+                  listen_addr="127.0.0.1", mixer="collective_mixer",
+                  interval_sec=1e9, interval_count=1 << 30)
+srv = EngineServer("classifier", CONF, args)
+port = srv.start(0)
+
+mark = lambda tag: open(coord_dir.rstrip("/") + "." + tag, "w").close()
+def wait_mark(tag, deadline):
+    path = coord_dir.rstrip("/") + "." + tag
+    while time.time() < deadline:
+        if os.path.exists(path):
+            return
+        time.sleep(0.1)
+    raise AssertionError("timed out waiting for " + tag)
+
+me = f"x{pid}"
+c = ClassifierClient("127.0.0.1", port, "cm", timeout=60)
+for _ in range(4):
+    c.train([["pos", Datum({me: 1.0})], ["neg", Datum({me: -1.0})]])
+
+deadline = time.time() + 120
+while time.time() < deadline:
+    if len(membership.get_all_nodes(srv.coord, "classifier", "cm")) == n:
+        break
+    time.sleep(0.2)
+
+if pid == 0:
+    time.sleep(1.0)  # let every replica finish its training calls
+    # round 1: whole fleet on plan A -> collective
+    out = srv.mixer.mix_now()
+    assert out and out.get("collective") is True, out
+    # STAGGERED transition: only the master has applied plan B when
+    # round 2 runs -> prepare signatures mismatch -> exactly one
+    # RPC-fallback round; the round still completes (never wedges)
+    srv.mixer.set_wire_plan(chunk_mb=2.0, compress="bf16")
+    out2 = srv.mixer.mix_now()
+    assert out2 and not out2.get("collective"), out2
+    st = srv.mixer.get_status()
+    assert st["collective_rounds"] == 1, st
+    assert st["fallback_rounds"] == 1, st
+    mark("plan_b")  # now let the stragglers catch up
+    for p in range(1, n):
+        wait_mark(f"ack{p}", deadline)
+    # round 3: whole fleet on plan B -> collective again, under the
+    # NEW plan (chunk 2.0, bf16 on the wire)
+    out3 = srv.mixer.mix_now()
+    assert out3 and out3.get("collective") is True, out3
+    st = srv.mixer.get_status()
+    assert st["collective_rounds"] == 2, st
+    assert st["fallback_rounds"] == 1, st
+    recs = srv.mixer.flight.snapshot()
+    col_ok = [r for r in recs
+              if r.get("mode") == "collective" and r.get("ok")]
+    col_bad = [r for r in recs
+               if r.get("mode") == "collective" and not r.get("ok")]
+    # the one fallback was a clean prepare mismatch, not a failed round
+    assert len(col_bad) == 1, recs
+    assert "prepare_not_viable" in col_bad[0]["reason"], col_bad
+    # the post-change collective really ran the new plan
+    ph = col_ok[-1].get("phases") or {}
+    assert ph.get("quant") == "bf16", col_ok[-1]
+    assert ph.get("chunk_mb") == 2.0, col_ok[-1]
+    mark("done")
+else:
+    wait_mark("plan_b", deadline)
+    srv.mixer.set_wire_plan(chunk_mb=2.0, compress="bf16")
+    mark(f"ack{pid}")
+    wait_mark("done", deadline)
+    # both collective rounds applied here (fallback pushed via RPC too)
+    assert srv.mixer.model_version >= 2, srv.mixer.model_version
+
+# model stayed correct through the transition: a feature trained ONLY
+# on another process scores here
+other = f"x{(pid + 1) % n}"
+(res,) = c.classify([Datum({other: 1.0})])
+scores = dict(res)
+assert scores["pos"] > 0.0 > scores["neg"], (other, scores)
+c.close()
+srv.stop()
+print(f"CHILD-{pid}-OK", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_multiprocess_plan_change_coherence():
+    """ISSUE 20 acceptance: a wire-plan change rolling through a REAL
+    3-process world costs AT MOST one RPC-fallback round. Fleet on plan
+    A mixes collectively; the master applies plan B first (staggered) —
+    that round mismatches at prepare and completes over the RPC mix
+    (never a wedged or failed round); once every member applies B, the
+    next round is collective again and its flight record proves the new
+    chunk/wire actually hit the psum."""
+    import bench_mix
+
+    n = 3
+    outs, rcs = bench_mix.run_jax_world(_CHILD_PLAN_CHANGE, n, timeout=240)
+    for i, (out, rc) in enumerate(zip(outs, rcs)):
+        assert rc == 0, f"child {i} exit {rc}:\n{out[-3000:]}"
+        assert f"CHILD-{i}-OK" in out, f"child {i}:\n{out[-3000:]}"

@@ -104,13 +104,18 @@ _HIGHER = re.compile(
 #: (``e2e_usage_attribution_err_frac``) — growth means requests are
 #: escaping attribution. The overhead verdicts ride the existing
 #: ``_ratio`` pattern (``e2e_usage_overhead_mean_ratio``).
+#: ``_converge_rounds`` covers the self-tuning plane (ISSUE 20): mix
+#: rounds the perf tuner burned before landing within the regret band
+#: of the hand-tuned optimum (``e2e_tune_converge_rounds``) — growth
+#: means the search got slower; the regret itself rides ``_ratio``
+#: (``e2e_tune_regret_ratio``).
 _LOWER = re.compile(
     r"(_ms($|_)|_ratio($|_)|_us($|_)|wire_mb|_per_host($|_)|drift"
     r"|_error(s)?($|_)|_timeouts|_errors_total|_denials|rows_lost"
     r"|_stall_ms($|_)|_lag_rounds($|_)"
     r"|_recovery_s($|_)|_violation_s($|_)|_psi($|_)"
     r"|_coldstart_to_serving_s($|_)|_model_loss_rows($|_)"
-    r"|_err_frac($|_))")
+    r"|_err_frac($|_)|_converge_rounds($|_))")
 
 #: built-in per-key tolerance defaults (explicit --key-tolerance wins):
 #: the nproc16 sweep time-slices 16 gloo processes over however few

@@ -171,12 +171,35 @@ EVENT_SITES = (
     ("jubatus_tpu/utils/slo.py",
      re.compile(r"st\[\"firing\"\]\s*="),
      "SLO firing transition"),
-    ("jubatus_tpu/coord/autoscaler.py",
+    ("jubatus_tpu/coord/controller.py",
      re.compile(r"self\.journal\.append\("),
-     "autoscaler decision/actuation record"),
+     "controller decision/actuation record"),
 )
 
 _EMIT_RE = re.compile(r"(\bevents\.emit\(|\.events\.emit\(|self\._emit\()")
+
+
+#: tuner-knob gate (ISSUE 20): the perf tuner actuates the wire chunk
+#: size, wire mode, coalescer depth, and mix cadence at runtime — a
+#: HARD-CODED numeric for one of those knobs inside an actuated module
+#: is a second source of truth the tuner silently fights (the knob
+#: snaps back, or two code paths disagree about the plan). The single
+#: home for knob defaults is coord/perf_tuner.TUNER_DEFAULTS plus the
+#: operator flags in server/args.py; everything else must READ the
+#: live attribute. A genuinely static constant (a floor, an EWMA
+#: smoothing factor, a compatibility default that predates the tuner)
+#: opts out per line with a ``# knob-ok`` pragma stating why.
+TUNED_KNOB_FILES = (
+    "jubatus_tpu/framework/collective_mixer.py",
+    "jubatus_tpu/framework/mixer.py",
+    "jubatus_tpu/framework/async_mixer.py",
+    "jubatus_tpu/server/microbatch.py",
+    "jubatus_tpu/parallel/collective.py",
+)
+
+_KNOB_RE = re.compile(
+    r"\b(chunk_mb|chunk_bytes|max_batch|interval_sec|flush_interval_ms"
+    r"|premix_interval)\s*[=:]\s*[-+]?[0-9.]", re.IGNORECASE)
 
 
 #: model-integrity coverage gate (ISSUE 15): mix is model averaging —
@@ -457,6 +480,8 @@ def check_file(path: str) -> List[str]:
         d in posix for d in FULL_GATHER_DIRS)
     ann_path = path.endswith(".py") and _is_ann_query_path(posix)
     span_timed = path.endswith(".py") and _is_span_timed(posix)
+    knob_gate = path.endswith(".py") and any(
+        posix.endswith(f) for f in TUNED_KNOB_FILES)
     for i, line in enumerate(text.splitlines(), 1):
         if "\t" in line and not allow_tabs:
             problems.append(f"{path}:{i}: tab character")
@@ -492,6 +517,16 @@ def check_file(path: str) -> List[str]:
                 "cells' gathered candidates via ops/ivf.py candidate_* "
                 "kernels; append '# full-scan-ok — <why>' where a full "
                 "sweep is genuinely required)")
+        if knob_gate and "# knob-ok" not in line and \
+                _KNOB_RE.search(line):
+            problems.append(
+                f"{path}:{i}: hard-coded tuner knob constant in an "
+                "actuated module (the perf tuner owns this knob at "
+                "runtime — a literal here is a second source of truth "
+                "the tuner fights; put defaults in coord/perf_tuner."
+                "TUNER_DEFAULTS or server/args.py and read the live "
+                "attribute; append '# knob-ok — <why>' where a static "
+                "constant is genuinely required)")
         if hot_time and "time.time()" in line and "# wall-clock" not in line:
             problems.append(
                 f"{path}:{i}: raw time.time() in a hot-path module (use "
